@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric mirrors the reference's `benchmark_score.py` (docs/faq/perf.md):
+ResNet-50 inference images/sec at batch 32. vs_baseline compares against the
+reference's best published single-GPU number (P100, 713.17 img/s,
+docs/faq/perf.md:137-144). Runs on whatever accelerator JAX exposes (one TPU
+chip under the driver).
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+
+    batch = 32
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape="3,224,224")
+    ctx = mx.tpu(0)
+    exe = sym.simple_bind(ctx, grad_req="null", data=(batch, 3, 224, 224),
+                          softmax_label=(batch,))
+    # random-init params (score benchmark measures compute, not accuracy)
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
+    data = rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32)
+    exe.arg_dict["data"][:] = data
+
+    # warmup (compile)
+    for _ in range(3):
+        exe.forward(is_train=False)
+    exe.outputs[0].wait_to_read()
+
+    n_iter = 30
+    tic = time.time()
+    for _ in range(n_iter):
+        exe.forward(is_train=False)
+    exe.outputs[0].wait_to_read()
+    elapsed = time.time() - tic
+    img_per_sec = batch * n_iter / elapsed
+
+    baseline_p100 = 713.17
+    print(json.dumps({
+        "metric": "resnet50_inference_batch32_img_per_sec",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / baseline_p100, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

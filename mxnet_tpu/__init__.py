@@ -1,0 +1,52 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the MXNet 1.2 API.
+
+Brand-new design for TPU (JAX/XLA/Pallas era) with the capabilities of the
+reference (huangzehao/mxnet, an Apache MXNet 1.2.1 fork). See SURVEY.md for the
+capability map. Import as `import mxnet_tpu as mx` — reference scripts written
+against `import mxnet as mx` run with only the import line changed (or via
+`sys.modules` aliasing in examples/).
+"""
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+from . import base
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random
+from . import autograd
+from .ops import list_ops
+
+# populated by later phases; keep imports at bottom to respect dependency order
+from . import initializer
+from .initializer import init_registry  # noqa: F401
+from . import optimizer
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from . import io
+from . import kvstore as kvs
+from .kvstore import KVStore, create as _kv_create
+
+
+class kvstore:  # namespace shim so `mx.kvstore.create(...)` works
+    create = staticmethod(_kv_create)
+    KVStore = KVStore
+
+
+from . import module
+from . import module as mod
+from . import model
+from .model import save_checkpoint, load_checkpoint
+from . import gluon
+from . import visualization
+from . import profiler
+from .util import test_utils
+
+viz = visualization

@@ -1,0 +1,160 @@
+"""Autograd user API (reference: python/mxnet/autograd.py).
+
+record()/pause()/train_mode()/predict_mode() scopes, backward(), grad(), and
+Function (custom differentiable python ops). Backed by the tape in imperative.py.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from . import imperative as _imp
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "Function"]
+
+
+def is_recording():
+    return _imp.is_recording()
+
+
+def is_training():
+    return _imp.is_training()
+
+
+def set_recording(is_record):
+    return _imp.set_recording(is_record)
+
+
+def set_training(train_mode_):
+    return _imp.set_training(train_mode_)
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = _imp.set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = _imp.set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, *exc):
+        if self._enter_is_record is not None:
+            _imp.set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            _imp.set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """reference: autograd.py:122."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    _imp.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+        head_grads = [head_grads] if head_grads is not None else None
+    _imp.backward(list(heads), head_grads, retain_graph=retain_graph, train_mode=train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """reference: autograd.py:270 — returns grads of heads w.r.t. variables."""
+    if create_graph:
+        raise MXNetError("create_graph=True (higher-order autograd) is not yet supported")
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+        head_grads = [head_grads] if head_grads is not None else None
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    # Temporarily attach fresh grads to the variables, run backward, collect.
+    saved = [(v._grad, v._grad_req) for v in variables]
+    from .ndarray.ndarray import zeros
+    for v in variables:
+        v.attach_grad()
+    try:
+        _imp.backward(list(heads), head_grads, retain_graph=retain_graph,
+                      train_mode=train_mode)
+        out = [v._grad for v in variables]
+    finally:
+        for v, (g, req) in zip(variables, saved):
+            v._grad, v._grad_req = g, req
+    return out if len(out) > 1 else out[0]
+
+
+class Function:
+    """Custom differentiable function (reference: autograd.py:364).
+
+    Subclass and implement forward(self, *inputs) and backward(self, *out_grads),
+    both operating on NDArrays with autograd paused.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        from . import imperative
+        import jax
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+
+        if imperative.is_recording() and any(
+                i._node is not None or i._grad_req != "null" for i in inputs):
+            func = self
+
+            def vjp(cotangents):
+                cts = [NDArray(c, ctx=inputs[0].context) for c in cotangents]
+                with pause():
+                    in_grads = func.backward(*cts)
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = [in_grads]
+                return tuple(g._data for g in in_grads)
+
+            in_entries = [(i._node, i._node_oidx, i) for i in inputs]
+            node = imperative.TapeNode(vjp, in_entries,
+                                       [(o.shape, o.dtype) for o in out_list])
+            for i, o in enumerate(out_list):
+                o._node = node
+                o._node_oidx = i
+        return out_list[0] if single else out_list

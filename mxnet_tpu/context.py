@@ -1,0 +1,123 @@
+"""Device context (reference: python/mxnet/context.py, include/mxnet/base.h:133 Context).
+
+TPU-native: a Context names a logical device; it maps onto a concrete `jax.Device`.
+`mx.tpu(i)` is the first-class accelerator context (the reference's `mx.gpu(i)`);
+`mx.gpu(i)` is kept as an alias so reference scripts run unchanged. `mx.cpu()` maps
+to the JAX CPU backend. Under tests (JAX_PLATFORMS=cpu with a forced host device
+count) `tpu(i)` resolves to virtual CPU device *i*, which is how multi-device code
+is exercised without hardware.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context", "num_tpus", "num_gpus"]
+
+
+class Context:
+    """A logical device. Works as a `with` scope like the reference Context."""
+
+    _local = threading.local()
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_type, self.device_id = device_type.device_type, device_type.device_id
+        else:
+            if device_type not in Context.devstr2type:
+                raise MXNetError("unknown device type %r" % (device_type,))
+            self.device_type = device_type
+            self.device_id = device_id
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def device_typeid(self):
+        return Context.devstr2type[self.device_type]
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __str__ = __repr__
+
+    # -- scope -------------------------------------------------------------
+    def __enter__(self):
+        if not hasattr(Context._local, "stack"):
+            Context._local.stack = []
+        Context._local.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Context._local.stack.pop()
+
+    # -- jax mapping -------------------------------------------------------
+    @property
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = _cpu_devices()
+            return devs[self.device_id % len(devs)]
+        devs = _accel_devices()
+        if self.device_id >= len(devs):
+            raise MXNetError("%s: device_id %d out of range (%d devices available)"
+                             % (self, self.device_id, len(devs)))
+        return devs[self.device_id]
+
+
+def _accel_devices():
+    """Accelerator devices: the default JAX backend (TPU on hardware, CPU in tests)."""
+    return jax.devices()
+
+
+def _cpu_devices():
+    try:
+        return jax.devices("cpu")
+    except RuntimeError:
+        return jax.devices()
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def gpu(device_id=0):
+    """Alias for tpu() — keeps reference scripts (`mx.gpu(0)`) running unchanged."""
+    return Context("gpu", device_id)
+
+
+def num_tpus():
+    return len(_accel_devices())
+
+
+num_gpus = num_tpus
+
+
+def current_context():
+    stack = getattr(Context._local, "stack", None)
+    if stack:
+        return stack[-1]
+    return Context("cpu", 0)
+
+
+Context.default_ctx = None  # populated lazily by current_context callers

@@ -1,0 +1,262 @@
+"""Executor — binds a Symbol to devices + arrays and runs it.
+
+Reference: include/mxnet/executor.h:53, src/executor/graph_executor.cc (2343 LoC:
+NNVM passes, memory planning, engine pushes). TPU-native: the whole graph traces
+into ONE jitted XLA program per (is_train, input-shapes) key — XLA subsumes
+PlanMemory/DetectInplaceAddTo/bulking. Training uses a fused forward+backward
+program (outputs + gradients + aux updates in a single XLA call), the same
+fusion the reference approximates with bulked engine segments.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray.ndarray import NDArray, zeros
+from . import random as _rnd
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self._group2ctx = group2ctx  # sharding hint (reference: PlaceDevice pass)
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        self.arg_dict = self._normalize(args, arg_names, "args")
+        self.aux_dict = self._normalize(aux_states or {}, aux_names, "aux_states",
+                                        allow_missing=True)
+        for name in aux_names:
+            if name not in self.aux_dict:
+                raise MXNetError("missing aux state %r" % name)
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(arg_names, grad_req))
+        else:
+            self._grad_req = dict(grad_req)
+            for n in arg_names:
+                self._grad_req.setdefault(n, "null")
+
+        if args_grad is None:
+            args_grad = {}
+        self.grad_dict = self._normalize(args_grad, arg_names, "args_grad",
+                                         allow_missing=True)
+        for n in arg_names:
+            if self._grad_req.get(n, "null") != "null" and n not in self.grad_dict:
+                self.grad_dict[n] = zeros(self.arg_dict[n].shape, ctx=self._ctx)
+
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self._grad_names = [n for n in arg_names
+                            if self._grad_req.get(n, "null") != "null"]
+        self.outputs = []
+        self._cached = {}
+
+        # node tables built once (trace order)
+        self._topo = [n for n in symbol._topo() if not n.is_variable]
+        self._var_nodes = symbol._variables()
+        self._aux_var_ids = symbol._aux_set()
+
+    # ------------------------------------------------------------------
+    def _normalize(self, arrays, names, what, allow_missing=False):
+        if isinstance(arrays, dict):
+            out = dict(arrays)
+        elif isinstance(arrays, (list, tuple)):
+            if len(arrays) != len(names):
+                raise MXNetError("%s length %d != expected %d (%s)"
+                                 % (what, len(arrays), len(names), names))
+            out = dict(zip(names, arrays))
+        else:
+            raise MXNetError("%s must be list or dict" % what)
+        if not allow_missing:
+            for n in names:
+                if n not in out:
+                    raise MXNetError("missing %s entry %r" % (what, n))
+        return out
+
+    # ------------------------------------------------------------------
+    # pure graph interpreter (traced under jit)
+    # ------------------------------------------------------------------
+    def _run_graph(self, arg_vals, aux_vals, key, is_train):
+        vals = {}
+        for node in self._var_nodes:
+            src = aux_vals if id(node) in self._aux_var_ids else arg_vals
+            if node.name in src:
+                vals[(id(node), 0)] = src[node.name]
+        aux_updates = {}
+        for node in self._topo:
+            params = node.make_params()
+            ins = []
+            for (inp, oidx) in node.inputs:
+                v = vals.get((id(inp), oidx))
+                if v is None:
+                    raise MXNetError("executor: missing input for node %s" % node.name)
+                ins.append(v)
+            rng = None
+            if node.op.need_rng:
+                key, rng = jax.random.split(key)
+            outs = node.op.apply(params, ins, is_train=is_train, rng=rng)
+            n_vis = node.op.n_outputs(params)
+            for i in range(n_vis):
+                vals[(id(node), i)] = outs[i]
+            aux_names_node = node.op.list_aux(params)
+            n_in = len(node.op.list_inputs(params))
+            for j, aux_upd in enumerate(outs[n_vis:]):
+                aux_node = node.inputs[n_in + j][0]
+                aux_updates[aux_node.name] = aux_upd
+        outputs = []
+        for node, oidx in self._symbol._outputs:
+            if node.is_variable:
+                outputs.append(vals[(id(node), 0)])
+            else:
+                outputs.append(vals[(id(node), oidx)])
+        return tuple(outputs), aux_updates
+
+    # ------------------------------------------------------------------
+    # compiled entry points (cached; jit recompiles per shape automatically)
+    # ------------------------------------------------------------------
+    def _fwd_fn(self, is_train):
+        key = ("fwd", is_train)
+        if key not in self._cached:
+            def f(arg_vals, aux_vals, rng):
+                return self._run_graph(arg_vals, aux_vals, rng, is_train)
+            self._cached[key] = jax.jit(f)
+        return self._cached[key]
+
+    def _fb_fn(self, with_out_grads):
+        key = ("fb", with_out_grads)
+        if key not in self._cached:
+            grad_names = tuple(self._grad_names)
+
+            def f(grad_args, other_args, aux_vals, rng, out_grads=None):
+                def inner(ga):
+                    all_args = dict(other_args)
+                    all_args.update(ga)
+                    outs, aux_upd = self._run_graph(all_args, aux_vals, rng, True)
+                    return outs, aux_upd
+                outs, vjp, aux_upd = jax.vjp(inner, grad_args, has_aux=True)
+                if out_grads is None:
+                    seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+                else:
+                    seeds = tuple(out_grads)
+                grads = vjp(seeds)[0]
+                return outs, aux_upd, grads
+
+            self._cached[key] = jax.jit(f)
+        return self._cached[key]
+
+    # ------------------------------------------------------------------
+    # public API (reference: executor.py forward/backward/outputs)
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown forward argument %r" % k)
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._data = v._data
+            else:
+                self.arg_dict[k]._data = jnp.asarray(_np.asarray(v))
+
+        arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+        aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        rng = _rnd.next_key()
+
+        if is_train and self._grad_names:
+            grad_args = {n: arg_vals.pop(n) for n in self._grad_names}
+            outs, aux_upd, grads = self._fb_fn(False)(grad_args, arg_vals,
+                                                      aux_vals, rng)
+            self._pending_grads = grads
+        else:
+            outs, aux_upd = self._fwd_fn(is_train)(arg_vals, aux_vals, rng)
+            self._pending_grads = None
+        for name, val in aux_upd.items():
+            self.aux_dict[name]._data = val
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        if not self._grad_names:
+            return
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+            aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+            grad_args = {n: arg_vals.pop(n) for n in self._grad_names}
+            rng = _rnd.next_key()
+            _, _, grads = self._fb_fn(True)(grad_args, arg_vals, aux_vals, rng,
+                                            tuple(g._data for g in out_grads))
+        else:
+            if getattr(self, "_pending_grads", None) is None:
+                raise MXNetError("backward() called before forward(is_train=True)")
+            grads = self._pending_grads
+        for name in self._grad_names:
+            g = grads[name]
+            dst = self.grad_dict[name]
+            if self._grad_req.get(name) == "add":
+                dst._data = dst._data + g
+            else:
+                dst._data = g.astype(dst.dtype) if g.dtype != dst.dtype else g
+        self._pending_grads = None
+
+    # convenience accessors (reference: executor.py)
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                array.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in executor arguments" % name)
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    array.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise MXNetError("Found name %r not in executor aux states" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new shapes; jit recompiles per-shape automatically."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, shape in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            if shape == cur.shape:
+                new_args[name] = cur
+            else:
+                new_args[name] = zeros(shape, ctx=self._ctx, dtype=cur.dtype)
+        new_aux = {}
+        for name, shape in zip(self._aux_names, aux_shapes):
+            cur = self.aux_dict[name]
+            new_aux[name] = cur if shape == cur.shape else zeros(shape, ctx=self._ctx)
+        grad_arrays = {n: zeros(a.shape, ctx=self._ctx)
+                       for n, a in new_args.items()
+                       if self._grad_req.get(n, "null") != "null"}
+        return Executor(self._symbol, self._ctx, new_args, grad_arrays,
+                        self._grad_req, new_aux, group2ctx=self._group2ctx)

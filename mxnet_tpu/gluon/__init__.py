@@ -1,0 +1,1 @@
+"""Gluon imperative API (reference: python/mxnet/gluon/)."""

@@ -1,0 +1,272 @@
+"""Imperative runtime: eager op execution + autograd tape recording.
+
+Reference: src/imperative/imperative.cc (Invoke :86, RecordOp :182, Backward :358).
+TPU-native design: eager calls run JAX ops directly (JAX's async dispatch plays the
+role of the reference dependency engine — ops return before the device finishes and
+`wait_to_read`/`asnumpy` are the sync points). When autograd is recording, each op
+additionally captures a `jax.vjp` closure on the tape; `backward` replays the tape
+in reverse creation order. This replaces the reference's NNVM-node tape + gradient
+graph pass with per-op VJPs, which is the idiomatic JAX formulation.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["is_recording", "is_training", "set_recording", "set_training",
+           "apply_fn", "invoke_op", "backward", "mark_variables", "get_symbol_hook"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.counter = 0          # creation order for topological backward
+        self.symbol_hook = None   # set by gluon HybridBlock tracing (deferred mode)
+
+
+_STATE = _State()
+
+
+def is_recording():
+    return _STATE.recording
+
+
+def is_training():
+    return _STATE.training
+
+
+def set_recording(flag):
+    prev = _STATE.recording
+    _STATE.recording = flag
+    return prev
+
+
+def set_training(flag):
+    prev = _STATE.training
+    _STATE.training = flag
+    return prev
+
+
+def get_symbol_hook():
+    return _STATE.symbol_hook
+
+
+def set_symbol_hook(hook):
+    prev = _STATE.symbol_hook
+    _STATE.symbol_hook = hook
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op invocation (reference: Imperative::RecordOp building an nnvm node).
+
+    Tape values are identified by (producing node, output index), NOT by array
+    object identity — an NDArray mutated in place (`y *= 2`) is the output of a
+    new node while the old value lives on as the node's input, so object
+    identity cannot name both.
+    """
+
+    __slots__ = ("vjp", "in_entries", "out_avals", "order")
+
+    def __init__(self, vjp, in_entries, out_avals):
+        self.vjp = vjp                  # jax vjp closure: cotangents -> input cotangents
+        # in_entries: list of (producer_node_or_None, out_idx, array_ref)
+        # array_ref kept for leaf-gradient writes and graph liveness
+        self.in_entries = in_entries
+        self.out_avals = out_avals      # [(shape, dtype)] per output
+        self.order = _STATE.counter
+        _STATE.counter += 1
+
+
+def _in_graph(arr):
+    return arr._node is not None or arr._grad_req != "null"
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """reference: Imperative::MarkVariables (imperative.cc:112)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var._grad = grad
+        var._grad_req = req
+        var._node = None
+
+
+# ---------------------------------------------------------------------------
+# Eager apply
+# ---------------------------------------------------------------------------
+
+def apply_fn(fn, inputs, n_out=1, record=True):
+    """Run a pure jax function on NDArray inputs; wrap + (maybe) record.
+
+    ``fn`` takes and returns jax arrays (tuple if n_out > 1).
+    """
+    from .ndarray.ndarray import NDArray  # cycle-free at call time
+
+    jax_in = [a._data for a in inputs]
+    recording = record and _STATE.recording and any(_in_graph(a) for a in inputs)
+
+    if recording:
+        # capture input tape entries BEFORE outputs are wired (in-place safety)
+        in_entries = [(a._node, a._node_oidx, a) for a in inputs]
+
+        def flat_fn(*args):
+            out = fn(*args)
+            return out if isinstance(out, tuple) else (out,)
+        out_vals, vjp = jax.vjp(flat_fn, *jax_in)
+    else:
+        out = fn(*jax_in)
+        out_vals, vjp = (out if isinstance(out, tuple) else (out,)), None
+
+    ctx = inputs[0].context if inputs else None
+    out_arrays = [NDArray(v, ctx=ctx) for v in out_vals]
+
+    if recording:
+        node = TapeNode(vjp, in_entries,
+                        [(v.shape, v.dtype) for v in out_vals])
+        for i, o in enumerate(out_arrays):
+            o._node = node
+            o._node_oidx = i
+    return out_arrays
+
+
+def invoke_op(opdef, inputs, attrs, rng=None):
+    """Invoke a registered operator eagerly on NDArrays.
+
+    Returns (outputs, aux_updates); aux updates are written back by the caller.
+    """
+    params = opdef.make_params(dict(attrs)) if attrs or opdef.param_cls else opdef.make_params({})
+    is_train = _STATE.training
+    if opdef.need_rng and rng is None:
+        from . import random as _rnd
+        rng = _rnd.next_key()
+
+    n_vis = opdef.n_outputs(params)
+
+    def fn(*jax_in):
+        return opdef.apply(params, jax_in, is_train=is_train, rng=rng)
+
+    outs = apply_fn(fn, inputs, n_out=None)
+    visible, aux_updates = outs[:n_vis], outs[n_vis:]
+    return visible, aux_updates
+
+
+# ---------------------------------------------------------------------------
+# Backward pass over the tape
+# ---------------------------------------------------------------------------
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """reference: Imperative::Backward (imperative.cc:358) + MXAutogradBackwardEx."""
+    import numpy as _np
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if len(head_grads) != len(heads):
+        raise MXNetError("head_grads length mismatch")
+
+    # Collect reachable nodes (via tape entries, not array objects).
+    nodes = {}
+    stack = [h._node for h in heads if h._node is not None]
+    while stack:
+        node = stack.pop()
+        if id(node) in nodes:
+            continue
+        nodes[id(node)] = node
+        for (pnode, _, _) in node.in_entries:
+            if pnode is not None:
+                stack.append(pnode)
+    if not nodes and not any(h._grad_req != "null" for h in heads):
+        raise MXNetError("cannot differentiate: outputs are not connected to any "
+                         "recorded computation (did you forget autograd.record()?)")
+
+    order = sorted(nodes.values(), key=lambda n: n.order, reverse=True)
+
+    # Cotangents keyed by tape value (node, out_idx); leaf cotangents keyed by
+    # array object, accumulated and written once (duplicate inputs like x*x sum).
+    cotangents = {}  # (id(node), oidx) -> jax array
+    leaf_cts = {}    # id(NDArray) -> (NDArray, jax array)
+
+    def _accum(node, oidx, val):
+        key = (id(node), oidx)
+        cotangents[key] = val if key not in cotangents else cotangents[key] + val
+
+    def _accum_leaf(arr, val):
+        key = id(arr)
+        if key in leaf_cts:
+            leaf_cts[key] = (arr, leaf_cts[key][1] + val)
+        else:
+            leaf_cts[key] = (arr, val)
+
+    for head, hg in zip(heads, head_grads):
+        if hg is None:
+            g = jnp.ones(head.shape, dtype=head.dtype)
+        else:
+            g = hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        if head._node is not None:
+            _accum(head._node, head._node_oidx, g)
+        if head._grad_req != "null" and head._node is None:
+            _accum_leaf(head, g)
+
+    for node in order:
+        outs_ct = []
+        has_any = False
+        for oidx, (shape, dtype) in enumerate(node.out_avals):
+            ct = cotangents.get((id(node), oidx))
+            if ct is None:
+                ct = jnp.zeros(shape, dtype=dtype)
+            else:
+                has_any = True
+            outs_ct.append(ct)
+        if not has_any:
+            continue
+        in_cts = node.vjp(tuple(outs_ct))
+        for (pnode, poidx, arr), ct in zip(node.in_entries, in_cts):
+            if pnode is not None:
+                _accum(pnode, poidx, ct)
+            elif arr._grad_req != "null":
+                _accum_leaf(arr, ct)
+
+    for arr, ct in leaf_cts.values():
+        _write_grad(arr, ct)
+
+    if not retain_graph:
+        for h in heads:
+            _free_graph(h)
+
+
+def _write_grad(arr, ct):
+    from .ndarray.ndarray import NDArray
+    if arr._grad is None:
+        raise MXNetError("variable has grad_req but no grad buffer attached")
+    if arr._grad_req == "add":
+        arr._grad._data = arr._grad._data + ct
+    else:  # write
+        arr._grad._data = ct.astype(arr._grad.dtype) if ct.dtype != arr._grad.dtype else ct
+
+
+def _free_graph(head):
+    node = head._node
+    stack = [node] if node is not None else []
+    seen = set()
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        for (pnode, _, arr) in n.in_entries:
+            if pnode is not None:
+                stack.append(pnode)
+            arr._node = None
+        n.vjp = None
+        n.in_entries = []
+    head._node = None

@@ -1,0 +1,500 @@
+"""Data iterators (reference: python/mxnet/io.py, src/io/*).
+
+NDArrayIter / CSVIter / LibSVMIter / MNISTIter with the reference API: DataBatch
+with data/label lists, provide_data/provide_label DataDesc lists, num_parts /
+part_index sharding for distributed training.
+"""
+from __future__ import annotations
+
+import os
+import gzip
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+from .ndarray.ndarray import NDArray, array
+from .ndarray import sparse as _sparse
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
+           "ImageRecordIter", "io_registry"]
+
+io_registry = Registry("data iterator")
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """reference: io.py DataDesc (name, shape, dtype, layout)."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, tuple(shape))
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """reference: io.py DataIter."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, numpy/NDArray)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of them or dict")
+    return list(sorted(data.items()))
+
+
+class NDArrayIter(DataIter):
+    """reference: io.py NDArrayIter — in-memory iterator with pad/discard/roll_over."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+
+        def _asnp(x):
+            if isinstance(x, _sparse.BaseSparseNDArray):
+                return x
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            return _np.asarray(x)
+
+        self.data = [(k, _asnp(v)) for k, v in self.data]
+        self.label = [(k, _asnp(v)) for k, v in self.label]
+
+        self.num_data = self.data[0][1].shape[0]
+        self.idx = _np.arange(self.num_data)
+        if shuffle:
+            _np.random.shuffle(self.idx)
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self.cursor = -1
+        self._cache = None
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         getattr(v, "dtype", _np.float32))
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         getattr(v, "dtype", _np.float32))
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -1
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+
+    def iter_next(self):
+        self.cursor += 1
+        return self.cursor < self.num_batches
+
+    def _take(self, arrays):
+        start = self.cursor * self.batch_size
+        end = min(start + self.batch_size, self.num_data)
+        sel = self.idx[start:end]
+        pad = self.batch_size - len(sel)
+        if pad:
+            sel = _np.concatenate([sel, self.idx[:pad]])
+        out = []
+        for _, v in arrays:
+            if isinstance(v, _sparse.BaseSparseNDArray):
+                dense = v.asnumpy()[sel]
+                out.append(_sparse.csr_matrix(dense) if v.stype == "csr"
+                           else array(dense))
+            else:
+                out.append(array(v[sel]))
+        return out, pad
+
+    def getdata(self):
+        return self._take(self.data)[0]
+
+    def getlabel(self):
+        return self._take(self.label)[0] if self.label else []
+
+    def getpad(self):
+        start = self.cursor * self.batch_size
+        end = min(start + self.batch_size, self.num_data)
+        return self.batch_size - (end - start)
+
+    def getindex(self):
+        start = self.cursor * self.batch_size
+        end = min(start + self.batch_size, self.num_data)
+        return self.idx[start:end]
+
+
+class ResizeIter(DataIter):
+    """Loop/truncate an iterator to a fixed number of batches (reference: io.py)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (reference: iter_prefetcher.h via io.py wrapper)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import threading
+        import queue
+        if not isinstance(iters, list):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue = queue.Queue(maxsize=4)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def _start(self):
+        import threading
+
+        def worker():
+            try:
+                while not self._stop.is_set():
+                    try:
+                        batches = [i.next() for i in self.iters]
+                    except StopIteration:
+                        self._queue.put(None)
+                        return
+                    self._queue.put(batches)
+            except Exception as e:  # transported to next() (reference: exception_handling.md)
+                self._queue.put(e)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        # keep draining until the worker has exited — a put() blocked on a full
+        # queue could otherwise land a stale batch after a one-shot drain
+        if self._thread is not None:
+            while self._thread.is_alive():
+                try:
+                    self._queue.get(timeout=0.05)
+                except Exception:
+                    pass
+            self._thread.join(timeout=5)
+        try:
+            while True:
+                self._queue.get_nowait()
+        except Exception:
+            pass
+        for i in self.iters:
+            i.reset()
+        self._stop.clear()
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        batch = item[0]
+        if len(item) > 1:
+            batch = DataBatch(data=sum([b.data for b in item], []),
+                              label=sum([b.label for b in item], []),
+                              pad=item[0].pad, index=item[0].index)
+        return batch
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def __del__(self):
+        self._stop.set()
+
+
+class CSVIter(DataIter):
+    """reference: src/io/iter_csv.cc:151."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=dtype, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=dtype, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1])
+        else:
+            label = _np.zeros((data.shape[0],), dtype=dtype)
+        self._inner = NDArrayIter(data, label, batch_size,
+                                  last_batch_handle="pad" if round_batch else "discard",
+                                  data_name="data", label_name="label")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """reference: src/io/iter_libsvm.cc:200 — sparse CSR batches."""
+
+    def __init__(self, data_libsvm, data_shape, label_shape=(1,), batch_size=1,
+                 num_parts=1, part_index=0, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        rows, labels = self._parse(data_libsvm)
+        n = len(rows)
+        shard = n // num_parts
+        lo = part_index * shard
+        hi = n if part_index == num_parts - 1 else lo + shard
+        self.rows = rows[lo:hi]
+        self.labels = _np.asarray(labels[lo:hi], dtype=_np.float32)
+        self.num_data = len(self.rows)
+        self.cursor = -1
+        self.num_batches = max(1, (self.num_data + batch_size - 1) // batch_size) \
+            if not round_batch else (self.num_data + batch_size - 1) // batch_size
+
+    def _parse(self, path):
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                feats = []
+                for tok in parts[1:]:
+                    idx, val = tok.split(":")
+                    feats.append((int(idx), float(val)))
+                rows.append(feats)
+        return rows, labels
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self.cursor = -1
+
+    def iter_next(self):
+        self.cursor += 1
+        return self.cursor < self.num_batches
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        start = self.cursor * self.batch_size
+        sel = [(start + i) % self.num_data for i in range(self.batch_size)]
+        dim = self.data_shape[0]
+        data, indices, indptr = [], [], [0]
+        for i in sel:
+            for idx, val in self.rows[i]:
+                if idx < dim:
+                    indices.append(idx)
+                    data.append(val)
+            indptr.append(len(indices))
+        csr = _sparse.CSRNDArray(_np.asarray(data, _np.float32),
+                                 _np.asarray(indices, _np.int32),
+                                 _np.asarray(indptr, _np.int32),
+                                 (self.batch_size, dim))
+        label = array(self.labels[sel])
+        pad = max(0, start + self.batch_size - self.num_data)
+        return DataBatch(data=[csr], label=[label], pad=pad)
+
+
+class MNISTIter(DataIter):
+    """reference: src/io/iter_mnist.cc:260 — reads idx-format MNIST files."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=None, input_shape=None, num_parts=1,
+                 part_index=0, **kwargs):
+        super().__init__(batch_size)
+        images = self._read_idx(image)
+        labels = self._read_idx(label)
+        images = images.astype(_np.float32) / 255.0
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images.reshape(images.shape[0], 1,
+                                    images.shape[1], images.shape[2])
+        shard = images.shape[0] // num_parts
+        lo = part_index * shard
+        hi = images.shape[0] if part_index == num_parts - 1 else lo + shard
+        self._inner = NDArrayIter(images[lo:hi], labels[lo:hi].astype(_np.float32),
+                                  batch_size, shuffle=shuffle)
+
+    @staticmethod
+    def _read_idx(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic = struct.unpack(">I", f.read(4))[0]
+            ndim = magic & 0xFF
+            dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            data = _np.frombuffer(f.read(), dtype=_np.uint8)
+            return data.reshape(dims)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+def ImageRecordIter(**kwargs):
+    """RecordIO image pipeline — implemented in the native io package (phase 6)."""
+    from .recordio_iter import ImageRecordIter as _Impl
+    return _Impl(**kwargs)

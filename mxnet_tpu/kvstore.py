@@ -1,0 +1,239 @@
+"""KVStore — the data-parallel communication backend.
+
+Reference: include/mxnet/kvstore.h:47, src/kvstore/* (§2.4 of SURVEY.md).
+TPU-native design: `local`/`device` keep the reference single-process semantics
+(merge pushed values across device copies, run the updater, broadcast on pull).
+The new **`tpu_sync`** type is the north-star backend: instead of ps-lite
+push/pull over ZeroMQ or NCCL reduce/broadcast, gradients are summed with XLA
+collectives — within a process by an on-device reduction over the device list,
+across processes by `psum` over the JAX process group (ICI/DCN) — and the
+optimizer runs inside the same compiled step ("update_on_kvstore" semantics,
+reference: kvstore_dist_server.h:282 ApplyUpdates).
+
+`dist_sync`/`dist_async` map onto tpu_sync (sync); async has no ICI analog and
+degrades to sync — documented divergence (SURVEY.md §5.8).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, zeros
+from .ndarray import sparse as _sparse
+from . import optimizer as opt_mod
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(keys):
+    single = not isinstance(keys, (list, tuple))
+    return ([keys] if single else list(keys)), single
+
+
+def _val_list(vals, n):
+    if isinstance(vals, (list, tuple)) and vals and isinstance(vals[0], (list, tuple)):
+        return list(vals)
+    if isinstance(vals, (list, tuple)) and n > 1:
+        # one value list per key
+        assert len(vals) == n
+        return [[v] if not isinstance(v, (list, tuple)) else list(v) for v in vals]
+    if isinstance(vals, (list, tuple)) and n == 1:
+        return [list(vals)]
+    return [[vals]]
+
+
+class KVStore:
+    """Single-process store with reference local/device semantics."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = {}
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    def get_rank(self):
+        return self.rank
+
+    @property
+    def num_workers(self):
+        return jax.process_count()
+
+    def get_group_size(self):
+        return self.num_workers
+
+    # -- core API ----------------------------------------------------------
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            v = vlist[0]
+            if isinstance(v, _sparse.BaseSparseNDArray):
+                self._store[str(k)] = v
+            else:
+                self._store[str(k)] = v.copy()
+
+    def _merge(self, vlist):
+        """Reduce device copies (reference: CommDevice::Reduce, comm.h:410)."""
+        if len(vlist) == 1:
+            merged = vlist[0]
+            if isinstance(merged, _sparse.BaseSparseNDArray):
+                return merged
+            return merged.copy()
+        if isinstance(vlist[0], _sparse.RowSparseNDArray):
+            import numpy as _np
+            idx = _np.concatenate([_np.asarray(v._indices) for v in vlist])
+            dat = _np.concatenate([_np.asarray(v._data) for v in vlist])
+            return _sparse.RowSparseNDArray(dat, idx, vlist[0].shape,
+                                            ctx=vlist[0].context)
+        acc = vlist[0]._data
+        for v in vlist[1:]:
+            acc = acc + v._data  # XLA reduce; devices transfer via jax
+        return NDArray(acc, ctx=vlist[0].context)
+
+    def push(self, key, value, priority=0):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            k = str(k)
+            merged = self._merge(vlist)
+            merged = self._allreduce_across_workers(merged)
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % k)
+            if self._updater is not None:
+                self._updater(self._updater_key(k), merged, self._store[k])
+            else:
+                if isinstance(merged, _sparse.BaseSparseNDArray):
+                    self._store[k] = merged
+                else:
+                    self._store[k]._data = merged._data
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, single = _key_list(key)
+        outs = _val_list(out, len(keys))
+        for k, olist in zip(keys, outs):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % k)
+            src = self._store[k]
+            for o in olist:
+                if isinstance(src, _sparse.BaseSparseNDArray):
+                    dense = src.todense()
+                    o._data = dense._data
+                else:
+                    src.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only requested rows (reference: kvstore.py:307)."""
+        keys, _ = _key_list(key)
+        outs = _val_list(out, len(keys))
+        rids, _ = _key_list(row_ids) if not isinstance(row_ids, NDArray) else ([row_ids], True)
+        if isinstance(row_ids, NDArray):
+            rids = [row_ids] * len(keys)
+        for k, olist, rid in zip(keys, outs, rids):
+            k = str(k)
+            src = self._store[k]
+            dense = src.todense() if isinstance(src, _sparse.BaseSparseNDArray) else src
+            import numpy as _np
+            rows = _np.unique(rid.asnumpy().astype(_np.int64))
+            for o in olist:
+                rsp = _sparse.RowSparseNDArray(
+                    _np.asarray(dense._data)[rows], rows.astype(_np.int32),
+                    dense.shape, ctx=dense.context)
+                o._data = rsp._data
+                o._indices = rsp._indices
+                o._shape = rsp._shape
+
+    # -- cross-worker collective (tpu_sync / dist) -------------------------
+    def _allreduce_across_workers(self, merged):
+        if self.num_workers == 1 or isinstance(merged, _sparse.BaseSparseNDArray):
+            return merged
+        # multi-host: XLA allreduce over DCN/ICI via process-spanning pmap-less psum
+        from .parallel.collectives import allreduce_hosts
+        return NDArray(allreduce_hosts(merged._data), ctx=merged.context)
+
+    # -- optimizer plumbing ------------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _update_rule = set_updater
+
+    def _updater_key(self, k):
+        try:
+            return int(k)
+        except ValueError:
+            return k
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self.set_updater(opt_mod.get_updater(optimizer))
+
+    def set_gradient_compression(self, compression_params):
+        """2-bit compression has no benefit on ICI allreduce; accepted + recorded."""
+        self._compression = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        if self.num_workers > 1:
+            from .parallel.collectives import host_barrier
+            host_barrier()
+
+    # ps-lite compat surface (reference: kvstore.h:254-304)
+    @staticmethod
+    def is_worker_node():
+        return True
+
+    @staticmethod
+    def is_server_node():
+        return False
+
+    @staticmethod
+    def is_scheduler_node():
+        return False
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+
+class KVStoreTPUSync(KVStore):
+    """North-star backend: allreduce over ICI/DCN + in-step optimizer.
+
+    Eager path shares KVStore.push/pull (with the cross-host psum); Module's
+    jitted train step fuses the same collective + update into one XLA program
+    (module/tpu_step.py).
+    """
+
+    def __init__(self):
+        super().__init__("tpu_sync")
+
+
+def create(name="local"):
+    """reference: src/kvstore/kvstore.cc:40-77 substring dispatch."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if "tpu" in name:
+        return KVStoreTPUSync()
+    if "dist" in name:
+        kv = KVStoreTPUSync()
+        kv.type = name
+        return kv
+    if "nccl" in name or "device" in name or "local" in name:
+        return KVStore(name)
+    raise MXNetError("unknown kvstore type %r" % name)

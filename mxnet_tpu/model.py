@@ -1,0 +1,126 @@
+"""Model helpers: kvstore wiring + checkpointing (reference: python/mxnet/model.py).
+
+Checkpoint format mirrors the reference two-file layout: `prefix-symbol.json`
+(graph) + `prefix-%04d.params` (param dict). The params container is an npz
+archive with `arg:`/`aux:` prefixed names (the reference uses its own legacy
+binary; the key structure is preserved, the container is not byte-compatible).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array
+from . import symbol as sym_mod
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint", "load_params",
+           "save_params"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """reference: model.py:58 — decide kvstore + update_on_kvstore."""
+    from . import kvstore as kvs
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore and "tpu" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(_np.prod(param.shape) for param in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """reference: model.py:89."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            kvstore.pull(name, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
+    """reference: model.py:126 — push grad, pull weight (priority overlaps comm)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
+                   param_names=None):
+    """reference: model.py:138 — updater on worker when update_on_kvstore=False."""
+    updates = [[] for _ in range(num_device)]
+    for i, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        index = i
+        if kvstore:
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            updates[k].append((index * num_device + k, g, w))
+    for dev_updates in updates:
+        for upd in dev_updates:
+            updater(*upd)
+
+
+def save_params(fname, arg_params, aux_params=None):
+    data = {}
+    for k, v in arg_params.items():
+        data["arg:" + k] = v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
+    for k, v in (aux_params or {}).items():
+        data["aux:" + k] = v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
+    _np.savez(fname, **data)
+    import os
+    if os.path.exists(fname + ".npz"):  # np.savez appends .npz
+        os.replace(fname + ".npz", fname)
+
+
+def load_params(fname):
+    data = _np.load(fname, allow_pickle=False)
+    arg_params, aux_params = {}, {}
+    for k in data.files:
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = array(data[k])
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = array(data[k])
+    return arg_params, aux_params
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """reference: model.py:365."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    save_params(param_name, arg_params, aux_params)
+
+
+def load_checkpoint(prefix, epoch):
+    """reference: model.py:395."""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params("%s-%04d.params" % (prefix, epoch))
+    return symbol, arg_params, aux_params

@@ -1,0 +1,10 @@
+"""Symbol builders for standard models (reference: example/image-classification/symbols/)."""
+from . import lenet, mlp, resnet
+
+__all__ = ["lenet", "mlp", "resnet", "get_symbol"]
+
+
+def get_symbol(network, **kwargs):
+    import importlib
+    mod = importlib.import_module("mxnet_tpu.models." + network)
+    return mod.get_symbol(**kwargs)

@@ -1,0 +1,206 @@
+"""DataParallelExecutorGroup (reference: python/mxnet/module/executor_group.py:129).
+
+Splits each batch across the context list, binds one Executor per context, and
+scatters/gathers. On TPU each context is one chip core; the tpu_sync kvstore
+turns per-device grads into one fused allreduce+update.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, zeros, concatenate
+from ..io import DataDesc
+
+__all__ = ["DataParallelExecutorGroup", "_split_input_slice"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """reference: executor_manager.py _split_input_slice."""
+    total = sum(work_load_list)
+    batch_num_list = [round(batch_size * w / total) for w in work_load_list]
+    delta = batch_size - sum(batch_num_list)
+    batch_num_list[0] += delta
+    slices = []
+    end = 0
+    for n in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + n, batch_size))
+        if begin >= end:
+            raise MXNetError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=None, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.state_names = state_names or []
+
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+
+        self.grad_req = {}
+        for name in self.arg_names:
+            if name in self.param_names:
+                self.grad_req[name] = ("null" if name in self.fixed_param_names
+                                       else grad_req)
+            elif inputs_need_grad and any(name == d.name for d in data_shapes):
+                self.grad_req[name] = grad_req
+            else:
+                self.grad_req[name] = "null"
+        if not for_training:
+            self.grad_req = {n: "null" for n in self.arg_names}
+
+        self.execs = []
+        self.slices = None
+        self.data_shapes = None
+        self.label_shapes = None
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    # ------------------------------------------------------------------
+    def decide_slices(self, data_shapes):
+        """reference: executor_group.py:267."""
+        batch_size = data_shapes[0].shape[0]
+        self.slices = _split_input_slice(batch_size, self.workload)
+        return batch_size
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None, reshape=False):
+        self.batch_size = self.decide_slices(data_shapes)
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self.execs = []
+        input_shapes = {}
+        for d in data_shapes:
+            input_shapes[d.name] = d.shape
+        for l in (label_shapes or []):
+            input_shapes[l.name] = l.shape
+
+        for i, ctx in enumerate(self.contexts):
+            sl = self.slices[i]
+            dev_shapes = {}
+            for name, shape in input_shapes.items():
+                dev_shapes[name] = (sl.stop - sl.start,) + tuple(shape[1:])
+            exec_ = self.symbol.simple_bind(ctx, grad_req=self.grad_req,
+                                            **dev_shapes)
+            self.execs.append(exec_)
+
+        self.data_arrays = [[(self.slices[i], e.arg_dict[d.name])
+                             for i, e in enumerate(self.execs)]
+                            for d in data_shapes]
+        self.label_arrays = None
+        if label_shapes:
+            self.label_arrays = [[(self.slices[i], e.arg_dict[l.name])
+                                  for i, e in enumerate(self.execs)]
+                                 for l in label_shapes if l.name in self.arg_names]
+        self.param_arrays = [[e.arg_dict[name] for e in self.execs]
+                             for name in self.param_names if name in self.arg_names]
+        self.grad_arrays = [[e.grad_dict.get(name) for e in self.execs]
+                            for name in self.param_names if name in self.arg_names]
+        self.aux_arrays = [[e.aux_dict[name] for e in self.execs]
+                           for name in self.aux_names]
+
+    # ------------------------------------------------------------------
+    def reshape(self, data_shapes, label_shapes):
+        self.bind_exec(data_shapes, label_shapes, reshape=True)
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for exec_ in self.execs:
+            exec_.copy_params_from(arg_params, aux_params,
+                                   allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Average over devices back into the shared dicts (reference semantics)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            if not block:
+                continue
+            weight = block[0]
+            if len(block) > 1:
+                weight = sum((w.as_in_context(block[0].context) for w in block[1:]),
+                             block[0]) / len(block)
+            if name in arg_params:
+                weight.astype(arg_params[name].dtype).copyto(arg_params[name])
+            else:
+                arg_params[name] = weight.copy()
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            aux = block[0]
+            if len(block) > 1:
+                aux = sum((w.as_in_context(block[0].context) for w in block[1:]),
+                          block[0]) / len(block)
+            if name in aux_params:
+                aux.astype(aux_params[name].dtype).copyto(aux_params[name])
+            else:
+                aux_params[name] = aux.copy()
+
+    # ------------------------------------------------------------------
+    def _load_data(self, batch):
+        for d_arr, d_src in zip(self.data_arrays, batch.data):
+            src = d_src.asnumpy() if not isinstance(d_src, _np.ndarray) else d_src
+            for sl, dst in d_arr:
+                dst[:] = src[sl]
+
+    def _load_label(self, batch):
+        if self.label_arrays is None or batch.label is None:
+            return
+        for l_arr, l_src in zip(self.label_arrays, batch.label):
+            src = l_src.asnumpy() if not isinstance(l_src, _np.ndarray) else l_src
+            for sl, dst in l_arr:
+                dst[:] = src[sl]
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        self._load_data(data_batch)
+        if is_train:
+            self._load_label(data_batch)
+        elif self.label_arrays is not None and data_batch.label:
+            self._load_label(data_batch)
+        for exec_ in self.execs:
+            exec_.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("re-bind with for_training=True to run backward")
+        for i, exec_ in enumerate(self.execs):
+            if out_grads is not None:
+                og = [o[self.slices[i]] if isinstance(o, NDArray) else o
+                      for o in out_grads]
+                exec_.backward(out_grads=og)
+            else:
+                exec_.backward()
+
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[e.outputs[i] for e in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            return [outs[0] if len(outs) == 1 else concatenate(outs, axis=0)
+                    for outs in outputs]
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        grads = []
+        for d in self.data_shapes:
+            per_dev = [e.grad_dict.get(d.name) for e in self.execs]
+            if merge_multi_context:
+                per_dev = [g for g in per_dev if g is not None]
+                grads.append(per_dev[0] if len(per_dev) == 1
+                             else concatenate(per_dev, axis=0))
+            else:
+                grads.append(per_dev)
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        for i, exec_ in enumerate(self.execs):
+            labels_slice = [l[self.slices[i]] if isinstance(l, NDArray) else l
+                            for l in labels]
+            eval_metric.update(labels_slice, exec_.outputs)

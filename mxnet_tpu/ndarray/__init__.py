@@ -1,0 +1,102 @@
+"""mx.nd namespace: NDArray + auto-generated op functions.
+
+Reference: python/mxnet/ndarray/register.py:168 generates Python wrappers from
+C-API op introspection; here we generate them from the in-process op registry.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..base import MXNetError
+from .. import imperative as _imp
+from ..ops import OPS, get_op
+from .ndarray import (NDArray, array, zeros, ones, full, arange, empty,
+                      concatenate, moveaxis, waitall, _new_from_jax)
+
+_this = sys.modules[__name__]
+
+
+def _make_nd_function(opdef):
+    def op_func(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        name = kwargs.pop("name", None)  # accepted for API parity, unused eagerly
+        # split NDArray kwargs (named inputs) from attrs
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        attr_args = [a for a in args if not isinstance(a, NDArray)]
+        attrs = {}
+        named_inputs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                named_inputs[k] = v
+            else:
+                attrs[k] = v
+        if attr_args:
+            # positional non-tensor args bind to param fields in declaration order
+            fields = [f for f in opdef.param_cls._fields if f not in attrs]
+            for a, f in zip(attr_args, fields):
+                attrs[f] = a
+        if named_inputs:
+            params_probe = opdef.make_params(dict(attrs))
+            names = opdef.list_inputs(params_probe) + opdef.list_aux(params_probe)
+            pos = {n: i for i, n in enumerate(names)}
+            merged = [None] * len(names)
+            for i, a in enumerate(inputs):
+                merged[i] = a
+            for k, v in named_inputs.items():
+                if k not in pos:
+                    raise MXNetError("%s: unknown input %r (expects %s)"
+                                     % (opdef.name, k, names))
+                merged[pos[k]] = v
+            inputs = [m for m in merged if m is not None]
+
+        visible, aux_updates = _imp.invoke_op(opdef, inputs, attrs)
+        if aux_updates:
+            # write updated aux states back in place (reference: aux_states mutation)
+            params_probe = opdef.make_params(dict(attrs))
+            n_in = len(opdef.list_inputs(params_probe))
+            aux_arrays = inputs[n_in:n_in + len(aux_updates)]
+            for arr, upd in zip(aux_arrays, aux_updates):
+                arr._data = upd._data
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for dst, src in zip(outs, visible):
+                dst._data = src._data
+                dst._node, dst._node_oidx = src._node, src._node_oidx
+            return out
+        if len(visible) == 1:
+            return visible[0]
+        return visible
+
+    op_func.__name__ = opdef.name
+    op_func.__doc__ = opdef.doc
+    return op_func
+
+
+_GENERATED = {}
+for _name, _opdef in list(OPS.items()):
+    _fn = _make_nd_function(_opdef)
+    _GENERATED[_name] = _fn
+    setattr(_this, _name, _fn)
+
+# aliases registered in the op registry
+from ..ops.registry import _ALIASES as _OP_ALIASES  # noqa: E402
+for _al, _target in _OP_ALIASES.items():
+    if _target in _GENERATED:
+        setattr(_this, _al, _GENERATED[_target])
+
+# snake_case mirrors of CamelCase ops that mxnet also exposes
+for _al, _target in [("fully_connected", "FullyConnected"), ("convolution", "Convolution"),
+                     ("pooling", "Pooling"), ("activation", "Activation"),
+                     ("batch_norm", "BatchNorm"), ("softmax_output", "SoftmaxOutput")]:
+    if _target in _GENERATED:
+        setattr(_this, _al, _GENERATED[_target])
+
+# make `nd.sum` etc. accept the NDArray-method style too (they already do).
+
+from . import sparse  # noqa: E402  (CSRNDArray / RowSparseNDArray)
+from .sparse import CSRNDArray, RowSparseNDArray, BaseSparseNDArray  # noqa: E402
+from . import random  # noqa: E402
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
+           "concatenate", "moveaxis", "waitall", "sparse", "random",
+           "CSRNDArray", "RowSparseNDArray"] + list(_GENERATED)

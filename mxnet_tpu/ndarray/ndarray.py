@@ -1,0 +1,510 @@
+"""NDArray — the imperative tensor (reference: include/mxnet/ndarray.h:82,
+python/mxnet/ndarray/ndarray.py, src/ndarray/ndarray.cc).
+
+TPU-native design: an NDArray is a mutable handle over an immutable `jax.Array`.
+The reference achieves async "engine semantics" with read/write Var dependencies
+(ndarray.h:720 Chunk::var); here JAX's async dispatch gives the same observable
+behavior — ops return immediately, `wait_to_read()`/`asnumpy()` are the sync
+points (reference: WaitForVar, threaded_engine.cc:366). Mutation (`a[:]=x`,
+`+=`, `out=`) swaps the underlying buffer; recorded VJP closures capture their
+own input buffers so the tape is immune to later mutation.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype, numeric_types, integer_types
+from ..context import Context, current_context, cpu
+from .. import imperative as _imp
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
+           "concatenate", "moveaxis", "waitall", "_new_from_jax"]
+
+
+class NDArray:
+    """Multi-dimensional array with MXNet-1.2 API over a jax.Array."""
+
+    __slots__ = ("_data", "_ctx", "_node", "_node_oidx", "_grad", "_grad_req",
+                 "_stype", "__weakref__")
+
+    # make numpy defer to us: mx_nd * np_array -> NDArray.__rmul__
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if ctx is None:
+            ctx = current_context()
+        elif not isinstance(ctx, Context):
+            ctx = Context(ctx)
+        if not isinstance(data, jax.Array):
+            # python lists/scalars default to float32 (mxnet convention);
+            # numpy arrays keep their dtype
+            keep_dtype = isinstance(data, _np.ndarray) and dtype is None
+            npd = _np.asarray(data, dtype=np_dtype(dtype) if dtype is not None else None)
+            if not keep_dtype and dtype is None and npd.dtype != _np.float32:
+                npd = npd.astype(_np.float32)
+            elif npd.dtype == _np.float64 and dtype is None:
+                npd = npd.astype(_np.float32)  # jax default is float32 anyway
+            data = jax.device_put(npd, ctx.jax_device)
+        elif dtype is not None and data.dtype != np_dtype(dtype):
+            data = data.astype(np_dtype(dtype))
+        self._data = data
+        self._ctx = ctx
+        self._node = None
+        self._node_oidx = 0
+        self._grad = None
+        self._grad_req = "null"
+        self._stype = "default"
+
+    # ------------------------------------------------------------------
+    # properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(_np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        if self.ndim < 2:
+            return self
+        return self.transpose()
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asnumpy().reshape(()))
+        raise ValueError("The truth value of an NDArray with multiple elements is ambiguous.")
+
+    def __repr__(self):
+        return "\n%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()), "x".join(str(s) for s in self.shape), self._ctx)
+
+    # ------------------------------------------------------------------
+    # sync / host transfer (reference sync points: asnumpy -> WaitForVar)
+    # ------------------------------------------------------------------
+    def asnumpy(self):
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    # ------------------------------------------------------------------
+    # context / dtype movement
+    # ------------------------------------------------------------------
+    def as_in_context(self, ctx):
+        if not isinstance(ctx, Context):
+            ctx = Context(ctx)
+        if ctx == self._ctx:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device), ctx=ctx)
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other):
+        """Copy into another NDArray (in-place write) or to a Context (new array)."""
+        if isinstance(other, NDArray):
+            if other is self:
+                return other
+            val = jax.device_put(self._data, other._ctx.jax_device)
+            if val.dtype != other.dtype:
+                val = val.astype(other.dtype)
+            if val.shape != other.shape:
+                raise MXNetError("copyto shape mismatch %s vs %s" % (self.shape, other.shape))
+            other._data = val
+            return other
+        ctx = other if isinstance(other, Context) else Context(other)
+        return NDArray(jax.device_put(self._data, ctx.jax_device), ctx=ctx)
+
+    def copy(self):
+        return NDArray(self._data + 0 if self.dtype != _np.bool_ else self._data.copy(),
+                       ctx=self._ctx)
+
+    def astype(self, dtype, copy=True):
+        dt = np_dtype(dtype)
+        if not copy and self.dtype == dt:
+            return self
+        return _imp.apply_fn(lambda x: x.astype(dt), [self])[0]
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """reference: python/mxnet/ndarray/ndarray.py attach_grad -> MarkVariables."""
+        grad = NDArray(jnp.zeros(self.shape, dtype=self.dtype), ctx=self._ctx)
+        _imp.mark_variables([self], [grad], grad_req)
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _imp.backward([self], [out_grad], retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # shape manipulation
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape"):
+            shape = tuple(kwargs["shape"])
+        shape = _infer_reshape(self.shape, shape)
+        return _imp.apply_fn(lambda x: jnp.reshape(x, shape), [self])[0]
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        ax = axes if axes else None
+        return _imp.apply_fn(lambda x: jnp.transpose(x, ax), [self])[0]
+
+    def swapaxes(self, dim1, dim2):
+        return _imp.apply_fn(lambda x: jnp.swapaxes(x, dim1, dim2), [self])[0]
+
+    def flatten(self):
+        n = self.shape[0] if self.ndim else 1
+        return self.reshape((n, -1))
+
+    def expand_dims(self, axis):
+        return _imp.apply_fn(lambda x: jnp.expand_dims(x, axis), [self])[0]
+
+    def squeeze(self, axis=None):
+        return _imp.apply_fn(lambda x: jnp.squeeze(x, axis), [self])[0]
+
+    def broadcast_to(self, shape):
+        shape = tuple(shape)
+        cur = self.shape
+        if len(cur) < len(shape):
+            cur = (1,) * (len(shape) - len(cur)) + cur
+        return _imp.apply_fn(lambda x: jnp.broadcast_to(x.reshape(cur), shape), [self])[0]
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def tile(self, reps):
+        return _imp.apply_fn(lambda x: jnp.tile(x, reps), [self])[0]
+
+    def repeat(self, repeats, axis=None):
+        return _imp.apply_fn(lambda x: jnp.repeat(x, repeats, axis=axis), [self])[0]
+
+    def flip(self, axis):
+        return _imp.apply_fn(lambda x: jnp.flip(x, axis), [self])[0]
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        from . import split as _split
+        return _split(self, num_outputs=num_outputs, axis=axis, squeeze_axis=squeeze_axis)
+
+    def slice_axis(self, axis, begin, end):
+        from . import slice_axis as _sa
+        return _sa(self, axis=axis, begin=begin, end=end)
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data
+        if isinstance(key, integer_types):
+            return _imp.apply_fn(lambda x: x[int(key)], [self])[0]
+        return _imp.apply_fn(lambda x: x[key], [self])[0]
+
+    def __setitem__(self, key, value):
+        if _imp.is_recording() and self._node is not None:
+            raise MXNetError("in-place assignment to an array produced inside "
+                             "autograd.record() is not supported")
+        if isinstance(key, NDArray):
+            key = key._data
+        if isinstance(value, NDArray):
+            val = value._data
+        elif isinstance(value, numeric_types):
+            val = value
+        else:
+            val = jnp.asarray(_np.asarray(value), dtype=self.dtype)
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            if isinstance(val, (int, float)):
+                self._data = jnp.full(self.shape, val, dtype=self.dtype)
+            else:
+                val = jnp.asarray(val, dtype=self.dtype)
+                self._data = jnp.broadcast_to(val, self.shape) + jnp.zeros((), dtype=self.dtype)
+        else:
+            self._data = self._data.at[key].set(val)
+
+    # ------------------------------------------------------------------
+    # arithmetic (records on tape via apply_fn)
+    # ------------------------------------------------------------------
+    def _binary(self, other, fn, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return _imp.apply_fn(lambda x, y: fn(x, y), [a, b])[0]
+        if isinstance(other, numeric_types):
+            if reverse:
+                return _imp.apply_fn(lambda x: fn(other, x), [self])[0]
+            return _imp.apply_fn(lambda x: fn(x, other), [self])[0]
+        if isinstance(other, _np.ndarray):
+            return self._binary(NDArray(other, ctx=self._ctx), fn, reverse)
+        return NotImplemented
+
+    def __add__(self, o):  return self._binary(o, jnp.add)
+    def __radd__(self, o): return self._binary(o, jnp.add, True)
+    def __sub__(self, o):  return self._binary(o, jnp.subtract)
+    def __rsub__(self, o): return self._binary(o, jnp.subtract, True)
+    def __mul__(self, o):  return self._binary(o, jnp.multiply)
+    def __rmul__(self, o): return self._binary(o, jnp.multiply, True)
+    def __div__(self, o):  return self._binary(o, jnp.divide)
+    def __rdiv__(self, o): return self._binary(o, jnp.divide, True)
+    def __truediv__(self, o):  return self._binary(o, jnp.divide)
+    def __rtruediv__(self, o): return self._binary(o, jnp.divide, True)
+    def __mod__(self, o):  return self._binary(o, jnp.mod)
+    def __rmod__(self, o): return self._binary(o, jnp.mod, True)
+    def __pow__(self, o):  return self._binary(o, jnp.power)
+    def __rpow__(self, o): return self._binary(o, jnp.power, True)
+    def __neg__(self):     return _imp.apply_fn(jnp.negative, [self])[0]
+    def __abs__(self):     return _imp.apply_fn(jnp.abs, [self])[0]
+
+    def _binary_cmp(self, other, fn):
+        out = self._binary(other, lambda x, y: fn(x, y).astype(jnp.float32))
+        return out
+
+    def __eq__(self, o):
+        if isinstance(o, (NDArray, _np.ndarray) + numeric_types):
+            return self._binary_cmp(o, jnp.equal)
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (NDArray, _np.ndarray) + numeric_types):
+            return self._binary_cmp(o, jnp.not_equal)
+        return NotImplemented
+
+    def __gt__(self, o):  return self._binary_cmp(o, jnp.greater)
+    def __ge__(self, o):  return self._binary_cmp(o, jnp.greater_equal)
+    def __lt__(self, o):  return self._binary_cmp(o, jnp.less)
+    def __le__(self, o):  return self._binary_cmp(o, jnp.less)  # fixed below
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: swap buffer (reference: engine write dependency on self var).
+    # Tape values are keyed by (node, out_idx), so adopting res's node is safe
+    # even though self also feeds that node as an input.
+    def _inplace(self, res):
+        self._data = res._data
+        self._node, self._node_oidx = res._node, res._node_oidx
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace(self.__add__(o))
+
+    def __isub__(self, o):
+        return self._inplace(self.__sub__(o))
+
+    def __imul__(self, o):
+        return self._inplace(self.__mul__(o))
+
+    def __itruediv__(self, o):
+        return self._inplace(self.__truediv__(o))
+
+    __idiv__ = __itruediv__
+
+    # ------------------------------------------------------------------
+    # reductions & misc math (thin wrappers; full op set lives in mx.nd.*)
+    # ------------------------------------------------------------------
+    def sum(self, axis=None, keepdims=False):
+        return _imp.apply_fn(lambda x: jnp.sum(x, axis=axis, keepdims=keepdims), [self])[0]
+
+    def mean(self, axis=None, keepdims=False):
+        return _imp.apply_fn(lambda x: jnp.mean(x, axis=axis, keepdims=keepdims), [self])[0]
+
+    def max(self, axis=None, keepdims=False):
+        return _imp.apply_fn(lambda x: jnp.max(x, axis=axis, keepdims=keepdims), [self])[0]
+
+    def min(self, axis=None, keepdims=False):
+        return _imp.apply_fn(lambda x: jnp.min(x, axis=axis, keepdims=keepdims), [self])[0]
+
+    def prod(self, axis=None, keepdims=False):
+        return _imp.apply_fn(lambda x: jnp.prod(x, axis=axis, keepdims=keepdims), [self])[0]
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _imp.apply_fn(
+            lambda x: jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+            if ord == 2 else jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims), [self])[0]
+
+    def argmax(self, axis=None, keepdims=False):
+        return _imp.apply_fn(
+            lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims).astype(jnp.float32), [self])[0]
+
+    def argmin(self, axis=None, keepdims=False):
+        return _imp.apply_fn(
+            lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32), [self])[0]
+
+    def abs(self):
+        return self.__abs__()
+
+    def clip(self, a_min=None, a_max=None):
+        return _imp.apply_fn(lambda x: jnp.clip(x, a_min, a_max), [self])[0]
+
+    def sqrt(self):
+        return _imp.apply_fn(jnp.sqrt, [self])[0]
+
+    def square(self):
+        return _imp.apply_fn(jnp.square, [self])[0]
+
+    def dot(self, other):
+        from . import dot as _dot
+        return _dot(self, other)
+
+    def sigmoid(self):
+        return _imp.apply_fn(jax.nn.sigmoid, [self])[0]
+
+    def tanh(self):
+        return _imp.apply_fn(jnp.tanh, [self])[0]
+
+    def relu(self):
+        return _imp.apply_fn(jax.nn.relu, [self])[0]
+
+    def softmax(self, axis=-1):
+        return _imp.apply_fn(lambda x: jax.nn.softmax(x, axis=axis), [self])[0]
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return _imp.apply_fn(
+            lambda x: jax.nn.one_hot(x.astype(jnp.int32), depth) * (on_value - off_value)
+            + off_value, [self])[0]
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        from . import topk as _topk
+        return _topk(self, axis=axis, k=k, ret_typ=ret_typ, is_ascend=is_ascend)
+
+
+NDArray.__le__ = lambda self, o: self._binary_cmp(o, jnp.less_equal)
+
+
+def _infer_reshape(cur_shape, shape):
+    """Support mxnet reshape special codes 0 (copy dim) and -1 (infer)."""
+    if 0 in shape:
+        shape = tuple(cur_shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return shape
+
+
+def _new_from_jax(data, ctx=None):
+    return NDArray(data, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# creation routines (reference: python/mxnet/ndarray/ndarray.py + init ops)
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        arr = source_array.as_in_context(ctx) if ctx is not None else source_array.copy()
+        return arr.astype(dtype) if dtype is not None else arr
+    return NDArray(source_array, ctx=ctx, dtype=dtype)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, stype=None, **kwargs):
+    if stype not in (None, "default"):
+        from .sparse import zeros as sparse_zeros
+        return sparse_zeros(stype, shape, ctx=ctx, dtype=dtype)
+    if isinstance(shape, int):
+        shape = (shape,)
+    ctx = ctx or current_context()
+    return NDArray(jax.device_put(jnp.zeros(shape, dtype=np_dtype(dtype)),
+                                  Context(ctx).jax_device if not isinstance(ctx, Context)
+                                  else ctx.jax_device), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    ctx = ctx or current_context()
+    c = ctx if isinstance(ctx, Context) else Context(ctx)
+    return NDArray(jax.device_put(jnp.ones(shape, dtype=np_dtype(dtype)), c.jax_device), ctx=c)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    ctx = ctx or current_context()
+    c = ctx if isinstance(ctx, Context) else Context(ctx)
+    return NDArray(jax.device_put(jnp.full(shape, val, dtype=np_dtype(dtype)), c.jax_device),
+                   ctx=c)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    c = ctx if isinstance(ctx, Context) else Context(ctx)
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(jax.device_put(out, c.jax_device), ctx=c)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _imp.apply_fn(lambda *xs: jnp.concatenate(xs, axis=axis), list(arrays))[0]
+
+
+def moveaxis(tensor, source, destination):
+    return _imp.apply_fn(lambda x: jnp.moveaxis(x, source, destination), [tensor])[0]
+
+
+def waitall():
+    """reference: MXNDArrayWaitAll — block until all async work completes."""
+    (jax.device_put(0.0) + 0).block_until_ready()

@@ -1,0 +1,70 @@
+"""mx.nd.random namespace (reference: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+import jax
+
+from .. import random as _rnd
+from .. import imperative as _imp
+from ..context import current_context
+from .ndarray import NDArray
+
+
+def _sample(fn, shape, ctx, dtype):
+    if isinstance(shape, int):
+        shape = (shape,)
+    key = _rnd.next_key()
+    out = fn(key, shape)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return NDArray(out, ctx=ctx or current_context())
+
+
+def uniform(low=0, high=1, shape=(1,), dtype=None, ctx=None, out=None, **kwargs):
+    res = _sample(lambda k, s: jax.random.uniform(k, s, minval=low, maxval=high),
+                  shape, ctx, dtype)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def normal(loc=0, scale=1, shape=(1,), dtype=None, ctx=None, out=None, **kwargs):
+    res = _sample(lambda k, s: jax.random.normal(k, s) * scale + loc, shape, ctx, dtype)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+randn = normal
+
+
+def gamma(alpha=1, beta=1, shape=(1,), dtype=None, ctx=None, **kwargs):
+    return _sample(lambda k, s: jax.random.gamma(k, alpha, s) * beta, shape, ctx, dtype)
+
+
+def exponential(scale=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
+    return _sample(lambda k, s: jax.random.exponential(k, s) * scale, shape, ctx, dtype)
+
+
+def poisson(lam=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
+    return _sample(lambda k, s: jax.random.poisson(k, lam, s).astype("float32"),
+                   shape, ctx, dtype)
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None, **kwargs):
+    return _sample(lambda k, s: jax.random.randint(k, s, low, high), shape, ctx, dtype)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kwargs):
+    from . import _sample_multinomial
+    return _sample_multinomial(data, shape=shape, get_prob=get_prob, dtype=dtype)
+
+
+def shuffle(data, **kwargs):
+    key = _rnd.next_key()
+    return _imp.apply_fn(lambda x: jax.random.permutation(key, x, axis=0), [data])[0]
+
+
+def seed(seed_state, ctx="all"):
+    _rnd.seed(seed_state)

@@ -1,0 +1,239 @@
+"""Sparse NDArray: CSRNDArray and RowSparseNDArray.
+
+Reference: include/mxnet/ndarray.h:61 (kCSRStorage/kRowSparseStorage),
+python/mxnet/ndarray/sparse.py. TPU has no native sparse tensors, so storage is
+(indices, values) host-device pairs and kernels are gather/segment ops —
+SURVEY.md §7 "Sparse on TPU". Eager-only for now; dense fallback via tostype.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype
+from ..context import current_context, Context
+from .ndarray import NDArray, zeros as _dense_zeros
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "zeros", "cast_storage", "dot",
+           "retain"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base; subclasses keep auxiliary index arrays beside values."""
+
+    __slots__ = ("_indices", "_indptr", "_shape")
+
+    def __init__(self, data, shape, ctx=None, dtype=None):
+        super().__init__(data, ctx=ctx, dtype=dtype)
+        self._shape = tuple(shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def todense(self):
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == self.stype:
+            return self
+        return cast_storage(self.todense(), stype)
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(str(s) for s in self._shape), self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2D compressed-sparse-row array (reference: CSRNDArray)."""
+
+    def __init__(self, data, indices, indptr, shape, ctx=None, dtype=None):
+        super().__init__(data, shape, ctx=ctx, dtype=dtype)
+        self._stype = "csr"
+        self._indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._indptr = jnp.asarray(indptr, dtype=jnp.int32)
+
+    @property
+    def data(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr, ctx=self._ctx)
+
+    def todense(self):
+        n, m = self._shape
+        nnz = self._indices.shape[0]
+        if nnz == 0:
+            return _dense_zeros(self._shape, ctx=self._ctx, dtype=self.dtype)
+        rows = jnp.searchsorted(self._indptr, jnp.arange(nnz), side="right") - 1
+        dense = jnp.zeros((n, m), dtype=self._data.dtype).at[
+            rows, self._indices].add(self._data)
+        return NDArray(dense, ctx=self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return CSRNDArray(self._data, self._indices, self._indptr, self._shape,
+                              ctx=other)
+        return super().copyto(other)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start = key.start or 0
+            stop = key.stop if key.stop is not None else self._shape[0]
+            d = self.todense().asnumpy()[start:stop]
+            return array(_np_csr(d), ctx=self._ctx)
+        raise MXNetError("CSRNDArray supports only row-slice indexing")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First-dim-sparse array: (indices, values-rows) (reference: RowSparseNDArray)."""
+
+    def __init__(self, data, indices, shape, ctx=None, dtype=None):
+        super().__init__(data, shape, ctx=ctx, dtype=dtype)
+        self._stype = "row_sparse"
+        self._indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._indptr = None
+
+    @property
+    def data(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, ctx=self._ctx)
+
+    def todense(self):
+        dense = jnp.zeros(self._shape, dtype=self._data.dtype)
+        if self._indices.shape[0]:
+            dense = dense.at[self._indices].add(self._data)
+        return NDArray(dense, ctx=self._ctx)
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create CSR from (data, indices, indptr) tuple or dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
+        indices = indices.asnumpy() if isinstance(indices, NDArray) else _np.asarray(indices)
+        indptr = indptr.asnumpy() if isinstance(indptr, NDArray) else _np.asarray(indptr)
+        return CSRNDArray(data.astype(np_dtype(dtype)), indices, indptr, shape, ctx=ctx)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return _np_csr(dense, ctx=ctx, dtype=dtype)
+
+
+def _np_csr(dense, ctx=None, dtype=None):
+    dense = _np.asarray(dense)
+    n, m = dense.shape
+    indptr = [0]
+    indices = []
+    data = []
+    for r in range(n):
+        nz = _np.nonzero(dense[r])[0]
+        indices.extend(nz.tolist())
+        data.extend(dense[r, nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(_np.asarray(data, dtype=np_dtype(dtype) if dtype else dense.dtype),
+                      _np.asarray(indices, dtype=_np.int32),
+                      _np.asarray(indptr, dtype=_np.int32), (n, m), ctx=ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
+        indices = indices.asnumpy() if isinstance(indices, NDArray) else _np.asarray(indices)
+        return RowSparseNDArray(data.astype(np_dtype(dtype)), indices, shape, ctx=ctx)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    nz_rows = _np.nonzero(_np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+    return RowSparseNDArray(dense[nz_rows], nz_rows.astype(_np.int32),
+                            dense.shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    dt = np_dtype(dtype)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,), dt), _np.zeros((0,), _np.int32),
+                          _np.zeros((shape[0] + 1,), _np.int32), shape, ctx=ctx)
+    if stype == "row_sparse":
+        return RowSparseNDArray(_np.zeros((0,) + tuple(shape[1:]), dt),
+                                _np.zeros((0,), _np.int32), shape, ctx=ctx)
+    return _dense_zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def cast_storage(arr, stype):
+    """reference: src/operator/tensor/cast_storage.cc."""
+    if stype == arr.stype:
+        return arr
+    if stype == "default":
+        return arr.todense()
+    dense = arr.asnumpy()
+    if stype == "csr":
+        return _np_csr(dense, ctx=arr.context)
+    if stype == "row_sparse":
+        return row_sparse_array(dense, ctx=arr.context)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def retain(rsp, indices):
+    """Keep only the given rows (reference: sparse_retain.cc)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    want = indices.asnumpy().astype(_np.int64) if isinstance(indices, NDArray) \
+        else _np.asarray(indices, dtype=_np.int64)
+    have = _np.asarray(rsp._indices)
+    mask = _np.isin(have, want)
+    return RowSparseNDArray(_np.asarray(rsp._data)[mask], have[mask], rsp.shape,
+                            ctx=rsp.context)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: src/operator/tensor/dot-inl.h).
+
+    csr x dense  -> dense        (FM forward)
+    csr.T x dense -> row_sparse  (FM gradient path)
+    """
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
+        nnz = lhs._indices.shape[0]
+        n, m = lhs.shape
+        if nnz == 0:
+            shape = (m, rhs.shape[1]) if transpose_a else (n, rhs.shape[1])
+            return _dense_zeros(shape, ctx=lhs.context, dtype=lhs.dtype)
+        rows = jnp.searchsorted(lhs._indptr, jnp.arange(nnz), side="right") - 1
+        vals = lhs._data
+        cols = lhs._indices
+        if transpose_a:
+            # out[m, k] = sum over nnz at (r, c): val * rhs[r, :] scattered to row c
+            contrib = vals[:, None] * rhs._data[rows]
+            out = jnp.zeros((m, rhs.shape[1]), dtype=rhs.dtype).at[cols].add(contrib)
+            return NDArray(out, ctx=lhs.context)
+        contrib = vals[:, None] * rhs._data[cols]
+        out = jnp.zeros((n, rhs.shape[1]), dtype=rhs.dtype).at[rows].add(contrib)
+        return NDArray(out, ctx=lhs.context)
+    # dense fallback
+    from . import dot as _dense_dot
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return _dense_dot(l, r, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def array(source, ctx=None, dtype=None):
+    if isinstance(source, BaseSparseNDArray):
+        return source
+    raise MXNetError("use csr_matrix/row_sparse_array to build sparse arrays")

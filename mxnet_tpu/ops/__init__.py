@@ -1,0 +1,14 @@
+"""Operator library: importing this package registers all ops.
+
+Reference analog: src/operator/ static registration at library load.
+"""
+from .registry import OpDef, register_op, get_op, find_op, list_ops, OPS
+
+from . import elemwise       # noqa: F401
+from . import tensor         # noqa: F401
+from . import nn             # noqa: F401
+from . import random_ops     # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import fork_ops       # noqa: F401
+
+__all__ = ["OpDef", "register_op", "get_op", "find_op", "list_ops", "OPS"]

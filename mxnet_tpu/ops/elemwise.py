@@ -1,0 +1,184 @@
+"""Elementwise / scalar / broadcast op families.
+
+Reference: src/operator/tensor/elemwise_{unary,binary,binary_scalar,binary_broadcast}_op*.cc
+(registered via MXNET_OPERATOR_REGISTER_* macros). On TPU these all lower to XLA
+elementwise HLOs and fuse into neighbors — one jnp call each is the whole port.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import Params, param_field, np_dtype
+from .registry import register_op
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "negative": jnp.negative,
+    "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sqrt": jnp.sqrt, "rsqrt": lambda x: jax.lax.rsqrt(x), "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "square": jnp.square, "reciprocal": lambda x: 1.0 / x,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+    "rint": jnp.rint, "trunc": jnp.trunc, "fix": jnp.trunc,
+    "sigmoid": jax.nn.sigmoid, "relu": jax.nn.relu,
+    "softsign": jax.nn.soft_sign,
+    "gamma": lambda x: jnp.exp(jax.lax.lgamma(x)),
+    "gammaln": lambda x: jax.lax.lgamma(x),
+    "erf": jax.lax.erf,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+
+def _make_unary(fn):
+    def op(params, x):
+        return fn(x)
+    return op
+
+
+for _name, _fn in _UNARY.items():
+    register_op(_name)(_make_unary(_fn))
+
+register_op("identity", aliases=("_copy", "stop_gradient_off"))(lambda params, x: x)
+register_op("BlockGrad", aliases=("stop_gradient",))(
+    lambda params, x: jax.lax.stop_gradient(x))
+register_op("make_loss")(lambda params, x: x)
+register_op("softrelu")(lambda params, x: jnp.logaddexp(x, 0.0))
+
+# ---------------------------------------------------------------------------
+# binary (same-shape elemwise and broadcast variants share impls — XLA
+# broadcasting covers both; mxnet distinguishes only for shape inference)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply, "div": jnp.divide,
+    "mod": jnp.mod, "power": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "equal": lambda a, b: (a == b).astype(a.dtype),
+    "not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "greater": lambda a, b: (a > b).astype(a.dtype),
+    "greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "lesser": lambda a, b: (a < b).astype(a.dtype),
+    "lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+    "logical_and": lambda a, b: ((a != 0) & (b != 0)).astype(a.dtype),
+    "logical_or": lambda a, b: ((a != 0) | (b != 0)).astype(a.dtype),
+    "logical_xor": lambda a, b: ((a != 0) ^ (b != 0)).astype(a.dtype),
+}
+
+
+def _make_binary(fn):
+    def op(params, lhs, rhs):
+        return fn(lhs, rhs)
+    return op
+
+
+for _name, _fn in _BINARY.items():
+    register_op("elemwise_" + _name if _name in ("add", "sub", "mul", "div") else _name,
+                aliases=("_" + _name, "broadcast_" + _name),
+                input_names=("lhs", "rhs"))(_make_binary(_fn))
+
+# mxnet also exposes broadcast_plus/minus as aliases
+from .registry import _ALIASES  # noqa: E402
+_ALIASES.update({
+    "broadcast_plus": "elemwise_add", "broadcast_minus": "elemwise_sub",
+    "_plus": "elemwise_add", "_minus": "elemwise_sub",
+    "_Plus": "elemwise_add", "_Minus": "elemwise_sub",
+    "_Mul": "elemwise_mul", "_Div": "elemwise_div",
+    "_Power": "power", "_Maximum": "maximum", "_Minimum": "minimum",
+})
+
+
+# ---------------------------------------------------------------------------
+# scalar ops (reference: elemwise_binary_scalar_op*.cc — _plus_scalar etc.)
+# ---------------------------------------------------------------------------
+
+class ScalarParam(Params):
+    scalar = param_field(float, default=0.0)
+
+
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+}
+
+
+def _make_scalar(fn):
+    def op(params, x):
+        return fn(x, params.scalar)
+    return op
+
+
+for _name, _fn in _SCALAR.items():
+    register_op(_name, param_cls=ScalarParam)(_make_scalar(_fn))
+
+
+class SmoothL1Param(Params):
+    scalar = param_field(float, default=1.0)
+
+
+@register_op("smooth_l1", param_cls=SmoothL1Param)
+def _smooth_l1(params, x):
+    """reference: elemwise_binary_scalar_op_extended.cc:86 (SSD loss building block)."""
+    sigma2 = params.scalar * params.scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / sigma2, 0.5 * sigma2 * x * x, absx - 0.5 / sigma2)
+
+
+class ClipParam(Params):
+    a_min = param_field(float, default=None)
+    a_max = param_field(float, default=None)
+
+
+@register_op("clip", param_cls=ClipParam)
+def _clip(params, x):
+    return jnp.clip(x, params.a_min, params.a_max)
+
+
+class CastParam(Params):
+    dtype = param_field(str, default="float32")
+
+
+@register_op("Cast", aliases=("cast",), param_cls=CastParam)
+def _cast(params, x):
+    return x.astype(np_dtype(params.dtype))
+
+
+class AddNParam(Params):
+    num_args = param_field(int, default=2, required=False)
+
+
+@register_op("add_n", aliases=("ElementWiseSum", "_sum"), param_cls=AddNParam,
+             key_var_num_args="num_args",
+             input_names=lambda p: tuple("arg%d" % i for i in range(p.num_args if p else 2)))
+def _add_n(params, *args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out

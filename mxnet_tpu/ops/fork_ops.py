@@ -1,0 +1,160 @@
+"""Fork-added legacy CV ops (reference: src/operator/{lsoftmax,correlation1D,
+multi_logistic,weighted_l1}.cc — the four ops this fork adds over upstream MXNet).
+
+TPU-native: expressed as pure jnp math; LSoftmax's piecewise large-margin logit
+is vectorized over the batch (reference computes it in a per-sample CUDA kernel,
+lsoftmax.cu:68-90); autodiff supplies the backward the reference hand-codes.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..base import Params, param_field
+from .registry import register_op
+
+
+class LSoftmaxParam(Params):
+    margin = param_field(int, default=2)
+    beta = param_field(float, default=1.0)
+    beta_min = param_field(float, default=0.0)
+    scale = param_field(float, default=1.0)
+    num_hidden = param_field(int, required=True)
+    verbose = param_field(bool, default=False)
+
+
+@register_op("LSoftmax", param_cls=LSoftmaxParam,
+             input_names=("data", "weight", "label"), num_outputs=3,
+             output_names=("output", "data_norm", "weight_norm"), need_train=True)
+def _lsoftmax(params, x, w, label, is_train=False):
+    """Large-Margin softmax logits (lsoftmax.cu:81-89):
+    out[i, yi] -> ((-1)^k cos(m*theta) - 2k) * |x_i| * |w_yi|, blended by beta."""
+    m = params.margin
+    out = jnp.dot(x, w.T)
+    x_norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=1) + 1e-12)
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(w), axis=1) + 1e-12)
+    if not is_train:
+        return out, x_norm, w_norm
+
+    yi = label.astype(jnp.int32)
+    fo = jnp.take_along_axis(out, yi[:, None], axis=1)[:, 0]
+    wn_y = w_norm[yi]
+    cos_t = fo / (x_norm * wn_y)
+    cos_t = jnp.clip(cos_t, -1.0, 1.0)
+    # k s.t. cos_t in [cos((k+1)pi/m), cos(k pi/m)]
+    k_table = jnp.asarray([math.cos(i * math.pi / m) for i in range(m + 1)],
+                          dtype=out.dtype)
+    k = jnp.sum((cos_t < k_table[None, 1:]).astype(jnp.int32), axis=1)
+    # cos(m t) via binomial expansion: sum_j (-1)^j C(m,2j) cos^{m-2j} sin^{2j}
+    sin2 = 1.0 - cos_t * cos_t
+    cos_mt = jnp.zeros_like(cos_t)
+    for j in range(m // 2 + 1):
+        c = math.comb(m, 2 * j)
+        cos_mt = cos_mt + ((-1) ** j) * c * jnp.power(cos_t, m - 2 * j) * jnp.power(sin2, j)
+    psi = jnp.power(-1.0, k.astype(out.dtype)) * cos_mt - 2.0 * k.astype(out.dtype)
+    f_new = psi * x_norm * wn_y
+    blended = (f_new + params.beta * fo) / (1.0 + params.beta)
+    out = out.at[jnp.arange(out.shape[0]), yi].set(blended)
+    return out, x_norm, w_norm
+
+
+class MultiLogisticParam(Params):
+    grad_scale = param_field(float, default=1.0)
+    p = param_field(float, default=2.0)
+    weight = param_field(float, default=1.0)
+
+
+@register_op("MultiLogistic", param_cls=MultiLogisticParam,
+             input_names=("data", "label"))
+def _multi_logistic(params, data, label):
+    """Sigmoid forward; backward = (sig-label)*(w*label + (1-label))*scale
+    (multi_logistic-inl.h Backward)."""
+
+    @jax.custom_vjp
+    def op(d, l):
+        return jax.nn.sigmoid(d)
+
+    def fwd(d, l):
+        return jax.nn.sigmoid(d), (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        out = jax.nn.sigmoid(d)
+        grad = out - l
+        grad = params.grad_scale * (grad * l * params.weight + grad * (1 - l))
+        return grad.astype(d.dtype), jnp.zeros_like(l)
+
+    op.defvjp(fwd, bwd)
+    return op(data, label)
+
+
+class WeightedL1Param(Params):
+    grad_scale = param_field(float, default=1.0)
+
+
+@register_op("WeightedL1", param_cls=WeightedL1Param, input_names=("data", "label"))
+def _weighted_l1(params, data, label):
+    """Identity forward; backward = scale*sign(out-label)*mask(label!=0)
+    (weighted_l1-inl.h Backward with binary_mask)."""
+
+    @jax.custom_vjp
+    def op(d, l):
+        return d
+
+    def fwd(d, l):
+        return d, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        grad = params.grad_scale * jnp.sign(d - l) * (l != 0).astype(d.dtype)
+        return grad, jnp.zeros_like(l)
+
+    op.defvjp(fwd, bwd)
+    return op(data, label)
+
+
+class Correlation1DParam(Params):
+    kernel_size = param_field(int, default=1)
+    max_displacement = param_field(int, default=1)
+    stride1 = param_field(int, default=1)
+    stride2 = param_field(int, default=1)
+    pad_size = param_field(int, default=0)
+    single_side = param_field(int, default=0)
+    is_multiply = param_field(bool, default=True)
+
+
+@register_op("Correlation1D", param_cls=Correlation1DParam,
+             input_names=("data1", "data2"))
+def _correlation1d(params, data1, data2):
+    """Stereo cost volume (correlation1D-inl.h): horizontal-only correlation.
+
+    out[:, d, y, x] = mean over kernel patch of data1[..., x] * data2[..., x + disp_d],
+    displacements spanning the (possibly single-sided) neighborhood.
+    """
+    pad = params.pad_size
+    k = params.kernel_size
+    kr = (k - 1) // 2
+    s2 = params.stride2
+    ngr = params.max_displacement // s2  # neighborhood_grid_radius
+    if params.single_side == 0:
+        disps = [d * s2 for d in range(-ngr, ngr + 1)]
+    elif params.single_side < 0:
+        disps = [d * s2 for d in range(-ngr, 1)]
+    else:
+        disps = [d * s2 for d in range(0, ngr + 1)]
+
+    p1 = jnp.pad(data1, [(0, 0), (0, 0), (0, 0), (pad, pad)])
+    p2 = jnp.pad(data2, [(0, 0), (0, 0), (0, 0), (pad, pad)])
+    W = data1.shape[3]
+    outs = []
+    for d in disps:
+        shifted = jnp.roll(p2, -d, axis=3)
+        prod = p1 * shifted
+        # average over kernel window and channels
+        if k > 1:
+            prod = sum(jnp.roll(prod, -o, axis=3) for o in range(-kr, kr + 1)) / k
+        corr = jnp.mean(prod, axis=1)  # (N, H, Wp)
+        outs.append(corr[:, :, pad:pad + W])
+    return jnp.stack(outs, axis=1)

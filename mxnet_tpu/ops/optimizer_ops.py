@@ -1,0 +1,153 @@
+"""Optimizer update ops (reference: src/operator/optimizer_op.cc:642).
+
+The reference runs optimizer math as device-side ops so updates never leave the
+accelerator; here each update is a pure jax fn the caller (Optimizer/Trainer or a
+jitted kvstore step) applies with buffer donation. Each op returns the new weight
+(plus new state tensors) instead of writing in place — callers swap buffers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import Params, param_field
+from .registry import register_op
+
+
+class SGDParam(Params):
+    lr = param_field(float, required=True)
+    wd = param_field(float, default=0.0)
+    rescale_grad = param_field(float, default=1.0)
+    clip_gradient = param_field(float, default=-1.0)
+    lazy_update = param_field(bool, default=True)
+
+
+def _prep_grad(params, grad):
+    g = grad * params.rescale_grad
+    if params.clip_gradient > 0:
+        g = jnp.clip(g, -params.clip_gradient, params.clip_gradient)
+    return g
+
+
+@register_op("sgd_update", param_cls=SGDParam, input_names=("weight", "grad"))
+def _sgd_update(params, weight, grad):
+    g = _prep_grad(params, grad) + params.wd * weight
+    return weight - params.lr * g
+
+
+class SGDMomParam(SGDParam):
+    momentum = param_field(float, default=0.0)
+
+
+@register_op("sgd_mom_update", param_cls=SGDMomParam,
+             input_names=("weight", "grad", "mom"), num_outputs=2)
+def _sgd_mom_update(params, weight, grad, mom):
+    g = _prep_grad(params, grad) + params.wd * weight
+    mom = params.momentum * mom - params.lr * g
+    return weight + mom, mom
+
+
+@register_op("mp_sgd_update", param_cls=SGDParam,
+             input_names=("weight", "grad", "weight32"), num_outputs=2)
+def _mp_sgd_update(params, weight, grad, weight32):
+    g = _prep_grad(params, grad.astype(jnp.float32)) + params.wd * weight32
+    w32 = weight32 - params.lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register_op("mp_sgd_mom_update", param_cls=SGDMomParam,
+             input_names=("weight", "grad", "mom", "weight32"), num_outputs=3)
+def _mp_sgd_mom_update(params, weight, grad, mom, weight32):
+    g = _prep_grad(params, grad.astype(jnp.float32)) + params.wd * weight32
+    mom = params.momentum * mom - params.lr * g
+    w32 = weight32 + mom
+    return w32.astype(weight.dtype), mom, w32
+
+
+class AdamParam(SGDParam):
+    beta1 = param_field(float, default=0.9)
+    beta2 = param_field(float, default=0.999)
+    epsilon = param_field(float, default=1e-8)
+
+
+@register_op("adam_update", param_cls=AdamParam,
+             input_names=("weight", "grad", "mean", "var"), num_outputs=3)
+def _adam_update(params, weight, grad, mean, var):
+    g = _prep_grad(params, grad) + params.wd * weight
+    mean = params.beta1 * mean + (1 - params.beta1) * g
+    var = params.beta2 * var + (1 - params.beta2) * jnp.square(g)
+    w = weight - params.lr * mean / (jnp.sqrt(var) + params.epsilon)
+    return w, mean, var
+
+
+class RMSPropParam(SGDParam):
+    gamma1 = param_field(float, default=0.95)
+    gamma2 = param_field(float, default=0.9)
+    epsilon = param_field(float, default=1e-8)
+    centered = param_field(bool, default=False)
+    clip_weights = param_field(float, default=-1.0)
+
+
+@register_op("rmsprop_update", param_cls=RMSPropParam,
+             input_names=("weight", "grad", "n"), num_outputs=2)
+def _rmsprop_update(params, weight, grad, n):
+    g = _prep_grad(params, grad) + params.wd * weight
+    n = (1 - params.gamma1) * jnp.square(g) + params.gamma1 * n
+    w = weight - params.lr * g / jnp.sqrt(n + params.epsilon)
+    if params.clip_weights > 0:
+        w = jnp.clip(w, -params.clip_weights, params.clip_weights)
+    return w, n
+
+
+@register_op("rmspropalex_update", param_cls=RMSPropParam,
+             input_names=("weight", "grad", "n", "g", "delta"), num_outputs=4)
+def _rmspropalex_update(params, weight, grad, n, gmean, delta):
+    g = _prep_grad(params, grad) + params.wd * weight
+    n = (1 - params.gamma1) * jnp.square(g) + params.gamma1 * n
+    gmean = (1 - params.gamma1) * g + params.gamma1 * gmean
+    delta = (params.gamma2 * delta
+             - params.lr * g / jnp.sqrt(n - jnp.square(gmean) + params.epsilon))
+    return weight + delta, n, gmean, delta
+
+
+class FtrlParam(SGDParam):
+    lamda1 = param_field(float, default=0.01)
+    beta = param_field(float, default=1.0)
+
+
+@register_op("ftrl_update", param_cls=FtrlParam,
+             input_names=("weight", "grad", "z", "n"), num_outputs=3)
+def _ftrl_update(params, weight, grad, z, n):
+    g = _prep_grad(params, grad)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / params.lr
+    z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z) > params.lamda1,
+        -(z - jnp.sign(z) * params.lamda1)
+        / ((params.beta + jnp.sqrt(new_n)) / params.lr + params.wd),
+        0.0).astype(weight.dtype)
+    return w, z, new_n
+
+
+class SignSGDParam(SGDParam):
+    pass
+
+
+@register_op("signsgd_update", param_cls=SignSGDParam, input_names=("weight", "grad"))
+def _signsgd_update(params, weight, grad):
+    g = _prep_grad(params, grad)
+    return weight - params.lr * (jnp.sign(g) + params.wd * weight)
+
+
+class SignumParam(SGDMomParam):
+    wd_lh = param_field(float, default=0.0)
+
+
+@register_op("signum_update", param_cls=SignumParam,
+             input_names=("weight", "grad", "mom"), num_outputs=2)
+def _signum_update(params, weight, grad, mom):
+    g = _prep_grad(params, grad) + params.wd * weight
+    mom = params.momentum * mom - (1 - params.momentum) * g
+    w = (1 - params.lr * params.wd_lh) * weight + params.lr * jnp.sign(mom)
+    return w, mom

@@ -1,0 +1,118 @@
+"""Random sampling ops (reference: src/operator/random/sample_op.cc).
+
+All draw from the framework PRNG chain (mx.random.seed) — see random.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import Params, param_field, np_dtype
+from .registry import register_op
+
+
+class SampleParam(Params):
+    shape = param_field(tuple, default=())
+    dtype = param_field(str, default="float32")
+    ctx = param_field(str, default=None)
+
+
+class UniformParam(SampleParam):
+    low = param_field(float, default=0.0)
+    high = param_field(float, default=1.0)
+
+
+@register_op("_random_uniform", aliases=("uniform", "random_uniform"),
+             param_cls=UniformParam, input_names=(), need_rng=True)
+def _uniform(params, rng=None):
+    return jax.random.uniform(rng, params.shape, dtype=np_dtype(params.dtype),
+                              minval=params.low, maxval=params.high)
+
+
+class NormalParam(SampleParam):
+    loc = param_field(float, default=0.0)
+    scale = param_field(float, default=1.0)
+
+
+@register_op("_random_normal", aliases=("normal", "random_normal"),
+             param_cls=NormalParam, input_names=(), need_rng=True)
+def _normal(params, rng=None):
+    return (jax.random.normal(rng, params.shape, dtype=np_dtype(params.dtype))
+            * params.scale + params.loc)
+
+
+class GammaParam(SampleParam):
+    alpha = param_field(float, default=1.0)
+    beta = param_field(float, default=1.0)
+
+
+@register_op("_random_gamma", aliases=("random_gamma",), param_cls=GammaParam,
+             input_names=(), need_rng=True)
+def _gamma(params, rng=None):
+    return (jax.random.gamma(rng, params.alpha, params.shape,
+                             dtype=np_dtype(params.dtype)) * params.beta)
+
+
+class ExpParam(SampleParam):
+    lam = param_field(float, default=1.0)
+
+
+@register_op("_random_exponential", aliases=("random_exponential",),
+             param_cls=ExpParam, input_names=(), need_rng=True)
+def _exponential(params, rng=None):
+    return jax.random.exponential(rng, params.shape,
+                                  dtype=np_dtype(params.dtype)) / params.lam
+
+
+@register_op("_random_poisson", aliases=("random_poisson",), param_cls=ExpParam,
+             input_names=(), need_rng=True)
+def _poisson(params, rng=None):
+    return jax.random.poisson(rng, params.lam, params.shape).astype(np_dtype(params.dtype))
+
+
+class NegBinParam(SampleParam):
+    k = param_field(int, default=1)
+    p = param_field(float, default=1.0)
+
+
+@register_op("_random_negative_binomial", aliases=("random_negative_binomial",),
+             param_cls=NegBinParam, input_names=(), need_rng=True)
+def _neg_binomial(params, rng=None):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    lam = jax.random.gamma(rng, params.k, params.shape) * (1 - params.p) / params.p
+    return jax.random.poisson(jax.random.fold_in(rng, 1), lam).astype(
+        np_dtype(params.dtype))
+
+
+class MultinomialParam(Params):
+    shape = param_field(tuple, default=())
+    get_prob = param_field(bool, default=False)
+    dtype = param_field(str, default="int32")
+
+
+@register_op("_sample_multinomial", aliases=("sample_multinomial",),
+             param_cls=MultinomialParam, input_names=("data",), need_rng=True,
+             num_outputs=lambda p: 2 if (p and p.get_prob) else 1)
+def _multinomial(params, data, rng=None):
+    n = int(jnp.prod(jnp.asarray(params.shape))) if params.shape else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    samp = jax.random.categorical(rng, logits, axis=-1,
+                                  shape=(n,) + data.shape[:-1])
+    if data.ndim > 1:
+        samp = jnp.moveaxis(samp, 0, -1)
+        out_shape = data.shape[:-1] + (params.shape or (1,))
+        samp = samp.reshape(out_shape) if params.shape else samp[..., 0]
+    else:
+        samp = samp.reshape(params.shape) if params.shape else samp[0]
+    samp = samp.astype(np_dtype(params.dtype))
+    if params.get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            samp.astype(jnp.int32).reshape(data.shape[:-1] + (-1,)), axis=-1)
+        return samp, lp.reshape(samp.shape)
+    return samp
+
+
+@register_op("shuffle", aliases=("_shuffle",), input_names=("data",), need_rng=True)
+def _shuffle(params, data, rng=None):
+    return jax.random.permutation(rng, data, axis=0)

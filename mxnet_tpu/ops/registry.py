@@ -1,0 +1,137 @@
+"""Operator registry — TPU-native replacement for the reference's NNVM registry.
+
+Reference model (src/operator, include/mxnet/op_attr_types.h:183-275): each op is
+registered with NNVM_REGISTER_OP + attributes (FInferShape, FInferType, FCompute,
+FGradient, ...). Here each op is a pure JAX function plus a typed Params struct
+(reference: DMLC_REGISTER_PARAMETER); gradients come from `jax.vjp`, shapes/dtypes
+from `jax.eval_shape` — XLA subsumes FCompute dispatch, memory planning and layout.
+
+Op function contract::
+
+    fn(params, *inputs, is_train=False, rng=None) -> tuple(jax arrays)
+
+The returned tuple has length ``num_outputs + num_aux``: visible outputs first,
+then updated auxiliary states (e.g. BatchNorm moving_mean/moving_var). ``inputs``
+likewise carries aux states at the end (reference input convention:
+data, weight, ..., aux...). ``rng`` is a jax PRNG key for stochastic ops.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..base import MXNetError, Params
+
+__all__ = ["OpDef", "register_op", "get_op", "find_op", "list_ops", "OPS"]
+
+OPS = {}
+_ALIASES = {}
+
+
+class _EmptyParams(Params):
+    pass
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "param_cls", "input_names", "aux_names", "num_outputs",
+                 "need_rng", "need_train", "key_var_num_args", "visible",
+                 "output_names", "doc")
+
+    def __init__(self, name, fn, param_cls=None, input_names=("data",), aux_names=(),
+                 num_outputs=1, need_rng=False, need_train=False,
+                 key_var_num_args=None, visible=True, output_names=None, doc=""):
+        self.name = name
+        self.fn = fn
+        self.param_cls = param_cls or _EmptyParams
+        self.input_names = input_names          # tuple | callable(params)->tuple
+        self.aux_names = aux_names              # tuple | callable(params)->tuple
+        self.num_outputs = num_outputs          # int | callable(params)->int
+        self.need_rng = need_rng
+        self.need_train = need_train
+        self.key_var_num_args = key_var_num_args  # attr naming the variadic input count
+        self.visible = visible
+        self.output_names = output_names
+        self.doc = doc or (fn.__doc__ or "")
+
+    # -- param/arity resolution -------------------------------------------
+    def make_params(self, kwargs):
+        return self.param_cls(**kwargs)
+
+    def list_inputs(self, params=None):
+        names = self.input_names
+        if callable(names):
+            names = names(params)
+        return list(names)
+
+    def list_aux(self, params=None):
+        names = self.aux_names
+        if callable(names):
+            names = names(params)
+        return list(names)
+
+    def list_outputs(self, params=None):
+        n = self.n_outputs(params)
+        if self.output_names and len(self.output_names) == n:
+            return list(self.output_names)
+        if n == 1:
+            return ["output"]
+        return ["output%d" % i for i in range(n)]
+
+    def n_outputs(self, params=None):
+        n = self.num_outputs
+        return n(params) if callable(n) else n
+
+    # -- execution ---------------------------------------------------------
+    def apply(self, params, inputs, is_train=False, rng=None):
+        """Run the op on jax arrays; always returns a tuple (outputs + aux updates)."""
+        kw = {}
+        if self.need_train:
+            kw["is_train"] = is_train
+        if self.need_rng:
+            kw["rng"] = rng
+        out = self.fn(params, *inputs, **kw)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return out
+
+    def infer(self, params, input_avals, is_train=False):
+        """Shape/dtype inference via jax.eval_shape (reference: FInferShape/FInferType)."""
+        rng = jax.eval_shape(lambda: jax.random.PRNGKey(0))() if False else None
+        def run(*ins):
+            key = jax.random.PRNGKey(0) if self.need_rng else None
+            return self.apply(params, ins, is_train=is_train, rng=key)
+        return jax.eval_shape(run, *input_avals)
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register_op(name, aliases=(), **kw):
+    """Decorator registering a jax function as an operator."""
+    def deco(fn):
+        if name in OPS:
+            raise MXNetError("op %s already registered" % name)
+        op = OpDef(name, fn, **kw)
+        OPS[name] = op
+        for al in aliases:
+            _ALIASES[al] = name
+        return fn
+    return deco
+
+
+def get_op(name):
+    op = find_op(name)
+    if op is None:
+        raise MXNetError("operator %r is not registered" % name)
+    return op
+
+
+def find_op(name):
+    if name in OPS:
+        return OPS[name]
+    if name in _ALIASES:
+        return OPS[_ALIASES[name]]
+    return None
+
+
+def list_ops():
+    return sorted(OPS)

@@ -1,0 +1,104 @@
+"""Learnable-parameter shape inference hooks.
+
+Reference: each op's FInferShape fills in weight shapes from data shapes
+(e.g. src/operator/nn/fully_connected.cc FullyConnectedShape). Forward output
+shapes come free from jax.eval_shape; these hooks supply only the *input*
+(weight/aux) shapes that cannot be derived by running the op.
+
+Hook signature: hook(params, shapes: dict name->shape|None) -> dict of filled
+names; `shapes` contains every input+aux name with known shapes filled in
+(data shapes are always known by the time the hook runs).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .nn import rnn_param_size
+
+PARAM_SHAPE_HOOKS = {}
+
+
+def hook(name):
+    def deco(fn):
+        PARAM_SHAPE_HOOKS[name] = fn
+        return fn
+    return deco
+
+
+@hook("FullyConnected")
+def _fc(params, shapes):
+    data = shapes["data"]
+    in_dim = int(_np.prod(data[1:])) if params.flatten else data[-1]
+    out = {"weight": (params.num_hidden, in_dim)}
+    if not params.no_bias:
+        out["bias"] = (params.num_hidden,)
+    return out
+
+
+@hook("Convolution")
+def _conv(params, shapes):
+    data = shapes["data"]
+    c = data[1]
+    out = {"weight": (params.num_filter, c // params.num_group) + tuple(params.kernel)}
+    if not params.no_bias:
+        out["bias"] = (params.num_filter,)
+    return out
+
+
+@hook("Deconvolution")
+def _deconv(params, shapes):
+    data = shapes["data"]
+    c = data[1]
+    out = {"weight": (c, params.num_filter // params.num_group) + tuple(params.kernel)}
+    if not params.no_bias:
+        out["bias"] = (params.num_filter,)
+    return out
+
+
+@hook("BatchNorm")
+def _bn(params, shapes):
+    c = shapes["data"][params.axis % len(shapes["data"])]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,), "moving_var": (c,)}
+
+
+@hook("LayerNorm")
+def _ln(params, shapes):
+    c = shapes["data"][params.axis % len(shapes["data"])]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+@hook("InstanceNorm")
+def _in(params, shapes):
+    c = shapes["data"][1]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+@hook("Embedding")
+def _emb(params, shapes):
+    return {"weight": (params.input_dim, params.output_dim)}
+
+
+@hook("LeakyReLU")
+def _prelu(params, shapes):
+    if params.act_type == "prelu":
+        return {"gamma": (shapes["data"][1],)}
+    return {}
+
+
+@hook("LSoftmax")
+def _lsoftmax(params, shapes):
+    data = shapes["data"]
+    return {"weight": (params.num_hidden, int(_np.prod(data[1:])))}
+
+
+@hook("RNN")
+def _rnn(params, shapes):
+    data = shapes["data"]  # (T, N, I)
+    d = 2 if params.bidirectional else 1
+    n = rnn_param_size(params.mode, data[2], params.state_size,
+                       params.num_layers, params.bidirectional)
+    out = {"parameters": (n,),
+           "state": (params.num_layers * d, data[1], params.state_size)}
+    if params.mode == "lstm":
+        out["state_cell"] = out["state"]
+    return out

@@ -1,0 +1,584 @@
+"""Tensor manipulation, reduction, indexing, ordering, init and linalg ops.
+
+Reference: src/operator/tensor/{matrix_op,broadcast_reduce_op,indexing_op,
+ordering_op,init_op,dot,la_op,control_flow_op}*.cc
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import Params, param_field, np_dtype
+from .registry import register_op
+
+# ---------------------------------------------------------------------------
+# shape manipulation (matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+
+class ReshapeParam(Params):
+    shape = param_field(tuple, default=())
+    reverse = param_field(bool, default=False)
+
+
+@register_op("Reshape", aliases=("reshape",), param_cls=ReshapeParam)
+def _reshape(params, x):
+    """Supports mxnet special codes 0 (keep) and -1 (infer); -2/-3/-4 unsupported→error."""
+    shape = list(params.shape)
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+        elif s in (-2, -3, -4):
+            raise NotImplementedError("reshape special code %d" % s)
+    return jnp.reshape(x, tuple(shape))
+
+
+class TransposeParam(Params):
+    axes = param_field(tuple, default=())
+
+
+@register_op("transpose", param_cls=TransposeParam)
+def _transpose(params, x):
+    return jnp.transpose(x, params.axes or None)
+
+
+class SwapAxisParam(Params):
+    dim1 = param_field(int, default=0)
+    dim2 = param_field(int, default=0)
+
+
+@register_op("SwapAxis", aliases=("swapaxes",), param_cls=SwapAxisParam)
+def _swapaxes(params, x):
+    return jnp.swapaxes(x, params.dim1, params.dim2)
+
+
+@register_op("Flatten", aliases=("flatten",))
+def _flatten(params, x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+class ExpandDimsParam(Params):
+    axis = param_field(int, default=0)
+
+
+@register_op("expand_dims", param_cls=ExpandDimsParam)
+def _expand_dims(params, x):
+    return jnp.expand_dims(x, params.axis)
+
+
+class SqueezeParam(Params):
+    axis = param_field(tuple, default=None)
+
+
+@register_op("squeeze", param_cls=SqueezeParam)
+def _squeeze(params, x):
+    return jnp.squeeze(x, params.axis)
+
+
+class SliceParam(Params):
+    begin = param_field(tuple, default=())
+    end = param_field(tuple, default=())
+    step = param_field(tuple, default=())
+
+
+@register_op("slice", aliases=("crop",), param_cls=SliceParam)
+def _slice(params, x):
+    idx = []
+    step = params.step or (None,) * len(params.begin)
+    for b, e, s in zip(params.begin, params.end, step):
+        idx.append(slice(b if b is not None else None,
+                         e if e is not None else None,
+                         s if s not in (0, None) else None))
+    return x[tuple(idx)]
+
+
+class SliceAxisParam(Params):
+    axis = param_field(int, default=0)
+    begin = param_field(int, default=0)
+    end = param_field(int, default=None)
+
+
+@register_op("slice_axis", param_cls=SliceAxisParam)
+def _slice_axis(params, x):
+    idx = [slice(None)] * x.ndim
+    end = params.end
+    idx[params.axis] = slice(params.begin, end)
+    return x[tuple(idx)]
+
+
+class SliceLikeParam(Params):
+    axes = param_field(tuple, default=())
+
+
+@register_op("slice_like", param_cls=SliceLikeParam, input_names=("data", "shape_like"))
+def _slice_like(params, x, like):
+    axes = params.axes or tuple(range(x.ndim))
+    idx = [slice(None)] * x.ndim
+    for ax in axes:
+        idx[ax] = slice(0, like.shape[ax])
+    return x[tuple(idx)]
+
+
+class ConcatParam(Params):
+    num_args = param_field(int, default=2)
+    dim = param_field(int, default=1)
+
+
+@register_op("Concat", aliases=("concat",), param_cls=ConcatParam,
+             key_var_num_args="num_args",
+             input_names=lambda p: tuple("arg%d" % i for i in range(p.num_args if p else 2)))
+def _concat(params, *args):
+    return jnp.concatenate(args, axis=params.dim)
+
+
+class StackParam(Params):
+    num_args = param_field(int, default=2)
+    axis = param_field(int, default=0)
+
+
+@register_op("stack", param_cls=StackParam, key_var_num_args="num_args",
+             input_names=lambda p: tuple("arg%d" % i for i in range(p.num_args if p else 2)))
+def _stack(params, *args):
+    return jnp.stack(args, axis=params.axis)
+
+
+class SplitParam(Params):
+    num_outputs = param_field(int, default=1)
+    axis = param_field(int, default=1)
+    squeeze_axis = param_field(bool, default=False)
+
+
+@register_op("SliceChannel", aliases=("split",), param_cls=SplitParam,
+             num_outputs=lambda p: p.num_outputs if p else 1)
+def _split(params, x):
+    parts = jnp.split(x, params.num_outputs, axis=params.axis)
+    if params.squeeze_axis:
+        parts = [jnp.squeeze(p, axis=params.axis) for p in parts]
+    return tuple(parts)
+
+
+class TileParam(Params):
+    reps = param_field(tuple, default=())
+
+
+@register_op("tile", param_cls=TileParam)
+def _tile(params, x):
+    return jnp.tile(x, params.reps)
+
+
+class RepeatParam(Params):
+    repeats = param_field(int, default=1)
+    axis = param_field(int, default=None)
+
+
+@register_op("repeat", param_cls=RepeatParam)
+def _repeat(params, x):
+    return jnp.repeat(x, params.repeats, axis=params.axis)
+
+
+class ReverseParam(Params):
+    axis = param_field(tuple, default=())
+
+
+@register_op("reverse", aliases=("flip",), param_cls=ReverseParam)
+def _reverse(params, x):
+    return jnp.flip(x, params.axis)
+
+
+class PadParam(Params):
+    mode = param_field(str, default="constant", enum=("constant", "edge", "reflect"))
+    pad_width = param_field(tuple, default=())
+    constant_value = param_field(float, default=0.0)
+
+
+@register_op("Pad", aliases=("pad",), param_cls=PadParam)
+def _pad(params, x):
+    pw = params.pad_width
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[params.mode]
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=params.constant_value)
+    return jnp.pad(x, pairs, mode=mode)
+
+
+class BroadcastToParam(Params):
+    shape = param_field(tuple, default=())
+
+
+@register_op("broadcast_to", param_cls=BroadcastToParam)
+def _broadcast_to(params, x):
+    tgt = tuple(t if t != 0 else s for t, s in zip(params.shape, x.shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register_op("broadcast_like", input_names=("lhs", "rhs"))
+def _broadcast_like(params, x, like):
+    return jnp.broadcast_to(x, like.shape)
+
+
+@register_op("shape_array")
+def _shape_array(params, x):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+@register_op("size_array")
+def _size_array(params, x):
+    return jnp.asarray([int(_np.prod(x.shape))], dtype=jnp.int64)
+
+
+@register_op("zeros_like")
+def _zeros_like(params, x):
+    return jnp.zeros_like(x)
+
+
+@register_op("ones_like")
+def _ones_like(params, x):
+    return jnp.ones_like(x)
+
+
+# ---------------------------------------------------------------------------
+# reductions (broadcast_reduce_op)
+# ---------------------------------------------------------------------------
+
+
+class ReduceParam(Params):
+    axis = param_field(tuple, default=None)
+    keepdims = param_field(bool, default=False)
+    exclude = param_field(bool, default=False)
+
+
+def _norm_axis(params, x):
+    axis = params.axis
+    if axis == ():
+        axis = None
+    if axis is not None and params.exclude:
+        axis = tuple(i for i in range(x.ndim) if i not in
+                     tuple(a % x.ndim for a in axis))
+    return axis
+
+
+_REDUCE = {
+    "sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+    "max": jnp.max, "min": jnp.min,
+    "nansum": jnp.nansum, "nanprod": jnp.nanprod,
+}
+
+
+def _make_reduce(fn):
+    def op(params, x):
+        return fn(x, axis=_norm_axis(params, x), keepdims=params.keepdims)
+    return op
+
+
+for _name, _fn in _REDUCE.items():
+    register_op(_name, aliases=("sum_axis",) if _name == "sum" else
+                (("max_axis",) if _name == "max" else
+                 (("min_axis",) if _name == "min" else ())),
+                param_cls=ReduceParam)(_make_reduce(_fn))
+
+
+class NormParam(Params):
+    ord = param_field(int, default=2)
+    axis = param_field(tuple, default=None)
+    keepdims = param_field(bool, default=False)
+
+
+@register_op("norm", param_cls=NormParam)
+def _norm(params, x):
+    axis = params.axis if params.axis != () else None
+    if params.ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=params.keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axis,
+                            keepdims=params.keepdims)).astype(x.dtype)
+
+
+class AxisParam(Params):
+    axis = param_field(int, default=None)
+    keepdims = param_field(bool, default=False)
+
+
+@register_op("argmax", param_cls=AxisParam)
+def _argmax(params, x):
+    return jnp.argmax(x, axis=params.axis, keepdims=params.keepdims).astype(jnp.float32)
+
+
+@register_op("argmin", param_cls=AxisParam)
+def _argmin(params, x):
+    return jnp.argmin(x, axis=params.axis, keepdims=params.keepdims).astype(jnp.float32)
+
+
+@register_op("argmax_channel")
+def _argmax_channel(params, x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# dot / linalg (dot-inl.h, la_op.cc)
+# ---------------------------------------------------------------------------
+
+
+class DotParam(Params):
+    transpose_a = param_field(bool, default=False)
+    transpose_b = param_field(bool, default=False)
+    forward_stype = param_field(str, default=None)
+
+
+@register_op("dot", param_cls=DotParam, input_names=("lhs", "rhs"))
+def _dot(params, a, b):
+    if params.transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if params.transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # mxnet dot contracts last axis of a with first axis of b (tensordot)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register_op("batch_dot", param_cls=DotParam, input_names=("lhs", "rhs"))
+def _batch_dot(params, a, b):
+    if params.transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if params.transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register_op("linalg_gemm2", input_names=("A", "B"), param_cls=DotParam)
+def _linalg_gemm2(params, a, b):
+    if params.transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if params.transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register_op("linalg_potrf", input_names=("A",))
+def _potrf(params, a):
+    return jnp.linalg.cholesky(a)
+
+
+@register_op("linalg_syrk", input_names=("A",), param_cls=DotParam)
+def _syrk(params, a):
+    at = jnp.swapaxes(a, -1, -2)
+    return jnp.matmul(a, at) if not params.transpose_a else jnp.matmul(at, a)
+
+
+# ---------------------------------------------------------------------------
+# indexing (indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+
+class TakeParam(Params):
+    axis = param_field(int, default=0)
+    mode = param_field(str, default="clip", enum=("clip", "wrap", "raise"))
+
+
+@register_op("take", param_cls=TakeParam, input_names=("a", "indices"))
+def _take(params, a, indices):
+    mode = "clip" if params.mode == "raise" else params.mode
+    return jnp.take(a, indices.astype(jnp.int32), axis=params.axis, mode=mode)
+
+
+@register_op("pick", param_cls=AxisParam, input_names=("data", "index"))
+def _pick(params, x, index):
+    axis = params.axis if params.axis is not None else -1
+    idx = index.astype(jnp.int32)
+    picked = jnp.take_along_axis(x, jnp.expand_dims(idx, axis), axis=axis)
+    return picked if params.keepdims else jnp.squeeze(picked, axis=axis)
+
+
+class OneHotParam(Params):
+    depth = param_field(int, required=True)
+    on_value = param_field(float, default=1.0)
+    off_value = param_field(float, default=0.0)
+    dtype = param_field(str, default="float32")
+
+
+@register_op("one_hot", param_cls=OneHotParam, input_names=("indices",))
+def _one_hot(params, indices):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), params.depth)
+    out = oh * (params.on_value - params.off_value) + params.off_value
+    return out.astype(np_dtype(params.dtype))
+
+
+@register_op("where", input_names=("condition", "x", "y"))
+def _where(params, cond, x, y):
+    return jnp.where(cond != 0, x, y)
+
+
+@register_op("gather_nd", input_names=("data", "indices"))
+def _gather_nd(params, data, indices):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+class ScatterNDParam(Params):
+    shape = param_field(tuple, default=())
+
+
+@register_op("scatter_nd", param_cls=ScatterNDParam, input_names=("data", "indices"))
+def _scatter_nd(params, data, indices):
+    out = jnp.zeros(params.shape, dtype=data.dtype)
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return out.at[idx].add(data)
+
+
+# ---------------------------------------------------------------------------
+# ordering (ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+
+class TopkParam(Params):
+    axis = param_field(int, default=-1)
+    k = param_field(int, default=1)
+    ret_typ = param_field(str, default="indices",
+                          enum=("value", "indices", "mask", "both"))
+    is_ascend = param_field(bool, default=False)
+    dtype = param_field(str, default="float32")
+
+
+@register_op("topk", param_cls=TopkParam,
+             num_outputs=lambda p: 2 if (p and p.ret_typ == "both") else 1)
+def _topk(params, x):
+    axis = params.axis if params.axis is not None else -1
+    xm = jnp.moveaxis(x, axis, -1)
+    val = -xm if not params.is_ascend else xm
+    neg_vals, idx = jax.lax.top_k(-val, params.k)
+    vals = jnp.moveaxis(jnp.take_along_axis(xm, idx, axis=-1), -1, axis)
+    idxf = jnp.moveaxis(idx, -1, axis).astype(np_dtype(params.dtype))
+    if params.ret_typ == "value":
+        return vals
+    if params.ret_typ == "indices":
+        return idxf
+    if params.ret_typ == "both":
+        return vals, idxf
+    # mask
+    mask = jnp.zeros(xm.shape, x.dtype).at[
+        tuple(jnp.indices(idx.shape)[:-1]) + (idx,)].set(1)
+    return jnp.moveaxis(mask, -1, axis)
+
+
+class SortParam(Params):
+    axis = param_field(int, default=-1)
+    is_ascend = param_field(bool, default=True)
+
+
+@register_op("sort", param_cls=SortParam)
+def _sort(params, x):
+    out = jnp.sort(x, axis=params.axis)
+    return out if params.is_ascend else jnp.flip(out, axis=params.axis)
+
+
+class ArgsortParam(SortParam):
+    dtype = param_field(str, default="float32")
+
+
+@register_op("argsort", param_cls=ArgsortParam)
+def _argsort(params, x):
+    out = jnp.argsort(x, axis=params.axis)
+    if not params.is_ascend:
+        out = jnp.flip(out, axis=params.axis)
+    return out.astype(np_dtype(params.dtype))
+
+
+# ---------------------------------------------------------------------------
+# init ops (init_op.cc) — these take no tensor inputs
+# ---------------------------------------------------------------------------
+
+
+class InitParam(Params):
+    shape = param_field(tuple, default=())
+    dtype = param_field(str, default="float32")
+    ctx = param_field(str, default=None)
+
+
+@register_op("_zeros", param_cls=InitParam, input_names=())
+def _zeros_op(params):
+    return jnp.zeros(params.shape, dtype=np_dtype(params.dtype))
+
+
+@register_op("_ones", param_cls=InitParam, input_names=())
+def _ones_op(params):
+    return jnp.ones(params.shape, dtype=np_dtype(params.dtype))
+
+
+class FullParam(InitParam):
+    value = param_field(float, default=0.0)
+
+
+@register_op("_full", param_cls=FullParam, input_names=())
+def _full_op(params):
+    return jnp.full(params.shape, params.value, dtype=np_dtype(params.dtype))
+
+
+class ArangeParam(Params):
+    start = param_field(float, default=0.0)
+    stop = param_field(float, default=None)
+    step = param_field(float, default=1.0)
+    repeat = param_field(int, default=1)
+    dtype = param_field(str, default="float32")
+    ctx = param_field(str, default=None)
+
+
+@register_op("_arange", param_cls=ArangeParam, input_names=())
+def _arange_op(params):
+    out = jnp.arange(params.start, params.stop, params.step, dtype=np_dtype(params.dtype))
+    if params.repeat > 1:
+        out = jnp.repeat(out, params.repeat)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (sequence_{mask,last,reverse}.cc)
+# ---------------------------------------------------------------------------
+
+
+class SequenceParam(Params):
+    use_sequence_length = param_field(bool, default=False)
+    value = param_field(float, default=0.0)
+    axis = param_field(int, default=0)
+
+
+def _seq_inputs(p):
+    if p is not None and p.use_sequence_length:
+        return ("data", "sequence_length")
+    return ("data",)
+
+
+@register_op("SequenceMask", param_cls=SequenceParam, input_names=_seq_inputs)
+def _sequence_mask(params, data, sequence_length=None):
+    if not params.use_sequence_length or sequence_length is None:
+        return data
+    # data: (T, N, ...) along axis
+    T = data.shape[params.axis]
+    steps = jnp.arange(T)
+    mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)  # (T, N)
+    mask = jnp.moveaxis(mask, 0, params.axis) if params.axis != 0 else mask
+    while mask.ndim < data.ndim:
+        mask = jnp.expand_dims(mask, -1)
+    return jnp.where(mask, data, jnp.asarray(params.value, data.dtype))
+
+
+@register_op("SequenceLast", param_cls=SequenceParam, input_names=_seq_inputs)
+def _sequence_last(params, data, sequence_length=None):
+    if not params.use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[params.axis] - 1, axis=params.axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, params.axis, 0)  # (T, N, ...)
+    return jnp.take_along_axis(
+        moved, idx.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+
+
+@register_op("SequenceReverse", param_cls=SequenceParam, input_names=_seq_inputs)
+def _sequence_reverse(params, data, sequence_length=None):
+    if not params.use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    rev_idx = jnp.where(steps < L, L - 1 - steps, steps)  # (T, N)
+    while rev_idx.ndim < data.ndim:
+        rev_idx = jnp.expand_dims(rev_idx, -1)
+    return jnp.take_along_axis(data, jnp.broadcast_to(rev_idx, data.shape), axis=0)

@@ -1,0 +1,604 @@
+"""Optimizers (reference: python/mxnet/optimizer.py, 1519 LoC).
+
+Optimizer math runs as device-side update ops (reference design point:
+src/operator/optimizer_op.cc — sgd_update etc.); here each update calls the
+registered jax update op which returns new (weight, state) buffers that are
+swapped in place. Inside a jitted train step (Module/tpu_sync kvstore) the same
+ops trace into the compiled program with buffer donation.
+"""
+from __future__ import annotations
+
+import math
+import numpy as _np
+
+from .base import Registry, MXNetError
+from .ndarray.ndarray import NDArray, zeros
+from .ndarray import sparse as _sparse
+from . import ndarray as nd
+
+__all__ = ["Optimizer", "SGD", "Signum", "NAG", "SGLD", "Adam", "AdaGrad", "RMSProp",
+           "AdaDelta", "Ftrl", "Adamax", "Nadam", "FTML", "DCASGD", "LBSGD",
+           "Updater", "get_updater", "create", "register", "opt_registry"]
+
+opt_registry = Registry("optimizer")
+
+
+def register(cls):
+    opt_registry.register(cls)
+    return cls
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return opt_registry.get(name)(**kwargs)
+
+
+class Optimizer:
+    """reference: optimizer.py:34 Optimizer base."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
+        self.param_dict = param_dict if param_dict else {}
+
+    create_optimizer = staticmethod(create)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master_copy = weight.astype(_np.float32)
+            return (weight_master_copy,) + (self.create_state(index, weight_master_copy),)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master, original_state = state[0], state[1]
+            grad32 = grad.astype(_np.float32)
+            self.update(index, weight_master, grad32, original_state)
+            weight._data = weight_master._data.astype(weight.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- lr/wd plumbing ----------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_kwargs(self, index):
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+@register
+class SGD(Optimizer):
+    """reference: optimizer.py:433 — momentum + multi-precision."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if isinstance(grad, _sparse.RowSparseNDArray):
+            self._sparse_update(weight, grad, state, kw)
+            return
+        if state is not None:
+            new_w, new_m = nd.sgd_mom_update(weight, grad, state,
+                                             momentum=self.momentum, **kw)
+            weight._data, state._data = new_w._data, new_m._data
+        else:
+            weight._data = nd.sgd_update(weight, grad, **kw)._data
+
+    def _sparse_update(self, weight, grad, state, kw):
+        """Lazy update: only rows present in grad (reference: sgd lazy_update)."""
+        import jax.numpy as jnp
+        rows = grad._indices
+        g = grad._data * kw["rescale_grad"]
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w_rows = weight._data[rows]
+        g = g + kw["wd"] * w_rows
+        if state is not None:
+            m_rows = state._data[rows] * self.momentum - kw["lr"] * g
+            state._data = state._data.at[rows].set(m_rows)
+            weight._data = weight._data.at[rows].add(m_rows)
+        else:
+            weight._data = weight._data.at[rows].add(-kw["lr"] * g)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            new_w, new_m = nd.signum_update(weight, grad, state, momentum=self.momentum,
+                                            wd_lh=self.wd_lh, **kw)
+            weight._data, state._data = new_w._data, new_m._data
+        else:
+            weight._data = nd.signsgd_update(weight, grad, **kw)._data
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference: optimizer.py:894)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        import jax.numpy as jnp
+        g = grad._data * kw["rescale_grad"]
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + kw["wd"] * weight._data
+        if state is not None:
+            # reference recurrence: mom = momentum*mom + g; w -= lr*(g + momentum*mom)
+            state._data = self.momentum * state._data + g
+            weight._data = weight._data - kw["lr"] * (g + self.momentum * state._data)
+        else:
+            weight._data = weight._data - kw["lr"] * g
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference: optimizer.py:946)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        import jax.numpy as jnp
+        from . import random as _rnd
+        import jax
+        g = grad._data * kw["rescale_grad"]
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + kw["wd"] * weight._data
+        noise = jax.random.normal(_rnd.next_key(), weight.shape) * math.sqrt(kw["lr"])
+        weight._data = weight._data - kw["lr"] / 2 * g + noise.astype(weight.dtype)
+
+
+@register
+class Adam(Optimizer):
+    """reference: optimizer.py:982 (with bias correction + sparse lazy update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = kw.pop("lr") * math.sqrt(coef2) / coef1
+        mean, var = state
+        if isinstance(grad, _sparse.RowSparseNDArray):
+            import jax.numpy as jnp
+            rows = grad._indices
+            g = grad._data * kw["rescale_grad"]
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            g = g + kw["wd"] * weight._data[rows]
+            m_rows = self.beta1 * mean._data[rows] + (1 - self.beta1) * g
+            v_rows = self.beta2 * var._data[rows] + (1 - self.beta2) * jnp.square(g)
+            mean._data = mean._data.at[rows].set(m_rows)
+            var._data = var._data.at[rows].set(v_rows)
+            weight._data = weight._data.at[rows].add(
+                -lr * m_rows / (jnp.sqrt(v_rows) + self.epsilon))
+            return
+        new_w, new_m, new_v = nd.adam_update(
+            weight, grad, mean, var, lr=lr, beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon, **kw)
+        weight._data, mean._data, var._data = new_w._data, new_m._data, new_v._data
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        import jax.numpy as jnp
+        g = grad._data * kw["rescale_grad"]
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + kw["wd"] * weight._data
+        state._data = state._data + jnp.square(g)
+        weight._data = weight._data - kw["lr"] * g / (
+            jnp.sqrt(state._data) + self.float_stable_eps)
+
+
+@register
+class RMSProp(Optimizer):
+    """reference: optimizer.py:1116 (centered variant = Graves 2013)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
+                 centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, ctx=weight.context),
+                    zeros(weight.shape, ctx=weight.context),
+                    zeros(weight.shape, ctx=weight.context))
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw["epsilon"] = self.epsilon
+        kw["gamma1"] = self.gamma1
+        if self.centered:
+            n, gmean, delta = state
+            new_w, new_n, new_g, new_d = nd.rmspropalex_update(
+                weight, grad, n, gmean, delta, gamma2=self.gamma2, **kw)
+            weight._data, n._data = new_w._data, new_n._data
+            gmean._data, delta._data = new_g._data, new_d._data
+        else:
+            if self.clip_weights:
+                kw["clip_weights"] = self.clip_weights
+            new_w, new_n = nd.rmsprop_update(weight, grad, state, **kw)
+            weight._data, state._data = new_w._data, new_n._data
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        import jax.numpy as jnp
+        g = grad._data * kw["rescale_grad"]
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + kw["wd"] * weight._data
+        acc_g, acc_delta = state
+        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
+        delta = (jnp.sqrt(acc_delta._data + self.epsilon)
+                 / jnp.sqrt(acc_g._data + self.epsilon)) * g
+        acc_delta._data = self.rho * acc_delta._data + (1 - self.rho) * jnp.square(delta)
+        weight._data = weight._data - delta
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        z, n = state
+        new_w, new_z, new_n = nd.ftrl_update(weight, grad, z, n, lamda1=self.lamda1,
+                                             beta=self.beta, **kw)
+        weight._data, z._data, n._data = new_w._data, new_z._data, new_n._data
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        lr = kw["lr"] / (1.0 - self.beta1 ** t)
+        import jax.numpy as jnp
+        g = grad._data * kw["rescale_grad"]
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + kw["wd"] * weight._data
+        m, u = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        weight._data = weight._data - lr * m._data / (u._data + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        import jax.numpy as jnp
+        g = grad._data * kw["rescale_grad"]
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + kw["wd"] * weight._data
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m._data = self.beta1 * m._data + (1.0 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1.0 - self.beta2) * jnp.square(g)
+        grad_prime = g / (1.0 - self.m_schedule)
+        m_t_prime = m._data / (1.0 - m_schedule_next)
+        v_t_prime = v._data / (1.0 - self.beta2 ** t)
+        m_t_bar = ((1.0 - momentum_t) * grad_prime + momentum_t_1 * m_t_prime)
+        weight._data = weight._data - kw["lr"] * m_t_bar / (
+            jnp.sqrt(v_t_prime) + self.epsilon)
+
+
+@register
+class FTML(Optimizer):
+    """reference: optimizer.py:600."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999, epsilon=1e-8,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        import jax.numpy as jnp
+        g = grad._data * kw["rescale_grad"]
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + kw["wd"] * weight._data
+        d, sigma, z = state
+        v_t = self.beta2 * sigma._data + (1 - self.beta2) * jnp.square(g)
+        d_t = (1 - self.beta1 ** t) / kw["lr"] * (
+            jnp.sqrt(v_t / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma_t = d_t - self.beta1 * d._data
+        z._data = self.beta1 * z._data + (1 - self.beta1) * g - sigma_t * weight._data
+        d._data = d_t
+        sigma._data = v_t
+        weight._data = -z._data / d_t
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py:838)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, ctx=weight.context), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        import jax.numpy as jnp
+        g = grad._data * kw["rescale_grad"]
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        comp = g + self.lamda * g * g * (weight._data - previous_weight._data)
+        if mom is not None:
+            mom._data = self.momentum * mom._data - kw["lr"] * (
+                comp + kw["wd"] * weight._data)
+            inc = mom._data
+        else:
+            inc = -kw["lr"] * (comp + kw["wd"] * weight._data)
+        previous_weight._data = weight._data
+        weight._data = weight._data + inc
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise adaptation (reference: optimizer.py:648)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, multi_precision=multi_precision, **kwargs)
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.num_epochs = num_epochs
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        # LARS trust ratio
+        wnorm = float(jnp.sqrt(jnp.sum(jnp.square(weight._data))))
+        gnorm = float(jnp.sqrt(jnp.sum(jnp.square(grad._data)))) * self.rescale_grad
+        if wnorm > 0 and gnorm > 0:
+            lars = wnorm / (gnorm + self.wd * wnorm + 1e-9)
+            lars = min(lars, 10.0)
+        else:
+            lars = 1.0
+        saved_lr = self.lr
+        self.lr = self.lr * lars
+        try:
+            super().update(index, weight, grad, state)
+        finally:
+            self.lr = saved_lr
+
+
+# ---------------------------------------------------------------------------
+# Updater — applies optimizer on (possibly remote) kvstore side
+# ---------------------------------------------------------------------------
+
+class Updater:
+    """reference: optimizer.py Updater — per-key state container."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        import pickle
+        self.states = pickle.loads(states)
+        self.states_synced = {k: False for k in self.states}
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,10 @@
+"""Parallelism utilities: device meshes, collectives, sharded train steps.
+
+TPU-native replacement for the reference's comm stack (src/kvstore/comm.h NCCL /
+ps-lite): XLA collectives over ICI/DCN driven by jax.sharding.Mesh + shard_map.
+"""
+from .mesh import get_mesh, data_parallel_mesh, ShardingConfig
+from .collectives import allreduce_hosts, host_barrier
+
+__all__ = ["get_mesh", "data_parallel_mesh", "ShardingConfig",
+           "allreduce_hosts", "host_barrier"]

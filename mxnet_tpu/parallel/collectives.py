@@ -1,0 +1,25 @@
+"""Cross-host collectives (reference role: ps-lite ZeroMQ push/pull + NCCL).
+
+On TPU pods these ride ICI/DCN through XLA; the single-host case is a no-op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+
+def allreduce_hosts(value):
+    """Sum `value` across all JAX processes. Single-process: identity."""
+    if jax.process_count() == 1:
+        return value
+    # multihost: every process contributes its array; use a global device mesh
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(value).sum(axis=0)
+
+
+def host_barrier():
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")

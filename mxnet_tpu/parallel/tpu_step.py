@@ -1,0 +1,147 @@
+"""Sharded, fused train step — the heart of the `tpu_sync` design.
+
+Reference path (SURVEY.md §3.1-3.2): forward → backward → kvstore.push(grad) →
+server optimizer → kvstore.pull(weight), each a separate engine/network op.
+TPU-native: ONE jitted program: forward + backward + gradient allreduce +
+optimizer update. Sharding annotations (batch over 'dp', params replicated or
+sharded per rules) let XLA insert the ICI collectives — no hand-written comm.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+from ..base import MXNetError
+
+__all__ = ["DataParallelTrainStep"]
+
+
+class DataParallelTrainStep:
+    """Compile a Symbol's forward+backward+SGD-update into one sharded XLA program.
+
+    Parameters live as a dict of jax arrays (replicated over the mesh); each
+    call consumes a global batch sharded along 'dp' and returns outputs plus
+    updated params — buffer donation makes the update in-place on device.
+    """
+
+    def __init__(self, symbol, mesh, lr=0.01, momentum=0.0, wd=0.0,
+                 data_names=("data",), label_names=("softmax_label",),
+                 sharding_config=None, rescale_grad=None):
+        self.symbol = symbol
+        self.mesh = mesh
+        self.lr = lr
+        self.momentum = momentum
+        self.wd = wd
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.sharding_config = sharding_config
+
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.param_names = [n for n in self.arg_names
+                            if n not in self.data_names + self.label_names]
+        self._rescale = rescale_grad
+
+        # pure graph runner borrowed from Executor (single source of truth)
+        from ..executor import Executor
+        self._graph_runner = None
+
+        self._repl = NamedSharding(mesh, PartitionSpec())
+        self._batch_shard = NamedSharding(
+            mesh, PartitionSpec("dp" if "dp" in mesh.axis_names else mesh.axis_names[0]))
+        self._step = None
+
+    # ------------------------------------------------------------------
+    def init(self, batch_shapes, dtype=_np.float32, seed=0):
+        """Infer shapes, initialize replicated params + momentum, build the step."""
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**batch_shapes)
+        shapes = dict(zip(self.arg_names, arg_shapes))
+        key = jax.random.PRNGKey(seed)
+        params = {}
+        for name in self.param_names:
+            key, sub = jax.random.split(key)
+            shape = shapes[name]
+            if name.endswith("_bias") or name.endswith("_beta") or \
+                    name.endswith("_gamma"):
+                init = (jnp.ones(shape, dtype) if name.endswith("_gamma")
+                        else jnp.zeros(shape, dtype))
+            else:
+                fan_in = _np.prod(shape[1:]) if len(shape) > 1 else shape[0]
+                scale = _np.sqrt(2.0 / max(fan_in, 1))
+                init = jax.random.normal(sub, shape, dtype) * scale
+            params[name] = jax.device_put(init, self._repl)
+        aux = {name: jax.device_put(
+                   jnp.ones(s, dtype) if "var" in name else jnp.zeros(s, dtype),
+                   self._repl)
+               for name, s in zip(self.aux_names, aux_shapes)}
+        moms = {name: jax.device_put(jnp.zeros_like(v), self._repl)
+                for name, v in params.items()} if self.momentum else {}
+        self.params, self.aux, self.moms = params, aux, moms
+        self._build_step(batch_shapes)
+        return self
+
+    def _build_step(self, batch_shapes):
+        from ..executor import Executor
+        from ..ndarray.ndarray import zeros as nd_zeros
+        from ..context import cpu
+        # an executor instance only for its traced pure _run_graph
+        dummy_args = {n: nd_zeros((1,)) for n in self.arg_names}
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**batch_shapes)
+        shapes = dict(zip(self.arg_names, arg_shapes))
+        dummy_args = {n: nd_zeros(shapes[n]) for n in self.arg_names}
+        dummy_aux = {n: nd_zeros(s) for n, s in
+                     zip(self.aux_names, aux_shapes)}
+        runner = Executor(self.symbol, cpu(), dummy_args, {}, "null", dummy_aux)
+
+        lr, momentum, wd = self.lr, self.momentum, self.wd
+        batch_size = list(batch_shapes.values())[0][0]
+        rescale = self._rescale if self._rescale is not None else 1.0 / batch_size
+
+        def step(params, moms, aux, batch, rng):
+            def loss_fn(p):
+                outs, aux_upd = runner._run_graph({**p, **batch}, aux, rng, True)
+                return outs, aux_upd
+            outs, vjp, aux_upd = jax.vjp(loss_fn, params, has_aux=True)
+            seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            grads = vjp(seeds)[0]
+            new_params, new_moms = {}, {}
+            for name, p in params.items():
+                g = grads[name] * rescale + wd * p
+                if momentum:
+                    m = momentum * moms[name] - lr * g
+                    new_moms[name] = m
+                    new_params[name] = p + m
+                else:
+                    new_params[name] = p - lr * g
+            return new_params, new_moms, aux_upd, outs
+
+        in_shardings = (
+            {n: self._repl for n in self.param_names},
+            {n: self._repl for n in self.moms},
+            {n: self._repl for n in self.aux_names},
+            {n: self._batch_shard for n in
+             self.data_names + [l for l in self.label_names
+                                if l in self.arg_names]},
+            self._repl,
+        )
+        self._step = jax.jit(step, in_shardings=in_shardings,
+                             donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    def __call__(self, batch_np, rng=None):
+        """Run one step on a global batch (dict name->numpy)."""
+        if self._step is None:
+            raise MXNetError("call init() first")
+        batch = {}
+        for name, arr in batch_np.items():
+            batch[name] = jax.device_put(jnp.asarray(arr), self._batch_shard)
+        if rng is None:
+            rng = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31))
+        rng = jax.device_put(rng, self._repl)
+        self.params, self.moms, aux_upd, outs = self._step(
+            self.params, self.moms, self.aux, batch, rng)
+        self.aux.update(aux_upd)
+        return outs

@@ -1,0 +1,89 @@
+"""Profiler (reference: src/profiler/profiler.h:256, python/mxnet/profiler.py).
+
+TPU-native: wraps the JAX/XLA profiler (XPlane/perfetto traces) behind the
+mx.profiler API. `dump()` finalizes the trace directory; chrome://tracing-style
+output comes from the JAX trace viewer artifacts.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import threading
+
+import jax
+
+__all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume"]
+
+_state = {"running": False, "filename": "profile.json", "events": [],
+          "jax_trace_dir": None, "lock": threading.Lock()}
+
+
+def set_config(**kwargs):
+    """profile_symbolic/profile_imperative/... accepted for API parity."""
+    if "filename" in kwargs:
+        _state["filename"] = kwargs["filename"]
+    _state.update({k: v for k, v in kwargs.items() if k != "filename"})
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        if not _state["running"]:
+            trace_dir = os.path.splitext(_state["filename"])[0] + "_jax_trace"
+            try:
+                jax.profiler.start_trace(trace_dir)
+                _state["jax_trace_dir"] = trace_dir
+            except Exception:
+                _state["jax_trace_dir"] = None
+            _state["running"] = True
+            _state["start_time"] = time.time()
+    elif state == "stop":
+        if _state["running"]:
+            if _state["jax_trace_dir"]:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+            _state["running"] = False
+
+
+def pause(profile_process="worker"):
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+class record_event:
+    """Chrome-tracing event recorder for host-side phases."""
+
+    def __init__(self, name, category="host"):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        with _state["lock"]:
+            _state["events"].append({
+                "name": self.name, "cat": self.category, "ph": "X",
+                "ts": self.t0 * 1e6, "dur": (time.time() - self.t0) * 1e6,
+                "pid": 0, "tid": threading.get_ident() % 1000,
+            })
+
+
+def dumps(reset=False):
+    with _state["lock"]:
+        out = json.dumps({"traceEvents": list(_state["events"])})
+        if reset:
+            _state["events"] = []
+    return out
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON of host events (device trace in *_jax_trace)."""
+    with open(_state["filename"], "w") as f:
+        f.write(dumps())

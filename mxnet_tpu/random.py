@@ -1,0 +1,55 @@
+"""Global PRNG state (reference: mx.random.seed, src/common/random_generator.h).
+
+TPU-native: a single functional JAX PRNG key chain. Eager stochastic ops draw
+`next_key()`; traced/jitted programs receive an explicit key input (Executor /
+CachedOp thread one in per step) so compiled code stays pure.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "current_key"]
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.trace_key = None  # set while tracing a jitted program
+
+
+_STATE = _RngState()
+
+
+def seed(seed_state, ctx="all"):
+    """reference: python/mxnet/random.py seed()."""
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    if _STATE.trace_key is not None:
+        _STATE.trace_key, sub = jax.random.split(_STATE.trace_key)
+        return sub
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+def current_key():
+    return _STATE.key
+
+
+class trace_key_scope:
+    """Context manager installing a traced key while building a jitted program."""
+
+    def __init__(self, key):
+        self.key = key
+        self.prev = None
+
+    def __enter__(self):
+        self.prev = _STATE.trace_key
+        _STATE.trace_key = self.key
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.trace_key = self.prev

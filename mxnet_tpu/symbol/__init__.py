@@ -1,0 +1,118 @@
+"""mx.sym namespace: Symbol + auto-generated symbolic op functions.
+
+Reference: python/mxnet/symbol/register.py:202 generates these from C-API
+introspection; here from the op registry. Missing weight inputs are auto-created
+as Variables named "<opname>_<input>" exactly like the reference composer.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..base import MXNetError
+from ..ops import OPS, get_op
+from ..ops.registry import _ALIASES as _OP_ALIASES
+from .symbol import (Symbol, Node, Variable, var, Group, load, load_json,
+                     fromjson, _NAMES)
+
+_this = sys.modules[__name__]
+
+
+def _invoke_symbol(opdef, sym_inputs, attrs, name=None):
+    """Create a graph node applying opdef to symbol inputs."""
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    if opdef.key_var_num_args and opdef.key_var_num_args not in attrs:
+        attrs[opdef.key_var_num_args] = len(sym_inputs)
+    params = opdef.make_params(dict(attrs))
+    in_names = opdef.list_inputs(params) + opdef.list_aux(params)
+    if name is None:
+        name = _NAMES.get(opdef.name.lower())
+    inputs = []
+    for i, nm in enumerate(in_names):
+        if i < len(sym_inputs) and sym_inputs[i] is not None:
+            s = sym_inputs[i]
+            if len(s._outputs) != 1:
+                raise MXNetError("op %s input %s must be a single-output symbol"
+                                 % (opdef.name, nm))
+            inputs.append(s._outputs[0])
+        else:
+            # auto-create parameter/aux variable (reference composer behavior)
+            vnode = Node(None, {}, [], "%s_%s" % (name, nm))
+            inputs.append((vnode, 0))
+    node = Node(opdef, attrs, inputs, name)
+    n_out = opdef.n_outputs(params)
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _make_sym_function(opdef):
+    def sym_func(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        # split symbol kwargs from attrs
+        attrs = {}
+        named_inputs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                named_inputs[k] = v
+            else:
+                attrs[k] = v
+        sym_args = [a for a in args if isinstance(a, Symbol)]
+        pos_attrs = [a for a in args if not isinstance(a, Symbol)]
+        if pos_attrs:
+            fields = [f for f in opdef.param_cls._fields if f not in attrs]
+            for a, f in zip(pos_attrs, fields):
+                attrs[f] = a
+        if opdef.key_var_num_args:
+            if opdef.key_var_num_args not in attrs:
+                attrs[opdef.key_var_num_args] = max(len(sym_args), 1)
+            inputs = sym_args
+        else:
+            probe = opdef.make_params({k: v for k, v in attrs.items() if v is not None})
+            in_names = opdef.list_inputs(probe) + opdef.list_aux(probe)
+            inputs = [None] * len(in_names)
+            for i, a in enumerate(sym_args):
+                if i < len(inputs):
+                    inputs[i] = a
+            for k, v in named_inputs.items():
+                if k in in_names:
+                    inputs[in_names.index(k)] = v
+                else:
+                    raise MXNetError("%s: unknown input %r (expects %s)"
+                                     % (opdef.name, k, in_names))
+        out = _invoke_symbol(opdef, inputs, attrs, name=name)
+        if attr:
+            out._set_attr(**attr)
+        return out
+
+    sym_func.__name__ = opdef.name
+    sym_func.__doc__ = opdef.doc
+    return sym_func
+
+
+_GENERATED = {}
+for _name, _opdef in list(OPS.items()):
+    _fn = _make_sym_function(_opdef)
+    _GENERATED[_name] = _fn
+    setattr(_this, _name, _fn)
+
+for _al, _target in _OP_ALIASES.items():
+    if _target in _GENERATED:
+        setattr(_this, _al, _GENERATED[_target])
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _GENERATED["_zeros"](shape=tuple(shape) if not isinstance(shape, int)
+                                else (shape,), dtype=str(dtype), **kwargs)
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _GENERATED["_ones"](shape=tuple(shape) if not isinstance(shape, int)
+                               else (shape,), dtype=str(dtype), **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
+    return _GENERATED["_arange"](start=start, stop=stop, step=step, repeat=repeat,
+                                 dtype=str(dtype), **kwargs)
+
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "fromjson",
+           "zeros", "ones", "arange"] + list(_GENERATED)

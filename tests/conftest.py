@@ -1,0 +1,17 @@
+"""Test config: 8-device virtual CPU platform so multi-device code paths
+(kvstore device lists, sharding meshes) run without TPU hardware, plus
+full-precision matmuls so numeric-gradient checks have resolution.
+
+Note: the env in this image force-registers the TPU plugin via sitecustomize,
+so JAX_PLATFORMS env vars are overridden — jax.config.update after import is
+the reliable switch.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")

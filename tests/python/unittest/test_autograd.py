@@ -1,0 +1,141 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.util.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y * x  # z = 2x^2, dz/dx = 4x
+        out = z.sum()
+    out.backward()
+    assert_almost_equal(x.grad.asnumpy(), 4 * x.asnumpy())
+
+
+def test_head_grad():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(mx.nd.array([10.0, 20.0]))
+    assert_almost_equal(x.grad.asnumpy(), np.array([30.0, 60.0], np.float32))
+
+
+def test_grad_add():
+    x = mx.nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.array([6.0], np.float32))
+
+
+def test_pause():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 2  # not recorded
+        w = y.sum()
+    w.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.array([2.0, 2.0], np.float32))
+
+
+def test_training_state():
+    assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_detach():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # d/dx of (const * x) = const = x^2 = 4
+    assert_almost_equal(x.grad.asnumpy(), np.array([4.0], np.float32))
+
+
+def test_grad_function():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    grads = autograd.grad_or = None
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.exp(x)
+    g = autograd.grad(y, x)
+    assert_almost_equal(g.asnumpy(), np.exp(x.asnumpy()), rtol=1e-4)
+
+
+def test_retain_graph():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    assert_almost_equal(x.grad.asnumpy(), np.array([6.0], np.float32))
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.array([6.0], np.float32))
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = mx.nd.array(np.random.uniform(-2, 2, (4,)).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad.asnumpy(), sig * (1 - sig), rtol=1e-4)
+
+
+def test_multi_output_backward():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = x * 3
+    autograd.backward([y, z])
+    assert_almost_equal(x.grad.asnumpy(), np.array([5.0, 5.0], np.float32))
+
+
+def test_nd_op_gradient():
+    x = mx.nd.array(np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.log(x).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 1.0 / x.asnumpy(), rtol=1e-4)

