@@ -1,0 +1,177 @@
+"""Train a word-level LSTM LM (reference: example/gluon/word_language_model/train.py).
+
+PTB files are read from --data if present; otherwise a synthetic markov corpus
+with the same shape is generated (zero-egress environment).
+"""
+import argparse
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+import model as model_mod
+
+parser = argparse.ArgumentParser(description="word language model")
+parser.add_argument("--data", type=str, default="./data/ptb.")
+parser.add_argument("--model", type=str, default="lstm")
+parser.add_argument("--emsize", type=int, default=200)
+parser.add_argument("--nhid", type=int, default=200)
+parser.add_argument("--nlayers", type=int, default=2)
+parser.add_argument("--lr", type=float, default=1.0)
+parser.add_argument("--clip", type=float, default=0.2)
+parser.add_argument("--epochs", type=int, default=3)
+parser.add_argument("--batch_size", type=int, default=32)
+parser.add_argument("--bptt", type=int, default=35)
+parser.add_argument("--dropout", type=float, default=0.2)
+parser.add_argument("--tied", action="store_true")
+parser.add_argument("--tpus", type=str, default=None)
+parser.add_argument("--gpus", type=str, default=None)
+parser.add_argument("--log-interval", type=int, default=100)
+parser.add_argument("--save", type=str, default="model.params")
+parser.add_argument("--hybridize", action="store_true",
+                    help="hybridize the recurrent net (jit to XLA)")
+args = parser.parse_args()
+
+
+class Corpus:
+    def __init__(self, path):
+        self.word2idx = {}
+        self.idx2word = []
+        if os.path.exists(path + "train.txt"):
+            self.train = self.tokenize(path + "train.txt")
+            self.valid = self.tokenize(path + "valid.txt")
+            self.test = self.tokenize(path + "test.txt")
+        else:
+            print("PTB not found at %s*; generating synthetic corpus" % path)
+            self.train = self._synthetic(200000)
+            self.valid = self._synthetic(20000)
+            self.test = self._synthetic(20000)
+
+    def _synthetic(self, n, vocab=500):
+        rng = np.random.RandomState(0)
+        # first-order markov chain -> learnable structure
+        trans = rng.dirichlet(np.ones(vocab) * 0.05, size=vocab)
+        out = np.zeros(n, dtype=np.int64)
+        state = 0
+        for i in range(n):
+            state = rng.choice(vocab, p=trans[state])
+            out[i] = state
+        for w in range(vocab):
+            self.word2idx.setdefault(str(w), len(self.word2idx))
+        return mx.nd.array(out.astype(np.float32))
+
+    def add_word(self, word):
+        if word not in self.word2idx:
+            self.idx2word.append(word)
+            self.word2idx[word] = len(self.idx2word) - 1
+        return self.word2idx[word]
+
+    def tokenize(self, path):
+        ids = []
+        with open(path) as f:
+            for line in f:
+                for word in line.split() + ["<eos>"]:
+                    ids.append(self.add_word(word))
+        return mx.nd.array(np.asarray(ids, dtype=np.float32))
+
+
+def batchify(data, batch_size):
+    nbatch = data.shape[0] // batch_size
+    data = data[:nbatch * batch_size]
+    return data.reshape((batch_size, nbatch)).T
+
+
+def get_batch(source, i):
+    seq_len = min(args.bptt, source.shape[0] - 1 - i)
+    data = source[i:i + seq_len]
+    target = source[i + 1:i + 1 + seq_len]
+    return data, target.reshape((-1,))
+
+
+def detach(hidden):
+    if isinstance(hidden, (tuple, list)):
+        return [h.detach() for h in hidden]
+    return hidden.detach()
+
+
+def eval_data(data_source, model, loss, context):
+    total_L = 0.0
+    ntotal = 0
+    hidden = model.begin_state(batch_size=args.batch_size, ctx=context)
+    for i in range(0, data_source.shape[0] - 1, args.bptt):
+        data, target = get_batch(data_source, i)
+        output, hidden = model(data, hidden)
+        L = loss(output, target)
+        total_L += float(L.sum().asscalar())
+        ntotal += L.size
+    return total_L / ntotal
+
+
+def main():
+    if args.tpus:
+        context = mx.tpu(int(args.tpus.split(",")[0]))
+    elif args.gpus:
+        context = mx.gpu(int(args.gpus.split(",")[0]))
+    else:
+        context = mx.cpu(0)
+
+    corpus = Corpus(args.data)
+    ntokens = max(len(corpus.word2idx), 1)
+    train_data = batchify(corpus.train, args.batch_size)
+    val_data = batchify(corpus.valid, args.batch_size)
+    test_data = batchify(corpus.test, args.batch_size)
+
+    model = model_mod.RNNModel(args.model, ntokens, args.emsize, args.nhid,
+                               args.nlayers, args.dropout, args.tied)
+    model.initialize(mx.initializer.Xavier(), ctx=context)
+    if args.hybridize:
+        model.rnn.hybridize()
+        model.decoder.hybridize()
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0,
+                             "wd": 0})
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total_L = 0.0
+        start_time = time.time()
+        hidden = model.begin_state(batch_size=args.batch_size, ctx=context)
+        for ibatch, i in enumerate(range(0, train_data.shape[0] - 1, args.bptt)):
+            data, target = get_batch(train_data, i)
+            hidden = detach(hidden)
+            with autograd.record():
+                output, hidden = model(data, hidden)
+                L = loss(output, target)
+            L.backward()
+            grads = [p.grad(context) for p in model.collect_params().values()
+                     if p.grad_req != "null"]
+            gluon.utils.clip_global_norm(grads, args.clip * args.bptt
+                                         * args.batch_size)
+            trainer.step(args.batch_size * args.bptt)
+            total_L += float(L.mean().asscalar()) * args.bptt
+
+            if ibatch % args.log_interval == 0 and ibatch > 0:
+                cur_L = total_L / args.bptt / (ibatch + 1)
+                wps = (ibatch + 1) * args.batch_size * args.bptt / \
+                    (time.time() - start_time)
+                print("[Epoch %d Batch %d] loss %.2f, ppl %.2f, %.1f wps"
+                      % (epoch, ibatch, cur_L, math.exp(min(cur_L, 20)), wps))
+
+        val_L = eval_data(val_data, model, loss, context)
+        print("[Epoch %d] time cost %.2fs, validation loss %.2f, ppl %.2f"
+              % (epoch, time.time() - start_time, val_L,
+                 math.exp(min(val_L, 20))))
+
+    test_L = eval_data(test_data, model, loss, context)
+    print("Best test loss %.2f, test ppl %.2f" % (test_L, math.exp(min(test_L, 20))))
+    model.save_parameters(args.save)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""Training harness (reference: example/image-classification/common/fit.py).
+
+kvstore creation, per-worker lr schedule (reference fit.py:27-50), Module.fit
+wiring with checkpoint + Speedometer callbacks.
+"""
+import argparse
+import logging
+import os
+import time
+
+import mxnet_tpu as mx
+
+
+def _get_lr_scheduler(args, kv):
+    if getattr(args, "lr_factor", 1) >= 1 or not getattr(args, "lr_step_epochs", None):
+        return (args.lr, None)
+    epoch_size = getattr(args, "num_examples", 50000) // args.batch_size
+    if "dist" in args.kv_store or "tpu" in args.kv_store:
+        epoch_size //= max(kv.num_workers, 1)
+    begin_epoch = args.load_epoch if args.load_epoch else 0
+    step_epochs = [int(l) for l in args.lr_step_epochs.split(",")]
+    lr = args.lr
+    for s in step_epochs:
+        if begin_epoch >= s:
+            lr *= args.lr_factor
+    if lr != args.lr:
+        logging.info("Adjust learning rate to %e for epoch %d", lr, begin_epoch)
+    steps = [epoch_size * (x - begin_epoch) for x in step_epochs
+             if x - begin_epoch > 0]
+    if not steps:
+        return (lr, None)
+    return (lr, mx.lr_scheduler.MultiFactorScheduler(step=steps,
+                                                     factor=args.lr_factor))
+
+
+def _load_model(args, rank=0):
+    if "load_epoch" not in args or args.load_epoch is None:
+        return (None, None, None)
+    assert args.model_prefix is not None
+    model_prefix = args.model_prefix
+    sym, arg_params, aux_params = mx.model.load_checkpoint(model_prefix,
+                                                           args.load_epoch)
+    logging.info("Loaded model %s_%04d.params", model_prefix, args.load_epoch)
+    return (sym, arg_params, aux_params)
+
+
+def _save_model(args, rank=0):
+    if args.model_prefix is None:
+        return None
+    dst_dir = os.path.dirname(args.model_prefix)
+    if dst_dir and not os.path.isdir(dst_dir):
+        os.makedirs(dst_dir, exist_ok=True)
+    return mx.callback.do_checkpoint(
+        args.model_prefix if rank == 0 else "%s-%d" % (args.model_prefix, rank))
+
+
+def add_fit_args(parser):
+    """reference: fit.py add_fit_args."""
+    train = parser.add_argument_group("Training", "model training")
+    train.add_argument("--network", type=str, help="the neural network to use")
+    train.add_argument("--num-layers", type=int, help="number of layers")
+    train.add_argument("--gpus", type=str,
+                       help="list of gpus to run, e.g. 0 or 0,2,5. empty=cpu")
+    train.add_argument("--tpus", type=str,
+                       help="list of tpu cores to run on, e.g. 0 or 0-7")
+    train.add_argument("--kv-store", type=str, default="device")
+    train.add_argument("--num-epochs", type=int, default=100)
+    train.add_argument("--lr", type=float, default=0.1)
+    train.add_argument("--lr-factor", type=float, default=0.1)
+    train.add_argument("--lr-step-epochs", type=str)
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=0.0001)
+    train.add_argument("--batch-size", type=int, default=128)
+    train.add_argument("--disp-batches", type=int, default=20)
+    train.add_argument("--model-prefix", type=str)
+    train.add_argument("--load-epoch", type=int)
+    train.add_argument("--top-k", type=int, default=0)
+    train.add_argument("--test-io", type=int, default=0)
+    train.add_argument("--dtype", type=str, default="float32")
+    train.add_argument("--monitor", dest="monitor", type=int, default=0)
+    return train
+
+
+def _parse_ctx(args):
+    if getattr(args, "tpus", None):
+        spec = args.tpus
+        if "-" in spec:
+            lo, hi = spec.split("-")
+            return [mx.tpu(i) for i in range(int(lo), int(hi) + 1)]
+        return [mx.tpu(int(i)) for i in spec.split(",")]
+    if getattr(args, "gpus", None):
+        return [mx.gpu(int(i)) for i in args.gpus.split(",")]
+    return [mx.cpu()]
+
+
+def fit(args, network, data_loader, **kwargs):
+    """reference: fit.py fit — the Module training entry."""
+    kv = mx.kvstore.create(args.kv_store)
+    logging.info("start with arguments %s", args)
+
+    (train, val) = data_loader(args, kv)
+    devs = _parse_ctx(args)
+
+    lr, lr_scheduler = _get_lr_scheduler(args, kv)
+
+    sym, arg_params, aux_params = _load_model(args, kv.rank)
+    if sym is not None:
+        assert sym.tojson() == network.tojson()
+
+    checkpoint = _save_model(args, kv.rank)
+
+    model = mx.mod.Module(context=devs, symbol=network)
+
+    optimizer_params = {
+        "learning_rate": lr,
+        "wd": args.wd,
+        "lr_scheduler": lr_scheduler}
+    if args.optimizer in ("sgd", "nag", "signum", "lbsgd"):
+        optimizer_params["momentum"] = args.mom
+    if args.dtype == "float16":
+        optimizer_params["multi_precision"] = True
+
+    eval_metrics = ["accuracy"]
+    if args.top_k > 0:
+        eval_metrics.append(mx.metric.create("top_k_accuracy",
+                                             top_k=args.top_k))
+
+    batch_end_callbacks = [mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches)]
+
+    model.fit(train,
+              begin_epoch=args.load_epoch if args.load_epoch else 0,
+              num_epoch=args.num_epochs,
+              eval_data=val,
+              eval_metric=eval_metrics,
+              kvstore=kv,
+              optimizer=args.optimizer,
+              optimizer_params=optimizer_params,
+              initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                                factor_type="in", magnitude=2),
+              arg_params=arg_params,
+              aux_params=aux_params,
+              batch_end_callback=batch_end_callbacks,
+              epoch_end_callback=checkpoint,
+              allow_missing=True)
+    return model

@@ -1,0 +1,90 @@
+"""Train mnist (reference: example/image-classification/train_mnist.py:93-96).
+
+Runs unchanged against mxnet_tpu. If the MNIST idx files are not present
+locally (this environment has no egress), a synthetic structured dataset with
+the same shapes is used so the config still exercises the full Module path.
+"""
+import argparse
+import gzip
+import logging
+import os
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+logging.basicConfig(level=logging.INFO)
+
+import mxnet_tpu as mx
+from common import fit
+
+
+def read_data(label_path, image_path):
+    with gzip.open(label_path) as flbl:
+        struct.unpack(">II", flbl.read(8))
+        label = np.frombuffer(flbl.read(), dtype=np.int8)
+    with gzip.open(image_path, "rb") as fimg:
+        _, num, rows, cols = struct.unpack(">IIII", fimg.read(16))
+        image = np.frombuffer(fimg.read(), dtype=np.uint8).reshape(
+            len(label), rows, cols)
+    return (label, image)
+
+
+def _synthetic_mnist(n):
+    """Class-dependent blob images: learnable stand-in when real MNIST absent."""
+    rng = np.random.RandomState(42)
+    label = rng.randint(0, 10, n).astype(np.int8)
+    image = rng.randint(0, 30, (n, 28, 28)).astype(np.uint8)
+    for i in range(n):
+        c = label[i]
+        r0, c0 = (c // 5) * 12 + 2, (c % 5) * 5 + 2
+        image[i, r0:r0 + 10, c0:c0 + 4] += 180
+    return label, image
+
+
+def to4d(img):
+    return img.reshape(img.shape[0], 1, 28, 28).astype(np.float32) / 255
+
+
+def get_mnist_iter(args, kv):
+    data_dir = os.environ.get("MNIST_DIR", "data")
+    train_lbl_p = os.path.join(data_dir, "train-labels-idx1-ubyte.gz")
+    if os.path.exists(train_lbl_p):
+        (train_lbl, train_img) = read_data(
+            train_lbl_p, os.path.join(data_dir, "train-images-idx3-ubyte.gz"))
+        (val_lbl, val_img) = read_data(
+            os.path.join(data_dir, "t10k-labels-idx1-ubyte.gz"),
+            os.path.join(data_dir, "t10k-images-idx3-ubyte.gz"))
+    else:
+        logging.warning("MNIST files not found under %s; using synthetic data",
+                        data_dir)
+        n = int(os.environ.get("MNIST_SYNTH_N", "6000"))
+        train_lbl, train_img = _synthetic_mnist(n)
+        val_lbl, val_img = _synthetic_mnist(n // 6)
+    train = mx.io.NDArrayIter(to4d(train_img), train_lbl.astype(np.float32),
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(to4d(val_img), val_lbl.astype(np.float32),
+                            args.batch_size)
+    return (train, val)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train an image classifier on mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.set_defaults(
+        network="mlp", num_layers=None, gpus=None, tpus=None,
+        batch_size=64, disp_batches=100, num_epochs=10,
+        lr=0.05, lr_step_epochs="10", kv_store="local")
+    fit.add_fit_args(parser)
+    args = parser.parse_args()
+
+    from mxnet_tpu import models
+    if args.network == "mlp":
+        sym = models.mlp.get_symbol(num_classes=10)
+    else:
+        sym = models.get_symbol(args.network, num_classes=10)
+
+    fit.fit(args, sym, get_mnist_iter)

@@ -1,0 +1,529 @@
+"""Gluon Block / HybridBlock (reference: python/mxnet/gluon/block.py, 867 LoC).
+
+TPU-native hybridize: `hybridize()` compiles the block's computation into ONE
+jitted XLA program (the reference's CachedOp bulked-engine replay,
+src/imperative/cached_op.cc — SURVEY.md calls this "the single most natural
+mapping in this port": hybridize() -> jax.jit). Gradients flow through the
+compiled program via the autograd tape (jax.vjp over the jitted function), so
+eager ops before/after a hybridized block differentiate seamlessly.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context, cpu
+from ..ndarray.ndarray import NDArray
+from .. import imperative as _imp
+from .. import random as _rnd
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope(threading.local):
+    def __init__(self):
+        self.counters = {}
+
+    def create_prefix(self, hint):
+        idx = self.counters.get(hint, 0)
+        self.counters[hint] = idx + 1
+        return "%s%d_" % (hint, idx)
+
+
+_SCOPE = _BlockScope()
+
+
+class _NameScopeCtx:
+    def __init__(self, block):
+        self._block = block
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+class Block:
+    """Base neural-network building block (reference: block.py:123)."""
+
+    def __init__(self, prefix=None, params=None):
+        hint = type(self).__name__.lower()
+        self._prefix = prefix if prefix is not None else _SCOPE.create_prefix(hint)
+        if params is None:
+            self._params = ParameterDict(self._prefix)
+        else:
+            # adopt the shared dict's prefix so `params=` weight sharing/tying
+            # resolves to the SAME parameters (reference: _BlockScope.create,
+            # block.py:56 — ParameterDict(params.prefix, params))
+            self._params = ParameterDict(params.prefix, shared=params)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+        self._scope = _NameScopeCtx(self)
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=key, block=_indent(str(block), 2))
+            for key, block in self._children.items())
+        return s.format(name=type(self).__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = getattr(self, name, None)
+            if existing is not None and name in getattr(self, "_children", {}):
+                self._children[name] = value
+            else:
+                self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """reference: block.py collect_params with regex select."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        for name, param in self._reg_params.items():
+            if select is None or re.compile(select).match(param.name):
+                ret.update({param.name: param})
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks[len(self._forward_hooks)] = hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks[len(self._forward_pre_hooks)] = hook
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        from .. import initializer as init_mod
+        self.collect_params().initialize(init or init_mod.Uniform(), ctx,
+                                         verbose, force_reinit)
+
+    def _structured_params(self):
+        """Structure-keyed params ('features.0.weight' style) — robust to the
+        global auto-prefix counters differing between two instances."""
+        out = {}
+        for attr, p in self._reg_params.items():
+            out[attr] = p
+        for name, child in self._children.items():
+            for k, v in child._structured_params().items():
+                out[name + "." + k] = v
+        return out
+
+    def save_parameters(self, filename):
+        import numpy as np
+        import os
+        arrays = {}
+        for key, p in self._structured_params().items():
+            if p._data is not None:
+                arrays[key] = p.data().asnumpy()
+        np.savez(filename, **arrays)
+        if os.path.exists(filename + ".npz"):
+            os.replace(filename + ".npz", filename)
+
+    save_params = save_parameters
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False):
+        import numpy as np
+        from ..ndarray.ndarray import array
+        loaded = np.load(filename, allow_pickle=False)
+        params = self._structured_params()
+        if not allow_missing:
+            for key in params:
+                if key not in loaded.files:
+                    raise MXNetError("Parameter %s is missing in file %s"
+                                     % (key, filename))
+        for key in loaded.files:
+            if key not in params:
+                if not ignore_extra:
+                    raise MXNetError("Parameter %s in file %s is not present "
+                                     "in this Block" % (key, filename))
+                continue
+            p = params[key]
+            value = loaded[key]
+            if p._data is None:
+                p._shape = value.shape
+                if p._deferred_init:
+                    p._finish_deferred_init()
+                else:
+                    p.initialize(ctx=ctx or [current_context()])
+            p.set_data(array(value))
+
+    load_params = load_parameters
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        return out
+
+
+def _indent(s, num_spaces):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    return lines[0] + "\n" + "\n".join(" " * num_spaces + line
+                                       for line in lines[1:])
+
+
+class HybridBlock(Block):
+    """Block that can compile to one XLA program (reference: block.py:486)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_fns = {}   # (is_train, shapes-key) -> jitted fn
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_fns = {}
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_fns = {}
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Deferred-shape resolution by running one eager forward."""
+        from ..autograd import pause
+        with pause():
+            self.forward(*args)
+
+    # ------------------------------------------------------------------
+    def _eager_forward(self, *args):
+        # the attribute name under which the layer registered the Parameter is
+        # the hybrid_forward kwarg name (robust to shared-prefix params)
+        params = {attr: p.data() for attr, p in sorted(self._reg_params.items())}
+        from .. import ndarray as nd_mod
+        return self.hybrid_forward(nd_mod, *args, **params)
+
+    def forward(self, x, *args):
+        """Dispatch eager / cached-jit (reference: block.py:698 forward switch).
+
+        Inside a parent's jit trace, run uncached with overridden params so the
+        whole tree compiles into the parent's single XLA program.
+        """
+        inputs = (x,) + args
+        if _is_tracing():
+            return self._eager_forward_overridden(*inputs)
+        try:
+            if self._active:
+                return self._call_cached(inputs)
+            return self._eager_forward(*inputs)
+        except DeferredInitializationError:
+            self._resolve_deferred(inputs)
+            if self._active:
+                return self._call_cached(inputs)
+            return self._eager_forward(*inputs)
+
+    def _resolve_deferred(self, inputs):
+        """Pin this block's deferred shapes from the inputs, then run one eager
+        pass (children resolve themselves recursively inside it)."""
+        self._pin_shapes(*inputs)
+        for _, p in self._reg_params.items():
+            if p._deferred_init:
+                p._finish_deferred_init()
+        from ..autograd import pause
+        with pause():
+            self._eager_forward(*inputs)
+
+    def _pin_shapes(self, *args):
+        """Hook: layers override to set deferred param dims from input shapes."""
+
+    # ------------------------------------------------------------------
+    # cached (hybridized) execution
+    # ------------------------------------------------------------------
+    def _call_cached(self, inputs):
+        params_items = self._all_block_params()
+        for _, p in params_items:
+            if p._data is None:
+                raise DeferredInitializationError("param %s deferred" % p.name)
+        is_train = _imp.is_training()
+        key = (is_train, len(inputs), tuple(a.shape for a in inputs),
+               tuple(str(a.dtype) for a in inputs))
+        entry = self._cached_fns.get(key)
+        if entry is None:
+            entry = self._build_cached(params_items, inputs, is_train)
+            self._cached_fns[key] = entry
+        jit_fn, n_out, out_tree, aux_refs = entry
+
+        param_arrays = [p.data() for _, p in params_items]
+        rng_val = _rnd.next_key()
+
+        def fn(*vals):
+            return jit_fn(rng_val, vals[:len(param_arrays)],
+                          vals[len(param_arrays):])
+
+        outs = _imp.apply_fn(fn, param_arrays + list(inputs))
+        # write back aux updates (running stats): jit fn returns them last
+        for p, upd in zip(aux_refs, outs[n_out:]):
+            p.data()._data = upd._data
+        return jax.tree_util.tree_unflatten(out_tree, outs[:n_out])
+
+    def _all_block_params(self):
+        return sorted(self.collect_params().items())
+
+    def _build_cached(self, params_items, inputs, is_train):
+        """Trace hybrid_forward into a jitted function (reference: _build_cache
+        block.py:564 -> CachedOp). Returns (jit_fn, n_out, out_treedef, aux_refs)."""
+        block = self
+        names = [n for n, _ in params_items]
+        # aux = non-differentiable params whose buffers the forward mutates
+        aux_idx = [i for i, (_, p) in enumerate(params_items)
+                   if p.grad_req == "null"]
+        aux_refs = [params_items[i][1] for i in aux_idx]
+
+        def pure(rng, param_vals, input_vals):
+            # rebuild NDArray wrappers around tracers, run the python forward
+            wrappers = [NDArray(v) for v in param_vals]
+            in_wrap = [NDArray(v) for v in input_vals]
+            prev = _imp.set_training(is_train)
+            prev_rec = _imp.set_recording(False)
+            _TRACING.depth = getattr(_TRACING, "depth", 0) + 1
+            try:
+                with _rnd.trace_key_scope(rng):
+                    out = block._traced_forward(names, wrappers, in_wrap)
+            finally:
+                _TRACING.depth -= 1
+                _imp.set_training(prev)
+                _imp.set_recording(prev_rec)
+            leaves, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda v: isinstance(v, NDArray))
+            block._cached_out_tree = treedef
+            aux_new = [wrappers[i]._data for i in aux_idx]
+            return tuple(l._data for l in leaves) + tuple(aux_new)
+
+        # probe output count + tree structure once (abstract); pure() records
+        # the treedef on the block at trace time
+        probe = jax.eval_shape(
+            pure, jax.random.PRNGKey(0),
+            tuple(jax.ShapeDtypeStruct(p.data().shape, p.data().dtype)
+                  for _, p in params_items),
+            tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in inputs))
+        n_out = len(probe) - len(aux_idx)
+        return (jax.jit(pure), n_out, self._cached_out_tree, aux_refs)
+
+    def _traced_forward(self, names, param_wrappers, input_wrappers):
+        """Run hybrid_forward with this block's params bound from wrappers,
+        recursing into children via a param-override context."""
+        override = dict(zip(names, param_wrappers))
+        with _param_override(override):
+            return self._eager_forward_overridden(*input_wrappers)
+
+    def _eager_forward_overridden(self, *args):
+        params = {}
+        for attr, p in sorted(self._reg_params.items()):
+            ov = _get_override(p.name)
+            params[attr] = ov if ov is not None else p.data()
+        from .. import ndarray as nd_mod
+        return self.hybrid_forward(nd_mod, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Emit symbol.json + params (reference: block.py:665)."""
+        from .. import symbol as sym_mod
+        from ..model import save_params
+        sym = self._as_symbol()
+        sym.save("%s-symbol.json" % path)
+        arg_params = {}
+        for name, param in self.collect_params().items():
+            if param._data is not None:
+                arg_params[name] = param.data()
+        save_params("%s-%04d.params" % (path, epoch), arg_params, {})
+
+    def _as_symbol(self):
+        """Trace hybrid_forward with Symbol proxies to build a Symbol graph."""
+        from .. import symbol as sym_mod
+        data = sym_mod.Variable("data")
+        params = {attr: p.var() for attr, p in sorted(self._reg_params.items())}
+        out = self.hybrid_forward(sym_mod, data, **params)
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# param override context used while tracing nested blocks under one jit
+# ---------------------------------------------------------------------------
+
+_OVERRIDE = threading.local()
+_TRACING = threading.local()
+
+
+def _is_tracing():
+    return getattr(_TRACING, "depth", 0) > 0
+
+
+class _param_override:
+    def __init__(self, mapping):
+        self.mapping = mapping
+
+    def __enter__(self):
+        stack = getattr(_OVERRIDE, "stack", None)
+        if stack is None:
+            _OVERRIDE.stack = stack = []
+        stack.append(self.mapping)
+
+    def __exit__(self, *exc):
+        _OVERRIDE.stack.pop()
+
+
+def _get_override(name):
+    stack = getattr(_OVERRIDE, "stack", None)
+    if not stack:
+        return None
+    for mapping in reversed(stack):
+        if name in mapping:
+            return mapping[name]
+    return None
+
+
+def _strip_prefix(name, prefix):
+    return name[len(prefix):] if name.startswith(prefix) else name
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol graph as a Block (reference: block.py:736)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        from .. import symbol as sym_mod
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        self._symbol = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        for name in arg_names:
+            if name not in self._input_names:
+                self.params.get(_strip_prefix(name, self.params.prefix),
+                                allow_deferred_init=True)
+        for name in aux_names:
+            self.params.get(_strip_prefix(name, self.params.prefix),
+                            grad_req="null", allow_deferred_init=True)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+        from ..model import load_params
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.Variable(n) for n in input_names]
+        block = SymbolBlock(sym, inputs)
+        if param_file:
+            arg_params, aux_params = load_params(param_file)
+            all_params = dict(arg_params)
+            all_params.update(aux_params)
+            for name, value in all_params.items():
+                if name in block.params.keys():
+                    p = block.params[name]
+                    p._shape = value.shape
+                    p.initialize(ctx=ctx or [current_context()])
+                    p.set_data(value)
+        return block
+
+    def forward(self, x, *args):
+        from ..executor import Executor
+        inputs = (x,) + args
+        arg_dict = dict(zip(self._input_names, inputs))
+        # finish deferred init with inferred shapes
+        in_shapes = {n: a.shape for n, a in arg_dict.items()}
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**in_shapes)
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in self._input_names:
+                continue
+            p = self.params[name]
+            if p._data is None:
+                p._shape = shape
+                if p._deferred_init:
+                    p._finish_deferred_init()
+                else:
+                    p.initialize(ctx=[x.context])
+            arg_dict[name] = p.data()
+        aux_dict = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            p = self.params[name]
+            if p._data is None:
+                p._shape = shape
+                if p._deferred_init:
+                    p._finish_deferred_init()
+                else:
+                    p.initialize(ctx=[x.context])
+            aux_dict[name] = p.data()
+        exe = Executor(self._symbol, x.context, arg_dict, {}, "null", aux_dict)
+        outs = exe.forward(is_train=_imp.is_training())
+        return outs[0] if len(outs) == 1 else outs
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise MXNetError("SymbolBlock computes via its wrapped Symbol")

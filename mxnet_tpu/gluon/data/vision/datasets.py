@@ -1,0 +1,141 @@
+"""Vision datasets (reference: python/mxnet/gluon/data/vision/datasets.py).
+
+MNIST/FashionMNIST read local idx files (no egress in this environment);
+CIFAR10/100 read the local python pickle batches. ImageRecordDataset rides the
+native RecordIO reader.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as _np
+
+from ....base import MXNetError
+from ....ndarray.ndarray import array
+from ..dataset import Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageRecordDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """reference: datasets.py MNIST (idx file format)."""
+
+    _train_files = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _test_files = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    @staticmethod
+    def _read_idx(path):
+        for cand in (path, path + ".gz"):
+            if os.path.exists(cand):
+                opener = gzip.open if cand.endswith(".gz") else open
+                with opener(cand, "rb") as f:
+                    magic = struct.unpack(">I", f.read(4))[0]
+                    ndim = magic & 0xFF
+                    dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+                    return _np.frombuffer(f.read(), dtype=_np.uint8).reshape(dims)
+        raise MXNetError("MNIST file %s not found (no network egress; place "
+                         "the idx files locally)" % path)
+
+    def _get_data(self):
+        files = self._train_files if self._train else self._test_files
+        data = self._read_idx(os.path.join(self._root, files[0]))
+        label = self._read_idx(os.path.join(self._root, files[1]))
+        self._data = data.reshape(-1, 28, 28, 1)
+        self._label = label.astype(_np.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        import pickle
+        batches = (["data_batch_%d" % i for i in range(1, 6)] if self._train
+                   else ["test_batch"])
+        data, labels = [], []
+        base = os.path.join(self._root, "cifar-10-batches-py")
+        for b in batches:
+            path = os.path.join(base, b)
+            if not os.path.exists(path):
+                raise MXNetError("CIFAR10 batch %s not found (no egress)" % path)
+            with open(path, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            data.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            labels.extend(d[b"labels"])
+        self._data = _np.concatenate(data)
+        self._label = _np.asarray(labels, dtype=_np.int32)
+
+
+class CIFAR100(_DownloadedDataset):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._train = train
+        self._fine = fine_label
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        import pickle
+        name = "train" if self._train else "test"
+        path = os.path.join(self._root, "cifar-100-python", name)
+        if not os.path.exists(path):
+            raise MXNetError("CIFAR100 file %s not found (no egress)" % path)
+        with open(path, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self._data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        key = b"fine_labels" if self._fine else b"coarse_labels"
+        self._label = _np.asarray(d[key], dtype=_np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """reference: datasets.py ImageRecordDataset."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack_img
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, cv_flag=self._flag)
+        img = array(img)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

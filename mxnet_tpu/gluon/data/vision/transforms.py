@@ -1,0 +1,169 @@
+"""Vision transforms (reference: python/mxnet/gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ....ndarray.ndarray import NDArray, array
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
+           "CenterCrop", "Resize", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation"]
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(Block):
+    """(H,W,C) uint8 [0,255] -> (C,H,W) float32 [0,1] (reference: to_tensor op)."""
+
+    def forward(self, x):
+        npx = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        out = npx.astype(_np.float32) / 255.0
+        if out.ndim == 3:
+            out = out.transpose(2, 0, 1)
+        elif out.ndim == 4:
+            out = out.transpose(0, 3, 1, 2)
+        return array(out)
+
+
+class Normalize(Block):
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype=_np.float32)
+        self._std = _np.asarray(std, dtype=_np.float32)
+
+    def forward(self, x):
+        npx = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        shape = (-1, 1, 1) if npx.ndim == 3 else (1, -1, 1, 1)
+        return array((npx - self._mean.reshape(shape))
+                     / self._std.reshape(shape))
+
+
+def _resize_np(img, size):
+    """Nearest-neighbor resize without cv2 dependency."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        ow, oh = size, size
+    else:
+        ow, oh = size
+    ys = (_np.arange(oh) * (h / oh)).astype(_np.int64).clip(0, h - 1)
+    xs = (_np.arange(ow) * (w / ow)).astype(_np.int64).clip(0, w - 1)
+    return img[ys][:, xs]
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+
+    def forward(self, x):
+        npx = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        return array(_resize_np(npx, self._size))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        npx = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        h, w = npx.shape[:2]
+        cw, ch = self._size
+        x0 = max((w - cw) // 2, 0)
+        y0 = max((h - ch) // 2, 0)
+        out = npx[y0:y0 + ch, x0:x0 + cw]
+        if out.shape[:2] != (ch, cw):
+            out = _resize_np(out, (cw, ch))
+        return array(out)
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4., 4. / 3.),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        npx = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        h, w = npx.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            ar = _np.random.uniform(*self._ratio)
+            cw = int(round(_np.sqrt(target_area * ar)))
+            ch = int(round(_np.sqrt(target_area / ar)))
+            if cw <= w and ch <= h:
+                x0 = _np.random.randint(0, w - cw + 1)
+                y0 = _np.random.randint(0, h - ch + 1)
+                crop = npx[y0:y0 + ch, x0:x0 + cw]
+                return array(_resize_np(crop, self._size))
+        return array(_resize_np(npx, self._size))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            npx = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            return array(npx[:, ::-1].copy())
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            npx = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+            return array(npx[::-1].copy())
+        return x
+
+
+class _RandomJitter(Block):
+    def __init__(self, amount):
+        super().__init__()
+        self._amount = amount
+
+    def _factor(self):
+        return 1.0 + _np.random.uniform(-self._amount, self._amount)
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        npx = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+        return array(_np.clip(npx * self._factor(), 0,
+                              255 if npx.dtype == _np.uint8 else 1.0
+                              ).astype(npx.dtype))
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        npx = (x.asnumpy() if isinstance(x, NDArray)
+               else _np.asarray(x)).astype(_np.float32)
+        mean = npx.mean()
+        out = (npx - mean) * self._factor() + mean
+        return array(out)
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        npx = (x.asnumpy() if isinstance(x, NDArray)
+               else _np.asarray(x)).astype(_np.float32)
+        gray = npx.mean(axis=-1, keepdims=True)
+        out = (npx - gray) * self._factor() + gray
+        return array(out)

@@ -1,0 +1,829 @@
+"""Gluon model zoo — vision (reference: python/mxnet/gluon/model_zoo/vision/).
+
+All eight families the reference ships: AlexNet, VGG(+BN), ResNet v1/v2,
+SqueezeNet, DenseNet, Inception-v3, MobileNet v1/v2. Pretrained-weight download
+is unavailable (zero-egress environment); `pretrained=True` raises with
+instructions to load local parameter files instead.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from .. import nn
+
+__all__ = ["get_model", "alexnet", "vgg11", "vgg13", "vgg16", "vgg19",
+           "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
+           "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+           "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+           "resnet101_v2", "resnet152_v2", "get_resnet",
+           "squeezenet1_0", "squeezenet1_1",
+           "densenet121", "densenet161", "densenet169", "densenet201",
+           "inception_v3", "mobilenet1_0", "mobilenet0_75", "mobilenet0_5",
+           "mobilenet0_25", "mobilenet_v2_1_0", "mobilenet_v2_0_75",
+           "mobilenet_v2_0_5", "mobilenet_v2_0_25",
+           "AlexNet", "VGG", "ResNetV1", "ResNetV2", "SqueezeNet", "DenseNet",
+           "Inception3", "MobileNet", "MobileNetV2"]
+
+
+def _check_pretrained(pretrained):
+    if pretrained:
+        raise MXNetError(
+            "pretrained weights cannot be downloaded in this environment "
+            "(zero egress); construct the model and call "
+            "net.load_parameters(<local file>) instead")
+
+
+# ---------------------------------------------------------------------------
+# AlexNet
+# ---------------------------------------------------------------------------
+
+class AlexNet(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential(prefix="")
+        self.features.add(nn.Conv2D(64, kernel_size=11, strides=4, padding=2,
+                                    activation="relu"))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
+                                    activation="relu"))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
+                                    activation="relu"))
+        self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
+                                    activation="relu"))
+        self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
+                                    activation="relu"))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(nn.Flatten())
+        self.features.add(nn.Dense(4096, activation="relu"))
+        self.features.add(nn.Dropout(0.5))
+        self.features.add(nn.Dense(4096, activation="relu"))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def alexnet(pretrained=False, ctx=None, **kwargs):
+    _check_pretrained(pretrained)
+    return AlexNet(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# VGG
+# ---------------------------------------------------------------------------
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(filters)
+        self.features = self._make_features(layers, filters, batch_norm)
+        self.features.add(nn.Dense(4096, activation="relu"))
+        self.features.add(nn.Dropout(0.5))
+        self.features.add(nn.Dense(4096, activation="relu"))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def _make_features(self, layers, filters, batch_norm):
+        featurizer = nn.HybridSequential(prefix="")
+        for i, num in enumerate(layers):
+            for _ in range(num):
+                featurizer.add(nn.Conv2D(filters[i], kernel_size=3, padding=1))
+                if batch_norm:
+                    featurizer.add(nn.BatchNorm())
+                featurizer.add(nn.Activation("relu"))
+            featurizer.add(nn.MaxPool2D(strides=2))
+        return featurizer
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+
+
+def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
+    _check_pretrained(pretrained)
+    layers, filters = vgg_spec[num_layers]
+    return VGG(layers, filters, **kwargs)
+
+
+def vgg11(**kw):
+    return get_vgg(11, **kw)
+
+
+def vgg13(**kw):
+    return get_vgg(13, **kw)
+
+
+def vgg16(**kw):
+    return get_vgg(16, **kw)
+
+
+def vgg19(**kw):
+    return get_vgg(19, **kw)
+
+
+def vgg11_bn(**kw):
+    kw["batch_norm"] = True
+    return get_vgg(11, **kw)
+
+
+def vgg13_bn(**kw):
+    kw["batch_norm"] = True
+    return get_vgg(13, **kw)
+
+
+def vgg16_bn(**kw):
+    kw["batch_norm"] = True
+    return get_vgg(16, **kw)
+
+
+def vgg19_bn(**kw):
+    kw["batch_norm"] = True
+    return get_vgg(19, **kw)
+
+
+# ---------------------------------------------------------------------------
+# ResNet v1/v2
+# ---------------------------------------------------------------------------
+
+class BasicBlockV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(nn.Conv2D(channels, 3, stride, 1, use_bias=False))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels, 3, 1, 1, use_bias=False))
+        self.body.add(nn.BatchNorm())
+        if downsample:
+            self.downsample = nn.HybridSequential(prefix="")
+            self.downsample.add(nn.Conv2D(channels, 1, stride, use_bias=False))
+            self.downsample.add(nn.BatchNorm())
+        else:
+            self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        x_out = self.body(x)
+        if self.downsample is not None:
+            residual = self.downsample(residual)
+        return F.Activation(residual + x_out, act_type="relu")
+
+
+class BottleneckV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(nn.Conv2D(channels // 4, 1, stride))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels // 4, 3, 1, 1, use_bias=False))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels, 1, 1))
+        self.body.add(nn.BatchNorm())
+        if downsample:
+            self.downsample = nn.HybridSequential(prefix="")
+            self.downsample.add(nn.Conv2D(channels, 1, stride, use_bias=False))
+            self.downsample.add(nn.BatchNorm())
+        else:
+            self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        x_out = self.body(x)
+        if self.downsample is not None:
+            residual = self.downsample(residual)
+        return F.Activation(x_out + residual, act_type="relu")
+
+
+class BasicBlockV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = nn.Conv2D(channels, 3, stride, 1, use_bias=False)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = nn.Conv2D(channels, 3, 1, 1, use_bias=False)
+        if downsample:
+            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False)
+        else:
+            self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        x = self.bn1(x)
+        x = F.Activation(x, act_type="relu")
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        x = self.conv1(x)
+        x = self.bn2(x)
+        x = F.Activation(x, act_type="relu")
+        x = self.conv2(x)
+        return x + residual
+
+
+class BottleneckV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = nn.Conv2D(channels // 4, 1, 1, use_bias=False)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = nn.Conv2D(channels // 4, 3, stride, 1, use_bias=False)
+        self.bn3 = nn.BatchNorm()
+        self.conv3 = nn.Conv2D(channels, 1, 1, use_bias=False)
+        if downsample:
+            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False)
+        else:
+            self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        x = self.bn1(x)
+        x = F.Activation(x, act_type="relu")
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        x = self.conv1(x)
+        x = self.bn2(x)
+        x = F.Activation(x, act_type="relu")
+        x = self.conv2(x)
+        x = self.bn3(x)
+        x = F.Activation(x, act_type="relu")
+        x = self.conv3(x)
+        return x + residual
+
+
+class ResNetV1(HybridBlock):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(channels) - 1
+        self.features = nn.HybridSequential(prefix="")
+        if thumbnail:
+            self.features.add(nn.Conv2D(channels[0], 3, 1, 1, use_bias=False))
+        else:
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, 1))
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            self.features.add(self._make_layer(block, num_layer, channels[i + 1],
+                                               stride, i + 1,
+                                               in_channels=channels[i]))
+        self.features.add(nn.GlobalAvgPool2D())
+        self.output = nn.Dense(classes, in_units=channels[-1])
+
+    def _make_layer(self, block, layers, channels, stride, stage_index,
+                    in_channels=0):
+        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
+        layer.add(block(channels, stride, channels != in_channels,
+                        in_channels=in_channels, prefix=""))
+        for _ in range(layers - 1):
+            layer.add(block(channels, 1, False, in_channels=channels, prefix=""))
+        return layer
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class ResNetV2(HybridBlock):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(channels) - 1
+        self.features = nn.HybridSequential(prefix="")
+        self.features.add(nn.BatchNorm(scale=False, center=False))
+        if thumbnail:
+            self.features.add(nn.Conv2D(channels[0], 3, 1, 1, use_bias=False))
+        else:
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, 1))
+        in_channels = channels[0]
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            self.features.add(self._make_layer(block, num_layer, channels[i + 1],
+                                               stride, i + 1,
+                                               in_channels=in_channels))
+            in_channels = channels[i + 1]
+        self.features.add(nn.BatchNorm())
+        self.features.add(nn.Activation("relu"))
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes, in_units=in_channels)
+
+    _make_layer = ResNetV1._make_layer
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+resnet_spec = {18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+               34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+               50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+               101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+               152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048])}
+resnet_net_versions = [ResNetV1, ResNetV2]
+resnet_block_versions = [{"basic_block": BasicBlockV1,
+                          "bottle_neck": BottleneckV1},
+                         {"basic_block": BasicBlockV2,
+                          "bottle_neck": BottleneckV2}]
+
+
+def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
+    _check_pretrained(pretrained)
+    block_type, layers, channels = resnet_spec[num_layers]
+    resnet_class = resnet_net_versions[version - 1]
+    block_class = resnet_block_versions[version - 1][block_type]
+    return resnet_class(block_class, layers, channels, **kwargs)
+
+
+def resnet18_v1(**kw):
+    return get_resnet(1, 18, **kw)
+
+
+def resnet34_v1(**kw):
+    return get_resnet(1, 34, **kw)
+
+
+def resnet50_v1(**kw):
+    return get_resnet(1, 50, **kw)
+
+
+def resnet101_v1(**kw):
+    return get_resnet(1, 101, **kw)
+
+
+def resnet152_v1(**kw):
+    return get_resnet(1, 152, **kw)
+
+
+def resnet18_v2(**kw):
+    return get_resnet(2, 18, **kw)
+
+
+def resnet34_v2(**kw):
+    return get_resnet(2, 34, **kw)
+
+
+def resnet50_v2(**kw):
+    return get_resnet(2, 50, **kw)
+
+
+def resnet101_v2(**kw):
+    return get_resnet(2, 101, **kw)
+
+
+def resnet152_v2(**kw):
+    return get_resnet(2, 152, **kw)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet
+# ---------------------------------------------------------------------------
+
+def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
+    out = nn.HybridSequential(prefix="")
+    out.add(_FireConv(squeeze_channels, 1, None))
+    out.add(_FireExpand(expand1x1_channels, expand3x3_channels))
+    return out
+
+
+class _FireConv(HybridBlock):
+    def __init__(self, channels, kernel, pad, **kwargs):
+        super().__init__(**kwargs)
+        self.conv = nn.Conv2D(channels, kernel, padding=pad or 0)
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(self.conv(x), act_type="relu")
+
+
+class _FireExpand(HybridBlock):
+    def __init__(self, e1, e3, **kwargs):
+        super().__init__(**kwargs)
+        self.conv1 = nn.Conv2D(e1, 1)
+        self.conv3 = nn.Conv2D(e3, 3, padding=1)
+
+    def hybrid_forward(self, F, x):
+        a = F.Activation(self.conv1(x), act_type="relu")
+        b = F.Activation(self.conv3(x), act_type="relu")
+        return F.Concat(a, b, dim=1)
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        assert version in ("1.0", "1.1")
+        self.features = nn.HybridSequential(prefix="")
+        if version == "1.0":
+            self.features.add(nn.Conv2D(96, 7, 2, activation="relu"))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            for spec in [(16, 64, 64), (16, 64, 64), (32, 128, 128)]:
+                self.features.add(_make_fire(*spec))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            for spec in [(32, 128, 128), (48, 192, 192), (48, 192, 192),
+                         (64, 256, 256)]:
+                self.features.add(_make_fire(*spec))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_make_fire(64, 256, 256))
+        else:
+            self.features.add(nn.Conv2D(64, 3, 2, activation="relu"))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_make_fire(16, 64, 64))
+            self.features.add(_make_fire(16, 64, 64))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_make_fire(32, 128, 128))
+            self.features.add(_make_fire(32, 128, 128))
+            self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_make_fire(48, 192, 192))
+            self.features.add(_make_fire(48, 192, 192))
+            self.features.add(_make_fire(64, 256, 256))
+            self.features.add(_make_fire(64, 256, 256))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.HybridSequential(prefix="")
+        self.output.add(nn.Conv2D(classes, 1, activation="relu"))
+        self.output.add(nn.GlobalAvgPool2D())
+        self.output.add(nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return SqueezeNet("1.1", **kw)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(HybridBlock):
+    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = nn.Conv2D(bn_size * growth_rate, 1, use_bias=False)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = nn.Conv2D(growth_rate, 3, padding=1, use_bias=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        out = self.conv1(F.Activation(self.bn1(x), act_type="relu"))
+        out = self.conv2(F.Activation(self.bn2(out), act_type="relu"))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return F.Concat(x, out, dim=1)
+
+
+class _Transition(HybridBlock):
+    def __init__(self, num_output_features, **kwargs):
+        super().__init__(**kwargs)
+        self.bn = nn.BatchNorm()
+        self.conv = nn.Conv2D(num_output_features, 1, use_bias=False)
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def hybrid_forward(self, F, x):
+        return self.pool(self.conv(F.Activation(self.bn(x), act_type="relu")))
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential(prefix="")
+        self.features.add(nn.Conv2D(num_init_features, 7, 2, 3, use_bias=False))
+        self.features.add(nn.BatchNorm())
+        self.features.add(nn.Activation("relu"))
+        self.features.add(nn.MaxPool2D(3, 2, 1))
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            block = nn.HybridSequential(prefix="stage%d_" % (i + 1))
+            for _ in range(num_layers):
+                block.add(_DenseLayer(growth_rate, bn_size, dropout, prefix=""))
+            self.features.add(block)
+            num_features = num_features + num_layers * growth_rate
+            if i != len(block_config) - 1:
+                self.features.add(_Transition(num_features // 2))
+                num_features = num_features // 2
+        self.features.add(nn.BatchNorm())
+        self.features.add(nn.Activation("relu"))
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
+                 161: (96, 48, [6, 12, 36, 24]),
+                 169: (64, 32, [6, 12, 32, 32]),
+                 201: (64, 32, [6, 12, 48, 32])}
+
+
+def get_densenet(num_layers, pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
+    return DenseNet(num_init_features, growth_rate, block_config, **kw)
+
+
+def densenet121(**kw):
+    return get_densenet(121, **kw)
+
+
+def densenet161(**kw):
+    return get_densenet(161, **kw)
+
+
+def densenet169(**kw):
+    return get_densenet(169, **kw)
+
+
+def densenet201(**kw):
+    return get_densenet(201, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Inception v3
+# ---------------------------------------------------------------------------
+
+def _make_basic_conv(channels, kernel_size, strides=1, padding=0):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(channels, kernel_size, strides, padding, use_bias=False))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+class _Branching(HybridBlock):
+    def __init__(self, branches, dim=1, **kwargs):
+        super().__init__(**kwargs)
+        self._dim = dim
+        for i, b in enumerate(branches):
+            self.register_child(b, "branch%d" % i)
+
+    def hybrid_forward(self, F, x):
+        outs = [b(x) for b in self._children.values()]
+        out = outs[0]
+        for o in outs[1:]:
+            out = F.Concat(out, o, dim=self._dim)
+        return out
+
+
+def _make_branch(use_pool, *conv_settings):
+    out = nn.HybridSequential(prefix="")
+    if use_pool == "avg":
+        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif use_pool == "max":
+        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    for setting in conv_settings:
+        out.add(_make_basic_conv(*setting))
+    return out
+
+
+class Inception3(HybridBlock):
+    """Simplified Inception-v3 trunk with the reference stage structure."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        f = nn.HybridSequential(prefix="")
+        f.add(_make_basic_conv(32, 3, 2))
+        f.add(_make_basic_conv(32, 3))
+        f.add(_make_basic_conv(64, 3, padding=1))
+        f.add(nn.MaxPool2D(3, 2))
+        f.add(_make_basic_conv(80, 1))
+        f.add(_make_basic_conv(192, 3))
+        f.add(nn.MaxPool2D(3, 2))
+        # inception A x3
+        for pool_features in (32, 64, 64):
+            f.add(_Branching([
+                _make_branch(None, (64, 1)),
+                _make_branch(None, (48, 1), (64, 5, 1, 2)),
+                _make_branch(None, (64, 1), (96, 3, 1, 1), (96, 3, 1, 1)),
+                _make_branch("avg", (pool_features, 1))]))
+        # reduction A
+        f.add(_Branching([
+            _make_branch(None, (384, 3, 2)),
+            _make_branch(None, (64, 1), (96, 3, 1, 1), (96, 3, 2)),
+            _make_branch("max")]))
+        # inception B x4
+        for c7 in (128, 160, 160, 192):
+            f.add(_Branching([
+                _make_branch(None, (192, 1)),
+                _make_branch(None, (c7, 1), (c7, (1, 7), 1, (0, 3)),
+                             (192, (7, 1), 1, (3, 0))),
+                _make_branch(None, (c7, 1), (c7, (7, 1), 1, (3, 0)),
+                             (c7, (1, 7), 1, (0, 3)), (c7, (7, 1), 1, (3, 0)),
+                             (192, (1, 7), 1, (0, 3))),
+                _make_branch("avg", (192, 1))]))
+        # reduction B
+        f.add(_Branching([
+            _make_branch(None, (192, 1), (320, 3, 2)),
+            _make_branch(None, (192, 1), (192, (1, 7), 1, (0, 3)),
+                         (192, (7, 1), 1, (3, 0)), (192, 3, 2)),
+            _make_branch("max")]))
+        # inception C x2
+        for _ in range(2):
+            f.add(_Branching([
+                _make_branch(None, (320, 1)),
+                _make_branch(None, (384, 1)),
+                _make_branch(None, (448, 1), (384, 3, 1, 1)),
+                _make_branch("avg", (192, 1))]))
+        f.add(nn.AvgPool2D(pool_size=8))
+        f.add(nn.Dropout(0.5))
+        self.features = f
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def inception_v3(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return Inception3(**kw)
+
+
+# ---------------------------------------------------------------------------
+# MobileNet v1 / v2
+# ---------------------------------------------------------------------------
+
+def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
+              active=True, relu6=False):
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
+                      use_bias=False))
+    out.add(nn.BatchNorm(scale=True))
+    if active:
+        out.add(_ReLU6() if relu6 else nn.Activation("relu"))
+
+
+class _ReLU6(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.clip(x, a_min=0.0, a_max=6.0)
+
+
+def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
+    _add_conv(out, dw_channels, kernel=3, stride=stride, pad=1,
+              num_group=dw_channels, relu6=relu6)
+    _add_conv(out, channels, relu6=relu6)
+
+
+class LinearBottleneck(HybridBlock):
+    def __init__(self, in_channels, channels, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        self.out = nn.HybridSequential(prefix="")
+        _add_conv(self.out, in_channels * t, relu6=True)
+        _add_conv(self.out, in_channels * t, kernel=3, stride=stride, pad=1,
+                  num_group=in_channels * t, relu6=True)
+        _add_conv(self.out, channels, active=False, relu6=True)
+
+    def hybrid_forward(self, F, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+class MobileNet(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential(prefix="")
+        _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2, pad=1)
+        dw_channels = [int(x * multiplier) for x in
+                       [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
+        channels = [int(x * multiplier) for x in
+                    [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
+        strides = [1, 2] * 3 + [1] * 5 + [2, 1]
+        for dwc, c, s in zip(dw_channels, channels, strides):
+            _add_conv_dw(self.features, dwc, c, s)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential(prefix="features_")
+        _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2,
+                  pad=1, relu6=True)
+        in_channels_group = [int(x * multiplier) for x in
+                             [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4
+                             + [96] * 3 + [160] * 3]
+        channels_group = [int(x * multiplier) for x in
+                          [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
+                          + [160] * 3 + [320]]
+        ts = [1] + [6] * 16
+        strides = [1, 2] * 2 + [1, 1, 2] + [1] * 6 + [2] + [1] * 3
+        for in_c, c, t, s in zip(in_channels_group, channels_group, ts, strides):
+            self.features.add(LinearBottleneck(in_channels=in_c, channels=c,
+                                               t=t, stride=s, prefix=""))
+        last_channels = int(1280 * multiplier) if multiplier > 1.0 else 1280
+        _add_conv(self.features, last_channels, relu6=True)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.output = nn.HybridSequential(prefix="output_")
+        self.output.add(nn.Conv2D(classes, 1, use_bias=False, prefix="pred_"))
+        self.output.add(nn.Flatten())
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def mobilenet1_0(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return MobileNet(1.0, **kw)
+
+
+def mobilenet0_75(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return MobileNet(0.75, **kw)
+
+
+def mobilenet0_5(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return MobileNet(0.5, **kw)
+
+
+def mobilenet0_25(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return MobileNet(0.25, **kw)
+
+
+def mobilenet_v2_1_0(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return MobileNetV2(1.0, **kw)
+
+
+def mobilenet_v2_0_75(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return MobileNetV2(0.75, **kw)
+
+
+def mobilenet_v2_0_5(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return MobileNetV2(0.5, **kw)
+
+
+def mobilenet_v2_0_25(pretrained=False, **kw):
+    _check_pretrained(pretrained)
+    return MobileNetV2(0.25, **kw)
+
+
+_models = {"alexnet": alexnet,
+           "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+           "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
+           "vgg19_bn": vgg19_bn,
+           "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+           "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+           "resnet152_v1": resnet152_v1,
+           "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2,
+           "resnet50_v2": resnet50_v2, "resnet101_v2": resnet101_v2,
+           "resnet152_v2": resnet152_v2,
+           "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+           "densenet121": densenet121, "densenet161": densenet161,
+           "densenet169": densenet169, "densenet201": densenet201,
+           "inceptionv3": inception_v3,
+           "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+           "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+           "mobilenetv2_1.0": mobilenet_v2_1_0,
+           "mobilenetv2_0.75": mobilenet_v2_0_75,
+           "mobilenetv2_0.5": mobilenet_v2_0_5,
+           "mobilenetv2_0.25": mobilenet_v2_0_25}
+
+
+def get_model(name, **kwargs):
+    """reference: model_zoo/__init__.py get_model."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError("Model %s is not supported. Available: %s"
+                         % (name, sorted(_models)))
+    return _models[name](**kwargs)

@@ -1,0 +1,8 @@
+"""Gluon neural-network layers (reference: python/mxnet/gluon/nn/)."""
+from ..block import Block, HybridBlock, SymbolBlock
+from .basic_layers import *
+from .conv_layers import *
+from .basic_layers import __all__ as _basic_all
+from .conv_layers import __all__ as _conv_all
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"] + _basic_all + _conv_all

@@ -1,0 +1,382 @@
+"""Gluon Parameter / ParameterDict (reference: python/mxnet/gluon/parameter.py, 796 LoC).
+
+Deferred initialization: a Parameter created with unknown dims (0 in shape)
+postpones allocation until the first forward pass reveals the input shape —
+layers call `_finish_deferred_init` once shapes are known.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, zeros, array
+from .. import initializer as init_mod
+from .. import imperative as _imp
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    """reference: gluon/parameter.py Parameter."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None   # list of NDArray per ctx
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req if differentiable else "null"
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._stype = stype
+        self._grad_stype = grad_stype
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self._shape,
+                                                      self.dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown_ok = all(s1 in (0, s2) for s1, s2 in zip(self._shape, new_shape)) \
+            and len(self._shape) == len(new_shape)
+        if not unknown_ok:
+            raise MXNetError("Cannot change shape of Parameter %s from %s to %s"
+                             % (self.name, self._shape, new_shape))
+        self._shape = tuple(new_shape)
+
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if self._shape is None or any(s == 0 for s in self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError("Cannot initialize Parameter %s because it has "
+                             "invalid shape %s." % (self.name, self._shape))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        data = zeros(self._shape, ctx=ctx[0], dtype=self.dtype)
+        initializer = init or self.init or default_init
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
+        initializer(init_mod.InitDesc(self.name), data)
+        self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._ctx_list = list(ctx_list)
+        self._data = [data.copyto(c) if c != data.context else data
+                      for c in self._ctx_list]
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = [zeros(self._shape, ctx=c, dtype=self.dtype)
+                      for c in self._ctx_list]
+        for d, g in zip(self._data, self._grad):
+            _imp.mark_variables([d], [g], self.grad_req)
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init = self._deferred_init
+        if self._shape is None or any(s == 0 for s in self._shape):
+            raise DeferredInitializationError(
+                "Parameter %s has unknown shape %s" % (self.name, self._shape))
+        self._deferred_init = ()
+        self._finish_init(init, ctx, default_init)
+
+    # ------------------------------------------------------------------
+    def _check_and_get(self, arr_list, ctx):
+        if arr_list is not None:
+            if ctx is list:
+                return arr_list
+            if ctx is None:
+                if len(arr_list) == 1:
+                    return arr_list[0]
+                ctx = current_context()
+            for c, a in zip(self._ctx_list, arr_list):
+                if c == ctx:
+                    return a
+            # fall back to first copy (device-flexible under jax)
+            return arr_list[0]
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter %s has not been initialized yet because initialization "
+                "was deferred. Actual initialization happens during the first "
+                "forward pass." % self.name)
+        raise MXNetError(
+            "Parameter %s has not been initialized. You should initialize "
+            "parameters with Block.initialize()." % self.name)
+
+    def data(self, ctx=None):
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return self._check_and_get(self._data, list)
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise MXNetError("Cannot get gradient array for Parameter %s because "
+                             "grad_req='null'" % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        if self._data is not None and self._grad is None:
+            raise MXNetError("grad_req='null' for %s" % self.name)
+        return self._check_and_get(self._grad, list)
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise MXNetError("Parameter %s not initialized" % self.name)
+        return self._ctx_list
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            if self._deferred_init:
+                self._deferred_init = ()
+                ctx = self._ctx_list or [current_context()]
+                self._init_impl(array(data), ctx)
+                return
+            raise MXNetError("Parameter %s not initialized" % self.name)
+        for arr in self._data:
+            if isinstance(data, NDArray):
+                data.copyto(arr)
+            else:
+                arr[:] = data
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._grad:
+            g[:] = 0
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            data = self._data[0]
+            self._init_impl(data, ctx)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with _imp_pause():
+            self._data = [d.astype(dtype) for d in self._data]
+            if self._grad is not None:
+                self._grad = [g.astype(dtype) for g in self._grad]
+                for d, g in zip(self._data, self._grad):
+                    _imp.mark_variables([d], [g], self.grad_req)
+
+    def var(self):
+        from .. import symbol as sym_mod
+        if self._var is None:
+            self._var = sym_mod.Variable(self.name, shape=self._shape,
+                                         dtype=self.dtype, lr_mult=self.lr_mult,
+                                         wd_mult=self.wd_mult)
+        return self._var
+
+
+def _imp_pause():
+    from ..autograd import pause
+    return pause()
+
+
+class Constant(Parameter):
+    """reference: gluon/parameter.py Constant — non-trainable fixed value."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = array(value)
+        self.value = value
+
+        class _Init(init_mod.Initializer):
+            def _init_weight(self2, _, arr):
+                value.copyto(arr)
+
+            def _init_default(self2, _, arr):
+                value.copyto(arr)
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_Init())
+
+
+class ParameterDict:
+    """reference: gluon/parameter.py ParameterDict."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __repr__(self):
+        return "%s(\n%s\n)" % (type(self).__name__,
+                               "\n".join("  " + repr(p)
+                                         for p in self._params.values()))
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                existing = getattr(param, k, None)
+                if existing is None or v is None:
+                    if v is not None:
+                        setattr(param, k, v)
+                    continue
+                if k == "shape" and len(v) == len(existing):
+                    # merge unknown (0) dims; conflicting known dims are an error
+                    if not all(a in (0, b) or b == 0
+                               for a, b in zip(v, existing)):
+                        raise MXNetError(
+                            "Parameter %s exists with shape %s, requested %s"
+                            % (name, existing, v))
+                    param._shape = tuple(a if a != 0 else b
+                                         for a, b in zip(v, existing))
+                elif k == "init":
+                    pass  # keep the original initializer
+                elif k == "dtype":
+                    if _np.dtype(v) != _np.dtype(existing):
+                        raise MXNetError(
+                            "Parameter %s exists with dtype=%s, requested %s"
+                            % (name, existing, v))
+                elif k == "grad_req" and v != existing:
+                    raise MXNetError(
+                        "Parameter %s exists with grad_req=%s, requested %s"
+                        % (name, existing, v))
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError("No constant named %s" % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError("Cannot update self with other because they "
+                                 "have different Parameters with the same name %s"
+                                 % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        init = init or init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        import numpy as np
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data() if param._data is not None else None
+            if weight is None:
+                continue
+            name = param.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg_dict[name] = weight.asnumpy()
+        np.savez(filename, **arg_dict)
+        import os
+        if os.path.exists(filename + ".npz"):
+            os.replace(filename + ".npz", filename)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        import numpy as np
+        loaded = np.load(filename, allow_pickle=False)
+        data = {restore_prefix + k: loaded[k] for k in loaded.files}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in data:
+                    raise MXNetError("Parameter %s is missing in file %s"
+                                     % (name, filename))
+        for name, arr in data.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError("Parameter %s loaded from file %s is not "
+                                     "present in ParameterDict" % (name, filename))
+                continue
+            param = self._params[name]
+            if param._data is None and not param._deferred_init:
+                param._shape = arr.shape
+                param.initialize(ctx=ctx or [current_context()])
+            param.set_data(array(arr))

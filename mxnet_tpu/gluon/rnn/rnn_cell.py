@@ -1,0 +1,385 @@
+"""Gluon RNN cells (reference: python/mxnet/gluon/rnn/rnn_cell.py, 978 LoC).
+
+Explicit per-step cells + unroll. Gate packing order matches the fused RNN op
+(ops/nn.py): LSTM [i, f, g, o], GRU [r, z, n].
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called directly."
+        from ... import ndarray as nd_mod
+        if func is None:
+            func = nd_mod.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """reference: rnn_cell.py unroll."""
+        from ... import ndarray as nd_mod
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if not isinstance(inputs, (list, tuple)):
+            batch_size = inputs.shape[batch_axis]
+            split = nd_mod.split(inputs, num_outputs=length, axis=axis,
+                                 squeeze_axis=True)
+            inputs = split if isinstance(split, list) else [split]
+        else:
+            batch_size = inputs[0].shape[0]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        if valid_length is not None:
+            stacked = nd_mod.stack(*outputs, axis=axis)
+            masked = nd_mod.SequenceMask(stacked, valid_length,
+                                         use_sequence_length=True, axis=axis)
+            if merge_outputs is False:
+                outputs = nd_mod.split(masked, num_outputs=length, axis=axis,
+                                       squeeze_axis=True)
+                if not isinstance(outputs, list):
+                    outputs = [outputs]
+            else:
+                outputs = masked
+        elif merge_outputs:
+            outputs = nd_mod.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def forward(self, x, states):
+        self._counter += 1
+        return self._cell_forward(x, states)
+
+    def _cell_forward(self, x, states):
+        from ..parameter import DeferredInitializationError
+        params = {}
+        for _, p in sorted(self._reg_params.items()):
+            from ..block import _get_override, _strip_prefix
+            ov = _get_override(p.name)
+            try:
+                params[_strip_prefix(p.name, self.prefix)] = \
+                    ov if ov is not None else p.data()
+            except DeferredInitializationError:
+                self._pin_shapes(x, states)
+                for _, pp in self._reg_params.items():
+                    if pp._deferred_init:
+                        pp._finish_deferred_init()
+                params[_strip_prefix(p.name, self.prefix)] = p.data()
+        from ... import ndarray as nd_mod
+        return self.hybrid_forward(nd_mod, x, states, **params)
+
+    def __call__(self, x, states):
+        return self.forward(x, states)
+
+
+class _BaseRNNCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = self._num_gates()
+        self.i2h_weight = self.params.get("i2h_weight",
+                                          shape=(ng * hidden_size, input_size),
+                                          init=i2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.h2h_weight = self.params.get("h2h_weight",
+                                          shape=(ng * hidden_size, hidden_size),
+                                          init=h2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(ng * hidden_size,),
+                                        init=i2h_bias_initializer,
+                                        allow_deferred_init=True)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(ng * hidden_size,),
+                                        init=h2h_bias_initializer,
+                                        allow_deferred_init=True)
+
+    def _num_gates(self):
+        raise NotImplementedError
+
+    def _pin_shapes(self, x, *states):
+        if self._input_size == 0:
+            self._input_size = x.shape[-1]
+            self.i2h_weight.shape = (self._num_gates() * self._hidden_size,
+                                     self._input_size)
+
+
+class RNNCell(_BaseRNNCell):
+    def __init__(self, hidden_size, activation="tanh", **kwargs):
+        super().__init__(hidden_size, **kwargs)
+        self._activation = activation
+
+    def _num_gates(self):
+        return 1
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(_BaseRNNCell):
+    def _num_gates(self):
+        return 4
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        H = self._hidden_size
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=4 * H)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias, num_hidden=4 * H)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slices[0])
+        forget_gate = F.sigmoid(slices[1])
+        in_transform = F.tanh(slices[2])
+        out_gate = F.sigmoid(slices[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * F.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(_BaseRNNCell):
+    def _num_gates(self):
+        return 3
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        H = self._hidden_size
+        prev = states[0]
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=3 * H)
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias, num_hidden=3 * H)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        next_h_tmp = F.tanh(i2h_n + reset * h2h_n)
+        next_h = (1.0 - update) * next_h_tmp + update * prev
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return sum([c.state_info(batch_size)
+                    for c in self._children.values()], [])
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return sum([c.begin_state(batch_size, func, **kwargs)
+                    for c in self._children.values()], [])
+
+    def __call__(self, x, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[p:p + n]
+            p += n
+            x, new_state = cell(x, state)
+            next_states.extend(new_state)
+        return x, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + "modifier_")
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def __call__(self, x, states):
+        from ... import ndarray as nd_mod
+        if self._rate > 0:
+            x = nd_mod.Dropout(x, p=self._rate, axes=self._axes)
+        return x, states
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout"
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def __call__(self, x, states):
+        from ... import ndarray as nd_mod
+        from ... import imperative as _imp
+        cell = self.base_cell
+        next_output, next_states = cell(x, states)
+        if not _imp.is_training():
+            return next_output, next_states
+        p_outputs, p_states = self.zoneout_outputs, self.zoneout_states
+        prev_output = self._prev_output
+        if prev_output is None:
+            prev_output = nd_mod.zeros_like(next_output)
+
+        def mask(p, like):
+            return nd_mod.Dropout(nd_mod.ones_like(like), p=p)
+
+        output = (nd_mod.where(mask(p_outputs, next_output), next_output,
+                               prev_output)
+                  if p_outputs != 0.0 else next_output)
+        new_states = ([nd_mod.where(mask(p_states, new_s), new_s, old_s)
+                       for new_s, old_s in zip(next_states, states)]
+                      if p_states != 0.0 else next_states)
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    def __call__(self, x, states):
+        output, states = self.base_cell(x, states)
+        output = output + x
+        return output, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+        self._output_prefix = output_prefix
+
+    def state_info(self, batch_size=0):
+        return (self._children["l_cell"].state_info(batch_size)
+                + self._children["r_cell"].state_info(batch_size))
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return (self._children["l_cell"].begin_state(batch_size, func, **kwargs)
+                + self._children["r_cell"].begin_state(batch_size, func, **kwargs))
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell can only be called with unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd_mod
+        self.reset()
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            batch_size = inputs.shape[layout.find("N")]
+            inputs = nd_mod.split(inputs, num_outputs=length, axis=axis,
+                                  squeeze_axis=True)
+            if not isinstance(inputs, list):
+                inputs = [inputs]
+        else:
+            batch_size = inputs[0].shape[0]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        n_l = len(l_cell.state_info())
+
+        def _reverse_seq(seq_list):
+            """valid_length-aware reversal (reference: SequenceReverse with
+            sequence_length) — padding must stay at the tail."""
+            if valid_length is None:
+                return list(reversed(seq_list))
+            stacked = nd_mod.stack(*seq_list, axis=0)  # (T, N, ...)
+            rev = nd_mod.SequenceReverse(stacked, valid_length,
+                                         use_sequence_length=True)
+            out = nd_mod.split(rev, num_outputs=len(seq_list), axis=0,
+                               squeeze_axis=True)
+            return out if isinstance(out, list) else [out]
+
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs, begin_state[:n_l], layout, merge_outputs=False,
+            valid_length=valid_length)
+        r_outputs, r_states = r_cell.unroll(
+            length, _reverse_seq(inputs), begin_state[n_l:], layout,
+            merge_outputs=False, valid_length=valid_length)
+        if not isinstance(r_outputs, list):
+            r_outputs = nd_mod.split(r_outputs, num_outputs=length, axis=axis,
+                                     squeeze_axis=True)
+            if not isinstance(r_outputs, list):
+                r_outputs = [r_outputs]
+        if not isinstance(l_outputs, list):
+            l_outputs = nd_mod.split(l_outputs, num_outputs=length, axis=axis,
+                                     squeeze_axis=True)
+            if not isinstance(l_outputs, list):
+                l_outputs = [l_outputs]
+        r_outputs = _reverse_seq(r_outputs)
+        outputs = [nd_mod.concat(l_o, r_o, dim=1)
+                   for l_o, r_o in zip(l_outputs, r_outputs)]
+        if merge_outputs:
+            outputs = nd_mod.stack(*outputs, axis=axis)
+        return outputs, l_states + r_states

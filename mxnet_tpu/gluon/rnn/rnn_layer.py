@@ -1,0 +1,174 @@
+"""Gluon recurrent layers riding the fused RNN op.
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py (523 LoC) — RNN/LSTM/GRU wrap the
+fused `RNN` op (src/operator/rnn-inl.h). Here the fused op is the lax.scan
+formulation in ops/nn.py; parameter packing must match rnn_param_size there.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ...ops.nn import rnn_param_size, _gates
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNParamInit:
+    """Initialize the packed RNN parameter vector: weights ~ uniform(+-0.07)
+    (or the given initializer's scale), biases zero. Packing layout matches
+    rnn_param_size in ops/nn.py: all weights first, then all biases."""
+
+    def __init__(self, mode, hidden_size, num_layers, bidirectional,
+                 weight_init=None):
+        self.mode = mode
+        self.hidden = hidden_size
+        self.layers = num_layers
+        self.dirs = 2 if bidirectional else 1
+        self.weight_init = weight_init
+
+    def __call__(self, desc, arr):
+        import numpy as np
+        from ...ndarray.ndarray import array
+        g = _gates(self.mode)
+        total = arr.shape[0]
+        n_bias = self.layers * self.dirs * 2 * g * self.hidden
+        n_weight = total - n_bias
+        scale = 0.07
+        out = np.zeros(total, dtype=np.float32)
+        out[:n_weight] = np.random.uniform(-scale, scale, n_weight)
+        arr[:] = array(out)
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+
+        n = rnn_param_size(mode, input_size, hidden_size, num_layers,
+                           bidirectional) if input_size else 0
+        self.parameters = self.params.get(
+            "rnn_param", shape=(n,) if n else (0,),
+            init=_RNNParamInit(mode, hidden_size, num_layers,
+                               bidirectional, i2h_weight_initializer),
+            allow_deferred_init=True)
+
+    def _pin_shapes(self, x, *states):
+        if self._input_size == 0:
+            self._input_size = x.shape[-1] if self._layout == "TNC" else x.shape[2]
+            n = rnn_param_size(self._mode, self._input_size, self._hidden_size,
+                               self._num_layers, self._dir == 2)
+            self.parameters.shape = (n,)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial hidden states (reference: rnn_layer.py begin_state)."""
+        from ... import ndarray as nd_mod
+        if func is None:
+            func = nd_mod.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def hybrid_forward(self, F, x, *states, **params):
+        parameters = params["parameters"]  # kwarg = registration attribute name
+        if self._layout == "NTC":
+            x = F.swapaxes(x, dim1=0, dim2=1)
+        batch_size = x.shape[1] if hasattr(x, "shape") else 0
+        if not states:
+            states = self._default_states(F, x)
+        skip_states = getattr(self, "_skip_states", False)
+        out = F.RNN(x, parameters, *states, state_size=self._hidden_size,
+                    num_layers=self._num_layers, bidirectional=self._dir == 2,
+                    mode=self._mode, p=self._dropout, state_outputs=True)
+        outputs, out_states = out[0], list(out[1:])
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        if skip_states:
+            return outputs
+        return outputs, out_states
+
+    def _default_states(self, F, x):
+        shape = (self._num_layers * self._dir, x.shape[1], self._hidden_size)
+        from ... import ndarray as nd_mod
+        if F is nd_mod:
+            n = 2 if self._mode == "lstm" else 1
+            return tuple(nd_mod.zeros(shape) for _ in range(n))
+        from ... import symbol as sym_mod
+        n = 2 if self._mode == "lstm" else 1
+        return tuple(sym_mod.zeros(shape) for _ in range(n))
+
+    def forward(self, x, *states):
+        """Accept optional states; return output or (output, states) like gluon."""
+        self._skip_states = len(states) == 0
+        out = super().forward(x, *states)
+        return out
+
+    def __repr__(self):
+        return "{}({}, {}, num_layers={})".format(
+            type(self).__name__, self._input_size or "?", self._hidden_size,
+            self._num_layers)
+
+
+class RNN(_RNNLayer):
+    """reference: rnn_layer.py RNN (relu/tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

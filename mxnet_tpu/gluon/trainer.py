@@ -1,0 +1,160 @@
+"""Gluon Trainer (reference: python/mxnet/gluon/trainer.py:27, 239 LoC)."""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from ..model import _create_kvstore
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("First argument must be a list or dict of Parameters, "
+                             "got %s." % type(params))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError("First argument must be a list or dict of "
+                                 "Parameters, got list of %s." % type(param))
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_initialized = False
+        self._kvstore_arg = kvstore
+        self._update_on_kvstore_arg = update_on_kvstore
+        self._kvstore = None
+        self._update_on_kvstore = None
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data is not None or \
+                param._deferred_init else None
+            if ctx is None:
+                continue
+            if contexts is None:
+                contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        """reference: trainer.py:108 — kvstore decision."""
+        arg_arrays = {param.name: param.data(param.list_ctx()[0])
+                      for param in self._params if param._data is not None}
+        n_devices = max(len(param.list_ctx()) for param in self._params) \
+            if self._params else 1
+        kvstore, update_on_kvstore = _create_kvstore(self._kvstore_arg, n_devices,
+                                                     arg_arrays)
+        if self._update_on_kvstore_arg is not None:
+            update_on_kvstore = self._update_on_kvstore_arg
+        if kvstore:
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+            for i, param in enumerate(self._params):
+                if param._data is None:
+                    continue
+                kvstore.init(i, param.data(param.list_ctx()[0]))
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore if kvstore else False
+        # one updater per device replica (reference: trainer.py — per-device
+        # updaters keep optimizer state separate per copy)
+        self._updaters = [opt_mod.get_updater(self._optimizer)
+                          for _ in range(n_devices)]
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """reference: trainer.py:157 — scaled grads -> push/pull or local update."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            grads = param.list_grad()
+            if self._update_on_kvstore:
+                # push grads; optimizer runs on the store; pull weights back
+                self._kvstore.push(i, grads, priority=-i)
+                self._kvstore.pull(i, param.list_data(), priority=-i)
+            else:
+                self._kvstore.push(i, grads, priority=-i)
+                self._kvstore.pull(i, grads, priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        assert not self._update_on_kvstore, \
+            "update() is not supported when update_on_kvstore is set"
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore and self._kvstore is not None:
+            return  # weights already updated by the store in _allreduce_grads
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                upd(i, grad, arr)
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if self._update_on_kvstore and self._kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and self._kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._optimizer

@@ -1,0 +1,237 @@
+"""Gluon tests (reference: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.util.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=[mx.cpu()])
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert len(p.list_data()) == 1
+
+
+def test_paramdict():
+    params = gluon.ParameterDict("net_")
+    params.get("weight", shape=(10, 10))
+    assert list(params.keys()) == ["net_weight"]
+    params.initialize(ctx=mx.cpu())
+    params.save("/tmp/test_paramdict.params")
+    params.load("/tmp/test_paramdict.params", mx.cpu())
+
+
+def test_dense():
+    net = nn.Dense(5, in_units=3)
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(4, 3))
+    out = net(x)
+    assert out.shape == (4, 5)
+    expect = x.asnumpy() @ net.weight.data().asnumpy().T + net.bias.data().asnumpy()
+    assert_almost_equal(out.asnumpy(), expect, rtol=1e-4)
+
+
+def test_dense_deferred():
+    net = nn.Dense(5)  # in_units unknown
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(4, 7))
+    out = net(x)
+    assert out.shape == (4, 5)
+    assert net.weight.shape == (5, 7)
+
+
+def test_sequential():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.random.uniform(shape=(2, 10))
+    out = net(x)
+    assert out.shape == (2, 4)
+
+
+def test_hybridize_consistency():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.normal(size=(3, 8)).astype(np.float32))
+    out_eager = net(x).asnumpy()
+    net.hybridize()
+    out_hybrid = net(x).asnumpy()
+    assert_almost_equal(out_eager, out_hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_hybridize_grad():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(1))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.random.uniform(shape=(4, 5))
+    with autograd.record():
+        out = net(x).sum()
+    out.backward()
+    w_grad = net[0].weight.grad()
+    assert w_grad.shape == net[0].weight.shape
+    assert float(np.abs(w_grad.asnumpy()).sum()) > 0
+
+
+def test_trainer_step():
+    net = nn.Dense(1, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.ones((2, 4))
+    y = mx.nd.zeros((2, 1))
+    loss_fn = gluon.loss.L2Loss()
+    w_before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(batch_size=2)
+    assert not np.allclose(w_before, net.weight.data().asnumpy())
+
+
+def test_gluon_training_converges():
+    np.random.seed(0)
+    mx.random.seed(0)
+    X = np.random.normal(size=(200, 4)).astype(np.float32)
+    w_true = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    y = X @ w_true
+    net = nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    data = mx.nd.array(X)
+    label = mx.nd.array(y.reshape(-1, 1))
+    for _ in range(200):
+        with autograd.record():
+            loss = loss_fn(net(data), label)
+        loss.backward()
+        trainer.step(batch_size=200)
+    final = float(loss.mean().asscalar())
+    assert final < 1e-2, "did not converge: %f" % final
+    assert_almost_equal(net.weight.data().asnumpy().ravel(), w_true,
+                        rtol=0.1, atol=0.05)
+
+
+def test_conv_layers():
+    x = mx.nd.random.uniform(shape=(2, 3, 16, 16))
+    conv = nn.Conv2D(8, kernel_size=3, padding=1)
+    conv.initialize()
+    assert conv(x).shape == (2, 8, 16, 16)
+    pool = nn.MaxPool2D()
+    assert pool(x).shape == (2, 3, 8, 8)
+    gp = nn.GlobalAvgPool2D()
+    assert gp(x).shape == (2, 3, 1, 1)
+
+
+def test_batchnorm_layer():
+    x = mx.nd.random.normal(shape=(4, 3, 8, 8))
+    bn = nn.BatchNorm()
+    bn.initialize()
+    with autograd.record():
+        out = bn(x)
+    assert out.shape == x.shape
+    assert bn.gamma.shape == (3,)
+    # running stats updated after training forward
+    assert float(np.abs(bn.running_mean.data().asnumpy()).sum()) > 0
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array([1, 2, 3])
+    assert emb(idx).shape == (3, 4)
+
+
+def test_losses():
+    pred = mx.nd.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    label = mx.nd.array([2, 1])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    p = np.exp(pred.asnumpy())
+    p = p / p.sum(-1, keepdims=True)
+    expect = -np.log(p[[0, 1], [2, 1]])
+    assert_almost_equal(l.asnumpy(), expect, rtol=1e-4)
+
+    l1 = gluon.loss.L1Loss()(pred, pred + 1)
+    assert_almost_equal(l1.asnumpy(), np.ones(2), rtol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(pred, pred)
+    assert_almost_equal(l2.asnumpy(), np.zeros(2))
+
+
+def test_lstm_layer():
+    lstm = gluon.rnn.LSTM(hidden_size=8, num_layers=2)
+    lstm.initialize()
+    x = mx.nd.random.uniform(shape=(5, 3, 4))  # TNC
+    out = lstm(x)  # no states passed -> output only (gluon semantics)
+    assert out.shape == (5, 3, 8)
+    states = lstm.begin_state(batch_size=3)
+    out, new_states = lstm(x, *states)
+    assert out.shape == (5, 3, 8)
+    assert new_states[0].shape == (2, 3, 8)
+    assert new_states[1].shape == (2, 3, 8)
+
+
+def test_gru_bidirectional():
+    gru = gluon.rnn.GRU(hidden_size=6, num_layers=1, bidirectional=True)
+    gru.initialize()
+    x = mx.nd.random.uniform(shape=(4, 2, 5))
+    out = gru(x)
+    assert out.shape == (4, 2, 12)
+
+
+def test_rnn_cell_unroll():
+    cell = gluon.rnn.LSTMCell(hidden_size=8)
+    cell.initialize()
+    inputs = [mx.nd.random.uniform(shape=(2, 4)) for _ in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    assert len(outputs) == 3
+    assert outputs[0].shape == (2, 8)
+
+
+def test_block_save_load():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    x = mx.nd.ones((1, 3))
+    out1 = net(x).asnumpy()
+    net.save_parameters("/tmp/test_gluon_sl.params")
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3))
+    net2.initialize()
+    net2.load_parameters("/tmp/test_gluon_sl.params")
+    out2 = net2(x).asnumpy()
+    assert_almost_equal(out1, out2)
+
+
+def test_model_zoo_smoke():
+    from mxnet_tpu.gluon.model_zoo import vision
+    for name in ["resnet18_v1", "resnet18_v2", "mobilenet0.25"]:
+        net = vision.get_model(name, classes=10)
+        net.initialize()
+        x = mx.nd.random.uniform(shape=(1, 3, 32, 32))
+        out = net(x)
+        assert out.shape == (1, 10)
+
+
+def test_split_and_load():
+    from mxnet_tpu.gluon.utils import split_and_load, clip_global_norm
+    data = mx.nd.arange(0, 16).reshape((8, 2))
+    parts = split_and_load(data, [mx.cpu(0), mx.cpu(0)])
+    assert len(parts) == 2
+    assert parts[0].shape == (4, 2)
+    arrays = [mx.nd.ones((2, 2)) * 10, mx.nd.ones((2,)) * 10]
+    norm = clip_global_norm(arrays, 1.0)
+    assert norm > 1.0
+    total = sum((a.asnumpy() ** 2).sum() for a in arrays)
+    assert abs(np.sqrt(total) - 1.0) < 1e-4
