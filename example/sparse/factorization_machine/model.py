@@ -1,0 +1,38 @@
+"""Factorization machine symbol (reference: example/sparse/factorization_machine/model.py:25-53).
+
+FM(x) = w0 + <w, x> + 0.5 * sum_k ((x @ v_k)^2 - (x^2 @ v_k^2))
+with csr input x and row_sparse factors v / weights w.
+"""
+import mxnet_tpu as mx
+
+
+def factorization_machine_model(factor_size, num_features,
+                                lr_mult_config=None, wd_mult_config=None,
+                                init_config=None):
+    x = mx.symbol.Variable("data", stype="csr")
+    # row_sparse parameters: pulled/updated row-wise (lazy) by the optimizer
+    v = mx.symbol.Variable("v", shape=(num_features, factor_size),
+                           stype="row_sparse",
+                           init=mx.initializer.Normal(sigma=0.01))
+    w = mx.symbol.Variable("w", shape=(num_features, 1), stype="row_sparse",
+                           init=mx.initializer.Normal(sigma=0.01))
+    w0 = mx.symbol.Variable("w0", shape=(1,),
+                            init=mx.initializer.Zero())
+
+    w1 = mx.symbol.broadcast_add(mx.symbol.dot(x, w), w0)
+
+    v_s = mx.symbol._internal._square_sum(v, axis=1, keepdims=True)
+    x_s = mx.symbol.square(x)
+    bd_sum = mx.symbol.dot(x_s, v_s)
+
+    w2 = mx.symbol.dot(x, v)
+    w2_squared = 0.5 * mx.symbol.square(w2)
+
+    w_all = mx.symbol.Concat(w1, w2_squared, dim=1)
+    sum1 = mx.symbol.sum(w_all, axis=1, keepdims=True)
+    sum2 = 0.5 * mx.symbol.negative(bd_sum)
+    model = mx.symbol.elemwise_add(sum1, sum2)
+
+    y = mx.symbol.Variable("softmax_label")
+    model = mx.symbol.LogisticRegressionOutput(data=model, label=y)
+    return model

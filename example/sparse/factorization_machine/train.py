@@ -1,0 +1,95 @@
+"""Train a factorization machine on libsvm data (reference:
+example/sparse/factorization_machine/train.py:58-119).
+
+Uses the sparse path end to end: LibSVMIter csr batches, row_sparse weights,
+lazy Adam updates, kvstore row_sparse_pull before forward. With no --data
+argument a synthetic separable libsvm dataset is generated.
+"""
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", ".."))
+import mxnet_tpu as mx
+from model import factorization_machine_model
+
+
+def synth_libsvm(path, num_samples=2000, num_features=1000, nnz=12, seed=0):
+    """Synthetic sparse binary classification data, linearly separable-ish."""
+    rng = np.random.RandomState(seed)
+    true_w = rng.normal(0, 1, num_features)
+    with open(path, "w") as f:
+        for _ in range(num_samples):
+            idx = np.sort(rng.choice(num_features, nnz, replace=False))
+            val = rng.uniform(0.5, 1.5, nnz)
+            y = 1 if float(np.dot(val, true_w[idx])) > 0 else 0
+            toks = ["%d" % y] + ["%d:%.4f" % (i, v) for i, v in zip(idx, val)]
+            f.write(" ".join(toks) + "\n")
+    return path
+
+
+def train(args):
+    kv = mx.kvstore.create(args.kvstore) if args.kvstore else None
+    num_parts = kv.num_workers if kv else 1
+    part_index = kv.rank if kv else 0
+
+    data_path = args.data
+    if not data_path:
+        data_path = os.path.join(tempfile.gettempdir(), "fm_synth.libsvm")
+        synth_libsvm(data_path, num_features=args.num_features)
+
+    train_iter = mx.io.LibSVMIter(data_libsvm=data_path,
+                                  data_shape=(args.num_features,),
+                                  batch_size=args.batch_size,
+                                  num_parts=num_parts, part_index=part_index)
+
+    sym = factorization_machine_model(args.factor_size, args.num_features)
+    mod = mx.mod.Module(symbol=sym, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    mod.init_params()
+    optimizer_params = {"learning_rate": args.lr, "beta1": 0.9, "beta2": 0.999}
+    mod.init_optimizer(optimizer="adam", kvstore=kv,
+                       optimizer_params=optimizer_params)
+    metric = mx.metric.Accuracy()
+
+    logging.info("start training on %s (%d features)", data_path,
+                 args.num_features)
+    for epoch in range(args.epochs):
+        train_iter.reset()
+        metric.reset()
+        for batch in train_iter:
+            # pull only the rows this batch touches (reference: train.py:119
+            # manual row_sparse_pull)
+            if kv is not None:
+                row_ids = batch.data[0].indices
+                mod.prepare(batch, sparse_row_id_fn=lambda b: {
+                    "v": row_ids, "w": row_ids})
+            mod.forward_backward(batch)
+            mod.update()
+            # FM emits probabilities in (N,1); threshold for accuracy
+            out = mod.get_outputs()[0]
+            pred = (out.asnumpy().ravel() > 0.5).astype(np.float32)
+            lbl = batch.label[0].asnumpy().ravel()
+            metric.update([mx.nd.array(lbl)], [mx.nd.array(pred)])
+        logging.info("epoch %d, train %s", epoch, metric.get())
+    return metric.get()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(description="factorization machine (sparse)")
+    p.add_argument("--data", type=str, default=None, help="libsvm file")
+    p.add_argument("--num-features", type=int, default=1000)
+    p.add_argument("--factor-size", type=int, default=16)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--kvstore", type=str, default="local")
+    train(p.parse_args())

@@ -87,10 +87,14 @@ class KVStore:
                 return merged
             return merged.copy()
         if isinstance(vlist[0], _sparse.RowSparseNDArray):
-            import numpy as _np
-            idx = _np.concatenate([_np.asarray(v._indices) for v in vlist])
-            dat = _np.concatenate([_np.asarray(v._data) for v in vlist])
-            return _sparse.RowSparseNDArray(dat, idx, vlist[0].shape,
+            # sum contributions per row: devices may emit grads for the SAME
+            # row; segment-sum over the unique index set (reference:
+            # ElementwiseSum rsp path, ndarray_function.cc)
+            idx = jnp.concatenate([v._indices for v in vlist])
+            dat = jnp.concatenate([v._data for v in vlist])
+            uniq, inv = jnp.unique(idx, return_inverse=True)
+            summed = jax.ops.segment_sum(dat, inv, num_segments=int(uniq.shape[0]))
+            return _sparse.RowSparseNDArray(summed, uniq, vlist[0].shape,
                                             ctx=vlist[0].context)
         acc = vlist[0]._data
         for v in vlist[1:]:
@@ -142,20 +146,33 @@ class KVStore:
             dense = src.todense() if isinstance(src, _sparse.BaseSparseNDArray) else src
             import numpy as _np
             rows = _np.unique(rid.asnumpy().astype(_np.int64))
+            row_vals = dense._data[jnp.asarray(rows)]
             for o in olist:
-                rsp = _sparse.RowSparseNDArray(
-                    _np.asarray(dense._data)[rows], rows.astype(_np.int32),
-                    dense.shape, ctx=dense.context)
-                o._data = rsp._data
-                o._indices = rsp._indices
-                o._shape = rsp._shape
+                if isinstance(o, _sparse.RowSparseNDArray):
+                    o._data = row_vals
+                    o._indices = jnp.asarray(rows.astype(_np.int32))
+                    o._shape = dense.shape
+                else:
+                    # dense destination (the TPU executor keeps weights dense;
+                    # scatter only the requested rows — reference row-wise
+                    # pull semantics, other rows left untouched)
+                    o._data = o._data.at[jnp.asarray(rows)].set(
+                        row_vals.astype(o._data.dtype))
 
     # -- cross-worker collective (tpu_sync / dist) -------------------------
     def _allreduce_across_workers(self, merged):
-        if self.num_workers == 1 or isinstance(merged, _sparse.BaseSparseNDArray):
+        if self.num_workers == 1:
             return merged
-        # multi-host: XLA allreduce over DCN/ICI via process-spanning pmap-less psum
         from .parallel.collectives import allreduce_hosts
+        if isinstance(merged, _sparse.BaseSparseNDArray):
+            # workers hold different row sets; XLA collectives need uniform
+            # shapes, so sum the densified grad over DCN then re-sparsify
+            # (reference pushes row-sparse shards to PS servers instead —
+            # kvstore_dist.h EncodeRowSparseKey)
+            dense = merged.todense()
+            summed = allreduce_hosts(dense._data)
+            return _sparse.row_sparse_array(
+                NDArray(summed, ctx=merged.context), ctx=merged.context)
         return NDArray(allreduce_hosts(merged._data), ctx=merged.context)
 
     # -- optimizer plumbing ------------------------------------------------

@@ -58,6 +58,7 @@ class Module(BaseModule):
         self._optimizer = None
         self._kvstore = None
         self._update_on_kvstore = None
+        self._rsp_param_names = None  # stype cache, filled lazily after bind
         self._updater = None
         self._preload_opt_states = None
         self._exec_group = None
@@ -226,6 +227,7 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._rsp_param_names = None
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
@@ -334,17 +336,41 @@ class Module(BaseModule):
         """reference: module.py update — kvstore push/pull or local updater."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
+        grad_arrays = self._sparsify_grads(self._exec_group.grad_arrays)
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
-                                      self._exec_group.grad_arrays,
+                                      grad_arrays,
                                       self._kvstore, self._exec_group.param_names)
         else:
             _update_params(self._exec_group.param_arrays,
-                           self._exec_group.grad_arrays,
+                           grad_arrays,
                            updater=self._updater,
                            num_device=len(self._context),
                            kvstore=self._kvstore,
                            param_names=self._exec_group.param_names)
+
+    def _sparsify_grads(self, grad_arrays):
+        """Dense→row_sparse grad conversion for params declared stype='row_sparse'.
+
+        Reference computes row_sparse grads natively in sparse kernels
+        (src/operator/tensor/dot-inl.h csr.T @ dense → rsp); the TPU executor
+        computes dense grads (XLA has no sparse), so the sparse-update / kvstore
+        row_sparse path recovers the nonzero rows here, on device, before push."""
+        if self._rsp_param_names is None:
+            attrs = self._symbol.attr_dict()
+            self._rsp_param_names = frozenset(
+                n for n in self._exec_group.param_names
+                if attrs.get(n, {}).get("__storage_type__") == "row_sparse")
+        if not self._rsp_param_names:
+            return grad_arrays
+        from ..ndarray import sparse as _sp
+        out = []
+        for name, dev_grads in zip(self._exec_group.param_names, grad_arrays):
+            if name in self._rsp_param_names:
+                dev_grads = [g if isinstance(g, _sp.BaseSparseNDArray)
+                             else _sp.row_sparse_from_dense(g) for g in dev_grads]
+            out.append(dev_grads)
+        return out
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -100,3 +100,6 @@ from . import random  # noqa: E402
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
            "concatenate", "moveaxis", "waitall", "sparse", "random",
            "CSRNDArray", "RowSparseNDArray"] + list(_GENERATED)
+
+from ..ops.registry import make_internal_namespace as _min  # noqa: E402
+_internal = _min(_GENERATED, _OP_ALIASES)

@@ -164,6 +164,23 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
                             dense.shape, ctx=ctx, dtype=dtype)
 
 
+def row_sparse_from_dense(nd):
+    """Device-side dense→row_sparse: nonzero-row scan and gather stay on
+    device; only the (small) row-index vector syncs to host for the dynamic
+    output shape. Used on the Module.update hot path (dense XLA grads →
+    row_sparse push) — avoids shipping the full grad through numpy."""
+    g = nd._data
+    mask = jnp.any(g != 0, axis=tuple(range(1, g.ndim)))
+    rows = jnp.nonzero(mask)[0]          # host sync, |rows| ints only
+    out = RowSparseNDArray.__new__(RowSparseNDArray)
+    NDArray.__init__(out, g[rows], ctx=nd.context)
+    out._stype = "row_sparse"
+    out._shape = tuple(g.shape)
+    out._indices = rows.astype(jnp.int32)
+    out._indptr = None
+    return out
+
+
 def zeros(stype, shape, ctx=None, dtype=None):
     if isinstance(shape, int):
         shape = (shape,)

@@ -21,7 +21,25 @@ import jax
 
 from ..base import MXNetError, Params
 
-__all__ = ["OpDef", "register_op", "get_op", "find_op", "list_ops", "OPS"]
+__all__ = ["OpDef", "register_op", "get_op", "find_op", "list_ops", "OPS",
+           "make_internal_namespace"]
+
+
+def make_internal_namespace(generated, aliases):
+    """Build a `_internal` namespace over a generated-op table (reference:
+    python/mxnet/{ndarray,symbol}/_internal.py, generated from C-API
+    introspection). Shared by mx.nd._internal and mx.sym._internal."""
+
+    class _InternalNamespace(object):
+        def __getattr__(self, name):
+            fn = generated.get(name)
+            if fn is None and name in aliases:
+                fn = generated.get(aliases[name])
+            if fn is None:
+                raise AttributeError("no internal op %r" % name)
+            return fn
+
+    return _InternalNamespace()
 
 OPS = {}
 _ALIASES = {}

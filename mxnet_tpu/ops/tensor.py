@@ -278,6 +278,17 @@ for _name, _fn in _REDUCE.items():
                 param_cls=ReduceParam)(_make_reduce(_fn))
 
 
+@register_op("_square_sum", param_cls=ReduceParam)
+def _square_sum(params, x):
+    """Sum of squares along axis (reference: src/operator/tensor/square_sum-inl.h).
+
+    On the reference this is a fused sparse kernel for row_sparse inputs; here
+    the square+sum pair fuses in XLA, and sparse inputs are densified at the
+    device boundary (SURVEY.md §7 sparse-on-TPU stance)."""
+    return jnp.sum(jnp.square(x), axis=_norm_axis(params, x),
+                   keepdims=params.keepdims)
+
+
 class NormParam(Params):
     ord = param_field(int, default=2)
     axis = param_field(tuple, default=None)

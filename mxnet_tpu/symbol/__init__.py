@@ -6,6 +6,7 @@ as Variables named "<opname>_<input>" exactly like the reference composer.
 """
 from __future__ import annotations
 
+import builtins as _builtins
 import sys
 
 from ..base import MXNetError
@@ -63,7 +64,9 @@ def _make_sym_function(opdef):
                 attrs[f] = a
         if opdef.key_var_num_args:
             if opdef.key_var_num_args not in attrs:
-                attrs[opdef.key_var_num_args] = max(len(sym_args), 1)
+                # NB: plain `max` here would resolve to the generated reduce op
+                # that shadows the builtin in this module's namespace
+                attrs[opdef.key_var_num_args] = _builtins.max(len(sym_args), 1)
             inputs = sym_args
         else:
             probe = opdef.make_params({k: v for k, v in attrs.items() if v is not None})
@@ -116,3 +119,6 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "fromjson",
            "zeros", "ones", "arange"] + list(_GENERATED)
+
+from ..ops.registry import make_internal_namespace as _min  # noqa: E402
+_internal = _min(_GENERATED, _OP_ALIASES)

@@ -1,0 +1,221 @@
+"""Sparse NDArray + sparse training-path tests.
+
+Reference shape: tests/python/unittest/test_sparse_ndarray.py and
+test_sparse_operator.py — per-op numerics vs dense/numpy, plus the
+factorization-machine end-to-end path (SURVEY.md Appendix A.5).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def dense_rand(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    d = rng.uniform(-1, 1, shape)
+    mask = rng.uniform(0, 1, shape) < density
+    return (d * mask).astype(np.float32)
+
+
+class TestCSR:
+    def test_roundtrip(self):
+        d = dense_rand((6, 9))
+        csr = sparse.csr_matrix(d)
+        assert csr.stype == "csr"
+        np.testing.assert_allclose(csr.asnumpy(), d, rtol=1e-6)
+
+    def test_from_triple(self):
+        data = np.array([1.0, 2.0, 3.0], np.float32)
+        indices = np.array([0, 2, 1], np.int32)
+        indptr = np.array([0, 2, 2, 3], np.int32)
+        csr = sparse.csr_matrix((data, indices, indptr), shape=(3, 3))
+        expect = np.array([[1, 0, 2], [0, 0, 0], [0, 3, 0]], np.float32)
+        np.testing.assert_allclose(csr.asnumpy(), expect)
+
+    def test_dot_csr_dense(self):
+        d = dense_rand((5, 7), seed=1)
+        rhs = np.random.RandomState(2).uniform(-1, 1, (7, 3)).astype(np.float32)
+        csr = sparse.csr_matrix(d)
+        out = sparse.dot(csr, mx.nd.array(rhs))
+        np.testing.assert_allclose(out.asnumpy(), d @ rhs, rtol=1e-5)
+
+    def test_dot_csr_T_dense(self):
+        d = dense_rand((5, 7), seed=3)
+        rhs = np.random.RandomState(4).uniform(-1, 1, (5, 2)).astype(np.float32)
+        csr = sparse.csr_matrix(d)
+        out = sparse.dot(csr, mx.nd.array(rhs), transpose_a=True)
+        assert out.shape == (7, 2)
+        np.testing.assert_allclose(out.asnumpy(), d.T @ rhs, rtol=1e-5)
+
+    def test_slice(self):
+        d = dense_rand((8, 4), seed=5)
+        csr = sparse.csr_matrix(d)
+        np.testing.assert_allclose(csr[2:5].asnumpy(), d[2:5], rtol=1e-6)
+
+
+class TestRowSparse:
+    def test_roundtrip(self):
+        d = np.zeros((7, 3), np.float32)
+        d[1] = [1, 2, 3]
+        d[4] = [4, 5, 6]
+        rsp = sparse.row_sparse_array(d)
+        assert rsp.stype == "row_sparse"
+        assert sorted(np.asarray(rsp._indices).tolist()) == [1, 4]
+        np.testing.assert_allclose(rsp.asnumpy(), d)
+
+    def test_retain(self):
+        d = np.zeros((6, 2), np.float32)
+        d[0] = 1
+        d[2] = 2
+        d[5] = 3
+        rsp = sparse.row_sparse_array(d)
+        kept = sparse.retain(rsp, mx.nd.array(np.array([2, 5], np.float32)))
+        expect = d.copy()
+        expect[0] = 0
+        np.testing.assert_allclose(kept.asnumpy(), expect)
+
+    def test_cast_storage(self):
+        d = dense_rand((4, 5), seed=6)
+        nd = mx.nd.array(d)
+        csr = sparse.cast_storage(nd, "csr")
+        assert csr.stype == "csr"
+        back = csr.tostype("default")
+        np.testing.assert_allclose(back.asnumpy(), d, rtol=1e-6)
+        rsp = sparse.cast_storage(nd, "row_sparse")
+        assert rsp.stype == "row_sparse"
+        np.testing.assert_allclose(rsp.asnumpy(), d, rtol=1e-6)
+
+
+class TestSquareSum:
+    def test_square_sum_op(self):
+        d = np.random.RandomState(0).uniform(-1, 1, (5, 4)).astype(np.float32)
+        out = mx.nd._internal._square_sum(mx.nd.array(d), axis=1, keepdims=True)
+        np.testing.assert_allclose(out.asnumpy(), (d ** 2).sum(1, keepdims=True),
+                                   rtol=1e-5)
+
+    def test_square_sum_symbol(self):
+        v = mx.sym.Variable("v")
+        s = mx.sym._internal._square_sum(v, axis=1, keepdims=True)
+        assert s.infer_shape(v=(5, 3))[1] == [(5, 1)]
+
+
+class TestSparseOptimizers:
+    def _run(self, opt_name, **opt_kw):
+        shape = (20, 4)
+        rng = np.random.RandomState(0)
+        w0 = rng.normal(0, 1, shape).astype(np.float32)
+        gd = np.zeros(shape, np.float32)
+        gd[3] = rng.normal(0, 1, (4,))
+        gd[11] = rng.normal(0, 1, (4,))
+        opt_d = mx.optimizer.create(opt_name, learning_rate=0.1, **opt_kw)
+        opt_s = mx.optimizer.create(opt_name, learning_rate=0.1, **opt_kw)
+        wd_ = mx.nd.array(w0)
+        ws_ = mx.nd.array(w0)
+        sd = opt_d.create_state(0, wd_)
+        ss = opt_s.create_state(0, ws_)
+        opt_d.update(0, wd_, mx.nd.array(gd), sd)
+        opt_s.update(0, ws_, sparse.row_sparse_array(gd), ss)
+        np.testing.assert_allclose(ws_.asnumpy(), wd_.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_sgd_lazy(self):
+        self._run("sgd", momentum=0.9, wd=0.0)
+
+    def test_adam_lazy(self):
+        self._run("adam", wd=0.0)
+
+
+class TestKVStoreSparse:
+    def test_push_pull_row_sparse(self):
+        kv = mx.kvstore.create("local")
+        shape = (10, 2)
+        init = np.arange(20).reshape(shape).astype(np.float32)
+        kv.init("w", mx.nd.array(init))
+        out = sparse.zeros("row_sparse", shape)
+        kv.row_sparse_pull("w", out=out,
+                           row_ids=mx.nd.array(np.array([1, 4], np.float32)))
+        got = out.asnumpy()
+        np.testing.assert_allclose(got[1], init[1])
+        np.testing.assert_allclose(got[4], init[4])
+        assert not got[0].any()
+
+    def test_row_sparse_pull_dense_out(self):
+        kv = mx.kvstore.create("local")
+        shape = (6, 3)
+        init = np.random.RandomState(1).normal(0, 1, shape).astype(np.float32)
+        kv.init("w", mx.nd.array(init))
+        out = mx.nd.zeros(shape)
+        kv.row_sparse_pull("w", out=out,
+                           row_ids=mx.nd.array(np.array([0, 5], np.float32)))
+        got = out.asnumpy()
+        np.testing.assert_allclose(got[0], init[0], rtol=1e-6)
+        np.testing.assert_allclose(got[5], init[5], rtol=1e-6)
+        assert not got[2].any()
+
+
+class TestLibSVMIter:
+    def test_iter(self, tmp_path):
+        p = tmp_path / "t.libsvm"
+        p.write_text("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0 3:1.0\n0 0:2.0\n")
+        it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+        batches = list(it)
+        assert len(batches) == 2
+        b0 = batches[0]
+        assert b0.data[0].stype == "csr"
+        d = b0.data[0].asnumpy()
+        np.testing.assert_allclose(d[0], [1.5, 0, 0, 2.0])
+        np.testing.assert_allclose(d[1], [0, 1.0, 0, 0])
+        np.testing.assert_allclose(b0.label[0].asnumpy(), [1, 0])
+
+
+class TestFactorizationMachineE2E:
+    def test_fm_converges(self, tmp_path):
+        import importlib.util
+        import os
+        import sys
+        fm_dir = os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                              "example", "sparse", "factorization_machine")
+        sys.path.insert(0, fm_dir)
+        try:
+            import model as fm_model
+            importlib.reload(fm_model)
+            num_features = 120
+            sym = fm_model.factorization_machine_model(4, num_features)
+
+            # synthetic separable data
+            rng = np.random.RandomState(0)
+            true_w = rng.normal(0, 1, num_features)
+            path = tmp_path / "fm.libsvm"
+            with open(path, "w") as f:
+                for _ in range(400):
+                    idx = np.sort(rng.choice(num_features, 8, replace=False))
+                    val = rng.uniform(0.5, 1.5, 8)
+                    y = 1 if float(np.dot(val, true_w[idx])) > 0 else 0
+                    toks = ["%d" % y] + ["%d:%.4f" % (i, v)
+                                         for i, v in zip(idx, val)]
+                    f.write(" ".join(toks) + "\n")
+
+            it = mx.io.LibSVMIter(data_libsvm=str(path),
+                                  data_shape=(num_features,), batch_size=50)
+            mod = mx.mod.Module(sym, data_names=["data"],
+                                label_names=["softmax_label"])
+            mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+            mod.init_params()
+            mod.init_optimizer(optimizer="adam",
+                               optimizer_params={"learning_rate": 0.05})
+            acc = None
+            for _ in range(6):
+                it.reset()
+                correct = total = 0
+                for batch in it:
+                    mod.forward_backward(batch)
+                    mod.update()
+                    pred = (mod.get_outputs()[0].asnumpy().ravel() > 0.5)
+                    lbl = batch.label[0].asnumpy().ravel() > 0.5
+                    correct += int((pred == lbl).sum())
+                    total += len(lbl)
+                acc = correct / total
+            assert acc > 0.9, "FM failed to converge: acc=%.3f" % acc
+        finally:
+            sys.path.remove(fm_dir)
