@@ -1,0 +1,81 @@
+"""Detection data iterators (reference: example/ssd/dataset/iterator.py:23).
+
+`DetRecordIter` wraps `mx.io.ImageDetRecordIter` when a RecordIO file exists;
+`SyntheticDetIter` generates learnable colored-rectangle scenes (class is a
+function of color) so the full SSD training path runs without VOC data in a
+zero-egress environment.
+"""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataIter, DataBatch, DataDesc
+
+
+class SyntheticDetIter(DataIter):
+    def __init__(self, batch_size, data_shape=(3, 300, 300), num_classes=20,
+                 max_objects=8, num_batches=20, label_pad_width=None, seed=0):
+        super().__init__(batch_size)
+        self.data_shape = (batch_size,) + tuple(data_shape)
+        self.num_classes = num_classes
+        self.max_objects = max_objects
+        self.num_batches = num_batches
+        self.label_shape = (batch_size, max_objects, 5)
+        self._rng = np.random.RandomState(seed)
+        self._cur = 0
+        self.provide_data = [DataDesc("data", self.data_shape)]
+        self.provide_label = [DataDesc("label", self.label_shape)]
+
+    def reset(self):
+        self._cur = 0
+
+    def next(self):
+        if self._cur >= self.num_batches:
+            raise StopIteration
+        self._cur += 1
+        b, c, h, w = self.data_shape
+        data = self._rng.uniform(0, 0.1, self.data_shape).astype(np.float32)
+        label = np.full(self.label_shape, -1.0, np.float32)
+        for i in range(b):
+            n_obj = self._rng.randint(1, self.max_objects // 2 + 1)
+            for j in range(n_obj):
+                cls = self._rng.randint(0, self.num_classes)
+                bw = self._rng.uniform(0.2, 0.6)
+                bh = self._rng.uniform(0.2, 0.6)
+                x0 = self._rng.uniform(0, 1 - bw)
+                y0 = self._rng.uniform(0, 1 - bh)
+                label[i, j] = [cls, x0, y0, x0 + bw, y0 + bh]
+                # paint a class-coded rectangle so the task is learnable
+                xs, ys = int(x0 * w), int(y0 * h)
+                xe, ye = int((x0 + bw) * w), int((y0 + bh) * h)
+                shade = 0.2 + 0.8 * (cls + 1) / self.num_classes
+                data[i, cls % c, ys:ye, xs:xe] = shade
+        return DataBatch(data=[mx.nd.array(data)], label=[mx.nd.array(label)],
+                         pad=0, provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class DetRecordIter(DataIter):
+    """ImageDetRecordIter wrapper (reference dataset/iterator.py:23); falls back
+    to SyntheticDetIter when the .rec file does not exist."""
+
+    def __init__(self, path_imgrec, batch_size, data_shape, label_pad_width=350,
+                 **kwargs):
+        super().__init__(batch_size)
+        if path_imgrec and os.path.exists(path_imgrec):
+            self.rec = mx.io.ImageDetRecordIter(
+                path_imgrec=path_imgrec, batch_size=batch_size,
+                data_shape=data_shape, label_pad_width=label_pad_width, **kwargs)
+        else:
+            synth_kw = {k: v for k, v in kwargs.items()
+                        if k in ("num_classes", "max_objects", "num_batches", "seed")}
+            self.rec = SyntheticDetIter(batch_size, data_shape=data_shape, **synth_kw)
+        self.provide_data = self.rec.provide_data
+        self.provide_label = self.rec.provide_label
+
+    def reset(self):
+        self.rec.reset()
+
+    def next(self):
+        return self.rec.next()

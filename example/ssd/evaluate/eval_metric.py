@@ -1,0 +1,109 @@
+"""VOC mAP metrics (reference: example/ssd/evaluate/eval_metric.py —
+MApMetric and VOC07MApMetric with 11-point interpolated AP)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class MApMetric(mx.metric.EvalMetric):
+    """Mean average precision over detection outputs.
+
+    update() consumes (labels, preds) where preds[0] is MultiBoxDetection
+    output (B, N, 6) [cls_id, score, xmin, ymin, xmax, ymax] and labels[0] is
+    padded gt (B, O, 5+) [cls_id, xmin, ymin, xmax, ymax]."""
+
+    def __init__(self, ovp_thresh=0.5, use_difficult=False, class_names=None,
+                 pred_idx=0):
+        super().__init__("mAP")
+        self.ovp_thresh = ovp_thresh
+        self.use_difficult = use_difficult
+        self.class_names = class_names
+        self.pred_idx = int(pred_idx)
+        self.reset()
+
+    def reset(self):
+        self.records = {}   # cls -> list of (score, tp)
+        self.counts = {}    # cls -> num gt
+
+    def update(self, labels, preds):
+        for batch_label, batch_pred in zip([labels[0]], [preds[self.pred_idx]]):
+            label = batch_label.asnumpy() if hasattr(batch_label, "asnumpy") \
+                else np.asarray(batch_label)
+            pred = batch_pred.asnumpy() if hasattr(batch_pred, "asnumpy") \
+                else np.asarray(batch_pred)
+            for i in range(label.shape[0]):
+                self._update_one(label[i], pred[i])
+
+    def _update_one(self, gts, dets):
+        gts = gts[gts[:, 0] >= 0]
+        dets = dets[dets[:, 0] >= 0]
+        order = np.argsort(-dets[:, 1])
+        dets = dets[order]
+        gt_matched = np.zeros(len(gts), bool)
+        for cls in np.unique(np.concatenate([gts[:, 0], dets[:, 0]])).astype(int):
+            self.counts.setdefault(cls, 0)
+            self.counts[cls] += int((gts[:, 0] == cls).sum())
+        for d in dets:
+            cls = int(d[0])
+            recs = self.records.setdefault(cls, [])
+            cand = np.where((gts[:, 0] == cls) & ~gt_matched)[0]
+            if len(cand) == 0:
+                recs.append((d[1], 0))
+                continue
+            ious = self._iou(d[2:6], gts[cand, 1:5])
+            j = np.argmax(ious)
+            if ious[j] >= self.ovp_thresh:
+                gt_matched[cand[j]] = True
+                recs.append((d[1], 1))
+            else:
+                recs.append((d[1], 0))
+
+    @staticmethod
+    def _iou(box, boxes):
+        tl = np.maximum(box[:2], boxes[:, :2])
+        br = np.minimum(box[2:], boxes[:, 2:])
+        wh = np.maximum(br - tl, 0)
+        inter = wh[:, 0] * wh[:, 1]
+        area = (box[2] - box[0]) * (box[3] - box[1])
+        areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        union = area + areas - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+    def _average_precision(self, rec, prec):
+        """Area under PR curve (VOC >=2010 style)."""
+        mrec = np.concatenate(([0.0], rec, [1.0]))
+        mpre = np.concatenate(([0.0], prec, [0.0]))
+        for i in range(len(mpre) - 1, 0, -1):
+            mpre[i - 1] = max(mpre[i - 1], mpre[i])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1])
+
+    def get(self):
+        aps = []
+        names = []
+        for cls, recs in sorted(self.records.items()):
+            n_gt = self.counts.get(cls, 0)
+            if n_gt == 0:
+                continue
+            recs = sorted(recs, key=lambda r: -r[0])
+            tps = np.array([r[1] for r in recs], np.float64)
+            tp_cum = np.cumsum(tps)
+            fp_cum = np.cumsum(1 - tps)
+            rec = tp_cum / n_gt
+            prec = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+            aps.append(self._average_precision(rec, prec))
+            names.append(self.class_names[cls] if self.class_names else str(cls))
+        if not aps:
+            return (self.name, float("nan"))
+        return (self.name, float(np.mean(aps)))
+
+
+class VOC07MApMetric(MApMetric):
+    """11-point interpolated AP (VOC07 protocol)."""
+
+    def _average_precision(self, rec, prec):
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            prec_at = prec[rec >= t]
+            ap += (np.max(prec_at) if prec_at.size else 0.0) / 11.0
+        return ap

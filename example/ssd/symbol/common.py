@@ -1,0 +1,89 @@
+"""Shared SSD head builders (reference: example/ssd/symbol/common.py —
+multi_layer_feature / multibox_layer)."""
+import mxnet_tpu as mx
+
+
+def conv_act_layer(from_layer, name, num_filter, kernel=(1, 1), pad=(0, 0),
+                   stride=(1, 1), act_type="relu"):
+    conv = mx.sym.Convolution(data=from_layer, kernel=kernel, pad=pad,
+                              stride=stride, num_filter=num_filter,
+                              name="{}_conv".format(name))
+    relu = mx.sym.Activation(data=conv, act_type=act_type,
+                             name="{}_{}".format(name, act_type))
+    return relu
+
+
+def multi_layer_feature(relu4_3, relu7, num_filters=(512, 1024, 512, 256, 256, 256),
+                        strides=(-1, -1, 2, 2, 2, 2), pads=(-1, -1, 1, 1, 1, 1)):
+    """Build the 6-scale SSD feature pyramid from the two backbone taps: the
+    first two scales come from the backbone; the rest are stride-2 conv blocks
+    appended on top (reference common.py multi_layer_feature)."""
+    layers = [relu4_3, relu7]
+    body = relu7
+    for k in range(2, len(num_filters)):
+        num_1x1 = max(num_filters[k] // 2, 16)
+        body = conv_act_layer(body, "multi_feat_%d_conv_1x1" % k, num_1x1)
+        body = conv_act_layer(body, "multi_feat_%d_conv_3x3" % k,
+                              num_filters[k], kernel=(3, 3),
+                              pad=(pads[k], pads[k]),
+                              stride=(strides[k], strides[k]))
+        layers.append(body)
+    return layers
+
+
+def multibox_layer(from_layers, num_classes, sizes, ratios,
+                   normalization=-1, num_channels=(),
+                   clip=False, interm_layer=0, steps=()):
+    """Attach loc/cls prediction convs + anchor generators to each scale and
+    concatenate into (loc_preds, cls_preds, anchors)
+    (reference common.py multibox_layer)."""
+    loc_pred_layers = []
+    cls_pred_layers = []
+    anchor_layers = []
+    num_classes += 1  # background
+
+    if isinstance(normalization, (int, float)):
+        normalization = [normalization] * len(from_layers)
+
+    for k, from_layer in enumerate(from_layers):
+        name = "multibox_%d" % k
+        if normalization[k] > 0:
+            from_layer = mx.sym.L2Normalization(data=from_layer, mode="channel",
+                                                name="{}_norm".format(name))
+            scale = mx.sym.Variable(name="{}_scale".format(name),
+                                    shape=(1, num_channels[k], 1, 1),
+                                    init=mx.init.Constant(normalization[k]))
+            from_layer = from_layer * scale
+        size = sizes[k]
+        ratio = ratios[k]
+        num_anchors = len(size) + len(ratio) - 1
+
+        # location prediction: num_anchors*4 channels -> (B, N*4)
+        loc_pred = mx.sym.Convolution(data=from_layer, kernel=(3, 3), pad=(1, 1),
+                                      num_filter=num_anchors * 4,
+                                      name="{}_loc_pred_conv".format(name))
+        loc_pred = mx.sym.transpose(loc_pred, axes=(0, 2, 3, 1))
+        loc_pred = mx.sym.Flatten(data=loc_pred)
+        loc_pred_layers.append(loc_pred)
+
+        # class prediction: num_anchors*num_classes channels -> (B, N, C)
+        cls_pred = mx.sym.Convolution(data=from_layer, kernel=(3, 3), pad=(1, 1),
+                                      num_filter=num_anchors * num_classes,
+                                      name="{}_cls_pred_conv".format(name))
+        cls_pred = mx.sym.transpose(cls_pred, axes=(0, 2, 3, 1))
+        cls_pred = mx.sym.Reshape(data=cls_pred, shape=(0, -1, num_classes))
+        cls_pred_layers.append(cls_pred)
+
+        # anchors for this scale
+        step = (steps[k], steps[k]) if steps else (-1.0, -1.0)
+        anchors = mx.sym.contrib.MultiBoxPrior(
+            from_layer, sizes=tuple(size), ratios=tuple(ratio), clip=clip,
+            steps=step, name="{}_anchors".format(name))
+        anchor_layers.append(anchors)
+
+    loc_preds = mx.sym.Concat(*loc_pred_layers, dim=1, name="multibox_loc_pred")
+    cls_preds = mx.sym.Concat(*cls_pred_layers, dim=1)
+    cls_preds = mx.sym.transpose(cls_preds, axes=(0, 2, 1),
+                                 name="multibox_cls_pred")  # (B, C, N)
+    anchor_boxes = mx.sym.Concat(*anchor_layers, dim=1, name="multibox_anchors")
+    return [loc_preds, cls_preds, anchor_boxes]

@@ -1,0 +1,70 @@
+"""SSD training/inference symbol assembly
+(reference: example/ssd/symbol/symbol_builder.py:81-112)."""
+import mxnet_tpu as mx
+
+from . import common
+from . import vgg16_reduced
+
+# SSD-300 default anchor config (reference example/ssd/symbol_factory.py vgg16_reduced)
+DEFAULT_SIZES = ((0.1, 0.141), (0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+                 (0.71, 0.79), (0.88, 0.961))
+DEFAULT_RATIOS = ((1, 2, 0.5),) + ((1, 2, 0.5, 3, 1.0 / 3),) * 4 + ((1, 2, 0.5),)
+DEFAULT_NORMALIZATION = (20, -1, -1, -1, -1, -1)
+DEFAULT_NUM_CHANNELS = (512, 1024, 512, 256, 256, 256)
+
+
+def _build_head(num_classes, num_filters=DEFAULT_NUM_CHANNELS,
+                sizes=DEFAULT_SIZES, ratios=DEFAULT_RATIOS,
+                normalization=DEFAULT_NORMALIZATION, steps=()):
+    relu4_3, relu7 = vgg16_reduced.get_symbol(num_classes)
+    layers = common.multi_layer_feature(relu4_3, relu7, num_filters=num_filters)
+    return common.multibox_layer(layers, num_classes, sizes=sizes, ratios=ratios,
+                                 normalization=normalization,
+                                 num_channels=num_filters, clip=False,
+                                 steps=steps)
+
+
+def get_symbol_train(num_classes=20, nms_thresh=0.5, force_suppress=False,
+                     nms_topk=400, **kwargs):
+    """Training symbol: Group([cls_prob, loc_loss, cls_label, det])
+    (reference symbol_builder.py get_symbol_train)."""
+    label = mx.sym.Variable(name="label")
+    loc_preds, cls_preds, anchor_boxes = _build_head(num_classes, **kwargs)
+
+    tmp = mx.sym.contrib.MultiBoxTarget(
+        anchor_boxes, label, cls_preds, overlap_threshold=0.5,
+        ignore_label=-1, negative_mining_ratio=3, minimum_negative_samples=0,
+        negative_mining_thresh=0.5, variances=(0.1, 0.1, 0.2, 0.2),
+        name="multibox_target")
+    loc_target = tmp[0]
+    loc_target_mask = tmp[1]
+    cls_target = tmp[2]
+
+    cls_prob = mx.sym.SoftmaxOutput(data=cls_preds, label=cls_target,
+                                    ignore_label=-1, use_ignore=True,
+                                    grad_scale=1.0, multi_output=True,
+                                    normalization="valid", name="cls_prob")
+    loc_loss_ = mx.sym.smooth_l1(data=loc_target_mask * (loc_preds - loc_target),
+                                 scalar=1.0, name="loc_loss_")
+    loc_loss = mx.sym.MakeLoss(loc_loss_, grad_scale=1.0,
+                               normalization="valid", name="loc_loss")
+
+    # monitoring outputs (no gradient)
+    cls_label = mx.sym.MakeLoss(data=cls_target, grad_scale=0, name="cls_label")
+    det = mx.sym.contrib.MultiBoxDetection(
+        cls_prob, loc_preds, anchor_boxes, name="detection",
+        nms_threshold=nms_thresh, force_suppress=force_suppress,
+        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=nms_topk)
+    det = mx.sym.MakeLoss(data=det, grad_scale=0, name="det_out")
+    return mx.sym.Group([cls_prob, loc_loss, cls_label, det])
+
+
+def get_symbol(num_classes=20, nms_thresh=0.5, force_suppress=False,
+               nms_topk=400, **kwargs):
+    """Inference symbol: detections only (reference symbol_builder.py get_symbol)."""
+    loc_preds, cls_preds, anchor_boxes = _build_head(num_classes, **kwargs)
+    cls_prob = mx.sym.softmax(data=cls_preds, axis=1, name="cls_prob")
+    return mx.sym.contrib.MultiBoxDetection(
+        cls_prob, loc_preds, anchor_boxes, name="detection",
+        nms_threshold=nms_thresh, force_suppress=force_suppress,
+        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=nms_topk)

@@ -1,0 +1,54 @@
+"""VGG16-reduced SSD backbone (reference: example/ssd/symbol/vgg16_reduced.py).
+
+Standard VGG16 conv stack with pool5 turned into 3x3/stride-1 and the fc6/fc7
+layers re-expressed as dilated (atrous) conv6/conv7, as in the SSD paper.
+"""
+import mxnet_tpu as mx
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    data = mx.sym.Variable(name="data")
+
+    def conv_block(data, prefix, num_filter, reps):
+        for i in range(1, reps + 1):
+            data = mx.sym.Convolution(data=data, kernel=(3, 3), pad=(1, 1),
+                                      num_filter=num_filter,
+                                      name="conv%s_%d" % (prefix, i))
+            data = mx.sym.Activation(data=data, act_type="relu",
+                                     name="relu%s_%d" % (prefix, i))
+        return data
+
+    body = conv_block(data, "1", 64, 2)
+    body = mx.sym.Pooling(data=body, pool_type="max", kernel=(2, 2),
+                          stride=(2, 2), name="pool1")
+    body = conv_block(body, "2", 128, 2)
+    body = mx.sym.Pooling(data=body, pool_type="max", kernel=(2, 2),
+                          stride=(2, 2), name="pool2")
+    body = conv_block(body, "3", 256, 3)
+    body = mx.sym.Pooling(data=body, pool_type="max", kernel=(2, 2),
+                          stride=(2, 2), name="pool3")
+    body = conv_block(body, "4", 512, 3)
+    relu4_3 = body
+    body = mx.sym.Pooling(data=body, pool_type="max", kernel=(2, 2),
+                          stride=(2, 2), name="pool4")
+    body = conv_block(body, "5", 512, 3)
+    # SSD modification: pool5 is 3x3 stride 1, fc6/fc7 become dilated convs
+    body = mx.sym.Pooling(data=body, pool_type="max", kernel=(3, 3),
+                          stride=(1, 1), pad=(1, 1), name="pool5")
+    body = mx.sym.Convolution(data=body, kernel=(3, 3), pad=(6, 6),
+                              dilate=(6, 6), num_filter=1024, name="fc6")
+    body = mx.sym.Activation(data=body, act_type="relu", name="relu6")
+    body = mx.sym.Convolution(data=body, kernel=(1, 1), num_filter=1024,
+                              name="fc7")
+    relu7 = mx.sym.Activation(data=body, act_type="relu", name="relu7")
+    return relu4_3, relu7
+
+
+def get_classifier_symbol(num_classes=1000, **kwargs):
+    """Plain VGG classifier head, for completeness/backbone pretraining."""
+    _, relu7 = get_symbol(num_classes, **kwargs)
+    pool = mx.sym.Pooling(data=relu7, pool_type="avg", global_pool=True,
+                          kernel=(7, 7), name="global_pool")
+    flat = mx.sym.Flatten(data=pool)
+    fc8 = mx.sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc8")
+    return mx.sym.SoftmaxOutput(data=fc8, name="softmax")

@@ -1,0 +1,50 @@
+"""SSD training entry point (reference: example/ssd/train.py).
+
+Trains SSD-VGG16 on VOC RecordIO when present; without data files a synthetic
+detection dataset exercises the same multi-device data-parallel Module path.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(__file__))
+from train.train_net import train_net  # noqa: E402
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="Train an SSD detection network")
+    parser.add_argument("--train-path", type=str,
+                        default="data/train.rec", help="train record file")
+    parser.add_argument("--val-path", type=str, default="data/val.rec")
+    parser.add_argument("--num-classes", type=int, default=20)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--data-shape", type=int, default=300)
+    parser.add_argument("--tpus", type=str, default="0",
+                        help="tpu cores for data parallelism, e.g. 0,1,2,3")
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=0.004)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=0.0005)
+    parser.add_argument("--frequent", type=int, default=20)
+    parser.add_argument("--num-batches", type=int, default=20,
+                        help="synthetic batches per epoch when no .rec data")
+    parser.add_argument("--prefix", type=str, default=None)
+    parser.add_argument("--small", action="store_true",
+                        help="reduced feature pyramid for smoke testing")
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    args = parse_args()
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",") if i != ""]
+    train_net(args.train_path, args.val_path, args.num_classes,
+              args.batch_size, args.data_shape, ctx=ctx,
+              num_epochs=args.epochs, lr=args.lr, momentum=args.momentum,
+              wd=args.wd, frequent=args.frequent,
+              num_batches=args.num_batches, prefix=args.prefix,
+              small=args.small)

@@ -1,0 +1,39 @@
+"""Training metric for SSD (reference: example/ssd/train/metric.py MultiBoxMetric):
+tracks cross-entropy over matched/hard-negative anchors and smooth-L1 loc loss."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class MultiBoxMetric(mx.metric.EvalMetric):
+    def __init__(self, eps=1e-8):
+        super().__init__("MultiBox")
+        self.eps = eps
+        self.num = 2
+        self.name = ["CrossEntropy", "SmoothL1"]
+        self.reset()
+
+    def reset(self):
+        self.num_inst = [0, 0]
+        self.sum_metric = [0.0, 0.0]
+
+    def update(self, labels, preds):
+        cls_prob = preds[0].asnumpy()     # (B, C, N)
+        loc_loss = preds[1].asnumpy()     # (B, N*4) smooth-l1 values
+        cls_label = preds[2].asnumpy()    # (B, N)
+        valid_count = np.sum(cls_label >= 0)
+        # overall cross-entropy over non-ignored anchors
+        label = cls_label.flatten()
+        mask = np.where(label >= 0)[0]
+        indices = label[mask].astype(np.int64)
+        prob = cls_prob.transpose((0, 2, 1)).reshape((-1, cls_prob.shape[1]))
+        prob = prob[mask, indices]
+        self.sum_metric[0] += (-np.log(prob + self.eps)).sum()
+        self.num_inst[0] += mask.size
+        self.sum_metric[1] += np.sum(loc_loss)
+        self.num_inst[1] += valid_count
+
+    def get(self):
+        names = ["%s" % (n) for n in self.name]
+        values = [s / max(1, n) for s, n in zip(self.sum_metric, self.num_inst)]
+        return (names, values)

@@ -1,0 +1,67 @@
+"""SSD training driver (reference: example/ssd/train/train_net.py:239-268):
+Module on a ctx list (multi-device data parallel), MultiBoxMetric, VOC mAP eval."""
+import logging
+import os
+import sys
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from symbol import symbol_builder            # noqa: E402
+from dataset.iterator import DetRecordIter   # noqa: E402
+from train.metric import MultiBoxMetric      # noqa: E402
+from evaluate.eval_metric import VOC07MApMetric  # noqa: E402
+
+
+def train_net(train_path, val_path, num_classes, batch_size, data_shape,
+              ctx=None, num_epochs=1, lr=0.004, momentum=0.9, wd=0.0005,
+              lr_steps=(80, 160), lr_factor=0.1, frequent=20,
+              num_batches=20, prefix=None, small=False):
+    if ctx is None or not ctx:
+        ctx = [mx.tpu(0)]
+    if isinstance(data_shape, int):
+        data_shape = (3, data_shape, data_shape)
+
+    train_iter = DetRecordIter(train_path, batch_size, data_shape,
+                               num_classes=num_classes,
+                               num_batches=num_batches)
+    val_iter = DetRecordIter(val_path, batch_size, data_shape,
+                             num_classes=num_classes,
+                             num_batches=max(2, num_batches // 4)) \
+        if val_path is not None else None
+
+    kwargs = {}
+    if small:
+        # reduced pyramid for smoke tests: 4 scales, lighter extra layers
+        kwargs = dict(num_filters=(512, 1024, 256, 256),
+                      sizes=symbol_builder.DEFAULT_SIZES[:4],
+                      ratios=symbol_builder.DEFAULT_RATIOS[:4],
+                      normalization=(20, -1, -1, -1))
+    net = symbol_builder.get_symbol_train(num_classes, **kwargs)
+
+    mod = mx.mod.Module(net, label_names=("label",), context=ctx)
+    batch_end_callback = mx.callback.Speedometer(batch_size, frequent=frequent)
+    epoch_end_callback = mx.callback.do_checkpoint(prefix) if prefix else None
+    optimizer_params = {"learning_rate": lr, "momentum": momentum, "wd": wd,
+                        "rescale_grad": 1.0 / len(ctx)}
+    steps = [s * num_batches for s in lr_steps]
+    if steps:
+        optimizer_params["lr_scheduler"] = mx.lr_scheduler.MultiFactorScheduler(
+            step=steps, factor=lr_factor)
+
+    mod.fit(train_iter,
+            eval_data=val_iter,
+            eval_metric=MultiBoxMetric(),
+            validation_metric=VOC07MApMetric(ovp_thresh=0.5, pred_idx=3),
+            batch_end_callback=batch_end_callback,
+            epoch_end_callback=epoch_end_callback,
+            optimizer="sgd",
+            optimizer_params=optimizer_params,
+            initializer=mx.init.Xavier(),
+            num_epoch=num_epochs)
+    return mod
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    train_net(None, None, num_classes=20, batch_size=8, data_shape=300)

@@ -21,6 +21,7 @@ from .ops import list_ops
 
 # populated by later phases; keep imports at bottom to respect dependency order
 from . import initializer
+from . import initializer as init
 from .initializer import init_registry  # noqa: F401
 from . import optimizer
 from . import metric

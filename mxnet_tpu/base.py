@@ -122,15 +122,18 @@ def _coerce(value, typ):
         return bool(value)
     if typ in (int, float):
         return typ(value)
-    if typ is tuple:  # shape-like "(1, 2)" or "[1,2]" strings
+    if typ is tuple:  # shape-like "(1, 2)" / float-list "(1, 0.5)" strings
+        def elem(x):
+            f = float(x)
+            return int(f) if f.is_integer() else f
         if isinstance(value, str):
             s = value.strip().strip("()[]")
             if not s:
                 return ()
-            return tuple(int(float(x)) for x in s.replace(" ", "").split(",") if x != "")
+            return tuple(elem(x) for x in s.replace(" ", "").split(",") if x != "")
         if isinstance(value, (list, tuple)):
-            return tuple(int(v) for v in value)
-        return (int(value),)
+            return tuple(elem(v) for v in value)
+        return (elem(value),)
     if typ is str:
         return str(value)
     return typ(value)

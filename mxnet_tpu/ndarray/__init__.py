@@ -102,4 +102,6 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
            "CSRNDArray", "RowSparseNDArray"] + list(_GENERATED)
 
 from ..ops.registry import make_internal_namespace as _min  # noqa: E402
+from ..ops.registry import make_contrib_namespace as _mcn  # noqa: E402
 _internal = _min(_GENERATED, _OP_ALIASES)
+contrib = _mcn(_GENERATED)

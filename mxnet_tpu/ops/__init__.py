@@ -10,5 +10,6 @@ from . import nn             # noqa: F401
 from . import random_ops     # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import fork_ops       # noqa: F401
+from . import multibox       # noqa: F401
 
 __all__ = ["OpDef", "register_op", "get_op", "find_op", "list_ops", "OPS"]

@@ -1,0 +1,317 @@
+"""SSD multibox ops (reference: src/operator/contrib/multibox_prior-inl.h,
+multibox_target-inl.h, multibox_detection-inl.h).
+
+TPU-native formulation: everything is fixed-shape and jittable so the whole SSD
+training step compiles to one XLA program. The reference's sequential CPU/CUDA
+kernels become:
+  - MultiBoxPrior: a closed-form broadcast over the (H, W, anchor) grid.
+  - MultiBoxTarget: greedy bipartite matching as a `lax.fori_loop` over ground
+    truths (each iteration one vectorized argmax over the IoU matrix), then a
+    vectorized threshold match + top-k hard-negative mining, vmapped over batch.
+  - MultiBoxDetection: per-class NMS as a `lax.fori_loop` whose body masks a
+    whole row of the pairwise-IoU matrix at once (O(N) vector work per kept box
+    instead of the reference's nested scalar loops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import Params, param_field
+from .registry import register_op
+
+__all__ = ["multibox_prior", "multibox_target", "multibox_detection"]
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _corner_iou(a, b):
+    """IoU between two corner-format box sets: a (N,4), b (M,4) -> (N,M)."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0.0) * jnp.maximum(a[:, 3] - a[:, 1], 0.0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0.0) * jnp.maximum(b[:, 3] - b[:, 1], 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior (multibox_prior-inl.h)
+# ---------------------------------------------------------------------------
+
+class MultiBoxPriorParam(Params):
+    sizes = param_field(tuple, default=(1.0,))
+    ratios = param_field(tuple, default=(1.0,))
+    clip = param_field(bool, default=False)
+    steps = param_field(tuple, default=(-1.0, -1.0))
+    offsets = param_field(tuple, default=(0.5, 0.5))
+
+
+@register_op("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",),
+             param_cls=MultiBoxPriorParam)
+def _multibox_prior(params, data):
+    """Anchor grid over the feature map; corner format, normalized to [0,1].
+
+    Anchor set per cell = each size at ratios[0] + sizes[0] at each extra ratio
+    (reference kernel loop, multibox_prior-inl.h)."""
+    in_h, in_w = data.shape[2], data.shape[3]
+    sizes = [float(s) for s in params.sizes]
+    ratios = [float(r) for r in params.ratios]
+    step_y, step_x = params.steps
+    if step_y <= 0:
+        step_y = 1.0 / in_h
+    if step_x <= 0:
+        step_x = 1.0 / in_w
+    off_y, off_x = params.offsets
+
+    cy = (jnp.arange(in_h, dtype=jnp.float32) + off_y) * step_y
+    cx = (jnp.arange(in_w, dtype=jnp.float32) + off_x) * step_x
+
+    # half-widths/heights per anchor kind (aspect correction in_h/in_w keeps
+    # ratio-1 anchors square in pixel space, as in the reference kernel)
+    ws, hs = [], []
+    for s in sizes:  # sizes loop uses ratio=1 regardless of ratios[0]
+        ws.append(s * in_h / in_w / 2.0)
+        hs.append(s / 2.0)
+    for r in ratios[1:]:
+        sr = r ** 0.5
+        ws.append(sizes[0] * in_h / in_w * sr / 2.0)
+        hs.append(sizes[0] / sr / 2.0)
+    w = jnp.asarray(ws, dtype=jnp.float32)   # (A,)
+    h = jnp.asarray(hs, dtype=jnp.float32)
+
+    cyg = cy[:, None, None]                  # (H,1,1)
+    cxg = cx[None, :, None]                  # (1,W,1)
+    boxes = jnp.stack(jnp.broadcast_arrays(
+        cxg - w[None, None, :], cyg - h[None, None, :],
+        cxg + w[None, None, :], cyg + h[None, None, :]), axis=-1)  # (H,W,A,4)
+    out = boxes.reshape((1, -1, 4))
+    if params.clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget (multibox_target-inl.h)
+# ---------------------------------------------------------------------------
+
+class MultiBoxTargetParam(Params):
+    overlap_threshold = param_field(float, default=0.5)
+    ignore_label = param_field(float, default=-1.0)
+    negative_mining_ratio = param_field(float, default=-1.0)
+    negative_mining_thresh = param_field(float, default=0.5)
+    minimum_negative_samples = param_field(int, default=0)
+    variances = param_field(tuple, default=(0.1, 0.1, 0.2, 0.2))
+
+
+def _encode_targets(anchors, gt_boxes, variances):
+    """Corner boxes -> (dx, dy, dw, dh) regression targets (reference encoding)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    acy = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    gw = jnp.maximum(gt_boxes[:, 2] - gt_boxes[:, 0], 1e-8)
+    gh = jnp.maximum(gt_boxes[:, 3] - gt_boxes[:, 1], 1e-8)
+    gcx = (gt_boxes[:, 0] + gt_boxes[:, 2]) * 0.5
+    gcy = (gt_boxes[:, 1] + gt_boxes[:, 3]) * 0.5
+    v0, v1, v2, v3 = variances
+    tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / v0
+    ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / v1
+    tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / v2
+    th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / v3
+    return jnp.stack([tx, ty, tw, th], axis=1)
+
+
+def _match_one(anchors, label, cls_pred, p):
+    """Target assignment for one sample. anchors (N,4), label (O,5[+]),
+    cls_pred (C,N). Returns (box_target (N,4), box_mask (N,4), cls_target (N,))."""
+    num_anchors = anchors.shape[0]
+    num_obj = label.shape[0]
+    gt_cls = label[:, 0]
+    gt_boxes = label[:, 1:5]
+    valid_gt = gt_cls >= 0                                     # padding rows are -1
+
+    iou = _corner_iou(anchors, gt_boxes)                       # (N,O)
+    iou = jnp.where(valid_gt[None, :], iou, 0.0)
+
+    # --- stage 1: greedy bipartite matching (each gt claims its best anchor,
+    # highest-IoU pair first; reference multibox_target-inl.h "bipartite" loop)
+    NEG = jnp.asarray(-1.0, iou.dtype)
+
+    def bipartite_body(_, state):
+        matched_gt, work = state                               # (N,), (N,O)
+        flat = jnp.argmax(work)
+        best = work.reshape(-1)[flat]
+        ai = flat // num_obj
+        gi = flat % num_obj
+        hit = best > 1e-12
+        matched_gt = jnp.where(hit, matched_gt.at[ai].set(gi), matched_gt)
+        # retire this anchor row and this gt column
+        work = jnp.where(hit, work.at[ai, :].set(NEG).at[:, gi].set(NEG), work)
+        return matched_gt, work
+
+    matched_gt = jnp.full((num_anchors,), -1, jnp.int32)
+    matched_gt, _ = lax.fori_loop(0, num_obj, bipartite_body, (matched_gt, iou))
+
+    # --- stage 2: threshold matching for still-unmatched anchors
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    best_iou = jnp.max(iou, axis=1)
+    thresh_match = (matched_gt < 0) & (best_iou >= p.overlap_threshold)
+    matched_gt = jnp.where(thresh_match, best_gt, matched_gt)
+    is_pos = matched_gt >= 0
+
+    # --- classification target: gt class + 1 for matched, else background 0
+    safe_gt = jnp.maximum(matched_gt, 0)
+    cls_target = jnp.where(is_pos, gt_cls[safe_gt] + 1.0, 0.0)
+
+    # --- hard negative mining (reference: rank negatives by their max
+    # non-background confidence, keep ratio*num_pos, rest -> ignore_label)
+    if p.negative_mining_ratio > 0:
+        neg_cand = (~is_pos) & (best_iou < p.negative_mining_thresh)
+        # rank negatives by LOWEST background softmax probability
+        # (multibox_target.cc computes softmax(cls_pred)[0] and sorts ascending)
+        bg_prob = jax.nn.softmax(cls_pred, axis=0)[0]          # (N,)
+        neg_score = jnp.where(neg_cand, 1.0 - bg_prob, -jnp.inf)
+        num_pos = jnp.sum(is_pos.astype(jnp.int32))
+        max_neg = jnp.maximum(
+            (p.negative_mining_ratio * num_pos.astype(jnp.float32)).astype(jnp.int32),
+            p.minimum_negative_samples)
+        order = jnp.argsort(-neg_score)                        # best negatives first
+        rank = jnp.zeros((num_anchors,), jnp.int32).at[order].set(
+            jnp.arange(num_anchors, dtype=jnp.int32))
+        keep_neg = neg_cand & (rank < max_neg)
+        cls_target = jnp.where(is_pos, cls_target,
+                               jnp.where(keep_neg, 0.0, p.ignore_label))
+
+    # --- regression targets for positives
+    targets = _encode_targets(anchors, gt_boxes[safe_gt], p.variances)
+    box_mask = jnp.where(is_pos[:, None], 1.0, 0.0) * jnp.ones((1, 4), jnp.float32)
+    box_target = targets * box_mask
+    return box_target, box_mask, cls_target
+
+
+@register_op("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+             param_cls=MultiBoxTargetParam,
+             input_names=("anchor", "label", "cls_pred"), num_outputs=3,
+             output_names=("box_target", "box_mask", "cls_target"))
+def _multibox_target(params, anchor, label, cls_pred):
+    # non-differentiable op: reference backward writes zero grads
+    # (multibox_target-inl.h); stop_gradient also keeps the fori_loop
+    # matching loop out of reverse-mode AD.
+    anchor, label, cls_pred = map(lax.stop_gradient, (anchor, label, cls_pred))
+    anchors = anchor.reshape((-1, 4))
+    if label.ndim == 2:
+        label = label[None]
+    box_t, box_m, cls_t = jax.vmap(
+        lambda lab, cp: _match_one(anchors, lab, cp, params))(label, cls_pred)
+    batch = label.shape[0]
+    return (box_t.reshape((batch, -1)), box_m.reshape((batch, -1)), cls_t)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection (multibox_detection-inl.h)
+# ---------------------------------------------------------------------------
+
+class MultiBoxDetectionParam(Params):
+    clip = param_field(bool, default=True)
+    threshold = param_field(float, default=0.01)
+    background_id = param_field(int, default=0)
+    nms_threshold = param_field(float, default=0.5)
+    force_suppress = param_field(bool, default=False)
+    variances = param_field(tuple, default=(0.1, 0.1, 0.2, 0.2))
+    nms_topk = param_field(int, default=-1)
+
+
+def _decode_boxes(anchors, loc, variances, clip):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    acy = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    v0, v1, v2, v3 = variances
+    cx = loc[:, 0] * v0 * aw + acx
+    cy = loc[:, 1] * v1 * ah + acy
+    w = jnp.exp(loc[:, 2] * v2) * aw * 0.5
+    h = jnp.exp(loc[:, 3] * v3) * ah * 0.5
+    out = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _detect_one(cls_prob, loc_pred, anchors, p):
+    """One sample: cls_prob (C,N), loc_pred (N*4,) -> (N,6) [id,score,4×corner]."""
+    num_anchors = anchors.shape[0]
+    boxes = _decode_boxes(anchors, loc_pred.reshape((-1, 4)), p.variances, p.clip)
+
+    # per-anchor winning foreground class
+    fg = jnp.concatenate([cls_prob[:p.background_id],
+                          cls_prob[p.background_id + 1:]], axis=0)  # (C-1,N)
+    best = jnp.argmax(fg, axis=0)
+    score = jnp.max(fg, axis=0)
+    cls_id = best.astype(jnp.float32)  # ids exclude background; 0 = first fg class
+    cls_id = jnp.where(score >= p.threshold, cls_id, -1.0)
+    score = jnp.where(cls_id >= 0, score, 0.0)
+
+    # sort by score desc; NMS over the top-k prefix
+    order = jnp.argsort(-score)
+    k = p.nms_topk if p.nms_topk > 0 else num_anchors
+    k = min(k, num_anchors)
+    sid = cls_id[order]
+    sscore = score[order]
+    sboxes = boxes[order]
+
+    # nms_threshold outside (0, 1] disables NMS entirely
+    # (multibox_detection.cc skips when nms_threshold <= 0 or > 1)
+    if not (0.0 < p.nms_threshold <= 1.0):
+        return jnp.concatenate([sid[:, None], sscore[:, None], sboxes], axis=1)
+
+    iou = _corner_iou(sboxes[:k], sboxes[:k])                  # (k,k)
+    same_cls = sid[:k, None] == sid[None, :k]
+    suppress_pair = (iou > p.nms_threshold) if p.force_suppress else \
+        ((iou > p.nms_threshold) & same_cls)
+
+    def nms_body(i, keep):
+        active = keep[i] & (sid[i] >= 0)
+        # kill every later box this one suppresses
+        later = jnp.arange(k) > i
+        kill = active & later & suppress_pair[i]
+        return keep & ~kill
+
+    keep = lax.fori_loop(0, k, nms_body, jnp.ones((k,), bool))
+    sid_k = jnp.where(keep, sid[:k], -1.0)
+    sid = jnp.concatenate([sid_k, jnp.full((num_anchors - k,), -1.0)]) \
+        if k < num_anchors else sid_k
+    out = jnp.concatenate([sid[:, None], sscore[:, None], sboxes], axis=1)
+    return out
+
+
+@register_op("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+             param_cls=MultiBoxDetectionParam,
+             input_names=("cls_prob", "loc_pred", "anchor"))
+def _multibox_detection(params, cls_prob, loc_pred, anchor):
+    # non-differentiable (reference multibox_detection-inl.h backward is zero)
+    cls_prob, loc_pred, anchor = map(lax.stop_gradient, (cls_prob, loc_pred, anchor))
+    anchors = anchor.reshape((-1, 4))
+    return jax.vmap(lambda cp, lp: _detect_one(cp, lp, anchors, params))(
+        cls_prob, loc_pred)
+
+
+# functional aliases used by mx.nd.contrib
+def multibox_prior(*a, **k):
+    from .. import ndarray as nd
+    return nd.contrib.MultiBoxPrior(*a, **k)
+
+
+def multibox_target(*a, **k):
+    from .. import ndarray as nd
+    return nd.contrib.MultiBoxTarget(*a, **k)
+
+
+def multibox_detection(*a, **k):
+    from .. import ndarray as nd
+    return nd.contrib.MultiBoxDetection(*a, **k)

@@ -175,11 +175,14 @@ def _pooling(params, x):
             rem = (size - kernel[i]) % stride[i]
             extra = (stride[i] - rem) % stride[i] if rem else 0
             pads[2 + i] = (pad[i], pad[i] + extra)
+    # init values must be CONCRETE scalars (np, not jnp): a traced init defeats
+    # jax's monoid matching and reduce_window falls back to the generic,
+    # non-differentiable reduce_window_p under jit+vjp.
     if params.pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+        init = -_np.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, _np.asarray(init, x.dtype), lax.max,
                                  window, strides, pads)
-    summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add, window, strides, pads)
+    summed = lax.reduce_window(x, _np.asarray(0, x.dtype), lax.add, window, strides, pads)
     if params.pool_type == "sum":
         return summed
     return summed / float(_np.prod(kernel))

@@ -22,7 +22,7 @@ import jax
 from ..base import MXNetError, Params
 
 __all__ = ["OpDef", "register_op", "get_op", "find_op", "list_ops", "OPS",
-           "make_internal_namespace"]
+           "make_internal_namespace", "make_contrib_namespace"]
 
 
 def make_internal_namespace(generated, aliases):
@@ -40,6 +40,24 @@ def make_internal_namespace(generated, aliases):
             return fn
 
     return _InternalNamespace()
+
+
+def make_contrib_namespace(generated):
+    """`mx.nd.contrib` / `mx.sym.contrib`: exposes ops registered under a
+    `_contrib_` prefix by bare name (reference: python/mxnet/ndarray/contrib.py,
+    generated from the C-API's contrib op list)."""
+
+    class _ContribNamespace(object):
+        def __getattr__(self, name):
+            fn = generated.get("_contrib_" + name)
+            if fn is None:
+                raise AttributeError("no contrib op %r" % name)
+            return fn
+
+        def __dir__(self):
+            return [k[len("_contrib_"):] for k in generated if k.startswith("_contrib_")]
+
+    return _ContribNamespace()
 
 OPS = {}
 _ALIASES = {}

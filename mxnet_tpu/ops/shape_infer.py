@@ -102,3 +102,43 @@ def _rnn(params, shapes):
     if params.mode == "lstm":
         out["state_cell"] = out["state"]
     return out
+
+
+# --- loss-layer label shapes (reference: each op's FInferShape also infers the
+# label input from data, which is what lets inference-mode bind omit labels) ---
+
+@hook("SoftmaxOutput")
+def _softmax_output(params, shapes):
+    data = shapes.get("data")
+    if data is None:
+        return {}
+    if params.multi_output:
+        return {"label": (data[0],) + tuple(data[2:])}
+    if params.preserve_shape or len(data) > 2:
+        # reference (softmax_output-inl.h:366-370): label = dshape[:-1]
+        return {"label": tuple(data[:-1])}
+    return {"label": (data[0],)}
+
+
+@hook("SVMOutput")
+def _svm_output(params, shapes):
+    data = shapes.get("data")
+    return {"label": (data[0],)} if data else {}
+
+
+@hook("LinearRegressionOutput")
+def _linreg_output(params, shapes):
+    data = shapes.get("data")
+    return {"label": tuple(data)} if data else {}
+
+
+@hook("MAERegressionOutput")
+def _maereg_output(params, shapes):
+    data = shapes.get("data")
+    return {"label": tuple(data)} if data else {}
+
+
+@hook("LogisticRegressionOutput")
+def _logreg_output(params, shapes):
+    data = shapes.get("data")
+    return {"label": tuple(data)} if data else {}

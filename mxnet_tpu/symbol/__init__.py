@@ -121,4 +121,6 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "fromjson"
            "zeros", "ones", "arange"] + list(_GENERATED)
 
 from ..ops.registry import make_internal_namespace as _min  # noqa: E402
+from ..ops.registry import make_contrib_namespace as _mcn  # noqa: E402
 _internal = _min(_GENERATED, _OP_ALIASES)
+contrib = _mcn(_GENERATED)

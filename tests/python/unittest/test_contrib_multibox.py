@@ -1,0 +1,135 @@
+"""SSD multibox op tests (reference behavior: src/operator/contrib/multibox_*
++ tests/python/unittest/test_contrib_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_multibox_prior_shapes_and_values():
+    data = nd.zeros((1, 3, 2, 2))
+    anchors = nd.contrib.MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0,))
+    a = anchors.asnumpy()
+    assert a.shape == (1, 4, 4)
+    # first cell center (0.25, 0.25), half-extent 0.25 → [0, 0, 0.5, 0.5]
+    np.testing.assert_allclose(a[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # second cell center (0.75, 0.25)
+    np.testing.assert_allclose(a[0, 1], [0.5, 0.0, 1.0, 0.5], atol=1e-6)
+
+
+def test_multibox_prior_multi_anchor_count():
+    data = nd.zeros((1, 8, 4, 6))
+    anchors = nd.contrib.MultiBoxPrior(data, sizes=(0.5, 0.25),
+                                       ratios=(1, 2, 0.5), clip=True)
+    # anchors per cell = num_sizes + num_ratios - 1 = 4
+    assert anchors.shape == (1, 4 * 6 * 4, 4)
+    a = anchors.asnumpy()
+    assert a.min() >= 0.0 and a.max() <= 1.0
+
+
+def test_multibox_target_matching():
+    # one anchor dead-on a gt, one far away
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.4, 0.4],
+                                  [0.6, 0.6, 0.9, 0.9],
+                                  [0.0, 0.0, 0.05, 0.05]]], np.float32))
+    label = np.full((1, 2, 5), -1.0, np.float32)
+    label[0, 0] = [3, 0.1, 0.1, 0.4, 0.4]
+    cls_pred = np.zeros((1, 5, 3), np.float32)
+    bt, bm, ct = nd.contrib.MultiBoxTarget(anchors, nd.array(label),
+                                           nd.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 4.0          # class 3 + 1 (background offset)
+    assert ct[1] == 0.0 and ct[2] == 0.0
+    bm = bm.asnumpy().reshape(3, 4)
+    assert bm[0].sum() == 4 and bm[1].sum() == 0
+    # perfectly-matched anchor ⇒ zero regression target
+    bt = bt.asnumpy().reshape(3, 4)
+    np.testing.assert_allclose(bt[0], 0.0, atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    n = 16
+    anchors = np.zeros((1, n, 4), np.float32)
+    for i in range(n):
+        x = (i % 4) / 4.0
+        y = (i // 4) / 4.0
+        anchors[0, i] = [x, y, x + 0.25, y + 0.25]
+    label = np.full((1, 1, 5), -1.0, np.float32)
+    label[0, 0] = [0, 0.0, 0.0, 0.25, 0.25]
+    cls_pred = np.random.RandomState(0).rand(1, 3, n).astype(np.float32)
+    _, _, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred),
+        negative_mining_ratio=2.0, negative_mining_thresh=0.3)
+    ct = ct.asnumpy()[0]
+    assert (ct > 0).sum() == 1
+    assert (ct == 0).sum() == 2          # 2 × num_pos hard negatives
+    assert (ct == -1).sum() == n - 3     # rest ignored
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.11, 0.11, 0.31, 0.31],
+                         [0.6, 0.6, 0.8, 0.8]]], np.float32)
+    # class probs: (B, C=3, N); background row first
+    cls_prob = np.array([[[0.1, 0.2, 0.1],
+                          [0.8, 0.7, 0.1],
+                          [0.1, 0.1, 0.8]]], np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = nd.contrib.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                       nd.array(anchors),
+                                       nms_threshold=0.5, threshold=0.05)
+    o = out.asnumpy()[0]
+    kept = o[o[:, 0] >= 0]
+    # overlapping same-class anchors collapse to one + the distinct class-1 box
+    assert len(kept) == 2
+    classes = sorted(kept[:, 0].tolist())
+    assert classes == [0.0, 1.0]
+    # zero loc_pred ⇒ decoded box equals anchor box
+    best = kept[np.argmax(kept[:, 1])]
+    np.testing.assert_allclose(best[2:6], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+
+
+def test_multibox_detection_threshold():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3]]], np.float32)
+    cls_prob = np.array([[[0.99], [0.01]]], np.float32)
+    loc_pred = np.zeros((1, 4), np.float32)
+    out = nd.contrib.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                       nd.array(anchors), threshold=0.5)
+    assert (out.asnumpy()[0, :, 0] >= 0).sum() == 0
+
+
+def test_ssd_train_symbol_builds_and_steps():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "..", "..", "..", "example", "ssd"))
+    from symbol import symbol_builder
+
+    net = symbol_builder.get_symbol_train(
+        num_classes=3, num_filters=(512, 1024, 256),
+        sizes=symbol_builder.DEFAULT_SIZES[:3],
+        ratios=symbol_builder.DEFAULT_RATIOS[:3],
+        normalization=(20, -1, -1))
+    assert len(net.list_outputs()) == 4
+
+    mod = mx.mod.Module(net, label_names=("label",), context=[mx.cpu()])
+    batch = 2
+    data_shapes = [mx.io.DataDesc("data", (batch, 3, 64, 64))]
+    label_shapes = [mx.io.DataDesc("label", (batch, 4, 5))]
+    mod.bind(data_shapes=data_shapes, label_shapes=label_shapes)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+
+    rng = np.random.RandomState(0)
+    label = np.full((batch, 4, 5), -1.0, np.float32)
+    label[:, 0] = [1, 0.2, 0.2, 0.7, 0.7]
+    db = mx.io.DataBatch(
+        data=[nd.array(rng.rand(batch, 3, 64, 64).astype(np.float32))],
+        label=[nd.array(label)])
+    mod.forward_backward(db)
+    mod.update()
+    outs = mod.get_outputs()
+    assert outs[0].shape[0] == batch        # cls_prob
+    assert outs[3].shape[-1] == 6           # detections
