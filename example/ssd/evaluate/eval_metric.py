@@ -39,10 +39,17 @@ class MApMetric(mx.metric.EvalMetric):
         dets = dets[dets[:, 0] >= 0]
         order = np.argsort(-dets[:, 1])
         dets = dets[order]
+        # VOC protocol: "difficult" gts (column 5 flag, when present) are
+        # excluded from the gt count and neither reward nor penalize matches,
+        # unless use_difficult is set (reference eval_metric.py semantics)
+        if gts.shape[1] > 5 and not self.use_difficult:
+            difficult = gts[:, 5] > 0
+        else:
+            difficult = np.zeros(len(gts), bool)
         gt_matched = np.zeros(len(gts), bool)
         for cls in np.unique(np.concatenate([gts[:, 0], dets[:, 0]])).astype(int):
             self.counts.setdefault(cls, 0)
-            self.counts[cls] += int((gts[:, 0] == cls).sum())
+            self.counts[cls] += int(((gts[:, 0] == cls) & ~difficult).sum())
         for d in dets:
             cls = int(d[0])
             recs = self.records.setdefault(cls, [])
@@ -53,8 +60,12 @@ class MApMetric(mx.metric.EvalMetric):
             ious = self._iou(d[2:6], gts[cand, 1:5])
             j = np.argmax(ious)
             if ious[j] >= self.ovp_thresh:
-                gt_matched[cand[j]] = True
-                recs.append((d[1], 1))
+                if difficult[cand[j]]:
+                    # match to a difficult gt: ignore (no TP, no FP)
+                    gt_matched[cand[j]] = True
+                else:
+                    gt_matched[cand[j]] = True
+                    recs.append((d[1], 1))
             else:
                 recs.append((d[1], 0))
 

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference keeps hand-tuned CUDA kernels (src/operator/*.cu, cuDNN
+specializations); the TPU-native analog is a small set of Pallas kernels for
+ops XLA does not already fuse optimally — attention above all. Everything
+else rides XLA fusion (SURVEY.md §2.3 "TPU equivalent" column).
+"""
+from .flash_attention import flash_attention, blockwise_attention, attention_with_lse
+
+__all__ = ["flash_attention", "blockwise_attention", "attention_with_lse"]

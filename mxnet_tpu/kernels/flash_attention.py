@@ -1,0 +1,270 @@
+"""Flash attention: Pallas TPU kernel + jnp blockwise fallback.
+
+The reference framework (MXNet 1.2) predates transformers and has no attention
+op at all (SURVEY.md §5.7) — this is TPU-native new capability that the
+long-context stack (ring attention, `mxnet_tpu/parallel/ring_attention.py`)
+builds on.
+
+Design:
+- `attention_with_lse`: plain-jnp softmax attention that also returns the
+  log-sum-exp per query row. The lse is what makes streaming/ring composition
+  possible (merge partial results from different KV chunks exactly).
+- `blockwise_attention`: lax.scan over KV blocks with online-softmax
+  accumulation — compiler-friendly (static shapes, no data-dependent control
+  flow) and memory-linear in sequence length. Differentiable by jax.grad.
+- `flash_attention`: public entry. On TPU backends it runs a Pallas kernel
+  (fused QK^T -> online softmax -> PV in VMEM, grid over (batch*heads,
+  q blocks)) wrapped in `jax.custom_vjp`; the backward pass recomputes
+  attention blockwise from the saved lse (standard FlashAttention-2 recompute
+  strategy). On CPU it falls back to the blockwise jnp path so tests and the
+  driver's virtual-device runs behave identically.
+
+Shapes follow [batch, heads, seq, head_dim] throughout.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["flash_attention", "blockwise_attention", "attention_with_lse"]
+
+_NEG_INF = -1e30
+
+
+def _causal_mask(q_len, k_len, q_offset, k_offset, dtype=jnp.float32):
+    """Additive causal mask for a q block at global offset vs k block."""
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    k_pos = k_offset + jnp.arange(k_len)[None, :]
+    return jnp.where(q_pos >= k_pos, 0.0, _NEG_INF).astype(dtype)
+
+
+def attention_with_lse(q, k, v, *, causal=False, sm_scale=None,
+                       q_offset=0, k_offset=0, bias=None):
+    """Softmax attention returning (out, lse).
+
+    q: [..., Sq, D], k/v: [..., Sk, D]. `lse[..., Sq]` is logsumexp of the
+    scaled (and masked) logits over the key axis — the quantity needed to
+    merge partial attention over disjoint KV chunks (ring attention).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / _np.sqrt(q.shape[-1])
+    logits = jnp.einsum("...qd,...kd->...qk", q, k) * sm_scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        logits = logits + _causal_mask(q.shape[-2], k.shape[-2],
+                                       q_offset, k_offset, logits.dtype)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    weights = jnp.exp(logits - lse[..., None])
+    # fully-masked rows (ring steps ahead of the causal frontier): all logits
+    # are _NEG_INF so lse ~ _NEG_INF + log(Sk); zero the output and pin lse to
+    # _NEG_INF so merge_attention gives such chunks no weight
+    masked_out = lse > _NEG_INF / 2
+    weights = jnp.where(masked_out[..., None], weights, 0.0)
+    lse = jnp.where(masked_out, lse, _NEG_INF)
+    out = jnp.einsum("...qk,...kd->...qd", weights, v)
+    return out, lse
+
+
+def merge_attention(out_a, lse_a, out_b, lse_b):
+    """Exactly combine two partial attentions over disjoint key sets."""
+    m = jnp.maximum(lse_a, lse_b)
+    m = jnp.where(m > _NEG_INF / 2, m, 0.0)  # both chunks fully masked: avoid nan
+    wa = jnp.exp(lse_a - m)
+    wb = jnp.exp(lse_b - m)
+    s = wa + wb
+    denom = jnp.where(s == 0.0, 1.0, s)
+    out = (out_a * wa[..., None] + out_b * wb[..., None]) / denom[..., None]
+    # guarded log: s == 0 (both fully masked) stays at _NEG_INF without the
+    # log(0) -> -inf that poisons gradients (0 * inf = nan in the vjp)
+    lse = jnp.where(s > 0.0, m + jnp.log(denom), _NEG_INF)
+    return out, lse
+
+
+def blockwise_attention(q, k, v, *, causal=False, sm_scale=None,
+                        block_k=256, q_offset=0, k_offset=0):
+    """Memory-linear attention: lax.scan over KV blocks w/ online softmax.
+
+    Equivalent to full attention; peak memory O(Sq * block_k) instead of
+    O(Sq * Sk). Differentiable via jax.grad (scan transposes cleanly).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / _np.sqrt(q.shape[-1])
+    sk = k.shape[-2]
+    block_k = min(block_k, sk)
+    if sk % block_k != 0:  # fall back to one block if not divisible
+        block_k = sk
+    nblk = sk // block_k
+    # [nblk, ..., block_k, D]
+    ksplit = jnp.moveaxis(
+        k.reshape(k.shape[:-2] + (nblk, block_k, k.shape[-1])), -3, 0)
+    vsplit = jnp.moveaxis(
+        v.reshape(v.shape[:-2] + (nblk, block_k, v.shape[-1])), -3, 0)
+
+    sq = q.shape[-2]
+    # zero that *depends on* q/k/v: keeps shard_map varying-axis (vma) types
+    # of the scan carry consistent when this runs inside a manual region
+    zdep = (q.sum() * 0 + k.sum() * 0 + v.sum() * 0).astype(jnp.float32)
+    out0 = jnp.zeros(q.shape[:-1] + (v.shape[-1],), q.dtype) + zdep.astype(q.dtype)
+    lse0 = jnp.full(q.shape[:-1], _NEG_INF, jnp.float32) + zdep
+
+    def body(carry, blk):
+        out, lse, idx = carry
+        kb, vb = blk
+        ob, lb = attention_with_lse(
+            q, kb, vb, causal=causal, sm_scale=sm_scale,
+            q_offset=q_offset, k_offset=k_offset + idx * block_k)
+        out, lse = merge_attention(out, lse, ob, lb)
+        return (out, lse, idx + 1), None
+
+    (out, lse, _), _ = lax.scan(body, (out0, lse0, jnp.int32(0)),
+                                (ksplit, vsplit))
+    del sq
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel (forward) — FlashAttention-2 layout
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      sm_scale, causal, block_k, kv_len):
+    """One (batch*head, q-block) program: stream KV blocks through VMEM."""
+    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    block_q, d = q.shape
+    qi = pl.program_id(1)
+    q_off = qi * block_q
+
+    nblk = kv_len // block_k
+
+    def body(i, carry):
+        acc, m_i, l_i = carry
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_off + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_i - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_i * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(p, v_blk,
+                                             preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    if causal:
+        # only blocks up to the causal frontier contribute
+        hi = lax.div(q_off + block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, nblk)
+    else:
+        hi = nblk
+    acc, m_i, l_i = lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m_i + jnp.log(l_safe)
+
+
+try:  # Pallas import is lazy-safe: CPU-only envs still work via fallback
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
+                      interpret=False):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError("seq lengths must divide block sizes")
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    kernel = functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, block_k=block_k, kv_len=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_tpu(q, k, v, sm_scale, causal, block_q, block_k,
+                         interpret):
+    out, _ = _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
+                               interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
+                                 interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, do):
+    # FlashAttention recompute strategy: re-derive the blockwise forward under
+    # jax.vjp (memory-linear) rather than materializing S. XLA fuses this well
+    # on TPU; a hand-written Pallas backward is a later optimization.
+    q, k, v = res
+
+    def f(q, k, v):
+        out, _ = blockwise_attention(q, k, v, causal=causal,
+                                     sm_scale=sm_scale, block_k=block_k)
+        return out
+
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(do)
+
+
+_flash_attention_tpu.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal=False, sm_scale=None,
+                    block_q=512, block_k=512, use_pallas=None):
+    """Fused attention over [B, H, S, D] tensors.
+
+    `use_pallas=None` auto-selects: the Pallas kernel on TPU backends,
+    blockwise jnp elsewhere (identical numerics up to fp tolerance).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / _np.sqrt(q.shape[-1])
+    if use_pallas is None:
+        use_pallas = _HAS_PALLAS and jax.default_backend() == "tpu"
+    ok_shapes = (q.shape[2] % min(block_q, q.shape[2]) == 0
+                 and k.shape[2] % min(block_k, k.shape[2]) == 0)
+    if use_pallas and ok_shapes:
+        return _flash_attention_tpu(q, k, v, sm_scale, causal,
+                                    block_q, block_k, False)
+    out, _ = blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                 block_k=block_k)
+    return out

@@ -1,0 +1,229 @@
+"""Transformer LM — the long-context flagship model family.
+
+The reference's sequence stack tops out at fused RNNs + bucketing (SURVEY.md
+§5.7); transformers are the TPU-native capability that the parallel stack
+(ring attention, tensor parallelism) is designed around. This module is
+functional-first (params pytree + pure forward) so it composes with
+`jax.jit`/`shard_map`/`jax.checkpoint`; a Gluon block wrapper can ride on top.
+
+TPU design points:
+- per-layer params are **stacked** on a leading axis and the layer loop is a
+  `lax.scan` — one trace regardless of depth, and the leading axis doubles as
+  the pipeline-stage shard axis (`parallel/pipeline.py`).
+- attention runs inside a full-mesh `shard_map` island: heads shard over
+  'tp', sequence over 'sp' (ring or Ulysses), batch over 'dp'. Everything
+  else is plain jnp under jit — XLA inserts the TP collectives from the
+  weight shardings (scaling-book recipe).
+- `cfg.remat` wraps each block in `jax.checkpoint` (reference analog:
+  MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:277-300).
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..kernels.flash_attention import flash_attention
+from ..parallel.ring_attention import sequence_parallel_attention
+
+__all__ = ["TransformerConfig", "init_transformer", "transformer_forward",
+           "transformer_loss", "transformer_sharding_rules"]
+
+
+class TransformerConfig:
+    """Decoder-only LM config (GPT-style, pre-LN)."""
+
+    def __init__(self, vocab_size, num_layers=2, num_heads=4, d_model=128,
+                 d_ff=None, max_len=512, dtype=jnp.float32, remat=False,
+                 attn_impl="ring", block_k=512, dropout=0.0):
+        self.vocab_size = vocab_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.d_model = d_model
+        self.d_ff = d_ff or 4 * d_model
+        self.max_len = max_len
+        self.dtype = dtype
+        self.remat = remat
+        self.attn_impl = attn_impl  # 'ring' | 'ulysses' | 'full'
+        self.block_k = block_k
+        self.dropout = dropout
+        assert d_model % num_heads == 0
+
+
+def init_transformer(cfg, key):
+    """Params pytree; layer params stacked on axis 0 (scan/pipeline axis)."""
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.num_layers
+    keys = jax.random.split(key, 8)
+    s = 0.02
+
+    def norm(k, shape):
+        return (jax.random.normal(k, shape) * s).astype(cfg.dtype)
+
+    params = {
+        "embed": norm(keys[0], (cfg.vocab_size, d)),
+        "pos_embed": norm(keys[1], (cfg.max_len, d)),
+        "ln_f_scale": jnp.ones((d,), cfg.dtype),
+        "ln_f_bias": jnp.zeros((d,), cfg.dtype),
+        "layers": {
+            "wq": norm(keys[2], (L, d, d)),
+            "wk": norm(keys[3], (L, d, d)),
+            "wv": norm(keys[4], (L, d, d)),
+            "wo": norm(keys[5], (L, d, d)),
+            "w1": norm(keys[6], (L, d, f)),
+            "b1": jnp.zeros((L, f), cfg.dtype),
+            "w2": norm(keys[7], (L, f, d)),
+            "b2": jnp.zeros((L, d), cfg.dtype),
+            "ln1_scale": jnp.ones((L, d), cfg.dtype),
+            "ln1_bias": jnp.zeros((L, d), cfg.dtype),
+            "ln2_scale": jnp.ones((L, d), cfg.dtype),
+            "ln2_bias": jnp.zeros((L, d), cfg.dtype),
+        },
+    }
+    return params
+
+
+def transformer_sharding_rules(cfg, mesh):
+    """PartitionSpec pytree matching init_transformer's structure.
+
+    TP recipe: attention projections column-shard the head dim ('tp' on the
+    output axis of wq/wk/wv, input axis of wo); MLP shards d_ff; embedding
+    shards vocab. Layer-stacked leading axis stays unsharded here — the
+    pipeline path shards it over 'pp' instead.
+    """
+    tp = "tp" if "tp" in mesh.axis_names else None
+    return {
+        "embed": P(tp, None),
+        "pos_embed": P(),
+        "ln_f_scale": P(),
+        "ln_f_bias": P(),
+        "layers": {
+            "wq": P(None, None, tp),
+            "wk": P(None, None, tp),
+            "wv": P(None, None, tp),
+            "wo": P(None, tp, None),
+            "w1": P(None, None, tp),
+            "b1": P(None, tp),
+            "w2": P(None, tp, None),
+            "b2": P(),
+            "ln1_scale": P(),
+            "ln1_bias": P(),
+            "ln2_scale": P(),
+            "ln2_bias": P(),
+        },
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * scale + bias
+
+
+def _attention(q, k, v, cfg, mesh):
+    """[B, H, S, D] attention; shard_map island when a mesh is given."""
+    if mesh is None:
+        return flash_attention(q, k, v, causal=True, block_k=cfg.block_k)
+    names = mesh.axis_names
+    bq = "dp" if "dp" in names else None
+    hq = "tp" if "tp" in names else None
+    impl = cfg.attn_impl
+    # impl='full' keeps the sequence replicated (no SP): sharding it over 'sp'
+    # without a ring/all-to-all would silently block-diagonalize attention
+    sq = "sp" if ("sp" in names and impl != "full") else None
+    spec = P(bq, hq, sq, None)
+
+    def local(q, k, v):
+        if sq is None or impl == "full":
+            return flash_attention(q, k, v, causal=True, block_k=cfg.block_k)
+        return sequence_parallel_attention(q, k, v, sq, impl=impl,
+                                           causal=True, block_k=cfg.block_k)
+
+    # pad sequence to a multiple of the sp degree: causal masking keeps
+    # end-padding invisible to real query positions
+    S = q.shape[2]
+    n_sp = mesh.shape[sq] if sq is not None else 1
+    pad = (-S) % n_sp
+    if pad:
+        padw = ((0, 0), (0, 0), (0, pad), (0, 0))
+        q, k, v = (jnp.pad(t, padw) for t in (q, k, v))
+    out = jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
+                        out_specs=spec)(q, k, v)
+    return out[:, :, :S] if pad else out
+
+
+def _dropout(x, rate, key):
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def _block(x, lp, cfg, mesh, key=None):
+    """One pre-LN decoder block. x: [B, S, D]; key enables dropout."""
+    B, S, d = x.shape
+    H, Dh = cfg.num_heads, cfg.d_model // cfg.num_heads
+    h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+    q = (h @ lp["wq"]).reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+    k = (h @ lp["wk"]).reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+    v = (h @ lp["wv"]).reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+    a = _attention(q, k, v, cfg, mesh)
+    a = a.transpose(0, 2, 1, 3).reshape(B, S, d)
+    a = a @ lp["wo"]
+    if key is not None:
+        k1, k2 = jax.random.split(key)
+        a = _dropout(a, cfg.dropout, k1)
+    x = x + a
+    h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+    h = jax.nn.gelu(h @ lp["w1"] + lp["b1"])
+    h = h @ lp["w2"] + lp["b2"]
+    if key is not None:
+        h = _dropout(h, cfg.dropout, k2)
+    x = x + h
+    return x
+
+
+def transformer_forward(params, tokens, cfg, mesh=None, rng=None,
+                        train=False):
+    """tokens: [B, S] int32 -> logits [B, S, vocab].
+
+    Dropout is applied only when `train` and `cfg.dropout > 0` and an `rng`
+    key is given (per-layer keys derived inside the layer scan).
+    """
+    B, S = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = x + params["pos_embed"][:S].astype(cfg.dtype)
+    use_dropout = train and cfg.dropout > 0.0 and rng is not None
+
+    block = lambda x, lp, key: _block(x, lp, cfg, mesh, key=key)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def body(carry, lp):
+        x, key = carry
+        if use_dropout:
+            key, sub = jax.random.split(key)
+        else:
+            sub = None
+        return (block(x, lp, sub), key), None
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    (x, _), _ = lax.scan(body, (x, rng), params["layers"])
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = x @ params["embed"].T.astype(cfg.dtype)
+    return logits
+
+
+def transformer_loss(params, tokens, targets, cfg, mesh=None, rng=None,
+                     train=True):
+    """Mean next-token cross-entropy. targets: [B, S] int32 (-1 = ignore)."""
+    logits = transformer_forward(params, tokens, cfg, mesh=mesh, rng=rng,
+                                 train=train)
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.maximum(targets, 0)
+    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    mask = (targets >= 0).astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -5,6 +5,12 @@ ps-lite): XLA collectives over ICI/DCN driven by jax.sharding.Mesh + shard_map.
 """
 from .mesh import get_mesh, data_parallel_mesh, ShardingConfig
 from .collectives import allreduce_hosts, host_barrier
+from .ring_attention import (ring_attention, ulysses_attention,
+                             sequence_parallel_attention)
+from .sharded_step import ShardedTrainStep
+from .pipeline import pipeline_apply, PipelinedTrainStep
 
 __all__ = ["get_mesh", "data_parallel_mesh", "ShardingConfig",
-           "allreduce_hosts", "host_barrier"]
+           "allreduce_hosts", "host_barrier", "ring_attention",
+           "ulysses_attention", "sequence_parallel_attention",
+           "ShardedTrainStep", "pipeline_apply", "PipelinedTrainStep"]

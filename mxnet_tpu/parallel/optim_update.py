@@ -1,0 +1,53 @@
+"""Tree-level fused optimizer updates shared by the sharded/pipelined/DP
+train steps.
+
+One definition of the in-program update math (the reference runs this on the
+PS server / in optimizer_op.cc kernels; here it fuses into the jitted step).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["init_opt_state", "apply_update"]
+
+_tm = jax.tree_util.tree_map
+
+
+def init_opt_state(optimizer, params, momentum=0.0):
+    """Optimizer-state pytree for 'sgd' (momentum optional) or 'adam'."""
+    if optimizer == "adam":
+        return {"m": _tm(jnp.zeros_like, params),
+                "v": _tm(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+    if optimizer == "sgd":
+        return {"mom": _tm(jnp.zeros_like, params) if momentum else None}
+    raise ValueError("unknown optimizer %r" % optimizer)
+
+
+def apply_update(optimizer, hp, params, opt_state, grads):
+    """(params, opt_state) -> (new_params, new_opt_state).
+
+    hp: dict with lr and, per optimizer, momentum / beta1 / beta2 / eps.
+    Pure and jit-safe; weight decay and clipping are the caller's concern.
+    """
+    lr = hp["lr"]
+    if optimizer == "adam":
+        b1, b2, eps = hp["beta1"], hp["beta2"], hp["eps"]
+        t = opt_state["t"] + 1
+        m = _tm(lambda m, g: b1 * m + (1 - b1) * g, opt_state["m"], grads)
+        v = _tm(lambda v, g: b2 * v + (1 - b2) * g * g, opt_state["v"], grads)
+        tf = t.astype(jnp.float32)
+        corr = jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+        params = _tm(lambda p, m, v: p - lr * corr * m / (jnp.sqrt(v) + eps),
+                     params, m, v)
+        return params, {"m": m, "v": v, "t": t}
+    if optimizer == "sgd":
+        momentum = hp.get("momentum", 0.0)
+        if opt_state["mom"] is not None:
+            mom = _tm(lambda mo, g: momentum * mo - lr * g,
+                      opt_state["mom"], grads)
+            params = _tm(lambda p, mo: p + mo, params, mom)
+            return params, {"mom": mom}
+        return _tm(lambda p, g: p - lr * g, params, grads), opt_state
+    raise ValueError("unknown optimizer %r" % optimizer)

@@ -1,0 +1,172 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a 'pp' mesh axis.
+
+The reference's closest feature is manual per-layer device placement with no
+microbatching (`group2ctx` model parallelism, SURVEY.md §2.8 — the 8-GPU LSTM
+example). TPU-native design: layer-stacked parameters shard their leading axis
+over 'pp' (each device owns a contiguous stage of layers); activations hop
+stages with `lax.ppermute` (neighbor ICI hops); microbatches keep every stage
+busy in the standard (M + P - 1)-step schedule. Backward differentiates
+through the whole schedule (ppermute transposes to the reverse hop), so one
+`jax.grad` gives pipeline-parallel training with no hand-written backward.
+
+Everything is expressed inside ONE `shard_map` + `lax.fori_loop` — a single
+XLA program per step, compiler-visible overlap of compute and ICI transfer.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["pipeline_apply", "PipelinedTrainStep"]
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
+    """Run microbatches through pipeline stages; call inside shard_map.
+
+    stage_fn(stage_params, x) -> y : applies this device's layers (same
+        output shape as input).
+    stage_params : pytree whose leaves are this device's stage shard.
+    microbatches : [M, mb, ...] — full input, replicated across 'pp'
+        (only stage 0 reads it).
+    Returns [M, mb, ...] final-stage outputs, replicated across 'pp'.
+    """
+    n = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    steps = M + n - 1
+
+    zdep = sum(jnp.sum(l) * 0 for l in jax.tree_util.tree_leaves(stage_params))
+    zdep = (zdep + microbatches.sum() * 0).astype(microbatches.dtype)
+    buf0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype) + zdep
+    outs0 = jnp.zeros_like(microbatches) + zdep
+    fwd_perm = [(i, i + 1) for i in range(n - 1)]
+
+    def body(t, carry):
+        outs, buf = carry
+        mb = lax.dynamic_index_in_dim(microbatches,
+                                      jnp.clip(t, 0, M - 1), 0,
+                                      keepdims=False)
+        x_in = jnp.where(stage == 0, mb, buf)
+        y = stage_fn(stage_params, x_in)
+        out_idx = t - (n - 1)
+        valid = jnp.logical_and(stage == n - 1,
+                                jnp.logical_and(out_idx >= 0, out_idx < M))
+        upd = lax.dynamic_update_index_in_dim(
+            outs, y.astype(outs.dtype), jnp.clip(out_idx, 0, M - 1), 0)
+        outs = jnp.where(valid, upd, outs)
+        buf = lax.ppermute(y, axis_name, fwd_perm)
+        return outs, buf
+
+    outs, _ = lax.fori_loop(0, steps, body, (outs0, buf0))
+    # replicate final-stage outputs to all pp ranks (zeros elsewhere)
+    return lax.psum(outs, axis_name)
+
+
+class PipelinedTrainStep:
+    """Full pp x dp training step for layer-stacked models.
+
+    Parameters
+    ----------
+    embed_fn(io_params, batch) -> x : stage-0 preprocessing (e.g. embedding),
+        computed redundantly on every pp rank (cheap vs layer stack).
+    stage_fn(layer_params, x) -> x : the stacked-layer body; layer_params
+        leaves have leading layer axis, sharded over 'pp'.
+    loss_fn(io_params, x, batch) -> scalar : final head + loss.
+    """
+
+    def __init__(self, embed_fn, stage_fn, loss_fn, mesh, num_microbatches,
+                 lr=1e-3, optimizer="sgd", momentum=0.9):
+        if "pp" not in mesh.axis_names:
+            raise MXNetError("mesh needs a 'pp' axis")
+        self.mesh = mesh
+        self.embed_fn = embed_fn
+        self.stage_fn = stage_fn
+        self.loss_fn = loss_fn
+        self.M = num_microbatches
+        self.lr = lr
+        self.momentum = momentum if optimizer == "sgd" else 0.0
+        self._step_fn = None
+
+    def init(self, io_params, layer_params):
+        mesh = self.mesh
+        self._io_spec = jax.tree_util.tree_map(lambda _: P(), io_params)
+        self._layer_spec = jax.tree_util.tree_map(lambda _: P("pp"),
+                                                  layer_params)
+        io_sh = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), io_params)
+        layer_sh = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P("pp")), layer_params)
+        self.io_params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s), io_params, io_sh)
+        self.layer_params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s),
+            layer_params, layer_sh)
+        self.moms = (jax.tree_util.tree_map(jnp.zeros_like, self.io_params),
+                     jax.tree_util.tree_map(jnp.zeros_like,
+                                            self.layer_params))
+        self._build()
+        return self
+
+    def _build(self):
+        mesh, M = self.mesh, self.M
+        embed_fn, stage_fn, loss_fn = (self.embed_fn, self.stage_fn,
+                                       self.loss_fn)
+        lr, momentum = self.lr, self.momentum
+        dp = "dp" if "dp" in mesh.axis_names else None
+        batch_spec = P(dp)
+
+        def device_step(io_params, layer_params, io_moms, layer_moms, batch):
+            def local_loss(io_params, layer_params):
+                x = embed_fn(io_params, batch)           # [b_local, ...]
+                mb_shape = (M, x.shape[0] // M) + x.shape[1:]
+                mbs = x.reshape(mb_shape)
+                def sf(lp, xm):
+                    return stage_fn(lp, xm)
+                y = pipeline_apply(sf, layer_params, mbs, "pp")
+                y = y.reshape(x.shape)
+                loss = loss_fn(io_params, y, batch)
+                if dp:
+                    loss = lax.pmean(loss, dp)
+                return loss
+
+            loss, (g_io, g_layer) = jax.value_and_grad(
+                local_loss, argnums=(0, 1))(io_params, layer_params)
+            if dp:  # replicated io params: average grads over data shards
+                g_io = jax.tree_util.tree_map(lambda g: lax.pmean(g, dp), g_io)
+                g_layer = jax.tree_util.tree_map(lambda g: lax.pmean(g, dp),
+                                                 g_layer)
+
+            from .optim_update import apply_update
+            hp = {"lr": lr, "momentum": momentum}
+            new_io, io_state = apply_update("sgd", hp, io_params,
+                                            {"mom": io_moms}, g_io)
+            new_layer, layer_state = apply_update("sgd", hp, layer_params,
+                                                  {"mom": layer_moms}, g_layer)
+            return (new_io, new_layer, io_state["mom"], layer_state["mom"],
+                    loss)
+
+        shmapped = jax.shard_map(
+            device_step, mesh=mesh,
+            in_specs=(self._io_spec, self._layer_spec,
+                      self._io_spec, self._layer_spec, batch_spec),
+            out_specs=(self._io_spec, self._layer_spec,
+                       self._io_spec, self._layer_spec, P()),
+            check_vma=False)
+        self._step_fn = jax.jit(shmapped, donate_argnums=(0, 1, 2, 3))
+        self._batch_sharding = NamedSharding(mesh, batch_spec)
+
+    def __call__(self, batch):
+        if self._step_fn is None:
+            raise MXNetError("call init() first")
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding)
+            if hasattr(x, "shape") and getattr(x, "ndim", 0) else x, batch)
+        (self.io_params, self.layer_params, iom, lm, loss) = self._step_fn(
+            self.io_params, self.layer_params, *self.moms, batch)
+        self.moms = (iom, lm)
+        return loss

@@ -1,0 +1,105 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO long-context story (SURVEY.md §5.7 — bucketing + fused
+RNN only); this module is the TPU-native first-class replacement. Two
+strategies, both written for `shard_map` bodies where the sequence axis of
+q/k/v is sharded over a named mesh axis:
+
+- **Ring attention** (`ring_attention`): each device keeps its Q chunk
+  resident and rotates KV chunks around the ring with `lax.ppermute`
+  (neighbor exchange -> rides ICI, never DCN). Partial results from each KV
+  chunk are merged exactly via the streaming-softmax lse trick
+  (`kernels.flash_attention.merge_attention`), so the result is bitwise-close
+  to full attention at O(S/n) memory per device. Compute for step i overlaps
+  XLA-async with the permute of step i+1.
+- **Ulysses** (`ulysses_attention`): `all_to_all` re-shards [B, S/n, H, D] to
+  [B, S, H/n, D], runs dense local attention over full sequence per head
+  group, and re-shards back. Cheaper at moderate S (two all-to-alls vs n-1
+  permutes) but caps the parallelism degree at the head count.
+
+Both are differentiable (ppermute/all_to_all have transposes) so they sit
+directly inside jitted train steps.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..kernels.flash_attention import (attention_with_lse, merge_attention,
+                                       blockwise_attention)
+
+__all__ = ["ring_attention", "ulysses_attention", "sequence_parallel_attention"]
+
+
+def ring_attention(q, k, v, axis_name, *, causal=False, sm_scale=None,
+                   block_k=512):
+    """Ring attention over a sharded sequence axis.
+
+    Must be called inside `shard_map`; `q`, `k`, `v` are the per-device
+    [B, H, S_local, D] chunks of sequence sharded over `axis_name`. Returns
+    the per-device [B, H, S_local, D] output chunk.
+
+    Reference role: this is the SP analog of the reference's collective layer
+    (src/kvstore/comm.h reduce trees) — but as in-graph XLA collectives.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / _np.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    s_local = q.shape[-2]
+    q_offset = idx * s_local
+
+    zdep = (q.sum() * 0 + k.sum() * 0 + v.sum() * 0).astype(jnp.float32)
+    out0 = jnp.zeros(q.shape[:-1] + (v.shape[-1],), q.dtype) + zdep.astype(q.dtype)
+    lse0 = jnp.full(q.shape[:-1], -1e30, jnp.float32) + zdep
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        out, lse, kc, vc = carry
+        # at `step`, this device holds the KV chunk that originated on
+        # device (idx - step) mod n
+        src = lax.rem(idx - step + n, n)
+        ob, lb = blockwise_attention(
+            q, kc, vc, causal=causal, sm_scale=sm_scale,
+            q_offset=q_offset, k_offset=src * kc.shape[-2], block_k=block_k)
+        out, lse = merge_attention(out, lse, ob, lb.astype(jnp.float32))
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return out, lse, kc, vc
+
+    out, _, _, _ = lax.fori_loop(0, n, body, (out0, lse0, k, v))
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, *, causal=False, sm_scale=None):
+    """Ulysses sequence parallelism: all-to-all seq<->head re-shard.
+
+    q/k/v: per-device [B, H, S_local, D] with H divisible by the axis size.
+    """
+    n = lax.psum(1, axis_name)
+    # [B, H, S_local, D] -> [B, H/n, S, D]: split heads across devices,
+    # gather sequence. all_to_all(split_axis=H, concat_axis=S)
+    qg = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    out, _ = attention_with_lse(qg, kg, vg, causal=causal, sm_scale=sm_scale)
+    # back: split sequence, gather heads
+    out = lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    del n
+    return out.astype(q.dtype)
+
+
+def sequence_parallel_attention(q, k, v, axis_name, *, impl="ring",
+                                causal=False, sm_scale=None, block_k=512):
+    """Dispatch between SP strategies by name ('ring' | 'ulysses')."""
+    if impl == "ring":
+        return ring_attention(q, k, v, axis_name, causal=causal,
+                              sm_scale=sm_scale, block_k=block_k)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, axis_name, causal=causal,
+                                 sm_scale=sm_scale)
+    raise ValueError("unknown sequence-parallel impl %r" % impl)

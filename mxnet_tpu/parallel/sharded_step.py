@@ -1,0 +1,124 @@
+"""Multi-axis sharded train step: dp x tp x sp in ONE jitted program.
+
+Generalizes `tpu_step.DataParallelTrainStep` beyond pure DP: parameters carry
+arbitrary `PartitionSpec`s (tensor parallelism), the batch shards over 'dp',
+the sequence axis over 'sp' (ring attention inside the model), and XLA derives
+every collective from the sharding annotations — the scaling-book recipe,
+replacing the reference's explicit KVStore push/pull + ps-lite/NCCL comm
+(SURVEY.md §2.4, §3.2).
+
+Optimizers run inside the same program with buffer donation ("update on
+kvstore" semantics — the reference runs the optimizer on the PS server,
+kvstore_dist_server.h:282; here it fuses into the step).
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["ShardedTrainStep"]
+
+
+class ShardedTrainStep:
+    """jit(loss -> grads -> optimizer) over an arbitrary mesh.
+
+    Parameters
+    ----------
+    loss_fn : callable(params, batch) -> scalar loss
+        Pure; `batch` is a pytree of arrays with leading batch dim.
+    mesh : jax.sharding.Mesh
+    param_specs : pytree of PartitionSpec matching params
+    batch_spec : PartitionSpec for batch leaves (default: shard dim 0 on 'dp')
+    optimizer : 'sgd' | 'adam'
+    """
+
+    def __init__(self, loss_fn, mesh, param_specs, batch_spec=None,
+                 optimizer="adam", lr=1e-3, momentum=0.9, wd=0.0,
+                 beta1=0.9, beta2=0.999, eps=1e-8, grad_clip=None):
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.param_specs = param_specs
+        if batch_spec is None:
+            batch_spec = P("dp" if "dp" in mesh.axis_names else
+                           mesh.axis_names[0])
+        self.batch_spec = batch_spec
+        self.optimizer = optimizer
+        self.hp = dict(lr=lr, momentum=momentum, wd=wd, beta1=beta1,
+                       beta2=beta2, eps=eps, grad_clip=grad_clip)
+        self._step_fn = None
+        self.step_count = 0
+
+    # ------------------------------------------------------------------
+    def _shard(self, tree, specs):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(jnp.asarray(x),
+                                        NamedSharding(self.mesh, s)),
+            tree, specs)
+
+    def init(self, params):
+        """Place params on the mesh per spec; allocate optimizer state."""
+        from .optim_update import init_opt_state
+        self.params = self._shard(params, self.param_specs)
+        if self.optimizer not in ("adam", "sgd"):
+            raise MXNetError("unknown optimizer %r" % self.optimizer)
+        self.opt_state = init_opt_state(self.optimizer, self.params,
+                                        momentum=self.hp["momentum"])
+        self._build()
+        return self
+
+    def _build(self):
+        hp = self.hp
+        opt = self.optimizer
+        loss_fn = self.loss_fn
+
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            if hp["grad_clip"]:
+                gnorm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                                     for g in jax.tree_util.tree_leaves(grads)))
+                scale = jnp.minimum(1.0, hp["grad_clip"] / (gnorm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            if hp["wd"]:
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g + hp["wd"] * p, grads, params)
+            from .optim_update import apply_update
+            params, opt_state = apply_update(opt, hp, params, opt_state, grads)
+            return params, opt_state, loss
+
+        # optimizer state shards like its param
+        if self.optimizer == "adam":
+            opt_specs = {"m": self.param_specs, "v": self.param_specs,
+                         "t": P()}
+        else:
+            opt_specs = {"mom": self.param_specs
+                         if self.opt_state["mom"] is not None else None}
+        param_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), self.param_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        opt_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), opt_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        self._batch_sharding = NamedSharding(self.mesh, self.batch_spec)
+        self._step_fn = jax.jit(
+            step,
+            in_shardings=(param_sh, opt_sh, None),
+            out_shardings=(param_sh, opt_sh, NamedSharding(self.mesh, P())),
+            donate_argnums=(0, 1))
+        self.opt_state = self._shard(self.opt_state, opt_specs)
+
+    # ------------------------------------------------------------------
+    def __call__(self, batch):
+        """One step on a global batch (pytree of numpy/jax arrays)."""
+        if self._step_fn is None:
+            raise MXNetError("call init() first")
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding),
+            batch)
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, batch)
+        self.step_count += 1
+        return loss

@@ -107,16 +107,13 @@ class DataParallelTrainStep:
             outs, vjp, aux_upd = jax.vjp(loss_fn, params, has_aux=True)
             seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads = vjp(seeds)[0]
-            new_params, new_moms = {}, {}
-            for name, p in params.items():
-                g = grads[name] * rescale + wd * p
-                if momentum:
-                    m = momentum * moms[name] - lr * g
-                    new_moms[name] = m
-                    new_params[name] = p + m
-                else:
-                    new_params[name] = p - lr * g
-            return new_params, new_moms, aux_upd, outs
+            from .optim_update import apply_update
+            grads = {name: grads[name] * rescale + wd * p
+                     for name, p in params.items()}
+            new_params, state = apply_update(
+                "sgd", {"lr": lr, "momentum": momentum}, params,
+                {"mom": moms if momentum else None}, grads)
+            return new_params, state["mom"] if momentum else {}, aux_upd, outs
 
         in_shardings = (
             {n: self._repl for n in self.param_names},
