@@ -1,0 +1,88 @@
+"""Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
+
+Absent in the reference (SURVEY.md §2.8: no EP/MoE); TPU-native capability.
+Design: switch (top-1) routing with capacity buffers, expressed as dense
+einsums with one-hot dispatch/combine masks — static shapes throughout, so
+XLA can tile everything onto the MXU — and `lax.all_to_all` over 'ep' to move
+token buffers to the devices that own their experts (the canonical
+expert-parallel exchange; rides ICI).
+
+All functions are shard_map bodies: call inside `jax.shard_map` with the
+token axis sharded over 'ep' (and/or 'dp') and expert weights sharded on
+their leading expert axis over 'ep'.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["init_moe_ffn", "moe_ffn"]
+
+
+def init_moe_ffn(key, num_experts, d_model, d_ff, dtype=jnp.float32):
+    """Params for a switch-FFN layer. Leading expert axis shards over 'ep'."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    s = 0.02
+    return {
+        "wg": (jax.random.normal(k1, (d_model, num_experts)) * s).astype(dtype),
+        "w1": (jax.random.normal(k2, (num_experts, d_model, d_ff)) * s).astype(dtype),
+        "w2": (jax.random.normal(k3, (num_experts, d_ff, d_model)) * s).astype(dtype),
+    }
+
+
+def moe_ffn(params, x, axis_name="ep", capacity_factor=2.0, num_experts=None):
+    """Switch-routed expert FFN; shard_map body.
+
+    params: {'wg': [d, E] replicated, 'w1': [e_local, d, f], 'w2':
+        [e_local, f, d]} — expert leaves pre-sharded over `axis_name`.
+    x: [T_local, d] local token slab.
+    Returns ([T_local, d], aux_loss) — aux_loss is the switch load-balancing
+    loss, E * sum_e(load_e * importance_e) (Switch Transformer eq. 4),
+    pmean-ed over `axis_name`.
+    """
+    n = lax.psum(1, axis_name)
+    e_local = params["w1"].shape[0]
+    E = num_experts or e_local * n
+    T, d = x.shape
+    C = int(_np.ceil(capacity_factor * T / E))
+
+    gate_logits = x @ params["wg"]                   # [T, E]
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)              # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    # position of each token within its expert's capacity buffer
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)          # [T, E]
+    pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot              # [T, E]
+    pos_tok = jnp.sum(pos, axis=1)                               # [T]
+    keep = pos_tok < C
+    # dispatch/combine one-hots (dropped tokens vanish)
+    disp = (jax.nn.one_hot(expert, E)[:, :, None] *
+            jax.nn.one_hot(jnp.clip(pos_tok, 0, C - 1), C)[:, None, :] *
+            keep[:, None, None])                                 # [T, E, C]
+    comb = disp * gate[:, None, None]
+
+    # load-balancing loss (Switch Transformer eq. 4)
+    load = jnp.mean(jax.nn.one_hot(expert, E, dtype=jnp.float32), axis=0)
+    importance = jnp.mean(probs, axis=0)
+    aux_loss = lax.pmean(E * jnp.sum(load * importance), axis_name)
+
+    buf = jnp.einsum("tec,td->ecd", disp, x)                     # [E, C, d]
+    # exchange: send each expert's buffer to its owner device
+    buf = buf.reshape(n, e_local, C, d)
+    buf = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)                            # [n, e_local, C, d]
+    buf = jnp.moveaxis(buf, 0, 1).reshape(e_local, n * C, d)
+
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", buf, params["w1"]))
+    out = jnp.einsum("ecf,efd->ecd", h, params["w2"])            # [e_local, n*C, d]
+
+    # reverse exchange
+    out = jnp.moveaxis(out.reshape(e_local, n, C, d), 1, 0)
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)
+    out = out.reshape(E, C, d)
+    y = jnp.einsum("tec,ecd->td", comb, out)
+    return y.astype(x.dtype), aux_loss

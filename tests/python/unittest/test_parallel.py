@@ -148,6 +148,53 @@ def test_sharded_step_sgd_momentum():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.parametrize("n_dev", [1, 4])
+def test_moe_expert_parallel_matches_dense(n_dev):
+    from mxnet_tpu.parallel.moe import init_moe_ffn, moe_ffn
+    E, d, f = 8, 16, 32
+    params = init_moe_ffn(jax.random.PRNGKey(0), E, d, f)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(0, 1, (64, d)).astype(np.float32))
+    probs = jax.nn.softmax(x @ params["wg"], -1)
+    e_star = jnp.argmax(probs, -1)
+    gate = jnp.take_along_axis(probs, e_star[:, None], 1)[:, 0]
+    h = jax.nn.gelu(jnp.einsum("td,edf->tef", x, params["w1"]))
+    ally = jnp.einsum("tef,efd->ted", h, params["w2"])
+    ref = gate[:, None] * jnp.take_along_axis(
+        ally, e_star[:, None, None].repeat(d, 2), 1)[:, 0]
+
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("ep",))
+    fn = jax.jit(jax.shard_map(
+        lambda p, x: moe_ffn(p, x, "ep", capacity_factor=8.0),
+        mesh=mesh,
+        in_specs=({"wg": P(), "w1": P("ep"), "w2": P("ep")}, P("ep")),
+        out_specs=(P("ep"), P())))
+    y, aux = fn(params, x)
+    np.testing.assert_allclose(ref, y, atol=1e-5)
+    assert 0.5 < float(aux) < float(E)
+    grads = jax.grad(lambda p: fn(p, x)[0].sum())(params)
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree_util.tree_leaves(grads))
+
+
+def test_moe_capacity_drops_tokens():
+    """With capacity_factor << 1 most tokens are dropped (output rows ~0)."""
+    from mxnet_tpu.parallel.moe import init_moe_ffn, moe_ffn
+    E, d, f = 8, 16, 32
+    params = init_moe_ffn(jax.random.PRNGKey(0), E, d, f)
+    x = jnp.asarray(np.random.RandomState(0).normal(
+        0, 1, (64, d)).astype(np.float32))
+    mesh = Mesh(np.array(jax.devices()[:1]), ("ep",))
+    fn = jax.jit(jax.shard_map(
+        lambda p, x: moe_ffn(p, x, "ep", capacity_factor=0.25),
+        mesh=mesh,
+        in_specs=({"wg": P(), "w1": P("ep"), "w2": P("ep")}, P("ep")),
+        out_specs=(P("ep"), P())))
+    y, _ = fn(params, x)
+    dropped = (np.abs(np.asarray(y)).max(axis=1) == 0.0).sum()
+    assert dropped > 0
+
+
 def test_pipeline_matches_reference_and_trains():
     L, d = 4, 16
     rng = np.random.RandomState(0)
