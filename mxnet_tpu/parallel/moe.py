@@ -8,8 +8,9 @@ token buffers to the devices that own their experts (the canonical
 expert-parallel exchange; rides ICI).
 
 All functions are shard_map bodies: call inside `jax.shard_map` with the
-token axis sharded over 'ep' (and/or 'dp') and expert weights sharded on
-their leading expert axis over 'ep'.
+token axis sharded over 'ep' and expert weights sharded on their leading
+expert axis over 'ep'. (For an additional 'dp' token axis, pmean the aux
+loss over 'dp' yourself — it is only reduced over `axis_name` here.)
 """
 from __future__ import annotations
 
@@ -32,7 +33,7 @@ def init_moe_ffn(key, num_experts, d_model, d_ff, dtype=jnp.float32):
     }
 
 
-def moe_ffn(params, x, axis_name="ep", capacity_factor=2.0, num_experts=None):
+def moe_ffn(params, x, axis_name="ep", capacity_factor=2.0):
     """Switch-routed expert FFN; shard_map body.
 
     params: {'wg': [d, E] replicated, 'w1': [e_local, d, f], 'w2':
@@ -44,7 +45,7 @@ def moe_ffn(params, x, axis_name="ep", capacity_factor=2.0, num_experts=None):
     """
     n = lax.psum(1, axis_name)
     e_local = params["w1"].shape[0]
-    E = num_experts or e_local * n
+    E = e_local * n
     T, d = x.shape
     C = int(_np.ceil(capacity_factor * T / E))
 

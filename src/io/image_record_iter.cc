@@ -1,0 +1,462 @@
+// Threaded ImageRecordIter: the TPU-native equivalent of the reference's
+// ImageRecordIOParser2 pipeline (src/io/iter_image_recordio_2.cc:50 —
+// sharded record read -> parallel JPEG decode + augment -> batch -> prefetch
+// queue). Same stages, portable C++17 threads instead of dmlc/OMP, OpenCV
+// decode like the reference.
+//
+// Pipeline: one producer thread walks the (optionally shuffled) record
+// offsets of this shard and assembles raw batches; `preprocess_threads`
+// workers decode + augment + pack float32 NCHW batches; a bounded reordering
+// output queue preserves batch order for deterministic non-shuffled epochs.
+//
+// Exposed through the flat C ABI at the bottom (reference model:
+// src/c_api/c_api.cc + MXDataIterCreateIter).
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <opencv2/core.hpp>
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+#include "recordio.h"
+
+namespace mxtpu {
+
+// MXTPU_IO_DEBUG=1 traces pipeline stage transitions to stderr
+static bool DebugOn() {
+  static bool on = [] {
+    const char* v = std::getenv("MXTPU_IO_DEBUG");
+    return v && v[0] == '1';
+  }();
+  return on;
+}
+#define MXTPU_DLOG(fmt, ...) \
+  do { if (DebugOn()) std::fprintf(stderr, "[mxtpu_io] " fmt "\n", ##__VA_ARGS__); } while (0)
+
+struct ImageRecParams {
+  std::string path_imgrec;
+  int batch_size = 1;
+  int channels = 3, height = 224, width = 224;
+  int preprocess_threads = 4;
+  bool shuffle = false;
+  unsigned seed = 0;
+  int num_parts = 1, part_index = 0;
+  float mean[3] = {0.f, 0.f, 0.f};
+  float std_[3] = {1.f, 1.f, 1.f};
+  bool rand_crop = false;
+  bool rand_mirror = false;
+  int resize = -1;           // shorter-side resize before crop; -1 = off
+  int label_width = 1;
+  bool round_batch = true;   // pad last batch from epoch start (pad count reported)
+  int prefetch_depth = 4;
+};
+
+struct Batch {
+  std::vector<float> data;    // [batch, c, h, w]
+  std::vector<float> label;   // [batch, label_width]
+  int pad = 0;
+  bool last = false;          // epoch-end sentinel
+};
+
+class ImageRecordIter {
+ public:
+  explicit ImageRecordIter(const ImageRecParams& p) : p_(p), rng_(p.seed) {
+    RecordIOReader scan(p_.path_imgrec);
+    if (!scan.is_open())
+      throw std::runtime_error("cannot open " + p_.path_imgrec);
+    auto all = scan.ScanOffsets();
+    for (size_t i = 0; i < all.size(); ++i) {
+      if (static_cast<int>(i % p_.num_parts) == p_.part_index)
+        shard_.push_back(all[i]);
+    }
+    if (shard_.empty())
+      throw std::runtime_error("empty shard for " + p_.path_imgrec);
+    Start();
+  }
+
+  ~ImageRecordIter() { Stop(); }
+
+  int64_t num_samples() const { return static_cast<int64_t>(shard_.size()); }
+
+  // Copies the next batch into out pointers. Returns pad count, or -1 at
+  // epoch end (call Reset for the next epoch).
+  int Next(float* data_out, float* label_out) {
+    std::unique_ptr<Batch> b;
+    {
+      std::unique_lock<std::mutex> lk(out_mu_);
+      out_cv_.wait(lk, [&] { return !out_q_.empty() || failed_; });
+      if (failed_) throw std::runtime_error(error_);
+      b = std::move(out_q_.front());
+      out_q_.pop();
+    }
+    out_space_cv_.notify_all();
+    if (b->last) { MXTPU_DLOG("Next: eof delivered"); return -1; }
+    std::memcpy(data_out, b->data.data(), b->data.size() * sizeof(float));
+    std::memcpy(label_out, b->label.data(), b->label.size() * sizeof(float));
+    return b->pad;
+  }
+
+  void Reset() {
+    Stop();
+    epoch_++;
+    Start();
+  }
+
+ private:
+  void Start() {
+    MXTPU_DLOG("Start epoch=%u", epoch_);
+    stop_ = false;
+    failed_ = false;
+    next_out_seq_ = 0;
+    raw_done_ = false;
+    eof_sent_ = false;
+    last_seq_ = 0;
+    raw_pad_.clear();
+    producer_ = std::thread([this] { Produce(); });
+    for (int i = 0; i < p_.preprocess_threads; ++i)
+      workers_.emplace_back([this] { Work(); });
+  }
+
+  void Stop() {
+    MXTPU_DLOG("Stop begin");
+    {
+      std::lock_guard<std::mutex> lk(raw_mu_);
+      stop_ = true;
+    }
+    raw_cv_.notify_all();
+    raw_space_cv_.notify_all();
+    {
+      std::lock_guard<std::mutex> lk(out_mu_);
+    }
+    out_cv_.notify_all();
+    out_space_cv_.notify_all();
+    if (producer_.joinable()) producer_.join();
+    for (auto& w : workers_)
+      if (w.joinable()) w.join();
+    workers_.clear();
+    // drain queues
+    std::queue<std::pair<uint64_t, std::vector<std::string>>>().swap(raw_q_);
+    std::queue<std::unique_ptr<Batch>>().swap(out_q_);
+    pending_.clear();
+    MXTPU_DLOG("Stop end");
+  }
+
+  // ---- stage 1: sharded (shuffled) record read, raw batch assembly -------
+  void Produce() {
+    try {
+      std::vector<size_t> order(shard_.size());
+      for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+      if (p_.shuffle) {
+        std::mt19937 g(p_.seed + 0x9e3779b9u * epoch_);
+        std::shuffle(order.begin(), order.end(), g);
+      }
+      RecordIOReader reader(p_.path_imgrec);
+      const size_t n = order.size();
+      const size_t bs = static_cast<size_t>(p_.batch_size);
+      uint64_t seq = 0;
+      size_t i = 0;
+      while (i < n && !stop_) {
+        std::vector<std::string> recs;
+        recs.reserve(bs);
+        size_t take = std::min(bs, n - i);
+        for (size_t j = 0; j < take; ++j) {
+          recs.emplace_back();
+          auto& off = shard_[order[i + j]];
+          if (!reader.ReadAt(off.first, off.second, &recs.back()))
+            throw std::runtime_error("short read in " + p_.path_imgrec);
+        }
+        int pad = 0;
+        if (take < bs) {
+          if (!p_.round_batch && take == 0) break;
+          pad = static_cast<int>(bs - take);
+          for (size_t j = 0; j < static_cast<size_t>(pad); ++j) {
+            recs.emplace_back();
+            auto& off = shard_[order[j % n]];  // wrap to epoch start
+            reader.ReadAt(off.first, off.second, &recs.back());
+          }
+        }
+        i += take;
+        PushRaw(seq++, std::move(recs), pad);
+      }
+      // one sentinel per worker so all exit, plus the epoch-end marker
+      {
+        std::unique_lock<std::mutex> lk(raw_mu_);
+        raw_done_ = true;
+        last_seq_ = seq;
+      }
+      raw_cv_.notify_all();
+      MXTPU_DLOG("producer done last_seq=%llu", (unsigned long long)seq);
+    } catch (const std::exception& e) {
+      MXTPU_DLOG("producer FAIL %s", e.what());
+      Fail(e.what());
+    }
+  }
+
+  void PushRaw(uint64_t seq, std::vector<std::string> recs, int pad) {
+    std::unique_lock<std::mutex> lk(raw_mu_);
+    raw_space_cv_.wait(lk, [&] {
+      return raw_q_.size() < static_cast<size_t>(p_.prefetch_depth) || stop_;
+    });
+    if (stop_) return;
+    raw_pad_[seq] = pad;
+    raw_q_.emplace(seq, std::move(recs));
+    raw_cv_.notify_all();
+  }
+
+  // ---- stage 2: decode + augment + pack ---------------------------------
+  void Work() {
+    try {
+      std::mt19937 rng(p_.seed ^ std::hash<std::thread::id>()(
+                                     std::this_thread::get_id()));
+      for (;;) {
+        std::pair<uint64_t, std::vector<std::string>> item;
+        int pad;
+        {
+          std::unique_lock<std::mutex> lk(raw_mu_);
+          raw_cv_.wait(lk, [&] {
+            return !raw_q_.empty() || stop_ || raw_done_;
+          });
+          if (stop_) return;
+          if (raw_q_.empty()) {  // producer finished: emit epoch-end once
+            MXTPU_DLOG("worker exit path raw_done=%d eof_sent=%d", (int)raw_done_, (int)eof_sent_);
+            if (raw_done_ && !eof_sent_) {
+              MXTPU_DLOG("worker sends eof seq=%llu", (unsigned long long)last_seq_);
+              eof_sent_ = true;
+              lk.unlock();
+              auto b = std::make_unique<Batch>();
+              b->last = true;
+              PushOut(last_seq_, std::move(b));
+            }
+            return;
+          }
+          MXTPU_DLOG("worker pops seq=%llu", (unsigned long long)raw_q_.front().first);
+          item = std::move(raw_q_.front());
+          raw_q_.pop();
+          pad = raw_pad_[item.first];
+          raw_pad_.erase(item.first);
+        }
+        raw_space_cv_.notify_one();
+        auto b = std::make_unique<Batch>();
+        FillBatch(item.second, pad, rng, b.get());
+        PushOut(item.first, std::move(b));
+      }
+    } catch (const std::exception& e) {
+      Fail(e.what());
+    }
+  }
+
+  void FillBatch(const std::vector<std::string>& recs, int pad,
+                 std::mt19937& rng, Batch* b) {
+    const int c = p_.channels, h = p_.height, w = p_.width;
+    b->data.assign(recs.size() * c * h * w, 0.f);
+    b->label.assign(recs.size() * p_.label_width, 0.f);
+    b->pad = pad;
+    for (size_t i = 0; i < recs.size(); ++i) {
+      const std::string& rec = recs[i];
+      if (rec.size() < sizeof(IRHeader))
+        throw std::runtime_error("record shorter than IRHeader");
+      IRHeader hdr;
+      std::memcpy(&hdr, rec.data(), sizeof(IRHeader));
+      const char* payload = rec.data() + sizeof(IRHeader);
+      size_t payload_len = rec.size() - sizeof(IRHeader);
+      float* lab = &b->label[i * p_.label_width];
+      if (hdr.flag > 0) {
+        size_t nlab = std::min<size_t>(hdr.flag, p_.label_width);
+        std::memcpy(lab, payload, nlab * sizeof(float));
+        payload += hdr.flag * sizeof(float);
+        payload_len -= hdr.flag * sizeof(float);
+      } else {
+        lab[0] = hdr.label;
+      }
+      DecodeAugment(payload, payload_len, rng,
+                    &b->data[i * c * h * w]);
+    }
+  }
+
+  void DecodeAugment(const char* buf, size_t len, std::mt19937& rng,
+                     float* out) {
+    const int c = p_.channels, h = p_.height, w = p_.width;
+    cv::Mat raw(1, static_cast<int>(len), CV_8U,
+                const_cast<char*>(buf));
+    cv::Mat img = cv::imdecode(raw, c == 1 ? cv::IMREAD_GRAYSCALE
+                                           : cv::IMREAD_COLOR);
+    if (img.empty()) throw std::runtime_error("image decode failed");
+    if (p_.resize > 0) {
+      int sw = img.cols, sh = img.rows;
+      double scale = static_cast<double>(p_.resize) / std::min(sw, sh);
+      cv::resize(img, img, cv::Size(std::max(w, static_cast<int>(sw * scale)),
+                                    std::max(h, static_cast<int>(sh * scale))),
+                 0, 0, cv::INTER_LINEAR);
+    }
+    if (img.cols < w || img.rows < h)
+      cv::resize(img, img, cv::Size(std::max(w, img.cols),
+                                    std::max(h, img.rows)));
+    int x0, y0;
+    if (p_.rand_crop) {
+      x0 = std::uniform_int_distribution<int>(0, img.cols - w)(rng);
+      y0 = std::uniform_int_distribution<int>(0, img.rows - h)(rng);
+    } else {
+      x0 = (img.cols - w) / 2;
+      y0 = (img.rows - h) / 2;
+    }
+    cv::Mat crop = img(cv::Rect(x0, y0, w, h));
+    bool mirror = p_.rand_mirror &&
+                  std::uniform_int_distribution<int>(0, 1)(rng);
+    if (mirror) cv::flip(crop, crop, 1);
+    // OpenCV is BGR; reference emits RGB-ordered channels (r=2-k swap)
+    for (int k = 0; k < c; ++k) {
+      int src_ch = (c == 3) ? 2 - k : k;
+      float mean = p_.mean[k], stdv = p_.std_[k];
+      float inv = stdv != 0.f ? 1.f / stdv : 1.f;
+      float* plane = out + k * h * w;
+      for (int y = 0; y < h; ++y) {
+        const uint8_t* row = crop.ptr<uint8_t>(y);
+        for (int x = 0; x < w; ++x) {
+          plane[y * w + x] = (static_cast<float>(row[x * c + src_ch]) - mean)
+                             * inv;
+        }
+      }
+    }
+  }
+
+  // ---- stage 3: ordered bounded output ----------------------------------
+  // Backpressure bounds only the ordered queue: a worker may block here only
+  // while out_q_ is nonempty, so the consumer can always drain and wake it —
+  // counting pending_ in the bound deadlocks (the batch the consumer needs
+  // can be the one blocked out). pending_ itself is bounded by the worker
+  // count (each worker holds at most one batch).
+  void PushOut(uint64_t seq, std::unique_ptr<Batch> b) {
+    std::unique_lock<std::mutex> lk(out_mu_);
+    out_space_cv_.wait(lk, [&] {
+      return out_q_.size() < static_cast<size_t>(p_.prefetch_depth) || stop_;
+    });
+    if (stop_) return;
+    pending_[seq] = std::move(b);
+    while (!pending_.empty() && pending_.begin()->first == next_out_seq_) {
+      out_q_.push(std::move(pending_.begin()->second));
+      pending_.erase(pending_.begin());
+      next_out_seq_++;
+      out_cv_.notify_one();
+    }
+  }
+
+  void Fail(const std::string& msg) {
+    {
+      std::lock_guard<std::mutex> lk(out_mu_);
+      failed_ = true;
+      error_ = msg;
+    }
+    out_cv_.notify_all();
+  }
+
+  ImageRecParams p_;
+  std::vector<std::pair<uint64_t, uint32_t>> shard_;
+  std::mt19937 rng_;
+  unsigned epoch_ = 0;
+
+  std::thread producer_;
+  std::vector<std::thread> workers_;
+  std::atomic<bool> stop_{false};
+  bool raw_done_ = false;
+  bool eof_sent_ = false;
+  uint64_t last_seq_ = 0;
+  bool failed_ = false;
+  std::string error_;
+
+  std::mutex raw_mu_;
+  std::condition_variable raw_cv_, raw_space_cv_;
+  std::queue<std::pair<uint64_t, std::vector<std::string>>> raw_q_;
+  std::map<uint64_t, int> raw_pad_;
+
+  std::mutex out_mu_;
+  std::condition_variable out_cv_, out_space_cv_;
+  std::queue<std::unique_ptr<Batch>> out_q_;
+  std::map<uint64_t, std::unique_ptr<Batch>> pending_;
+  uint64_t next_out_seq_ = 0;
+};
+
+}  // namespace mxtpu
+
+// ---------------------------------------------------------------------------
+// Flat C ABI (reference model: ~300 extern "C" entry points in src/c_api/)
+// ---------------------------------------------------------------------------
+
+static thread_local std::string g_last_error;
+
+extern "C" {
+
+const char* MXTIOGetLastError() { return g_last_error.c_str(); }
+
+void* MXTIOCreateImageRecordIter(
+    const char* path_imgrec, int batch_size, int channels, int height,
+    int width, int preprocess_threads, int shuffle, unsigned seed,
+    int num_parts, int part_index, const float* mean, const float* stdv,
+    int rand_crop, int rand_mirror, int resize, int label_width,
+    int round_batch, int prefetch_depth) {
+  try {
+    mxtpu::ImageRecParams p;
+    p.path_imgrec = path_imgrec;
+    p.batch_size = batch_size;
+    p.channels = channels;
+    p.height = height;
+    p.width = width;
+    p.preprocess_threads = std::max(1, preprocess_threads);
+    p.shuffle = shuffle != 0;
+    p.seed = seed;
+    p.num_parts = std::max(1, num_parts);
+    p.part_index = part_index;
+    for (int i = 0; i < 3; ++i) {
+      p.mean[i] = mean ? mean[i] : 0.f;
+      p.std_[i] = stdv ? stdv[i] : 1.f;
+    }
+    p.rand_crop = rand_crop != 0;
+    p.rand_mirror = rand_mirror != 0;
+    p.resize = resize;
+    p.label_width = std::max(1, label_width);
+    p.round_batch = round_batch != 0;
+    p.prefetch_depth = std::max(1, prefetch_depth);
+    return new mxtpu::ImageRecordIter(p);
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+int MXTIONext(void* handle, float* data_out, float* label_out) {
+  try {
+    return static_cast<mxtpu::ImageRecordIter*>(handle)->Next(data_out,
+                                                              label_out);
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -2;
+  }
+}
+
+void MXTIOReset(void* handle) {
+  try {
+    static_cast<mxtpu::ImageRecordIter*>(handle)->Reset();
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+  }
+}
+
+long long MXTIONumSamples(void* handle) {
+  return static_cast<mxtpu::ImageRecordIter*>(handle)->num_samples();
+}
+
+void MXTIOFree(void* handle) {
+  delete static_cast<mxtpu::ImageRecordIter*>(handle);
+}
+
+}  // extern "C"

@@ -1,0 +1,78 @@
+// RecordIO reader/writer implementation (format: see recordio.h).
+#include "recordio.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace mxtpu {
+
+RecordIOReader::RecordIOReader(const std::string& path) {
+  fp_ = std::fopen(path.c_str(), "rb");
+}
+
+RecordIOReader::~RecordIOReader() {
+  if (fp_) std::fclose(fp_);
+}
+
+bool RecordIOReader::ReadRecord(std::string* out) {
+  uint32_t hdr[2];
+  if (std::fread(hdr, sizeof(uint32_t), 2, fp_) != 2) return false;
+  if (hdr[0] != kRecordIOMagic)
+    throw std::runtime_error("invalid RecordIO magic");
+  uint32_t length = hdr[1] & ((1u << 29) - 1);
+  out->resize(length);
+  if (length && std::fread(&(*out)[0], 1, length, fp_) != length) return false;
+  uint32_t pad = (4 - (length % 4)) % 4;
+  if (pad) std::fseek(fp_, pad, SEEK_CUR);
+  return true;
+}
+
+std::vector<std::pair<uint64_t, uint32_t>> RecordIOReader::ScanOffsets() {
+  std::vector<std::pair<uint64_t, uint32_t>> offsets;
+  std::fseek(fp_, 0, SEEK_SET);
+  uint32_t hdr[2];
+  for (;;) {
+    uint64_t pos = static_cast<uint64_t>(std::ftell(fp_));
+    if (std::fread(hdr, sizeof(uint32_t), 2, fp_) != 2) break;
+    if (hdr[0] != kRecordIOMagic)
+      throw std::runtime_error("invalid RecordIO magic during scan");
+    uint32_t length = hdr[1] & ((1u << 29) - 1);
+    offsets.emplace_back(pos, length);
+    uint32_t pad = (4 - (length % 4)) % 4;
+    std::fseek(fp_, static_cast<long>(length + pad), SEEK_CUR);
+  }
+  std::fseek(fp_, 0, SEEK_SET);
+  return offsets;
+}
+
+bool RecordIOReader::ReadAt(uint64_t offset, uint32_t length,
+                            std::string* out) {
+  std::fseek(fp_, static_cast<long>(offset + 8), SEEK_SET);  // skip magic+len
+  out->resize(length);
+  return length == 0 || std::fread(&(*out)[0], 1, length, fp_) == length;
+}
+
+void RecordIOReader::Seek(uint64_t offset) {
+  std::fseek(fp_, static_cast<long>(offset), SEEK_SET);
+}
+
+RecordIOWriter::RecordIOWriter(const std::string& path) {
+  fp_ = std::fopen(path.c_str(), "wb");
+}
+
+RecordIOWriter::~RecordIOWriter() {
+  if (fp_) std::fclose(fp_);
+}
+
+uint64_t RecordIOWriter::WriteRecord(const void* data, size_t size) {
+  uint64_t pos = static_cast<uint64_t>(std::ftell(fp_));
+  uint32_t hdr[2] = {kRecordIOMagic, static_cast<uint32_t>(size)};
+  std::fwrite(hdr, sizeof(uint32_t), 2, fp_);
+  std::fwrite(data, 1, size, fp_);
+  uint32_t pad = (4 - (size % 4)) % 4;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (pad) std::fwrite(zeros, 1, pad, fp_);
+  return pos;
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,59 @@
+// RecordIO binary format — byte-compatible with the reference
+// (python/mxnet/recordio.py:36-334, src/io/image_recordio.h): records are
+// delimited by kMagic + a length word whose top 3 bits carry the
+// continuation flag; payloads are padded to 4 bytes.
+#ifndef MXTPU_IO_RECORDIO_H_
+#define MXTPU_IO_RECORDIO_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+static const uint32_t kRecordIOMagic = 0xced7230a;
+
+// IRHeader: (flag, label, id, id2) packed <IfQQ (reference recordio.py:291)
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const std::string& path);
+  ~RecordIOReader();
+  bool is_open() const { return fp_ != nullptr; }
+  // Read next record payload into *out; false at EOF. Throws std::runtime_error
+  // on a corrupt magic.
+  bool ReadRecord(std::string* out);
+  // Scan the whole file, returning (offset, length) of every record payload.
+  std::vector<std::pair<uint64_t, uint32_t>> ScanOffsets();
+  // Read the payload at a known offset (as produced by ScanOffsets).
+  bool ReadAt(uint64_t offset, uint32_t length, std::string* out);
+  void Seek(uint64_t offset);
+
+ private:
+  FILE* fp_;
+};
+
+class RecordIOWriter {
+ public:
+  explicit RecordIOWriter(const std::string& path);
+  ~RecordIOWriter();
+  bool is_open() const { return fp_ != nullptr; }
+  // Returns the byte offset the record was written at (for .idx files).
+  uint64_t WriteRecord(const void* data, size_t size);
+
+ private:
+  FILE* fp_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_IO_RECORDIO_H_

@@ -1,0 +1,167 @@
+"""Native C++ IO pipeline tests (reference model: tests/python/unittest/
+test_io.py ImageRecordIter cases + recordio round-trips).
+
+Builds libmxtpu_io.so on demand (mxnet_tpu/_native.py); skips if no
+toolchain.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+pytestmark = pytest.mark.skipif(
+    not __import__("mxnet_tpu._native", fromlist=["available"]).available(),
+    reason="native io library unavailable")
+
+from mxnet_tpu.recordio_iter import ImageRecordIter  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    """37 solid-color 40x52 images; color value verifiable post-decode."""
+    path = str(tmp_path_factory.mktemp("recio") / "test.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    colors = []
+    for i in range(37):
+        val = int(rng.randint(0, 256))
+        img = np.full((40, 52, 3), val, np.uint8)
+        colors.append(val)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 10), i, 0), img, quality=100))
+    rec.close()
+    return path, colors
+
+
+def test_sequential_epoch(rec_file):
+    path, colors = rec_file
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                         batch_size=8, shuffle=False, preprocess_threads=3)
+    assert it.num_samples == 37
+    labels, vals, nb = [], [], 0
+    for batch in it:
+        nb += 1
+        n = 8 - batch.pad
+        labels.extend(batch.label[0].asnumpy()[:n].tolist())
+        vals.extend(batch.data[0].asnumpy()[:n, 0, 0, 0].tolist())
+    assert nb == 5
+    assert labels == [float(i % 10) for i in range(37)]
+    # solid colors survive JPEG at quality 100 within small tolerance
+    assert max(abs(vals[i] - colors[i]) for i in range(37)) <= 3
+
+
+def test_reset_epochs(rec_file):
+    path, _ = rec_file
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                         batch_size=8)
+    assert sum(1 for _ in it) == 5
+    it.reset()
+    assert sum(1 for _ in it) == 5
+
+
+def test_shuffle_permutes(rec_file):
+    path, _ = rec_file
+    seq = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                          batch_size=8, shuffle=False)
+    base = []
+    for b in seq:
+        base.extend(b.label[0].asnumpy()[:8 - b.pad].tolist())
+    shuf = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                           batch_size=8, shuffle=True, seed=3)
+    got = []
+    for b in shuf:
+        got.extend(b.label[0].asnumpy()[:8 - b.pad].tolist())
+    assert sorted(got) == sorted(base) and got != base
+    # different epochs shuffle differently
+    shuf.reset()
+    got2 = []
+    for b in shuf:
+        got2.extend(b.label[0].asnumpy()[:8 - b.pad].tolist())
+    assert sorted(got2) == sorted(base) and got2 != got
+
+
+def test_sharding_partitions(rec_file):
+    path, _ = rec_file
+    parts = []
+    total = 0
+    for pi in range(3):
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                             batch_size=4, num_parts=3, part_index=pi)
+        total += it.num_samples
+        got = []
+        for b in it:
+            got.extend(b.label[0].asnumpy()[:4 - b.pad].tolist())
+        parts.append(got)
+        assert len(got) == it.num_samples
+    assert total == 37
+
+
+def test_normalization_applied(rec_file):
+    path, colors = rec_file
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                         batch_size=8, mean_r=128.0, mean_g=128.0,
+                         mean_b=128.0, std_r=64.0, std_g=64.0, std_b=64.0)
+    b = next(iter(it))
+    v = b.data[0].asnumpy()[0, 0, 0, 0]
+    expect = (colors[0] - 128.0) / 64.0
+    assert abs(v - expect) < 0.1
+
+
+def test_mean_img_channels_rgb(tmp_path):
+    """R and B channels must not be swapped (OpenCV BGR -> RGB output)."""
+    path = str(tmp_path / "rgb.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    img = np.zeros((32, 32, 3), np.uint8)
+    img[:, :, 2] = 200  # OpenCV BGR: red channel
+    rec.write(recordio.pack_img(recordio.IRHeader(0, 0.0, 0, 0), img,
+                                quality=100))
+    rec.close()
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                         batch_size=1)
+    d = next(iter(it)).data[0].asnumpy()[0]
+    assert d[0].mean() > 150  # channel 0 = R
+    assert d[2].mean() < 50   # channel 2 = B
+
+
+def test_bad_file_raises(tmp_path):
+    bad = tmp_path / "bad.rec"
+    bad.write_bytes(b"not a recordio file at all........")
+    with pytest.raises(Exception):
+        ImageRecordIter(path_imgrec=str(bad), data_shape=(3, 32, 32),
+                        batch_size=2)
+
+
+def test_im2rec_roundtrip(tmp_path):
+    import cv2
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            img = np.full((40, 40, 3), 60 * i + 30, np.uint8)
+            cv2.imwrite(str(root / cls / ("%d.jpg" % i)), img)
+    prefix = str(tmp_path / "ds")
+    tools = os.path.join(os.path.dirname(mx.__file__), "..", "tools",
+                         "im2rec.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run([sys.executable, tools, "--list", prefix, str(root)],
+                   check=True, env=env)
+    subprocess.run([sys.executable, tools, prefix, str(root)], check=True,
+                   env=env)
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         data_shape=(3, 32, 32), batch_size=2)
+    assert it.num_samples == 6
+    labels = []
+    for b in it:
+        labels.extend(b.label[0].asnumpy()[:2 - b.pad].tolist())
+    assert sorted(set(labels)) == [0.0, 1.0]
+    # indexed random access via the .idx sidecar
+    idx_rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                         "r")
+    hdr, img = recordio.unpack_img(idx_rec.read_idx(idx_rec.keys[-1]))
+    assert img.shape[2] == 3

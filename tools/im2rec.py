@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder (or .lst list file) into RecordIO.
+
+Reference: tools/im2rec.py — same .lst format (index\tlabel...\trelpath) and
+.rec/.idx output, so datasets packed by either tool interchange.
+
+Usage:
+  python tools/im2rec.py --list prefix root     # generate prefix.lst
+  python tools/im2rec.py prefix root            # pack prefix.lst -> .rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive=True):
+    cat = {}
+    entries = []
+    i = 0
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for fname in sorted(filenames):
+            if os.path.splitext(fname)[1].lower() not in _EXTS:
+                continue
+            label_dir = os.path.relpath(dirpath, root)
+            if label_dir not in cat:
+                cat[label_dir] = len(cat)
+            rel = os.path.relpath(os.path.join(dirpath, fname), root)
+            entries.append((i, cat[label_dir], rel))
+            i += 1
+        if not recursive:
+            break
+    return entries
+
+
+def write_list(prefix, entries, shuffle=False, seed=0):
+    if shuffle:
+        rng = random.Random(seed)
+        rng.shuffle(entries)
+    with open(prefix + ".lst", "w") as f:
+        for idx, label, rel in entries:
+            f.write("%d\t%f\t%s\n" % (idx, float(label), rel))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, quality=95, resize=0, color=1):
+    import cv2
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        path = os.path.join(root, rel)
+        img = cv2.imread(path, cv2.IMREAD_COLOR if color else
+                         cv2.IMREAD_GRAYSCALE)
+        if img is None:
+            print("skip unreadable %s" % path, file=sys.stderr)
+            continue
+        if resize:
+            h, w = img.shape[:2]
+            scale = float(resize) / min(h, w)
+            img = cv2.resize(img, (int(w * scale + 0.5),
+                                   int(h * scale + 0.5)))
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack_img(header, img, quality=quality))
+        n += 1
+    rec.close()
+    print("packed %d records -> %s.rec" % (n, prefix))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst file instead of packing")
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--no-recursive", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.list:
+        entries = list_images(args.root, recursive=not args.no_recursive)
+        write_list(args.prefix, entries, shuffle=args.shuffle, seed=args.seed)
+        print("wrote %d entries -> %s.lst" % (len(entries), args.prefix))
+    else:
+        pack(args.prefix, args.root, quality=args.quality, resize=args.resize)
+
+
+if __name__ == "__main__":
+    main()
