@@ -11,5 +11,9 @@ from . import random_ops     # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import fork_ops       # noqa: F401
 from . import multibox       # noqa: F401
+from . import vision         # noqa: F401
+from . import contrib_ops    # noqa: F401
+from . import linalg_extra   # noqa: F401
+from . import quantization   # noqa: F401
 
 __all__ = ["OpDef", "register_op", "get_op", "find_op", "list_ops", "OPS"]

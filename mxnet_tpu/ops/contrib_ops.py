@@ -1,0 +1,158 @@
+"""Contrib ops: CTC loss, FFT/IFFT, quadratic.
+
+Reference: src/operator/contrib/{ctc_loss.cc (vendored warp-ctc),
+fft/ifft (cuFFT-backed), quadratic_op.cc (the tutorial op)}.
+
+TPU formulation: CTC is the classic alpha recursion in log space as a
+`lax.scan` over time — autodiff through the scan gives the gradient the
+reference computes analytically in warp-ctc; FFT lowers to XLA's native FFT.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import Params, param_field, MXNetError
+from .registry import register_op
+
+_NEG = -1e30
+
+
+def _ctc_single(logprobs, labels, in_len, lab_len, blank):
+    """logprobs [T, A] log-softmaxed; labels [L] padded; returns scalar nll."""
+    T, A = logprobs.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    # extended sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((S,), blank, jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    pos = jnp.arange(S)
+    is_lab = (pos % 2) == 1
+    # allowed skip: ext[s] != ext[s-2] and ext[s] != blank
+    prev2 = jnp.roll(ext, 2)
+    can_skip = is_lab & (ext != prev2)
+
+    valid_s = pos < (2 * lab_len + 1)
+
+    alpha0 = jnp.full((S,), _NEG)
+    alpha0 = alpha0.at[0].set(logprobs[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(lab_len > 0,
+                                        logprobs[0, ext[1]], _NEG))
+
+    def step(alpha, lp):
+        a_prev = alpha
+        a_shift1 = jnp.concatenate([jnp.array([_NEG]), alpha[:-1]])
+        a_shift2 = jnp.concatenate([jnp.array([_NEG, _NEG]), alpha[:-2]])
+        a_shift2 = jnp.where(can_skip, a_shift2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+        alpha_new = merged + lp[ext]
+        alpha_new = jnp.where(valid_s, alpha_new, _NEG)
+        return alpha_new, alpha_new
+
+    t_idx = jnp.arange(T)
+
+    def scan_step(alpha, inp):
+        lp, t = inp
+        alpha_new, _ = step(alpha, lp)
+        # frozen past in_len: keep alpha fixed
+        alpha_new = jnp.where(t < in_len, alpha_new, alpha)
+        return alpha_new, None
+
+    alpha, _ = lax.scan(scan_step, alpha0, (logprobs[1:], t_idx[1:]))
+    end1 = alpha[jnp.maximum(2 * lab_len - 1, 0)]
+    end2 = alpha[2 * lab_len]
+    ll = jnp.logaddexp(jnp.where(lab_len > 0, end1, _NEG), end2)
+    return -ll
+
+
+class CTCLossParam(Params):
+    use_data_lengths = param_field(bool, default=False)
+    use_label_lengths = param_field(bool, default=False)
+    blank_label = param_field(str, default="first")
+
+
+def _ctc_inputs(p):
+    names = ["data", "label"]
+    if p is not None and p.use_data_lengths:
+        names.append("data_lengths")
+    if p is not None and p.use_label_lengths:
+        names.append("label_lengths")
+    return tuple(names)
+
+
+@register_op("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss",
+                                 "_contrib_ctc_loss"),
+             param_cls=CTCLossParam, input_names=_ctc_inputs)
+def _ctc_loss(params, data, label, data_lengths=None, label_lengths=None):
+    """data [T, B, A] activations (pre-softmax); label [B, L] padded.
+
+    blank_label='first': blank is index 0 and padding value is 0 (reference
+    semantics); 'last': blank is A-1, padding 0... labels use 1-based? —
+    reference uses 0-padding with first, -1 padding handled by lengths.
+    """
+    T, B, A = data.shape
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    if params.blank_label == "first":
+        blank = 0
+        labels = label.astype(jnp.int32)
+        default_len = (label != 0).astype(jnp.int32).sum(axis=1)
+    else:
+        blank = A - 1
+        labels = label.astype(jnp.int32)
+        default_len = (label >= 0).astype(jnp.int32).sum(axis=1)
+    in_lens = (data_lengths.astype(jnp.int32) if data_lengths is not None
+               else jnp.full((B,), T, jnp.int32))
+    lab_lens = (label_lengths.astype(jnp.int32) if label_lengths is not None
+                else default_len)
+    losses = jax.vmap(_ctc_single, in_axes=(1, 0, 0, 0, None))(
+        logp, labels, in_lens, lab_lens, blank)
+    return losses.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# FFT / IFFT (contrib/fft): real input, interleaved re/im output
+# ---------------------------------------------------------------------------
+
+
+class FFTParam(Params):
+    compute_size = param_field(int, default=128)
+
+
+@register_op("_contrib_fft", param_cls=FFTParam)
+def _fft(params, data):
+    """[..., d] real -> [..., 2d] interleaved (re, im) (reference fft-inl.h)."""
+    out = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(
+        jnp.float32)
+
+
+@register_op("_contrib_ifft", param_cls=FFTParam)
+def _ifft(params, data):
+    """[..., 2d] interleaved -> [..., d] real part of inverse FFT.
+
+    Reference ifft does not normalize by d (cuFFT convention) — kept.
+    """
+    d = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (d, 2))
+    comp = c[..., 0] + 1j * c[..., 1]
+    out = jnp.fft.ifft(comp, axis=-1) * d  # undo numpy's 1/d normalization
+    return out.real.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# quadratic (the "how to add an op" tutorial op, contrib/quadratic_op.cc)
+# ---------------------------------------------------------------------------
+
+
+class QuadraticParam(Params):
+    a = param_field(float, default=0.0)
+    b = param_field(float, default=0.0)
+    c = param_field(float, default=0.0)
+
+
+@register_op("_contrib_quadratic", param_cls=QuadraticParam)
+def _quadratic(params, data):
+    return params.a * data * data + params.b * data + params.c

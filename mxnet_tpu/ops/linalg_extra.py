@@ -1,0 +1,116 @@
+"""Extended linalg ops (reference: src/operator/tensor/la_op.cc — the
+BLAS/LAPACK family: gemm, trmm, trsm, potri, gelqf, syevd, sumlogdiag,
+extractdiag/makediag). XLA lowers these to its native triangular-solve /
+cholesky / eigh; batching comes from leading dims like the reference.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import Params, param_field
+from .registry import register_op
+
+
+class GemmParam(Params):
+    transpose_a = param_field(bool, default=False)
+    transpose_b = param_field(bool, default=False)
+    alpha = param_field(float, default=1.0)
+    beta = param_field(float, default=1.0)
+    axis = param_field(int, default=-2)
+
+
+def _t(x, do):
+    return jnp.swapaxes(x, -1, -2) if do else x
+
+
+@register_op("linalg_gemm", param_cls=GemmParam, input_names=("A", "B", "C"))
+def _linalg_gemm(params, a, b, c):
+    axis = params.axis
+    if axis != -2:  # la_op.cc: axis selects the matrix-row axis
+        a = jnp.moveaxis(a, axis, -2)
+        b = jnp.moveaxis(b, axis, -2)
+        c = jnp.moveaxis(c, axis, -2)
+    out = params.alpha * jnp.matmul(_t(a, params.transpose_a),
+                                    _t(b, params.transpose_b))
+    out = out + params.beta * c
+    if axis != -2:
+        out = jnp.moveaxis(out, -2, axis)
+    return out
+
+
+class TriParam(Params):
+    transpose = param_field(bool, default=False)
+    rightside = param_field(bool, default=False)
+    lower = param_field(bool, default=True)
+    alpha = param_field(float, default=1.0)
+
+
+@register_op("linalg_trmm", param_cls=TriParam, input_names=("A", "B"))
+def _linalg_trmm(params, a, b):
+    tri = jnp.tril(a) if params.lower else jnp.triu(a)
+    tri = _t(tri, params.transpose)
+    out = jnp.matmul(b, tri) if params.rightside else jnp.matmul(tri, b)
+    return params.alpha * out
+
+
+@register_op("linalg_trsm", param_cls=TriParam, input_names=("A", "B"))
+def _linalg_trsm(params, a, b):
+    lower = params.lower != params.transpose  # transpose flips triangularity
+    a_eff = _t(a, params.transpose)
+    if params.rightside:
+        # X A = alpha B  =>  A^T X^T = alpha B^T
+        x_t = jax.scipy.linalg.solve_triangular(
+            _t(a_eff, True), _t(params.alpha * b, True), lower=not lower)
+        return _t(x_t, True)
+    return jax.scipy.linalg.solve_triangular(a_eff, params.alpha * b,
+                                             lower=lower)
+
+
+@register_op("linalg_potri", input_names=("A",))
+def _linalg_potri(params, a):
+    """Inverse from a Cholesky factor: A = L L^T -> A^{-1} (la_op.cc potri)."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(_t(linv, True), linv)
+
+
+@register_op("linalg_gelqf", input_names=("A",), num_outputs=2)
+def _linalg_gelqf(params, a):
+    """LQ factorization A = L Q (rows orthonormal Q) via QR of A^T."""
+    q, r = jnp.linalg.qr(_t(a, True))
+    return _t(r, True), _t(q, True)
+
+
+@register_op("linalg_syevd", input_names=("A",), num_outputs=2)
+def _linalg_syevd(params, a):
+    """Symmetric eigendecomposition: returns (U, lambda), A = U^T diag(l) U."""
+    w, v = jnp.linalg.eigh(a)
+    return _t(v, True), w
+
+
+@register_op("linalg_sumlogdiag", input_names=("A",))
+def _linalg_sumlogdiag(params, a):
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.log(diag).sum(axis=-1)
+
+
+class DiagParam(Params):
+    offset = param_field(int, default=0)
+
+
+@register_op("linalg_extractdiag", param_cls=DiagParam, input_names=("A",))
+def _linalg_extractdiag(params, a):
+    return jnp.diagonal(a, offset=params.offset, axis1=-2, axis2=-1)
+
+
+@register_op("linalg_makediag", param_cls=DiagParam, input_names=("A",))
+def _linalg_makediag(params, a):
+    n = a.shape[-1] + abs(params.offset)
+    base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    r = idx + max(-params.offset, 0)
+    c = idx + max(params.offset, 0)
+    return base.at[..., r, c].set(a)

@@ -1,0 +1,94 @@
+"""Quantization ops: quantize / dequantize / requantize (+ helpers).
+
+Reference: src/operator/quantization/{quantize,dequantize,requantize}-inl.h —
+the INT8 post-training flow driven by python/mxnet/contrib/quantization.py.
+TPU analog: int8 storage with float scale/zero bookkeeping; int8 matmuls ride
+XLA's native int8 MXU path when used inside jitted models.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import Params, param_field
+from .registry import register_op
+
+
+class QuantizeParam(Params):
+    out_type = param_field(str, default="uint8")
+
+
+def _qrange(out_type):
+    if out_type == "uint8":
+        return 0.0, 255.0, jnp.uint8
+    if out_type == "int8":
+        return -127.0, 127.0, jnp.int8
+    raise ValueError("unsupported quantized type %r" % out_type)
+
+
+@register_op("_contrib_quantize", param_cls=QuantizeParam,
+             input_names=("data", "min_range", "max_range"), num_outputs=3)
+def _quantize(params, data, min_range, max_range):
+    """Quantize float -> uint8 (affine) / int8 (symmetric, reference
+    quantize-inl.h: scale = 127 / MaxAbs(min, max), no zero point).
+
+    Returns (quantized, min_range, max_range)."""
+    qmin, qmax, qdt = _qrange(params.out_type)
+    real_min = jnp.minimum(min_range.reshape(()), 0.0)
+    real_max = jnp.maximum(max_range.reshape(()), 0.0)
+    if params.out_type == "int8":
+        absmax = jnp.maximum(jnp.abs(real_min), jnp.abs(real_max))
+        scale = 127.0 / jnp.maximum(absmax, 1e-12)
+        q = jnp.clip(jnp.round(data * scale), qmin, qmax).astype(qdt)
+        return q, (-absmax).reshape((1,)), absmax.reshape((1,))
+    scale = (qmax - qmin) / jnp.maximum(real_max - real_min, 1e-12)
+    zero = qmin - real_min * scale
+    q = jnp.clip(jnp.round(data * scale + zero), qmin, qmax).astype(qdt)
+    return q, real_min.reshape((1,)), real_max.reshape((1,))
+
+
+class DequantizeParam(Params):
+    out_type = param_field(str, default="float32")
+
+
+@register_op("_contrib_dequantize", param_cls=DequantizeParam,
+             input_names=("data", "min_range", "max_range"))
+def _dequantize(params, data, min_range, max_range):
+    real_min = min_range.reshape(())
+    real_max = max_range.reshape(())
+    if data.dtype == jnp.uint8:
+        scale = (real_max - real_min) / 255.0
+        return (data.astype(jnp.float32) * scale + real_min).astype(
+            jnp.float32)
+    # int8: symmetric (matches the quantize path above)
+    absmax = jnp.maximum(jnp.abs(real_min), jnp.abs(real_max))
+    return (data.astype(jnp.float32) * (absmax / 127.0)).astype(jnp.float32)
+
+
+class RequantizeParam(Params):
+    min_calib_range = param_field(float, default=None)
+    max_calib_range = param_field(float, default=None)
+
+
+@register_op("_contrib_requantize", param_cls=RequantizeParam,
+             input_names=("data", "min_range", "max_range"), num_outputs=3)
+def _requantize(params, data, min_range, max_range):
+    """int32 (conv/fc accumulators) -> int8 with calibrated or dynamic range."""
+    real_min = min_range.reshape(())
+    real_max = max_range.reshape(())
+    # float value of one int32 step
+    scale32 = jnp.maximum(jnp.abs(real_min), jnp.abs(real_max)) / (2.0 ** 31)
+    if params.min_calib_range is not None and \
+            params.max_calib_range is not None:
+        out_min = jnp.float32(params.min_calib_range)
+        out_max = jnp.float32(params.max_calib_range)
+    else:
+        fdata_absmax = jnp.max(jnp.abs(data.astype(jnp.float32))) * scale32
+        out_min = -fdata_absmax
+        out_max = fdata_absmax
+    fdata = data.astype(jnp.float32) * scale32
+    scale8 = 127.0 / jnp.maximum(jnp.maximum(jnp.abs(out_min),
+                                             jnp.abs(out_max)), 1e-12)
+    q = jnp.clip(jnp.round(fdata * scale8), -127, 127).astype(jnp.int8)
+    return q, out_min.reshape((1,)), out_max.reshape((1,))

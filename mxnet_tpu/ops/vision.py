@@ -1,0 +1,389 @@
+"""Vision ops: ROIPooling, Crop, SpatialTransformer, GridGenerator,
+BilinearSampler, Correlation, contrib resize/pool/box ops.
+
+Reference: src/operator/{roi_pooling,crop,spatial_transformer,
+bilinear_sampler,grid_generator,correlation}.cc and
+src/operator/contrib/{bilinear_resize,adaptive_avg_pooling,bounding_box}.cc.
+
+TPU formulation notes:
+- data-dependent regions (ROI pooling) become masked reductions over static
+  shapes — no dynamic slicing, so XLA compiles one program per shape.
+- bilinear sampling is two gathers + lerp, vmapped over batch.
+- correlation unrolls the (static) displacement grid into shifted
+  elementwise products pooled over the kernel window.
+- adaptive pooling uses integral images with *static* bin edges (shapes are
+  static under trace, so the edges are Python ints).
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import Params, param_field, MXNetError
+from .registry import register_op
+
+# ---------------------------------------------------------------------------
+# ROIPooling (roi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+class ROIPoolParam(Params):
+    pooled_size = param_field(tuple, required=True)
+    spatial_scale = param_field(float, required=True)
+
+
+@register_op("ROIPooling", param_cls=ROIPoolParam, input_names=("data", "rois"))
+def _roi_pooling(params, data, rois):
+    """data [N,C,H,W]; rois [R,5] = (batch_idx, x1, y1, x2, y2) in image coords."""
+    ph, pw = params.pooled_size
+    N, C, H, W = data.shape
+    scale = params.spatial_scale
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale)
+        y1 = jnp.round(roi[2] * scale)
+        x2 = jnp.round(roi[3] * scale)
+        y2 = jnp.round(roi[4] * scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[bidx]  # [C,H,W]
+
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        ystart = jnp.floor(y1 + iy * bin_h)          # [ph]
+        yend = jnp.ceil(y1 + (iy + 1) * bin_h)
+        xstart = jnp.floor(x1 + ix * bin_w)          # [pw]
+        xend = jnp.ceil(x1 + (ix + 1) * bin_w)
+        ymask = (ys[None, :] >= ystart[:, None]) & (ys[None, :] < yend[:, None])  # [ph,H]
+        xmask = (xs[None, :] >= xstart[:, None]) & (xs[None, :] < xend[:, None])  # [pw,W]
+        mask = ymask[:, None, :, None] & xmask[None, :, None, :]  # [ph,pw,H,W]
+        neg = jnp.finfo(data.dtype).min
+        vals = jnp.where(mask[None], img[:, None, None, :, :], neg)  # [C,ph,pw,H,W]
+        pooled = vals.max(axis=(-1, -2))
+        empty = ~mask.any(axis=(-1, -2))  # [ph,pw]
+        return jnp.where(empty[None], 0.0, pooled).astype(data.dtype)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Crop (crop.cc) — crop to explicit h_w or to shape of a second input
+# ---------------------------------------------------------------------------
+
+
+class CropParam(Params):
+    num_args = param_field(int, default=1)
+    offset = param_field(tuple, default=(0, 0))
+    h_w = param_field(tuple, default=(0, 0))
+    center_crop = param_field(bool, default=False)
+
+
+def _crop_inputs(p):
+    if p is not None and p.num_args == 2:
+        return ("data", "crop_like")
+    return ("data",)
+
+
+@register_op("Crop", param_cls=CropParam, input_names=_crop_inputs)
+def _crop(params, data, crop_like=None):
+    H, W = data.shape[2], data.shape[3]
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = params.h_w
+        if th == 0:
+            raise MXNetError("Crop needs h_w or a second input")
+    if params.center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = params.offset
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+# ---------------------------------------------------------------------------
+# GridGenerator / BilinearSampler / SpatialTransformer
+# ---------------------------------------------------------------------------
+
+
+class GridGenParam(Params):
+    transform_type = param_field(str, required=True)  # 'affine' | 'warp'
+    target_shape = param_field(tuple, default=(0, 0))
+
+
+def _affine_grid(theta6, h, w):
+    """[N, 6] affine params -> [N, 2, h, w] sampling grid in [-1, 1]."""
+    theta = theta6.reshape(-1, 2, 3)
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx.ravel(), gy.ravel(), jnp.ones(h * w)], axis=0)  # [3,HW]
+    out = jnp.einsum("nij,jk->nik", theta, base)  # [N, 2, HW]
+    return out.reshape(-1, 2, h, w)
+
+
+@register_op("GridGenerator", param_cls=GridGenParam)
+def _grid_generator(params, data):
+    if params.transform_type == "affine":
+        h, w = params.target_shape
+        return _affine_grid(data, h, w).astype(data.dtype)
+    if params.transform_type == "warp":
+        # data: [N, 2, H, W] optical flow; grid = identity + normalized flow
+        n, _, h, w = data.shape
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        fx = data[:, 0] + gx[None]
+        fy = data[:, 1] + gy[None]
+        nx = fx * 2.0 / jnp.maximum(w - 1, 1) - 1.0
+        ny = fy * 2.0 / jnp.maximum(h - 1, 1) - 1.0
+        return jnp.stack([nx, ny], axis=1).astype(data.dtype)
+    raise MXNetError("unknown transform_type %r" % params.transform_type)
+
+
+def _bilinear_sample_one(img, grid):
+    """img [C,H,W], grid [2,Ho,Wo] in [-1,1] (x, y); zeros outside."""
+    C, H, W = img.shape
+    gx = (grid[0] + 1.0) * (W - 1) / 2.0
+    gy = (grid[1] + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def at(yi, xi):
+        valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]  # [C,Ho,Wo]
+        return jnp.where(valid[None], v, 0.0)
+
+    v00 = at(y0, x0)
+    v01 = at(y0, x0 + 1)
+    v10 = at(y0 + 1, x0)
+    v11 = at(y0 + 1, x0 + 1)
+    top = v00 * (1 - wx)[None] + v01 * wx[None]
+    bot = v10 * (1 - wx)[None] + v11 * wx[None]
+    return (top * (1 - wy)[None] + bot * wy[None]).astype(img.dtype)
+
+
+@register_op("BilinearSampler", input_names=("data", "grid"))
+def _bilinear_sampler(params, data, grid):
+    """data [N,C,H,W], grid [N,2,Ho,Wo] normalized to [-1,1]."""
+    return jax.vmap(_bilinear_sample_one)(data, grid.astype(jnp.float32))
+
+
+class STParam(Params):
+    transform_type = param_field(str, required=True)   # 'affine'
+    sampler_type = param_field(str, required=True)     # 'bilinear'
+    target_shape = param_field(tuple, default=(0, 0))
+
+
+@register_op("SpatialTransformer", param_cls=STParam, input_names=("data", "loc"))
+def _spatial_transformer(params, data, loc):
+    if params.transform_type != "affine" or params.sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine/bilinear")
+    h, w = params.target_shape
+    if h == 0:
+        h, w = data.shape[2], data.shape[3]
+    grid = _affine_grid(loc, h, w)
+    return jax.vmap(_bilinear_sample_one)(data, grid.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Correlation (correlation.cc) — stereo/flow cost volume
+# ---------------------------------------------------------------------------
+
+
+class CorrelationParam(Params):
+    kernel_size = param_field(int, default=1)
+    max_displacement = param_field(int, default=1)
+    stride1 = param_field(int, default=1)
+    stride2 = param_field(int, default=1)
+    pad_size = param_field(int, default=0)
+    is_multiply = param_field(bool, default=True)
+
+
+@register_op("Correlation", param_cls=CorrelationParam,
+             input_names=("data1", "data2"), num_outputs=1)
+def _correlation(params, data1, data2):
+    k = params.kernel_size
+    s2 = params.stride2
+    ngr = params.max_displacement // s2  # reference: neighborhood grid radius
+    pad = params.pad_size
+    n, c, h, w = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = p1.shape[2], p1.shape[3]
+    dmax = ngr * s2
+    # zero-fill halo so shifts never wrap (reference zero-pads the window)
+    p2z = jnp.pad(p2, ((0, 0), (0, 0), (dmax, dmax), (dmax, dmax)))
+    disps = [i * s2 for i in range(-ngr, ngr + 1)]
+    maps = []
+    for dy in disps:
+        for dx in disps:
+            shifted = lax.dynamic_slice(
+                p2z, (0, 0, dmax + dy, dmax + dx), (n, c, ph, pw))
+            prod = (p1 * shifted if params.is_multiply
+                    else jnp.abs(p1 - shifted))
+            m = prod.mean(axis=1, keepdims=True)  # over channels
+            if k > 1:  # average over kernel window
+                m = lax.reduce_window(
+                    m, 0.0, lax.add, (1, 1, k, k), (1, 1, 1, 1), "SAME") / (k * k)
+            maps.append(m)
+    out = jnp.concatenate(maps, axis=1)
+    # correlation evaluated at every original pixel (pad_size=max_displacement
+    # is the common config); crop padding back, then stride1 subsample
+    if pad:
+        out = out[:, :, pad:pad + h, pad:pad + w]
+    if params.stride1 > 1:
+        out = out[:, :, ::params.stride1, ::params.stride1]
+    return out.astype(data1.dtype)
+
+
+# ---------------------------------------------------------------------------
+# contrib: BilinearResize2D, AdaptiveAvgPooling2D
+# ---------------------------------------------------------------------------
+
+
+class ResizeParam(Params):
+    height = param_field(int, required=True)
+    width = param_field(int, required=True)
+
+
+@register_op("_contrib_BilinearResize2D", param_cls=ResizeParam)
+def _bilinear_resize(params, data):
+    n, c, _, _ = data.shape
+    return jax.image.resize(data, (n, c, params.height, params.width),
+                            method="linear").astype(data.dtype)
+
+
+class AdaptivePoolParam(Params):
+    output_size = param_field(tuple, default=(1, 1))
+
+
+@register_op("_contrib_AdaptiveAvgPooling2D", param_cls=AdaptivePoolParam)
+def _adaptive_avg_pool(params, data):
+    oh, ow = (params.output_size if len(params.output_size) == 2
+              else (params.output_size[0],) * 2)
+    n, c, h, w = data.shape
+    # integral image with static bin edges (PyTorch/MXNet bin convention)
+    integ = jnp.cumsum(jnp.cumsum(data, axis=2), axis=3)
+    integ = jnp.pad(integ, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    y_edges = [(i * h) // oh for i in range(oh)] + [h]
+    x_edges = [(j * w) // ow for j in range(ow)] + [w]
+    rows = []
+    for i in range(oh):
+        cols = []
+        y0, y1 = y_edges[i], y_edges[i + 1]
+        for j in range(ow):
+            x0, x1 = x_edges[j], x_edges[j + 1]
+            s = (integ[:, :, y1, x1] - integ[:, :, y0, x1]
+                 - integ[:, :, y1, x0] + integ[:, :, y0, x0])
+            cols.append(s / ((y1 - y0) * (x1 - x0)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# contrib: bounding-box ops (bounding_box.cc) — box_iou, box_nms
+# ---------------------------------------------------------------------------
+
+
+class BoxIouParam(Params):
+    format = param_field(str, default="corner")
+
+
+def _to_corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    # center: (x, y, w, h) -> corners
+    x, y, w, h = (boxes[..., 0], boxes[..., 1], boxes[..., 2], boxes[..., 3])
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _to_center(boxes):
+    x1, y1, x2, y2 = (boxes[..., 0], boxes[..., 1], boxes[..., 2],
+                      boxes[..., 3])
+    return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1],
+                     axis=-1)
+
+
+@register_op("_contrib_box_iou", param_cls=BoxIouParam,
+             input_names=("lhs", "rhs"))
+def _box_iou(params, lhs, rhs):
+    a = _to_corner(lhs, params.format)
+    b = _to_corner(rhs, params.format)
+    a_ = a.reshape((-1, 4))
+    b_ = b.reshape((-1, 4))
+    tl = jnp.maximum(a_[:, None, :2], b_[None, :, :2])
+    br = jnp.minimum(a_[:, None, 2:], b_[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = ((a_[:, 2] - a_[:, 0]) * (a_[:, 3] - a_[:, 1]))[:, None]
+    area_b = ((b_[:, 2] - b_[:, 0]) * (b_[:, 3] - b_[:, 1]))[None, :]
+    iou = inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+    return iou.reshape(lhs.shape[:-1] + rhs.shape[:-1]).astype(lhs.dtype)
+
+
+class BoxNMSParam(Params):
+    overlap_thresh = param_field(float, default=0.5)
+    valid_thresh = param_field(float, default=0.0)
+    topk = param_field(int, default=-1)
+    coord_start = param_field(int, default=2)
+    score_index = param_field(int, default=1)
+    id_index = param_field(int, default=-1)
+    force_suppress = param_field(bool, default=False)
+    in_format = param_field(str, default="corner")
+    out_format = param_field(str, default="corner")
+
+
+@register_op("_contrib_box_nms", param_cls=BoxNMSParam)
+def _box_nms(params, data):
+    """data [..., N, K]: greedy NMS; suppressed entries have score -1."""
+    cs, si, ii = params.coord_start, params.score_index, params.id_index
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+
+    def per_batch(items):
+        scores = items[:, si]
+        order = jnp.argsort(-scores)
+        items_s = items[order]
+        boxes = _to_corner(items_s[:, cs:cs + 4], params.in_format)
+        n = items_s.shape[0]
+        tl = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+        br = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+        wh = jnp.maximum(br - tl, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-12)
+        same_cls = (jnp.ones((n, n), bool) if (params.force_suppress or ii < 0)
+                    else items_s[:, ii][:, None] == items_s[:, ii][None, :])
+        valid0 = items_s[:, si] > params.valid_thresh
+        if params.topk > 0:
+            valid0 = valid0 & (jnp.arange(n) < params.topk)
+
+        def body(i, keep):
+            sup = (iou[i] > params.overlap_thresh) & same_cls[i] & \
+                  (jnp.arange(n) > i) & keep[i] & valid0[i]
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, n, body, valid0)
+        if params.out_format != params.in_format:
+            conv = _to_corner(items_s[:, cs:cs + 4], params.in_format) \
+                if params.out_format == "corner" else \
+                _to_center(items_s[:, cs:cs + 4])
+            items_s = lax.dynamic_update_slice(
+                items_s, conv.astype(items_s.dtype), (0, cs))
+        # reference marks suppressed rows as all -1
+        return jnp.where(keep[:, None], items_s, -jnp.ones_like(items_s))
+
+    out = jax.vmap(per_batch)(flat)
+    return out.reshape(shape).astype(data.dtype)

@@ -1,0 +1,347 @@
+"""Tests for the widened op catalog: vision, contrib (CTC/FFT), linalg,
+quantization (reference model: tests/python/unittest/test_operator.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _a(x):
+    return mx.nd.array(np.asarray(x, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# vision
+# ---------------------------------------------------------------------------
+
+
+def test_roi_pooling_matches_naive():
+    rng = np.random.RandomState(0)
+    data = rng.normal(size=(2, 3, 12, 16)).astype(np.float32)
+    rois = np.array([[0, 2, 2, 9, 9], [1, 0, 0, 15, 11], [0, 4, 4, 4, 4]],
+                    np.float32)
+    out = mx.nd.ROIPooling(_a(data), _a(rois), pooled_size=(3, 3),
+                           spatial_scale=1.0).asnumpy()
+
+    def naive(img, roi, ph, pw):
+        x1, y1, x2, y2 = [int(round(v)) for v in roi]
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        res = np.zeros((img.shape[0], ph, pw), np.float32)
+        for i in range(ph):
+            for j in range(pw):
+                ys = int(np.floor(y1 + i * rh / ph))
+                ye = int(np.ceil(y1 + (i + 1) * rh / ph))
+                xs = int(np.floor(x1 + j * rw / pw))
+                xe = int(np.ceil(x1 + (j + 1) * rw / pw))
+                ys, ye = max(ys, 0), min(ye, img.shape[1])
+                xs, xe = max(xs, 0), min(xe, img.shape[2])
+                if ye > ys and xe > xs:
+                    res[:, i, j] = img[:, ys:ye, xs:xe].max(axis=(1, 2))
+        return res
+
+    for r, roi in enumerate(rois):
+        ref = naive(data[int(roi[0])], roi[1:], 3, 3)
+        np.testing.assert_allclose(out[r], ref, atol=1e-5)
+
+
+def test_crop():
+    data = np.arange(2 * 3 * 8 * 8, dtype=np.float32).reshape(2, 3, 8, 8)
+    out = mx.nd.Crop(_a(data), offset=(1, 2), h_w=(4, 5)).asnumpy()
+    np.testing.assert_allclose(out, data[:, :, 1:5, 2:7])
+    like = np.zeros((2, 3, 6, 6), np.float32)
+    out2 = mx.nd.Crop(_a(data), _a(like), num_args=2,
+                      center_crop=True).asnumpy()
+    np.testing.assert_allclose(out2, data[:, :, 1:7, 1:7])
+
+
+def test_bilinear_sampler_identity():
+    rng = np.random.RandomState(0)
+    data = rng.normal(size=(2, 3, 7, 9)).astype(np.float32)
+    h, w = 7, 9
+    ys = np.linspace(-1, 1, h)
+    xs = np.linspace(-1, 1, w)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    grid = np.stack([gx, gy])[None].repeat(2, 0).astype(np.float32)
+    out = mx.nd.BilinearSampler(_a(data), _a(grid)).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_spatial_transformer_identity_and_shift():
+    rng = np.random.RandomState(0)
+    data = rng.normal(size=(1, 2, 8, 8)).astype(np.float32)
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    out = mx.nd.SpatialTransformer(_a(data), _a(theta),
+                                   transform_type="affine",
+                                   sampler_type="bilinear",
+                                   target_shape=(8, 8)).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_grid_generator_affine():
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    grid = mx.nd.GridGenerator(_a(theta), transform_type="affine",
+                               target_shape=(4, 6)).asnumpy()
+    assert grid.shape == (1, 2, 4, 6)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 6), atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+
+
+def test_correlation_zero_displacement():
+    rng = np.random.RandomState(0)
+    a = rng.normal(size=(1, 4, 6, 6)).astype(np.float32)
+    out = mx.nd.Correlation(_a(a), _a(a), kernel_size=1, max_displacement=0,
+                            stride1=1, stride2=1, pad_size=0).asnumpy()
+    ref = (a * a).mean(axis=1, keepdims=True)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_correlation_displacement_no_wrap():
+    """Border displacements must see zeros, not wrapped pixels."""
+    a = np.ones((1, 1, 1, 4), np.float32)
+    out = mx.nd.Correlation(_a(a), _a(a), kernel_size=1, max_displacement=1,
+                            stride1=1, stride2=1, pad_size=0).asnumpy()
+    assert out.shape == (1, 9, 1, 4)
+    # dx=+1 channel (dy=0, dx=1 -> index 5): last column has no right
+    # neighbor -> 0
+    np.testing.assert_allclose(out[0, 5, 0], [1, 1, 1, 0], atol=1e-6)
+    # dx=-1 channel (index 3): first column 0
+    np.testing.assert_allclose(out[0, 3, 0], [0, 1, 1, 1], atol=1e-6)
+
+
+def test_correlation_stride2_grid():
+    """stride2 picks multiples of stride2 within max_displacement (ngr)."""
+    a = np.ones((1, 1, 4, 4), np.float32)
+    out = mx.nd.Correlation(_a(a), _a(a), kernel_size=1, max_displacement=3,
+                            stride1=1, stride2=2, pad_size=0).asnumpy()
+    assert out.shape[1] == 9  # (2*(3//2)+1)^2 = 9 displacements
+
+
+def test_box_nms_out_format():
+    dets = np.array([[0, 0.9, 1.0, 1.0, 2.0, 2.0]], np.float32)[None]
+    out = mx.nd.contrib.box_nms(_a(dets), coord_start=2, score_index=1,
+                                id_index=0, in_format="corner",
+                                out_format="center").asnumpy()[0]
+    np.testing.assert_allclose(out[0, 2:6], [1.5, 1.5, 1.0, 1.0], atol=1e-6)
+
+
+def test_bilinear_resize2d():
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(2, 3, 4, 4)).astype(np.float32)
+    out = mx.nd.contrib.BilinearResize2D(_a(x), height=8, width=8).asnumpy()
+    assert out.shape == (2, 3, 8, 8)
+    # corners preserved by align-corners-free linear resize center samples
+    np.testing.assert_allclose(out.mean(), x.mean(), atol=1e-2)
+
+
+def test_adaptive_avg_pooling():
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(2, 3, 7, 5)).astype(np.float32)
+    out = mx.nd.contrib.AdaptiveAvgPooling2D(_a(x),
+                                             output_size=(3, 2)).asnumpy()
+    out1 = mx.nd.contrib.AdaptiveAvgPooling2D(_a(x),
+                                              output_size=(1, 1)).asnumpy()
+    np.testing.assert_allclose(out1[:, :, 0, 0], x.mean(axis=(2, 3)),
+                               atol=1e-5)
+    # bins partition: weighted mean of bin means (weights=bin areas) == mean
+    y_edges = [(i * 7) // 3 for i in range(3)] + [7]
+    x_edges = [(j * 5) // 2 for j in range(2)] + [5]
+    acc = np.zeros((2, 3))
+    for i in range(3):
+        for j in range(2):
+            area = (y_edges[i + 1] - y_edges[i]) * (x_edges[j + 1] - x_edges[j])
+            acc += out[:, :, i, j] * area
+    np.testing.assert_allclose(acc / 35.0, x.mean(axis=(2, 3)), atol=1e-5)
+
+
+def test_box_iou():
+    lhs = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    rhs = np.array([[0, 0, 2, 2], [10, 10, 11, 11]], np.float32)
+    iou = mx.nd.contrib.box_iou(_a(lhs), _a(rhs), format="corner").asnumpy()
+    np.testing.assert_allclose(iou[0, 0], 1.0, atol=1e-6)
+    np.testing.assert_allclose(iou[1, 0], 1.0 / 7.0, atol=1e-5)
+    np.testing.assert_allclose(iou[:, 1], 0.0, atol=1e-6)
+
+
+def test_box_nms():
+    # [cls, score, x1, y1, x2, y2]
+    dets = np.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],   # overlaps first -> suppressed
+        [0, 0.7, 5, 5, 7, 7],           # kept
+        [1, 0.6, 0, 0, 2, 2],           # other class -> kept
+    ], np.float32)[None]
+    out = mx.nd.contrib.box_nms(_a(dets), overlap_thresh=0.5, coord_start=2,
+                                score_index=1, id_index=0).asnumpy()[0]
+    kept_scores = sorted(out[out[:, 1] > 0][:, 1].tolist(), reverse=True)
+    np.testing.assert_allclose(kept_scores, [0.9, 0.7, 0.6], atol=1e-6)
+    assert (out[1] == -1).all()
+
+
+# ---------------------------------------------------------------------------
+# contrib: CTC, FFT, quadratic
+# ---------------------------------------------------------------------------
+
+
+def test_ctc_loss_vs_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(0)
+    T, B, A, L = 10, 4, 6, 3
+    acts = rng.normal(size=(T, B, A)).astype(np.float32)
+    labels = rng.randint(1, A, (B, L)).astype(np.float32)
+    lab_lens = np.array([3, 2, 3, 1], np.int64)
+    lab_padded = labels.copy()
+    for b, n in enumerate(lab_lens):
+        lab_padded[b, n:] = 0  # 0-padding, blank_label='first'
+    out = mx.nd.CTCLoss(_a(acts), _a(lab_padded)).asnumpy()
+
+    logp = torch.log_softmax(torch.tensor(acts), dim=-1)
+    ref = torch.nn.functional.ctc_loss(
+        logp, torch.tensor(lab_padded, dtype=torch.long),
+        torch.full((B,), T, dtype=torch.long),
+        torch.tensor(lab_lens), blank=0, reduction="none",
+        zero_infinity=False).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_grad_finite():
+    import jax
+    rng = np.random.RandomState(0)
+    acts = rng.normal(size=(6, 2, 5)).astype(np.float32)
+    labels = np.array([[1, 2], [3, 0]], np.float32)
+
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+    op = get_op("CTCLoss")
+    params = op.make_params({})
+
+    def f(a):
+        return op.fn(params, a, jnp.asarray(labels)).sum()
+
+    g = jax.grad(f)(jnp.asarray(acts))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    f = mx.nd.contrib.fft(_a(x)).asnumpy()
+    assert f.shape == (3, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f.reshape(3, 8, 2)[..., 0], ref.real,
+                               atol=1e-4)
+    np.testing.assert_allclose(f.reshape(3, 8, 2)[..., 1], ref.imag,
+                               atol=1e-4)
+    back = mx.nd.contrib.ifft(_a(f)).asnumpy()
+    np.testing.assert_allclose(back, x * 8, atol=1e-4)  # cuFFT: unnormalized
+
+
+def test_quadratic():
+    x = np.array([[1.0, 2.0]], np.float32)
+    out = mx.nd.contrib.quadratic(_a(x), a=2, b=3, c=4).asnumpy()
+    np.testing.assert_allclose(out, 2 * x * x + 3 * x + 4)
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+
+def _rand_spd(n, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.normal(size=(n, n)).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+def test_linalg_gemm():
+    rng = np.random.RandomState(0)
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    b = rng.normal(size=(4, 5)).astype(np.float32)
+    c = rng.normal(size=(3, 5)).astype(np.float32)
+    out = mx.nd.linalg_gemm(_a(a), _a(b), _a(c), alpha=2.0,
+                            beta=0.5).asnumpy()
+    np.testing.assert_allclose(out, 2 * a @ b + 0.5 * c, atol=1e-5)
+    out2 = mx.nd.linalg_gemm(_a(a.T), _a(b), _a(c), transpose_a=True).asnumpy()
+    np.testing.assert_allclose(out2, a @ b + c, atol=1e-5)
+
+
+def test_linalg_trmm_trsm():
+    spd = _rand_spd(4)
+    l = np.linalg.cholesky(spd).astype(np.float32)
+    rng = np.random.RandomState(1)
+    b = rng.normal(size=(4, 3)).astype(np.float32)
+    out = mx.nd.linalg_trmm(_a(l), _a(b)).asnumpy()
+    np.testing.assert_allclose(out, l @ b, atol=1e-4)
+    x = mx.nd.linalg_trsm(_a(l), _a(l @ b)).asnumpy()
+    np.testing.assert_allclose(x, b, atol=1e-3)
+    # rightside: X L = B
+    b2 = rng.normal(size=(3, 4)).astype(np.float32)
+    x2 = mx.nd.linalg_trsm(_a(l), _a(b2 @ l), rightside=True).asnumpy()
+    np.testing.assert_allclose(x2, b2, atol=1e-3)
+
+
+def test_linalg_potri_potrf():
+    spd = _rand_spd(4)
+    l = mx.nd.linalg_potrf(_a(spd))
+    inv = mx.nd.linalg_potri(l).asnumpy()
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+
+
+def test_linalg_gelqf():
+    rng = np.random.RandomState(0)
+    a = rng.normal(size=(3, 5)).astype(np.float32)
+    l, q = (x.asnumpy() for x in mx.nd.linalg_gelqf(_a(a)))
+    np.testing.assert_allclose(l @ q, a, atol=1e-4)
+    np.testing.assert_allclose(q @ q.T, np.eye(3), atol=1e-4)
+
+
+def test_linalg_syevd():
+    spd = _rand_spd(4)
+    ut, lam = (x.asnumpy() for x in mx.nd.linalg_syevd(_a(spd)))
+    np.testing.assert_allclose(ut.T @ np.diag(lam) @ ut, spd, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_linalg_sumlogdiag_and_diag():
+    spd = _rand_spd(3)
+    out = mx.nd.linalg_sumlogdiag(_a(spd)).asnumpy()
+    np.testing.assert_allclose(out, np.log(np.diag(spd)).sum(), atol=1e-5)
+    d = mx.nd.linalg_extractdiag(_a(spd)).asnumpy()
+    np.testing.assert_allclose(d, np.diag(spd))
+    m = mx.nd.linalg_makediag(_a(d)).asnumpy()
+    np.testing.assert_allclose(m, np.diag(np.diag(spd)))
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-3, 5, (4, 6)).astype(np.float32)
+    q, qmin, qmax = mx.nd.contrib.quantize(
+        _a(x), _a([x.min()]), _a([x.max()]), out_type="uint8")
+    back = mx.nd.contrib.dequantize(q, qmin, qmax).asnumpy()
+    assert q.asnumpy().dtype == np.uint8
+    np.testing.assert_allclose(back, x, atol=(x.max() - x.min()) / 250.0)
+
+
+def test_quantize_int8():
+    x = np.array([[-1.0, 0.0, 1.0]], np.float32)
+    q, _, _ = mx.nd.contrib.quantize(_a(x), _a([-1.0]), _a([1.0]),
+                                     out_type="int8")
+    np.testing.assert_allclose(q.asnumpy(), [[-127, 0, 127]])
+
+
+def test_quantize_int8_symmetric_asymmetric_range():
+    """int8 path is symmetric: scale = 127/MaxAbs (quantize-inl.h)."""
+    x = np.array([[-1.0, 0.0, 3.0]], np.float32)
+    q, qmin, qmax = mx.nd.contrib.quantize(_a(x), _a([-1.0]), _a([3.0]),
+                                           out_type="int8")
+    np.testing.assert_allclose(q.asnumpy(), [[-42, 0, 127]])
+    back = mx.nd.contrib.dequantize(q, qmin, qmax).asnumpy()
+    np.testing.assert_allclose(back, x, atol=3.0 / 127 + 1e-6)
