@@ -46,6 +46,7 @@ from . import module as mod
 from . import model
 from .model import save_checkpoint, load_checkpoint
 from . import gluon
+from . import rnn
 from . import recordio
 from . import visualization
 from . import profiler

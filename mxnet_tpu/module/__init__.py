@@ -2,5 +2,7 @@
 from .base_module import BaseModule
 from .module import Module
 from .executor_group import DataParallelExecutorGroup
+from .bucketing_module import BucketingModule
 
-__all__ = ["BaseModule", "Module", "DataParallelExecutorGroup"]
+__all__ = ["BaseModule", "Module", "DataParallelExecutorGroup",
+           "BucketingModule"]

@@ -258,11 +258,32 @@ class ResizeParam(Params):
     width = param_field(int, required=True)
 
 
+def _interp_axis(x, out_size, axis):
+    """align_corners=True linear interpolation along one axis (the reference
+    bilinear_resize-inl.h convention; jax.image.resize is half-pixel)."""
+    in_size = x.shape[axis]
+    if out_size == in_size:
+        return x
+    if in_size == 1 or out_size == 1:
+        idx0 = jnp.zeros((out_size,), jnp.int32)
+        return jnp.take(x, idx0, axis=axis)
+    pos = jnp.arange(out_size) * ((in_size - 1.0) / (out_size - 1.0))
+    lo = jnp.floor(pos).astype(jnp.int32)
+    lo = jnp.minimum(lo, in_size - 2)
+    frac = (pos - lo).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[axis] = out_size
+    frac = frac.reshape(shape)
+    a = jnp.take(x, lo, axis=axis)
+    b = jnp.take(x, lo + 1, axis=axis)
+    return a * (1 - frac) + b * frac
+
+
 @register_op("_contrib_BilinearResize2D", param_cls=ResizeParam)
 def _bilinear_resize(params, data):
-    n, c, _, _ = data.shape
-    return jax.image.resize(data, (n, c, params.height, params.width),
-                            method="linear").astype(data.dtype)
+    out = _interp_axis(data, params.height, 2)
+    out = _interp_axis(out, params.width, 3)
+    return out.astype(data.dtype)
 
 
 class AdaptivePoolParam(Params):
@@ -274,17 +295,19 @@ def _adaptive_avg_pool(params, data):
     oh, ow = (params.output_size if len(params.output_size) == 2
               else (params.output_size[0],) * 2)
     n, c, h, w = data.shape
-    # integral image with static bin edges (PyTorch/MXNet bin convention)
+    # integral image with static OVERLAPPING bin edges: start = floor(i*h/oh),
+    # end = ceil((i+1)*h/oh) — the MXNet/PyTorch adaptive-pool convention
     integ = jnp.cumsum(jnp.cumsum(data, axis=2), axis=3)
     integ = jnp.pad(integ, ((0, 0), (0, 0), (1, 0), (1, 0)))
-    y_edges = [(i * h) // oh for i in range(oh)] + [h]
-    x_edges = [(j * w) // ow for j in range(ow)] + [w]
+
+    def edges(size, bins):
+        return [((i * size) // bins, -((-(i + 1) * size) // bins))
+                for i in range(bins)]
+
     rows = []
-    for i in range(oh):
+    for y0, y1 in edges(h, oh):
         cols = []
-        y0, y1 = y_edges[i], y_edges[i + 1]
-        for j in range(ow):
-            x0, x1 = x_edges[j], x_edges[j + 1]
+        for x0, x1 in edges(w, ow):
             s = (integ[:, :, y1, x1] - integ[:, :, y0, x1]
                  - integ[:, :, y1, x0] + integ[:, :, y0, x0])
             cols.append(s / ((y1 - y0) * (x1 - x0)))

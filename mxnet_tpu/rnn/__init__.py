@@ -1,0 +1,16 @@
+"""Legacy symbolic RNN package (reference: python/mxnet/rnn/).
+
+Symbolic cells + unroll for the BucketingModule workflow; the Gluon-era API
+lives in mxnet_tpu.gluon.rnn.
+"""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
+                       SequentialRNNCell, BidirectionalCell, DropoutCell,
+                       ResidualCell, ZoneoutCell, ModifierCell, RNNParams)
+from .io import BucketSentenceIter, encode_sentences
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint)
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ResidualCell", "ZoneoutCell", "ModifierCell", "RNNParams",
+           "BucketSentenceIter", "encode_sentences", "save_rnn_checkpoint",
+           "load_rnn_checkpoint", "do_rnn_checkpoint"]

@@ -128,33 +128,27 @@ def test_box_nms_out_format():
     np.testing.assert_allclose(out[0, 2:6], [1.5, 1.5, 1.0, 1.0], atol=1e-6)
 
 
-def test_bilinear_resize2d():
+def test_bilinear_resize2d_matches_torch_align_corners():
+    torch = pytest.importorskip("torch")
     rng = np.random.RandomState(0)
-    x = rng.normal(size=(2, 3, 4, 4)).astype(np.float32)
-    out = mx.nd.contrib.BilinearResize2D(_a(x), height=8, width=8).asnumpy()
-    assert out.shape == (2, 3, 8, 8)
-    # corners preserved by align-corners-free linear resize center samples
-    np.testing.assert_allclose(out.mean(), x.mean(), atol=1e-2)
+    x = rng.normal(size=(2, 3, 4, 6)).astype(np.float32)
+    out = mx.nd.contrib.BilinearResize2D(_a(x), height=8, width=9).asnumpy()
+    ref = torch.nn.functional.interpolate(
+        torch.tensor(x), size=(8, 9), mode="bilinear",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
-def test_adaptive_avg_pooling():
+def test_adaptive_avg_pooling_matches_torch():
+    torch = pytest.importorskip("torch")
     rng = np.random.RandomState(0)
     x = rng.normal(size=(2, 3, 7, 5)).astype(np.float32)
-    out = mx.nd.contrib.AdaptiveAvgPooling2D(_a(x),
-                                             output_size=(3, 2)).asnumpy()
-    out1 = mx.nd.contrib.AdaptiveAvgPooling2D(_a(x),
-                                              output_size=(1, 1)).asnumpy()
-    np.testing.assert_allclose(out1[:, :, 0, 0], x.mean(axis=(2, 3)),
-                               atol=1e-5)
-    # bins partition: weighted mean of bin means (weights=bin areas) == mean
-    y_edges = [(i * 7) // 3 for i in range(3)] + [7]
-    x_edges = [(j * 5) // 2 for j in range(2)] + [5]
-    acc = np.zeros((2, 3))
-    for i in range(3):
-        for j in range(2):
-            area = (y_edges[i + 1] - y_edges[i]) * (x_edges[j + 1] - x_edges[j])
-            acc += out[:, :, i, j] * area
-    np.testing.assert_allclose(acc / 35.0, x.mean(axis=(2, 3)), atol=1e-5)
+    for osize in ((3, 2), (1, 1), (7, 5), (4, 4)):
+        out = mx.nd.contrib.AdaptiveAvgPooling2D(
+            _a(x), output_size=osize).asnumpy()
+        ref = torch.nn.functional.adaptive_avg_pool2d(
+            torch.tensor(x), osize).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5, err_msg=str(osize))
 
 
 def test_box_iou():

@@ -1,0 +1,226 @@
+"""Legacy RNN cells + BucketingModule tests (reference:
+tests/python/unittest/test_rnn.py + test_module.py bucketing cases).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(num_hidden=10, prefix="r_")
+    data = mx.sym.Variable("data")
+    outputs, states = cell.unroll(3, data, layout="NTC", merge_outputs=True)
+    args, outs, _ = outputs.infer_shape(data=(4, 3, 6))
+    assert outs[0] == (4, 3, 10)
+    assert len(states) == 1
+
+
+def test_lstm_gru_cell_shapes():
+    data = mx.sym.Variable("data")
+    for cell, n_state in ((mx.rnn.LSTMCell(8, prefix="l_"), 2),
+                          (mx.rnn.GRUCell(8, prefix="g_"), 1)):
+        outputs, states = cell.unroll(4, data, merge_outputs=True)
+        _, outs, _ = outputs.infer_shape(data=(2, 4, 5))
+        assert outs[0] == (2, 4, 8)
+        assert len(states) == n_state
+
+
+def test_sequential_residual_dropout_cells():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.GRUCell(12, prefix="g1_"))
+    stack.add(mx.rnn.DropoutCell(0.5))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(12, prefix="g2_")))
+    data = mx.sym.Variable("data")
+    outputs, states = stack.unroll(4, data, merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 4, 12))
+    assert outs[0] == (2, 4, 12)
+    assert len(states) == 2
+
+
+def test_bidirectional_cell():
+    bi = mx.rnn.BidirectionalCell(mx.rnn.RNNCell(8, prefix="f_"),
+                                  mx.rnn.RNNCell(8, prefix="b_"))
+    data = mx.sym.Variable("data")
+    outputs, _ = bi.unroll(4, data, merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 4, 6))
+    assert outs[0] == (2, 4, 16)  # fwd + bwd concat
+
+
+def test_zoneout_cell_runs():
+    cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(8, prefix="z_"),
+                              zoneout_outputs=0.2, zoneout_states=0.2)
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(3, data, merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(2, 3, 4))
+    assert outs[0] == (2, 3, 8)
+
+
+def test_fused_rnn_cell_and_unfuse():
+    cell = mx.rnn.FusedRNNCell(16, num_layers=2, mode="lstm", prefix="f_")
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(5, data, layout="NTC", merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(3, 5, 8))
+    assert outs[0] == (3, 5, 16)
+    stack = cell.unfuse()
+    outputs2, _ = stack.unroll(5, data, layout="NTC", merge_outputs=True)
+    _, outs2, _ = outputs2.infer_shape(data=(3, 5, 8))
+    assert outs2[0] == (3, 5, 16)
+
+
+def test_fused_weights_pack_unpack_roundtrip():
+    """Fused blob <-> per-cell weights; unfused graph binds with the
+    unpacked names and reproduces the fused outputs."""
+    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm", prefix="f_")
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(4, data, layout="NTC", merge_outputs=True)
+    args_shapes, _, _ = outputs.infer_shape(data=(2, 4, 6))
+    shapes = dict(zip(outputs.list_arguments(), args_shapes))
+    rng = np.random.RandomState(0)
+    blob = mx.nd.array(rng.normal(
+        0, 0.1, shapes["f_parameters"]).astype(np.float32))
+    args = {"f_parameters": blob}
+    unpacked = cell.unpack_weights(args)
+    assert "f_parameters" not in unpacked
+    assert "f_l0_i2h_weight" in unpacked and "f_l1_h2h_bias" in unpacked
+    assert unpacked["f_l0_i2h_weight"].shape == (32, 6)
+    repacked = cell.pack_weights(unpacked)
+    np.testing.assert_allclose(repacked["f_parameters"].asnumpy(),
+                               blob.asnumpy(), atol=1e-6)
+
+    # numerics: fused vs unfused forward with the shared weights
+    x = rng.normal(0, 1, (2, 4, 6)).astype(np.float32)
+    ex = outputs.simple_bind(mx.cpu(), grad_req="null", data=(2, 4, 6))
+    ex.arg_dict["f_parameters"][:] = blob.asnumpy()
+    ex.arg_dict["data"][:] = x
+    fused_out = ex.forward()[0].asnumpy()
+
+    stack = cell.unfuse()
+    out2, _ = stack.unroll(4, data, layout="NTC", merge_outputs=True)
+    ex2 = out2.simple_bind(mx.cpu(), grad_req="null", data=(2, 4, 6))
+    for name, arr in unpacked.items():
+        ex2.arg_dict[name][:] = arr.asnumpy()
+    ex2.arg_dict["data"][:] = x
+    unfused_out = ex2.forward()[0].asnumpy()
+    np.testing.assert_allclose(fused_out, unfused_out, atol=1e-4)
+
+
+def test_bucket_iter_with_unused_bucket():
+    """A user-supplied bucket with no sentences must not crash (empty 2-D)."""
+    it = mx.rnn.BucketSentenceIter([[1, 2, 3], [1, 2, 3]], batch_size=1,
+                                   buckets=[2, 3], invalid_label=0)
+    keys = [b.bucket_key for b in it]
+    assert keys and all(k == 3 for k in keys)
+
+
+def test_encode_sentences_and_bucket_iter():
+    sents = [["a", "b", "c"], ["b", "c"], ["a", "b", "c", "d", "e"]]
+    coded, vocab = mx.rnn.encode_sentences(sents, start_label=1,
+                                           invalid_label=0)
+    assert len(vocab) >= 5
+    it = mx.rnn.BucketSentenceIter(coded * 8, batch_size=4, buckets=[3, 5],
+                                   invalid_label=0)
+    seen = set()
+    for b in it:
+        seen.add(b.bucket_key)
+        assert b.data[0].shape == (4, b.bucket_key)
+        assert b.label[0].shape == (4, b.bucket_key)
+        d = b.data[0].asnumpy()
+        l = b.label[0].asnumpy()
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+    assert seen == {3, 5}
+
+
+def _lm_sym_gen(V):
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=V, output_dim=12,
+                                 name="embed")
+        cell = mx.rnn.LSTMCell(num_hidden=24, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, embed, layout="NTC",
+                                 merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, 24))
+        pred = mx.sym.FullyConnected(pred, num_hidden=V, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax",
+                                    use_ignore=True, ignore_label=0)
+        return pred, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def _toy_sentences(V, n=160, seed=0):
+    rng = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n):
+        L = rng.choice([4, 7])
+        start = rng.randint(1, V)
+        sents.append([(start + k) % (V - 1) + 1 for k in range(L)])
+    return sents
+
+
+def test_bucketing_module_trains_across_buckets():
+    V = 16
+    it = mx.rnn.BucketSentenceIter(_toy_sentences(V), 8, buckets=[4, 7],
+                                   invalid_label=0, shuffle_seed=1)
+    mod = mx.mod.BucketingModule(_lm_sym_gen(V),
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=mx.cpu())
+    mod.fit(it, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            eval_metric=mx.metric.Perplexity(ignore_label=0))
+    it.reset()
+    m = mx.metric.Perplexity(ignore_label=0)
+    for b in it:
+        mod.forward(b, is_train=False)
+        mod.update_metric(m, b.label)
+    assert m.get()[1] < 2.5, m.get()
+    # both buckets compiled
+    assert set(mod._buckets.keys()) == {4, 7}
+
+
+def test_bucketing_module_params_shared_across_buckets():
+    V = 16
+    it = mx.rnn.BucketSentenceIter(_toy_sentences(V), 8, buckets=[4, 7],
+                                   invalid_label=0)
+    mod = mx.mod.BucketingModule(_lm_sym_gen(V),
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    # force both buckets to exist by forwarding one batch of each
+    seen = {}
+    for b in it:
+        if b.bucket_key not in seen:
+            mod.forward(b, is_train=False)
+            seen[b.bucket_key] = True
+        if len(seen) == 2:
+            break
+    args, _ = mod.get_params()
+    e1 = args["embed_weight"].asnumpy()
+    # switch back to the other bucket; params must be identical
+    it.reset()
+    for b in it:
+        if b.bucket_key != mod._curr_bucket_key:
+            mod.forward(b, is_train=False)
+            break
+    args2, _ = mod.get_params()
+    np.testing.assert_allclose(args2["embed_weight"].asnumpy(), e1)
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    V = 16
+    cell = mx.rnn.LSTMCell(num_hidden=24, prefix="lstm_")
+    sym, _, _ = _lm_sym_gen(V)(4)
+    it = mx.rnn.BucketSentenceIter(_toy_sentences(V), 8, buckets=[4],
+                                   invalid_label=0)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    args, auxs = mod.get_params()
+    prefix = str(tmp_path / "rnnlm")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 3, sym, args, auxs)
+    sym2, args2, auxs2 = mx.rnn.load_rnn_checkpoint(cell, prefix, 3)
+    assert set(args2.keys()) == set(args.keys())
+    np.testing.assert_allclose(args2["embed_weight"].asnumpy(),
+                               args["embed_weight"].asnumpy())
