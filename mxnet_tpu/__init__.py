@@ -12,6 +12,7 @@ __version__ = "0.1.0"
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 from . import base
+from . import operator  # registers the Custom op before namespace generation
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
@@ -44,12 +45,17 @@ class kvstore:  # namespace shim so `mx.kvstore.create(...)` works
 from . import module
 from . import module as mod
 from . import model
-from .model import save_checkpoint, load_checkpoint
+from .model import save_checkpoint, load_checkpoint, FeedForward
 from . import gluon
 from . import rnn
 from . import recordio
 from . import visualization
 from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import image
+from . import rtc
+from . import contrib
 from .util import test_utils
 
 viz = visualization

@@ -1,0 +1,9 @@
+"""mx.contrib — experimental / auxiliary subpackages (reference:
+python/mxnet/contrib/)."""
+from . import quantization
+from . import text
+from . import tensorboard
+from . import io
+from . import autograd
+
+__all__ = ["quantization", "text", "tensorboard", "io", "autograd"]

@@ -1,0 +1,54 @@
+"""Old-style contrib autograd API (reference:
+python/mxnet/contrib/autograd.py — pre-gluon interface kept for compat)."""
+from __future__ import annotations
+
+from .. import autograd as _ag
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient", "grad_and_loss",
+           "grad"]
+
+
+def set_is_training(is_train):
+    prev = _ag.set_training(is_train)
+    _ag.set_recording(is_train)
+    return prev
+
+
+train_section = _ag.record
+test_section = _ag.pause
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    return _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    return _ag.backward(outputs, head_grads=out_grads,
+                        retain_graph=retain_graph)
+
+
+compute_gradient = backward
+
+
+def grad_and_loss(func, argnum=None):
+    """Returns fn computing (gradients, loss) (reference contrib API)."""
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else argnum
+            variables = [args[i] for i in argnums]
+        for x in variables:
+            x.attach_grad()
+        with _ag.record():
+            outputs = func(*args)
+        _ag.backward([outputs] if not isinstance(outputs, (list, tuple))
+                     else list(outputs))
+        return [x.grad for x in variables], outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    def wrapped(*args):
+        return grad_and_loss(func, argnum)(*args)[0]
+    return wrapped

@@ -1,0 +1,36 @@
+"""Contrib IO (reference: python/mxnet/contrib/io.py — DataLoaderIter
+bridging gluon DataLoader to the DataIter interface)."""
+from __future__ import annotations
+
+from ..io import DataIter, DataBatch, DataDesc
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a gluon DataLoader as a module-style DataIter."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size=getattr(loader, "_batch_size", 0))
+        self._loader = loader
+        self._iter = iter(loader)
+        self.data_name = data_name
+        self.label_name = label_name
+        self._first = next(self._iter)
+        self._consumed_first = False
+        data, label = self._first
+        self.batch_size = data.shape[0]
+        self.provide_data = [DataDesc(data_name, tuple(data.shape))]
+        self.provide_label = [DataDesc(label_name, tuple(label.shape))]
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._consumed_first = True  # first batch cache is stale after reset
+
+    def next(self):
+        if not self._consumed_first:
+            self._consumed_first = True
+            data, label = self._first
+            return DataBatch(data=[data], label=[label], pad=0)
+        data, label = next(self._iter)
+        return DataBatch(data=[data], label=[label], pad=0)

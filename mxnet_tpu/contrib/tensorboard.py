@@ -1,0 +1,42 @@
+"""TensorBoard bridge (reference: python/mxnet/contrib/tensorboard.py:73 —
+LogMetricsCallback writing scalar summaries)."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback(object):
+    """Batch-end callback logging eval metrics to a SummaryWriter.
+
+    Uses tensorboardX / torch.utils.tensorboard when importable; otherwise
+    falls back to collecting scalars in-memory (`.scalars`) and logging.
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.scalars = []
+        self._writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._writer = SummaryWriter(logging_dir)
+        except Exception:
+            try:
+                from tensorboardX import SummaryWriter
+                self._writer = SummaryWriter(logging_dir)
+            except Exception:
+                logging.warning("no tensorboard writer available; metrics "
+                                "collected in memory only")
+        self._step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self._step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.scalars.append((self._step, name, value))
+            if self._writer is not None:
+                self._writer.add_scalar(name, value, self._step)

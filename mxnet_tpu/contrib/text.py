@@ -1,0 +1,160 @@
+"""Text utilities: vocabulary + token embeddings (reference:
+python/mxnet/contrib/text/{vocab.py,embedding.py} — GloVe/fastText loaders).
+
+Zero-egress build: `CustomEmbedding` reads local embedding files in the
+standard `token v1 v2 ...` text format (the format GloVe/fastText ship);
+the named downloaders accept a pre-downloaded file path.
+"""
+from __future__ import annotations
+
+import collections
+import io as _io
+import os
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import array as nd_array
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding",
+           "get_pretrained_file_names"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """reference: text/utils.py count_tokens_from_str."""
+    source_str = source_str.lower() if to_lower else source_str
+    counter = (counter_to_update if counter_to_update is not None
+               else collections.Counter())
+    for seq in source_str.split(seq_delim):
+        counter.update(tok for tok in seq.split(token_delim) if tok)
+    return counter
+
+
+class Vocabulary(object):
+    """Indexed vocabulary with reserved tokens (reference: text/vocab.py)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        self.unknown_token = unknown_token
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise MXNetError("unknown_token cannot be reserved")
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        self._reserved_tokens = reserved_tokens
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for token, freq in pairs:
+                if freq < min_freq or token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        toks = []
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise MXNetError("index %d out of vocabulary range" % i)
+            toks.append(self._idx_to_token[i])
+        return toks[0] if single else toks
+
+
+class CustomEmbedding(object):
+    """Token embedding from a `token v1 v2 ...` text file (reference:
+    text/embedding.py CustomEmbedding; GloVe/fastText files load directly)."""
+
+    def __init__(self, pretrained_file_path=None, elem_delim=" ",
+                 encoding="utf8", vocabulary=None, init_unknown_vec=None):
+        self._token_to_idx = {}
+        self._idx_to_token = []
+        vecs = []
+        dim = None
+        if pretrained_file_path is not None:
+            with _io.open(pretrained_file_path, "r",
+                          encoding=encoding) as f:
+                for line in f:
+                    parts = line.rstrip().split(elem_delim)
+                    if len(parts) < 2:
+                        continue
+                    token, vals = parts[0], parts[1:]
+                    if dim is None:
+                        dim = len(vals)
+                    elif len(vals) != dim:
+                        continue  # malformed line (reference warns + skips)
+                    if token in self._token_to_idx:
+                        continue
+                    self._token_to_idx[token] = len(self._idx_to_token)
+                    self._idx_to_token.append(token)
+                    vecs.append(_np.asarray(vals, _np.float32))
+        if dim is None:
+            raise MXNetError("no embedding vectors loaded")
+        self.vec_len = dim
+        self._mat = _np.stack(vecs) if vecs else _np.zeros((0, dim))
+        self._unknown = (init_unknown_vec((dim,)) if init_unknown_vec
+                         else _np.zeros((dim,), _np.float32))
+        if vocabulary is not None:
+            rows = []
+            for tok in vocabulary.idx_to_token:
+                j = self._token_to_idx.get(tok)
+                rows.append(self._mat[j] if j is not None else self._unknown)
+            self._mat = _np.stack(rows)
+            self._idx_to_token = list(vocabulary.idx_to_token)
+            self._token_to_idx = dict(vocabulary.token_to_idx)
+
+    @property
+    def idx_to_vec(self):
+        return nd_array(self._mat)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        rows = []
+        for t in toks:
+            j = self._token_to_idx.get(t)
+            if j is None and lower_case_backup:
+                j = self._token_to_idx.get(t.lower())
+            rows.append(self._mat[j] if j is not None else self._unknown)
+        out = _np.stack(rows)
+        return nd_array(out[0] if single else out)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Catalog of the reference's downloadable embeddings (names only —
+    zero-egress: supply the file via CustomEmbedding(pretrained_file_path))."""
+    catalog = {
+        "glove": ["glove.6B.50d.txt", "glove.6B.100d.txt",
+                  "glove.6B.200d.txt", "glove.6B.300d.txt",
+                  "glove.42B.300d.txt", "glove.840B.300d.txt"],
+        "fasttext": ["wiki.en.vec", "wiki.simple.vec"],
+    }
+    if embedding_name is not None:
+        return catalog.get(embedding_name, [])
+    return catalog

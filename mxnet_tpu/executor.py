@@ -63,6 +63,9 @@ class Executor:
                             if self._grad_req.get(n, "null") != "null"]
         self.outputs = []
         self._cached = {}
+        self._monitor_cb = None
+        self._monitor_active = False
+        self._pending_monitor = []
 
         # node tables built once (trace order)
         self._topo = [n for n in symbol._topo() if not n.is_variable]
@@ -89,7 +92,8 @@ class Executor:
     # ------------------------------------------------------------------
     # pure graph interpreter (traced under jit)
     # ------------------------------------------------------------------
-    def _run_graph(self, arg_vals, aux_vals, key, is_train):
+    def _run_graph(self, arg_vals, aux_vals, key, is_train,
+                   collect_interior=False):
         vals = {}
         for node in self._var_nodes:
             src = aux_vals if id(node) in self._aux_var_ids else arg_vals
@@ -122,6 +126,15 @@ class Executor:
                 outputs.append(vals[(id(node), 0)])
             else:
                 outputs.append(vals[(id(node), oidx)])
+        if collect_interior:
+            interior = []
+            for node in self._topo:
+                n_vis = node.op.n_outputs(node.make_params())
+                for i in range(n_vis):
+                    suffix = "_output" if n_vis == 1 else "_output%d" % i
+                    interior.append((node.name + suffix,
+                                     vals[(id(node), i)]))
+            return tuple(outputs), aux_updates, interior
         return tuple(outputs), aux_updates
 
     # ------------------------------------------------------------------
@@ -184,7 +197,58 @@ class Executor:
         for name, val in aux_upd.items():
             self.aux_dict[name]._data = val
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        if self._monitor_cb is not None and self._monitor_active:
+            self._collect_monitor(is_train, rng)
         return self.outputs
+
+    # ------------------------------------------------------------------
+    # monitor hooks (reference: GraphExecutor monitor callback,
+    # src/executor/graph_executor.cc:123 — per-op output stat hooks)
+    # ------------------------------------------------------------------
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_cb = callback
+        self._monitor_active = True
+        self._pending_monitor = []
+
+    def monitor_activate(self, active):
+        """Gate the interior-capture side program (Monitor.tic/toc toggle it
+        so off-interval batches pay nothing)."""
+        self._monitor_active = bool(active)
+        if not active:
+            self._pending_monitor = []
+
+    def _monitor_fn(self, is_train):
+        key = ("mon", is_train)
+        if key not in self._cached:
+            def f(arg_vals, aux_vals, rng):
+                _, _, interior = self._run_graph(arg_vals, aux_vals, rng,
+                                                 is_train,
+                                                 collect_interior=True)
+                return [v for _, v in interior]
+            self._cached[key] = jax.jit(f)
+        return self._cached[key]
+
+    def _collect_monitor(self, is_train, rng):
+        arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+        aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        # names come from an untraced pass; values from the jitted one
+        names = []
+        for node in self._topo:
+            n_vis = node.op.n_outputs(node.make_params())
+            for i in range(n_vis):
+                suffix = "_output" if n_vis == 1 else "_output%d" % i
+                names.append(node.name + suffix)
+        vals = self._monitor_fn(is_train)(arg_vals, aux_vals, rng)
+        self._pending_monitor.extend(zip(names, vals))
+
+    def monitor_flush(self):
+        cb = self._monitor_cb
+        if cb is None:
+            self._pending_monitor = []
+            return
+        for name, arr in self._pending_monitor:
+            cb(name, arr)
+        self._pending_monitor = []
 
     def backward(self, out_grads=None, is_train=True):
         if not self._grad_names:

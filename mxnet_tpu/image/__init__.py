@@ -1,0 +1,26 @@
+"""mx.image — Python image pipeline (reference: python/mxnet/image/, 2213
+LoC: ImageIter + augmenter chain, ImageDetIter for detection).
+
+The C++ ImageRecordIter (mxnet_tpu/recordio_iter.py over
+src/io/image_record_iter.cc) is the fast path; this package is the flexible
+Python fallback, mirroring the reference's split.
+"""
+from .image import (imdecode, imread, imresize, resize_short, fixed_crop,
+                    random_crop, center_crop, color_normalize, scale_down,
+                    Augmenter, ResizeAug, ForceResizeAug, RandomCropAug,
+                    CenterCropAug, HorizontalFlipAug, BrightnessJitterAug,
+                    ContrastJitterAug, SaturationJitterAug, ColorJitterAug,
+                    LightingAug, ColorNormalizeAug, CastAug, CreateAugmenter,
+                    ImageIter)
+from .detection import (DetAugmenter, DetBorrowAug, DetHorizontalFlipAug,
+                        DetRandomCropAug, CreateDetAugmenter, ImageDetIter)
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize", "scale_down",
+           "Augmenter", "ResizeAug", "ForceResizeAug", "RandomCropAug",
+           "CenterCropAug", "HorizontalFlipAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "ColorJitterAug",
+           "LightingAug", "ColorNormalizeAug", "CastAug", "CreateAugmenter",
+           "ImageIter", "DetAugmenter", "DetBorrowAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "CreateDetAugmenter",
+           "ImageDetIter"]

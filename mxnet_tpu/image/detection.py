@@ -1,0 +1,243 @@
+"""Detection image pipeline (reference: python/mxnet/image/detection.py —
+ImageDetIter + box-aware augmenters; C++ analog
+src/io/image_det_aug_default.cc).
+
+Labels are [N, 5+]: (cls_id, xmin, ymin, xmax, ymax, ...) with normalized
+[0, 1] coordinates; padded rows have cls_id = -1.
+"""
+from __future__ import annotations
+
+import random as _random
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc
+from ..ndarray.ndarray import array as nd_array
+from .image import (ImageIter, Augmenter, ForceResizeAug, imdecode)
+
+__all__ = []
+
+
+class DetAugmenter(object):
+    """Box-aware augmenter: __call__(src, label) -> (src, label)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src, label):
+        raise NotImplementedError()
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only augmenter (reference: DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        super().__init__(augmenter=augmenter.__class__.__name__)
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if _random.random() < self.p:
+            src = src[:, ::-1]
+            valid = label[:, 0] >= 0
+            xmin = label[:, 1].copy()
+            label[valid, 1] = 1.0 - label[valid, 3]
+            label[valid, 3] = 1.0 - xmin[valid]
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (reference: DetRandomCropAug, simplified
+    to the SSD-style sampling loop)."""
+
+    def __init__(self, min_object_covered=0.3, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.3, 1.0), max_attempts=20):
+        super().__init__(min_object_covered=min_object_covered)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            area = _random.uniform(*self.area_range)
+            ratio = _random.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, _np.sqrt(area * ratio))
+            ch = min(1.0, _np.sqrt(area / ratio))
+            cx = _random.uniform(0, 1 - cw)
+            cy = _random.uniform(0, 1 - ch)
+            new_label = self._update_labels(label, (cx, cy, cw, ch))
+            if new_label is not None:
+                x0, y0 = int(cx * w), int(cy * h)
+                cw_px, ch_px = max(1, int(cw * w)), max(1, int(ch * h))
+                return src[y0:y0 + ch_px, x0:x0 + cw_px], new_label
+        return src, label
+
+    def _update_labels(self, label, crop):
+        cx, cy, cw, ch = crop
+        out = label.copy()
+        valid = label[:, 0] >= 0
+        if not valid.any():
+            return None
+        boxes = label[valid, 1:5]
+        # intersection with crop
+        ix0 = _np.maximum(boxes[:, 0], cx)
+        iy0 = _np.maximum(boxes[:, 1], cy)
+        ix1 = _np.minimum(boxes[:, 2], cx + cw)
+        iy1 = _np.minimum(boxes[:, 3], cy + ch)
+        iw = _np.maximum(ix1 - ix0, 0)
+        ih = _np.maximum(iy1 - iy0, 0)
+        inter = iw * ih
+        areas = ((boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]))
+        cover = inter / _np.maximum(areas, 1e-12)
+        keep = cover >= self.min_object_covered
+        if not keep.any():
+            return None
+        # re-normalize kept boxes to the crop
+        new_boxes = _np.stack([
+            _np.clip((ix0 - cx) / cw, 0, 1),
+            _np.clip((iy0 - cy) / ch, 0, 1),
+            _np.clip((ix1 - cx) / cw, 0, 1),
+            _np.clip((iy1 - cy) / ch, 0, 1)], axis=1)
+        out[:] = -1.0
+        vidx = _np.where(valid)[0][keep]
+        out[:len(vidx), 0] = label[vidx, 0]
+        out[:len(vidx), 1:5] = new_boxes[keep]
+        if label.shape[1] > 5:
+            out[:len(vidx), 5:] = label[vidx, 5:]
+        return out
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_mirror=False,
+                       mean=None, std=None, brightness=0, contrast=0,
+                       saturation=0, min_object_covered=0.3,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.3, 3.0), inter_method=2, **kwargs):
+    """reference: detection.py CreateDetAugmenter."""
+    from .image import (ColorJitterAug, ColorNormalizeAug, CastAug)
+    auglist = []
+    if rand_crop > 0:
+        auglist.append(DetRandomCropAug(
+            min_object_covered=min_object_covered,
+            aspect_ratio_range=aspect_ratio_range,
+            area_range=(min(area_range[0], 1.0), min(area_range[1], 1.0))))
+    auglist.append(DetBorrowAug(ForceResizeAug(
+        (data_shape[2], data_shape[1]), inter_method)))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator (reference: detection.py ImageDetIter).
+
+    Record labels: flat header vector [4(+)…] per the im2rec detection
+    format: [header_width, label_width_per_obj, (cls, x0, y0, x1, y1) * N].
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", imglist=None,
+                 label_width=-1, label_pad_width=-1, label_pad_value=-1.0,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 data_name="data", label_name="label", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+        self._det_aug = aug_list
+        self.label_pad_width = label_pad_width
+        self.label_pad_value = label_pad_value
+        self._obj_width = 5
+        super().__init__(batch_size=batch_size, data_shape=data_shape,
+                         label_width=1, path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         imglist=imglist, shuffle=shuffle,
+                         part_index=part_index, num_parts=num_parts,
+                         aug_list=[], data_name=data_name,
+                         label_name=label_name)
+        # scan first record for label geometry
+        first = self._parse_label(self._peek_label())
+        self._obj_width = first.shape[1]
+        if self.label_pad_width < 0:
+            self.label_pad_width = max(8, first.shape[0])
+        self.reset()
+
+    def _peek_label(self):
+        label, _ = self.next_sample()
+        self.cur = 0
+        return label
+
+    @staticmethod
+    def _parse_label(label):
+        """Flat header vector -> [N, obj_width] (reference:
+        detection.py _parse_label)."""
+        raw = _np.asarray(label, _np.float32).ravel()
+        if raw.size < 7:
+            raise MXNetError("label too short for detection: %s" % raw)
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        body = raw[header_width:]
+        n = body.size // obj_width
+        return body[:n * obj_width].reshape(n, obj_width)
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.label_pad_width,
+                          self._obj_width))]
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = _np.zeros((self.batch_size, c, h, w), _np.float32)
+        batch_label = _np.full((self.batch_size, self.label_pad_width,
+                                self._obj_width), self.label_pad_value,
+                               _np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, buf = self.next_sample()
+                img = imdecode(buf).astype(_np.float32)
+                objs = self._parse_label(label)
+                if len(objs) > self.label_pad_width:
+                    import logging
+                    logging.warning(
+                        "ImageDetIter: record has %d objects > "
+                        "label_pad_width=%d; extra ground truth DROPPED — "
+                        "pass a larger label_pad_width", len(objs),
+                        self.label_pad_width)
+                padded = _np.full((self.label_pad_width, self._obj_width),
+                                  self.label_pad_value, _np.float32)
+                padded[:min(len(objs), self.label_pad_width)] = \
+                    objs[:self.label_pad_width]
+                for aug in self._det_aug:
+                    img, padded = aug(img, padded)
+                batch_data[i] = img.transpose(2, 0, 1)
+                batch_label[i] = padded
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        return DataBatch(data=[nd_array(batch_data)],
+                         label=[nd_array(batch_label)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

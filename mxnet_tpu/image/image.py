@@ -1,0 +1,407 @@
+"""Classification image pipeline (reference: python/mxnet/image/image.py).
+
+Arrays are HWC uint8/float32 numpy (RGB order, like the reference's
+mx.image), converted to NCHW float NDArrays at batch time.
+"""
+from __future__ import annotations
+
+import os
+import random as _random
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..io import DataIter, DataBatch, DataDesc
+from ..ndarray.ndarray import NDArray, array as nd_array
+from .. import recordio
+
+__all__ = []  # re-exported by package __init__
+
+
+def _cv2():
+    import cv2
+    return cv2
+
+
+def imdecode(buf, to_rgb=True, flag=1):
+    """JPEG/PNG bytes -> HWC numpy (reference: image.py imdecode)."""
+    cv2 = _cv2()
+    img = cv2.imdecode(_np.frombuffer(buf, dtype=_np.uint8), flag)
+    if img is None:
+        raise MXNetError("image decode failed")
+    if to_rgb and img.ndim == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return img
+
+
+def imread(path, to_rgb=True, flag=1):
+    with open(path, "rb") as f:
+        return imdecode(f.read(), to_rgb=to_rgb, flag=flag)
+
+
+def imresize(src, w, h, interp=1):
+    return _cv2().resize(src, (w, h), interpolation=interp)
+
+
+def scale_down(src_size, size):
+    """Scale (w, h) down to fit src (reference: image.py scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _random.randint(0, w - new_w)
+    y0 = _random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype(_np.float32) - mean
+    if std is not None:
+        src /= std
+    return src
+
+
+class Augmenter(object):
+    """reference: image.py Augmenter."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError()
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _random.random() < self.p:
+            src = src[:, ::-1]
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.contrast, self.contrast)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray.mean() * (1.0 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = ContrastJitterAug._coef
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _random.uniform(-self.saturation, self.saturation)
+        gray = (src * self._coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness, contrast, saturation):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        augs = []
+        if brightness > 0:
+            augs.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            augs.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            augs.append(SaturationJitterAug(saturation))
+        self.augs = augs
+
+    def __call__(self, src):
+        _random.shuffle(self.augs)
+        for aug in self.augs:
+            src = aug(src)
+        return src
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (reference: image.py LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = _np.dot(self.eigvec * alpha, self.eigval)
+        return src + rgb.reshape(1, 1, 3)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = _np.asarray(mean, _np.float32) if mean is not None else None
+        self.std = _np.asarray(std, _np.float32) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean if self.mean is not None else 0.0,
+                               self.std)
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return src.astype(_np.float32)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """reference: image.py CreateAugmenter."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Flexible Python image iterator (reference: image.py ImageIter).
+
+    Sources: `path_imgrec` (RecordIO, optional `path_imgidx`) or `imglist` +
+    `path_root` (entries [label, relpath]).
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        if len(data_shape) != 3 or data_shape[0] not in (1, 3):
+            raise MXNetError("data_shape must be (channels, height, width)")
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        if path_imgrec is not None:
+            idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(idx_path,
+                                                         path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                rec = recordio.MXRecordIO(path_imgrec, "r")
+                items = []
+                while True:
+                    buf = rec.read()
+                    if buf is None:
+                        break
+                    items.append(buf)
+                rec.close()
+                self._raw_items = items
+                self.seq = list(range(len(items)))
+        elif imglist is not None or path_imglist is not None:
+            if path_imglist is not None:
+                imglist = []
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        imglist.append([float(x) for x in parts[1:-1]]
+                                       + [parts[-1]])
+            self.imglist = {}
+            self.seq = []
+            for i, item in enumerate(imglist):
+                label = _np.asarray(item[:-1], _np.float32)
+                self.imglist[i] = (label, item[-1])
+                self.seq.append(i)
+            self.path_root = path_root
+        else:
+            raise MXNetError("need path_imgrec, path_imglist or imglist")
+
+        if num_parts > 1:
+            self.seq = self.seq[part_index::num_parts]
+        self.shuffle = shuffle
+        if aug_list is None:
+            aug_list = CreateAugmenter(data_shape, **kwargs)
+        self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        if self.shuffle:
+            _random.shuffle(self.seq)
+        self.cur = 0
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            header, img = recordio.unpack(self.imgrec.read_idx(idx))
+            return header.label, img
+        if self.imglist is not None:
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as f:
+                return label, f.read()
+        header, img = recordio.unpack(self._raw_items[idx])
+        return header.label, img
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = _np.zeros((self.batch_size, c, h, w), _np.float32)
+        batch_label = _np.zeros((self.batch_size, self.label_width),
+                                _np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, buf = self.next_sample()
+                img = imdecode(buf, flag=1 if c == 3 else 0)
+                img = img.astype(_np.float32)
+                for aug in self.auglist:
+                    img = aug(img)
+                if img.ndim == 2:
+                    img = img[:, :, None]
+                batch_data[i] = img.transpose(2, 0, 1)
+                batch_label[i] = _np.asarray(label, _np.float32).reshape(-1)[
+                    :self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        label = (batch_label[:, 0] if self.label_width == 1 else batch_label)
+        return DataBatch(data=[nd_array(batch_data)],
+                         label=[nd_array(label)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

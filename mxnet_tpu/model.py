@@ -124,3 +124,105 @@ def load_checkpoint(prefix, epoch):
     symbol = sym_mod.load("%s-symbol.json" % prefix)
     arg_params, aux_params = load_params("%s-%04d.params" % (prefix, epoch))
     return symbol, arg_params, aux_params
+
+
+class FeedForward(object):
+    """Legacy training API (reference: model.py:470 FeedForward — deprecated
+    in 1.2 in favor of Module; kept as a thin Module wrapper for parity)."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .initializer import Uniform
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    def _get_module(self, label_names=("softmax_label",)):
+        from .module.module import Module
+        if self._module is None:
+            self._module = Module(self.symbol, context=self.ctx,
+                                  label_names=list(label_names))
+        return self._module
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from .io import NDArrayIter
+        if not hasattr(X, "provide_data"):
+            X = NDArrayIter(X, y, batch_size=self.numpy_batch_size,
+                            shuffle=True)
+        label_names = [d.name for d in (X.provide_label or [])] or \
+            ["softmax_label"]
+        mod = self._get_module(label_names)
+        if logger is not None:
+            mod.logger = logger
+        opt_params = dict(self.kwargs)
+        mod.fit(X, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer, optimizer_params=opt_params,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
+                initializer=self.initializer, arg_params=self.arg_params,
+                aux_params=self.aux_params, allow_missing=True,
+                begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+                monitor=monitor)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        from .io import NDArrayIter
+        if not hasattr(X, "provide_data"):
+            X = NDArrayIter(X, batch_size=self.numpy_batch_size)
+        mod = self._get_module()
+        if not mod.binded:
+            mod.bind(data_shapes=X.provide_data, for_training=False)
+            mod.set_params(self.arg_params, self.aux_params or {})
+        if reset:
+            X.reset()
+        out = mod.predict(X, num_batch=num_batch)
+        return out.asnumpy()
+
+    def score(self, X, eval_metric="acc", num_batch=None):
+        from . import metric as metric_mod
+        mod = self._get_module()
+        if not mod.binded:
+            mod.bind(data_shapes=X.provide_data,
+                     label_shapes=X.provide_label, for_training=False)
+            mod.set_params(self.arg_params, self.aux_params or {})
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        res = mod.score(X, eval_metric, num_batch=num_batch)
+        return dict(res)[eval_metric.name]
+
+    def save(self, prefix, epoch=None):
+        epoch = epoch if epoch is not None else (self.num_epoch or 0)
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, **kwargs):
+        """Train and return a model (reference: FeedForward.create)."""
+        fit_kwargs = {k: kwargs.pop(k) for k in
+                      ("eval_data", "eval_metric", "epoch_end_callback",
+                       "batch_end_callback", "kvstore", "logger")
+                      if k in kwargs}
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch, **kwargs)
+        return model.fit(X, y, **fit_kwargs)

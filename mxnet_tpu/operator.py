@@ -1,0 +1,198 @@
+"""User-defined Python operators (reference: python/mxnet/operator.py, 1101
+LoC — CustomOp/CustomOpProp + the C++ CustomOperator worker thread,
+src/operator/custom/custom-inl.h:50).
+
+TPU-native: the Python callbacks run through `jax.pure_callback` (host
+callback out of the XLA program — the analog of the reference's dedicated
+worker thread that keeps Python off the engine threads), wrapped in
+`jax.custom_vjp` so `backward()` drives the user's backward implementation.
+Shapes/dtypes come from the prop's infer_shape/infer_type at trace time.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, Params, param_field
+from .ops.registry import register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+_REGISTRY = {}
+
+
+class CustomOp(object):
+    """Base class for user ops (reference: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        """reference semantics: honor the grad_req of the destination."""
+        if req == "null":
+            return
+        elif req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst[:] + src if hasattr(dst, "__getitem__") else dst + src
+
+
+class CustomOpProp(object):
+    """Op metadata provider (reference: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass (reference:
+    operator.py register)."""
+    def do_register(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_all_registered_operators():
+    return list(_REGISTRY.keys())
+
+
+class _SimpleArray(_np.ndarray):
+    """numpy view that also answers .asnumpy() (user code may call either)."""
+
+    def asnumpy(self):
+        return _np.asarray(self)
+
+
+def _wrap(arr):
+    return _np.asarray(arr).view(_SimpleArray)
+
+
+class CustomParam(Params):
+    op_type = param_field(str, required=True)
+
+    def __init__(self, **kwargs):
+        # arbitrary extra kwargs are forwarded to the prop constructor
+        # (reference: MXCustomOpRegister passes all string kwargs through)
+        op_type = kwargs.pop("op_type", None)
+        if op_type is None:
+            raise MXNetError("Custom op requires op_type")
+        super().__init__(op_type=op_type)
+        self.kwargs = kwargs
+
+    def as_str_dict(self):
+        out = {"op_type": self.op_type}
+        out.update({k: str(v) for k, v in self.kwargs.items()})
+        return out
+
+
+def _get_prop(params):
+    if params.op_type not in _REGISTRY:
+        raise MXNetError("custom op type %r is not registered (known: %s)"
+                         % (params.op_type, list(_REGISTRY)))
+    return _REGISTRY[params.op_type](**(params.kwargs or {}))
+
+
+def _custom_inputs(p):
+    if p is None:
+        return ("data",)
+    prop = _get_prop(p)
+    return tuple(prop.list_arguments()) + tuple(prop.list_auxiliary_states())
+
+
+def _custom_n_outputs(p):
+    if p is None:
+        return 1
+    return len(_get_prop(p).list_outputs())
+
+
+@register_op("Custom", param_cls=CustomParam, input_names=_custom_inputs,
+             num_outputs=_custom_n_outputs, need_train=True)
+def _custom(params, *inputs, is_train=False):
+    prop = _get_prop(params)
+    n_args = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
+    args, aux = inputs[:n_args], inputs[n_args:]
+    in_shapes = [tuple(a.shape) for a in args]
+    in_dtypes = [a.dtype for a in args]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    _, out_dtypes, _ = prop.infer_type([_np.dtype(d) for d in in_dtypes])
+    out_dtypes = [_np.dtype(d) for d in out_dtypes]
+    result_shapes = [jax.ShapeDtypeStruct(tuple(s), d)
+                     for s, d in zip(out_shapes, out_dtypes)]
+
+    def host_forward(train_flag, *host_inputs):
+        op = prop.create_operator(None, in_shapes, in_dtypes)
+        h_args = [_wrap(a) for a in host_inputs[:n_args]]
+        h_aux = [_wrap(a) for a in host_inputs[n_args:]]
+        outs = [_np.zeros(s.shape, s.dtype) for s in result_shapes]
+        op.forward(bool(train_flag), ["write"] * n_out, h_args, outs, h_aux)
+        return tuple(_np.asarray(o) for o in outs)
+
+    @jax.custom_vjp
+    def run(args, aux):
+        outs = jax.pure_callback(functools.partial(host_forward, is_train),
+                                 tuple(result_shapes), *args, *aux)
+        return tuple(outs)
+
+    def run_fwd(args, aux):
+        outs = run(args, aux)
+        return outs, (args, aux, outs)
+
+    def run_bwd(res, out_grads):
+        args_v, aux_v, outs = res
+
+        def host_backward(*host_vals):
+            n = len(args_v)
+            h_args = [_wrap(v) for v in host_vals[:n]]
+            h_aux = [_wrap(v) for v in host_vals[n:n + len(aux_v)]]
+            h_outs = [_wrap(v) for v in
+                      host_vals[n + len(aux_v):n + len(aux_v) + n_out]]
+            h_ograds = [_wrap(v) for v in host_vals[n + len(aux_v) + n_out:]]
+            op = prop.create_operator(None, in_shapes, in_dtypes)
+            igrads = [_np.zeros(a.shape, a.dtype) for a in h_args]
+            op.backward(["write"] * n, h_ograds, h_args, h_outs, igrads,
+                        h_aux)
+            return tuple(_np.asarray(g) for g in igrads)
+
+        grad_shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                            for a in args_v)
+        grads = jax.pure_callback(host_backward, grad_shapes,
+                                  *args_v, *aux_v, *outs, *out_grads)
+        return tuple(grads), tuple(jnp.zeros_like(a) for a in aux_v)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(tuple(args), tuple(aux))

@@ -2,8 +2,6 @@
 BucketSentenceIter + encode_sentences)."""
 from __future__ import annotations
 
-import random as _random
-
 import numpy as _np
 
 from ..io import DataIter, DataBatch, DataDesc
@@ -83,7 +81,7 @@ class BucketSentenceIter(DataIter):
         self.invalid_label = invalid_label
         self.layout = layout
         self.default_bucket_key = max(buckets)
-        self._rng = _random.Random(shuffle_seed)
+        self._rng = _np.random.RandomState(shuffle_seed)
 
         self.idx = []
         for i, buck in enumerate(self.data):
@@ -108,9 +106,12 @@ class BucketSentenceIter(DataIter):
 
     def reset(self):
         self.curr_idx = 0
-        self._rng.shuffle(self.idx)
-        for buck in self.data:
-            self._rng.shuffle(buck)
+        # numpy permutation — random.Random.shuffle corrupts 2-D ndarrays
+        # (its tuple-swap operates on row views)
+        perm = self._rng.permutation(len(self.idx))
+        self.idx = [self.idx[i] for i in perm]
+        self.data = [buck[self._rng.permutation(len(buck))]
+                     if len(buck) else buck for buck in self.data]
         self.nddata = []
         self.ndlabel = []
         for buck in self.data:

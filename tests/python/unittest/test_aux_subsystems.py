@@ -1,0 +1,361 @@
+"""Aux-subsystem tests: CustomOp, Monitor, mx.image, contrib (quantization/
+text/io/autograd), rtc Pallas module, FeedForward.
+
+Reference models: tests/python/unittest/{test_operator.py custom-op cases,
+test_image.py, test_io.py, test_module.py}.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+# ---------------------------------------------------------------------------
+# CustomOp
+# ---------------------------------------------------------------------------
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        self.assign(in_grad[0], req[0], out_grad[0].asnumpy() * y * (1 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _Sigmoid()
+
+
+def test_custom_op_eager_autograd():
+    x = mx.nd.array(np.array([[-1.0, 0.0, 2.0]], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="test_sigmoid")
+        y.sum().backward()
+    ref = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), ref, atol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), ref * (1 - ref), atol=1e-6)
+
+
+def test_custom_op_symbol_train():
+    data = mx.sym.Variable("data")
+    s = mx.sym.Custom(data, op_type="test_sigmoid", name="sig")
+    ex = s.simple_bind(mx.cpu(), grad_req="write", data=(2, 3))
+    ex.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    out = ex.forward(is_train=True)[0].asnumpy()
+    sig = 1 / (1 + np.exp(-1))
+    np.testing.assert_allclose(out, sig, atol=1e-6)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               sig * (1 - sig), atol=1e-6)
+
+
+def test_custom_op_kwargs():
+    class Scale(mx.operator.CustomOp):
+        def __init__(self, factor):
+            self.factor = factor
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        in_data[0].asnumpy() * self.factor)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        out_grad[0].asnumpy() * self.factor)
+
+    @mx.operator.register("test_scale")
+    class ScaleProp(mx.operator.CustomOpProp):
+        def __init__(self, factor="1.0"):
+            super().__init__(need_top_grad=True)
+            self.factor = float(factor)
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return Scale(self.factor)
+
+    x = mx.nd.array(np.ones((2, 2), np.float32))
+    y = mx.nd.Custom(x, op_type="test_scale", factor="2.5")
+    np.testing.assert_allclose(y.asnumpy(), 2.5)
+
+
+# ---------------------------------------------------------------------------
+# Monitor
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_collects_interior_outputs():
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (20, 4)).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=10)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc1")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=2, name="fc2"), name="softmax")
+    mon = mx.Monitor(interval=1, pattern="fc.*")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(next(iter(it)), is_train=True)
+    stats = mon.toc()
+    names = [k for _, k, _ in stats]
+    assert "fc1_output" in names and "fc2_output" in names
+    assert "softmax_output" not in names  # filtered by pattern
+
+
+def test_monitor_interval_gating():
+    """Off-interval batches must not buffer interior tensors."""
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (40, 4)).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=10)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name="fc"), name="softmax")
+    mon = mx.Monitor(interval=3, pattern="fc.*")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.install_monitor(mon)
+    exe = mod._exec_group.execs[0]
+    for i, b in enumerate(it):
+        mon.tic()
+        mod.forward(b, is_train=True)
+        if i % 3 != 0:  # gated off: no pending capture
+            assert not exe._pending_monitor
+        mon.toc()
+    assert not exe._pending_monitor
+
+
+def test_custom_op_infer_type_consulted():
+    class ArgMaxOp(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        in_data[0].asnumpy().argmax(1).astype(np.int32))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        np.zeros_like(in_data[0].asnumpy()))
+
+    @mx.operator.register("test_argmax_i32")
+    class ArgMaxProp(mx.operator.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [[in_shape[0][0]]], []
+
+        def infer_type(self, in_type):
+            return in_type, [np.int32], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return ArgMaxOp()
+
+    x = mx.nd.array(np.array([[1.0, 5.0, 2.0]], np.float32))
+    out = mx.nd.Custom(x, op_type="test_argmax_i32")
+    assert out.asnumpy().dtype == np.int32
+    assert out.asnumpy()[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# mx.image
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def img_rec(tmp_path_factory):
+    import cv2
+    d = tmp_path_factory.mktemp("imgs")
+    path = str(d / "data.rec")
+    idx_path = str(d / "data.idx")
+    rec = mx.recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(12):
+        img = np.full((40, 48, 3), 20 * i, np.uint8)
+        rec.write_idx(i, mx.recordio.pack_img(
+            mx.recordio.IRHeader(0, float(i % 3), i, 0), img, quality=100))
+    rec.close()
+    return path, idx_path
+
+
+def test_image_iter_rec(img_rec):
+    path, idx = img_rec
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                            path_imgrec=path, path_imgidx=idx)
+    labels = []
+    for b in it:
+        assert b.data[0].shape == (4, 3, 32, 32)
+        labels.extend(b.label[0].asnumpy()[:4 - b.pad].tolist())
+    assert len(labels) == 12
+    assert sorted(set(labels)) == [0.0, 1.0, 2.0]
+
+
+def test_image_augmenters():
+    src = np.random.RandomState(0).uniform(
+        0, 255, (50, 60, 3)).astype(np.float32)
+    out = mx.image.resize_short(src, 32)
+    assert min(out.shape[:2]) == 32
+    out, _ = mx.image.center_crop(src, (24, 24))
+    assert out.shape[:2] == (24, 24)
+    auglist = mx.image.CreateAugmenter((3, 24, 24), rand_crop=True,
+                                       rand_mirror=True, brightness=0.1,
+                                       contrast=0.1, saturation=0.1,
+                                       pca_noise=0.05, mean=True, std=True)
+    img = src
+    for aug in auglist:
+        img = aug(img)
+    assert img.shape == (24, 24, 3)
+    assert img.dtype == np.float32
+
+
+def test_image_det_iter():
+    import cv2
+    # build detection records: label = [4, 5, (cls,x0,y0,x1,y1)*2]
+    imglist = []
+    import tempfile
+    root = tempfile.mkdtemp()
+    for i in range(6):
+        img = np.full((40, 40, 3), 30 * i, np.uint8)
+        fname = os.path.join(root, "%d.jpg" % i)
+        cv2.imwrite(fname, img)
+        label = [4, 5, 0, 0,  # header: header_width=4, obj_width=5, pad, pad
+                 float(i % 2), 0.1, 0.1, 0.5, 0.5,
+                 float((i + 1) % 2), 0.4, 0.4, 0.9, 0.9]
+        imglist.append(label + ["%d.jpg" % i])
+    it = mx.image.ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                               imglist=imglist, path_root=root,
+                               rand_mirror=True)
+    for b in it:
+        assert b.data[0].shape == (3, 3, 32, 32)
+        lab = b.label[0].asnumpy()
+        assert lab.shape[2] == 5
+        valid = lab[lab[:, :, 0] >= 0]
+        assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+
+
+# ---------------------------------------------------------------------------
+# contrib
+# ---------------------------------------------------------------------------
+
+
+def test_contrib_text_vocab_embedding(tmp_path):
+    counter = mx.contrib.text.count_tokens_from_str("a b b c c c")
+    vocab = mx.contrib.text.Vocabulary(counter, min_freq=1)
+    assert vocab.to_indices("c") < vocab.to_indices("a")  # freq-sorted
+    assert vocab.to_tokens(vocab.to_indices("b")) == "b"
+    emb_file = tmp_path / "emb.txt"
+    emb_file.write_text("a 1.0 2.0\nb 3.0 4.0\n")
+    emb = mx.contrib.text.CustomEmbedding(str(emb_file), vocabulary=vocab)
+    assert emb.vec_len == 2
+    va = emb.get_vecs_by_tokens("a").asnumpy()
+    np.testing.assert_allclose(va, [1.0, 2.0])
+    assert emb.idx_to_vec.shape == (len(vocab), 2)
+
+
+def test_contrib_quantization_roundtrip():
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=2, name="fc2"), name="softmax")
+    args = {"fc1_weight": mx.nd.array(rng.normal(0, 1, (8, 4)).astype(np.float32)),
+            "fc1_bias": mx.nd.array(np.zeros(8, np.float32)),
+            "fc2_weight": mx.nd.array(rng.normal(0, 1, (2, 8)).astype(np.float32)),
+            "fc2_bias": mx.nd.array(np.zeros(2, np.float32))}
+    qsym, qargs, _, th = mx.contrib.quantization.quantize_model(
+        net, args, {}, calib_mode="none")
+    for name in ("fc1_weight", "fc2_weight"):
+        orig = args[name].asnumpy()
+        quant = qargs[name].asnumpy()
+        assert np.abs(orig - quant).max() <= np.abs(orig).max() / 127 + 1e-6
+    # with naive calibration
+    X = rng.normal(0, 1, (16, 4)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, None, batch_size=8)
+    _, _, _, th = mx.contrib.quantization.quantize_model(
+        net, args, {}, calib_mode="naive", calib_data=it)
+    assert any("fc1" in k for k in th)
+
+
+def test_contrib_kl_threshold():
+    hist = np.ones(512)
+    edges = np.linspace(0, 1.0, 513)
+    t = mx.contrib.quantization.calib_threshold_kl(hist, edges[1:],
+                                                   num_quantized_bins=255)
+    assert 0.4 <= t <= 1.0  # uniform dist: threshold near the top
+
+
+def test_contrib_dataloader_iter():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.arange(40, dtype=np.float32).reshape(20, 2)
+    y = np.arange(20, dtype=np.float32)
+    loader = DataLoader(ArrayDataset(mx.nd.array(X), mx.nd.array(y)),
+                        batch_size=5)
+    it = mx.contrib.io.DataLoaderIter(loader)
+    n = sum(1 for _ in it)
+    assert n == 4
+    it.reset()
+    assert sum(1 for _ in it) == 4
+
+
+def test_contrib_autograd_old_api():
+    x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+
+    def f(x):
+        return (x * x).sum()
+
+    grads, loss = mx.contrib.autograd.grad_and_loss(f)(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), [2.0, 4.0])
+
+
+# ---------------------------------------------------------------------------
+# rtc (Pallas module)
+# ---------------------------------------------------------------------------
+
+
+def test_rtc_pallas_kernel():
+    import jax
+
+    def double_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    mod = mx.rtc.PallasModule()
+    k = mod.add_kernel(
+        "double", double_kernel,
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype))
+    x = mx.nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    out = k.launch([x])
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy() * 2)
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaModule("__global__ void k() {}")
+
+
+# ---------------------------------------------------------------------------
+# FeedForward
+# ---------------------------------------------------------------------------
+
+
+def test_feedforward_fit_predict_save_load(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (80, 6)).astype(np.float32)
+    y = (X.sum(1) > 0).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=2), name="softmax")
+    model = mx.FeedForward(net, ctx=mx.cpu(), num_epoch=15,
+                           optimizer="sgd", learning_rate=0.3, momentum=0.9,
+                           numpy_batch_size=16)
+    model.fit(X, y)
+    preds = model.predict(X)
+    acc = (preds.argmax(1) == y).mean()
+    assert acc > 0.9
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 1)
+    model2 = mx.FeedForward.load(prefix, 1, ctx=mx.cpu())
+    preds2 = model2.predict(X)
+    np.testing.assert_allclose(preds, preds2, atol=1e-5)

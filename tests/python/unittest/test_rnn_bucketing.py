@@ -105,6 +105,21 @@ def test_fused_weights_pack_unpack_roundtrip():
     np.testing.assert_allclose(fused_out, unfused_out, atol=1e-4)
 
 
+def test_bucket_iter_shuffle_preserves_rows():
+    """reset() must permute, never corrupt, the stored sentences."""
+    sents = [[i + 1, i + 2, i + 3] for i in range(24)]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[3],
+                                   invalid_label=0, shuffle_seed=0)
+    orig = {tuple(s) for s in sents}
+    for _ in range(5):
+        it.reset()
+        seen = set()
+        for b in it:
+            for row in b.data[0].asnumpy().astype(int):
+                seen.add(tuple(row))
+        assert seen == orig  # every epoch: same 24 unique rows
+
+
 def test_bucket_iter_with_unused_bucket():
     """A user-supplied bucket with no sentences must not crash (empty 2-D)."""
     it = mx.rnn.BucketSentenceIter([[1, 2, 3], [1, 2, 3]], batch_size=1,
