@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Inference throughput benchmark (reference:
+example/image-classification/benchmark_score.py — the imgs/sec score table
+from docs/faq/perf.md:115-144).
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import resnet
+
+
+def get_symbol(network, num_layers, image_shape):
+    if network == "resnet":
+        return resnet.get_symbol(num_classes=1000, num_layers=num_layers,
+                                 image_shape=image_shape)
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model(network)
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def score(network, num_layers, dev, batch_size, image_shape="3,224,224",
+          num_batches=20):
+    shape = (batch_size,) + tuple(int(x) for x in image_shape.split(","))
+    rng = np.random.RandomState(0)
+    data = rng.uniform(-1, 1, shape).astype(np.float32)
+    sym = get_symbol(network, num_layers, image_shape)
+    if isinstance(sym, mx.Symbol):
+        exe = sym.simple_bind(dev, grad_req="null", data=shape,
+                              softmax_label=(batch_size,))
+        for name, arr in exe.arg_dict.items():
+            if name != "data" and name != "softmax_label":
+                arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
+        exe.arg_dict["data"][:] = data
+
+        def run():
+            exe.forward(is_train=False)
+            return exe.outputs[0]
+    else:
+        x = mx.nd.array(data)
+
+        def run():
+            return sym(x)
+    for _ in range(3):
+        run().wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        out = run()
+    out.wait_to_read()
+    return batch_size * num_batches / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--network", type=str, default="resnet")
+    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--batch-sizes", type=str, default="1,32")
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    args = parser.parse_args()
+    dev = mx.tpu() if mx.num_tpus() else mx.cpu()
+    for b in (int(x) for x in args.batch_sizes.split(",")):
+        speed = score(args.network, args.num_layers, dev, b,
+                      args.image_shape)
+        print("network: %s-%d, batch %3d: %.1f img/sec"
+              % (args.network, args.num_layers, b, speed))

@@ -1,0 +1,86 @@
+"""Data providers for image-classification examples (reference:
+example/image-classification/common/data.py — ImageRecordIter pair with
+kv-based sharding; synthetic fallback mirrors the reference's
+--benchmark 1 dummy-data mode for zero-egress environments)."""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataIter, DataBatch, DataDesc
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data")
+    data.add_argument("--data-train", type=str, help="training record file")
+    data.add_argument("--data-val", type=str, help="validation record file")
+    data.add_argument("--image-shape", type=str, default="3,224,224")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    data.add_argument("--rgb-std", type=str, default="1,1,1")
+    data.add_argument("--num-classes", type=int, default=1000)
+    data.add_argument("--num-examples", type=int, default=1281167)
+    data.add_argument("--data-nthreads", type=int, default=4)
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="1 = synthetic data (reference --benchmark mode)")
+    return data
+
+
+class SyntheticDataIter(DataIter):
+    """Dummy-data mode (reference: common/data.py SyntheticDataIter)."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype=np.float32):
+        super().__init__(data_shape[0])
+        self.cur_iter = 0
+        self.max_iter = max_iter
+        rng = np.random.RandomState(0)
+        self.data = mx.nd.array(
+            rng.uniform(-1, 1, data_shape).astype(dtype))
+        self.label = mx.nd.array(
+            rng.randint(0, num_classes, (data_shape[0],)).astype(dtype))
+        self.provide_data = [DataDesc("data", data_shape)]
+        self.provide_label = [DataDesc("softmax_label", (data_shape[0],))]
+
+    def reset(self):
+        self.cur_iter = 0
+
+    def next(self):
+        if self.cur_iter >= self.max_iter:
+            raise StopIteration
+        self.cur_iter += 1
+        return DataBatch(data=[self.data], label=[self.label], pad=0,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+def get_rec_iter(args, kv=None):
+    """reference: common/data.py get_rec_iter — ImageRecordIter pair sharded
+    by kv rank (num_parts=kv.num_workers, part_index=kv.rank)."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    if args.benchmark or not args.data_train:
+        batch = args.batch_size
+        data_shape = (batch,) + image_shape
+        train = SyntheticDataIter(args.num_classes, data_shape,
+                                  max_iter=max(1, args.num_examples
+                                               // max(batch, 1)))
+        return train, None
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    mean = [float(x) for x in args.rgb_mean.split(",")]
+    std = [float(x) for x in args.rgb_std.split(",")]
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=image_shape,
+        batch_size=args.batch_size, shuffle=True,
+        preprocess_threads=args.data_nthreads, rand_crop=True,
+        rand_mirror=True, mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        std_r=std[0], std_g=std[1], std_b=std[2],
+        num_parts=nworker, part_index=rank)
+    val = None
+    if args.data_val:
+        val = mx.io.ImageRecordIter(
+            path_imgrec=args.data_val, data_shape=image_shape,
+            batch_size=args.batch_size, shuffle=False,
+            preprocess_threads=args.data_nthreads,
+            mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+            std_r=std[0], std_g=std[1], std_b=std[2],
+            num_parts=nworker, part_index=rank)
+    return train, val

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Train ResNet on ImageNet records (reference:
+example/image-classification/train_imagenet.py:55-58 — the north-star
+data-parallel config with kv-store=tpu_sync).
+
+Usage (synthetic smoke): python train_imagenet.py --benchmark 1 --num-epochs 1
+Real data: python train_imagenet.py --data-train train.rec --kv-store tpu_sync
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import resnet
+from common import fit, data
+
+
+def main():
+    import logging
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(message)s")
+    parser = argparse.ArgumentParser(
+        description="train imagenet",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(network="resnet", num_layers=50,
+                        batch_size=32, num_epochs=1, lr=0.1, lr_factor=0.1,
+                        lr_step_epochs="30,60,80", wd=1e-4, mom=0.9)
+    args = parser.parse_args()
+
+    sym = resnet.get_symbol(num_classes=args.num_classes,
+                            num_layers=args.num_layers,
+                            image_shape=args.image_shape)
+    fit.fit(args, sym, data.get_rec_iter)
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,8 @@ class LogMetricsCallback(object):
         for name, value in param.eval_metric.get_name_value():
             if self.prefix is not None:
                 name = "%s-%s" % (self.prefix, name)
-            self.scalars.append((self._step, name, value))
             if self._writer is not None:
                 self._writer.add_scalar(name, value, self._step)
+            else:
+                # in-memory fallback only when no writer (bounded by caller)
+                self.scalars.append((self._step, name, value))

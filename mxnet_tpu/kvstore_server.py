@@ -1,0 +1,42 @@
+"""KVStore server bootstrap (reference: python/mxnet/kvstore_server.py:28-85
+— the process entry for DMLC_ROLE=server/scheduler nodes running the
+ps-lite parameter server).
+
+TPU-native: there are no server/scheduler roles — gradients reduce in-graph
+via XLA collectives (SURVEY.md §5.8) and the optimizer runs inside the
+jitted step ("update_on_kvstore" semantics without a server process). This
+module keeps the entry points so reference launch scripts run unchanged:
+server/scheduler roles exit immediately with an explanatory log.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer(object):
+    """API-parity shim for the reference server controller."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def run(self):
+        logging.info(
+            "kvstore server role is subsumed by XLA collectives on TPU; "
+            "nothing to serve — exiting (workers reduce over ICI/DCN)")
+
+
+def _init_kvstore_server_module():
+    """reference: kvstore_server.py module hook reading DMLC_ROLE."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        logging.info("DMLC_ROLE=%s has no TPU analog (XLA collectives "
+                     "replace the parameter server); exiting cleanly", role)
+        raise SystemExit(0)
+
+
+if os.environ.get("MXNET_TPU_AUTO_SERVER_EXIT", "0") == "1":
+    _init_kvstore_server_module()

@@ -164,6 +164,9 @@ class FeedForward(object):
                             shuffle=True)
         label_names = [d.name for d in (X.provide_label or [])] or \
             ["softmax_label"]
+        # fresh executors per fit (reference FeedForward rebuilds per call) —
+        # a module previously bound for inference cannot run backward
+        self._module = None
         mod = self._get_module(label_names)
         if logger is not None:
             mod.logger = logger

@@ -129,8 +129,13 @@ def _get_prop(params):
 def _custom_inputs(p):
     if p is None:
         return ("data",)
-    prop = _get_prop(p)
-    return tuple(prop.list_arguments()) + tuple(prop.list_auxiliary_states())
+    return tuple(_get_prop(p).list_arguments())
+
+
+def _custom_aux(p):
+    if p is None:
+        return ()
+    return tuple(_get_prop(p).list_auxiliary_states())
 
 
 def _custom_n_outputs(p):
@@ -140,11 +145,13 @@ def _custom_n_outputs(p):
 
 
 @register_op("Custom", param_cls=CustomParam, input_names=_custom_inputs,
-             num_outputs=_custom_n_outputs, need_train=True)
+             aux_names=_custom_aux, num_outputs=_custom_n_outputs,
+             need_train=True)
 def _custom(params, *inputs, is_train=False):
     prop = _get_prop(params)
     n_args = len(prop.list_arguments())
     n_out = len(prop.list_outputs())
+    n_aux = len(prop.list_auxiliary_states())
     args, aux = inputs[:n_args], inputs[n_args:]
     in_shapes = [tuple(a.shape) for a in args]
     in_dtypes = [a.dtype for a in args]
@@ -153,36 +160,45 @@ def _custom(params, *inputs, is_train=False):
     out_dtypes = [_np.dtype(d) for d in out_dtypes]
     result_shapes = [jax.ShapeDtypeStruct(tuple(s), d)
                      for s, d in zip(out_shapes, out_dtypes)]
+    aux_shapes = [jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) for a in aux]
+    # ONE operator instance shared by forward and backward (the reference
+    # keeps one CustomOp per graph node — ops may stash state on self in
+    # forward for use in backward)
+    op = prop.create_operator(None, in_shapes, in_dtypes)
 
     def host_forward(train_flag, *host_inputs):
-        op = prop.create_operator(None, in_shapes, in_dtypes)
         h_args = [_wrap(a) for a in host_inputs[:n_args]]
-        h_aux = [_wrap(a) for a in host_inputs[n_args:]]
+        # aux arrays are mutable on host; updates flow back as extra outputs
+        h_aux = [_np.array(a).view(_SimpleArray)
+                 for a in host_inputs[n_args:]]
         outs = [_np.zeros(s.shape, s.dtype) for s in result_shapes]
         op.forward(bool(train_flag), ["write"] * n_out, h_args, outs, h_aux)
-        return tuple(_np.asarray(o) for o in outs)
+        return tuple(_np.asarray(o) for o in outs) + \
+            tuple(_np.asarray(a) for a in h_aux)
 
     @jax.custom_vjp
     def run(args, aux):
-        outs = jax.pure_callback(functools.partial(host_forward, is_train),
-                                 tuple(result_shapes), *args, *aux)
-        return tuple(outs)
+        res = jax.pure_callback(functools.partial(host_forward, is_train),
+                                tuple(result_shapes) + tuple(aux_shapes),
+                                *args, *aux)
+        return tuple(res)
 
     def run_fwd(args, aux):
-        outs = run(args, aux)
-        return outs, (args, aux, outs)
+        res = run(args, aux)
+        return res, (args, aux, res[:n_out])
 
     def run_bwd(res, out_grads):
         args_v, aux_v, outs = res
+        out_grads = out_grads[:n_out]  # aux-update outputs carry no grads
 
         def host_backward(*host_vals):
             n = len(args_v)
             h_args = [_wrap(v) for v in host_vals[:n]]
-            h_aux = [_wrap(v) for v in host_vals[n:n + len(aux_v)]]
+            h_aux = [_np.array(v).view(_SimpleArray)
+                     for v in host_vals[n:n + len(aux_v)]]
             h_outs = [_wrap(v) for v in
                       host_vals[n + len(aux_v):n + len(aux_v) + n_out]]
             h_ograds = [_wrap(v) for v in host_vals[n + len(aux_v) + n_out:]]
-            op = prop.create_operator(None, in_shapes, in_dtypes)
             igrads = [_np.zeros(a.shape, a.dtype) for a in h_args]
             op.backward(["write"] * n, h_ograds, h_args, h_outs, igrads,
                         h_aux)
@@ -195,4 +211,6 @@ def _custom(params, *inputs, is_train=False):
         return tuple(grads), tuple(jnp.zeros_like(a) for a in aux_v)
 
     run.defvjp(run_fwd, run_bwd)
-    return run(tuple(args), tuple(aux))
+    out = run(tuple(args), tuple(aux))
+    del n_aux
+    return out

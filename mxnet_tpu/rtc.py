@@ -100,7 +100,11 @@ def register_pallas_op(name, kernel_fn, out_shape_fn, interpret=None,
 
     def nd_fn(*arrays):
         from .ndarray.ndarray import NDArray
-        vals = [a._data for a in arrays]
-        return NDArray(op_fn(None, *vals))
+        vals = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in arrays]
+        out = op_fn(None, *vals)
+        if isinstance(out, (list, tuple)):
+            return [NDArray(o) for o in out]
+        return NDArray(out)
 
     return nd_fn

@@ -1,0 +1,73 @@
+"""Tools + example-path tests: bandwidth harness, data providers, launcher
+command construction (reference: tools/bandwidth, tools/launch.py,
+example/image-classification/common/data.py).
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+
+_REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", "..",
+                                      ".."))
+sys.path.insert(0, os.path.join(_REPO, "tools", "bandwidth"))
+sys.path.insert(0, os.path.join(_REPO, "example", "image-classification"))
+
+
+def test_bandwidth_measure_runs_on_mesh():
+    from measure import measure
+    res = measure(total_mb=4.0, num_arrays=4, iters=2,
+                  devices=jax.devices()[:4])
+    assert res["devices"] == 4
+    assert res["gb_per_sec_per_device"] > 0
+    assert abs(res["payload_mb"] - 4.0) < 0.5
+
+
+def test_synthetic_data_iter():
+    from common.data import SyntheticDataIter
+    it = SyntheticDataIter(10, (8, 3, 16, 16), max_iter=3)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (8, 3, 16, 16)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_get_rec_iter_benchmark_mode():
+    from common.data import get_rec_iter
+    args = argparse.Namespace(
+        benchmark=1, data_train=None, data_val=None, batch_size=4,
+        image_shape="3,8,8", num_classes=10, num_examples=8,
+        rgb_mean="0,0,0", rgb_std="1,1,1", data_nthreads=1)
+    train, val = get_rec_iter(args, None)
+    b = next(iter(train))
+    assert b.data[0].shape == (4, 3, 8, 8)
+    assert val is None
+
+
+def test_launch_local_spawns_workers(tmp_path):
+    """local launcher must run N processes with rank envs set."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "print('RANK', os.environ['JAX_PROCESS_ID'],\n"
+        "      os.environ['JAX_NUM_PROCESSES'])\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    lines = sorted(l for l in out.stdout.splitlines() if l.startswith("RANK"))
+    assert lines == ["RANK 0 2", "RANK 1 2"]
+
+
+def test_kvstore_server_shim():
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    KVStoreServer(mx.kvstore.create("local")).run()  # logs + returns

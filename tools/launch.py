@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Multi-host job launcher (reference: tools/launch.py:71-73 — dmlc-tracker
+local/ssh/mpi/sge/yarn launchers spawning scheduler + servers + workers).
+
+TPU-native: there is no parameter-server topology — every host runs the SAME
+SPMD program and JAX's coordination service replaces the dmlc scheduler.
+Supported launchers:
+- `local`: spawn N worker processes on this machine wired together via
+  `jax.distributed` env (JAX_COORDINATOR_ADDRESS/PROCESS_ID/NUM_PROCESSES).
+  CPU-only multi-process on one host is for testing the multi-host code path.
+- `ssh`: print (or run) the per-host command list for a host file; on real
+  TPU pods the platform runtime (e.g. GKE/QR) usually injects these envs.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def launch_local(n, command, coordinator="127.0.0.1:12345"):
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update({
+            "JAX_COORDINATOR_ADDRESS": coordinator,
+            "JAX_NUM_PROCESSES": str(n),
+            "JAX_PROCESS_ID": str(rank),
+            # DMLC-compat aliases (reference env protocol, kvstore.h:254)
+            "DMLC_NUM_WORKER": str(n),
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_ROLE": "worker",
+        })
+        procs.append(subprocess.Popen(command, env=env, shell=False))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def launch_ssh(hostfile, command, coordinator_port=12345, dry_run=True):
+    with open(hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    n = len(hosts)
+    coordinator = "%s:%d" % (hosts[0], coordinator_port)
+    cmds = []
+    for rank, host in enumerate(hosts):
+        envs = ("JAX_COORDINATOR_ADDRESS=%s JAX_NUM_PROCESSES=%d "
+                "JAX_PROCESS_ID=%d" % (coordinator, n, rank))
+        cmds.append(["ssh", host, "%s %s" % (envs, " ".join(command))])
+    if dry_run:
+        for c in cmds:
+            print(" ".join(c))
+        return 0
+    procs = [subprocess.Popen(c) for c in cmds]
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("--launcher", choices=["local", "ssh"],
+                        default="local")
+    parser.add_argument("-H", "--hostfile", type=str, default=None)
+    parser.add_argument("--coordinator-port", type=int, default=12345)
+    parser.add_argument("--run-ssh", action="store_true",
+                        help="actually exec over ssh instead of printing")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    command = [c for c in args.command if c != "--"]
+    if not command:
+        parser.error("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args.num_workers, command,
+                              "127.0.0.1:%d" % args.coordinator_port))
+    if not args.hostfile:
+        parser.error("ssh launcher needs --hostfile")
+    sys.exit(launch_ssh(args.hostfile, command, args.coordinator_port,
+                        dry_run=not args.run_ssh))
+
+
+if __name__ == "__main__":
+    main()
