@@ -2,56 +2,155 @@
 """Benchmark harness — prints ONE JSON line for the driver.
 
 Headline metric mirrors the reference's `benchmark_score.py` (docs/faq/perf.md):
-ResNet-50 inference images/sec at batch 32. vs_baseline compares against the
-reference's best published single-GPU number (P100, 713.17 img/s,
-docs/faq/perf.md:137-144). Runs on whatever accelerator JAX exposes (one TPU
-chip under the driver).
+ResNet-50 inference images/sec at batch 32, vs the reference's best published
+single-GPU number (P100, 713.17 img/s, docs/faq/perf.md:137-144). The `extra`
+field carries a fused train-step throughput (analog of `train_imagenet.py`
+numbers, docs/faq/perf.md:154-185) plus the platform the run landed on.
+
+Robustness: the parent process never imports jax. It re-execs itself as a
+child (`--run`) so a flaky TPU backend init can be retried in a genuinely
+fresh process (jax caches backend-init failure in-process); after two TPU
+attempts it falls back to a forced-CPU child; and it ALWAYS emits one
+parseable JSON line, with `platform` and `error` populated on failure.
 """
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+BASELINE_INFER_P100 = 713.17   # ResNet-50 score b32, docs/faq/perf.md:137-144
+BASELINE_TRAIN_P100 = 181.53   # ResNet-50 train b32, docs/faq/perf.md:178-185
+CHILD_TIMEOUT_S = 2400
+
+
+def _emit(value, vs_baseline, extra):
+    print(json.dumps({
+        "metric": "resnet50_inference_batch32_img_per_sec",
+        "value": value,
+        "unit": "images/sec",
+        "vs_baseline": vs_baseline,
+        "extra": extra,
+    }), flush=True)
+
+
+def _run_child(force_cpu):
+    env = dict(os.environ)
+    env["_BENCH_CHILD"] = "1"
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--run"],
+            env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    except subprocess.TimeoutExpired:
+        return None, "timeout after %ds" % CHILD_TIMEOUT_S
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                continue
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+    return None, "rc=%d: %s" % (proc.returncode, " | ".join(tail))
 
 
 def main():
-    import mxnet_tpu as mx
-    from mxnet_tpu.models import resnet
+    errors = []
+    for attempt, force_cpu in ((1, False), (2, False), (3, True)):
+        result, err = _run_child(force_cpu)
+        if result is not None:
+            _emit(result["value"], result["vs_baseline"], result["extra"])
+            return
+        errors.append("attempt%d(%s): %s"
+                      % (attempt, "cpu" if force_cpu else "default", err))
+        time.sleep(5)
+    _emit(0.0, 0.0, {"platform": "none", "error": "; ".join(errors)[-2000:]})
 
-    batch = 32
+
+def _bench_infer(np, mx, resnet, batch, n_iter):
+    """Reference benchmark_score.py analog: jitted forward, random params."""
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
                             image_shape="3,224,224")
     ctx = mx.tpu(0)
     exe = sym.simple_bind(ctx, grad_req="null", data=(batch, 3, 224, 224),
                           softmax_label=(batch,))
-    # random-init params (score benchmark measures compute, not accuracy)
     rng = np.random.RandomState(0)
     for name, arr in exe.arg_dict.items():
         if name not in ("data", "softmax_label"):
             arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
-    data = rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32)
-    exe.arg_dict["data"][:] = data
-
-    # warmup (compile)
-    for _ in range(3):
+    exe.arg_dict["data"][:] = rng.uniform(
+        -1, 1, (batch, 3, 224, 224)).astype(np.float32)
+    for _ in range(3):  # warmup: compile + steady-state
         exe.forward(is_train=False)
     exe.outputs[0].wait_to_read()
-
-    n_iter = 30
     tic = time.time()
     for _ in range(n_iter):
         exe.forward(is_train=False)
     exe.outputs[0].wait_to_read()
-    elapsed = time.time() - tic
-    img_per_sec = batch * n_iter / elapsed
+    return batch * n_iter / (time.time() - tic)
 
-    baseline_p100 = 713.17
+
+def _bench_train(np, jax, resnet, batch, n_iter):
+    """Fused train step (fwd+bwd+SGD in ONE jitted program, donated buffers)
+    on a 1-device mesh — the `train_imagenet.py --kv-store tpu_sync` path."""
+    from mxnet_tpu.parallel.mesh import data_parallel_mesh
+    from mxnet_tpu.parallel.tpu_step import DataParallelTrainStep
+    mesh = data_parallel_mesh(jax.devices()[:1])
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape="3,224,224")
+    step = DataParallelTrainStep(sym, mesh, lr=0.05, momentum=0.9,
+                                 data_names=("data",),
+                                 label_names=("softmax_label",))
+    step.init({"data": (batch, 3, 224, 224), "softmax_label": (batch,)})
+    rng = np.random.RandomState(0)
+    b = {"data": rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32),
+         "softmax_label": rng.randint(0, 1000, (batch,)).astype(np.float32)}
+    # stage the batch on device once — the reference score benchmark also
+    # measures compute, not host->device copies
+    b = {k: jax.device_put(v, step._batch_shard) for k, v in b.items()}
+    key = jax.random.PRNGKey(0)
+    for _ in range(2):  # warmup
+        out = step(b, rng=key)
+    jax.block_until_ready(out)
+    tic = time.time()
+    for _ in range(n_iter):
+        out = step(b, rng=key)
+    jax.block_until_ready(out)
+    return batch * n_iter / (time.time() - tic)
+
+
+def _run():
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+
+    platform = jax.devices()[0].platform
+    batch = 32
+    n_iter = 30 if platform != "cpu" else 3
+
+    extra = {"platform": platform}
+    img_per_sec = _bench_infer(np, mx, resnet, batch, n_iter)
+    try:
+        train_ips = _bench_train(np, jax, resnet, batch,
+                                 max(n_iter // 2, 2))
+        extra["train_img_per_sec"] = round(train_ips, 2)
+        extra["train_vs_baseline"] = round(train_ips / BASELINE_TRAIN_P100, 3)
+    except Exception as e:  # train metric is additive; never kill headline
+        extra["train_error"] = "%s: %s" % (type(e).__name__, str(e)[:300])
+
     print(json.dumps({
-        "metric": "resnet50_inference_batch32_img_per_sec",
         "value": round(img_per_sec, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(img_per_sec / baseline_p100, 3),
-    }))
+        "vs_baseline": round(img_per_sec / BASELINE_INFER_P100, 3),
+        "extra": extra,
+    }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--run" in sys.argv or os.environ.get("_BENCH_CHILD") == "1":
+        _run()
+    else:
+        main()

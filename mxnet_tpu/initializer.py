@@ -244,9 +244,8 @@ class LSTMBias(Initializer):
         self.forget_bias = forget_bias
 
     def _init_weight(self, name, arr):
-        arr[:] = 0.0
         num_hidden = arr.shape[0] // 4
-        a = arr.asnumpy()
+        a = _np.zeros(arr.shape, dtype=_np.float32)
         a[num_hidden:2 * num_hidden] = self.forget_bias  # [i, f, g, o] packing
         arr[:] = a
 

@@ -64,6 +64,14 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._group2ctxs = group2ctxs
+        # fused tpu_sync train path (parallel/tpu_step.py): one XLA program
+        # per iteration instead of per-param push/pull (model.py:59-88)
+        self._fused_step = None
+        self._fused_outputs = None
+        self._fused_active = False
+        self._fused_dirty = False   # fused params newer than exec_group's
+        self._monitor = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -170,6 +178,11 @@ class Module(BaseModule):
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params,
                                     allow_extra=allow_extra)
+        if self._fused_step is not None:
+            # externally-set values become the fused step's device copies
+            # (optimizer state and compiled program are preserved)
+            self._fused_step.reload_params(self._arg_params, self._aux_params)
+            self._fused_dirty = False
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
@@ -183,6 +196,13 @@ class Module(BaseModule):
                             "set_params call ignored.")
             return
         self._exec_group.set_params(arg_params, aux_params, allow_extra=allow_extra)
+        if self._fused_step is not None:
+            merged_args = dict(self._arg_params or {})
+            merged_args.update(arg_params or {})
+            merged_aux = dict(self._aux_params or {})
+            merged_aux.update(aux_params or {})
+            self._fused_step.reload_params(merged_args, merged_aux)
+            self._fused_dirty = False
         self._params_dirty = True
         self.params_initialized = True
 
@@ -249,6 +269,8 @@ class Module(BaseModule):
         if self._params_dirty:
             self._sync_params_from_devices()
 
+        kvstore_type = (kvstore if isinstance(kvstore, str)
+                        else getattr(kvstore, "type", "") or "")
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
         batch_size = self._exec_group.batch_size
@@ -284,6 +306,8 @@ class Module(BaseModule):
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
 
+        self._try_build_fused_step(kvstore_type)
+
         if kvstore:
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
@@ -304,8 +328,117 @@ class Module(BaseModule):
             self._preload_opt_states = None
 
     # ------------------------------------------------------------------
+    # fused tpu_sync path: ONE jitted XLA program per iteration doing
+    # forward + backward + gradient psum over 'dp' + optimizer update with
+    # donated buffers — replacing the reference's per-param
+    # push/pull/update loop (reference model.py:126-136, SURVEY §3.1)
+    # ------------------------------------------------------------------
+    def _try_build_fused_step(self, kvstore_type):
+        self._fused_step = None
+        if not ("tpu" in kvstore_type
+                or (kvstore_type == "device" and len(self._context) > 1)):
+            return
+        if not self.for_training or self._grad_req != "write":
+            return
+        if self.inputs_need_grad or self._state_names or self._monitor:
+            return
+        if self._label_shapes is None:
+            return
+        from .. import optimizer as _opt
+        opt = self._optimizer
+        if type(opt) is _opt.SGD:
+            fused_name, hp = "sgd", {"momentum": opt.momentum}
+        elif type(opt) is _opt.Adam:
+            fused_name, hp = "adam", {"beta1": opt.beta1, "beta2": opt.beta2,
+                                      "eps": opt.epsilon}
+        else:
+            self.logger.info("kvstore=%s: optimizer %s has no fused kernel; "
+                             "using the per-param update path",
+                             kvstore_type, type(opt).__name__)
+            return
+        # row_sparse params need the kvstore row_sparse path
+        attrs = self._symbol.attr_dict()
+        if any(attrs.get(n, {}).get("__storage_type__") == "row_sparse"
+               for n in self._param_names):
+            return
+        batch_size = self._data_shapes[0].shape[0]
+        if batch_size % len(self._context) != 0:
+            self.logger.warning(
+                "kvstore=%s: batch %d not divisible by %d devices; "
+                "fused step disabled", kvstore_type, batch_size,
+                len(self._context))
+            return
+        from ..parallel.mesh import data_parallel_mesh
+        from ..parallel.tpu_step import DataParallelTrainStep
+        try:
+            devices = [c.jax_device for c in self._context]
+        except MXNetError:
+            return
+        mesh = data_parallel_mesh(devices)
+        batch_shapes = {d.name: d.shape for d in self._data_shapes}
+        batch_shapes.update({l.name: l.shape for l in self._label_shapes})
+        step = DataParallelTrainStep(
+            self._symbol, mesh, lr=opt.lr, wd=opt.wd,
+            data_names=self._data_names, label_names=self._label_names,
+            rescale_grad=opt.rescale_grad, optimizer=fused_name, opt_hp=hp,
+            fixed_param_names=self._fixed_param_names,
+            clip_gradient=opt.clip_gradient)
+        step.init_from(self._arg_params, self._aux_params, batch_shapes)
+        self._fused_step = step
+        self._fused_dirty = False
+        self.logger.info("kvstore=%s: fused train step active "
+                         "(fwd+bwd+allreduce+%s in one XLA program over %d "
+                         "device(s))", kvstore_type, fused_name, len(devices))
+
+    def _fused_lr(self):
+        """Per-step learning rate honoring the optimizer's lr scheduler
+        (num_update counts fused global steps)."""
+        opt = self._optimizer
+        opt.num_update += 1
+        if opt.lr_scheduler is not None:
+            return opt.lr_scheduler(opt.num_update)
+        return opt.lr
+
+    def _fused_forward(self, data_batch):
+        import numpy as _np2
+        fused = self._fused_step
+        batch = {}
+        for desc, arr in zip(self._data_shapes, data_batch.data):
+            batch[desc.name] = arr.asnumpy() if hasattr(arr, "asnumpy") \
+                else _np2.asarray(arr)
+        for desc, arr in zip(self._label_shapes or [], data_batch.label or []):
+            batch[desc.name] = arr.asnumpy() if hasattr(arr, "asnumpy") \
+                else _np2.asarray(arr)
+        batch = {k: v for k, v in batch.items() if k in fused.arg_names}
+        outs = fused(batch, lr=self._fused_lr())
+        from ..ndarray.ndarray import _new_from_jax
+        self._fused_outputs = [_new_from_jax(o) for o in outs]
+        self._fused_active = True
+        self._fused_dirty = True
+        self._params_dirty = True
+
+    def _sync_fused_to_execs(self):
+        """Push fused-step params into exec_group (before eval/predict)."""
+        if self._fused_step is None or not self._fused_dirty:
+            return
+        arg_np, aux_np = self._fused_step.export_params()
+        for name, v in arg_np.items():
+            self._arg_params[name][:] = v
+        for name, v in aux_np.items():
+            self._aux_params[name][:] = v
+        self._exec_group.set_params(self._arg_params, self._aux_params)
+        self._fused_dirty = False
+
+    # ------------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if (self._fused_step is not None and self._monitor is None
+                and (is_train is None or is_train)
+                and getattr(data_batch, "label", None)):
+            self._fused_forward(data_batch)
+            return
+        self._fused_active = False
+        self._sync_fused_to_execs()
         curr_data_shapes = tuple(i.shape for i in self._data_shapes)
         if isinstance(data_batch, list):
             new_data_shapes = tuple(b.data[0].shape for b in data_batch)
@@ -330,11 +463,19 @@ class Module(BaseModule):
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        if self._fused_active:
+            return  # gradient already consumed inside the fused program
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
-        """reference: module.py update — kvstore push/pull or local updater."""
+        """reference: module.py update — kvstore push/pull or local updater.
+
+        Under the fused tpu_sync path the optimizer already ran inside the
+        jitted step (forward), so this is a no-op."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
+        if self._fused_active:
+            self._params_dirty = True
+            return
         self._params_dirty = True
         grad_arrays = self._sparsify_grads(self._exec_group.grad_arrays)
         if self._update_on_kvstore:
@@ -374,6 +515,8 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused_active:
+            return list(self._fused_outputs)
         return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -381,9 +524,22 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        if self._fused_active:
+            eval_metric.update(labels, self._fused_outputs)
+            return
         self._exec_group.update_metric(eval_metric, labels)
 
     def _sync_params_from_devices(self):
+        if self._fused_step is not None and self._fused_dirty:
+            arg_np, aux_np = self._fused_step.export_params()
+            for name, v in arg_np.items():
+                self._arg_params[name][:] = v
+            for name, v in aux_np.items():
+                self._aux_params[name][:] = v
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+            self._fused_dirty = False
+            self._params_dirty = False
+            return
         self._exec_group.get_params(self._arg_params, self._aux_params)
         if self._kvstore and self._update_on_kvstore:
             # weights live on the kvstore; pull the authoritative copies
@@ -397,6 +553,14 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        if self._fused_step is not None:
+            import pickle
+            import numpy as _np2
+            state_np = jax_tree_to_numpy(self._fused_step.opt_state)
+            with open(fname, "wb") as fout:
+                pickle.dump({"fused": self._fused_step.optimizer,
+                             "state": state_np}, fout)
+            return
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -405,6 +569,20 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        if self._fused_step is not None:
+            import pickle
+            with open(fname, "rb") as f:
+                blob = pickle.load(f)
+            if isinstance(blob, dict) and "fused" in blob:
+                import jax
+                from jax.tree_util import tree_map
+                self._fused_step.opt_state = tree_map(
+                    lambda ref, v: jax.device_put(
+                        v, self._fused_step._repl),
+                    self._fused_step.opt_state, blob["state"])
+                return
+            raise MXNetError("optimizer states file %s is not a fused-step "
+                             "checkpoint" % fname)
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
@@ -413,6 +591,10 @@ class Module(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        self._monitor = mon  # interior capture needs executors; disables fused
+        if self._fused_step is not None:
+            self._sync_fused_to_execs()
+            self._fused_step = None
         for exec_ in self._exec_group.execs:
             mon.install(exec_)
 
@@ -427,6 +609,11 @@ class Module(BaseModule):
                     self._kvstore.row_sparse_pull(
                         name, out=self._exec_group.param_arrays[idx],
                         row_ids=rid)
+
+
+def jax_tree_to_numpy(tree):
+    import jax
+    return jax.tree_util.tree_map(lambda v: _np.asarray(v), tree)
 
 
 def _parse_data_desc(data_names, label_names, data_shapes, label_shapes):

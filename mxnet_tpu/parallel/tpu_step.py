@@ -1,10 +1,12 @@
 """Sharded, fused train step — the heart of the `tpu_sync` design.
 
 Reference path (SURVEY.md §3.1-3.2): forward → backward → kvstore.push(grad) →
-server optimizer → kvstore.pull(weight), each a separate engine/network op.
-TPU-native: ONE jitted program: forward + backward + gradient allreduce +
-optimizer update. Sharding annotations (batch over 'dp', params replicated or
-sharded per rules) let XLA insert the ICI collectives — no hand-written comm.
+server optimizer → kvstore.pull(weight), each a separate engine/network op
+(reference python/mxnet/model.py:126-136). TPU-native: ONE jitted program:
+forward + backward + gradient allreduce + optimizer update. Sharding
+annotations (batch over 'dp', params replicated) let XLA insert the ICI
+collectives — no hand-written comm. Module wires this in when
+`kvstore='tpu_sync'` (module/module.py), so `fit` is one XLA dispatch/step.
 """
 from __future__ import annotations
 
@@ -20,16 +22,21 @@ __all__ = ["DataParallelTrainStep"]
 
 
 class DataParallelTrainStep:
-    """Compile a Symbol's forward+backward+SGD-update into one sharded XLA program.
+    """Compile a Symbol's forward+backward+optimizer-update into one sharded
+    XLA program.
 
     Parameters live as a dict of jax arrays (replicated over the mesh); each
     call consumes a global batch sharded along 'dp' and returns outputs plus
     updated params — buffer donation makes the update in-place on device.
+
+    `lr` is a runtime argument of the jitted program, so lr schedules never
+    trigger recompilation.
     """
 
     def __init__(self, symbol, mesh, lr=0.01, momentum=0.0, wd=0.0,
                  data_names=("data",), label_names=("softmax_label",),
-                 sharding_config=None, rescale_grad=None):
+                 sharding_config=None, rescale_grad=None, optimizer="sgd",
+                 opt_hp=None, fixed_param_names=(), clip_gradient=None):
         self.symbol = symbol
         self.mesh = mesh
         self.lr = lr
@@ -38,16 +45,19 @@ class DataParallelTrainStep:
         self.data_names = list(data_names)
         self.label_names = list(label_names)
         self.sharding_config = sharding_config
+        self.optimizer = optimizer
+        # static hyperparams baked into the program (momentum/beta1/beta2/eps)
+        self.opt_hp = dict(opt_hp or {})
+        if optimizer == "sgd":
+            self.opt_hp.setdefault("momentum", momentum)
+        self.fixed_param_names = frozenset(fixed_param_names or ())
+        self.clip_gradient = clip_gradient
 
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.param_names = [n for n in self.arg_names
                             if n not in self.data_names + self.label_names]
         self._rescale = rescale_grad
-
-        # pure graph runner borrowed from Executor (single source of truth)
-        from ..executor import Executor
-        self._graph_runner = None
 
         self._repl = NamedSharding(mesh, PartitionSpec())
         self._batch_shard = NamedSharding(
@@ -56,7 +66,7 @@ class DataParallelTrainStep:
 
     # ------------------------------------------------------------------
     def init(self, batch_shapes, dtype=_np.float32, seed=0):
-        """Infer shapes, initialize replicated params + momentum, build the step."""
+        """Infer shapes, initialize replicated params + opt state, build the step."""
         arg_shapes, _, aux_shapes = self.symbol.infer_shape(**batch_shapes)
         shapes = dict(zip(self.arg_names, arg_shapes))
         key = jax.random.PRNGKey(seed)
@@ -77,18 +87,61 @@ class DataParallelTrainStep:
                    jnp.ones(s, dtype) if "var" in name else jnp.zeros(s, dtype),
                    self._repl)
                for name, s in zip(self.aux_names, aux_shapes)}
-        moms = {name: jax.device_put(jnp.zeros_like(v), self._repl)
-                for name, v in params.items()} if self.momentum else {}
-        self.params, self.aux, self.moms = params, aux, moms
+        self.params, self.aux = params, aux
+        self._init_opt_state()
         self._build_step(batch_shapes)
         return self
+
+    def init_from(self, arg_params, aux_params, batch_shapes):
+        """Adopt existing parameter values (dict name -> NDArray/ndarray) —
+        the Module path: init_params already ran, this step becomes the
+        device-side authority for them during fit."""
+        self.params = {n: jax.device_put(jnp.asarray(
+                           arg_params[n].asnumpy()
+                           if hasattr(arg_params[n], "asnumpy")
+                           else arg_params[n]), self._repl)
+                       for n in self.param_names}
+        self.aux = {n: jax.device_put(jnp.asarray(
+                        aux_params[n].asnumpy()
+                        if hasattr(aux_params[n], "asnumpy")
+                        else aux_params[n]), self._repl)
+                    for n in self.aux_names}
+        self._init_opt_state()
+        self._build_step(batch_shapes)
+        return self
+
+    def reload_params(self, arg_params, aux_params):
+        """Overwrite device param/aux values in place, PRESERVING optimizer
+        state and the compiled program (no re-jit, no momentum reset)."""
+        self.params = {n: jax.device_put(jnp.asarray(
+                           arg_params[n].asnumpy()
+                           if hasattr(arg_params[n], "asnumpy")
+                           else arg_params[n]), self._repl)
+                       for n in self.param_names}
+        self.aux = {n: jax.device_put(jnp.asarray(
+                        aux_params[n].asnumpy()
+                        if hasattr(aux_params[n], "asnumpy")
+                        else aux_params[n]), self._repl)
+                    for n in self.aux_names}
+
+    def _init_opt_state(self):
+        from .optim_update import init_opt_state
+        self.opt_state = init_opt_state(
+            self.optimizer, self.params,
+            momentum=self.opt_hp.get("momentum", self.momentum))
+        # keep legacy attribute for existing callers/tests
+        self.moms = self.opt_state.get("mom") or {}
+
+    def export_params(self):
+        """Current (params, aux) as numpy dicts (host sync point)."""
+        return ({n: _np.asarray(v) for n, v in self.params.items()},
+                {n: _np.asarray(v) for n, v in self.aux.items()})
 
     def _build_step(self, batch_shapes):
         from ..executor import Executor
         from ..ndarray.ndarray import zeros as nd_zeros
         from ..context import cpu
         # an executor instance only for its traced pure _run_graph
-        dummy_args = {n: nd_zeros((1,)) for n in self.arg_names}
         arg_shapes, _, aux_shapes = self.symbol.infer_shape(**batch_shapes)
         shapes = dict(zip(self.arg_names, arg_shapes))
         dummy_args = {n: nd_zeros(shapes[n]) for n in self.arg_names}
@@ -96,11 +149,14 @@ class DataParallelTrainStep:
                      zip(self.aux_names, aux_shapes)}
         runner = Executor(self.symbol, cpu(), dummy_args, {}, "null", dummy_aux)
 
-        lr, momentum, wd = self.lr, self.momentum, self.wd
+        wd = self.wd
+        optimizer, opt_hp = self.optimizer, dict(self.opt_hp)
+        fixed = self.fixed_param_names
+        clip = self.clip_gradient
         batch_size = list(batch_shapes.values())[0][0]
         rescale = self._rescale if self._rescale is not None else 1.0 / batch_size
 
-        def step(params, moms, aux, batch, rng):
+        def step(params, opt_state, aux, batch, rng, lr):
             def loss_fn(p):
                 outs, aux_upd = runner._run_graph({**p, **batch}, aux, rng, True)
                 return outs, aux_upd
@@ -108,37 +164,56 @@ class DataParallelTrainStep:
             seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads = vjp(seeds)[0]
             from .optim_update import apply_update
-            grads = {name: grads[name] * rescale + wd * p
-                     for name, p in params.items()}
-            new_params, state = apply_update(
-                "sgd", {"lr": lr, "momentum": momentum}, params,
-                {"mom": moms if momentum else None}, grads)
-            return new_params, state["mom"] if momentum else {}, aux_upd, outs
+            # reference optimizer order: rescale -> clip -> + wd*weight
+            grads = {name: grads[name] * rescale for name in params}
+            if clip is not None:
+                grads = {name: jnp.clip(g, -clip, clip)
+                         for name, g in grads.items()}
+            grads = {name: g + wd * params[name]
+                     for name, g in grads.items()}
+            hp = dict(opt_hp, lr=lr)
+            new_params, new_state = apply_update(
+                optimizer, hp, params, opt_state, grads)
+            if fixed:
+                new_params = {n: (params[n] if n in fixed else v)
+                              for n, v in new_params.items()}
+            return new_params, new_state, aux_upd, outs
 
+        st_sharding = jax.tree_util.tree_map(lambda _: self._repl,
+                                             self.opt_state)
         in_shardings = (
             {n: self._repl for n in self.param_names},
-            {n: self._repl for n in self.moms},
+            st_sharding,
             {n: self._repl for n in self.aux_names},
             {n: self._batch_shard for n in
              self.data_names + [l for l in self.label_names
                                 if l in self.arg_names]},
             self._repl,
+            None,
         )
         self._step = jax.jit(step, in_shardings=in_shardings,
                              donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
-    def __call__(self, batch_np, rng=None):
-        """Run one step on a global batch (dict name->numpy)."""
+    def __call__(self, batch_np, rng=None, lr=None):
+        """Run one step on a global batch (dict name->numpy or jax.Array)."""
         if self._step is None:
             raise MXNetError("call init() first")
         batch = {}
         for name, arr in batch_np.items():
-            batch[name] = jax.device_put(jnp.asarray(arr), self._batch_shard)
+            if isinstance(arr, jax.Array):  # already staged on device
+                batch[name] = arr
+            else:
+                batch[name] = jax.device_put(jnp.asarray(arr),
+                                             self._batch_shard)
         if rng is None:
             rng = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31))
         rng = jax.device_put(rng, self._repl)
-        self.params, self.moms, aux_upd, outs = self._step(
-            self.params, self.moms, self.aux, batch, rng)
+        if lr is None:
+            lr = self.lr
+        self.params, self.opt_state, aux_upd, outs = self._step(
+            self.params, self.opt_state, self.aux, batch,
+            rng, jnp.float32(lr))
+        self.moms = self.opt_state.get("mom") or {}
         self.aux.update(aux_upd)
         return outs

@@ -14,7 +14,10 @@ from collections import namedtuple
 
 import numpy as _np
 
-from .base import MXNetError
+try:  # normal package context
+    from .base import MXNetError
+except ImportError:  # loaded standalone (tools/im2rec.py stays jax-free)
+    MXNetError = RuntimeError
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
@@ -77,32 +80,68 @@ class MXRecordIO:
     def tell(self):
         return self.handle.tell()
 
-    def write(self, buf):
-        assert self.writable
-        length = len(buf)
-        upper = 0  # single-record (no continuation) cflag
-        lrec = (upper << 29) | length
+    def _write_part(self, cflag, buf):
+        lrec = (cflag << 29) | len(buf)
         self.handle.write(struct.pack("<II", _kMagic, lrec))
         self.handle.write(buf)
-        pad = (4 - (length % 4)) % 4
+        pad = (4 - (len(buf) % 4)) % 4
         if pad:
             self.handle.write(b"\x00" * pad)
 
-    def read(self):
-        assert not self.writable
+    def write(self, buf):
+        """Write one record, splitting at 4-byte-aligned magic occurrences
+        into continuation parts (cflag 1/2/3) like the reference dmlc
+        RecordIO, so any payload round-trips byte-exactly."""
+        assert self.writable
+        if len(buf) >= (1 << 29):
+            raise MXNetError("RecordIO record exceeds 2^29 bytes")
+        magic = struct.pack("<I", _kMagic)
+        dptr = 0
+        lower = (len(buf) // 4) * 4
+        first = True
+        i = 0
+        while i < lower:
+            if buf[i:i + 4] == magic:
+                self._write_part(1 if first else 2, buf[dptr:i])
+                first = False
+                dptr = i + 4
+            i += 4
+        self._write_part(0 if first else 3, buf[dptr:])
+
+    def _read_part(self):
         hdr = self.handle.read(8)
         if len(hdr) < 8:
-            return None
+            return None, 0
         magic, lrec = struct.unpack("<II", hdr)
         if magic != _kMagic:
             raise MXNetError("Invalid RecordIO magic number at offset %d"
                              % (self.handle.tell() - 8))
+        cflag = (lrec >> 29) & 7
         length = lrec & ((1 << 29) - 1)
         buf = self.handle.read(length)
         pad = (4 - (length % 4)) % 4
         if pad:
             self.handle.read(pad)
-        return buf
+        return buf, cflag
+
+    def read(self):
+        """Read one logical record, stitching continuation parts back
+        together (re-inserting the magic consumed at each seam)."""
+        assert not self.writable
+        buf, cflag = self._read_part()
+        if buf is None or cflag == 0:
+            return buf
+        if cflag != 1:
+            raise MXNetError("RecordIO record starts with continuation part")
+        magic = struct.pack("<I", _kMagic)
+        parts = [buf]
+        while cflag != 3:
+            part, cflag = self._read_part()
+            if part is None or cflag not in (2, 3):
+                raise MXNetError("truncated multi-part RecordIO record")
+            parts.append(magic)
+            parts.append(part)
+        return b"".join(parts)
 
 
 class MXIndexedRecordIO(MXRecordIO):

@@ -184,7 +184,12 @@ class LSTMCell(BaseRNNCell):
         self._num_hidden = num_hidden
         self._forget_bias = forget_bias
         self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
+        # forget_bias is an initializer concern (reference rnn_cell.py
+        # attaches init.LSTMBias(forget_bias) to i2h_bias); runtime math
+        # stays untouched so fused/unfused numerics agree.
+        from ..initializer import LSTMBias
+        self._iB = self.params.get("i2h_bias",
+                                   init=LSTMBias(forget_bias=forget_bias))
         self._hW = self.params.get("h2h_weight")
         self._hB = self.params.get("h2h_bias")
 
@@ -212,7 +217,7 @@ class LSTMCell(BaseRNNCell):
                                      name="%sslice" % name))
         in_gate = s.Activation(slices[0], act_type="sigmoid")
         # forget_bias is an *initializer* concern in the reference (the
-        # LSTMBias init writes it into h2h_bias, rnn_cell.py LSTMCell) —
+        # LSTMBias init writes it into i2h_bias, rnn_cell.py LSTMCell) —
         # nothing is added at runtime, keeping fused/unfused numerics equal
         forget_gate = s.Activation(slices[1], act_type="sigmoid")
         in_trans = s.Activation(slices[2], act_type="tanh")
@@ -255,7 +260,10 @@ class GRUCell(BaseRNNCell):
         reset = s.Activation(i2h_r + h2h_r, act_type="sigmoid")
         update = s.Activation(i2h_z + h2h_z, act_type="sigmoid")
         trans = s.Activation(i2h_o + reset * h2h_o, act_type="tanh")
-        next_h = prev_h + update * (trans - prev_h)
+        # h' = (1-z)*candidate + z*prev — matches the reference rnn_cell.py
+        # GRUCell and this repo's fused RNN op (ops/nn.py), so fused/unfused
+        # weights stay interchangeable.
+        next_h = trans + update * (prev_h - trans)
         return next_h, [next_h]
 
 

@@ -178,12 +178,18 @@ class ImageRecordIter {
         }
         int pad = 0;
         if (take < bs) {
-          if (!p_.round_batch && take == 0) break;
           pad = static_cast<int>(bs - take);
-          for (size_t j = 0; j < static_cast<size_t>(pad); ++j) {
-            recs.emplace_back();
-            auto& off = shard_[order[j % n]];  // wrap to epoch start
-            reader.ReadAt(off.first, off.second, &recs.back());
+          if (p_.round_batch) {
+            for (size_t j = 0; j < static_cast<size_t>(pad); ++j) {
+              recs.emplace_back();
+              auto& off = shard_[order[j % n]];  // wrap to epoch start
+              reader.ReadAt(off.first, off.second, &recs.back());
+            }
+          } else {
+            // partial batch: pad slots are placeholders (consumer trims via
+            // `pad`), so reuse already-read records instead of wrapping
+            for (size_t j = 0; j < static_cast<size_t>(pad); ++j)
+              recs.emplace_back(recs[j % take]);
           }
         }
         i += take;
@@ -272,10 +278,16 @@ class ImageRecordIter {
       size_t payload_len = rec.size() - sizeof(IRHeader);
       float* lab = &b->label[i * p_.label_width];
       if (hdr.flag > 0) {
+        size_t lab_bytes = static_cast<size_t>(hdr.flag) * sizeof(float);
+        if (lab_bytes > payload_len)
+          throw std::runtime_error(
+              "corrupt record: IRHeader.flag labels exceed record size "
+              "(flag=" + std::to_string(hdr.flag) + ", payload=" +
+              std::to_string(payload_len) + " bytes)");
         size_t nlab = std::min<size_t>(hdr.flag, p_.label_width);
         std::memcpy(lab, payload, nlab * sizeof(float));
-        payload += hdr.flag * sizeof(float);
-        payload_len -= hdr.flag * sizeof(float);
+        payload += lab_bytes;
+        payload_len -= lab_bytes;
       } else {
         lab[0] = hdr.label;
       }

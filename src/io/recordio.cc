@@ -1,10 +1,24 @@
 // RecordIO reader/writer implementation (format: see recordio.h).
+//
+// Byte-compatible with the reference's dmlc RecordIO (recordio.py:36-120,
+// dmlc-core recordio.cc): payloads containing the 4-byte magic at an aligned
+// position are split into parts (cflag 1=start, 2=middle, 3=end; 0=whole),
+// with the magic occurrence itself consumed as the seam. The reader stitches
+// parts back, re-inserting the magic between them.
 #include "recordio.h"
 
 #include <cstring>
 #include <stdexcept>
 
 namespace mxtpu {
+
+namespace {
+inline uint32_t DecodeFlag(uint32_t rec) { return (rec >> 29U) & 7U; }
+inline uint32_t DecodeLength(uint32_t rec) { return rec & ((1U << 29U) - 1U); }
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t length) {
+  return (cflag << 29U) | length;
+}
+}  // namespace
 
 RecordIOReader::RecordIOReader(const std::string& path) {
   fp_ = std::fopen(path.c_str(), "rb");
@@ -14,12 +28,14 @@ RecordIOReader::~RecordIOReader() {
   if (fp_) std::fclose(fp_);
 }
 
-bool RecordIOReader::ReadRecord(std::string* out) {
+// Reads one physical part. Returns false on EOF. Sets *cflag.
+bool RecordIOReader::ReadPart(std::string* out, uint32_t* cflag) {
   uint32_t hdr[2];
   if (std::fread(hdr, sizeof(uint32_t), 2, fp_) != 2) return false;
   if (hdr[0] != kRecordIOMagic)
     throw std::runtime_error("invalid RecordIO magic");
-  uint32_t length = hdr[1] & ((1u << 29) - 1);
+  *cflag = DecodeFlag(hdr[1]);
+  uint32_t length = DecodeLength(hdr[1]);
   out->resize(length);
   if (length && std::fread(&(*out)[0], 1, length, fp_) != length) return false;
   uint32_t pad = (4 - (length % 4)) % 4;
@@ -27,29 +43,69 @@ bool RecordIOReader::ReadRecord(std::string* out) {
   return true;
 }
 
+bool RecordIOReader::ReadRecord(std::string* out) {
+  uint32_t cflag = 0;
+  std::string part;
+  if (!ReadPart(out, &cflag)) return false;
+  if (cflag == 0) return true;
+  if (cflag != 1)
+    throw std::runtime_error("RecordIO: record starts with continuation part");
+  // multi-part record: stitch, re-inserting the magic consumed at each seam
+  for (;;) {
+    out->append(reinterpret_cast<const char*>(&kRecordIOMagic),
+                sizeof(kRecordIOMagic));
+    if (!ReadPart(&part, &cflag))
+      throw std::runtime_error("RecordIO: truncated multi-part record");
+    if (cflag != 2 && cflag != 3)
+      throw std::runtime_error("RecordIO: bad continuation flag");
+    out->append(part);
+    if (cflag == 3) return true;
+  }
+}
+
 std::vector<std::pair<uint64_t, uint32_t>> RecordIOReader::ScanOffsets() {
   std::vector<std::pair<uint64_t, uint32_t>> offsets;
   std::fseek(fp_, 0, SEEK_SET);
   uint32_t hdr[2];
+  uint64_t rec_start = 0;
+  uint32_t rec_len = 0;
+  bool in_record = false;
   for (;;) {
     uint64_t pos = static_cast<uint64_t>(std::ftell(fp_));
     if (std::fread(hdr, sizeof(uint32_t), 2, fp_) != 2) break;
     if (hdr[0] != kRecordIOMagic)
       throw std::runtime_error("invalid RecordIO magic during scan");
-    uint32_t length = hdr[1] & ((1u << 29) - 1);
-    offsets.emplace_back(pos, length);
+    uint32_t cflag = DecodeFlag(hdr[1]);
+    uint32_t length = DecodeLength(hdr[1]);
     uint32_t pad = (4 - (length % 4)) % 4;
     std::fseek(fp_, static_cast<long>(length + pad), SEEK_CUR);
+    if (cflag == 0) {
+      offsets.emplace_back(pos, length);
+    } else if (cflag == 1) {
+      rec_start = pos;
+      rec_len = length;
+      in_record = true;
+    } else {
+      if (!in_record)
+        throw std::runtime_error("RecordIO: orphan continuation during scan");
+      rec_len += length + sizeof(kRecordIOMagic);  // seam magic re-inserted
+      if (cflag == 3) {
+        offsets.emplace_back(rec_start, rec_len);
+        in_record = false;
+      }
+    }
   }
+  if (in_record)
+    throw std::runtime_error("RecordIO: truncated multi-part record in scan");
   std::fseek(fp_, 0, SEEK_SET);
   return offsets;
 }
 
 bool RecordIOReader::ReadAt(uint64_t offset, uint32_t length,
                             std::string* out) {
-  std::fseek(fp_, static_cast<long>(offset + 8), SEEK_SET);  // skip magic+len
-  out->resize(length);
-  return length == 0 || std::fread(&(*out)[0], 1, length, fp_) == length;
+  std::fseek(fp_, static_cast<long>(offset), SEEK_SET);
+  if (!ReadRecord(out)) return false;
+  return out->size() == length;
 }
 
 void RecordIOReader::Seek(uint64_t offset) {
@@ -65,11 +121,30 @@ RecordIOWriter::~RecordIOWriter() {
 }
 
 uint64_t RecordIOWriter::WriteRecord(const void* data, size_t size) {
+  if (size >= (1ULL << 29))
+    throw std::runtime_error("RecordIO: record exceeds 2^29 bytes");
   uint64_t pos = static_cast<uint64_t>(std::ftell(fp_));
-  uint32_t hdr[2] = {kRecordIOMagic, static_cast<uint32_t>(size)};
-  std::fwrite(hdr, sizeof(uint32_t), 2, fp_);
-  std::fwrite(data, 1, size, fp_);
-  uint32_t pad = (4 - (size % 4)) % 4;
+  const char* bhead = static_cast<const char*>(data);
+  const char* magic = reinterpret_cast<const char*>(&kRecordIOMagic);
+  uint32_t len = static_cast<uint32_t>(size);
+  uint32_t lower_align = (len >> 2U) << 2U;
+  uint32_t dptr = 0;
+  // split at 4-byte-aligned magic occurrences (seam = the magic itself)
+  for (uint32_t i = 0; i < lower_align; i += 4) {
+    if (std::memcmp(bhead + i, magic, 4) == 0) {
+      uint32_t lrec = EncodeLRec(dptr == 0 ? 1U : 2U, i - dptr);
+      std::fwrite(magic, 1, 4, fp_);
+      std::fwrite(&lrec, sizeof(lrec), 1, fp_);
+      if (i != dptr) std::fwrite(bhead + dptr, 1, i - dptr, fp_);
+      dptr = i + 4;
+    }
+  }
+  uint32_t lrec = EncodeLRec(dptr != 0 ? 3U : 0U, len - dptr);
+  std::fwrite(magic, 1, 4, fp_);
+  std::fwrite(&lrec, sizeof(lrec), 1, fp_);
+  if (len != dptr) std::fwrite(bhead + dptr, 1, len - dptr, fp_);
+  uint32_t tail = len - dptr;
+  uint32_t pad = (4 - (tail % 4)) % 4;
   static const char zeros[4] = {0, 0, 0, 0};
   if (pad) std::fwrite(zeros, 1, pad, fp_);
   return pos;

@@ -29,16 +29,20 @@ class RecordIOReader {
   explicit RecordIOReader(const std::string& path);
   ~RecordIOReader();
   bool is_open() const { return fp_ != nullptr; }
-  // Read next record payload into *out; false at EOF. Throws std::runtime_error
-  // on a corrupt magic.
+  // Read next logical record payload into *out (stitching multi-part
+  // continuation records); false at EOF. Throws std::runtime_error on a
+  // corrupt magic or truncated multi-part record.
   bool ReadRecord(std::string* out);
-  // Scan the whole file, returning (offset, length) of every record payload.
+  // Scan the whole file, returning (offset, stitched length) of every
+  // logical record (multi-part records count once, at their first part).
   std::vector<std::pair<uint64_t, uint32_t>> ScanOffsets();
-  // Read the payload at a known offset (as produced by ScanOffsets).
+  // Read the logical record at a known offset (as produced by ScanOffsets);
+  // `length` is validated against the stitched payload size.
   bool ReadAt(uint64_t offset, uint32_t length, std::string* out);
   void Seek(uint64_t offset);
 
  private:
+  bool ReadPart(std::string* out, uint32_t* cflag);
   FILE* fp_;
 };
 

@@ -1,0 +1,156 @@
+"""Fused `tpu_sync` Module path: one jitted XLA program per train step
+(fwd+bwd+grad-psum+optimizer, donated buffers) instead of the reference's
+per-param push/pull loop (reference: python/mxnet/model.py:126-136).
+
+Covers: activation conditions, numerical parity with the per-param path,
+convergence through fit, epoch-boundary param sync, lr scheduling, and
+checkpointing of fused optimizer state.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_data(n=256, d=10, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(0, 1, (n, d)).astype(np.float32)
+    W = rng.normal(0, 1, (d, k)).astype(np.float32)
+    y = (X @ W).argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+def _fit_module(kv, nctx, X, y, arg_params=None, num_epoch=3, momentum=0.9,
+                optimizer="sgd", opt_params=None):
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=False,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=[mx.tpu(i) for i in range(nctx)])
+    params = opt_params or {"learning_rate": 0.05, "momentum": momentum}
+    mod.fit(it, num_epoch=num_epoch, kvstore=kv, arg_params=arg_params,
+            allow_missing=arg_params is None,
+            initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=1.0),
+            optimizer=optimizer, optimizer_params=params)
+    return mod
+
+
+def test_fused_step_activates_for_tpu_sync():
+    X, y = _toy_data()
+    mod = _fit_module("tpu_sync", 2, X, y, num_epoch=1)
+    assert mod._fused_step is not None
+
+
+def test_fused_step_not_used_for_local():
+    X, y = _toy_data()
+    mod = _fit_module("local", 1, X, y, num_epoch=1)
+    assert mod._fused_step is None
+
+
+def test_fused_matches_per_param_path():
+    """Same init, same data, same hyperparams: fused tpu_sync and the
+    per-param 'local' path must land on (numerically) the same params."""
+    X, y = _toy_data()
+    # shared initial params
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    seed_mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    seed_mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seed_mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=1.0))
+    arg0, _ = seed_mod.get_params()
+    arg0 = {k: v.copy() for k, v in arg0.items()}
+
+    m_local = _fit_module("local", 1, X, y, arg_params=arg0, num_epoch=2,
+                          momentum=0.0)
+    m_fused = _fit_module("tpu_sync", 2, X, y, arg_params=arg0, num_epoch=2,
+                          momentum=0.0)
+    assert m_fused._fused_step is not None
+    a_local, _ = m_local.get_params()
+    a_fused, _ = m_fused.get_params()
+    for name in a_local:
+        np.testing.assert_allclose(a_local[name].asnumpy(),
+                                   a_fused[name].asnumpy(),
+                                   rtol=2e-3, atol=2e-4, err_msg=name)
+
+
+def test_fused_convergence_and_eval():
+    X, y = _toy_data()
+    mod = _fit_module("tpu_sync", 2, X, y, num_epoch=10, momentum=0.9)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.9, acc
+
+
+def test_fused_adam():
+    X, y = _toy_data()
+    mod = _fit_module("tpu_sync", 2, X, y, num_epoch=8, optimizer="adam",
+                      opt_params={"learning_rate": 0.01})
+    assert mod._fused_step is not None
+    assert mod._fused_step.optimizer == "adam"
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.9, acc
+
+
+def test_fused_lr_scheduler_applies():
+    """lr is a runtime arg of the jitted program: a scheduler must take
+    effect without rebuilding the step."""
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=[mx.tpu(0), mx.tpu(1)])
+    sched = mx.lr_scheduler.FactorScheduler(step=4, factor=0.5)
+    mod.fit(it, num_epoch=2, kvstore="tpu_sync", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "lr_scheduler": sched},
+            initializer=mx.init.Xavier())
+    assert mod._fused_step is not None
+    assert mod._optimizer.num_update >= 8  # scheduler consumed step counts
+
+
+def test_fused_checkpoint_roundtrip(tmp_path):
+    X, y = _toy_data()
+    mod = _fit_module("tpu_sync", 2, X, y, num_epoch=2)
+    prefix = str(tmp_path / "fused")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+    assert os.path.exists(prefix + "-0002.params")
+    assert os.path.exists(prefix + "-0002.states")
+
+    mod2 = mx.mod.Module.load(prefix, 2, load_optimizer_states=True)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params()
+    mod2.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.05,
+                                          "momentum": 0.9})
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for name in a1:
+        np.testing.assert_allclose(a1[name].asnumpy(), a2[name].asnumpy(),
+                                   atol=1e-6, err_msg=name)
+    # momentum state survived the roundtrip
+    mom1 = {k: np.asarray(v) for k, v in
+            (mod._fused_step.opt_state["mom"] or {}).items()}
+    mom2 = {k: np.asarray(v) for k, v in
+            (mod2._fused_step.opt_state["mom"] or {}).items()}
+    for name in mom1:
+        np.testing.assert_allclose(mom1[name], mom2[name], atol=1e-6,
+                                   err_msg=name)
+
+
+def test_fused_monitor_falls_back():
+    """Installing a Monitor needs executor interior capture — Module must
+    drop the fused path and still train."""
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=[mx.tpu(0)])
+    mon = mx.monitor.Monitor(100)
+    mod.fit(it, num_epoch=1, kvstore="tpu_sync", monitor=mon,
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.05})
+    assert mod._fused_step is None

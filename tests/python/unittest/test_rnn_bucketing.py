@@ -68,10 +68,11 @@ def test_fused_rnn_cell_and_unfuse():
     assert outs2[0] == (3, 5, 16)
 
 
-def test_fused_weights_pack_unpack_roundtrip():
+@pytest.mark.parametrize("mode,gates", [("lstm", 4), ("gru", 3)])
+def test_fused_weights_pack_unpack_roundtrip(mode, gates):
     """Fused blob <-> per-cell weights; unfused graph binds with the
-    unpacked names and reproduces the fused outputs."""
-    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm", prefix="f_")
+    unpacked names and reproduces the fused outputs (lstm AND gru)."""
+    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode=mode, prefix="f_")
     data = mx.sym.Variable("data")
     outputs, _ = cell.unroll(4, data, layout="NTC", merge_outputs=True)
     args_shapes, _, _ = outputs.infer_shape(data=(2, 4, 6))
@@ -83,7 +84,7 @@ def test_fused_weights_pack_unpack_roundtrip():
     unpacked = cell.unpack_weights(args)
     assert "f_parameters" not in unpacked
     assert "f_l0_i2h_weight" in unpacked and "f_l1_h2h_bias" in unpacked
-    assert unpacked["f_l0_i2h_weight"].shape == (32, 6)
+    assert unpacked["f_l0_i2h_weight"].shape == (8 * gates, 6)
     repacked = cell.pack_weights(unpacked)
     np.testing.assert_allclose(repacked["f_parameters"].asnumpy(),
                                blob.asnumpy(), atol=1e-6)

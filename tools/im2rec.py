@@ -58,9 +58,25 @@ def read_list(path):
             yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
 
 
+def _load_recordio():
+    """Load mxnet_tpu.recordio WITHOUT importing the mxnet_tpu package:
+    the package __init__ initializes jax, and a data-packing tool must
+    never touch (or hang on) an accelerator backend."""
+    if "mxnet_tpu" in sys.modules:  # caller already paid the import
+        from mxnet_tpu import recordio
+        return recordio
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "mxnet_tpu", "recordio.py")
+    spec = importlib.util.spec_from_file_location("_mxtpu_recordio", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def pack(prefix, root, quality=95, resize=0, color=1):
     import cv2
-    from mxnet_tpu import recordio
+    recordio = _load_recordio()
     rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
     n = 0
     for idx, labels, rel in read_list(prefix + ".lst"):
