@@ -9,6 +9,21 @@ against `import mxnet as mx` run with only the import line changed (or via
 
 __version__ = "0.1.0"
 
+# Join the launcher's process group BEFORE anything can touch a backend
+# (several op modules build small jnp constants at import). The analog of
+# ps-lite's rendezvous-at-startup (reference: kvstore_dist.h Customer init).
+import os as _os
+
+if int(_os.environ.get("JAX_NUM_PROCESSES", "1") or "1") > 1:
+    from .parallel import collectives as _collectives
+    try:
+        _collectives.ensure_distributed()
+    except RuntimeError as _e:  # backend already touched before this import
+        import logging as _logging
+        _logging.warning("mxnet_tpu: jax.distributed init skipped (%s); "
+                         "call parallel.collectives.ensure_distributed() "
+                         "before any jax computation", _e)
+
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
 from . import base
@@ -28,6 +43,8 @@ from . import optimizer
 from . import metric
 from . import lr_scheduler
 from . import callback
+from . import attribute
+from .attribute import AttrScope
 from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
@@ -40,6 +57,9 @@ from .kvstore import KVStore, create as _kv_create
 class kvstore:  # namespace shim so `mx.kvstore.create(...)` works
     create = staticmethod(_kv_create)
     KVStore = KVStore
+
+
+kv = kvstore  # reference alias: mx.kv.create(...)
 
 
 from . import module
@@ -56,6 +76,7 @@ from .monitor import Monitor
 from . import image
 from . import rtc
 from . import contrib
+from . import storage
 from .util import test_utils
 
 viz = visualization

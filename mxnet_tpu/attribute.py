@@ -1,0 +1,46 @@
+"""AttrScope — scoped symbol attributes (reference: python/mxnet/attribute.py).
+
+`with mx.AttrScope(ctx_group='dev1'):` attaches attrs to every Symbol created
+inside the scope; `ctx_group` + `group2ctx` at bind time is the model-parallel
+placement API (reference: graph_executor.cc:406 PlaceDevice pass; here the
+groups map onto a mesh axis — executor.py _build_group_shardings).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current_attrs"]
+
+
+class AttrScope:
+    """Attach user attrs to symbols created within the scope (nestable;
+    inner scopes override outer keys)."""
+
+    _local = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attrs = {k: str(v) for k, v in kwargs.items()}
+
+    @classmethod
+    def _stack(cls):
+        if not hasattr(cls._local, "stack"):
+            cls._local.stack = []
+        return cls._local.stack
+
+    @classmethod
+    def get_current(cls):
+        merged = {}
+        for scope in cls._stack():
+            merged.update(scope._attrs)
+        return merged
+
+    def __enter__(self):
+        self._stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        self._stack().pop()
+
+
+def current_attrs():
+    return AttrScope.get_current()

@@ -78,15 +78,17 @@ class Context:
 
 
 def _accel_devices():
-    """Accelerator devices: the default JAX backend (TPU on hardware, CPU in tests)."""
-    return jax.devices()
+    """Accelerator devices addressable by THIS process: the default JAX
+    backend (TPU on hardware, CPU in tests). Multi-process (launch.py /
+    pod) jobs index local devices — global topology is the mesh's job."""
+    return jax.local_devices()
 
 
 def _cpu_devices():
     try:
-        return jax.devices("cpu")
+        return jax.local_devices(backend="cpu")
     except RuntimeError:
-        return jax.devices()
+        return jax.local_devices()
 
 
 def cpu(device_id=0):

@@ -5,5 +5,6 @@ from . import text
 from . import tensorboard
 from . import io
 from . import autograd
+from . import onnx
 
 __all__ = ["quantization", "text", "tensorboard", "io", "autograd"]

@@ -1,0 +1,8 @@
+"""ONNX importer (reference: python/mxnet/contrib/onnx/_import).
+
+`import_model(path) -> (sym, arg_params, aux_params)` for the model-zoo op
+subset; no onnx package needed (in-repo protobuf decoder).
+"""
+from .import_onnx import import_model, GraphProto  # noqa: F401
+
+onnx2mx = import_model  # reference exposes both spellings

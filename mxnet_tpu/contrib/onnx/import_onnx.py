@@ -1,0 +1,388 @@
+"""ONNX graph -> mxnet_tpu Symbol + params.
+
+Reference: python/mxnet/contrib/onnx/_import/{import_onnx,op_translations}.py
+— same translation targets (each ONNX node becomes an mx.sym call), built on
+the in-repo protobuf decoder (protobuf_lite.py) since the image has no onnx
+package. Covers the model-zoo op subset: Conv, BatchNormalization, Relu /
+Sigmoid / Tanh / LeakyRelu, MaxPool / AveragePool / GlobalAveragePool /
+GlobalMaxPool, Gemm, MatMul, Flatten, Reshape, Transpose, Concat, Add / Sub /
+Mul / Div / Sum, Dropout, Softmax, Identity, Clip, Squeeze, Unsqueeze, Pad,
+LRN, Constant.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from .protobuf_lite import decode_message
+
+# onnx.proto field numbers
+_MODEL_GRAPH = 7
+_GRAPH_NODE, _GRAPH_INITIALIZER = 1, 5
+_GRAPH_INPUT, _GRAPH_OUTPUT = 11, 12
+_NODE_INPUT, _NODE_OUTPUT, _NODE_NAME, _NODE_OPTYPE, _NODE_ATTR = 1, 2, 3, 4, 5
+_ATTR_NAME, _ATTR_F, _ATTR_I, _ATTR_S, _ATTR_T = 1, 2, 3, 4, 5
+_ATTR_FLOATS, _ATTR_INTS, _ATTR_STRINGS = 7, 8, 9
+_T_DIMS, _T_DTYPE, _T_FLOAT_DATA, _T_INT32_DATA = 1, 2, 4, 5
+_T_NAME, _T_INT64_DATA, _T_RAW = 8, 7, 9
+
+_ONNX_DT = {1: _np.float32, 2: _np.uint8, 3: _np.int8, 6: _np.int32,
+            7: _np.int64, 10: _np.float16, 11: _np.float64}
+
+
+def _tensor_to_np(t):
+    dims = tuple(t.get_ints(_T_DIMS))
+    dt = _ONNX_DT.get(t.get(_T_DTYPE, 1), _np.float32)
+    raw = t.get(_T_RAW)
+    if raw:
+        arr = _np.frombuffer(raw, dtype=dt)
+    elif t.get_all(_T_FLOAT_DATA):
+        arr = _np.asarray(t.get_floats(_T_FLOAT_DATA), dtype=dt)
+    elif t.get_all(_T_INT64_DATA):
+        arr = _np.asarray(t.get_ints(_T_INT64_DATA), dtype=dt)
+    elif t.get_all(_T_INT32_DATA):
+        arr = _np.asarray(t.get_ints(_T_INT32_DATA), dtype=dt)
+    else:
+        arr = _np.zeros(dims, dt)
+    return arr.reshape(dims) if dims else arr
+
+
+def _attrs(node):
+    out = {}
+    for a in node.get_msgs(_NODE_ATTR):
+        name = a.get_str(_ATTR_NAME)
+        if a.get_all(_ATTR_INTS):
+            out[name] = tuple(a.get_ints(_ATTR_INTS))
+        elif a.get_all(_ATTR_FLOATS):
+            out[name] = tuple(a.get_floats(_ATTR_FLOATS))
+        elif a.get(_ATTR_I) is not None:
+            out[name] = a.get_ints(_ATTR_I)[0]
+        elif a.get(_ATTR_F) is not None:
+            out[name] = a.get_float(_ATTR_F)
+        elif a.get(_ATTR_S) is not None:
+            out[name] = a.get_str(_ATTR_S)
+        elif a.get(_ATTR_T) is not None:
+            out[name] = _tensor_to_np(decode_message(a.get(_ATTR_T)))
+    return out
+
+
+def _pads_to_mx(pads, ndim=2):
+    """ONNX pads [x1b, x2b, x1e, x2e] -> symmetric mx pad tuple; asymmetric
+    pads are rejected (reference importer does the same)."""
+    if not pads:
+        return (0,) * ndim
+    half = len(pads) // 2
+    begin, end = pads[:half], pads[half:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError("asymmetric ONNX pads %r unsupported" % (pads,))
+    return tuple(begin)
+
+
+class GraphProto:
+    """Translate a decoded ONNX GraphProto into a Symbol + params
+    (reference: import_onnx.py GraphProto.from_onnx)."""
+
+    def __init__(self):
+        self._nodes = {}
+        self._params = {}
+
+    def from_onnx(self, graph):
+        from ... import symbol as sym
+
+        for t_raw in graph.get_all(_GRAPH_INITIALIZER):
+            t = decode_message(t_raw)
+            self._params[t.get_str(_T_NAME)] = _tensor_to_np(t)
+
+        for vi_raw in graph.get_all(_GRAPH_INPUT):
+            vi = decode_message(vi_raw)
+            name = vi.get_str(1)
+            if name not in self._params:
+                self._nodes[name] = sym.Variable(name)
+
+        for node_raw in graph.get_all(_GRAPH_NODE):
+            node = decode_message(node_raw)
+            op_type = node.get_str(_NODE_OPTYPE)
+            inputs = [v.decode("utf-8") for v in node.get_all(_NODE_INPUT)]
+            outputs = [v.decode("utf-8") for v in node.get_all(_NODE_OUTPUT)]
+            name = node.get_str(_NODE_NAME) or outputs[0]
+            fn = _TRANSLATORS.get(op_type)
+            if fn is None:
+                raise MXNetError("ONNX op %r not supported by importer"
+                                 % op_type)
+            res = fn(self, name, inputs, outputs, _attrs(node))
+            if res is not None:
+                for out_name, s in zip(outputs, res if isinstance(res, list)
+                                       else [res]):
+                    self._nodes[out_name] = s
+
+        out_syms = []
+        for vi_raw in graph.get_all(_GRAPH_OUTPUT):
+            vi = decode_message(vi_raw)
+            out_syms.append(self._nodes[vi.get_str(1)])
+        from ...symbol.symbol import Group
+        out = out_syms[0] if len(out_syms) == 1 else Group(out_syms)
+
+        from ...ndarray.ndarray import array
+        arg_names = set(out.list_arguments())
+        aux_names = set(out.list_auxiliary_states())
+        arg_params = {k: array(v) for k, v in self._params.items()
+                      if k in arg_names}
+        aux_params = {k: array(v) for k, v in self._params.items()
+                      if k in aux_names}
+        return out, arg_params, aux_params
+
+    # -- helpers -----------------------------------------------------------
+    def _in(self, name):
+        if name in self._nodes:
+            return self._nodes[name]
+        from ... import symbol as sym
+        # initializer used as graph input: becomes a learned Variable
+        self._nodes[name] = sym.Variable(name)
+        return self._nodes[name]
+
+    def _const_value(self, name):
+        """Compile-time constant (for Reshape shapes etc.)."""
+        if name in self._params:
+            return self._params[name]
+        raise MXNetError("ONNX input %r must be a constant initializer"
+                         % name)
+
+
+# ---------------------------------------------------------------------------
+# per-op translators (reference: op_translations.py)
+# ---------------------------------------------------------------------------
+
+
+def _conv(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    kernel = tuple(attrs.get("kernel_shape", ()))
+    args = dict(kernel=kernel,
+                num_filter=int(g._const_value(ins[1]).shape[0]),
+                stride=tuple(attrs.get("strides", (1,) * len(kernel))),
+                dilate=tuple(attrs.get("dilations", (1,) * len(kernel))),
+                pad=_pads_to_mx(attrs.get("pads"), len(kernel)),
+                num_group=int(attrs.get("group", 1)),
+                no_bias=len(ins) < 3, name=name)
+    inputs = [g._in(ins[0]), g._in(ins[1])]
+    if len(ins) >= 3:
+        inputs.append(g._in(ins[2]))
+    return sym.Convolution(*inputs, **args)
+
+
+def _batch_norm(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    return sym.BatchNorm(g._in(ins[0]), g._in(ins[1]), g._in(ins[2]),
+                         g._in(ins[3]), g._in(ins[4]),
+                         eps=float(attrs.get("epsilon", 1e-5)),
+                         momentum=float(attrs.get("momentum", 0.9)),
+                         fix_gamma=False, name=name)
+
+
+def _activation(act):
+    def f(g, name, ins, outs, attrs):
+        from ... import symbol as sym
+        return sym.Activation(g._in(ins[0]), act_type=act, name=name)
+    return f
+
+
+def _leaky_relu(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    return sym.LeakyReLU(g._in(ins[0]), act_type="leaky",
+                         slope=float(attrs.get("alpha", 0.01)), name=name)
+
+
+def _pool(pool_type, global_pool=False):
+    def f(g, name, ins, outs, attrs):
+        from ... import symbol as sym
+        if global_pool:
+            return sym.Pooling(g._in(ins[0]), kernel=(1, 1),
+                               pool_type=pool_type, global_pool=True,
+                               name=name)
+        kernel = tuple(attrs.get("kernel_shape", (1, 1)))
+        return sym.Pooling(
+            g._in(ins[0]), kernel=kernel, pool_type=pool_type,
+            stride=tuple(attrs.get("strides", (1,) * len(kernel))),
+            pad=_pads_to_mx(attrs.get("pads"), len(kernel)), name=name)
+    return f
+
+
+def _gemm(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    trans_b = int(attrs.get("transB", 0))
+    a = g._in(ins[0])
+    if int(attrs.get("transA", 0)):
+        a = sym.transpose(a)
+    w = g._const_value(ins[1])
+    num_hidden = w.shape[0] if trans_b else w.shape[1]
+    if not trans_b:  # FullyConnected expects [out, in]
+        g._params[ins[1]] = _np.ascontiguousarray(w.T)
+    if alpha != 1.0:
+        a = alpha * a
+    has_bias = len(ins) >= 3 and beta != 0.0  # C optional since opset 11
+    if has_bias and beta != 1.0 and ins[2] in g._params:
+        g._params[ins[2]] = beta * _np.asarray(g._params[ins[2]])
+    if has_bias:
+        return sym.FullyConnected(a, g._in(ins[1]), g._in(ins[2]),
+                                  num_hidden=int(num_hidden), name=name)
+    return sym.FullyConnected(a, g._in(ins[1]), num_hidden=int(num_hidden),
+                              no_bias=True, name=name)
+
+
+def _matmul(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    return sym.dot(g._in(ins[0]), g._in(ins[1]), name=name)
+
+
+def _flatten(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    return sym.Flatten(g._in(ins[0]), name=name)
+
+
+def _reshape(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    if len(ins) > 1:
+        shape = tuple(int(x) for x in g._const_value(ins[1]))
+    else:
+        shape = tuple(attrs.get("shape", ()))
+    return sym.Reshape(g._in(ins[0]), shape=shape, name=name)
+
+
+def _transpose(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    perm = attrs.get("perm")
+    return sym.transpose(g._in(ins[0]), axes=tuple(perm) if perm else None,
+                         name=name)
+
+
+def _concat(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    return sym.Concat(*[g._in(i) for i in ins],
+                      dim=int(attrs.get("axis", 1)), name=name)
+
+
+def _binary(op):
+    def f(g, name, ins, outs, attrs):
+        from ... import symbol as sym
+        fn = getattr(sym, op)
+        return fn(g._in(ins[0]), g._in(ins[1]), name=name)
+    return f
+
+
+def _sum(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    out = g._in(ins[0])
+    for i in ins[1:]:
+        out = out + g._in(i)
+    return out
+
+
+def _dropout(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    return sym.Dropout(g._in(ins[0]), p=float(attrs.get("ratio", 0.5)),
+                       name=name)
+
+
+def _softmax(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    return sym.softmax(g._in(ins[0]), axis=int(attrs.get("axis", 1)),
+                       name=name)
+
+
+def _identity(g, name, ins, outs, attrs):
+    return g._in(ins[0])
+
+
+def _clip(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    return sym.clip(g._in(ins[0]), a_min=float(attrs.get("min", -3.4e38)),
+                    a_max=float(attrs.get("max", 3.4e38)), name=name)
+
+
+def _squeeze(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    return sym.squeeze(g._in(ins[0]), axis=tuple(attrs.get("axes", ())),
+                       name=name)
+
+
+def _unsqueeze(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    out = g._in(ins[0])
+    for ax in sorted(attrs.get("axes", ())):
+        out = sym.expand_dims(out, axis=int(ax))
+    return out
+
+
+def _pad_op(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    pads = attrs.get("pads", ())
+    half = len(pads) // 2
+    width = []
+    for b, e in zip(pads[:half], pads[half:]):
+        width.extend([int(b), int(e)])
+    return sym.Pad(g._in(ins[0]), mode=attrs.get("mode", "constant"),
+                   pad_width=tuple(width),
+                   constant_value=float(attrs.get("value", 0.0)), name=name)
+
+
+def _lrn(g, name, ins, outs, attrs):
+    from ... import symbol as sym
+    return sym.LRN(g._in(ins[0]), nsize=int(attrs.get("size", 5)),
+                   alpha=float(attrs.get("alpha", 1e-4)),
+                   beta=float(attrs.get("beta", 0.75)),
+                   knorm=float(attrs.get("bias", 1.0)), name=name)
+
+
+def _constant(g, name, ins, outs, attrs):
+    val = attrs.get("value")
+    if val is None:
+        raise MXNetError("ONNX Constant without value")
+    g._params[outs[0]] = _np.asarray(val)
+    return None  # realized lazily through _in / _const_value
+
+
+_TRANSLATORS = {
+    "Conv": _conv,
+    "BatchNormalization": _batch_norm,
+    "Relu": _activation("relu"),
+    "Sigmoid": _activation("sigmoid"),
+    "Tanh": _activation("tanh"),
+    "LeakyRelu": _leaky_relu,
+    "MaxPool": _pool("max"),
+    "AveragePool": _pool("avg"),
+    "GlobalAveragePool": _pool("avg", global_pool=True),
+    "GlobalMaxPool": _pool("max", global_pool=True),
+    "Gemm": _gemm,
+    "MatMul": _matmul,
+    "Flatten": _flatten,
+    "Reshape": _reshape,
+    "Transpose": _transpose,
+    "Concat": _concat,
+    "Add": _binary("broadcast_add"),
+    "Sub": _binary("broadcast_sub"),
+    "Mul": _binary("broadcast_mul"),
+    "Div": _binary("broadcast_div"),
+    "Sum": _sum,
+    "Dropout": _dropout,
+    "Softmax": _softmax,
+    "Identity": _identity,
+    "Clip": _clip,
+    "Squeeze": _squeeze,
+    "Unsqueeze": _unsqueeze,
+    "Pad": _pad_op,
+    "LRN": _lrn,
+    "Constant": _constant,
+}
+
+
+def import_model(model_file):
+    """Import an .onnx file -> (sym, arg_params, aux_params)
+    (reference: _import/import_model.py:24)."""
+    with open(model_file, "rb") as f:
+        buf = f.read()
+    model = decode_message(buf)
+    graph_raw = model.get(_MODEL_GRAPH)
+    if graph_raw is None:
+        raise MXNetError("%s: no graph in ONNX model" % model_file)
+    return GraphProto().from_onnx(decode_message(graph_raw))

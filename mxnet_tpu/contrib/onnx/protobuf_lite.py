@@ -1,0 +1,170 @@
+"""Minimal protobuf wire-format codec for ONNX graphs.
+
+The image ships no `onnx` package (zero egress), so the importer decodes the
+ONNX protobuf directly: ModelProto/GraphProto/NodeProto/AttributeProto/
+TensorProto are plain proto2/3 messages and the wire format is stable.
+Field numbers follow onnx/onnx.proto (the public schema). The encoder half
+exists so tests can synthesize valid .onnx files without the package.
+"""
+from __future__ import annotations
+
+import struct
+
+__all__ = ["decode_message", "encode_message", "Msg"]
+
+_WT_VARINT, _WT_I64, _WT_LEN, _WT_I32 = 0, 1, 2, 5
+
+
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _write_varint(out, value):
+    if value < 0:
+        value += 1 << 64
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+class Msg:
+    """Decoded message: dict field_number -> list of raw values.
+    Varints come back as ints, length-delimited fields as bytes (decode
+    nested messages with another decode_message call)."""
+
+    def __init__(self):
+        self.fields = {}
+
+    def add(self, num, val):
+        self.fields.setdefault(num, []).append(val)
+
+    def get(self, num, default=None):
+        vals = self.fields.get(num)
+        return vals[0] if vals else default
+
+    def get_all(self, num):
+        return self.fields.get(num, [])
+
+    def get_str(self, num, default=""):
+        v = self.get(num)
+        return v.decode("utf-8") if isinstance(v, bytes) else (v or default)
+
+    def get_msg(self, num):
+        v = self.get(num)
+        return decode_message(v) if v is not None else None
+
+    def get_msgs(self, num):
+        return [decode_message(v) for v in self.get_all(num)]
+
+    def get_ints(self, num):
+        """Repeated int64: either packed (one bytes blob) or unpacked."""
+        out = []
+        for v in self.get_all(num):
+            if isinstance(v, bytes):
+                pos = 0
+                while pos < len(v):
+                    x, pos = _read_varint(v, pos)
+                    out.append(_signed64(x))
+            else:
+                out.append(_signed64(v))
+        return out
+
+    def get_floats(self, num):
+        """Repeated float: packed blob or individual fixed32 ints."""
+        out = []
+        for v in self.get_all(num):
+            if isinstance(v, bytes):
+                out.extend(struct.unpack("<%df" % (len(v) // 4), v))
+            else:
+                out.append(struct.unpack("<f", struct.pack("<I", v))[0])
+        return out
+
+    def get_float(self, num, default=0.0):
+        v = self.get(num)
+        if v is None:
+            return default
+        if isinstance(v, bytes):
+            return struct.unpack("<f", v[:4])[0]
+        return struct.unpack("<f", struct.pack("<I", v & 0xFFFFFFFF))[0]
+
+
+def _signed64(x):
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+def decode_message(buf):
+    msg = Msg()
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == _WT_VARINT:
+            val, pos = _read_varint(buf, pos)
+        elif wt == _WT_I64:
+            val = struct.unpack("<Q", buf[pos:pos + 8])[0]
+            pos += 8
+        elif wt == _WT_LEN:
+            ln, pos = _read_varint(buf, pos)
+            val = bytes(buf[pos:pos + ln])
+            pos += ln
+        elif wt == _WT_I32:
+            val = struct.unpack("<I", buf[pos:pos + 4])[0]
+            pos += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+        msg.add(field, val)
+    return msg
+
+
+def encode_message(fields):
+    """fields: list of (field_number, kind, value); kind in
+    {'varint','bytes','msg','float','floats','ints'}. 'msg' values are
+    nested field lists."""
+    out = bytearray()
+    for num, kind, value in fields:
+        if kind == "varint":
+            _write_varint(out, (num << 3) | _WT_VARINT)
+            _write_varint(out, int(value))
+        elif kind == "float":
+            _write_varint(out, (num << 3) | _WT_I32)
+            out += struct.pack("<f", float(value))
+        elif kind == "bytes":
+            if isinstance(value, str):
+                value = value.encode("utf-8")
+            _write_varint(out, (num << 3) | _WT_LEN)
+            _write_varint(out, len(value))
+            out += value
+        elif kind == "msg":
+            sub = encode_message(value)
+            _write_varint(out, (num << 3) | _WT_LEN)
+            _write_varint(out, len(sub))
+            out += sub
+        elif kind == "floats":  # packed repeated float
+            blob = struct.pack("<%df" % len(value), *value)
+            _write_varint(out, (num << 3) | _WT_LEN)
+            _write_varint(out, len(blob))
+            out += blob
+        elif kind == "ints":  # packed repeated varint
+            sub = bytearray()
+            for v in value:
+                _write_varint(sub, int(v) & ((1 << 64) - 1))
+            _write_varint(out, (num << 3) | _WT_LEN)
+            _write_varint(out, len(sub))
+            out += bytes(sub)
+        else:
+            raise ValueError("unknown kind %r" % kind)
+    return bytes(out)

@@ -29,6 +29,7 @@ class Executor:
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
         self._group2ctx = group2ctx  # sharding hint (reference: PlaceDevice pass)
+        self._group_shardings = None
 
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
@@ -71,6 +72,65 @@ class Executor:
         self._topo = [n for n in symbol._topo() if not n.is_variable]
         self._var_nodes = symbol._variables()
         self._aux_var_ids = symbol._aux_set()
+
+        if group2ctx:
+            self._group_shardings = self._build_group_shardings(group2ctx)
+
+    # ------------------------------------------------------------------
+    # group2ctx -> mesh sharding (TPU-native model parallelism)
+    # ------------------------------------------------------------------
+    def _build_group_shardings(self, group2ctx):
+        """Map ctx groups onto a model-parallel mesh axis.
+
+        The reference places each ctx group's ops on its own device
+        (PlaceDevice, graph_executor.cc:406) so a model too big for one
+        device spreads across several. The TPU-native form: one mesh axis
+        'mp' over the union of group devices; every grouped parameter is
+        sharded along its first mp-divisible axis, everything else is
+        replicated. XLA GSPMD then partitions the (single) program and
+        inserts the ICI collectives the reference's copy nodes imply —
+        the same memory scaling without host-visible placement.
+        """
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        devices, seen = [], set()
+        for c in group2ctx.values():
+            d = (c if isinstance(c, Context) else Context(c)).jax_device
+            if d.id not in seen:
+                seen.add(d.id)
+                devices.append(d)
+        if len(devices) < 2:
+            return None
+        mesh = Mesh(_np.asarray(devices), ("mp",))
+        repl = NamedSharding(mesh, PartitionSpec())
+        attrs = self._symbol.attr_dict()
+        shardings = {}
+        n = len(devices)
+        for name in (self._symbol.list_arguments()
+                     + self._symbol.list_auxiliary_states()):
+            group = attrs.get(name, {}).get("ctx_group")
+            spec = repl
+            if group is not None and group in group2ctx:
+                arr = self.arg_dict.get(name)
+                if arr is None:
+                    arr = self.aux_dict.get(name)
+                if arr is not None:
+                    for axis, dim in enumerate(arr.shape):
+                        if dim % n == 0 and dim >= n:
+                            parts = [None] * len(arr.shape)
+                            parts[axis] = "mp"
+                            spec = NamedSharding(mesh, PartitionSpec(*parts))
+                            break
+            shardings[name] = spec
+        shardings["__default__"] = repl
+        return shardings
+
+    def _apply_group_shardings(self, arg_vals, aux_vals):
+        sh = self._group_shardings
+        default = sh["__default__"]
+        return ({n: jax.device_put(v, sh.get(n, default))
+                 for n, v in arg_vals.items()},
+                {n: jax.device_put(v, sh.get(n, default))
+                 for n, v in aux_vals.items()})
 
     # ------------------------------------------------------------------
     def _normalize(self, arrays, names, what, allow_missing=False):
@@ -184,8 +244,15 @@ class Executor:
 
         arg_vals = {n: a._data for n, a in self.arg_dict.items()}
         aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        if self._group_shardings is not None:
+            arg_vals, aux_vals = self._apply_group_shardings(arg_vals, aux_vals)
         rng = _rnd.next_key()
 
+        from . import profiler as _prof
+        _profiling = _prof.is_running()
+        if _profiling:
+            import time as _time
+            _t0 = _time.perf_counter()
         if is_train and self._grad_names:
             grad_args = {n: arg_vals.pop(n) for n in self._grad_names}
             outs, aux_upd, grads = self._fb_fn(False)(grad_args, arg_vals,
@@ -194,6 +261,12 @@ class Executor:
         else:
             outs, aux_upd = self._fwd_fn(is_train)(arg_vals, aux_vals, rng)
             self._pending_grads = None
+        if _profiling:
+            jax.block_until_ready(outs)
+            _prof.record_op_event(
+                "graph_forward_backward" if (is_train and self._grad_names)
+                else "graph_forward",
+                _time.perf_counter() - _t0, category="executor")
         for name, val in aux_upd.items():
             self.aux_dict[name]._data = val
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]

@@ -153,44 +153,41 @@ class Block:
         return out
 
     def save_parameters(self, filename):
-        import numpy as np
-        import os
+        """Reference binary NDArray-list format (gluon/block.py save_params
+        → ndarray.save), interchangeable with reference-produced files."""
+        from ..ndarray.utils import save as _nd_save
         arrays = {}
         for key, p in self._structured_params().items():
             if p._data is not None:
-                arrays[key] = p.data().asnumpy()
-        np.savez(filename, **arrays)
-        if os.path.exists(filename + ".npz"):
-            os.replace(filename + ".npz", filename)
+                arrays[key] = p.data()
+        _nd_save(filename, arrays)
 
     save_params = save_parameters
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False):
-        import numpy as np
-        from ..ndarray.ndarray import array
-        loaded = np.load(filename, allow_pickle=False)
+        from ..ndarray.utils import load as _nd_load
+        loaded = _nd_load(filename)
         params = self._structured_params()
         if not allow_missing:
             for key in params:
-                if key not in loaded.files:
+                if key not in loaded:
                     raise MXNetError("Parameter %s is missing in file %s"
                                      % (key, filename))
-        for key in loaded.files:
+        for key, value in loaded.items():
             if key not in params:
                 if not ignore_extra:
                     raise MXNetError("Parameter %s in file %s is not present "
                                      "in this Block" % (key, filename))
                 continue
             p = params[key]
-            value = loaded[key]
             if p._data is None:
                 p._shape = value.shape
                 if p._deferred_init:
                     p._finish_deferred_init()
                 else:
                     p.initialize(ctx=ctx or [current_context()])
-            p.set_data(array(value))
+            p.set_data(value)
 
     load_params = load_parameters
 

@@ -344,7 +344,8 @@ class ParameterDict:
             setattr(p, name, value)
 
     def save(self, filename, strip_prefix=""):
-        import numpy as np
+        """Reference binary NDArray-list format (ndarray/utils.py save)."""
+        from ..ndarray.utils import save as _nd_save
         arg_dict = {}
         for param in self.values():
             weight = param.data() if param._data is not None else None
@@ -353,17 +354,14 @@ class ParameterDict:
             name = param.name
             if strip_prefix and name.startswith(strip_prefix):
                 name = name[len(strip_prefix):]
-            arg_dict[name] = weight.asnumpy()
-        np.savez(filename, **arg_dict)
-        import os
-        if os.path.exists(filename + ".npz"):
-            os.replace(filename + ".npz", filename)
+            arg_dict[name] = weight
+        _nd_save(filename, arg_dict)
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):
-        import numpy as np
-        loaded = np.load(filename, allow_pickle=False)
-        data = {restore_prefix + k: loaded[k] for k in loaded.files}
+        from ..ndarray.utils import load as _nd_load
+        loaded = _nd_load(filename)
+        data = {restore_prefix + k: v for k, v in loaded.items()}
         if not allow_missing:
             for name in self.keys():
                 if name not in data:
@@ -379,4 +377,4 @@ class ParameterDict:
             if param._data is None and not param._deferred_init:
                 param._shape = arr.shape
                 param.initialize(ctx=ctx or [current_context()])
-            param.set_data(array(arr))
+            param.set_data(arr)

@@ -154,7 +154,18 @@ def invoke_op(opdef, inputs, attrs, rng=None):
     def fn(*jax_in):
         return opdef.apply(params, jax_in, is_train=is_train, rng=rng)
 
-    outs = apply_fn(fn, inputs, n_out=None)
+    from . import profiler as _prof
+    if _prof.is_running():
+        # while profiling, block per op so the measurement is the real
+        # device time (reference engine measures op runtime on-thread)
+        import time as _time
+        import jax as _jax
+        t0 = _time.perf_counter()
+        outs = apply_fn(fn, inputs, n_out=None)
+        _jax.block_until_ready([o._data for o in outs])
+        _prof.record_op_event(opdef.name, _time.perf_counter() - t0)
+    else:
+        outs = apply_fn(fn, inputs, n_out=None)
     visible, aux_updates = outs[:n_vis], outs[n_vis:]
     return visible, aux_updates
 

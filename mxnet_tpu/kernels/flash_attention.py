@@ -30,7 +30,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["flash_attention", "blockwise_attention", "attention_with_lse"]
+__all__ = ["flash_attention", "blockwise_attention", "attention_with_lse",
+           "default_use_pallas"]
+
+
+def default_use_pallas():
+    """Single policy for kernel selection: Pallas on any TPU PJRT platform
+    (including experimental plugins whose backend NAME isn't 'tpu'),
+    provided the Pallas import succeeded."""
+    try:
+        return _HAS_PALLAS and jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
 
 _NEG_INF = -1e30
 
@@ -183,6 +194,134 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 
+# ---------------------------------------------------------------------------
+# offset-aware forward kernel (ring attention): q/k global offsets arrive as
+# scalar-prefetch values, output includes the lse so ring steps can merge
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_offs_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                           sm_scale, causal, block_k, kv_len):
+    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    block_q, d = q.shape
+    qi = pl.program_id(1)
+    q_off = offs_ref[0] + qi * block_q   # global query offset
+    k_base = offs_ref[1]                 # global key offset
+    nblk = kv_len // block_k
+
+    def body(i, carry):
+        acc, m_i, l_i = carry
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_off + lax.broadcasted_iota(jnp.int32,
+                                                 (block_q, block_k), 0)
+            k_pos = k_base + i * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
+        # rows with every key masked keep m == -inf; exp(s - m) would be
+        # exp(0) = 1 there, so mask p explicitly
+        p = jnp.where(s > _NEG_INF / 2,
+                      jnp.exp(s - m_new[:, None]), 0.0)
+        alpha = jnp.where(m_i > _NEG_INF / 2, jnp.exp(m_i - m_new), 0.0)
+        l_new = l_i * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m_i, l_i = lax.fori_loop(0, nblk, body, (acc0, m0, l0))
+    l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(l_i > 0.0, m_i + jnp.log(l_safe), _NEG_INF)
+
+
+def _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal, block_q, block_k,
+                           interpret=False):
+    """(out, lse) with dynamic global offsets; offs = int32[2]."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError("seq lengths must divide block sizes")
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    kernel = functools.partial(_flash_fwd_offs_kernel, sm_scale=sm_scale,
+                               causal=causal, block_k=block_k, kv_len=sk)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j, offs: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j, offs: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j, offs: (i, j)),
+        ],
+    )
+    # inside shard_map, outputs inherit the inputs' varying-mesh-axes type
+    try:
+        vma = jax.typeof(q).vma
+        out_shapes = [
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32, vma=vma),
+        ]
+    except (AttributeError, TypeError):
+        out_shapes = [
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ]
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(offs.astype(jnp.int32), qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def flash_attention_with_lse(q, k, v, offs, sm_scale, causal, block_q,
+                             block_k, interpret):
+    """Pallas fused (out, lse) attention with dynamic global offsets —
+    the ring-attention inner step. Backward recomputes blockwise
+    (FlashAttention-2 strategy) under jax.vjp."""
+    return _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal,
+                                  block_q, block_k, interpret)
+
+
+def _flash_lse_fwd_rule(q, k, v, offs, sm_scale, causal, block_q, block_k,
+                        interpret):
+    out = _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal,
+                                 block_q, block_k, interpret)
+    return out, (q, k, v, offs)
+
+
+def _flash_lse_bwd_rule(sm_scale, causal, block_q, block_k, interpret,
+                        res, cts):
+    q, k, v, offs = res
+
+    def f(q, k, v):
+        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                   block_k=block_k, q_offset=offs[0],
+                                   k_offset=offs[1])
+
+    _, vjp = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp(cts)
+    return dq, dk, dv, jnp.zeros_like(offs)
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
 def _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
                       interpret=False):
     b, h, sq, d = q.shape
@@ -259,7 +398,7 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     if sm_scale is None:
         sm_scale = 1.0 / _np.sqrt(q.shape[-1])
     if use_pallas is None:
-        use_pallas = _HAS_PALLAS and jax.default_backend() == "tpu"
+        use_pallas = default_use_pallas()
     ok_shapes = (q.shape[2] % min(block_q, q.shape[2]) == 0
                  and k.shape[2] % min(block_k, k.shape[2]) == 0)
     if use_pallas and ok_shapes:

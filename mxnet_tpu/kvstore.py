@@ -51,7 +51,9 @@ class KVStore:
         self._store = {}
         self._updater = None
         self._optimizer = None
-        self._compression = {}
+        from .gradient_compression import GradientCompression
+        self._gc = GradientCompression()
+        self._residuals = {}  # (key, device_slot) -> error-feedback residual
 
     # -- identity ----------------------------------------------------------
     @property
@@ -101,11 +103,32 @@ class KVStore:
             acc = acc + v._data  # XLA reduce; devices transfer via jax
         return NDArray(acc, ctx=vlist[0].context)
 
+    def _compress_vlist(self, k, vlist):
+        """Lossy 2-bit quantize/dequantize of each device grad before the
+        reduce (reference: CommDevice quantizes per-device copies on the
+        compressed path; error-feedback residual lives per (key, slot))."""
+        out = []
+        for slot, v in enumerate(vlist):
+            if isinstance(v, _sparse.BaseSparseNDArray):
+                out.append(v)  # reference skips compression for sparse
+                continue
+            rkey = (k, slot)
+            if rkey not in self._residuals:
+                self._residuals[rkey] = jnp.zeros(
+                    int(jnp.size(v._data)), jnp.float32)
+            recv, new_r = self._gc.compress_decompress(
+                v._data, self._residuals[rkey])
+            self._residuals[rkey] = new_r
+            out.append(NDArray(recv, ctx=v.context))
+        return out
+
     def push(self, key, value, priority=0):
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
             k = str(k)
+            if self._gc.active:
+                vlist = self._compress_vlist(k, vlist)
             merged = self._merge(vlist)
             merged = self._allreduce_across_workers(merged)
             if k not in self._store:
@@ -192,8 +215,10 @@ class KVStore:
         self.set_updater(opt_mod.get_updater(optimizer))
 
     def set_gradient_compression(self, compression_params):
-        """2-bit compression has no benefit on ICI allreduce; accepted + recorded."""
-        self._compression = dict(compression_params)
+        """Activate 2-bit error-feedback compression on the push path
+        (reference: kvstore.py set_gradient_compression →
+        gradient_compression.cc SetParams)."""
+        self._gc.set_params(compression_params)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
@@ -245,6 +270,18 @@ def create(name="local"):
     """reference: src/kvstore/kvstore.cc:40-77 substring dispatch."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
+    if "tpu" in name or "dist" in name:
+        # join the process group if a launcher provided one (launch.py env);
+        # must happen before first device use — workers launched via
+        # launch.py should call parallel.collectives.ensure_distributed()
+        # right after import, this is the safety net
+        from .parallel.collectives import ensure_distributed
+        try:
+            ensure_distributed()
+        except RuntimeError as e:  # backend already initialized
+            import logging
+            logging.warning("kvstore %s: jax.distributed init skipped: %s",
+                            name, e)
     if "tpu" in name:
         return KVStoreTPUSync()
     if "dist" in name:

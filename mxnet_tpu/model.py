@@ -89,25 +89,29 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None,
 
 
 def save_params(fname, arg_params, aux_params=None):
+    """Write `arg:`/`aux:`-prefixed params in the reference's legacy binary
+    NDArray-list format (ndarray/utils.py), so checkpoints interchange with
+    reference-produced `.params` files."""
+    from .ndarray.utils import save as _nd_save
     data = {}
     for k, v in arg_params.items():
-        data["arg:" + k] = v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
+        data["arg:" + k] = v if isinstance(v, NDArray) else array(_np.asarray(v))
     for k, v in (aux_params or {}).items():
-        data["aux:" + k] = v.asnumpy() if isinstance(v, NDArray) else _np.asarray(v)
-    _np.savez(fname, **data)
-    import os
-    if os.path.exists(fname + ".npz"):  # np.savez appends .npz
-        os.replace(fname + ".npz", fname)
+        data["aux:" + k] = v if isinstance(v, NDArray) else array(_np.asarray(v))
+    _nd_save(fname, data)
 
 
 def load_params(fname):
-    data = _np.load(fname, allow_pickle=False)
+    """Read a `.params` file (reference binary format; legacy npz archives
+    from earlier rounds of this repo still load)."""
+    from .ndarray.utils import load as _nd_load
+    data = _nd_load(fname)
     arg_params, aux_params = {}, {}
-    for k in data.files:
+    for k, v in data.items():
         if k.startswith("arg:"):
-            arg_params[k[4:]] = array(data[k])
+            arg_params[k[4:]] = v
         elif k.startswith("aux:"):
-            aux_params[k[4:]] = array(data[k])
+            aux_params[k[4:]] = v
     return arg_params, aux_params
 
 

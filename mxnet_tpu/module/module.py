@@ -342,6 +342,11 @@ class Module(BaseModule):
             return
         if self.inputs_need_grad or self._state_names or self._monitor:
             return
+        import jax as _jax
+        if _jax.process_count() > 1:
+            # multi-process goes through the kvstore allreduce path (the
+            # in-graph cross-host psum lives in parallel/collectives.py)
+            return
         if self._label_shapes is None:
             return
         from .. import optimizer as _opt

@@ -96,10 +96,11 @@ for _al, _target in [("fully_connected", "FullyConnected"), ("convolution", "Con
 from . import sparse  # noqa: E402  (CSRNDArray / RowSparseNDArray)
 from .sparse import CSRNDArray, RowSparseNDArray, BaseSparseNDArray  # noqa: E402
 from . import random  # noqa: E402
+from .utils import save, load  # noqa: E402  (legacy binary format)
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
            "concatenate", "moveaxis", "waitall", "sparse", "random",
-           "CSRNDArray", "RowSparseNDArray"] + list(_GENERATED)
+           "CSRNDArray", "RowSparseNDArray", "save", "load"] + list(_GENERATED)
 
 from ..ops.registry import make_internal_namespace as _min  # noqa: E402
 from ..ops.registry import make_contrib_namespace as _mcn  # noqa: E402

@@ -1,0 +1,246 @@
+"""NDArray list save/load in the reference's legacy binary format.
+
+Byte-compatible with the reference serializer
+(`/root/reference/src/ndarray/ndarray.cc:1591-1824`,
+`python/mxnet/ndarray/utils.py:222`): little-endian dmlc stream with
+
+  uint64 kMXAPINDArrayListMagic(0x112) | uint64 reserved
+  uint64 n_arrays | n * NDArray-V2
+  uint64 n_names  | n * (uint64 len + bytes)
+
+and each NDArray-V2 as
+
+  uint32 0xF993fac9 | int32 stype | [sparse: storage TShape]
+  TShape(uint32 ndim + int64*ndim) | int32 dev_type,int32 dev_id
+  | int32 type_flag | [sparse: per-aux int32 type + TShape]
+  | raw data | [sparse: raw aux data]
+
+so `.params` checkpoints interchange with reference-produced files in both
+directions (dense, row_sparse and csr).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["save", "load"]
+
+_LIST_MAGIC = 0x112
+_V1_MAGIC = 0xF993FAC8
+_V2_MAGIC = 0xF993FAC9
+
+# mshadow type flags <-> numpy dtypes
+_FLAG2DT = {0: _np.float32, 1: _np.float64, 2: _np.float16, 3: _np.uint8,
+            4: _np.int32, 5: _np.int8, 6: _np.int64}
+_DT2FLAG = {_np.dtype(v): k for k, v in _FLAG2DT.items()}
+
+_STYPE_DEFAULT, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+_STYPE2STR = {_STYPE_DEFAULT: "default", _STYPE_ROW_SPARSE: "row_sparse",
+              _STYPE_CSR: "csr"}
+
+
+def _w_shape(out, shape):
+    out.append(struct.pack("<I", len(shape)))
+    out.append(struct.pack("<%dq" % len(shape), *shape))
+
+
+def _r_shape(buf, pos):
+    (ndim,) = struct.unpack_from("<I", buf, pos)
+    pos += 4
+    dims = struct.unpack_from("<%dq" % ndim, buf, pos)
+    return tuple(int(d) for d in dims), pos + 8 * ndim
+
+
+def _save_one(out, arr):
+    """Serialize one NDArray (dense or sparse) as NDArray-V2."""
+    stype = getattr(arr, "stype", "default")
+    out.append(struct.pack("<I", _V2_MAGIC))
+    if len(getattr(arr, "shape", (1,))) == 0:
+        # ndim==0 means "None placeholder" in the reference format
+        # (ndarray.cc: is_none() stops after the shape) — a 0-d tensor
+        # cannot round-trip; reject instead of silently dropping the value
+        raise MXNetError("cannot save a 0-d NDArray in the legacy format; "
+                         "reshape to (1,) first")
+    if stype == "default":
+        data = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+        out.append(struct.pack("<i", _STYPE_DEFAULT))
+        _w_shape(out, data.shape)
+        out.append(struct.pack("<ii", 1, 0))  # Context: kCPU, id 0
+        flag = _DT2FLAG.get(data.dtype)
+        if flag is None:
+            data = data.astype(_np.float32)
+            flag = 0
+        out.append(struct.pack("<i", flag))
+        out.append(_np.ascontiguousarray(data).tobytes())
+        return
+    if stype == "row_sparse":
+        dat = arr.data.asnumpy()
+        idx = arr.indices.asnumpy().astype(_np.int64)
+        out.append(struct.pack("<i", _STYPE_ROW_SPARSE))
+        _w_shape(out, dat.shape)           # storage shape
+        _w_shape(out, arr.shape)           # logical shape
+        out.append(struct.pack("<ii", 1, 0))
+        out.append(struct.pack("<i", _DT2FLAG[dat.dtype]))
+        out.append(struct.pack("<i", 6))   # aux 0: int64 indices
+        _w_shape(out, idx.shape)
+        out.append(_np.ascontiguousarray(dat).tobytes())
+        out.append(_np.ascontiguousarray(idx).tobytes())
+        return
+    if stype == "csr":
+        dat = arr.data.asnumpy()
+        indptr = arr.indptr.asnumpy().astype(_np.int64)
+        idx = arr.indices.asnumpy().astype(_np.int64)
+        out.append(struct.pack("<i", _STYPE_CSR))
+        _w_shape(out, dat.shape)
+        _w_shape(out, arr.shape)
+        out.append(struct.pack("<ii", 1, 0))
+        out.append(struct.pack("<i", _DT2FLAG[dat.dtype]))
+        out.append(struct.pack("<i", 6))   # aux 0: indptr int64
+        _w_shape(out, indptr.shape)
+        out.append(struct.pack("<i", 6))   # aux 1: indices int64
+        _w_shape(out, idx.shape)
+        out.append(_np.ascontiguousarray(dat).tobytes())
+        out.append(_np.ascontiguousarray(indptr).tobytes())
+        out.append(_np.ascontiguousarray(idx).tobytes())
+        return
+    raise MXNetError("cannot serialize storage type %r" % stype)
+
+
+def _load_one(buf, pos):
+    """Deserialize one NDArray; returns (NDArray, new_pos)."""
+    from .ndarray import array as _dense_array
+    (magic,) = struct.unpack_from("<I", buf, pos)
+    pos += 4
+    stype = _STYPE_DEFAULT
+    sshape = None
+    if magic == _V2_MAGIC:
+        (stype,) = struct.unpack_from("<i", buf, pos)
+        pos += 4
+        nad = {_STYPE_DEFAULT: 0, _STYPE_ROW_SPARSE: 1, _STYPE_CSR: 2}.get(stype)
+        if nad is None:
+            raise MXNetError("unknown storage type %d in file" % stype)
+        if nad > 0:
+            sshape, pos = _r_shape(buf, pos)
+        shape, pos = _r_shape(buf, pos)
+    elif magic == _V1_MAGIC:
+        nad = 0
+        shape, pos = _r_shape(buf, pos)
+    else:
+        # pre-V1 legacy: magic itself is ndim, dims are uint32
+        ndim = magic
+        dims = struct.unpack_from("<%dI" % ndim, buf, pos)
+        shape = tuple(int(d) for d in dims)
+        pos += 4 * ndim
+        nad = 0
+    if len(shape) == 0:
+        return _dense_array(_np.zeros((0,), _np.float32)), pos
+    pos += 8  # Context (dev_type, dev_id) — always load to our device
+    (type_flag,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    if type_flag not in _FLAG2DT:
+        raise MXNetError("unknown dtype flag %d in file" % type_flag)
+    dtype = _np.dtype(_FLAG2DT[type_flag])
+
+    aux = []
+    for _ in range(nad):
+        (aux_flag,) = struct.unpack_from("<i", buf, pos)
+        pos += 4
+        ashape, pos = _r_shape(buf, pos)
+        aux.append((_np.dtype(_FLAG2DT[aux_flag]), ashape))
+
+    data_shape = sshape if nad > 0 else shape
+    nbytes = int(_np.prod(data_shape)) * dtype.itemsize if data_shape else \
+        dtype.itemsize
+    data = _np.frombuffer(buf, dtype=dtype, count=max(
+        int(_np.prod(data_shape)), 0), offset=pos).reshape(data_shape)
+    pos += nbytes
+    aux_data = []
+    for adt, ashape in aux:
+        cnt = int(_np.prod(ashape)) if ashape else 1
+        aux_data.append(_np.frombuffer(buf, dtype=adt, count=cnt,
+                                       offset=pos).reshape(ashape))
+        pos += cnt * adt.itemsize
+
+    if stype == _STYPE_DEFAULT:
+        return _dense_array(data.copy()), pos
+    from . import sparse as _sp
+    if stype == _STYPE_ROW_SPARSE:
+        return _sp.row_sparse_array((data.copy(), aux_data[0].copy()),
+                                    shape=shape, dtype=dtype), pos
+    # CSR aux order in the file: aux0=indptr, aux1=indices
+    return _sp.csr_matrix((data.copy(), aux_data[1].copy(),
+                           aux_data[0].copy()), shape=shape,
+                          dtype=dtype), pos
+
+
+def save(fname, data):
+    """Save a list or str->NDArray dict in the reference binary format
+    (reference: python/mxnet/ndarray/utils.py:222 save)."""
+    from .ndarray import NDArray
+    from .sparse import BaseSparseNDArray
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = list(data.values())
+    elif isinstance(data, (list, tuple)):
+        names = []
+        arrays = list(data)
+    else:
+        raise TypeError("save expects dict/list/NDArray, got %r" % type(data))
+    for a in arrays:
+        if not isinstance(a, (NDArray, BaseSparseNDArray, _np.ndarray)):
+            raise TypeError("cannot save %r" % type(a))
+    out = [struct.pack("<QQ", _LIST_MAGIC, 0),
+           struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        _save_one(out, a)
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        nb = n.encode("utf-8")
+        out.append(struct.pack("<Q", len(nb)))
+        out.append(nb)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+
+
+def load(fname):
+    """Load the reference binary format; returns a list (unnamed) or a dict
+    (named). npz archives written by earlier versions of this repo are
+    detected and still loaded."""
+    with open(fname, "rb") as f:
+        buf = f.read()
+    if buf[:4] in (b"PK\x03\x04", b"\x93NUM"):  # npz / npy fallback
+        return _load_npz(fname)
+    if len(buf) < 24:
+        raise MXNetError("%s: not an NDArray file" % fname)
+    header, _res, n = struct.unpack_from("<QQQ", buf, 0)
+    if header != _LIST_MAGIC:
+        raise MXNetError("%s: bad NDArray list magic 0x%x" % (fname, header))
+    pos = 24
+    arrays = []
+    for _ in range(n):
+        arr, pos = _load_one(buf, pos)
+        arrays.append(arr)
+    (n_names,) = struct.unpack_from("<Q", buf, pos)
+    pos += 8
+    names = []
+    for _ in range(n_names):
+        (ln,) = struct.unpack_from("<Q", buf, pos)
+        pos += 8
+        names.append(buf[pos:pos + ln].decode("utf-8"))
+        pos += ln
+    if n_names == 0:
+        return arrays
+    if n_names != n:
+        raise MXNetError("%s: %d names for %d arrays" % (fname, n_names, n))
+    return dict(zip(names, arrays))
+
+
+def _load_npz(fname):
+    from .ndarray import array as _dense_array
+    data = _np.load(fname, allow_pickle=False)
+    return {k: _dense_array(data[k]) for k in data.files}

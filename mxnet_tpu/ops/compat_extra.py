@@ -1,0 +1,582 @@
+"""Catalog-completing ops + legacy alias registrations.
+
+Reference anchors: src/operator/contrib/psroi_pooling.cc, proposal_target
+(rcnn), src/operator/identity_attach_KL_sparse_reg.cc, batch_take /
+reshape_like / softmax_cross_entropy (src/operator/tensor/), _eye
+(init_op.cc), image ops (src/operator/image/image_random.cc), ftml_update
+(src/operator/optimizer_op.cc), the _slice_assign/_scatter family
+(tensor/matrix_op.cc, tensor/indexing_op.cc), bipartite matching
+(contrib/bounding_box.cc), and the capitalized/v1 alias surface kept by the
+reference for backward compatibility.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import Params, param_field, np_dtype, MXNetError
+from .registry import register_op, OPS, _ALIASES
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling (contrib/psroi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+class PSROIPoolParam(Params):
+    spatial_scale = param_field(float, required=True)
+    output_dim = param_field(int, required=True)
+    pooled_size = param_field(int, required=True)
+    group_size = param_field(int, default=0)
+
+
+@register_op("_contrib_PSROIPooling", param_cls=PSROIPoolParam,
+             input_names=("data", "rois"), aliases=("_contrib_psroi_pooling",))
+def _psroi_pooling(params, data, rois):
+    """Position-sensitive ROI average pooling: bin (i,j) of roi r averages
+    channel block od*(gy*gs+gx) over the bin's pixels."""
+    k = params.pooled_size
+    gs = params.group_size or k
+    od = params.output_dim
+    scale = params.spatial_scale
+    N, C, H, W = data.shape
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        img = data[roi[0].astype(jnp.int32)]
+        x1 = jnp.round(roi[1]) * scale
+        y1 = jnp.round(roi[2]) * scale
+        x2 = (jnp.round(roi[3]) + 1.0) * scale
+        y2 = (jnp.round(roi[4]) + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / k, rw / k
+        iy = jnp.arange(k, dtype=jnp.float32)
+        ystart = jnp.floor(y1 + iy * bh)
+        yend = jnp.ceil(y1 + (iy + 1) * bh)
+        xstart = jnp.floor(x1 + iy * bw)
+        xend = jnp.ceil(x1 + (iy + 1) * bw)
+        ymask = (ys[None] >= ystart[:, None]) & (ys[None] < yend[:, None])
+        xmask = (xs[None] >= xstart[:, None]) & (xs[None] < xend[:, None])
+        mask = (ymask[:, None, :, None] & xmask[None, :, None, :]).astype(
+            data.dtype)  # [k,k,H,W]
+        counts = jnp.maximum(mask.sum(axis=(-1, -2)), 1.0)
+        sums = jnp.einsum("chw,ijhw->cij", img, mask)
+        avg = sums / counts[None]                       # [C,k,k]
+        gi = jnp.clip((jnp.arange(k) * gs) // k, 0, gs - 1)
+        sel = gi[:, None] * gs + gi[None, :]            # [k,k]
+        avg = avg.reshape(od, gs * gs, k, k)
+        return jnp.take_along_axis(avg, sel[None, None], axis=1)[:, 0]
+
+    return jax.vmap(one_roi)(rois).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# ProposalTarget (rcnn training: sample rois, assign labels + bbox targets)
+# ---------------------------------------------------------------------------
+
+
+class ProposalTargetParam(Params):
+    num_classes = param_field(int, required=True)
+    batch_images = param_field(int, required=True)
+    batch_rois = param_field(int, required=True)
+    fg_fraction = param_field(float, default=0.25)
+    fg_overlap = param_field(float, default=0.5)
+    box_stds = param_field(tuple, default=(0.1, 0.1, 0.2, 0.2))
+
+
+@register_op("_contrib_ProposalTarget", param_cls=ProposalTargetParam,
+             input_names=("rois", "gt_boxes"), num_outputs=4, need_rng=True,
+             output_names=("rois_output", "label", "bbox_target",
+                           "bbox_weight"),
+             aliases=("_contrib_proposal_target", "ProposalTarget"))
+def _proposal_target(params, rois, gt_boxes, rng=None):
+    """rois [R,5]; gt_boxes [G,5]=(x1,y1,x2,y2,cls). Samples batch_rois
+    proposals (fg_fraction foreground), emitting per-roi class labels and
+    bbox regression targets (reference rcnn proposal_target.py semantics)."""
+    R = rois.shape[0]
+    n_out = params.batch_rois
+    n_fg_max = int(round(params.fg_fraction * n_out))
+    boxes = rois[:, 1:5]
+    gt = gt_boxes[:, :4]
+    gt_cls = gt_boxes[:, 4]
+    valid_gt = (gt_boxes[:, 2] > gt_boxes[:, 0])
+
+    ix1 = jnp.maximum(boxes[:, 0:1], gt[None, :, 0])
+    iy1 = jnp.maximum(boxes[:, 1:2], gt[None, :, 1])
+    ix2 = jnp.minimum(boxes[:, 2:3], gt[None, :, 2])
+    iy2 = jnp.minimum(boxes[:, 3:4], gt[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + 1.0, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + 1.0, 0.0)
+    inter = iw * ih
+    area_r = ((boxes[:, 2] - boxes[:, 0] + 1.0)
+              * (boxes[:, 3] - boxes[:, 1] + 1.0))
+    area_g = (gt[:, 2] - gt[:, 0] + 1.0) * (gt[:, 3] - gt[:, 1] + 1.0)
+    iou = inter / (area_r[:, None] + area_g[None, :] - inter)
+    iou = jnp.where(valid_gt[None, :], iou, -1.0)
+    best_iou = iou.max(axis=1)
+    best_gt = iou.argmax(axis=1)
+
+    is_fg = best_iou >= params.fg_overlap
+    # randomized priority sampling: fg first (shuffled), then bg
+    u = jax.random.uniform(rng if rng is not None else jax.random.PRNGKey(0),
+                           (R,))
+    fg_rank = jnp.where(is_fg, u, 2.0)
+    _, fg_order = lax.top_k(-fg_rank, R)   # shuffled fg first
+    bg_rank = jnp.where(is_fg, 2.0, u)
+    _, bg_order = lax.top_k(-bg_rank, R)   # shuffled bg first
+    n_fg = jnp.minimum(is_fg.sum(), n_fg_max)
+    # output slot s takes the s-th fg pick while s < n_fg, then bg picks
+    slot = jnp.arange(n_out)
+    bg_idx = jnp.clip(slot - n_fg, 0, R - 1)
+    sel = jnp.where(slot < n_fg,
+                    jnp.pad(fg_order, (0, max(0, n_out)))[
+                        jnp.clip(slot, 0, R - 1)],
+                    jnp.pad(bg_order, (0, max(0, n_out)))[bg_idx])
+    sel = jnp.clip(sel, 0, R - 1)
+
+    out_rois = rois[sel]
+    fg_sel = slot < n_fg
+    label = jnp.where(fg_sel, gt_cls[best_gt[sel]], 0.0)
+
+    # bbox regression targets for the matched gt, class-specific layout
+    b = boxes[sel]
+    g = gt[best_gt[sel]]
+    bw = b[:, 2] - b[:, 0] + 1.0
+    bh = b[:, 3] - b[:, 1] + 1.0
+    bcx = b[:, 0] + 0.5 * (bw - 1)
+    bcy = b[:, 1] + 0.5 * (bh - 1)
+    gw = g[:, 2] - g[:, 0] + 1.0
+    gh = g[:, 3] - g[:, 1] + 1.0
+    gcx = g[:, 0] + 0.5 * (gw - 1)
+    gcy = g[:, 1] + 0.5 * (gh - 1)
+    stds = jnp.asarray(params.box_stds)
+    t = jnp.stack([(gcx - bcx) / bw, (gcy - bcy) / bh,
+                   jnp.log(gw / bw), jnp.log(gh / bh)], axis=1) / stds
+    K = params.num_classes
+    tgt = jnp.zeros((n_out, 4 * K))
+    wgt = jnp.zeros((n_out, 4 * K))
+    cls_idx = label.astype(jnp.int32)
+    col = cls_idx[:, None] * 4 + jnp.arange(4)[None, :]
+    rowi = jnp.arange(n_out)[:, None]
+    tgt = tgt.at[rowi, col].set(jnp.where(fg_sel[:, None], t, 0.0))
+    wgt = wgt.at[rowi, col].set(jnp.where(fg_sel[:, None], 1.0, 0.0))
+    return out_rois, label, tgt, wgt
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg (identity_attach_KL_sparse_reg.cc)
+# ---------------------------------------------------------------------------
+
+
+class KLSparseRegParam(Params):
+    sparseness_target = param_field(float, default=0.1)
+    penalty = param_field(float, default=0.001)
+    momentum = param_field(float, default=0.9)
+
+
+@register_op("IdentityAttachKLSparseReg", param_cls=KLSparseRegParam,
+             input_names=("data",), aux_names=("moving_avg",))
+def _identity_attach_kl_sparse_reg(params, data, moving_avg):
+    """Identity forward; backward adds the KL sparsity penalty gradient
+    penalty * (-rho/rho_hat + (1-rho)/(1-rho_hat)) using the momentum-
+    averaged activation mean rho_hat (the aux state)."""
+    rho = params.sparseness_target
+    penalty = params.penalty
+    mom = params.momentum
+    rho_hat = jnp.mean(data, axis=0)
+    new_avg = mom * moving_avg + (1 - mom) * rho_hat
+
+    @jax.custom_vjp
+    def f(x, avg):
+        return x
+
+    def fwd(x, avg):
+        return x, (avg,)
+
+    def bwd(res, g):
+        (avg,) = res
+        a = jnp.clip(avg, 1e-6, 1 - 1e-6)
+        reg = penalty * (-rho / a + (1 - rho) / (1 - a))
+        return g + reg[None, :], jnp.zeros_like(avg)
+
+    f.defvjp(fwd, bwd)
+    return f(data, new_avg), new_avg
+
+
+# ---------------------------------------------------------------------------
+# small tensor ops
+# ---------------------------------------------------------------------------
+
+
+@register_op("batch_take", input_names=("a", "indices"))
+def _batch_take(params, a, indices):
+    """out[i] = a[i, indices[i]] (tensor/indexing_op.cc batch_take)."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1)[:, 0]
+
+
+@register_op("reshape_like", input_names=("lhs", "rhs"))
+def _reshape_like(params, lhs, rhs):
+    return lhs.reshape(rhs.shape)
+
+
+class SoftmaxCEParam(Params):
+    pass
+
+
+@register_op("softmax_cross_entropy", param_cls=SoftmaxCEParam,
+             input_names=("data", "label"))
+def _softmax_cross_entropy(params, data, label):
+    """Scalar summed CE between softmax(data) and integer labels
+    (loss_binary_op.cc)."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, label.astype(jnp.int32).reshape(-1, 1), axis=1)
+    return -picked.sum().reshape((1,))
+
+
+class EyeParam(Params):
+    N = param_field(int, required=True)
+    M = param_field(int, default=0)
+    k = param_field(int, default=0)
+    dtype = param_field(str, default="float32")
+    ctx = param_field(str, default=None)
+
+
+@register_op("_eye", param_cls=EyeParam, input_names=(), aliases=("eye",))
+def _eye(params, ):
+    M = params.M or params.N
+    return jnp.eye(params.N, M, k=params.k, dtype=np_dtype(params.dtype))
+
+
+@register_op("_grad_add", input_names=("lhs", "rhs"))
+def _grad_add(params, lhs, rhs):
+    return lhs + rhs
+
+
+@register_op("_identity_with_attr_like_rhs", input_names=("lhs", "rhs"))
+def _identity_with_attr_like_rhs(params, lhs, rhs):
+    return lhs
+
+
+@register_op("sparse_retain", input_names=("data", "indices"))
+def _sparse_retain_op(params, data, indices):
+    """Keep only the given rows, zero the rest (tensor/sparse_retain.cc;
+    dense formulation of the rsp kernel)."""
+    keep = jnp.zeros((data.shape[0],), bool).at[
+        indices.astype(jnp.int32)].set(True, mode="drop")
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+class CastStorageParam(Params):
+    stype = param_field(str, required=True)
+
+
+@register_op("cast_storage", param_cls=CastStorageParam,
+             input_names=("data",))
+def _cast_storage(params, data):
+    """Storage casts are an NDArray-level concept (XLA computes dense);
+    the op keeps API parity and is the identity on values."""
+    return data
+
+
+# ---------------------------------------------------------------------------
+# image ops (src/operator/image/image_random.cc)
+# ---------------------------------------------------------------------------
+
+
+class ImageNormalizeParam(Params):
+    mean = param_field(tuple, default=(0.0,))
+    std = param_field(tuple, default=(1.0,))
+
+
+@register_op("_image_normalize", param_cls=ImageNormalizeParam,
+             input_names=("data",))
+def _image_normalize(params, data):
+    """(data - mean) / std over the leading channel axis (CHW)."""
+    mean = jnp.asarray(params.mean, data.dtype)
+    std = jnp.asarray(params.std, data.dtype)
+    shape = (-1,) + (1,) * (data.ndim - 1)
+    return (data - mean.reshape(shape)) / std.reshape(shape)
+
+
+@register_op("_image_to_tensor", input_names=("data",))
+def _image_to_tensor(params, data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+    out = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return out.transpose(2, 0, 1)
+    return out.transpose(0, 3, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# ftml_update (optimizer_op.cc) — Follow The Moving Leader
+# ---------------------------------------------------------------------------
+
+
+class FTMLParam(Params):
+    lr = param_field(float, required=True)
+    beta1 = param_field(float, default=0.6)
+    beta2 = param_field(float, default=0.999)
+    epsilon = param_field(float, default=1e-8)
+    t = param_field(int, required=True)
+    wd = param_field(float, default=0.0)
+    rescale_grad = param_field(float, default=1.0)
+    clip_grad = param_field(float, default=-1.0)
+
+
+@register_op("ftml_update", param_cls=FTMLParam,
+             input_names=("weight", "grad", "d", "v", "z"), num_outputs=4,
+             output_names=("out", "d_out", "v_out", "z_out"))
+def _ftml_update(params, weight, grad, d, v, z):
+    """FTML (Zheng & Kwok 2017; reference optimizer_op.cc ftml_update)."""
+    b1, b2, eps, t = params.beta1, params.beta2, params.epsilon, params.t
+    g = grad * params.rescale_grad + params.wd * weight
+    if params.clip_grad > 0:
+        g = jnp.clip(g, -params.clip_grad, params.clip_grad)
+    v_t = b2 * v + (1 - b2) * g * g
+    d_t = (1 - b1 ** t) / params.lr * (
+        jnp.sqrt(v_t / (1 - b2 ** t)) + eps)
+    sigma_t = d_t - b1 * d
+    z_t = b1 * z + (1 - b1) * g - sigma_t * weight
+    w_t = -z_t / d_t
+    return w_t, d_t, v_t, z_t
+
+
+# ---------------------------------------------------------------------------
+# slice/scatter assign family (tensor/matrix_op.cc _slice_assign,
+# tensor/indexing_op.cc _scatter_set_nd; _crop_assign is the legacy alias)
+# ---------------------------------------------------------------------------
+
+
+class SliceAssignParam(Params):
+    begin = param_field(tuple, required=True)
+    end = param_field(tuple, required=True)
+    step = param_field(tuple, default=())
+
+
+def _slice_tuple(params, shape):
+    sl = []
+    step = params.step or (None,) * len(params.begin)
+    for b, e, s, dim in zip(params.begin, params.end, step, shape):
+        sl.append(slice(b, e, s))
+    return tuple(sl)
+
+
+@register_op("_slice_assign", input_names=("lhs", "rhs"),
+             param_cls=SliceAssignParam, aliases=("_crop_assign",))
+def _slice_assign(params, lhs, rhs):
+    return lhs.at[_slice_tuple(params, lhs.shape)].set(rhs)
+
+
+class SliceAssignScalarParam(SliceAssignParam):
+    scalar = param_field(float, default=0.0)
+
+
+@register_op("_slice_assign_scalar", input_names=("data",),
+             param_cls=SliceAssignScalarParam,
+             aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(params, data):
+    return data.at[_slice_tuple(params, data.shape)].set(
+        jnp.asarray(params.scalar, data.dtype))
+
+
+class ScatterNDParam(Params):
+    shape = param_field(tuple, required=True)
+
+
+@register_op("_scatter_set_nd", input_names=("lhs", "rhs", "indices"),
+             param_cls=ScatterNDParam)
+def _scatter_set_nd(params, lhs, rhs, indices):
+    """lhs with lhs[indices] = rhs (gather_nd's inverse; indices [K, M])."""
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+class ScatterScalarParam(Params):
+    scalar = param_field(float, default=0.0)
+
+
+@register_op("_scatter_plus_scalar", input_names=("data",),
+             param_cls=ScatterScalarParam)
+def _scatter_plus_scalar(params, data):
+    """Sparse-aware scalar add: on TPU values are dense, so this is
+    elementwise (nonzero-structure preservation is an rsp storage notion)."""
+    return data + jnp.asarray(params.scalar, data.dtype)
+
+
+@register_op("_scatter_minus_scalar", input_names=("data",),
+             param_cls=ScatterScalarParam)
+def _scatter_minus_scalar(params, data):
+    return data - jnp.asarray(params.scalar, data.dtype)
+
+
+@register_op("_scatter_elemwise_div", input_names=("lhs", "rhs"))
+def _scatter_elemwise_div(params, lhs, rhs):
+    return lhs / rhs
+
+
+# ---------------------------------------------------------------------------
+# bipartite matching (contrib/bounding_box.cc bipartite_matching)
+# ---------------------------------------------------------------------------
+
+
+class BipartiteMatchingParam(Params):
+    threshold = param_field(float, required=True)
+    is_ascend = param_field(bool, default=False)
+    topk = param_field(int, default=-1)
+
+
+@register_op("_contrib_bipartite_matching", param_cls=BipartiteMatchingParam,
+             input_names=("data",), num_outputs=2,
+             output_names=("row_ids", "col_ids"))
+def _bipartite_matching(params, data):
+    """Greedy bipartite matching on score matrix [..., N, M]: repeatedly
+    take the globally best remaining pair. Returns per-row matched col
+    (row_ids [...,N]) and per-col matched row (col_ids [...,M]); -1 = no
+    match."""
+    sign = -1.0 if params.is_ascend else 1.0
+
+    def match(mat):
+        N, M = mat.shape
+        n_iter = min(N, M) if params.topk < 0 else min(params.topk, N, M)
+        big_neg = -jnp.inf
+
+        def body(_, state):
+            scores, rows, cols = state
+            flat = scores.reshape(-1)
+            best = jnp.argmax(flat)
+            val = flat[best]
+            r, c = best // M, best % M
+            ok = (val * 1.0) > big_neg
+            if params.is_ascend:
+                passes = (-val) <= params.threshold
+            else:
+                passes = val >= params.threshold
+            do = ok & passes
+            rows = jnp.where(do, rows.at[r].set(c.astype(rows.dtype)), rows)
+            cols = jnp.where(do, cols.at[c].set(r.astype(cols.dtype)), cols)
+            scores = jnp.where(do, scores.at[r, :].set(big_neg), scores)
+            scores = jnp.where(do, scores.at[:, c].set(big_neg), scores)
+            return scores, rows, cols
+
+        init = (mat * sign, jnp.full((N,), -1.0), jnp.full((M,), -1.0))
+        _, rows, cols = lax.fori_loop(0, n_iter, body, init)
+        return rows, cols
+
+    batch_shape = data.shape[:-2]
+    flat = data.reshape((-1,) + data.shape[-2:])
+    rows, cols = jax.vmap(match)(flat)
+    return (rows.reshape(batch_shape + rows.shape[-1:]),
+            cols.reshape(batch_shape + cols.shape[-1:]))
+
+
+# ---------------------------------------------------------------------------
+# scalar-param generalized negative binomial (sample_op.cc)
+# ---------------------------------------------------------------------------
+
+
+class GenNegBinParam(Params):
+    mu = param_field(float, default=1.0)
+    alpha = param_field(float, default=1.0)
+    shape = param_field(tuple, default=())
+    dtype = param_field(str, default="float32")
+    ctx = param_field(str, default=None)
+
+
+@register_op("_random_generalized_negative_binomial",
+             aliases=("random_generalized_negative_binomial",),
+             param_cls=GenNegBinParam, input_names=(), need_rng=True)
+def _random_gen_neg_binomial(params, rng=None):
+    a = max(params.alpha, 1e-6)
+    lam = jax.random.gamma(rng, 1.0 / a, params.shape) * params.mu * a
+    return jax.random.poisson(jax.random.fold_in(rng, 1), lam).astype(
+        np_dtype(params.dtype))
+
+
+@register_op("_hypot_scalar", input_names=("data",),
+             param_cls=ScatterScalarParam)
+def _hypot_scalar(params, data):
+    return jnp.hypot(data, jnp.asarray(params.scalar, data.dtype))
+
+
+class BroadcastAxisParam(Params):
+    axis = param_field(tuple, default=())
+    size = param_field(tuple, default=())
+
+
+@register_op("broadcast_axis", param_cls=BroadcastAxisParam,
+             input_names=("data",))
+def _broadcast_axis(params, data):
+    """Broadcast size-1 axes to the given sizes (tensor/broadcast_reduce_op)."""
+    axes = params.axis if isinstance(params.axis, tuple) else (params.axis,)
+    sizes = params.size if isinstance(params.size, tuple) else (params.size,)
+    tgt = list(data.shape)
+    for ax, sz in zip(axes, sizes):
+        tgt[int(ax)] = int(sz)
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+# ---------------------------------------------------------------------------
+# legacy alias surface (reference keeps these registered for old graphs)
+# ---------------------------------------------------------------------------
+
+_COMPAT_ALIASES = {
+    # capitalized scalar/broadcast aliases (reference elemwise registrations)
+    "_PlusScalar": "_plus_scalar", "_MinusScalar": "_minus_scalar",
+    "_RMinusScalar": "_rminus_scalar", "_MulScalar": "_mul_scalar",
+    "_DivScalar": "_div_scalar", "_RDivScalar": "_rdiv_scalar",
+    "_PowerScalar": "_power_scalar", "_RPowerScalar": "_rpower_scalar",
+    "_ModScalar": "_mod_scalar", "_RModScalar": "_rmod_scalar",
+    "_MaximumScalar": "_maximum_scalar", "_MinimumScalar": "_minimum_scalar",
+    "_EqualScalar": "_equal_scalar", "_GreaterScalar": "_greater_scalar",
+    "_GreaterEqualScalar": "_greater_equal_scalar",
+    "_LesserScalar": "_lesser_scalar",
+    "_LesserEqualScalar": "_lesser_equal_scalar",
+    "_NotEqualScalar": "_not_equal_scalar",
+    "_Equal": "_equal", "_Not_Equal": "_not_equal", "_Greater": "_greater",
+    "_Greater_Equal": "_greater_equal", "_Lesser": "_lesser",
+    "_Lesser_Equal": "_lesser_equal", "_Mod": "_mod",
+    "_Hypot": "_hypot", "_HypotScalar": "_hypot_scalar",
+    # v1 legacy ops resolve to the current kernels
+    "BatchNorm_v1": "BatchNorm", "Convolution_v1": "Convolution",
+    "Pooling_v1": "Pooling", "ROIPooling_v1": "ROIPooling",
+    # linalg underscore-internal names
+    "_linalg_gemm": "linalg_gemm", "_linalg_gemm2": "linalg_gemm2",
+    "_linalg_potrf": "linalg_potrf", "_linalg_potri": "linalg_potri",
+    "_linalg_trmm": "linalg_trmm", "_linalg_trsm": "linalg_trsm",
+    "_linalg_sumlogdiag": "linalg_sumlogdiag",
+    "_linalg_syrk": "linalg_syrk", "_linalg_syevd": "linalg_syevd",
+    "_linalg_gelqf": "linalg_gelqf",
+    # contrib alternates
+    "_contrib_ROIAlign_v2": "_contrib_ROIAlign",
+    "_contrib_box_non_maximum_suppression": "_contrib_box_nms",
+    "_contrib_SparseEmbedding": "Embedding",
+    # sparse-storage dispatch names (values are dense on TPU)
+    "_sparse_retain": "sparse_retain",
+    "_sparse_cast_storage": "cast_storage",
+    "_sparse_dot": "dot",
+    "_sparse_zeros_like": "zeros_like",
+    "broadcast_axes": "broadcast_axis",
+}
+
+
+def _register_compat_aliases():
+    from .registry import find_op
+    missing_targets = []
+    for alias, target in _COMPAT_ALIASES.items():
+        if find_op(target) is None:
+            missing_targets.append((alias, target))
+            continue
+        if alias not in OPS and alias not in _ALIASES:
+            real = target if target in OPS else _ALIASES[target]
+            _ALIASES[alias] = real
+    if missing_targets:
+        raise MXNetError("compat aliases with no target: %r" % missing_targets)
+
+
+_register_compat_aliases()

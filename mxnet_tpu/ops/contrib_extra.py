@@ -1,0 +1,480 @@
+"""Contrib detection/math ops: deformable convolution, deformable PSROI
+pooling, ROIAlign, Proposal/MultiProposal, count_sketch, khatri_rao.
+
+Reference: src/operator/contrib/{deformable_convolution.cc,
+deformable_psroi_pooling.cc, proposal.cc, multi_proposal.cc, roi_align*.,
+count_sketch.cc, krprod.cc}.
+
+TPU formulation notes:
+- deformable conv = bilinear gather at offset-shifted kernel taps (a batched
+  gather XLA vectorizes) + one big tensordot onto the MXU — no im2col buffer.
+- NMS runs as a fixed-trip lax.fori_loop over the top-k candidates with a
+  keep mask (static shapes; the reference's early-exit CPU loop is
+  data-dependent and untileable).
+- count_sketch is a segment_sum (scatter-add) over hash buckets.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import Params, param_field, MXNetError
+from .registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# bilinear sampling helper (zero outside the image, matching the reference
+# deformable im2col_bilinear / ROIAlign interpolation)
+# ---------------------------------------------------------------------------
+
+def _bilinear_gather(img, ys, xs):
+    """img [C,H,W]; ys/xs broadcastable float arrays of sample coords.
+    Returns [C, *ys.shape]; samples outside [0,H-1]x[0,W-1] are 0."""
+    H, W = img.shape[-2:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    y1 = y0 + 1
+    x1 = x0 + 1
+    wy1 = ys - y0
+    wx1 = xs - x0
+    wy0 = 1.0 - wy1
+    wx0 = 1.0 - wx1
+    valid = (ys > -1.0) & (ys < H) & (xs > -1.0) & (xs < W)
+
+    def tap(yc, xc, w):
+        inb = (yc >= 0) & (yc < H) & (xc >= 0) & (xc < W)
+        yi = jnp.clip(yc, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xc, 0, W - 1).astype(jnp.int32)
+        v = img[:, yi, xi]  # [C, *coords]
+        return v * (w * inb).astype(img.dtype)
+
+    out = (tap(y0, x0, wy0 * wx0) + tap(y0, x1, wy0 * wx1)
+           + tap(y1, x0, wy1 * wx0) + tap(y1, x1, wy1 * wx1))
+    return out * valid.astype(img.dtype)
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution (contrib/deformable_convolution.cc:1)
+# ---------------------------------------------------------------------------
+
+
+class DeformableConvParam(Params):
+    kernel = param_field(tuple, required=True)
+    stride = param_field(tuple, default=())
+    dilate = param_field(tuple, default=())
+    pad = param_field(tuple, default=())
+    num_filter = param_field(int, required=True)
+    num_group = param_field(int, default=1)
+    num_deformable_group = param_field(int, default=1)
+    no_bias = param_field(bool, default=False)
+    workspace = param_field(int, default=1024)
+    layout = param_field(str, default=None)
+
+
+def _defconv_inputs(p):
+    if p is not None and p.no_bias:
+        return ("data", "offset", "weight")
+    return ("data", "offset", "weight", "bias")
+
+
+@register_op("_contrib_DeformableConvolution", param_cls=DeformableConvParam,
+             input_names=_defconv_inputs,
+             aliases=("_contrib_deformable_convolution",))
+def _deformable_convolution(params, data, offset, weight, bias=None):
+    """data [N,C,H,W]; offset [N, 2*ndg*kh*kw, Ho, Wo]; weight
+    [F, C/num_group, kh, kw]. Each kernel tap samples the input at its
+    regular grid position plus a learned (dy, dx)."""
+    kh, kw = params.kernel
+    sh, sw = params.stride or (1, 1)
+    dh, dw = params.dilate or (1, 1)
+    ph, pw = params.pad or (0, 0)
+    ndg = params.num_deformable_group
+    N, C, H, W = data.shape
+    F = params.num_filter
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    K = kh * kw
+
+    # base grid [K, Ho, Wo] for y and x (in input coords, pad-shifted)
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                          indexing="ij")
+    base_y = ky.reshape(K, 1, 1) + oy[None, :, None]
+    base_x = kx.reshape(K, 1, 1) + ox[None, None, :]
+
+    def one_image(img, off):
+        # off [2*ndg*K, Ho, Wo] -> [ndg, K, 2, Ho, Wo] (reference channel
+        # order: per deformable group, per tap, (dy, dx))
+        off = off.reshape(ndg, K, 2, Ho, Wo)
+
+        def one_dg(img_dg, off_dg):
+            ys = base_y + off_dg[:, 0]
+            xs = base_x + off_dg[:, 1]
+            return _bilinear_gather(img_dg, ys, xs)  # [C/ndg, K, Ho, Wo]
+
+        cols = jax.vmap(one_dg)(img.reshape(ndg, C // ndg, H, W), off)
+        return cols.reshape(C, K, Ho, Wo)
+
+    cols = jax.vmap(one_image)(data, offset)       # [N, C, K, Ho, Wo]
+    g = params.num_group
+    cols = cols.reshape(N, g, C // g, K, Ho, Wo)
+    wg = weight.reshape(g, F // g, C // g, kh * kw)
+    out = jnp.einsum("ngckhw,gfck->ngfhw", cols, wg)
+    out = out.reshape(N, F, Ho, Wo)
+    if bias is not None:
+        out = out + bias.reshape(1, F, 1, 1)
+    return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign (roi_align_v2 semantics: no coordinate rounding, bilinear
+# sample averaging)
+# ---------------------------------------------------------------------------
+
+
+class ROIAlignParam(Params):
+    pooled_size = param_field(tuple, required=True)
+    spatial_scale = param_field(float, required=True)
+    sample_ratio = param_field(int, default=-1)
+
+
+@register_op("_contrib_ROIAlign", param_cls=ROIAlignParam,
+             input_names=("data", "rois"), aliases=("_contrib_roi_align",))
+def _roi_align(params, data, rois):
+    """data [N,C,H,W]; rois [R,5]=(batch_idx,x1,y1,x2,y2)."""
+    ph, pw = params.pooled_size
+    scale = params.spatial_scale
+    sr = params.sample_ratio if params.sample_ratio > 0 else 2
+
+    def one_roi(roi):
+        img = data[roi[0].astype(jnp.int32)]
+        x1, y1, x2, y2 = roi[1] * scale, roi[2] * scale, roi[3] * scale, \
+            roi[4] * scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        sy = jnp.arange(sr, dtype=jnp.float32)
+        # sample grid: bin start + (s + .5)/sr * bin
+        ys = y1 + bin_h * (iy[:, None] + (sy[None, :] + 0.5) / sr)  # [ph,sr]
+        xs = x1 + bin_w * (ix[:, None] + (sy[None, :] + 0.5) / sr)  # [pw,sr]
+        yy = ys.reshape(ph, sr, 1, 1)
+        xx = xs.reshape(1, 1, pw, sr)
+        vals = _bilinear_gather(img, jnp.broadcast_to(yy, (ph, sr, pw, sr)),
+                                jnp.broadcast_to(xx, (ph, sr, pw, sr)))
+        return vals.mean(axis=(2, 4))  # avg over sample points -> [C,ph,pw]
+
+    return jax.vmap(one_roi)(rois).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling (contrib/deformable_psroi_pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+class DeformablePSROIParam(Params):
+    spatial_scale = param_field(float, required=True)
+    output_dim = param_field(int, required=True)
+    group_size = param_field(int, required=True)
+    pooled_size = param_field(int, required=True)
+    part_size = param_field(int, default=0)
+    sample_per_part = param_field(int, default=1)
+    trans_std = param_field(float, default=0.0)
+    no_trans = param_field(bool, default=False)
+
+
+def _defpsroi_inputs(p):
+    if p is not None and p.no_trans:
+        return ("data", "rois")
+    return ("data", "rois", "trans")
+
+
+@register_op("_contrib_DeformablePSROIPooling", param_cls=DeformablePSROIParam,
+             input_names=_defpsroi_inputs,
+             aliases=("_contrib_deformable_psroi_pooling",))
+def _deformable_psroi_pooling(params, data, rois, trans=None):
+    """Position-sensitive ROI pooling with per-part learned offsets.
+    data [N, output_dim*group_size^2, H, W]; rois [R,5];
+    trans [R, 2*pooled^2 split as (class_part?, 2, part, part)] — here
+    [R, 2, part_size, part_size] per the no-class-aware common case."""
+    k = params.pooled_size
+    gs = params.group_size
+    od = params.output_dim
+    scale = params.spatial_scale
+    spp = params.sample_per_part
+    part = params.part_size or k
+    ts = params.trans_std
+
+    def one_roi(roi, tr):
+        img = data[roi[0].astype(jnp.int32)]
+        # reference shifts roi by rounding to a 0.5-aligned grid
+        x1 = jnp.round(roi[1]) * scale - 0.5
+        y1 = jnp.round(roi[2]) * scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / k
+        bin_w = rw / k
+        sub_h = bin_h / spp
+        sub_w = bin_w / spp
+
+        iy = jnp.arange(k)
+        ix = jnp.arange(k)
+        # part index for trans lookup
+        py = jnp.clip((iy * part) // k, 0, part - 1)
+        px = jnp.clip((ix * part) // k, 0, part - 1)
+        if tr is None:
+            dy = jnp.zeros((k, k))
+            dx = jnp.zeros((k, k))
+        else:
+            dy = tr[0][py[:, None], px[None, :]] * ts * rh
+            dx = tr[1][py[:, None], px[None, :]] * ts * rw
+        sy = jnp.arange(spp, dtype=jnp.float32)
+        ys = (y1 + iy[:, None, None, None] * bin_h + dy[:, :, None, None]
+              + (sy[None, None, :, None] + 0.5) * sub_h)   # [k,k,spp,1]
+        xs = (x1 + ix[None, :, None, None] * bin_w + dx[:, :, None, None]
+              + (sy[None, None, None, :] + 0.5) * sub_w)   # [k,k,1,spp]
+        ys = jnp.broadcast_to(ys, (k, k, spp, spp))
+        xs = jnp.broadcast_to(xs, (k, k, spp, spp))
+        vals = _bilinear_gather(img, ys, xs)  # [C,k,k,spp,spp]
+        vals = vals.mean(axis=(-1, -2))       # [C,k,k]
+        # position-sensitive channel select: bin (i,j) reads channel block
+        # od*(gy*gs+gx) where gy=i*gs//k
+        gy = jnp.clip((iy * gs) // k, 0, gs - 1)
+        gx = jnp.clip((ix * gs) // k, 0, gs - 1)
+        vals = vals.reshape(od, gs * gs, k, k)
+        sel = (gy[:, None] * gs + gx[None, :])  # [k,k]
+        return jnp.take_along_axis(
+            vals, sel[None, None, :, :], axis=1)[:, 0]  # [od,k,k]
+
+    if trans is None:
+        return jax.vmap(lambda r: one_roi(r, None))(rois).astype(data.dtype)
+    tr = trans.reshape(trans.shape[0], 2, part, part)
+    return jax.vmap(one_roi)(rois, tr).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Proposal / MultiProposal (contrib/proposal.cc, multi_proposal.cc)
+# ---------------------------------------------------------------------------
+
+
+class ProposalParam(Params):
+    rpn_pre_nms_top_n = param_field(int, default=6000)
+    rpn_post_nms_top_n = param_field(int, default=300)
+    threshold = param_field(float, default=0.7)
+    rpn_min_size = param_field(int, default=16)
+    scales = param_field(tuple, default=(4.0, 8.0, 16.0, 32.0))
+    ratios = param_field(tuple, default=(0.5, 1.0, 2.0))
+    feature_stride = param_field(int, default=16)
+    output_score = param_field(bool, default=False)
+    iou_loss = param_field(bool, default=False)
+    workspace = param_field(int, default=256)
+
+
+def _generate_anchors(scales, ratios, stride):
+    """Reference anchor enumeration (proposal.cc GenerateAnchors): base box
+    [0,0,stride-1,stride-1], ratio then scale enumeration."""
+    base = _np.array([0, 0, stride - 1, stride - 1], dtype=_np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        size_r = size / r
+        ws = round(_np.sqrt(size_r))
+        hs = round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                            cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return _np.array(anchors, dtype=_np.float32)  # [A,4]
+
+
+def _bbox_transform(anchors, deltas, iou_loss):
+    """Apply regression deltas (proposal.cc BBoxTransformInv)."""
+    w = anchors[:, 2] - anchors[:, 0] + 1.0
+    h = anchors[:, 3] - anchors[:, 1] + 1.0
+    cx = anchors[:, 0] + 0.5 * (w - 1.0)
+    cy = anchors[:, 1] + 0.5 * (h - 1.0)
+    if iou_loss:
+        x1 = anchors[:, 0] + deltas[:, 0]
+        y1 = anchors[:, 1] + deltas[:, 1]
+        x2 = anchors[:, 2] + deltas[:, 2]
+        y2 = anchors[:, 3] + deltas[:, 3]
+    else:
+        pcx = deltas[:, 0] * w + cx
+        pcy = deltas[:, 1] * h + cy
+        pw = jnp.exp(deltas[:, 2]) * w
+        ph = jnp.exp(deltas[:, 3]) * h
+        x1 = pcx - 0.5 * (pw - 1.0)
+        y1 = pcy - 0.5 * (ph - 1.0)
+        x2 = pcx + 0.5 * (pw - 1.0)
+        y2 = pcy + 0.5 * (ph - 1.0)
+    return jnp.stack([x1, y1, x2, y2], axis=1)
+
+
+def _nms_fixed(boxes, scores, thresh, pre_n, post_n):
+    """Greedy IoU NMS over the top pre_n boxes as a fixed-trip loop.
+    Returns (boxes [post_n,4], scores [post_n]) — suppressed slots repeat
+    the best surviving box (reference pads by reusing kept proposals)."""
+    n = min(pre_n, scores.shape[0])
+    sc, order = lax.top_k(scores, n)
+    bx = boxes[order]
+    x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+    areas = (x2 - x1 + 1.0) * (y2 - y1 + 1.0)
+
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + 1.0, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + 1.0, 0.0)
+    inter = iw * ih
+    iou = inter / (areas[:, None] + areas[None, :] - inter)
+
+    def body(i, keep):
+        # suppress j>i overlapping kept box i
+        sup = (iou[i] > thresh) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # gather first post_n kept indices (stable order = score order);
+    # suppressed boxes and kept ranks >= post_n scatter out of range and
+    # are DROPPED (no clip — clipping would dump them all onto slot
+    # post_n-1 and overwrite the real 300th proposal)
+    rank = jnp.cumsum(keep) - 1          # rank among kept
+    slot = jnp.where(keep, rank, n + post_n)
+    out_idx = jnp.zeros((post_n,), jnp.int32)
+    out_idx = out_idx.at[slot].set(jnp.arange(n, dtype=jnp.int32),
+                                   mode="drop")
+    # pad: slots past the kept count reuse index 0 (the best box, which is
+    # never suppressed)
+    n_kept = keep.sum()
+    filled = jnp.arange(post_n) < n_kept
+    out_idx = jnp.where(filled, out_idx, out_idx[0])
+    return bx[out_idx], sc[out_idx]
+
+
+def _proposal_one(cls_prob, bbox_pred, im_info, params, anchors):
+    """cls_prob [2A,H,W] (bg/fg), bbox_pred [4A,H,W], im_info [3]."""
+    A = anchors.shape[0]
+    H, W = cls_prob.shape[-2:]
+    stride = params.feature_stride
+    shift_x = jnp.arange(W) * stride
+    shift_y = jnp.arange(H) * stride
+    # all anchors [H,W,A,4]
+    shifts = jnp.stack(
+        [shift_x[None, :, None] + jnp.zeros((H, 1, 1)),
+         shift_y[:, None, None] + jnp.zeros((1, W, 1)),
+         shift_x[None, :, None] + jnp.zeros((H, 1, 1)),
+         shift_y[:, None, None] + jnp.zeros((1, W, 1))], axis=-1)
+    all_anchors = (jnp.asarray(anchors)[None, None] + shifts).reshape(-1, 4)
+    scores = cls_prob[A:].transpose(1, 2, 0).reshape(-1)  # fg scores
+    deltas = bbox_pred.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+    props = _bbox_transform(all_anchors, deltas, params.iou_loss)
+    # clip to image
+    im_h, im_w = im_info[0], im_info[1]
+    props = jnp.stack([jnp.clip(props[:, 0], 0, im_w - 1.0),
+                       jnp.clip(props[:, 1], 0, im_h - 1.0),
+                       jnp.clip(props[:, 2], 0, im_w - 1.0),
+                       jnp.clip(props[:, 3], 0, im_h - 1.0)], axis=1)
+    # min size filter (scaled by im_info[2])
+    min_size = params.rpn_min_size * im_info[2]
+    ws = props[:, 2] - props[:, 0] + 1.0
+    hs = props[:, 3] - props[:, 1] + 1.0
+    valid = (ws >= min_size) & (hs >= min_size)
+    scores = jnp.where(valid, scores, -1.0)
+    return _nms_fixed(props, scores, params.threshold,
+                      params.rpn_pre_nms_top_n, params.rpn_post_nms_top_n)
+
+
+def _proposal_outputs(p):
+    return 2 if (p is not None and p.output_score) else 1
+
+
+@register_op("_contrib_Proposal", param_cls=ProposalParam,
+             input_names=("cls_prob", "bbox_pred", "im_info"),
+             num_outputs=_proposal_outputs, aliases=("_contrib_proposal",))
+def _proposal(params, cls_prob, bbox_pred, im_info):
+    """Single-image RPN proposals: output [post_n, 5] = (0, x1,y1,x2,y2)."""
+    anchors = _generate_anchors(params.scales, params.ratios,
+                                params.feature_stride)
+    boxes, scores = _proposal_one(cls_prob[0], bbox_pred[0], im_info[0],
+                                  params, anchors)
+    out = jnp.concatenate([jnp.zeros((boxes.shape[0], 1)), boxes], axis=1)
+    if params.output_score:
+        return out, scores[:, None]
+    return out
+
+
+@register_op("_contrib_MultiProposal", param_cls=ProposalParam,
+             input_names=("cls_prob", "bbox_pred", "im_info"),
+             num_outputs=_proposal_outputs,
+             aliases=("_contrib_multi_proposal",))
+def _multi_proposal(params, cls_prob, bbox_pred, im_info):
+    """Batched proposals: output [N*post_n, 5] with batch index in col 0."""
+    anchors = _generate_anchors(params.scales, params.ratios,
+                                params.feature_stride)
+    boxes, scores = jax.vmap(
+        lambda c, b, i: _proposal_one(c, b, i, params, anchors))(
+        cls_prob, bbox_pred, im_info)
+    N, P = boxes.shape[:2]
+    bidx = jnp.repeat(jnp.arange(N, dtype=boxes.dtype), P)[:, None]
+    out = jnp.concatenate([bidx, boxes.reshape(N * P, 4)], axis=1)
+    if params.output_score:
+        return out, scores.reshape(N * P, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# count_sketch (contrib/count_sketch.cc)
+# ---------------------------------------------------------------------------
+
+
+class CountSketchParam(Params):
+    out_dim = param_field(int, required=True)
+    processing_batch_size = param_field(int, default=32)
+
+
+@register_op("_contrib_count_sketch", param_cls=CountSketchParam,
+             input_names=("data", "h", "s"))
+def _count_sketch(params, data, h, s):
+    """data [N,d]; h [1,d] bucket indices in [0,out_dim); s [1,d] signs.
+    out[n, h[i]] += s[i] * data[n, i]."""
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    contrib = data * sign[None, :]
+    out = jnp.zeros((data.shape[0], params.out_dim), data.dtype)
+    return out.at[:, idx].add(contrib, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# khatri_rao (contrib/krprod.cc:75)
+# ---------------------------------------------------------------------------
+
+
+class KhatriRaoParam(Params):
+    num_args = param_field(int, default=1)
+
+
+@register_op("khatri_rao", param_cls=KhatriRaoParam,
+             key_var_num_args="num_args",
+             input_names=lambda p: tuple(
+                 "arg%d" % i for i in range(p.num_args if p else 1)))
+def _khatri_rao(params, *mats):
+    """Column-wise Kronecker product: inputs [r_i, k] -> [prod r_i, k]."""
+    if not mats:
+        raise MXNetError("khatri_rao needs at least one input")
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(
+            out.shape[0] * m.shape[0], out.shape[1])
+    return out

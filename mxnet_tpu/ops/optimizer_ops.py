@@ -151,3 +151,27 @@ def _signum_update(params, weight, grad, mom):
     mom = params.momentum * mom - (1 - params.momentum) * g
     w = (1 - params.lr * params.wd_lh) * weight + params.lr * jnp.sign(mom)
     return w, mom
+
+
+class AdagradParam(Params):
+    lr = param_field(float, required=True)
+    epsilon = param_field(float, default=1e-7)
+    wd = param_field(float, default=0.0)
+    rescale_grad = param_field(float, default=1.0)
+    clip_gradient = param_field(float, default=-1.0)
+
+
+@register_op("_sparse_adagrad_update", aliases=("adagrad_update",),
+             param_cls=AdagradParam,
+             input_names=("weight", "grad", "history"), num_outputs=2,
+             output_names=("out", "history_out"))
+def _adagrad_update(params, weight, grad, history):
+    """AdaGrad (reference: src/operator/optimizer_op.cc _sparse_adagrad_update;
+    dense formulation — XLA keeps values dense)."""
+    g = grad * params.rescale_grad
+    if params.clip_gradient > 0:
+        g = jnp.clip(g, -params.clip_gradient, params.clip_gradient)
+    g = g + params.wd * weight
+    h = history + g * g
+    w = weight - params.lr * g / (jnp.sqrt(h) + params.epsilon)
+    return w, h

@@ -92,3 +92,128 @@ def _requantize(params, data, min_range, max_range):
                                              jnp.abs(out_max)), 1e-12)
     q = jnp.clip(jnp.round(fdata * scale8), -127, 127).astype(jnp.int8)
     return q, out_min.reshape((1,)), out_max.reshape((1,))
+
+
+# ---------------------------------------------------------------------------
+# quantized compute ops (reference: quantized_conv.cc, 
+# quantized_fully_connected.cc, quantized_pooling.cc, quantized_flatten.cc)
+# ---------------------------------------------------------------------------
+
+
+def _float_per_level(vmin, vmax, bits_lo, bits_hi):
+    """quantization_utils.h:127 FloatForOneQuantizedLevel."""
+    return (vmax - vmin) / (bits_hi - bits_lo)
+
+
+def _range_for_multiplication(min_a, max_a, min_b, max_b):
+    """int8 x int8 -> int32 output range (quantization_utils.h:138)."""
+    qa = _float_per_level(min_a, max_a, -128.0, 127.0)
+    qb = _float_per_level(min_b, max_b, -128.0, 127.0)
+    qc = qa * qb
+    c_lo, c_hi = -(2.0 ** 31), 2.0 ** 31 - 1
+    return (qc * c_lo).reshape((1,)), (qc * c_hi).reshape((1,))
+
+
+from .nn import ConvParam, FCParam, PoolParam  # noqa: E402
+
+
+def _qconv_inputs(p):
+    if p is not None and p.no_bias:
+        return ("data", "weight", "min_data", "max_data",
+                "min_weight", "max_weight")
+    return ("data", "weight", "bias", "min_data", "max_data",
+            "min_weight", "max_weight", "min_bias", "max_bias")
+
+
+@register_op("_contrib_quantized_conv", param_cls=ConvParam,
+             input_names=_qconv_inputs, num_outputs=3,
+             output_names=("output", "min_output", "max_output"))
+def _quantized_conv(params, data, weight, *rest):
+    """int8 conv with int32 accumulation (reference quantized_conv.cc:1).
+    Output range derives from the input/weight quantization ranges."""
+    from jax import lax
+    if params.no_bias:
+        bias = None
+        min_data, max_data, min_weight, max_weight = rest
+    else:
+        bias, min_data, max_data, min_weight, max_weight, \
+            min_bias, max_bias = rest
+    nd = len(params.kernel)
+    stride = params.stride or (1,) * nd
+    dilate = params.dilate or (1,) * nd
+    pad = params.pad or (0,) * nd
+    if nd != 2:
+        raise ValueError("quantized_conv supports 2D kernels only")
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int32), weight.astype(jnp.int32),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, feature_group_count=params.num_group,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    min_out, max_out = _range_for_multiplication(
+        min_data.reshape(()), max_data.reshape(()),
+        min_weight.reshape(()), max_weight.reshape(()))
+    if bias is not None:
+        # rescale int8 bias into the int32 output scale (reference
+        # quantized_conv.cu bias_scale handling)
+        bias_q = _float_per_level(min_bias.reshape(()), max_bias.reshape(()),
+                                  -128.0, 127.0)
+        out_q = _float_per_level(min_out.reshape(()), max_out.reshape(()),
+                                 -(2.0 ** 31), 2.0 ** 31 - 1)
+        scale = bias_q / out_q
+        out = out + jnp.round(
+            bias.astype(jnp.float32) * scale).astype(jnp.int32).reshape(
+            (1, -1) + (1,) * nd)
+    return out, min_out, max_out
+
+
+@register_op("_contrib_quantized_fully_connected", param_cls=FCParam,
+             input_names=_qconv_inputs, num_outputs=3,
+             output_names=("output", "min_output", "max_output"))
+def _quantized_fully_connected(params, data, weight, *rest):
+    """int8 FC with int32 accumulation (quantized_fully_connected.cc)."""
+    if params.no_bias:
+        bias = None
+        min_data, max_data, min_weight, max_weight = rest
+    else:
+        bias, min_data, max_data, min_weight, max_weight, \
+            min_bias, max_bias = rest
+    x = data
+    if params.flatten and x.ndim > 2:
+        x = x.reshape((x.shape[0], -1))
+    out = jax.lax.dot(x.astype(jnp.int32), weight.astype(jnp.int32).T,
+                      preferred_element_type=jnp.int32)
+    min_out, max_out = _range_for_multiplication(
+        min_data.reshape(()), max_data.reshape(()),
+        min_weight.reshape(()), max_weight.reshape(()))
+    if bias is not None:
+        bias_q = _float_per_level(min_bias.reshape(()), max_bias.reshape(()),
+                                  -128.0, 127.0)
+        out_q = _float_per_level(min_out.reshape(()), max_out.reshape(()),
+                                 -(2.0 ** 31), 2.0 ** 31 - 1)
+        out = out + jnp.round(bias.astype(jnp.float32)
+                              * (bias_q / out_q)).astype(jnp.int32)[None, :]
+    return out, min_out, max_out
+
+
+@register_op("_contrib_quantized_pooling", param_cls=PoolParam,
+             input_names=("data", "min_data", "max_data"), num_outputs=3,
+             output_names=("output", "min_output", "max_output"))
+def _quantized_pooling(params, data, min_data, max_data):
+    """int8 pooling: range passes straight through (quantized_pooling.cc)."""
+    from .nn import _pooling
+    out = _pooling(params, data.astype(jnp.float32))
+    if params.pool_type == "max":
+        out = jnp.round(out).astype(data.dtype)
+    else:
+        out = jnp.clip(jnp.round(out), -128, 127).astype(data.dtype)
+    return out, min_data.reshape((1,)), max_data.reshape((1,))
+
+
+@register_op("_contrib_quantized_flatten",
+             input_names=("data", "min_data", "max_data"), num_outputs=3,
+             output_names=("output", "min_output", "max_output"))
+def _quantized_flatten(params, data, min_data, max_data):
+    """Flatten preserving the quantization range (quantized_flatten.cc)."""
+    return (data.reshape((data.shape[0], -1)), min_data.reshape((1,)),
+            max_data.reshape((1,)))

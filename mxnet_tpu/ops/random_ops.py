@@ -116,3 +116,104 @@ def _multinomial(params, data, rng=None):
 @register_op("shuffle", aliases=("_shuffle",), input_names=("data",), need_rng=True)
 def _shuffle(params, data, rng=None):
     return jax.random.permutation(rng, data, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# multisample family: per-row distribution parameters
+# (reference: src/operator/random/multisample_op.cc — _sample_uniform etc.:
+# input arrays give one distribution per element; `shape` samples per row)
+# ---------------------------------------------------------------------------
+
+
+class MultiSampleParam(Params):
+    shape = param_field(tuple, default=())
+    dtype = param_field(str, default="float32")
+
+
+def _ms_shape(params, base):
+    s = tuple(params.shape) if params.shape else ()
+    return tuple(base.shape) + s, s
+
+
+def _ms_cast(x, params):
+    dt = params.dtype or "float32"
+    return x.astype(np_dtype(dt))
+
+
+@register_op("_sample_uniform", aliases=("sample_uniform",),
+             param_cls=MultiSampleParam, input_names=("low", "high"),
+             need_rng=True)
+def _ms_uniform(params, low, high, rng=None):
+    out_shape, _ = _ms_shape(params, low)
+    u = jax.random.uniform(rng, out_shape)
+    ex = low.reshape(low.shape + (1,) * (len(out_shape) - low.ndim))
+    return _ms_cast(ex + u * (high.reshape(ex.shape) - ex), params)
+
+
+@register_op("_sample_normal", aliases=("sample_normal",),
+             param_cls=MultiSampleParam, input_names=("mu", "sigma"),
+             need_rng=True)
+def _ms_normal(params, mu, sigma, rng=None):
+    out_shape, _ = _ms_shape(params, mu)
+    z = jax.random.normal(rng, out_shape)
+    ex = mu.reshape(mu.shape + (1,) * (len(out_shape) - mu.ndim))
+    return _ms_cast(ex + z * sigma.reshape(ex.shape), params)
+
+
+@register_op("_sample_gamma", aliases=("sample_gamma",),
+             param_cls=MultiSampleParam, input_names=("alpha", "beta"),
+             need_rng=True)
+def _ms_gamma(params, alpha, beta, rng=None):
+    out_shape, _ = _ms_shape(params, alpha)
+    ex = alpha.reshape(alpha.shape + (1,) * (len(out_shape) - alpha.ndim))
+    g = jax.random.gamma(rng, jnp.broadcast_to(ex, out_shape))
+    return _ms_cast(g * beta.reshape(ex.shape), params)
+
+
+@register_op("_sample_exponential", aliases=("sample_exponential",),
+             param_cls=MultiSampleParam, input_names=("lam",), need_rng=True)
+def _ms_exponential(params, lam, rng=None):
+    out_shape, _ = _ms_shape(params, lam)
+    e = jax.random.exponential(rng, out_shape)
+    return _ms_cast(e / lam.reshape(lam.shape + (1,) * (len(out_shape)
+                                                        - lam.ndim)), params)
+
+
+@register_op("_sample_poisson", aliases=("sample_poisson",),
+             param_cls=MultiSampleParam, input_names=("lam",), need_rng=True)
+def _ms_poisson(params, lam, rng=None):
+    out_shape, _ = _ms_shape(params, lam)
+    ex = lam.reshape(lam.shape + (1,) * (len(out_shape) - lam.ndim))
+    p = jax.random.poisson(rng, jnp.broadcast_to(ex, out_shape))
+    return _ms_cast(p, params)
+
+
+@register_op("_sample_negative_binomial", aliases=("sample_negative_binomial",),
+             param_cls=MultiSampleParam, input_names=("k", "p"), need_rng=True)
+def _ms_negative_binomial(params, k, p, rng=None):
+    out_shape, _ = _ms_shape(params, k)
+    kk = k.reshape(k.shape + (1,) * (len(out_shape) - k.ndim))
+    pp = p.reshape(kk.shape)
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    lam = jax.random.gamma(rng, jnp.broadcast_to(kk.astype(jnp.float32),
+                                                 out_shape)) * (1 - pp) / pp
+    s = jax.random.poisson(jax.random.fold_in(rng, 1), lam)
+    return _ms_cast(s, params)
+
+
+@register_op("_sample_generalized_negative_binomial",
+             aliases=("sample_generalized_negative_binomial",),
+             param_cls=MultiSampleParam, input_names=("mu", "alpha"),
+             need_rng=True)
+def _ms_gen_negative_binomial(params, mu, alpha, rng=None):
+    out_shape, _ = _ms_shape(params, mu)
+    m = mu.reshape(mu.shape + (1,) * (len(out_shape) - mu.ndim))
+    a = alpha.reshape(m.shape)
+    # GNB(mu, alpha) = Poisson(Gamma(1/alpha, mu*alpha)); alpha->0 = Poisson
+    a_safe = jnp.maximum(a, 1e-6)
+    lam = jax.random.gamma(rng, jnp.broadcast_to(1.0 / a_safe, out_shape)) \
+        * m * a_safe
+    lam = jnp.where(jnp.broadcast_to(a, out_shape) < 1e-6,
+                    jnp.broadcast_to(m, out_shape), lam)
+    s = jax.random.poisson(jax.random.fold_in(rng, 1), lam)
+    return _ms_cast(s, params)

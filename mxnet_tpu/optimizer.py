@@ -236,6 +236,142 @@ class NAG(SGD):
 
 
 @register
+class LBSGD(Optimizer):
+    """Large-Batch SGD with warmup / LARS lr scaling (reference:
+    optimizer.py:648): gradients accumulate per layer for `batch_scale`
+    micro-steps, then one SGD step runs with the warmup- (or LARS-)scaled
+    learning rate."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.multi_precision = multi_precision
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.cumgrads = {}
+
+    def create_state(self, index, weight):
+        import numpy as _np2
+        if self.multi_precision and weight.dtype == _np2.float16:
+            # fp32 master copy + fp32 momentum (reference optimizer.py:703)
+            master = weight.astype(_np2.float32)
+            mom = (zeros(weight.shape, ctx=weight.context)
+                   if self.momentum != 0.0 else None)
+            return (mom, master)
+        if self.momentum != 0.0:
+            return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def _get_lbmult(self, nup):
+        """Warmup lr multiplier ramping 1 -> batch_scale (reference
+        optimizer.py:720 _get_lbmult)."""
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        maxmult = float(self.batch_scale)
+        if nup >= nwup:
+            return maxmult
+        if nwup <= 1:
+            return 1.0
+        if self.warmup_strategy == "linear":
+            return 1.0 + (maxmult - 1) * nup / nwup
+        if self.warmup_strategy == "power2":
+            return 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
+        if self.warmup_strategy == "sqrt":
+            return 1.0 + (maxmult - 1) * math.sqrt(float(nup) / nwup)
+        return 1.0
+
+    def _get_lars(self, weight, g, wd):
+        """Layer-wise adaptive rate scaling, clamped to [0.01, 100]."""
+        import jax.numpy as jnp
+        w2 = float(jnp.sum(weight._data * weight._data))
+        g2 = float(jnp.sum(g * g))
+        lars = math.sqrt(w2 / (g2 + wd * w2 + 1e-18))
+        return min(max(lars, 0.01), 100.0)
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        cg = self.cumgrads.get(index)
+        if cg and cg["num_cums"] > 0:
+            cum_grad = cg["cum_grad"] + grad._data
+            num_cums = cg["num_cums"] + 1
+        else:
+            cum_grad = grad._data
+            # deliberately seeded with the resume offset — the reference
+            # does exactly this (_cumulate_gradient, optimizer.py:779:
+            # `num_cums = self.init_updates + 1`), sharing one counter
+            # between the warmup schedule and the accumulation window
+            num_cums = self.init_updates + 1
+        self.cumgrads[index] = {"cum_grad": cum_grad, "num_cums": num_cums}
+        if num_cums % self.batch_scale != 0:
+            return  # accumulate only (reference runs a lr=0 sgd_update no-op)
+        g = (cum_grad / self.batch_scale) * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        if self.warmup_strategy == "lars":
+            lbmult = self._get_lars(weight, g, wd)
+        else:
+            lbmult = self._get_lbmult(num_cums)
+        lr = lr * lbmult
+        use_mp = isinstance(state, tuple)
+        mom, master = state if use_mp else (state, None)
+        target = master if use_mp else weight
+        g = g.astype(jnp.float32) if use_mp else g
+        g = g + wd * target._data
+        if mom is not None:
+            mom._data = self.momentum * mom._data + lr * g
+            target._data = target._data - mom._data
+        else:
+            target._data = target._data - lr * g
+        if use_mp:  # write fp32 master back into the fp16 weight
+            weight._data = target._data.astype(weight.dtype)
+        self.cumgrads[index]["cum_grad"] = 0
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py:838;
+    arXiv:1609.08326): the update adds lamda * g^2 * (w - w_prev) to
+    compensate gradient staleness."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+               if self.momentum != 0.0 else None)
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, prev_w = state
+        comp = g + wd * weight._data + self.lamda * g * g * (weight._data -
+                                                             prev_w._data)
+        if mom is not None:
+            mom._data = self.momentum * mom._data - lr * comp
+            step = mom._data
+        else:
+            step = -lr * comp
+        prev_w._data = weight._data
+        weight._data = weight._data + step
+
+
+@register
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (reference: optimizer.py:946)."""
 
@@ -504,72 +640,6 @@ class FTML(Optimizer):
         d._data = d_t
         sigma._data = v_t
         weight._data = -z._data / d_t
-
-
-@register
-class DCASGD(Optimizer):
-    """Delay-compensated async SGD (reference: optimizer.py:838)."""
-
-    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
-        super().__init__(**kwargs)
-        self.momentum = momentum
-        self.weight_previous = {}
-        self.lamda = lamda
-
-    def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return (None, weight.copy())
-        return (zeros(weight.shape, ctx=weight.context), weight.copy())
-
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        kw = self._common_kwargs(index)
-        import jax.numpy as jnp
-        g = grad._data * kw["rescale_grad"]
-        if self.clip_gradient is not None:
-            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-        mom, previous_weight = state
-        comp = g + self.lamda * g * g * (weight._data - previous_weight._data)
-        if mom is not None:
-            mom._data = self.momentum * mom._data - kw["lr"] * (
-                comp + kw["wd"] * weight._data)
-            inc = mom._data
-        else:
-            inc = -kw["lr"] * (comp + kw["wd"] * weight._data)
-        previous_weight._data = weight._data
-        weight._data = weight._data + inc
-
-
-@register
-class LBSGD(SGD):
-    """Large-batch SGD with LARS-style layer-wise adaptation (reference: optimizer.py:648)."""
-
-    def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy="linear",
-                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32, begin_epoch=0,
-                 num_epochs=60, **kwargs):
-        super().__init__(momentum=momentum, multi_precision=multi_precision, **kwargs)
-        self.warmup_strategy = warmup_strategy
-        self.warmup_epochs = warmup_epochs
-        self.batch_scale = batch_scale
-        self.updates_per_epoch = updates_per_epoch
-        self.num_epochs = num_epochs
-
-    def update(self, index, weight, grad, state):
-        import jax.numpy as jnp
-        # LARS trust ratio
-        wnorm = float(jnp.sqrt(jnp.sum(jnp.square(weight._data))))
-        gnorm = float(jnp.sqrt(jnp.sum(jnp.square(grad._data)))) * self.rescale_grad
-        if wnorm > 0 and gnorm > 0:
-            lars = wnorm / (gnorm + self.wd * wnorm + 1e-9)
-            lars = min(lars, 10.0)
-        else:
-            lars = 1.0
-        saved_lr = self.lr
-        self.lr = self.lr * lars
-        try:
-            super().update(index, weight, grad, state)
-        finally:
-            self.lr = saved_lr
 
 
 # ---------------------------------------------------------------------------

@@ -36,7 +36,7 @@ __all__ = ["ring_attention", "ulysses_attention", "sequence_parallel_attention"]
 
 
 def ring_attention(q, k, v, axis_name, *, causal=False, sm_scale=None,
-                   block_k=512):
+                   block_k=512, use_pallas=None, pallas_interpret=False):
     """Ring attention over a sharded sequence axis.
 
     Must be called inside `shard_map`; `q`, `k`, `v` are the per-device
@@ -58,14 +58,32 @@ def ring_attention(q, k, v, axis_name, *, causal=False, sm_scale=None,
     lse0 = jnp.full(q.shape[:-1], -1e30, jnp.float32) + zdep
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    if use_pallas is None:
+        from ..kernels.flash_attention import default_use_pallas
+        use_pallas = default_use_pallas()
+    s_ok = (q.shape[-2] % min(block_k, q.shape[-2]) == 0
+            and k.shape[-2] % min(block_k, k.shape[-2]) == 0)
+
     def body(step, carry):
         out, lse, kc, vc = carry
         # at `step`, this device holds the KV chunk that originated on
         # device (idx - step) mod n
         src = lax.rem(idx - step + n, n)
-        ob, lb = blockwise_attention(
-            q, kc, vc, causal=causal, sm_scale=sm_scale,
-            q_offset=q_offset, k_offset=src * kc.shape[-2], block_k=block_k)
+        if use_pallas and s_ok:
+            # fused Pallas inner step: dynamic global offsets ride in as
+            # scalar-prefetch values (kernels/flash_attention.py)
+            from ..kernels.flash_attention import flash_attention_with_lse
+            offs = jnp.stack([jnp.int32(q_offset),
+                              (src * kc.shape[-2]).astype(jnp.int32)])
+            ob, lb = flash_attention_with_lse(
+                q, kc, vc, offs, sm_scale, causal,
+                min(block_k, q.shape[-2]), min(block_k, kc.shape[-2]),
+                pallas_interpret)
+        else:
+            ob, lb = blockwise_attention(
+                q, kc, vc, causal=causal, sm_scale=sm_scale,
+                q_offset=q_offset, k_offset=src * kc.shape[-2],
+                block_k=block_k)
         out, lse = merge_attention(out, lse, ob, lb.astype(jnp.float32))
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)

@@ -75,7 +75,61 @@ class record_event:
             })
 
 
-def dumps(reset=False):
+def is_running():
+    return _state["running"]
+
+
+def record_op_event(name, dur_s, category="operator"):
+    """Record one operator execution (called by the imperative runtime and
+    executor when the profiler is running)."""
+    with _state["lock"]:
+        _state["events"].append({
+            "name": name, "cat": category, "ph": "X",
+            "ts": time.time() * 1e6, "dur": dur_s * 1e6,
+            "pid": 0, "tid": threading.get_ident() % 1000,
+        })
+
+
+def aggregate_stats():
+    """Per-op aggregate table (reference: src/profiler/aggregate_stats.cc
+    DumpTable — Name / Total Count / total, avg, min, max ms)."""
+    with _state["lock"]:
+        events = list(_state["events"])
+    stats = {}
+    for e in events:
+        s = stats.setdefault(e["name"], {"count": 0, "total": 0.0,
+                                         "min": float("inf"), "max": 0.0,
+                                         "cat": e.get("cat", "operator")})
+        d_ms = e["dur"] / 1e3
+        s["count"] += 1
+        s["total"] += d_ms
+        s["min"] = min(s["min"], d_ms)
+        s["max"] = max(s["max"], d_ms)
+    lines = ["Profile Statistics.",
+             "\tNote the difference in units of the overall profiler.",
+             "%-32s %-12s %-14s %-14s %-14s %-14s" %
+             ("Name", "Total Count", "Time (ms)", "Min Time (ms)",
+              "Max Time (ms)", "Avg Time (ms)")]
+    lines.append("%-32s %-12s %-14s %-14s %-14s %-14s" %
+                 ("----", "-----------", "---------", "-------------",
+                  "-------------", "-------------"))
+    for name in sorted(stats, key=lambda n: -stats[n]["total"]):
+        s = stats[name]
+        lines.append("%-32s %-12d %-14.4f %-14.4f %-14.4f %-14.4f" %
+                     (name[:32], s["count"], s["total"], s["min"], s["max"],
+                      s["total"] / s["count"]))
+    return "\n".join(lines)
+
+
+def dumps(reset=False, format="table"):
+    """format='table': per-op aggregate stats (reference profiler.dumps);
+    format='chrome': chrome://tracing JSON of the recorded events."""
+    if format == "table":
+        out = aggregate_stats()
+        if reset:
+            with _state["lock"]:
+                _state["events"] = []
+        return out
     with _state["lock"]:
         out = json.dumps({"traceEvents": list(_state["events"])})
         if reset:
@@ -86,4 +140,4 @@ def dumps(reset=False):
 def dump(finished=True, profile_process="worker"):
     """Write chrome://tracing JSON of host events (device trace in *_jax_trace)."""
     with open(_state["filename"], "w") as f:
-        f.write(dumps())
+        f.write(dumps(format="chrome"))

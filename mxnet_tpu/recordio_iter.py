@@ -55,9 +55,12 @@ class ImageRecordIter(DataIter):
             self.label_width, int(bool(round_batch)), int(prefetch_buffer))
         if not self._handle:
             raise MXNetError("ImageRecordIter: %s" % _native.last_error())
-        self._data_buf = _np.empty((batch_size, c, h, w), _np.float32)
-        self._label_buf = _np.empty((batch_size, self.label_width),
-                                    _np.float32)
+        # staging buffers from the pooled host allocator (storage.py /
+        # src/storage/host_pool.cc) — page-aligned, reused across batches
+        from . import storage as _storage
+        self._data_buf = _storage.empty((batch_size, c, h, w), _np.float32)
+        self._label_buf = _storage.empty((batch_size, self.label_width),
+                                         _np.float32)
         self._exhausted = False
 
     @property

@@ -1,0 +1,114 @@
+"""Storage facade — pooled host staging buffers for infeed.
+
+Reference: src/storage/ (StorageManager facade + pooled pinned-memory
+managers, pooled_storage_manager.h). TPU-native split: device memory belongs
+to PJRT/XLA (BFC allocator inside the runtime — nothing to manage here);
+the HOST side keeps the reference's pooled design for the staging buffers
+the data pipeline assembles batches into before `device_put`. Backed by the
+native pool (src/storage/host_pool.cc) via ctypes; falls back to plain numpy
+allocation when the native library is unavailable.
+
+API:
+  alloc(nbytes) -> PooledBuffer (with .asnumpy(shape, dtype) view)
+  empty(shape, dtype) -> numpy array backed by a pooled buffer
+  release_all() / stats()
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as _np
+
+__all__ = ["alloc", "empty", "release_all", "stats", "PooledBuffer"]
+
+
+def _lib():
+    from . import _native
+    try:
+        lib = _native.get_lib()
+    except Exception:
+        return None
+    if not hasattr(lib, "MXTStorageAlloc"):
+        return None
+    lib.MXTStorageAlloc.restype = ctypes.c_void_p
+    lib.MXTStorageAlloc.argtypes = [ctypes.c_size_t]
+    lib.MXTStorageFree.argtypes = [ctypes.c_void_p]
+    lib.MXTStorageStats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+    return lib
+
+
+class PooledBuffer:
+    """One pooled host buffer; returns to the pool on free()/GC."""
+
+    def __init__(self, nbytes):
+        self.nbytes = int(nbytes)
+        self._lib = _lib()
+        self._ptr = None
+        if self._lib is not None:
+            self._ptr = self._lib.MXTStorageAlloc(self.nbytes)
+        if self._ptr is None:  # fallback: plain numpy backing
+            self._np = _np.empty(self.nbytes, _np.uint8)
+        else:
+            self._np = _np.ctypeslib.as_array(
+                ctypes.cast(self._ptr, ctypes.POINTER(ctypes.c_uint8)),
+                shape=(self.nbytes,))
+
+    def asnumpy(self, shape, dtype=_np.float32):
+        dt = _np.dtype(dtype)
+        count = int(_np.prod(shape)) if shape else 1
+        if count * dt.itemsize > self.nbytes:
+            raise ValueError("view of %s exceeds buffer of %d bytes"
+                             % ((shape, dt), self.nbytes))
+        return self._np[:count * dt.itemsize].view(dt).reshape(shape)
+
+    def free(self):
+        if self._ptr is not None and self._lib is not None:
+            self._lib.MXTStorageFree(self._ptr)
+            self._ptr = None
+            self._np = None
+
+    def __del__(self):
+        try:
+            self.free()
+        except Exception:
+            pass
+
+
+def alloc(nbytes):
+    return PooledBuffer(nbytes)
+
+
+class _PooledArray(_np.ndarray):
+    """ndarray subclass that owns its PooledBuffer (returns to the pool
+    when the array is garbage collected)."""
+    _mxtpu_buffer = None
+
+
+def empty(shape, dtype=_np.float32):
+    """Pool-backed numpy array; the buffer returns to the pool when the
+    array dies."""
+    dt = _np.dtype(dtype)
+    buf = PooledBuffer(int(_np.prod(shape)) * dt.itemsize if shape
+                       else dt.itemsize)
+    arr = buf.asnumpy(shape, dt).view(_PooledArray)
+    arr._mxtpu_buffer = buf
+    return arr
+
+
+def release_all():
+    lib = _lib()
+    if lib is not None:
+        lib.MXTStorageReleaseAll()
+
+
+def stats():
+    """{'bytes_in_use', 'bytes_pooled', 'hits', 'misses', 'frees'}."""
+    lib = _lib()
+    if lib is None:
+        return {"bytes_in_use": 0, "bytes_pooled": 0, "hits": 0,
+                "misses": 0, "frees": 0, "native": False}
+    out = (ctypes.c_uint64 * 5)()
+    lib.MXTStorageStats(out)
+    return {"bytes_in_use": int(out[0]), "bytes_pooled": int(out[1]),
+            "hits": int(out[2]), "misses": int(out[3]),
+            "frees": int(out[4]), "native": True}

@@ -27,6 +27,8 @@ def _invoke_symbol(opdef, sym_inputs, attrs, name=None):
     in_names = opdef.list_inputs(params) + opdef.list_aux(params)
     if name is None:
         name = _NAMES.get(opdef.name.lower())
+    from ..attribute import current_attrs
+    scope_attrs = current_attrs()
     inputs = []
     for i, nm in enumerate(in_names):
         if i < len(sym_inputs) and sym_inputs[i] is not None:
@@ -38,8 +40,12 @@ def _invoke_symbol(opdef, sym_inputs, attrs, name=None):
         else:
             # auto-create parameter/aux variable (reference composer behavior)
             vnode = Node(None, {}, [], "%s_%s" % (name, nm))
+            if scope_attrs:
+                vnode._extra_attrs.update(scope_attrs)
             inputs.append((vnode, 0))
     node = Node(opdef, attrs, inputs, name)
+    if scope_attrs:
+        node._extra_attrs.update(scope_attrs)
     n_out = opdef.n_outputs(params)
     return Symbol([(node, i) for i in range(n_out)])
 

@@ -408,10 +408,80 @@ class Symbol:
         return arg_shapes, out_shapes, aux_shapes
 
     def infer_type(self, *args, **kwargs):
-        args_ = self.list_arguments()
-        dtype = _np.float32
-        return ([dtype] * len(args_), [dtype] * len(self.list_outputs()),
-                [dtype] * len(self.list_auxiliary_states()))
+        """Bidirectional dtype unification (reference: FInferType attrs,
+        nnvm InferType pass). Each op unifies its tensor inputs/outputs to
+        one dtype; `Cast` breaks the chain (output dtype = its param), so
+        `data -> Cast(fp16) -> FullyConnected` infers an fp16 weight the
+        same way the reference does. Unknowns default to float32."""
+        arg_names = self.list_arguments()
+        if args:
+            kwargs = dict(kwargs)
+            kwargs.update({n: d for n, d in zip(arg_names, args)
+                           if d is not None})
+        topo = self._topo()
+        dtype_of = {}
+        for n in topo:
+            if not n.is_variable:
+                continue
+            if n.name in kwargs and kwargs[n.name] is not None:
+                dtype_of[(id(n), 0)] = _np.dtype(kwargs[n.name])
+            elif "__dtype__" in n._extra_attrs:
+                dtype_of[(id(n), 0)] = _np.dtype(n._extra_attrs["__dtype__"])
+        # ops whose listed input positions do NOT share the unified dtype
+        # (index-like inputs; reference FInferType marks these int-capable)
+        _EXCLUDE_INPUTS = {
+            "Embedding": (0,), "SparseEmbedding": (0,),
+            "take": (1,), "batch_take": (1,), "gather_nd": (1,),
+            "pick": (1,), "one_hot": (0,), "scatter_nd": (1,),
+            "_scatter_set_nd": (2,), "sparse_retain": (1,),
+            "SequenceMask": (1,), "SequenceLast": (1,),
+            "SequenceReverse": (1,),
+            # BatchNorm keeps gamma/beta/moving stats in float32 even for
+            # fp16 data (reference batch_norm.cc AuxType)
+            "BatchNorm": (1, 2, 3, 4), "CuDNNBatchNorm": (1, 2, 3, 4),
+        }
+        for _ in range(8):  # fixpoint over forward+backward constraints
+            changed = False
+            for node in topo:
+                if node.is_variable:
+                    continue
+                params = node.make_params()
+                n_vis = node.op.n_outputs(params)
+                excl = _EXCLUDE_INPUTS.get(node.op.name, ())
+                in_keys = [(id(i), oi)
+                           for pos, (i, oi) in enumerate(node.inputs)
+                           if pos not in excl]
+                out_keys = [(id(node), i) for i in range(n_vis)]
+                if node.op.name == "Cast":
+                    out_dt = _np.dtype(getattr(params, "dtype", "float32"))
+                    for k in out_keys:
+                        if dtype_of.get(k) != out_dt:
+                            dtype_of[k] = out_dt
+                            changed = True
+                    keys = in_keys  # input side unifies independently
+                else:
+                    keys = in_keys + out_keys
+                known = [dtype_of[k] for k in keys if k in dtype_of]
+                if not known:
+                    continue
+                dt = known[0]
+                for k in keys:
+                    if k not in dtype_of:
+                        dtype_of[k] = dt
+                        changed = True
+            if not changed:
+                break
+        default = _np.dtype(_np.float32)
+        name2var = {n.name: n for n in topo if n.is_variable}
+        aux_set = self._aux_set()
+        arg_types = [dtype_of.get((id(name2var[n]), 0), default)
+                     for n in arg_names]
+        aux_types = [dtype_of.get((id(name2var[n]), 0), default)
+                     for n in self.list_auxiliary_states()]
+        out_types = []
+        for node, oidx in self._outputs:
+            out_types.append(dtype_of.get((id(node), oidx), default))
+        return arg_types, out_types, aux_types
 
     # ------------------------------------------------------------------
     # serialization (reference: symbol JSON model format, model.py:365)
@@ -465,18 +535,20 @@ class Symbol:
         if any(s is None for s in arg_shapes):
             missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
             raise MXNetError("simple_bind: could not infer shapes for %s" % missing)
+        arg_types, _, aux_types = self.infer_type(**(type_dict or {}))
         args = {}
-        for name, shape in zip(arg_names, arg_shapes):
-            dtype = (type_dict or {}).get(name, _np.float32)
+        for name, shape, idt in zip(arg_names, arg_shapes, arg_types):
+            dtype = (type_dict or {}).get(name, idt)
             args[name] = zeros(shape, ctx=ctx, dtype=dtype)
         args_grad = {}
         req = grad_req if isinstance(grad_req, dict) else {
             n: grad_req for n in arg_names}
-        for name, shape in zip(arg_names, arg_shapes):
+        for name, shape, idt in zip(arg_names, arg_shapes, arg_types):
             if req.get(name, "null") != "null":
-                args_grad[name] = zeros(shape, ctx=ctx)
-        aux_states = {name: zeros(shape, ctx=ctx)
-                      for name, shape in zip(aux_names, aux_shapes)}
+                args_grad[name] = zeros(shape, ctx=ctx, dtype=idt)
+        aux_states = {name: zeros(shape, ctx=ctx, dtype=adt)
+                      for name, shape, adt in zip(aux_names, aux_shapes,
+                                                  aux_types)}
         return Executor(self, ctx, args, args_grad, grad_req, aux_states,
                         group2ctx=group2ctx)
 
@@ -496,6 +568,10 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None
     if not isinstance(name, str):
         raise TypeError("Expect a string for variable name")
     node = Node(None, {}, [], name)
+    from ..attribute import current_attrs
+    scope_attrs = current_attrs()
+    if scope_attrs:
+        node._extra_attrs.update(scope_attrs)
     if shape is not None:
         node._extra_attrs["__shape__"] = str(list(shape))
     if lr_mult is not None:

@@ -1,0 +1,111 @@
+// Host staging-buffer pool (reference analog: src/storage/ — the pooled
+// storage manager with pinned-memory round-up pooling,
+// pooled_storage_manager.h). On TPU the accelerator side is owned by
+// PJRT/XLA; what remains native is the HOST side: page-aligned, pooled
+// staging buffers for infeed (batch assembly before device_put), so the
+// data pipeline never churns malloc/free at steady state.
+//
+// C ABI (consumed by mxnet_tpu/storage.py via ctypes):
+//   MXTStorageAlloc(size)        -> aligned ptr (pool hit or fresh)
+//   MXTStorageFree(ptr)          -> return to pool (NOT freed)
+//   MXTStorageReleaseAll()       -> free every pooled buffer
+//   MXTStorageStats(out[5])      -> {bytes_in_use, bytes_pooled,
+//                                    hits, misses, frees}
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlignment = 4096;  // page-aligned for DMA-friendly infeed
+
+struct Pool {
+  std::mutex mu;
+  // size-class (rounded) -> free buffers
+  std::unordered_map<size_t, std::vector<void*>> free_list;
+  std::unordered_map<void*, size_t> sizes;  // live + pooled ptr -> class
+  uint64_t bytes_in_use = 0;
+  uint64_t bytes_pooled = 0;
+  uint64_t hits = 0, misses = 0, frees = 0;
+};
+
+Pool& pool() {
+  static Pool* p = new Pool();
+  return *p;
+}
+
+// round up to the next power of two (>= 4KB) like the reference's
+// pooled_storage_manager round-up, bounding pool fragmentation
+size_t SizeClass(size_t size) {
+  size_t c = kAlignment;
+  while (c < size) c <<= 1;
+  return c;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* MXTStorageAlloc(size_t size) {
+  if (size == 0) return nullptr;
+  size_t cls = SizeClass(size);
+  Pool& p = pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  auto it = p.free_list.find(cls);
+  if (it != p.free_list.end() && !it->second.empty()) {
+    void* ptr = it->second.back();
+    it->second.pop_back();
+    p.bytes_pooled -= cls;
+    p.bytes_in_use += cls;
+    p.hits++;
+    return ptr;
+  }
+  void* ptr = nullptr;
+  if (posix_memalign(&ptr, kAlignment, cls) != 0) return nullptr;
+  p.sizes[ptr] = cls;
+  p.bytes_in_use += cls;
+  p.misses++;
+  return ptr;
+}
+
+void MXTStorageFree(void* ptr) {
+  if (!ptr) return;
+  Pool& p = pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  auto it = p.sizes.find(ptr);
+  if (it == p.sizes.end()) return;  // not ours
+  size_t cls = it->second;
+  p.free_list[cls].push_back(ptr);
+  p.bytes_in_use -= cls;
+  p.bytes_pooled += cls;
+  p.frees++;
+}
+
+void MXTStorageReleaseAll() {
+  Pool& p = pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  for (auto& kv : p.free_list) {
+    for (void* ptr : kv.second) {
+      p.sizes.erase(ptr);
+      std::free(ptr);
+    }
+    kv.second.clear();
+  }
+  p.bytes_pooled = 0;
+}
+
+void MXTStorageStats(uint64_t* out) {
+  Pool& p = pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  out[0] = p.bytes_in_use;
+  out[1] = p.bytes_pooled;
+  out[2] = p.hits;
+  out[3] = p.misses;
+  out[4] = p.frees;
+}
+
+}  // extern "C"

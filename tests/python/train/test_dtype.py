@@ -1,0 +1,71 @@
+"""Mixed-precision / fp16 training (reference: tests/python/train/
+test_dtype.py — cast the net to float16, train, assert accuracy).
+
+TPU note: bfloat16 is the native low-precision dtype on the MXU, so both
+float16 (reference parity) and bfloat16 (TPU-native) are exercised.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+from .test_convergence import synthetic_digits
+
+
+def _lenet_cast(dtype):
+    data = mx.sym.Variable("data")
+    data = mx.sym.Cast(data, dtype=dtype)
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="tanh")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    fl = mx.sym.Flatten(p1)
+    f1 = mx.sym.FullyConnected(fl, num_hidden=64, name="fc1")
+    a2 = mx.sym.Activation(f1, act_type="tanh")
+    f2 = mx.sym.FullyConnected(a2, num_hidden=10, name="fc2")
+    f2 = mx.sym.Cast(f2, dtype="float32")  # loss in fp32
+    return mx.sym.SoftmaxOutput(f2, name="softmax")
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_low_precision_training_converges(dtype):
+    X, y = synthetic_digits(1000, seed=5)
+    Xv, yv = synthetic_digits(300, seed=95)
+    train = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=40,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(_lenet_cast(dtype), context=mx.tpu(0))
+    mod.fit(train, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34))
+    val.reset()
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.85, "%s val accuracy %f < 0.85" % (dtype, acc)
+
+
+def test_fp16_forward_dtype_flows():
+    """The cast net really computes in fp16 between the casts."""
+    data = mx.sym.Variable("data")
+    h = mx.sym.Cast(data, dtype="float16")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc")
+    ex = h.simple_bind(mx.tpu(0), grad_req="null", data=(2, 3),
+                       type_dict={"data": np.float32})
+    assert ex.arg_dict["fc_weight"].dtype == np.float16
+    out = ex.forward()
+    assert out[0].dtype == np.float16
+
+
+def test_mp_sgd_keeps_fp32_master_weights():
+    """mp_sgd_update: fp16 weights, fp32 master copy + momentum (reference
+    optimizer.py SGD multi_precision path)."""
+    w16 = mx.nd.array(np.ones((4,), np.float16), dtype=np.float16)
+    g16 = mx.nd.array(np.full((4,), 1e-4, np.float16), dtype=np.float16)
+    mom = mx.nd.zeros((4,))
+    w32 = mx.nd.ones((4,))
+    out, mom_out, w32_out = mx.nd.mp_sgd_mom_update(
+        w16, g16, mom, w32, lr=0.1, momentum=0.9)
+    assert out.dtype == np.float16
+    # the tiny update survives in the fp32 master even though it
+    # underflows the fp16 representation
+    assert w32_out.asnumpy()[0] < 1.0
+    np.testing.assert_allclose(w32_out.asnumpy(), 1 - 0.1 * 1e-4, rtol=1e-3)

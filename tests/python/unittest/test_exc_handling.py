@@ -1,0 +1,107 @@
+"""Exception propagation semantics (reference:
+tests/python/unittest/test_exc_handling.py).
+
+Divergence note (SURVEY §5.3): the reference's async engine defers errors to
+the next sync point (asnumpy/WaitToRead). JAX dispatch surfaces *structural*
+errors (shape/dtype/validation) eagerly at the call — strictly earlier,
+never later — while *numeric* anomalies (nan/inf) compute through, exactly
+like the reference's GPU kernels. These tests pin that contract.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu import gluon
+
+
+def test_exc_imperative_shape_mismatch():
+    a = mx.nd.array(np.ones((2, 3)))
+    b = mx.nd.array(np.ones((4, 5)))
+    with pytest.raises(Exception):
+        mx.nd.dot(a, b)
+
+
+def test_exc_imperative_nan_computes_through():
+    """Numeric anomalies do NOT raise (reference: kernels compute through;
+    the error the reference raises for scale<0 is a *validation* in the
+    sampler, which jax does not perform — nan propagates instead)."""
+    a = mx.nd.array(np.array([[1.0, -1.0]]))
+    out = mx.nd.sqrt(a)          # sqrt(-1) -> nan, no exception
+    assert np.isnan(out.asnumpy()[0, 1])
+
+
+def test_exc_symbolic_infer_shape():
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    out = mx.sym.dot(x, y)
+    with pytest.raises(MXNetError):
+        out.infer_shape(x=(2, 3), y=(5, 7))  # inner dims disagree
+
+
+def test_exc_symbolic_bind_missing_arg():
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    out = x + y
+    with pytest.raises(MXNetError):
+        out.bind(mx.cpu(), {"x": mx.nd.ones((2, 2))})  # y missing
+
+
+def test_exc_executor_forward_bad_kwarg():
+    x = mx.sym.Variable("x")
+    out = 2 * x
+    ex = out.simple_bind(mx.cpu(), grad_req="null", x=(2, 2))
+    with pytest.raises(MXNetError):
+        ex.forward(nosuch=np.ones((2, 2)))
+
+
+def test_exc_unknown_op_param():
+    x = mx.sym.Variable("x")
+    with pytest.raises(Exception):
+        mx.sym.FullyConnected(x, num_hidden=8, definitely_not_a_param=1)
+
+
+def test_exc_backward_before_forward():
+    x = mx.sym.Variable("x")
+    out = mx.sym.make_loss(2 * x)
+    ex = out.simple_bind(mx.cpu(), x=(2, 2))
+    with pytest.raises(MXNetError):
+        ex.backward()
+
+
+def test_exc_gluon_shape_mismatch():
+    """reference test_exc_gluon: Dense with wrong in_units raises when the
+    bad batch flows (here: eagerly at the call, never silently)."""
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(4, in_units=10))
+    net.initialize()
+    with pytest.raises(Exception):
+        net(mx.nd.ones((2, 7)))  # 7 != in_units 10
+
+
+def test_exc_gluon_hybridized():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, in_units=10))
+    net.initialize()
+    net.hybridize()
+    with pytest.raises(Exception):
+        net(mx.nd.ones((2, 7)))
+
+
+def test_exc_message_names_operator():
+    """Errors must identify the failing operator (reference engine attaches
+    op names to engine-thread exceptions)."""
+    x = mx.sym.Variable("x")
+    out = mx.sym.Reshape(x, shape=(7, 7))
+    try:
+        out.infer_shape(x=(2, 2))
+    except MXNetError as e:
+        assert "Reshape" in str(e) or "reshape" in str(e) or "7" in str(e)
+    else:
+        pytest.fail("no error raised")
+
+
+def test_exc_kvstore_uninit_key():
+    kv = mx.kv.create("local")
+    with pytest.raises(MXNetError):
+        kv.pull("never_inited", out=mx.nd.ones((1,)))

@@ -1,0 +1,177 @@
+"""KVStore unit tests (reference: tests/python/unittest/test_kvstore.py +
+the aggregation-exactness assertions of tests/nightly/dist_sync_kvstore.py:30-62
+run single-process over device copies).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _init_kv(kv_type="local"):
+    kv = mx.kv.create(kv_type)
+    kv.init(3, mx.nd.ones(SHAPE))
+    kv.init(KEYS, [mx.nd.ones(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_single_kv_pair():
+    kv = _init_kv()
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_array_equal(out.asnumpy(), 4 * np.ones(SHAPE))
+
+
+def test_aggregation_exactness():
+    """Pushing N device copies must yield the EXACT sum (the nightly
+    dist_sync assertion, single-process)."""
+    kv = _init_kv("device")
+    ndev = 4
+    vals = [mx.nd.array(np.full(SHAPE, i + 1, np.float32))
+            for i in range(ndev)]
+    kv.push(3, vals)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    expect = sum(range(1, ndev + 1)) * np.ones(SHAPE, np.float32)
+    np.testing.assert_array_equal(out.asnumpy(), expect)
+
+
+def test_list_kv_pair():
+    kv = _init_kv()
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    outs = [mx.nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        np.testing.assert_array_equal(o.asnumpy(), 4 * np.ones(SHAPE))
+
+
+def test_updater_runs_on_push():
+    kv = _init_kv()
+    updates = []
+
+    def updater(key, recv, local):
+        updates.append(key)
+        local += recv * 2
+
+    kv.set_updater(updater)
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_array_equal(out.asnumpy(), 3 * np.ones(SHAPE))
+    assert updates == [3]
+
+
+def test_push_uninitialized_key_raises():
+    kv = mx.kv.create("local")
+    with pytest.raises(Exception):
+        kv.push(99, mx.nd.ones(SHAPE))
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    W = np.arange(20, dtype=np.float32).reshape(5, 4)
+    kv.init("w", mx.nd.array(W))
+    out = mx.nd.sparse.zeros("row_sparse", (5, 4))
+    kv.row_sparse_pull("w", out=[out], row_ids=mx.nd.array([1, 3]))
+    dense = out.todense().asnumpy()
+    np.testing.assert_array_equal(dense[1], W[1])
+    np.testing.assert_array_equal(dense[3], W[3])
+    np.testing.assert_array_equal(dense[0], 0)
+
+
+# ---------------------------------------------------------------------------
+# 2-bit gradient compression (reference: gradient_compression-inl.h kernels,
+# exactness mirrored from tests/nightly/dist_sync_kvstore.py compressed cases)
+# ---------------------------------------------------------------------------
+
+def _np_quantize_roundtrip(grad, residual, threshold):
+    """Numpy mirror of quantize_2bit+dequantize_2bit semantics."""
+    r = residual + grad
+    out = np.zeros_like(grad)
+    pos = r >= threshold
+    neg = r <= -threshold
+    out[pos] = threshold
+    out[neg] = -threshold
+    r = r - threshold * pos + threshold * neg
+    return out, r
+
+
+def test_compression_quantize_exact():
+    from mxnet_tpu.gradient_compression import (quantize_2bit,
+                                                dequantize_2bit)
+    rng = np.random.RandomState(0)
+    grad = rng.normal(0, 1, (37,)).astype(np.float32)  # non-multiple of 16
+    residual = np.zeros_like(grad)
+    T = 0.5
+    packed, new_r = quantize_2bit(grad, residual, T)
+    got = np.asarray(dequantize_2bit(packed, T, grad.size))
+    expect, exp_r = _np_quantize_roundtrip(grad, residual, T)
+    np.testing.assert_array_equal(got, expect)
+    np.testing.assert_allclose(np.asarray(new_r), exp_r, atol=1e-6)
+    assert packed.dtype == np.uint32
+    assert packed.shape[0] == (37 + 15) // 16  # 16x compression
+
+
+def test_compression_bit_layout():
+    """Element i lands in byte i>>2, bits 7-6 downward — the reference's
+    wire layout (posbits {0xc0,0x30,0x0c,0x03})."""
+    from mxnet_tpu.gradient_compression import quantize_2bit
+    grad = np.zeros(16, np.float32)
+    grad[0] = 1.0    # byte 0, bits 7-6 -> 0xc0
+    grad[5] = -1.0   # byte 1, bits 5-4 -> 0x20
+    packed, _ = quantize_2bit(grad, np.zeros_like(grad), 0.5)
+    word = int(packed[0])
+    assert word & 0xFF == 0xC0          # little-endian byte 0
+    assert (word >> 8) & 0xFF == 0x20   # byte 1
+
+
+def test_compression_error_feedback_converges():
+    """Residual accumulation: repeated small grads below threshold must
+    eventually emit; total emitted approximates total gradient mass."""
+    from mxnet_tpu.gradient_compression import GradientCompression
+    import jax.numpy as jnp
+    gc = GradientCompression()
+    gc.set_params({"type": "2bit", "threshold": 0.5})
+    g = jnp.full((8,), 0.2, jnp.float32)
+    r = jnp.zeros((8,), jnp.float32)
+    total = np.zeros(8, np.float32)
+    for _ in range(10):
+        recv, r = gc.compress_decompress(g, r)
+        total += np.asarray(recv)
+    # 10 * 0.2 = 2.0 mass; emitted in 0.5 quanta -> 3 or 4 pulses
+    np.testing.assert_allclose(total, 2.0 * np.ones(8), atol=0.5)
+
+
+def test_compression_on_kvstore_push():
+    """Compressed push must aggregate the (lossy) per-device values exactly
+    as the numpy mirror predicts."""
+    kv = mx.kv.create("device")
+    shape = (3, 5)
+    kv.init("w", mx.nd.zeros(shape))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    rng = np.random.RandomState(1)
+    grads = [rng.normal(0, 1, shape).astype(np.float32) for _ in range(3)]
+    kv.push("w", [mx.nd.array(g) for g in grads])
+    out = mx.nd.empty(shape)
+    kv.pull("w", out=out)
+    expect = np.zeros(shape, np.float32)
+    for g in grads:
+        recv, _ = _np_quantize_roundtrip(g.ravel(),
+                                         np.zeros(g.size, np.float32), 0.5)
+        expect += recv.reshape(shape)
+    np.testing.assert_allclose(out.asnumpy(), expect, atol=1e-6)
+
+
+def test_compression_params_validation():
+    kv = mx.kv.create("device")
+    with pytest.raises(Exception):
+        kv.set_gradient_compression({"type": "1bit"})
+    with pytest.raises(Exception):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0})
+    gc_roundtrip = mx.kv.create("device")
+    gc_roundtrip.set_gradient_compression({"type": "2bit", "threshold": 2.0})
+    assert gc_roundtrip._gc.encode_params() == "2,2.0"

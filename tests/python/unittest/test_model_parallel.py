@@ -1,0 +1,121 @@
+"""group2ctx model parallelism (reference: example/model-parallel/lstm/lstm.py
+pattern; PlaceDevice pass graph_executor.cc:406; python/mxnet/attribute.py
+AttrScope).
+
+TPU-native: ctx groups map onto an 'mp' mesh axis — grouped params shard
+across the union of group devices (executor.py _build_group_shardings), so
+the memory-scaling intent of placement is delivered by GSPMD sharding.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 2,
+                                reason="needs >=2 devices")
+
+
+def _grouped_net():
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="dev1"):
+        fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+        act1 = mx.sym.Activation(fc1, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(act1, num_hidden=16, name="fc2")
+        act2 = mx.sym.Activation(fc2, act_type="relu")
+        fc3 = mx.sym.FullyConnected(act2, num_hidden=4, name="fc3")
+    return mx.sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def test_attr_scope_attaches_ctx_group():
+    net = _grouped_net()
+    attrs = net.attr_dict()
+    assert attrs["fc1_weight"]["ctx_group"] == "dev1"
+    assert attrs["fc2_weight"]["ctx_group"] == "dev2"
+    assert attrs["fc1"]["ctx_group"] == "dev1"
+    # scope nesting: inner overrides outer
+    with mx.AttrScope(ctx_group="a", foo="1"):
+        with mx.AttrScope(ctx_group="b"):
+            v = mx.sym.Variable("v")
+    assert v.attr("ctx_group") == "b"
+    assert v.attr("foo") == "1"
+
+
+def test_group2ctx_builds_mp_shardings():
+    net = _grouped_net()
+    group2ctx = {"dev1": mx.tpu(0), "dev2": mx.tpu(1)}
+    ex = net.simple_bind(mx.tpu(0), group2ctx=group2ctx,
+                         data=(8, 10), softmax_label=(8,))
+    sh = ex._group_shardings
+    assert sh is not None
+    # grouped weights are sharded along 'mp'; data replicated
+    assert "mp" in str(sh["fc1_weight"].spec)
+    assert "mp" in str(sh["fc2_weight"].spec)
+    assert sh["data"].spec == jax.sharding.PartitionSpec()
+
+
+def test_group2ctx_forward_backward_parity():
+    """The sharded (group2ctx) program must match the single-device one."""
+    net = _grouped_net()
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 1, (8, 10)).astype(np.float32)
+    y = rng.randint(0, 4, (8,)).astype(np.float32)
+    weights = {}
+
+    def bind(group2ctx):
+        ex = net.simple_bind(mx.tpu(0), group2ctx=group2ctx,
+                             data=(8, 10), softmax_label=(8,))
+        for name, arr in ex.arg_dict.items():
+            if name in ("data", "softmax_label"):
+                continue
+            if name not in weights:
+                weights[name] = rng.normal(0, 0.1, arr.shape).astype(np.float32)
+            arr[:] = weights[name]
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["softmax_label"][:] = y
+        return ex
+
+    ex_plain = bind(None)
+    out_plain = ex_plain.forward(is_train=True)[0].asnumpy()
+    ex_plain.backward()
+    g_plain = {n: g.asnumpy() for n, g in ex_plain.grad_dict.items()
+               if g is not None}
+
+    ex_mp = bind({"dev1": mx.tpu(0), "dev2": mx.tpu(1)})
+    out_mp = ex_mp.forward(is_train=True)[0].asnumpy()
+    ex_mp.backward()
+    np.testing.assert_allclose(out_plain, out_mp, rtol=1e-4, atol=1e-5)
+    for n, g in g_plain.items():
+        np.testing.assert_allclose(g, ex_mp.grad_dict[n].asnumpy(),
+                                   rtol=1e-3, atol=1e-4, err_msg=n)
+
+
+def test_group2ctx_model_parallel_lstm_pattern():
+    """The reference model-parallel LSTM example shape: per-layer ctx groups
+    (example/model-parallel/lstm/lstm.py:75) — unrolled cells in distinct
+    groups train under one program."""
+    num_layers, H = 2, 16
+    data = mx.sym.Variable("data")
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(num_layers):
+        with mx.AttrScope(ctx_group="layer%d" % i):
+            stack.add(mx.rnn.LSTMCell(H, prefix="l%d_" % i))
+    with mx.AttrScope(ctx_group="decode"):
+        outputs, _ = stack.unroll(5, data, merge_outputs=True)
+        pred = mx.sym.FullyConnected(mx.sym.Reshape(outputs, shape=(-1, H)),
+                                     num_hidden=4, name="pred")
+    net = mx.sym.SoftmaxOutput(pred, name="softmax")
+    group2ctx = {"layer0": mx.tpu(0), "layer1": mx.tpu(1),
+                 "decode": mx.tpu(0)}
+    ex = net.simple_bind(mx.tpu(0), group2ctx=group2ctx,
+                         data=(4, 5, 8), softmax_label=(20,))
+    rng = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        arr[:] = rng.normal(0, 0.1, arr.shape).astype(np.float32)
+    out = ex.forward(is_train=True)[0]
+    ex.backward()
+    assert out.shape == (20, 4)
+    assert all(np.isfinite(g.asnumpy()).all()
+               for g in ex.grad_dict.values() if g is not None)

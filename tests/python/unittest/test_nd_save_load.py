@@ -1,0 +1,147 @@
+"""Legacy binary NDArray save/load (reference: src/ndarray/ndarray.cc
+NDArray::Save/Load + python/mxnet/ndarray/utils.py:222).
+
+Pins the byte format (magic 0x112 list header, 0xF993fac9 V2 records) so
+checkpoints interchange with reference-produced `.params` files.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_save_load_dict_roundtrip(tmp_path):
+    f = str(tmp_path / "d.params")
+    data = {"w": mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4)),
+            "b": mx.nd.array(np.ones((4,), np.float32)),
+            "i": mx.nd.array(np.arange(5), dtype="int32")}
+    nd.save(f, data)
+    back = nd.load(f)
+    assert set(back) == {"w", "b", "i"}
+    for k in data:
+        np.testing.assert_array_equal(back[k].asnumpy(), data[k].asnumpy())
+        assert back[k].dtype == data[k].dtype
+
+
+def test_save_load_list_roundtrip(tmp_path):
+    f = str(tmp_path / "l.params")
+    arrs = [mx.nd.array(np.random.RandomState(i).normal(0, 1, (2, 3))
+                        .astype(np.float32)) for i in range(3)]
+    nd.save(f, arrs)
+    back = nd.load(f)
+    assert isinstance(back, list) and len(back) == 3
+    for a, b in zip(arrs, back):
+        np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+
+
+def test_save_load_dtypes(tmp_path):
+    f = str(tmp_path / "t.params")
+    arrays = {
+        "f32": np.array([1.5, -2.5], np.float32),
+        "f16": np.array([0.5, 2.0], np.float16),
+        "u8": np.array([0, 255], np.uint8),
+        "i32": np.array([-7, 9], np.int32),
+        "i8": np.array([-128, 127], np.int8),
+    }
+    nd.save(f, {k: mx.nd.array(v, dtype=v.dtype) for k, v in arrays.items()})
+    back = nd.load(f)
+    for k, v in arrays.items():
+        np.testing.assert_array_equal(back[k].asnumpy(), v)
+        assert back[k].dtype == v.dtype, k
+    # f64/i64 downcast to f32/i32 at NDArray construction (TPU framework,
+    # jax x64 off); values within range are preserved through save/load
+    nd.save(f, {"f64": mx.nd.array(np.array([1.25], np.float64)),
+                "i64": mx.nd.array(np.array([-9], np.int64), dtype=np.int64)})
+    back = nd.load(f)
+    np.testing.assert_array_equal(back["f64"].asnumpy(),
+                                  np.array([1.25], np.float32))
+    assert int(back["i64"].asnumpy()[0]) == -9
+
+
+def test_binary_layout_pinned(tmp_path):
+    """Golden bytes for one tiny fp32 array — guards byte-compatibility with
+    the reference serializer (ndarray.cc:1596 NDArray::Save)."""
+    f = str(tmp_path / "g.params")
+    nd.save(f, {"x": mx.nd.array(np.array([[1.0, 2.0]], np.float32))})
+    raw = open(f, "rb").read()
+    expect = b"".join([
+        struct.pack("<QQ", 0x112, 0),          # list magic + reserved
+        struct.pack("<Q", 1),                  # 1 array
+        struct.pack("<I", 0xF993FAC9),         # NDARRAY_V2_MAGIC
+        struct.pack("<i", 0),                  # stype: default
+        struct.pack("<I", 2),                  # ndim
+        struct.pack("<qq", 1, 2),              # int64 dims
+        struct.pack("<ii", 1, 0),              # context cpu(0)
+        struct.pack("<i", 0),                  # dtype: float32
+        np.array([[1.0, 2.0]], np.float32).tobytes(),
+        struct.pack("<Q", 1),                  # 1 name
+        struct.pack("<Q", 1), b"x",
+    ])
+    assert raw == expect
+
+
+def test_sparse_roundtrip(tmp_path):
+    f = str(tmp_path / "s.params")
+    dense = np.zeros((6, 4), np.float32)
+    dense[1] = 1.5
+    dense[4] = -2.0
+    rsp = mx.nd.sparse.row_sparse_array(dense)
+    csr = mx.nd.sparse.csr_matrix(dense)
+    nd.save(f, {"rsp": rsp, "csr": csr})
+    back = nd.load(f)
+    assert back["rsp"].stype == "row_sparse"
+    assert back["csr"].stype == "csr"
+    np.testing.assert_array_equal(back["rsp"].todense().asnumpy()
+                                  if hasattr(back["rsp"], "todense")
+                                  else back["rsp"].asnumpy(), dense)
+    np.testing.assert_array_equal(back["csr"].todense().asnumpy()
+                                  if hasattr(back["csr"], "todense")
+                                  else back["csr"].asnumpy(), dense)
+
+
+def test_npz_fallback(tmp_path):
+    """Earlier rounds wrote npz; load() must still read them."""
+    f = str(tmp_path / "old.params")
+    np.savez(f, **{"arg:w": np.ones((2, 2), np.float32)})
+    import os
+    os.replace(f + ".npz", f)
+    from mxnet_tpu.model import load_params
+    args, auxs = load_params(f)
+    np.testing.assert_array_equal(args["w"].asnumpy(), np.ones((2, 2)))
+
+
+def test_module_checkpoint_binary(tmp_path):
+    """Module.save_checkpoint now writes the binary container."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    X = np.random.RandomState(0).normal(0, 1, (8, 5)).astype(np.float32)
+    y = np.zeros((8,), np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=4, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+    raw = open(prefix + "-0001.params", "rb").read()
+    assert struct.unpack_from("<Q", raw, 0)[0] == 0x112
+    sym2, args2, aux2 = mx.model.load_checkpoint(prefix, 1)
+    a1, _ = mod.get_params()
+    for k in a1:
+        np.testing.assert_array_equal(a1[k].asnumpy(), args2[k].asnumpy())
+
+
+def test_gluon_save_load_binary(tmp_path):
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    f = str(tmp_path / "g.params")
+    net.save_parameters(f)
+    raw = open(f, "rb").read()
+    assert struct.unpack_from("<Q", raw, 0)[0] == 0x112
+    net2 = mx.gluon.nn.Dense(4, in_units=3)
+    net2.load_parameters(f)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(),
+                                  net2.weight.data().asnumpy())

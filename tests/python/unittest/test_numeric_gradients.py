@@ -1,0 +1,313 @@
+"""check_numeric_gradient sweep over the differentiable op catalog
+(reference: tests/python/unittest/test_operator.py runs per-op gradient
+checks; this sweep covers every major differentiable family with finite
+differences vs the executor's fused backward).
+
+Inputs are kept tiny (finite differences are O(n) forwards per op) and
+positive/offset where the op's domain requires it.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.util.test_utils import check_numeric_gradient
+
+RNG = np.random.RandomState(7)
+
+
+def _pos(shape, lo=0.3, hi=1.7):
+    return RNG.uniform(lo, hi, shape).astype(np.float32)
+
+
+def _sym(shape, scale=1.0):
+    return (RNG.uniform(-scale, scale, shape).astype(np.float32))
+
+
+def _away_from_kinks(shape, margin=0.25):
+    """Values kept |x|>margin so kinked ops (abs, relu, clip) don't land a
+    finite-difference step across the kink."""
+    x = RNG.uniform(margin, 1.0, shape).astype(np.float32)
+    sign = np.where(RNG.uniform(size=shape) < 0.5, -1.0, 1.0)
+    return (x * sign).astype(np.float32)
+
+
+X = mx.sym.Variable("x")
+Y = mx.sym.Variable("y")
+
+# (name, symbol, {input: value}) — one entry per differentiable family member
+UNARY = [
+    ("sigmoid", mx.sym.sigmoid(X), _sym((2, 3))),
+    ("tanh", mx.sym.tanh(X), _sym((2, 3))),
+    ("relu", mx.sym.relu(X), _away_from_kinks((2, 3))),
+    ("softrelu", mx.sym.Activation(X, act_type="softrelu"), _sym((2, 3))),
+    ("softsign", mx.sym.Activation(X, act_type="softsign"), _sym((2, 3))),
+    ("exp", mx.sym.exp(X), _sym((2, 3))),
+    ("log", mx.sym.log(X), _pos((2, 3))),
+    ("log2", mx.sym.log2(X), _pos((2, 3))),
+    ("log10", mx.sym.log10(X), _pos((2, 3))),
+    ("log1p", mx.sym.log1p(X), _pos((2, 3))),
+    ("expm1", mx.sym.expm1(X), _sym((2, 3))),
+    ("sqrt", mx.sym.sqrt(X), _pos((2, 3))),
+    ("rsqrt", mx.sym.rsqrt(X), _pos((2, 3))),
+    ("cbrt", mx.sym.cbrt(X), _pos((2, 3))),
+    ("rcbrt", mx.sym.rcbrt(X), _pos((2, 3))),
+    ("square", mx.sym.square(X), _sym((2, 3))),
+    ("reciprocal", mx.sym.reciprocal(X), _pos((2, 3))),
+    ("abs", mx.sym.abs(X), _away_from_kinks((2, 3))),
+    ("sin", mx.sym.sin(X), _sym((2, 3))),
+    ("cos", mx.sym.cos(X), _sym((2, 3))),
+    ("tan", mx.sym.tan(X), _sym((2, 3), 0.5)),
+    ("arcsin", mx.sym.arcsin(X), _sym((2, 3), 0.6)),
+    ("arccos", mx.sym.arccos(X), _sym((2, 3), 0.6)),
+    ("arctan", mx.sym.arctan(X), _sym((2, 3))),
+    ("sinh", mx.sym.sinh(X), _sym((2, 3))),
+    ("cosh", mx.sym.cosh(X), _sym((2, 3))),
+    ("arcsinh", mx.sym.arcsinh(X), _sym((2, 3))),
+    ("arccosh", mx.sym.arccosh(X), _pos((2, 3), 1.3, 2.5)),
+    ("arctanh", mx.sym.arctanh(X), _sym((2, 3), 0.6)),
+    ("degrees", mx.sym.degrees(X), _sym((2, 3))),
+    ("radians", mx.sym.radians(X), _sym((2, 3))),
+    ("gamma", mx.sym.gamma(X), _pos((2, 3), 1.2, 2.5)),
+    ("gammaln", mx.sym.gammaln(X), _pos((2, 3), 1.2, 2.5)),
+    ("erf", mx.sym.erf(X), _sym((2, 3))) if hasattr(mx.sym, "erf") else None,
+    ("softmax", mx.sym.softmax(X), _sym((2, 4))),
+    ("log_softmax", mx.sym.log_softmax(X), _sym((2, 4))),
+    ("flatten", mx.sym.Flatten(X), _sym((2, 2, 3))),
+    ("transpose", mx.sym.transpose(X, axes=(1, 0)), _sym((2, 3))),
+    ("reshape", mx.sym.Reshape(X, shape=(3, 2)), _sym((2, 3))),
+    ("expand_dims", mx.sym.expand_dims(X, axis=1), _sym((2, 3))),
+    ("slice", mx.sym.slice(X, begin=(0, 1), end=(2, 3)), _sym((3, 4))),
+    ("slice_axis", mx.sym.slice_axis(X, axis=1, begin=1, end=3),
+     _sym((2, 4))),
+    ("reverse", mx.sym.reverse(X, axis=1), _sym((2, 3))),
+    ("tile", mx.sym.tile(X, reps=(2, 1)), _sym((2, 3))),
+    ("repeat", mx.sym.repeat(X, repeats=2, axis=0), _sym((2, 3))),
+    ("pad", mx.sym.Pad(X, mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+     _sym((1, 1, 3, 3))),
+    ("clip", mx.sym.clip(X, a_min=-0.6, a_max=0.6), _away_from_kinks((2, 3))),
+    ("negative", mx.sym.negative(X), _sym((2, 3))),
+    ("sum", mx.sym.sum(X), _sym((2, 3))),
+    ("sum_axis", mx.sym.sum(X, axis=1), _sym((2, 3))),
+    ("mean", mx.sym.mean(X, axis=0), _sym((2, 3))),
+    ("prod", mx.sym.prod(X, axis=1), _pos((2, 3))),
+    ("nansum", mx.sym.nansum(X, axis=1), _sym((2, 3))),
+    ("max", mx.sym.max(X, axis=1), RNG.permutation(6).reshape(2, 3)
+     .astype(np.float32)),
+    ("min", mx.sym.min(X, axis=1), RNG.permutation(6).reshape(2, 3)
+     .astype(np.float32)),
+    ("norm", mx.sym.norm(X), _pos((2, 3))),
+    ("L2Normalization", mx.sym.L2Normalization(X), _sym((2, 3))),
+    ("LeakyReLU", mx.sym.LeakyReLU(X, act_type="leaky", slope=0.1),
+     _away_from_kinks((2, 3))),
+    ("elu", mx.sym.LeakyReLU(X, act_type="elu", slope=0.3),
+     _away_from_kinks((2, 3))),
+    ("softmax_activation", mx.sym.SoftmaxActivation(X), _sym((2, 4))),
+    ("smooth_l1", mx.sym.smooth_l1(X, scalar=1.0), _away_from_kinks((2, 3))
+     * 3),
+    ("sort", mx.sym.sort(X, axis=1), RNG.permutation(6).reshape(2, 3)
+     .astype(np.float32)),
+    ("gather_pick", mx.sym.pick(X, mx.sym.BlockGrad(Y), axis=1),
+     None),  # handled separately below
+]
+UNARY = [u for u in UNARY if u is not None and u[2] is not None]
+
+BINARY = [
+    ("add", X + Y), ("sub", X - Y), ("mul", X * Y), ("div", X / Y),
+    ("maximum", mx.sym.maximum(X, Y)), ("minimum", mx.sym.minimum(X, Y)),
+    ("hypot", mx.sym.hypot(X, Y)),
+    ("power", mx.sym.broadcast_power(X, Y)),
+    ("dot", mx.sym.dot(X, Y)),
+    ("batch_dot", mx.sym.batch_dot(X, Y)),
+    ("broadcast_add", mx.sym.broadcast_add(X, Y)),
+    ("broadcast_mul", mx.sym.broadcast_mul(X, Y)),
+]
+
+
+@pytest.mark.parametrize("name,sym,val", UNARY,
+                         ids=[u[0] for u in UNARY])
+def test_unary_gradient(name, sym, val):
+    check_numeric_gradient(sym, {"x": val}, numeric_eps=1e-3, rtol=2e-2,
+                           atol=2e-3)
+
+
+@pytest.mark.parametrize("name,sym", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_gradient(name, sym):
+    if name == "dot":
+        loc = {"x": _sym((2, 3)), "y": _sym((3, 2))}
+    elif name == "batch_dot":
+        loc = {"x": _sym((2, 2, 3)), "y": _sym((2, 3, 2))}
+    elif name == "power":
+        loc = {"x": _pos((2, 3), 0.5, 1.5), "y": _pos((2, 3), 0.5, 2.0)}
+    elif name in ("maximum", "minimum"):
+        a = _sym((2, 3))
+        loc = {"x": a, "y": a + _away_from_kinks((2, 3), 0.3)}
+    elif name.startswith("broadcast"):
+        loc = {"x": _sym((2, 3)), "y": _pos((1, 3))}
+    elif name == "div":
+        loc = {"x": _sym((2, 3)), "y": _pos((2, 3))}
+    else:
+        loc = {"x": _sym((2, 3)), "y": _sym((2, 3))}
+    check_numeric_gradient(sym, loc, numeric_eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+# ---- layer ops with parameters ------------------------------------------
+
+def test_fully_connected_gradient():
+    out = mx.sym.FullyConnected(X, num_hidden=4, name="fc")
+    check_numeric_gradient(out, {"x": _sym((2, 3)),
+                                 "fc_weight": _sym((4, 3)),
+                                 "fc_bias": _sym((4,))},
+                           numeric_eps=1e-2, rtol=2e-2, atol=2e-3)
+
+
+def test_convolution_gradient():
+    out = mx.sym.Convolution(X, kernel=(2, 2), num_filter=2, name="c")
+    check_numeric_gradient(out, {"x": _sym((1, 2, 4, 4)),
+                                 "c_weight": _sym((2, 2, 2, 2)),
+                                 "c_bias": _sym((2,))},
+                           numeric_eps=1e-2, rtol=3e-2, atol=3e-3)
+
+
+def test_deconvolution_gradient():
+    out = mx.sym.Deconvolution(X, kernel=(2, 2), num_filter=2, name="d")
+    check_numeric_gradient(out, {"x": _sym((1, 2, 3, 3)),
+                                 "d_weight": _sym((2, 2, 2, 2))},
+                           numeric_eps=1e-2, rtol=3e-2, atol=3e-3)
+
+
+def test_pooling_gradients():
+    for pt in ("avg", "max"):
+        out = mx.sym.Pooling(X, kernel=(2, 2), stride=(2, 2), pool_type=pt)
+        check_numeric_gradient(
+            out, {"x": RNG.permutation(16).reshape(1, 1, 4, 4)
+                  .astype(np.float32)},
+            numeric_eps=1e-2, rtol=3e-2, atol=3e-3)
+
+
+def test_batchnorm_gradient():
+    out = mx.sym.BatchNorm(X, name="bn", fix_gamma=False)
+    check_numeric_gradient(
+        out, {"x": _sym((4, 3)), "bn_gamma": _pos((3,)),
+              "bn_beta": _sym((3,))},
+        aux_states={"bn_moving_mean": np.zeros(3, np.float32),
+                    "bn_moving_var": np.ones(3, np.float32)},
+        numeric_eps=1e-2, rtol=4e-2, atol=4e-3)
+
+
+def test_layernorm_gradient():
+    out = mx.sym.LayerNorm(X, name="ln")
+    check_numeric_gradient(out, {"x": _sym((3, 4)), "ln_gamma": _pos((4,)),
+                                 "ln_beta": _sym((4,))},
+                           numeric_eps=1e-2, rtol=4e-2, atol=4e-3)
+
+
+def test_embedding_gradient():
+    out = mx.sym.Embedding(X, input_dim=5, output_dim=3, name="emb")
+    check_numeric_gradient(out, {"x": np.array([[0, 2], [4, 1]], np.float32),
+                                 "emb_weight": _sym((5, 3))},
+                           grad_nodes=["emb_weight"],
+                           numeric_eps=1e-2, rtol=2e-2, atol=2e-3)
+
+
+def test_take_gradient():
+    out = mx.sym.take(X, mx.sym.BlockGrad(Y))
+    check_numeric_gradient(out, {"x": _sym((4, 3)),
+                                 "y": np.array([0, 2], np.float32)},
+                           grad_nodes=["x"],
+                           numeric_eps=1e-2, rtol=2e-2, atol=2e-3)
+
+
+def test_concat_gradient():
+    out = mx.sym.Concat(X, Y, dim=1)
+    check_numeric_gradient(out, {"x": _sym((2, 2)), "y": _sym((2, 3))},
+                           numeric_eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_where_gradient():
+    cond = mx.sym.Variable("c")
+    out = mx.sym.where(mx.sym.BlockGrad(cond), X, Y)
+    check_numeric_gradient(out, {"c": np.array([[1, 0], [0, 1]], np.float32),
+                                 "x": _sym((2, 2)), "y": _sym((2, 2))},
+                           grad_nodes=["x", "y"],
+                           numeric_eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_linalg_gradients():
+    out = mx.sym.linalg_gemm2(X, Y)
+    check_numeric_gradient(out, {"x": _sym((2, 3)), "y": _sym((3, 2))},
+                           numeric_eps=1e-2, rtol=3e-2, atol=3e-3)
+    spd = _sym((3, 3))
+    spd = spd @ spd.T + 3 * np.eye(3, dtype=np.float32)
+    out = mx.sym.linalg_potrf(X)
+    check_numeric_gradient(out, {"x": spd}, numeric_eps=1e-2, rtol=5e-2,
+                           atol=5e-3)
+    out = mx.sym.linalg_sumlogdiag(X)
+    check_numeric_gradient(out, {"x": spd}, numeric_eps=1e-2, rtol=4e-2,
+                           atol=4e-3)
+
+
+def test_loss_layer_gradients():
+    """Loss output layers use custom VJPs that IGNORE the head gradient
+    (reference softmax_output-inl.h semantics), so finite differences of
+    the forward don't apply — assert the analytic gradient instead."""
+    lab = mx.sym.Variable("label")
+    x = _sym((3, 2))
+    label = _sym((3, 2))
+
+    def run_grad(sym):
+        ex = sym.simple_bind(mx.cpu(), grad_req={"x": "write",
+                                                 "label": "null"},
+                             x=(3, 2), label=(3, 2))
+        ex.arg_dict["x"][:] = x
+        ex.arg_dict["label"][:] = label
+        ex.forward(is_train=True)
+        ex.backward()
+        return ex.grad_dict["x"].asnumpy()
+
+    g = run_grad(mx.sym.LinearRegressionOutput(X, lab, name="lro"))
+    np.testing.assert_allclose(g, (x - label) / 3.0, rtol=1e-4, atol=1e-5)
+    g = run_grad(mx.sym.MAERegressionOutput(X, lab, name="mae"))
+    np.testing.assert_allclose(g, np.sign(x - label) / 3.0, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_blockgrad_stops_gradient():
+    """BlockGrad: the blocked branch contributes value but no gradient."""
+    out = mx.sym.make_loss(mx.sym.sigmoid(X) + mx.sym.BlockGrad(
+        mx.sym.tanh(X)))
+    x = _sym((2, 3))
+    ex = out.simple_bind(mx.cpu(), x=(2, 3))
+    ex.arg_dict["x"][:] = x
+    ex.forward(is_train=True)
+    ex.backward()
+    sig = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                               sig * (1 - sig), rtol=1e-4, atol=1e-5)
+
+
+def test_upsampling_gradient():
+    out = mx.sym.UpSampling(X, scale=2, sample_type="nearest")
+    check_numeric_gradient(out, {"x": _sym((1, 1, 2, 2))},
+                           numeric_eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_fork_op_gradients():
+    """WeightedL1 is a loss OUTPUT layer (fork op): analytic gradient
+    check, not finite differences of its identity-like forward."""
+    if not hasattr(mx.sym, "WeightedL1"):
+        pytest.skip("WeightedL1 not present")
+    lab = mx.sym.Variable("label")
+    x = _away_from_kinks((2, 3))
+    out = mx.sym.WeightedL1(X, lab, name="wl1")
+    ex = out.simple_bind(mx.cpu(), grad_req={"x": "write", "label": "null"},
+                         x=(2, 3), label=(2, 3))
+    label = np.full((2, 3), 0.1, np.float32)
+    label[0, 0] = 0.0  # masked position: zero gradient there
+    ex.arg_dict["x"][:] = x
+    ex.arg_dict["label"][:] = label
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["x"].asnumpy()
+    # gradient of the L1 head: sign(pred-label), masked where label == 0
+    expect = np.sign(x - label) * (label != 0)
+    np.testing.assert_array_equal(np.sign(g), np.sign(expect))

