@@ -21,7 +21,7 @@ import time
 
 BASELINE_INFER_P100 = 713.17   # ResNet-50 score b32, docs/faq/perf.md:137-144
 BASELINE_TRAIN_P100 = 181.53   # ResNet-50 train b32, docs/faq/perf.md:178-185
-CHILD_TIMEOUT_S = 2400
+CHILD_TIMEOUT_S = 1500
 
 
 def _emit(value, vs_baseline, extra):
@@ -59,13 +59,21 @@ def _run_child(force_cpu):
 
 def main():
     errors = []
-    for attempt, force_cpu in ((1, False), (2, False), (3, True)):
+    attempts = [(1, False), (2, False), (3, True)]
+    i = 0
+    while i < len(attempts):
+        attempt, force_cpu = attempts[i]
         result, err = _run_child(force_cpu)
         if result is not None:
             _emit(result["value"], result["vs_baseline"], result["extra"])
             return
         errors.append("attempt%d(%s): %s"
                       % (attempt, "cpu" if force_cpu else "default", err))
+        if not force_cpu and err and err.startswith("timeout"):
+            # a hung TPU init won't heal on retry — go straight to CPU
+            i = len(attempts) - 1
+        else:
+            i += 1
         time.sleep(5)
     _emit(0.0, 0.0, {"platform": "none", "error": "; ".join(errors)[-2000:]})
 

@@ -1,0 +1,81 @@
+/*
+ * mxnet_tpu C API — the native runtime's stable C ABI
+ * (reference analog: include/mxnet/c_api.h, the libmxnet.so boundary that
+ * language bindings and embedders consume).
+ *
+ * TPU-native split of the reference's C surface:
+ *  - COMPUTE lives behind XLA's own stable C ABI (the PJRT C API,
+ *    libtpu/PJRT plugin) — graphs compiled from the Python layer execute
+ *    through PJRT; re-wrapping that here would duplicate a maintained
+ *    standard. (Reference equivalent: the ~200 MXNDArray- and
+ *    MXSymbol-prefixed entry points.)
+ *  - The RUNTIME pieces that are native in this framework — the threaded
+ *    image/RecordIO pipeline and the pooled host staging allocator —
+ *    export the C ABI declared below (implemented in src/io/ and
+ *    src/storage/, shipped in libmxtpu_io.so, consumed by Python via
+ *    ctypes and by embedders directly).
+ *
+ * All functions are thread-safe. Errors: functions returning pointers
+ * yield NULL and set a thread-local message readable via
+ * MXTIOGetLastError(); MXTIONext returns -2 on error.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- error handling --------------------------------------------------- */
+
+/* Last error message of the calling thread (empty string if none). */
+const char* MXTIOGetLastError(void);
+
+/* ---- ImageRecordIter: threaded decode/augment/batch pipeline ---------- */
+
+/* Create an iterator over a RecordIO file of packed images.
+ * mean/stdv: per-channel normalization (length 3, may be NULL).
+ * Returns an opaque handle or NULL (see MXTIOGetLastError). */
+void* MXTIOCreateImageRecordIter(
+    const char* path_imgrec, int batch_size, int channels, int height,
+    int width, int preprocess_threads, int shuffle, unsigned seed,
+    int num_parts, int part_index, const float* mean, const float* stdv,
+    int rand_crop, int rand_mirror, int resize, int label_width,
+    int round_batch, int prefetch_depth);
+
+/* Fill data_out [batch*c*h*w] and label_out [batch*label_width].
+ * Returns pad count (>=0), -1 at epoch end, -2 on error. */
+int MXTIONext(void* handle, float* data_out, float* label_out);
+
+/* Rewind to the start of the epoch (reshuffles if enabled). */
+void MXTIOReset(void* handle);
+
+/* Number of records in this iterator's shard. */
+long long MXTIONumSamples(void* handle);
+
+/* Destroy the iterator and join its worker threads. */
+void MXTIOFree(void* handle);
+
+/* ---- pooled host staging allocator ------------------------------------ */
+
+/* Page-aligned allocation from the size-class pool (never returns memory
+ * to the OS until MXTStorageReleaseAll). NULL on failure or size 0. */
+void* MXTStorageAlloc(size_t size);
+
+/* Return a buffer to the pool (it stays allocated for reuse). */
+void MXTStorageFree(void* ptr);
+
+/* Free every pooled (idle) buffer back to the OS. */
+void MXTStorageReleaseAll(void);
+
+/* out[5] = {bytes_in_use, bytes_pooled, hits, misses, frees}. */
+void MXTStorageStats(uint64_t* out);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

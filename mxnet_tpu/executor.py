@@ -212,6 +212,12 @@ class Executor:
         key = ("fb", with_out_grads)
         if key not in self._cached:
             grad_names = tuple(self._grad_names)
+            # MXNET_BACKWARD_DO_MIRROR: trade FLOPs for memory by
+            # rematerializing forward activations in the backward pass
+            # (reference: graph mirroring, src/executor/graph_executor.cc +
+            # docs/faq/env_var.md). TPU-native form: jax.checkpoint.
+            from .base import env_flag
+            do_mirror = env_flag("MXNET_BACKWARD_DO_MIRROR")
 
             def f(grad_args, other_args, aux_vals, rng, out_grads=None):
                 def inner(ga):
@@ -219,6 +225,8 @@ class Executor:
                     all_args.update(ga)
                     outs, aux_upd = self._run_graph(all_args, aux_vals, rng, True)
                     return outs, aux_upd
+                if do_mirror:
+                    inner = jax.checkpoint(inner)
                 outs, vjp, aux_upd = jax.vjp(inner, grad_args, has_aux=True)
                 if out_grads is None:
                     seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)

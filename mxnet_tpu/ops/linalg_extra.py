@@ -77,11 +77,13 @@ def _linalg_potri(params, a):
     return jnp.matmul(_t(linv, True), linv)
 
 
-@register_op("linalg_gelqf", input_names=("A",), num_outputs=2)
+@register_op("linalg_gelqf", input_names=("A",), num_outputs=2,
+             output_names=("Q", "L"))
 def _linalg_gelqf(params, a):
-    """LQ factorization A = L Q (rows orthonormal Q) via QR of A^T."""
+    """LQ factorization A = L Q (rows-orthonormal Q) via QR of A^T.
+    Returns (Q, L) — the reference's output order (la_op.cc:511)."""
     q, r = jnp.linalg.qr(_t(a, True))
-    return _t(r, True), _t(q, True)
+    return _t(q, True), _t(r, True)
 
 
 @register_op("linalg_syevd", input_names=("A",), num_outputs=2)

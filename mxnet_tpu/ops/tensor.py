@@ -370,10 +370,17 @@ def _potrf(params, a):
     return jnp.linalg.cholesky(a)
 
 
-@register_op("linalg_syrk", input_names=("A",), param_cls=DotParam)
+class SyrkParam(Params):
+    transpose = param_field(bool, default=False)
+    alpha = param_field(float, default=1.0)
+
+
+@register_op("linalg_syrk", input_names=("A",), param_cls=SyrkParam)
 def _syrk(params, a):
+    """alpha * A A^T (or A^T A) — reference la_op.cc linalg_syrk."""
     at = jnp.swapaxes(a, -1, -2)
-    return jnp.matmul(a, at) if not params.transpose_a else jnp.matmul(at, a)
+    out = jnp.matmul(a, at) if not params.transpose else jnp.matmul(at, a)
+    return params.alpha * out
 
 
 # ---------------------------------------------------------------------------

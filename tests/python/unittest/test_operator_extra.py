@@ -287,7 +287,7 @@ def test_linalg_potri_potrf():
 def test_linalg_gelqf():
     rng = np.random.RandomState(0)
     a = rng.normal(size=(3, 5)).astype(np.float32)
-    l, q = (x.asnumpy() for x in mx.nd.linalg_gelqf(_a(a)))
+    q, l = (x.asnumpy() for x in mx.nd.linalg_gelqf(_a(a)))  # (Q, L) order
     np.testing.assert_allclose(l @ q, a, atol=1e-4)
     np.testing.assert_allclose(q @ q.T, np.eye(3), atol=1e-4)
 
