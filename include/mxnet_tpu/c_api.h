@@ -46,6 +46,17 @@ void* MXTIOCreateImageRecordIter(
     int rand_crop, int rand_mirror, int resize, int label_width,
     int round_batch, int prefetch_depth);
 
+/* Extended creator: aug (length 7, may be NULL) = {brightness, contrast,
+ * saturation, pca_noise, max_rotate_angle, min_random_scale,
+ * max_random_scale} — the reference DefaultImageAugmenter's color and
+ * geometric jitters. */
+void* MXTIOCreateImageRecordIterEx(
+    const char* path_imgrec, int batch_size, int channels, int height,
+    int width, int preprocess_threads, int shuffle, unsigned seed,
+    int num_parts, int part_index, const float* mean, const float* stdv,
+    int rand_crop, int rand_mirror, int resize, int label_width,
+    int round_batch, int prefetch_depth, const float* aug);
+
 /* Fill data_out [batch*c*h*w] and label_out [batch*label_width].
  * Returns pad count (>=0), -1 at epoch end, -2 on error. */
 int MXTIONext(void* handle, float* data_out, float* label_out);

@@ -56,6 +56,10 @@ def _load():
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int]
+        lib.MXTIOCreateImageRecordIterEx.restype = ctypes.c_void_p
+        lib.MXTIOCreateImageRecordIterEx.argtypes = (
+            lib.MXTIOCreateImageRecordIter.argtypes
+            + [ctypes.POINTER(ctypes.c_float)])
         lib.MXTIONext.restype = ctypes.c_int
         lib.MXTIONext.argtypes = [ctypes.c_void_p,
                                   ctypes.POINTER(ctypes.c_float),

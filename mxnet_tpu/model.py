@@ -36,8 +36,13 @@ def _create_kvstore(kvstore, num_device, arg_params):
         else:
             kv = kvs.create(kvstore)
             if kvstore == "local":
+                # reference: MXNET_KVSTORE_BIGARRAY_BOUND (env_var.md) —
+                # params above the bound update on workers, not the store
+                from .base import get_env
+                bound = get_env("MXNET_KVSTORE_BIGARRAY_BOUND",
+                                1024 * 1024 * 16, int)
                 max_size = max(_np.prod(param.shape) for param in arg_params.values())
-                if max_size > 1024 * 1024 * 16:
+                if max_size > bound:
                     update_on_kvstore = False
     else:
         raise TypeError("kvstore must be KVStore, str or None")

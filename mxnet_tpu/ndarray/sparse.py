@@ -33,6 +33,16 @@ class BaseSparseNDArray(NDArray):
     def shape(self):
         return self._shape
 
+    @property
+    def nnz(self):
+        """Stored value count (reference: BaseSparseNDArray nnz)."""
+        return int(self._data.size)
+
+    @property
+    def density(self):
+        total = int(_np.prod(self._shape)) or 1
+        return self.nnz / total
+
     def asnumpy(self):
         return self.todense().asnumpy()
 
@@ -45,6 +55,44 @@ class BaseSparseNDArray(NDArray):
         if stype == self.stype:
             return self
         return cast_storage(self.todense(), stype)
+
+    def astype(self, dtype):
+        out = self.copy()
+        out._data = out._data.astype(np_dtype(dtype))
+        return out
+
+    def sum(self, axis=None):
+        return self.todense().sum(axis=axis)
+
+    def mean(self, axis=None):
+        return self.todense().mean(axis=axis)
+
+    def __mul__(self, other):
+        """Scalar multiply keeps the sparsity structure (reference:
+        _mul_scalar csr/rsp kernels)."""
+        if isinstance(other, (int, float)):
+            out = self.copy()
+            out._data = out._data * other
+            return out
+        return self.todense() * (other.todense()
+                                 if isinstance(other, BaseSparseNDArray)
+                                 else other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, (int, float)):
+            out = self.copy()
+            out._data = out._data / other
+            return out
+        return self.todense() / (other.todense()
+                                 if isinstance(other, BaseSparseNDArray)
+                                 else other)
+
+    def __neg__(self):
+        out = self.copy()
+        out._data = -out._data
+        return out
 
     def __repr__(self):
         return "\n<%s %s @%s>" % (type(self).__name__,
@@ -88,13 +136,62 @@ class CSRNDArray(BaseSparseNDArray):
                               ctx=other)
         return super().copyto(other)
 
+    def copy(self):
+        return CSRNDArray(self._data, self._indices, self._indptr,
+                          self._shape, ctx=self._ctx)
+
     def __getitem__(self, key):
+        """Row slicing WITHOUT densifying: slice indptr, take the nnz
+        window (reference: sparse.py CSRNDArray.__getitem__ -> slice op's
+        csr kernel)."""
+        if isinstance(key, int):
+            n = self._shape[0]
+            if key < -n or key >= n:
+                raise MXNetError("row index %d out of range for %d rows"
+                                 % (key, n))
+            key = key + n if key < 0 else key
+            key = slice(key, key + 1)
         if isinstance(key, slice):
-            start = key.start or 0
-            stop = key.stop if key.stop is not None else self._shape[0]
-            d = self.todense().asnumpy()[start:stop]
-            return array(_np_csr(d), ctx=self._ctx)
+            if key.step not in (None, 1):
+                raise MXNetError("CSRNDArray slicing supports step 1 only")
+            n = self._shape[0]
+            start, stop, _ = key.indices(n)  # numpy slice semantics
+            stop = max(start, stop)
+            lo = int(self._indptr[start])
+            hi = int(self._indptr[stop])
+            return CSRNDArray(self._data[lo:hi], self._indices[lo:hi],
+                              self._indptr[start:stop + 1] - lo,
+                              (stop - start, self._shape[1]), ctx=self._ctx)
         raise MXNetError("CSRNDArray supports only row-slice indexing")
+
+    def check_format(self, full_check=True):
+        """Validate CSR invariants (reference: sparse.py check_format ->
+        CheckFormatCsrImpl): indptr non-decreasing, starts at 0, ends at
+        nnz; indices within [0, cols) and sorted per row."""
+        indptr = _np.asarray(self._indptr)
+        indices = _np.asarray(self._indices)
+        if indptr.shape[0] != self._shape[0] + 1:
+            raise MXNetError("csr indptr length %d != rows+1 (%d)"
+                             % (indptr.shape[0], self._shape[0] + 1))
+        if indptr[0] != 0 or indptr[-1] != indices.shape[0]:
+            raise MXNetError("csr indptr must span [0, nnz]")
+        if (_np.diff(indptr) < 0).any():
+            raise MXNetError("csr indptr must be non-decreasing")
+        if full_check and indices.size:
+            if indices.min() < 0 or indices.max() >= self._shape[1]:
+                raise MXNetError("csr indices out of range")
+            for r in range(self._shape[0]):
+                seg = indices[indptr[r]:indptr[r + 1]]
+                if (_np.diff(seg) <= 0).any():
+                    raise MXNetError("csr indices must be sorted, unique "
+                                     "within row %d" % r)
+
+    def asscipy(self):
+        """scipy.sparse.csr_matrix view (reference: sparse.py asscipy)."""
+        from scipy.sparse import csr_matrix as _scipy_csr
+        return _scipy_csr((_np.asarray(self._data),
+                           _np.asarray(self._indices),
+                           _np.asarray(self._indptr)), shape=self._shape)
 
 
 class RowSparseNDArray(BaseSparseNDArray):
@@ -122,6 +219,44 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def retain(self, indices):
         return retain(self, indices)
+
+    def copy(self):
+        return RowSparseNDArray(self._data, self._indices, self._shape,
+                                ctx=self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return RowSparseNDArray(self._data, self._indices, self._shape,
+                                    ctx=other)
+        return super().copyto(other)
+
+    def __getitem__(self, key):
+        """Row slicing on the stored rows (reference: sparse.py
+        RowSparseNDArray.__getitem__, full-slice + row-slice support)."""
+        if isinstance(key, slice):
+            if key.step not in (None, 1):
+                raise MXNetError("RowSparseNDArray slicing supports step 1")
+            n = self._shape[0]
+            start, stop, _ = key.indices(n)  # numpy slice semantics
+            stop = max(start, stop)
+            idx = _np.asarray(self._indices)
+            mask = (idx >= start) & (idx < stop)
+            return RowSparseNDArray(
+                _np.asarray(self._data)[mask], idx[mask] - start,
+                (stop - start,) + self._shape[1:], ctx=self._ctx)
+        raise MXNetError("RowSparseNDArray supports only row-slice indexing")
+
+    def check_format(self, full_check=True):
+        """Validate rsp invariants: indices sorted, unique, in range
+        (reference: CheckFormatRSPImpl)."""
+        idx = _np.asarray(self._indices)
+        if idx.shape[0] != self._data.shape[0]:
+            raise MXNetError("rsp indices length != stored row count")
+        if full_check and idx.size:
+            if idx.min() < 0 or idx.max() >= self._shape[0]:
+                raise MXNetError("rsp indices out of range")
+            if (_np.diff(idx) <= 0).any():
+                raise MXNetError("rsp indices must be sorted and unique")
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):

@@ -27,14 +27,21 @@ __all__ = ["ImageRecordIter"]
 
 class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
-                 shuffle=False, preprocess_threads=4, seed=0,
+                 shuffle=False, preprocess_threads=None, seed=0,
                  num_parts=1, part_index=0,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0,
                  rand_crop=False, rand_mirror=False, resize=-1,
                  round_batch=True, prefetch_buffer=4,
+                 brightness=0.0, contrast=0.0, saturation=0.0,
+                 pca_noise=0.0, max_rotate_angle=0.0,
+                 min_random_scale=1.0, max_random_scale=1.0,
                  data_name="data", label_name="softmax_label", **kwargs):
         super().__init__(batch_size)
+        if preprocess_threads is None:
+            # reference: MXNET_CPU_WORKER_NTHREADS sizes the decode pool
+            from .base import get_env
+            preprocess_threads = get_env("MXNET_CPU_WORKER_NTHREADS", 4, int)
         from . import _native
         self._lib = _native.get_lib()
         data_shape = tuple(int(x) for x in data_shape)
@@ -47,12 +54,16 @@ class ImageRecordIter(DataIter):
         c, h, w = data_shape
         mean = (ctypes.c_float * 3)(mean_r, mean_g, mean_b)
         std = (ctypes.c_float * 3)(std_r, std_g, std_b)
-        self._handle = self._lib.MXTIOCreateImageRecordIter(
+        aug = (ctypes.c_float * 7)(brightness, contrast, saturation,
+                                   pca_noise, max_rotate_angle,
+                                   min_random_scale, max_random_scale)
+        self._handle = self._lib.MXTIOCreateImageRecordIterEx(
             str(path_imgrec).encode(), int(batch_size), c, h, w,
             int(preprocess_threads), int(bool(shuffle)), int(seed),
             int(num_parts), int(part_index), mean, std,
             int(bool(rand_crop)), int(bool(rand_mirror)), int(resize),
-            self.label_width, int(bool(round_batch)), int(prefetch_buffer))
+            self.label_width, int(bool(round_batch)), int(prefetch_buffer),
+            aug)
         if not self._handle:
             raise MXNetError("ImageRecordIter: %s" % _native.last_error())
         # staging buffers from the pooled host allocator (storage.py /

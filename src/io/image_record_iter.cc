@@ -60,6 +60,15 @@ struct ImageRecParams {
   int label_width = 1;
   bool round_batch = true;   // pad last batch from epoch start (pad count reported)
   int prefetch_depth = 4;
+  // color/geometric augmenters (reference: src/io/image_aug_default.cc
+  // DefaultImageAugmenter params)
+  float brightness = 0.f;        // jitter in [1-b, 1+b]
+  float contrast = 0.f;
+  float saturation = 0.f;
+  float pca_noise = 0.f;         // ImageNet PCA lighting noise stddev
+  float max_rotate_angle = 0.f;  // degrees
+  float min_random_scale = 1.f;  // shorter-side resize scale jitter
+  float max_random_scale = 1.f;
 };
 
 struct Batch {
@@ -304,11 +313,32 @@ class ImageRecordIter {
     cv::Mat img = cv::imdecode(raw, c == 1 ? cv::IMREAD_GRAYSCALE
                                            : cv::IMREAD_COLOR);
     if (img.empty()) throw std::runtime_error("image decode failed");
+    std::uniform_real_distribution<float> uni01(0.f, 1.f);
+    // rotation (reference image_aug_default.cc: uniform in +-angle)
+    if (p_.max_rotate_angle > 0.f) {
+      float angle = (uni01(rng) * 2.f - 1.f) * p_.max_rotate_angle;
+      cv::Mat rot = cv::getRotationMatrix2D(
+          cv::Point2f(img.cols / 2.f, img.rows / 2.f), angle, 1.0);
+      cv::warpAffine(img, img, rot, img.size(), cv::INTER_LINEAR,
+                     cv::BORDER_REFLECT_101);
+    }
+    float rscale = 1.f;
+    if (p_.max_random_scale > p_.min_random_scale)
+      rscale = p_.min_random_scale
+               + uni01(rng) * (p_.max_random_scale - p_.min_random_scale);
+    else
+      rscale = p_.min_random_scale;
     if (p_.resize > 0) {
       int sw = img.cols, sh = img.rows;
-      double scale = static_cast<double>(p_.resize) / std::min(sw, sh);
+      double scale = rscale * static_cast<double>(p_.resize)
+                     / std::min(sw, sh);
       cv::resize(img, img, cv::Size(std::max(w, static_cast<int>(sw * scale)),
                                     std::max(h, static_cast<int>(sh * scale))),
+                 0, 0, cv::INTER_LINEAR);
+    } else if (rscale != 1.f) {
+      cv::resize(img, img,
+                 cv::Size(std::max(w, static_cast<int>(img.cols * rscale)),
+                          std::max(h, static_cast<int>(img.rows * rscale))),
                  0, 0, cv::INTER_LINEAR);
     }
     if (img.cols < w || img.rows < h)
@@ -326,7 +356,75 @@ class ImageRecordIter {
     bool mirror = p_.rand_mirror &&
                   std::uniform_int_distribution<int>(0, 1)(rng);
     if (mirror) cv::flip(crop, crop, 1);
+
+    // color jitter in float, RGB order (reference applies brightness,
+    // then contrast vs the mean gray, then saturation vs per-pixel gray,
+    // then PCA lighting noise — image_aug_default.cc)
+    const bool color = c == 3 && (p_.brightness > 0.f || p_.contrast > 0.f
+                                  || p_.saturation > 0.f
+                                  || p_.pca_noise > 0.f);
+    float balpha = 1.f, calpha = 1.f, salpha = 1.f;
+    float pca[3] = {0.f, 0.f, 0.f};
+    if (color) {
+      auto jitter = [&](float amt) {
+        return 1.f + (uni01(rng) * 2.f - 1.f) * amt;
+      };
+      balpha = p_.brightness > 0.f ? jitter(p_.brightness) : 1.f;
+      calpha = p_.contrast > 0.f ? jitter(p_.contrast) : 1.f;
+      salpha = p_.saturation > 0.f ? jitter(p_.saturation) : 1.f;
+      if (p_.pca_noise > 0.f) {
+        // ImageNet eigen basis (reference image_aug_default.cc kEig*)
+        static const float eigval[3] = {55.46f, 4.794f, 1.148f};
+        static const float eigvec[3][3] = {
+            {-0.5675f, 0.7192f, 0.4009f},
+            {-0.5808f, -0.0045f, -0.8140f},
+            {-0.5836f, -0.6948f, 0.4203f}};
+        std::normal_distribution<float> gauss(0.f, p_.pca_noise);
+        float a[3] = {gauss(rng), gauss(rng), gauss(rng)};
+        for (int k = 0; k < 3; ++k)
+          pca[k] = eigvec[k][0] * a[0] * eigval[0]
+                   + eigvec[k][1] * a[1] * eigval[1]
+                   + eigvec[k][2] * a[2] * eigval[2];
+      }
+    }
+    float gray_mean = 0.f;
+    if (color && calpha != 1.f) {
+      cv::Scalar m = cv::mean(crop);  // BGR
+      gray_mean = 0.114f * static_cast<float>(m[0])
+                  + 0.587f * static_cast<float>(m[1])
+                  + 0.299f * static_cast<float>(m[2]);
+    }
     // OpenCV is BGR; reference emits RGB-ordered channels (r=2-k swap)
+    if (color) {
+      // one pixel pass writing all three planes: the gray/jitter chain is
+      // computed once per pixel, not once per output channel.
+      // Sequential linear jitters; gray/mean are transformed the same way
+      // so each stage sees the previous stage's image.
+      const float mean1 = gray_mean * balpha;
+      float inv[3], mean_out[3];
+      for (int k = 0; k < 3; ++k) {
+        mean_out[k] = p_.mean[k];
+        inv[k] = p_.std_[k] != 0.f ? 1.f / p_.std_[k] : 1.f;
+      }
+      for (int y = 0; y < h; ++y) {
+        const uint8_t* row = crop.ptr<uint8_t>(y);
+        for (int x = 0; x < w; ++x) {
+          float rgb[3] = {static_cast<float>(row[x * 3 + 2]),
+                          static_cast<float>(row[x * 3 + 1]),
+                          static_cast<float>(row[x * 3 + 0])};
+          float gray = 0.299f * rgb[0] + 0.587f * rgb[1] + 0.114f * rgb[2];
+          float gray2 = (gray * balpha) * calpha + (1.f - calpha) * mean1;
+          for (int k = 0; k < 3; ++k) {
+            float v = rgb[k] * balpha;                    // brightness
+            v = v * calpha + (1.f - calpha) * mean1;      // contrast
+            v = v * salpha + (1.f - salpha) * gray2;      // saturation
+            v += pca[k];                                  // lighting noise
+            out[k * h * w + y * w + x] = (v - mean_out[k]) * inv[k];
+          }
+        }
+      }
+      return;
+    }
     for (int k = 0; k < c; ++k) {
       int src_ch = (c == 3) ? 2 - k : k;
       float mean = p_.mean[k], stdv = p_.std_[k];
@@ -410,12 +508,12 @@ extern "C" {
 
 const char* MXTIOGetLastError() { return g_last_error.c_str(); }
 
-void* MXTIOCreateImageRecordIter(
+void* MXTIOCreateImageRecordIterEx(
     const char* path_imgrec, int batch_size, int channels, int height,
     int width, int preprocess_threads, int shuffle, unsigned seed,
     int num_parts, int part_index, const float* mean, const float* stdv,
     int rand_crop, int rand_mirror, int resize, int label_width,
-    int round_batch, int prefetch_depth) {
+    int round_batch, int prefetch_depth, const float* aug) {
   try {
     mxtpu::ImageRecParams p;
     p.path_imgrec = path_imgrec;
@@ -438,11 +536,34 @@ void* MXTIOCreateImageRecordIter(
     p.label_width = std::max(1, label_width);
     p.round_batch = round_batch != 0;
     p.prefetch_depth = std::max(1, prefetch_depth);
+    if (aug) {  // {brightness, contrast, saturation, pca_noise,
+                //  max_rotate_angle, min_random_scale, max_random_scale}
+      p.brightness = aug[0];
+      p.contrast = aug[1];
+      p.saturation = aug[2];
+      p.pca_noise = aug[3];
+      p.max_rotate_angle = aug[4];
+      p.min_random_scale = aug[5];
+      p.max_random_scale = aug[6];
+    }
     return new mxtpu::ImageRecordIter(p);
   } catch (const std::exception& e) {
     g_last_error = e.what();
     return nullptr;
   }
+}
+
+void* MXTIOCreateImageRecordIter(
+    const char* path_imgrec, int batch_size, int channels, int height,
+    int width, int preprocess_threads, int shuffle, unsigned seed,
+    int num_parts, int part_index, const float* mean, const float* stdv,
+    int rand_crop, int rand_mirror, int resize, int label_width,
+    int round_batch, int prefetch_depth) {
+  return MXTIOCreateImageRecordIterEx(
+      path_imgrec, batch_size, channels, height, width, preprocess_threads,
+      shuffle, seed, num_parts, part_index, mean, stdv, rand_crop,
+      rand_mirror, resize, label_width, round_batch, prefetch_depth,
+      nullptr);
 }
 
 int MXTIONext(void* handle, float* data_out, float* label_out) {

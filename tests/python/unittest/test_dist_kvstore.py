@@ -73,3 +73,66 @@ def test_two_process_dist_sync_aggregation(tmp_path):
         # 2*1 + 2*2 = 6
         np.testing.assert_array_equal(np.asarray(res["sum2"]),
                                       np.full((3, 4), 6.0))
+
+
+TRAIN_WORKER = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    sys.path.insert(0, %(repo)r)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx   # package init joins the process group
+
+    rank = jax.process_index()
+    # each worker gets its own half of a shared synthetic dataset
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (320, 10)).astype(np.float32)
+    W = rng.normal(0, 1, (10, 4)).astype(np.float32)
+    y = (X @ W).argmax(1).astype(np.float32)
+    Xw = X[rank::2]
+    yw = y[rank::2]
+    it = mx.io.NDArrayIter(Xw, yw, batch_size=16, label_name="softmax_label")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=12, kvstore="dist_sync",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
+            initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=1.0))
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    args, _ = mod.get_params()
+    with open(%(outdir)r + "/train%%d.json" %% rank, "w") as f:
+        json.dump({"acc": float(acc),
+                   "w": args["fc_weight"].asnumpy().tolist()}, f)
+""")
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
+                    reason="dist tests disabled")
+def test_two_process_module_training_converges(tmp_path):
+    """SURVEY §3.2: Module.fit over dist_sync across 2 real processes —
+    both workers converge and end with IDENTICAL weights (synchronous
+    data parallelism)."""
+    worker_py = tmp_path / "train_worker.py"
+    worker_py.write_text(TRAIN_WORKER % {"repo": REPO,
+                                         "outdir": str(tmp_path)})
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--coordinator-port", "23459", "--",
+         sys.executable, str(worker_py)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    results = []
+    for rank in range(2):
+        with open(tmp_path / ("train%d.json" % rank)) as f:
+            results.append(json.load(f))
+    for r in results:
+        assert r["acc"] > 0.9, results
+    np.testing.assert_allclose(np.asarray(results[0]["w"]),
+                               np.asarray(results[1]["w"]), atol=1e-5)

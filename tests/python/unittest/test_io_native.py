@@ -200,3 +200,65 @@ def test_continuation_record_roundtrip(tmp_path):
     # raw file structure: record 2 must have been split (contains >1 magic)
     raw = open(path, "rb").read()
     assert raw.count(magic) > len(payloads)  # seams present on disk
+
+
+def test_color_geometric_augmenters(tmp_path):
+    """Reference DefaultImageAugmenter jitters (image_aug_default.cc):
+    brightness/contrast/saturation/pca/rotate/scale wired through the Ex
+    C entry point. Statistical checks on solid-color images."""
+    path = str(tmp_path / "aug.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    import cv2
+    for i in range(8):
+        img = np.full((40, 40, 3), 120, np.uint8)
+        rec.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                    img, quality=100))
+    rec.close()
+
+    def batch_mean(**kw):
+        it = ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                             batch_size=8, seed=3, **kw)
+        return next(iter(it)).data[0].asnumpy()
+
+    base = batch_mean()
+    np.testing.assert_allclose(base, 120.0, atol=2.0)
+
+    # brightness jitter moves per-image means apart
+    b = batch_mean(brightness=0.4)
+    per_img = b.mean(axis=(1, 2, 3))
+    assert per_img.std() > 2.0, per_img
+    assert abs(b.mean() - 120.0) < 40.0
+
+    # saturation on a gray image is a no-op (gray == value)
+    s = batch_mean(saturation=0.5)
+    np.testing.assert_allclose(s, 120.0, atol=2.5)
+
+    # pca noise shifts channels jointly but images stay finite, near base
+    p = batch_mean(pca_noise=0.1)
+    assert np.isfinite(p).all()
+    assert abs(p.mean() - 120.0) < 30.0
+
+    # rotation of a solid image changes nothing; of a structured image it
+    # moves pixels
+    img_struct = np.zeros((40, 40, 3), np.uint8)
+    img_struct[:, :20] = 200
+    path2 = str(tmp_path / "rot.rec")
+    rec2 = recordio.MXRecordIO(path2, "w")
+    for i in range(4):
+        rec2.write(recordio.pack_img(recordio.IRHeader(0, 0.0, i, 0),
+                                     img_struct, quality=100))
+    rec2.close()
+    it0 = ImageRecordIter(path_imgrec=path2, data_shape=(3, 32, 32),
+                          batch_size=4, seed=5)
+    it1 = ImageRecordIter(path_imgrec=path2, data_shape=(3, 32, 32),
+                          batch_size=4, seed=5, max_rotate_angle=30.0)
+    d0 = next(iter(it0)).data[0].asnumpy()
+    d1 = next(iter(it1)).data[0].asnumpy()
+    assert np.abs(d0 - d1).max() > 10.0  # rotation really happened
+
+    # random scale changes the pre-crop geometry
+    it2 = ImageRecordIter(path_imgrec=path2, data_shape=(3, 32, 32),
+                          batch_size=4, seed=5, resize=36,
+                          min_random_scale=0.7, max_random_scale=1.3)
+    d2 = next(iter(it2)).data[0].asnumpy()
+    assert np.isfinite(d2).all()

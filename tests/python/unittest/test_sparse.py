@@ -219,3 +219,101 @@ class TestFactorizationMachineE2E:
             assert acc > 0.9, "FM failed to converge: acc=%.3f" % acc
         finally:
             sys.path.remove(fm_dir)
+
+
+# ---------------------------------------------------------------------------
+# round-2 depth: slicing without densify, check_format, scalar ops, nnz
+# (reference: python/mxnet/ndarray/sparse.py CSRNDArray/RowSparseNDArray)
+# ---------------------------------------------------------------------------
+
+def _dense_fixture():
+    d = np.zeros((6, 5), np.float32)
+    d[0, 1] = 1.0
+    d[2, 0] = 2.0
+    d[2, 4] = 3.0
+    d[5, 2] = 4.0
+    return d
+
+
+def test_csr_row_slicing_no_densify():
+    d = _dense_fixture()
+    csr = mx.nd.sparse.csr_matrix(d)
+    for sl in (slice(0, 3), slice(2, 6), slice(1, 2), slice(None)):
+        sub = csr[sl]
+        assert sub.stype == "csr"
+        np.testing.assert_array_equal(sub.asnumpy(), d[sl])
+    one = csr[2]
+    np.testing.assert_array_equal(one.asnumpy(), d[2:3])
+    assert one.nnz == 2
+
+
+def test_rsp_row_slicing():
+    d = _dense_fixture()
+    rsp = mx.nd.sparse.row_sparse_array(d)
+    sub = rsp[1:4]
+    assert sub.stype == "row_sparse"
+    np.testing.assert_array_equal(sub.asnumpy(), d[1:4])
+
+
+def test_nnz_density_scalar_ops():
+    d = _dense_fixture()
+    csr = mx.nd.sparse.csr_matrix(d)
+    assert csr.nnz == 4
+    assert abs(csr.density - 4 / 30) < 1e-9
+    scaled = csr * 2.0
+    assert scaled.stype == "csr" and scaled.nnz == 4
+    np.testing.assert_array_equal(scaled.asnumpy(), d * 2)
+    np.testing.assert_array_equal((-csr).asnumpy(), -d)
+    np.testing.assert_array_equal((csr / 2).asnumpy(), d / 2)
+    rsp = mx.nd.sparse.row_sparse_array(d)
+    np.testing.assert_array_equal((3 * rsp).asnumpy(), 3 * d)
+
+
+def test_check_format_catches_corruption():
+    d = _dense_fixture()
+    csr = mx.nd.sparse.csr_matrix(d)
+    csr.check_format()  # valid
+    bad = mx.nd.sparse.csr_matrix(
+        (np.ones(2, np.float32), np.array([3, 1], np.int32),  # unsorted row
+         np.array([0, 2, 2], np.int32)), shape=(2, 5))
+    with pytest.raises(Exception):
+        bad.check_format()
+    bad2 = mx.nd.sparse.csr_matrix(
+        (np.ones(1, np.float32), np.array([9], np.int32),  # col out of range
+         np.array([0, 1], np.int32)), shape=(1, 5))
+    with pytest.raises(Exception):
+        bad2.check_format()
+    rsp_bad = mx.nd.sparse.RowSparseNDArray(
+        np.ones((2, 5), np.float32), np.array([4, 1], np.int32), (6, 5))
+    with pytest.raises(Exception):
+        rsp_bad.check_format()
+
+
+def test_csr_asscipy():
+    scipy = pytest.importorskip("scipy")
+    d = _dense_fixture()
+    csr = mx.nd.sparse.csr_matrix(d)
+    sp = csr.asscipy()
+    np.testing.assert_array_equal(sp.toarray(), d)
+
+
+def test_sparse_astype_and_copy():
+    d = _dense_fixture()
+    csr = mx.nd.sparse.csr_matrix(d)
+    c16 = csr.astype(np.float16)
+    assert c16.stype == "csr" and c16.data.dtype == np.float16
+    cp = csr.copy()
+    cp._data = cp._data * 5
+    np.testing.assert_array_equal(csr.asnumpy(), d)  # original untouched
+
+
+def test_sparse_negative_and_bad_indexing():
+    d = _dense_fixture()
+    csr = mx.nd.sparse.csr_matrix(d)
+    np.testing.assert_array_equal(csr[-1].asnumpy(), d[-1:])
+    np.testing.assert_array_equal(csr[-3:-1].asnumpy(), d[-3:-1])
+    with pytest.raises(Exception):
+        csr[10]
+    rsp = mx.nd.sparse.row_sparse_array(d)
+    np.testing.assert_array_equal(rsp[-10:3].asnumpy(), d[-10:3])
+    assert rsp[4:2].shape[0] == 0  # empty, not negative
