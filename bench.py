@@ -65,7 +65,10 @@ def main():
         attempt, force_cpu = attempts[i]
         result, err = _run_child(force_cpu)
         if result is not None:
-            _emit(result["value"], result["vs_baseline"], result["extra"])
+            extra = result["extra"]
+            if errors:  # record why earlier attempts (e.g. TPU) failed
+                extra["fallback_reason"] = "; ".join(errors)[-600:]
+            _emit(result["value"], result["vs_baseline"], extra)
             return
         errors.append("attempt%d(%s): %s"
                       % (attempt, "cpu" if force_cpu else "default", err))

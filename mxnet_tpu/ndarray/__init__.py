@@ -93,6 +93,84 @@ for _al, _target in [("fully_connected", "FullyConnected"), ("convolution", "Con
 
 # make `nd.sum` etc. accept the NDArray-method style too (they already do).
 
+# free-function arithmetic (reference: ndarray.py:2xxx add/subtract/...)
+def _binary_free_fn(op_attr):
+    def fn(lhs, rhs):
+        if isinstance(lhs, NDArray):
+            return getattr(lhs, op_attr)(rhs)
+        # scalar lhs: reflect onto the NDArray operand
+        refl = op_attr.replace("__", "__r", 1)
+        return getattr(rhs, refl)(lhs)
+    return fn
+
+
+add = _binary_free_fn("__add__")
+subtract = _binary_free_fn("__sub__")
+multiply = _binary_free_fn("__mul__")
+divide = _binary_free_fn("__truediv__")
+true_divide = divide
+modulo = _binary_free_fn("__mod__")  # `power` already op-generated above
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0,
+             channels=3, mean=None):
+    """Decode an encoded image buffer to a (H, W, C) NDArray (reference:
+    ndarray.py imdecode, backed by opencv). With `out` of shape
+    (N, H, W, C), the decoded image is written into out[index]."""
+    import cv2
+    import numpy as _np_
+    buf = _np_.frombuffer(bytes(str_img), dtype=_np_.uint8)
+    img = cv2.imdecode(buf, cv2.IMREAD_COLOR if channels == 3
+                       else cv2.IMREAD_GRAYSCALE)
+    if img is None:
+        raise MXNetError("imdecode: decode failed")
+    if channels == 3:
+        img = img[:, :, ::-1]  # BGR -> RGB
+    else:
+        img = img[:, :, None]  # always (H, W, C), reference layout
+    x0, y0, x1, y1 = clip_rect
+    if x1 > 0 and y1 > 0:
+        img = img[y0:y1, x0:x1]
+    if mean is not None:
+        img = img.astype(_np_.float32) - (mean.asnumpy()
+                                          if isinstance(mean, NDArray)
+                                          else _np_.asarray(mean))
+    img = _np_.ascontiguousarray(img)
+    if out is not None:
+        if out.ndim == img.ndim + 1:  # batch destination: fill slot `index`
+            if img.shape != out.shape[1:]:
+                raise MXNetError("imdecode: image %s does not fit out[%d] %s"
+                                 % (img.shape, index, out.shape[1:]))
+            out._data = out._data.at[index].set(
+                _jnp_asarray(img, out.dtype))
+            return out
+        out._data = array(img)._data
+        return out
+    return array(img)
+
+
+def _jnp_asarray(npd, dtype):
+    import jax.numpy as _jnp
+    return _jnp.asarray(npd).astype(dtype)
+
+
+def onehot_encode(indices, out):
+    """Legacy one-hot (reference: ndarray.py onehot_encode ->
+    _onehot_encode): out[i, indices[i]] = 1, rest 0. Out-of-range
+    indices fail fast (a mislabeled sample must not become a silent
+    zero vector)."""
+    import jax.numpy as _jnp
+    import numpy as _np_
+    n, k = out.shape
+    idx_np = _np_.asarray(indices.asnumpy()).astype(_np_.int64)
+    if idx_np.size and (idx_np.min() < 0 or idx_np.max() >= k):
+        raise MXNetError("onehot_encode: index out of range [0, %d)" % k)
+    idx = indices._data.astype(_jnp.int32)
+    out._data = _jnp.zeros((n, k), out._data.dtype).at[
+        _jnp.arange(n), idx].set(1)
+    return out
+
+
 from . import sparse  # noqa: E402  (CSRNDArray / RowSparseNDArray)
 from .sparse import CSRNDArray, RowSparseNDArray, BaseSparseNDArray  # noqa: E402
 from . import random  # noqa: E402
@@ -100,7 +178,9 @@ from .utils import save, load  # noqa: E402  (legacy binary format)
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
            "concatenate", "moveaxis", "waitall", "sparse", "random",
-           "CSRNDArray", "RowSparseNDArray", "save", "load"] + list(_GENERATED)
+           "CSRNDArray", "RowSparseNDArray", "save", "load", "add",
+           "subtract", "multiply", "divide", "true_divide", "modulo",
+           "imdecode", "onehot_encode"] + list(_GENERATED)
 
 from ..ops.registry import make_internal_namespace as _min  # noqa: E402
 from ..ops.registry import make_contrib_namespace as _mcn  # noqa: E402

@@ -124,9 +124,29 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
 
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "fromjson",
-           "zeros", "ones", "arange"] + list(_GENERATED)
+           "zeros", "ones", "arange", "full", "pow"] + list(_GENERATED)
 
 from ..ops.registry import make_internal_namespace as _min  # noqa: E402
 from ..ops.registry import make_contrib_namespace as _mcn  # noqa: E402
 _internal = _min(_GENERATED, _OP_ALIASES)
 contrib = _mcn(_GENERATED)
+
+
+def full(shape, val, dtype="float32", **kwargs):
+    """reference: symbol.py full -> _full op."""
+    return _GENERATED["_full"](shape=tuple(shape) if not isinstance(shape, int)
+                               else (shape,), value=float(val),
+                               dtype=str(dtype), **kwargs)
+
+
+def pow(base, exp):
+    """reference: symbol.py pow — symbol/scalar power dispatch."""
+    base_sym = isinstance(base, Symbol)
+    exp_sym = isinstance(exp, Symbol)
+    if base_sym and exp_sym:
+        return _GENERATED["power"](base, exp)  # broadcast power op
+    if base_sym:
+        return base.__pow__(exp)
+    if exp_sym:
+        return exp._apply_op("_rpower_scalar", scalar=float(base))
+    return base ** exp

@@ -12,7 +12,7 @@ from ..base import MXNetError
 from ..context import Context, cpu, current_context
 from ..ndarray.ndarray import NDArray, array, zeros
 
-__all__ = ["default_context", "assert_almost_equal", "almost_equal", "same",
+__all__ = ["with_seed", "default_context", "assert_almost_equal", "almost_equal", "same",
            "rand_ndarray", "rand_shape_2d", "rand_shape_3d", "rand_shape_nd",
            "check_numeric_gradient", "check_consistency", "simple_forward",
            "default_dtype"]
@@ -200,3 +200,32 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
         for a, b in zip(results[0], other):
             assert_almost_equal(a, b, rtol=tol, atol=tol)
     return results
+
+
+def with_seed(seed=None):
+    """Per-test deterministic seeding decorator (reference:
+    tests/python/unittest/common.py:97 with_seed): seeds numpy + mx.random,
+    logs the seed on failure so the exact run reproduces."""
+    import functools
+    import logging
+    import random as _pyrandom
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            this_seed = seed if seed is not None \
+                else _np.random.randint(0, 2 ** 31)
+            _np.random.seed(this_seed)
+            _pyrandom.seed(this_seed)
+            from .. import random as _mxrandom
+            _mxrandom.seed(this_seed)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                logging.error("test failed with seed %d: reproduce with "
+                              "@with_seed(%d)", this_seed, this_seed)
+                raise
+        return wrapper
+    return deco
+
+

@@ -1,0 +1,97 @@
+// C++ unit tests for the native runtime (reference analog: tests/cpp/
+// googletest suites — storage_test.cc, engine tests). Plain asserts, no
+// gtest in the image; built+run by tests/python/unittest/test_cpp_units.py.
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../../src/io/recordio.h"
+
+extern "C" {
+void* MXTStorageAlloc(size_t size);
+void MXTStorageFree(void* ptr);
+void MXTStorageReleaseAll();
+void MXTStorageStats(uint64_t* out);
+}
+
+static int tests_run = 0;
+#define CHECK_TRUE(cond)                                            \
+  do {                                                              \
+    if (!(cond)) {                                                  \
+      std::fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__, \
+                   #cond);                                          \
+      return 1;                                                     \
+    }                                                               \
+  } while (0)
+
+int test_recordio_roundtrip(const std::string& path) {
+  const char magic_bytes[] = {0x0a, 0x23, static_cast<char>(0xd7),
+                              static_cast<char>(0xce)};
+  std::string magic(magic_bytes, 4);
+  std::vector<std::string> payloads = {
+      std::string("plain"),
+      magic + "starts with magic",
+      std::string("abcd") + magic + "efgh" + magic + "ijkl",
+      magic + magic + magic,
+      std::string("abc") + magic,  // unaligned: must NOT split
+      std::string(""),             // empty payload
+  };
+  {
+    mxtpu::RecordIOWriter w(path);
+    for (auto& p : payloads) w.WriteRecord(p.data(), p.size());
+  }
+  {
+    mxtpu::RecordIOReader r(path);
+    std::string rec;
+    size_t i = 0;
+    while (r.ReadRecord(&rec)) {
+      CHECK_TRUE(i < payloads.size());
+      CHECK_TRUE(rec == payloads[i]);
+      i++;
+    }
+    CHECK_TRUE(i == payloads.size());
+  }
+  {
+    // ScanOffsets indexes LOGICAL records; ReadAt re-reads each
+    mxtpu::RecordIOReader r(path);
+    auto offsets = r.ScanOffsets();
+    CHECK_TRUE(offsets.size() == payloads.size());
+    for (size_t i = 0; i < offsets.size(); ++i) {
+      std::string rec;
+      CHECK_TRUE(r.ReadAt(offsets[i].first, offsets[i].second, &rec));
+      CHECK_TRUE(rec == payloads[i]);
+    }
+  }
+  tests_run++;
+  return 0;
+}
+
+int test_storage_pool() {
+  uint64_t st[5];
+  void* a = MXTStorageAlloc(5000);
+  CHECK_TRUE(a != nullptr);
+  CHECK_TRUE(reinterpret_cast<uintptr_t>(a) % 4096 == 0);  // page aligned
+  std::memset(a, 0xAB, 5000);
+  MXTStorageFree(a);
+  void* b = MXTStorageAlloc(6000);  // same 8KB class -> pool hit
+  CHECK_TRUE(b == a);
+  MXTStorageStats(st);
+  CHECK_TRUE(st[2] >= 1);  // hits
+  MXTStorageFree(b);
+  MXTStorageReleaseAll();
+  MXTStorageStats(st);
+  CHECK_TRUE(st[1] == 0);  // bytes_pooled drained
+  tests_run++;
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  std::string tmp = argc > 1 ? argv[1] : "/tmp/recordio_test.rec";
+  if (test_recordio_roundtrip(tmp)) return 1;
+  if (test_storage_pool()) return 1;
+  std::printf("CPP_TESTS_OK ran=%d\n", tests_run);
+  return 0;
+}

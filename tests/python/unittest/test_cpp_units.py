@@ -1,0 +1,28 @@
+"""Builds and runs the C++ unit tests (reference analog: tests/cpp/
+googletest suites run by the CI make target)."""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_cpp_unit_suite(tmp_path):
+    exe = str(tmp_path / "cpp_tests")
+    build = subprocess.run(
+        ["g++", "-O1", "-std=c++17", "-pthread",
+         os.path.join(REPO, "tests", "cpp", "recordio_test.cc"),
+         os.path.join(REPO, "src", "io", "recordio.cc"),
+         os.path.join(REPO, "src", "storage", "host_pool.cc"),
+         "-o", exe],
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr[-3000:]
+    run = subprocess.run([exe, str(tmp_path / "t.rec")],
+                         capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stderr[-2000:] + run.stdout[-500:]
+    assert "CPP_TESTS_OK" in run.stdout
