@@ -1,0 +1,28 @@
+"""Contrib samplers (reference: gluon/contrib/data/sampler.py)."""
+from __future__ import annotations
+
+from ...data.sampler import Sampler
+
+
+class IntervalSampler(Sampler):
+    """Samples i, i+interval, i+2*interval, ... for each start i
+    (reference: sampler.py IntervalSampler — used for truncated-BPTT
+    batching)."""
+
+    def __init__(self, length, interval, rollover=True):
+        assert interval <= length
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for i in range(self._interval if self._rollover else 1):
+            for j in range(i, self._length, self._interval):
+                yield j
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        # actual yielded count (the reference returns `length` here even
+        # for rollover=False — a bug a DataLoader would inherit)
+        return (self._length - 1) // self._interval + 1

@@ -1,0 +1,5 @@
+"""contrib neural-network layers (reference:
+python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from .basic_layers import Concurrent, HybridConcurrent, Identity
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity"]

@@ -1,0 +1,39 @@
+"""Contrib layers (reference: gluon/contrib/nn/basic_layers.py:27-117)."""
+from __future__ import annotations
+
+from ...nn.basic_layers import Sequential, HybridSequential
+from ...block import HybridBlock
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs along `axis`
+    (reference: basic_layers.py:27)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference: basic_layers.py:60)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block for skip paths in Concurrent
+    (reference: basic_layers.py:93)."""
+
+    def hybrid_forward(self, F, x):
+        return x

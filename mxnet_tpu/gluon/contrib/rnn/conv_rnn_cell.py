@@ -1,0 +1,171 @@
+"""Convolutional recurrent cells (reference:
+gluon/contrib/rnn/conv_rnn_cell.py:37-980 — ConvRNN/ConvLSTM/ConvGRU in
+1D/2D/3D). Gates are convolutions over spatial feature maps instead of
+dense projections (Shi et al. 2015 ConvLSTM)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import RecurrentCell
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, num_gates, activation="tanh",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)   # (C, *spatial)
+        self._hidden_channels = hidden_channels
+        self._i2h_kernel = tuple(i2h_kernel)
+        self._h2h_kernel = tuple(h2h_kernel)
+        self._i2h_pad = tuple(i2h_pad)
+        # h2h must preserve spatial dims: odd kernel, symmetric pad
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise ValueError("h2h_kernel must be odd to preserve shape")
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        self._num_gates = num_gates
+        self._activation = activation
+        in_c = self._input_shape[0]
+        ng = num_gates
+        hc = hidden_channels
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(ng * hc, in_c) + self._i2h_kernel,
+            allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(ng * hc, hc) + self._h2h_kernel,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(ng * hc,), init="zeros",
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(ng * hc,), init="zeros",
+            allow_deferred_init=True)
+
+    @property
+    def _state_shape(self):
+        """Spatial shape of h: i2h conv output shape (stride 1)."""
+        spatial = self._input_shape[1:]
+        out = tuple((s + 2 * p - k) + 1 for s, k, p in
+                    zip(spatial, self._i2h_kernel, self._i2h_pad))
+        return (self._hidden_channels,) + out
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": "NC" + "DHW"[3 - len(self._i2h_kernel):]}]
+
+    def _pin_shapes(self, x, *states):
+        pass  # shapes fixed by input_shape at construction
+
+    def _conv_gates(self, F, x, h):
+        ng, hc = self._num_gates, self._hidden_channels
+        i2h = F.Convolution(x, self.i2h_weight.data(), self.i2h_bias.data(),
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=ng * hc)
+        h2h = F.Convolution(h, self.h2h_weight.data(), self.h2h_bias.data(),
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            num_filter=ng * hc)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        return F.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation="tanh", prefix=None,
+                 params=None):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, 1,
+                         activation=activation, prefix=prefix, params=params)
+
+    def __call__(self, x, states):
+        from .... import ndarray as F
+        i2h, h2h = self._conv_gates(F, x, states[0])
+        out = self._act(F, i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation="tanh", prefix=None,
+                 params=None):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, 4,
+                         activation=activation, prefix=prefix, params=params)
+
+    def state_info(self, batch_size=0):
+        info = super().state_info(batch_size)
+        return info + [dict(info[0])]  # (h, c)
+
+    def __call__(self, x, states):
+        from .... import ndarray as F
+        i2h, h2h = self._conv_gates(F, x, states[0])
+        gates = i2h + h2h
+        s = F.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(s[0])
+        f = F.sigmoid(s[1])
+        g = self._act(F, s[2])
+        o = F.sigmoid(s[3])
+        next_c = f * states[1] + i * g
+        next_h = o * self._act(F, next_c)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation="tanh", prefix=None,
+                 params=None):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, 3,
+                         activation=activation, prefix=prefix, params=params)
+
+    def __call__(self, x, states):
+        from .... import ndarray as F
+        i2h, h2h = self._conv_gates(F, x, states[0])
+        i2h_s = F.split(i2h, num_outputs=3, axis=1)
+        h2h_s = F.split(h2h, num_outputs=3, axis=1)
+        reset = F.sigmoid(i2h_s[0] + h2h_s[0])
+        update = F.sigmoid(i2h_s[1] + h2h_s[1])
+        cand = self._act(F, i2h_s[2] + reset * h2h_s[2])
+        # h' = (1-z)*cand + z*prev (matches gluon GRUCell orientation)
+        next_h = cand + update * (states[0] - cand)
+        return next_h, [next_h]
+
+
+def _make(dim, base, name, doc):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad=None, activation="tanh",
+                 prefix=None, params=None):
+        if isinstance(i2h_kernel, int):
+            i2h_kernel = (i2h_kernel,) * dim
+        if isinstance(h2h_kernel, int):
+            h2h_kernel = (h2h_kernel,) * dim
+        if i2h_pad is None:
+            i2h_pad = (0,) * dim
+        elif isinstance(i2h_pad, int):
+            i2h_pad = (i2h_pad,) * dim
+        base.__init__(self, input_shape, hidden_channels,
+                      tuple(i2h_kernel), tuple(h2h_kernel),
+                      tuple(i2h_pad),
+                      activation=activation, prefix=prefix, params=params)
+
+    return type(name, (base,), {"__init__": __init__, "__doc__": doc})
+
+
+Conv1DRNNCell = _make(1, _ConvRNNCell, "Conv1DRNNCell",
+                      "reference: conv_rnn_cell.py:218")
+Conv2DRNNCell = _make(2, _ConvRNNCell, "Conv2DRNNCell",
+                      "reference: conv_rnn_cell.py:285")
+Conv3DRNNCell = _make(3, _ConvRNNCell, "Conv3DRNNCell",
+                      "reference: conv_rnn_cell.py:352")
+Conv1DLSTMCell = _make(1, _ConvLSTMCell, "Conv1DLSTMCell",
+                       "reference: conv_rnn_cell.py:473")
+Conv2DLSTMCell = _make(2, _ConvLSTMCell, "Conv2DLSTMCell",
+                       "reference: conv_rnn_cell.py:550")
+Conv3DLSTMCell = _make(3, _ConvLSTMCell, "Conv3DLSTMCell",
+                       "reference: conv_rnn_cell.py:627")
+Conv1DGRUCell = _make(1, _ConvGRUCell, "Conv1DGRUCell",
+                      "reference: conv_rnn_cell.py:762")
+Conv2DGRUCell = _make(2, _ConvGRUCell, "Conv2DGRUCell",
+                      "reference: conv_rnn_cell.py:834")
+Conv3DGRUCell = _make(3, _ConvGRUCell, "Conv3DGRUCell",
+                      "reference: conv_rnn_cell.py:906")

@@ -1,0 +1,116 @@
+"""Contrib recurrent cells (reference:
+gluon/contrib/rnn/rnn_cell.py:26 VariationalDropoutCell, :197 LSTMPCell)."""
+from __future__ import annotations
+
+from ...rnn.rnn_cell import ModifierCell, RecurrentCell
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (locked) dropout: ONE dropout mask per unroll, reused at
+    every timestep (Gal & Ghahramani 2016; reference: rnn_cell.py:26)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_masks = None
+        self._output_mask = None
+
+    def _mask(self, p, like):
+        from .... import ndarray as nd
+        # Dropout of a ones tensor gives the scaled bernoulli mask the
+        # reference builds with F.Dropout on ones_like
+        return nd.Dropout(nd.ones_like(like), p=p)
+
+    def __call__(self, x, states):
+        from .... import imperative as _imp
+        if not _imp.is_training():
+            return self.base_cell(x, states)
+        if self.drop_inputs:
+            if self._input_mask is None:
+                self._input_mask = self._mask(self.drop_inputs, x)
+            x = x * self._input_mask
+        if self.drop_states:
+            if self._state_masks is None:
+                self._state_masks = [self._mask(self.drop_states, s)
+                                     for s in states]
+            # reference masks only the h state (index 0)
+            states = [states[0] * self._state_masks[0]] + list(states[1:])
+        out, next_states = self.base_cell(x, states)
+        if self.drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask(self.drop_outputs, out)
+            out = out * self._output_mask
+        return out, next_states
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projection layer on the hidden state (LSTMP, Sak et al.
+    2014; reference: rnn_cell.py:197): h = W_r * (o * tanh(c)). The h2h
+    projection consumes the PROJECTED state, so parameters are declared
+    here rather than through _BaseRNNCell."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        H, P = hidden_size, projection_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * H, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * H, P),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(P, H),
+            init=h2r_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * H,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * H,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def _pin_shapes(self, x, *states):
+        if self._input_size == 0:
+            self._input_size = x.shape[-1]
+            self.i2h_weight.shape = (4 * self._hidden_size,
+                                     self._input_size)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        H = self._hidden_size
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=4 * H)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * H)
+        gates = i2h + h2h
+        s = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(s[0])
+        forget_gate = F.sigmoid(s[1])
+        in_trans = F.tanh(s[2])
+        out_gate = F.sigmoid(s[3])
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        hidden = out_gate * F.tanh(next_c)
+        next_r = F.FullyConnected(hidden, h2r_weight, no_bias=True,
+                                  num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]

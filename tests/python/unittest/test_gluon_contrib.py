@@ -1,0 +1,130 @@
+"""gluon.contrib parity (reference: tests/python/unittest/
+test_gluon_contrib.py — Concurrent/Identity, VariationalDropoutCell,
+LSTMPCell, Conv{1,2,3}D{RNN,LSTM,GRU}Cell)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import contrib
+
+
+def test_concurrent_and_identity():
+    net = contrib.nn.HybridConcurrent(axis=1)
+    net.add(gluon.nn.Dense(3, in_units=4))
+    net.add(gluon.nn.Dense(2, in_units=4))
+    net.add(contrib.nn.Identity())
+    net.initialize()
+    x = mx.nd.array(np.ones((2, 4), np.float32))
+    out = net(x)
+    assert out.shape == (2, 3 + 2 + 4)
+    np.testing.assert_array_equal(out.asnumpy()[:, 5:], np.ones((2, 4)))
+
+    net2 = contrib.nn.Concurrent(axis=1)
+    net2.add(contrib.nn.Identity(), contrib.nn.Identity())
+    out2 = net2(x)
+    assert out2.shape == (2, 8)
+
+
+def test_variational_dropout_locks_mask():
+    cell = contrib.rnn.VariationalDropoutCell(
+        gluon.rnn.RNNCell(8, input_size=4), drop_inputs=0.5)
+    cell.base_cell.initialize()
+    from mxnet_tpu import autograd
+    x = mx.nd.array(np.ones((40, 3, 4), np.float32))
+    mx.random.seed(0)
+    with autograd.record():
+        outputs, _ = cell.unroll(3, x, layout="NTC", merge_outputs=False)
+    # same mask every step: the dropped input columns match across t
+    m = cell._input_mask.asnumpy()
+    assert (m == 0).any() and (m != 0).any()
+    # eval mode: no dropout at all
+    o1, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    o2, _ = cell.unroll(3, x, layout="NTC", merge_outputs=True)
+    np.testing.assert_array_equal(o1.asnumpy(), o2.asnumpy())
+
+
+def test_lstmp_cell_shapes():
+    cell = contrib.rnn.LSTMPCell(hidden_size=16, projection_size=6,
+                                 input_size=5)
+    cell.initialize()
+    x = mx.nd.array(np.random.RandomState(0).normal(0, 1, (2, 5))
+                    .astype(np.float32))
+    states = cell.begin_state(2)
+    assert states[0].shape == (2, 6)    # projected h
+    assert states[1].shape == (2, 16)   # full c
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 6)
+    assert new_states[1].shape == (2, 16)
+    # unroll works and stays finite
+    xs = mx.nd.array(np.random.RandomState(1).normal(0, 1, (2, 4, 5))
+                     .astype(np.float32))
+    outs, _ = cell.unroll(4, xs, layout="NTC", merge_outputs=True)
+    assert outs.shape == (2, 4, 6)
+    assert np.isfinite(outs.asnumpy()).all()
+
+
+@pytest.mark.parametrize("cls,ngates_states", [
+    ("Conv1DRNNCell", 1), ("Conv1DLSTMCell", 2), ("Conv1DGRUCell", 1)])
+def test_conv_rnn_cells_1d(cls, ngates_states):
+    cell = getattr(contrib.rnn, cls)(input_shape=(3, 12), hidden_channels=4,
+                                     i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mx.nd.array(np.random.RandomState(0).normal(0, 1, (2, 3, 12))
+                    .astype(np.float32))
+    states = cell.begin_state(2)
+    assert len(states) == ngates_states
+    assert states[0].shape == (2, 4, 12)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 4, 12)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_conv2d_lstm_unroll():
+    cell = contrib.rnn.Conv2DLSTMCell(input_shape=(2, 8, 8),
+                                      hidden_channels=3, i2h_kernel=3,
+                                      h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    seq = mx.nd.array(np.random.RandomState(2).normal(0, 1, (2, 4, 2, 8, 8))
+                      .astype(np.float32))
+    outs, states = cell.unroll(4, seq, layout="NTC", merge_outputs=False)
+    assert len(outs) == 4 and outs[0].shape == (2, 3, 8, 8)
+    assert states[1].shape == (2, 3, 8, 8)
+
+
+def test_conv3d_gru_step():
+    cell = contrib.rnn.Conv3DGRUCell(input_shape=(1, 4, 4, 4),
+                                     hidden_channels=2, i2h_kernel=3,
+                                     h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mx.nd.array(np.random.RandomState(3).normal(0, 1, (1, 1, 4, 4, 4))
+                    .astype(np.float32))
+    out, _ = cell(x, cell.begin_state(1))
+    assert out.shape == (1, 2, 4, 4, 4)
+
+
+def test_interval_sampler():
+    s = contrib.data.IntervalSampler(10, 3)
+    idx = list(s)
+    assert sorted(idx) == list(range(10))
+    assert idx[:4] == [0, 3, 6, 9]
+    s2 = contrib.data.IntervalSampler(10, 3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9]
+
+
+def test_interval_sampler_len_matches_iter():
+    s = contrib.data.IntervalSampler(10, 3, rollover=False)
+    assert len(list(s)) == len(s) == 4
+    s2 = contrib.data.IntervalSampler(10, 3)
+    assert len(list(s2)) == len(s2) == 10
+
+
+def test_hybrid_concurrent_hybridizes():
+    net = contrib.nn.HybridConcurrent(axis=1)
+    net.add(gluon.nn.Dense(3, in_units=4), contrib.nn.Identity())
+    net.initialize()
+    x = mx.nd.array(np.ones((2, 4), np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    jitted = net(x).asnumpy()
+    np.testing.assert_allclose(eager, jitted, rtol=1e-5)
