@@ -92,14 +92,23 @@ def _bench_infer(np, mx, resnet, batch, n_iter):
     for name, arr in exe.arg_dict.items():
         if name not in ("data", "softmax_label"):
             arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
-    exe.arg_dict["data"][:] = rng.uniform(
-        -1, 1, (batch, 3, 224, 224)).astype(np.float32)
+    # Pre-stage DISTINCT batches on device and cycle through them: repeated
+    # identical executions can be deduped by the runtime (observed on the
+    # tunneled TPU backend), and per-step host->device copies would measure
+    # the tunnel, not the chip. The reference score benchmark also measures
+    # compute only.
+    import jax
+    from mxnet_tpu.ndarray.ndarray import _new_from_jax
+    datas = [_new_from_jax(jax.device_put(rng.uniform(
+        -1, 1, (batch, 3, 224, 224)).astype(np.float32)))
+        for _ in range(n_iter)]
+    jax.block_until_ready([d._data for d in datas])
     for _ in range(3):  # warmup: compile + steady-state
-        exe.forward(is_train=False)
+        exe.forward(is_train=False, data=datas[0])
     exe.outputs[0].wait_to_read()
     tic = time.time()
-    for _ in range(n_iter):
-        exe.forward(is_train=False)
+    for d in datas:
+        exe.forward(is_train=False, data=d)
     exe.outputs[0].wait_to_read()
     return batch * n_iter / (time.time() - tic)
 
@@ -117,48 +126,57 @@ def _bench_train(np, jax, resnet, batch, n_iter):
                                  label_names=("softmax_label",))
     step.init({"data": (batch, 3, 224, 224), "softmax_label": (batch,)})
     rng = np.random.RandomState(0)
-    b = {"data": rng.uniform(-1, 1, (batch, 3, 224, 224)).astype(np.float32),
-         "softmax_label": rng.randint(0, 1000, (batch,)).astype(np.float32)}
-    # stage the batch on device once — the reference score benchmark also
-    # measures compute, not host->device copies
-    b = {k: jax.device_put(v, step._batch_shard) for k, v in b.items()}
+    # distinct device-staged batches (see _bench_infer for why)
+    batches = []
+    for _ in range(4):
+        b = {"data": rng.uniform(-1, 1,
+                                 (batch, 3, 224, 224)).astype(np.float32),
+             "softmax_label": rng.randint(0, 1000,
+                                          (batch,)).astype(np.float32)}
+        batches.append({k: jax.device_put(v, step._batch_shard)
+                        for k, v in b.items()})
+    jax.block_until_ready(batches)
     key = jax.random.PRNGKey(0)
     for _ in range(2):  # warmup
-        out = step(b, rng=key)
+        out = step(batches[0], rng=key)
     jax.block_until_ready(out)
     tic = time.time()
-    for _ in range(n_iter):
-        out = step(b, rng=key)
+    for i in range(n_iter):
+        out = step(batches[i % len(batches)], rng=key)
     jax.block_until_ready(out)
     return batch * n_iter / (time.time() - tic)
 
 
 def _bench_flash_attention(np, jax, platform):
-    """Fused Pallas flash-attention kernel (non-interpret on TPU): causal
-    attention [B=4, H=8, S=2048, D=64] TFLOP/s. New TPU-native capability —
-    the reference (2018) has no attention op; this is the kernel the
-    long-context stack (ring attention) is built on."""
+    """Fused Pallas flash-attention kernel (non-interpret on TPU): bf16
+    causal attention [B=4, H=8, S=4096, D=128] TFLOP/s. New TPU-native
+    capability — the reference (2018) has no attention op; this is the
+    kernel the long-context stack (ring attention) is built on."""
     import jax.numpy as jnp
     from mxnet_tpu.kernels.flash_attention import flash_attention
-    B, H, S, D = 4, 8, 2048, 64
+    on_tpu = platform == "tpu"
+    B, H, S, D = (4, 8, 4096, 128) if on_tpu else (2, 2, 512, 64)
     rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32))
-    k = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32))
-    v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32))
-    use_pallas = platform == "tpu"
-    fn = lambda: flash_attention(q, k, v, causal=True, block_q=512,
-                                 block_k=512, use_pallas=use_pallas)
-    jax.block_until_ready(fn())  # compile
-    n_iter = 20 if platform == "tpu" else 2
+    # distinct q per timed call: identical dispatches can be deduped by the
+    # runtime, which would inflate the number past chip peak
+    n_iter = 16 if on_tpu else 2
+    dt_ = jnp.bfloat16 if on_tpu else jnp.float32
+    qs = [jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32),
+                      dtype=dt_) for _ in range(n_iter)]
+    k = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32), dt_)
+    v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32), dt_)
+    fn = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, block_q=1024 if on_tpu else 256,
+        block_k=512 if on_tpu else 256, use_pallas=on_tpu))
+    jax.block_until_ready([fn(qs[0], k, v)] + qs)  # compile + stage
     tic = time.time()
-    for _ in range(n_iter):
-        out = fn()
-    jax.block_until_ready(out)
+    outs = [fn(q, k, v) for q in qs]
+    jax.block_until_ready(outs)
     dt = time.time() - tic
     # causal attention flops: 2 matmuls * B*H*S^2*D, halved by causality
     flops = 2 * 2 * B * H * S * S * D * 0.5 * n_iter
     return {"flash_attn_tflops": round(flops / dt / 1e12, 2),
-            "flash_attn_pallas": bool(use_pallas)}
+            "flash_attn_pallas": bool(on_tpu)}
 
 
 def _run():

@@ -55,12 +55,15 @@ class _BaseConvRNNCell(RecurrentCell):
     def _pin_shapes(self, x, *states):
         pass  # shapes fixed by input_shape at construction
 
-    def _conv_gates(self, F, x, h):
+    def _conv_gates(self, F, x, h, i2h_weight, h2h_weight, i2h_bias,
+                    h2h_bias):
+        # weights arrive via _cell_forward so hybridized traces see traced
+        # parameter values (never baked-in device constants)
         ng, hc = self._num_gates, self._hidden_channels
-        i2h = F.Convolution(x, self.i2h_weight.data(), self.i2h_bias.data(),
+        i2h = F.Convolution(x, i2h_weight, i2h_bias,
                             kernel=self._i2h_kernel, pad=self._i2h_pad,
                             num_filter=ng * hc)
-        h2h = F.Convolution(h, self.h2h_weight.data(), self.h2h_bias.data(),
+        h2h = F.Convolution(h, h2h_weight, h2h_bias,
                             kernel=self._h2h_kernel, pad=self._h2h_pad,
                             num_filter=ng * hc)
         return i2h, h2h
@@ -77,9 +80,10 @@ class _ConvRNNCell(_BaseConvRNNCell):
                          h2h_kernel, i2h_pad, 1,
                          activation=activation, prefix=prefix, params=params)
 
-    def __call__(self, x, states):
-        from .... import ndarray as F
-        i2h, h2h = self._conv_gates(F, x, states[0])
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_gates(F, x, states[0], i2h_weight, h2h_weight,
+                                    i2h_bias, h2h_bias)
         out = self._act(F, i2h + h2h)
         return out, [out]
 
@@ -96,9 +100,10 @@ class _ConvLSTMCell(_BaseConvRNNCell):
         info = super().state_info(batch_size)
         return info + [dict(info[0])]  # (h, c)
 
-    def __call__(self, x, states):
-        from .... import ndarray as F
-        i2h, h2h = self._conv_gates(F, x, states[0])
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_gates(F, x, states[0], i2h_weight, h2h_weight,
+                                    i2h_bias, h2h_bias)
         gates = i2h + h2h
         s = F.split(gates, num_outputs=4, axis=1)
         i = F.sigmoid(s[0])
@@ -118,9 +123,10 @@ class _ConvGRUCell(_BaseConvRNNCell):
                          h2h_kernel, i2h_pad, 3,
                          activation=activation, prefix=prefix, params=params)
 
-    def __call__(self, x, states):
-        from .... import ndarray as F
-        i2h, h2h = self._conv_gates(F, x, states[0])
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_gates(F, x, states[0], i2h_weight, h2h_weight,
+                                    i2h_bias, h2h_bias)
         i2h_s = F.split(i2h, num_outputs=3, axis=1)
         h2h_s = F.split(h2h, num_outputs=3, axis=1)
         reset = F.sigmoid(i2h_s[0] + h2h_s[0])

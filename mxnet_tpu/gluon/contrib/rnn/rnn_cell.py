@@ -16,13 +16,13 @@ class VariationalDropoutCell(ModifierCell):
         self.drop_states = drop_states
         self.drop_outputs = drop_outputs
         self._input_mask = None
-        self._state_masks = None
+        self._state_mask = None
         self._output_mask = None
 
     def reset(self):
         super().reset()
         self._input_mask = None
-        self._state_masks = None
+        self._state_mask = None
         self._output_mask = None
 
     def _mask(self, p, like):
@@ -40,11 +40,10 @@ class VariationalDropoutCell(ModifierCell):
                 self._input_mask = self._mask(self.drop_inputs, x)
             x = x * self._input_mask
         if self.drop_states:
-            if self._state_masks is None:
-                self._state_masks = [self._mask(self.drop_states, s)
-                                     for s in states]
-            # reference masks only the h state (index 0)
-            states = [states[0] * self._state_masks[0]] + list(states[1:])
+            if self._state_mask is None:
+                # reference masks only the h state (index 0)
+                self._state_mask = self._mask(self.drop_states, states[0])
+            states = [states[0] * self._state_mask] + list(states[1:])
         out, next_states = self.base_cell(x, states)
         if self.drop_outputs:
             if self._output_mask is None:

@@ -145,8 +145,12 @@ def blockwise_attention(q, k, v, *, causal=False, sm_scale=None,
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                       sm_scale, causal, block_k, kv_len):
-    """One (batch*head, q-block) program: stream KV blocks through VMEM."""
-    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    """One (batch*head, q-block) program: stream KV blocks through VMEM.
+
+    Matmuls run in the input dtype (bf16 inputs -> full-rate MXU passes)
+    with fp32 accumulation; softmax statistics are fp32 throughout.
+    """
+    q = q_ref[0]  # [block_q, d], input dtype
     block_q, d = q.shape
     qi = pl.program_id(1)
     q_off = qi * block_q
@@ -155,8 +159,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     def body(i, carry):
         acc, m_i, l_i = carry
-        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = q_off + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -167,7 +171,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         alpha = jnp.exp(m_i - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_new = l_i * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jnp.dot(p, v_blk,
+        acc = acc * alpha[:, None] + jnp.dot(p.astype(v_blk.dtype), v_blk,
                                              preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
@@ -183,7 +187,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     acc, m_i, l_i = lax.fori_loop(0, hi, body, (acc0, m0, l0))
     l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m_i + jnp.log(l_safe)
+    # lse ref carries a trailing lane dim of 1: TPU block shapes must be
+    # (8,128)-tileable or match the array dims in the last two axes
+    lse_ref[0] = (m_i + jnp.log(l_safe))[:, None]
 
 
 try:  # Pallas import is lazy-safe: CPU-only envs still work via fallback
@@ -202,7 +208,7 @@ except Exception:  # pragma: no cover
 
 def _flash_fwd_offs_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                            sm_scale, causal, block_k, kv_len):
-    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+    q = q_ref[0]  # [block_q, d], input dtype (matmuls accumulate in fp32)
     block_q, d = q.shape
     qi = pl.program_id(1)
     q_off = offs_ref[0] + qi * block_q   # global query offset
@@ -211,8 +217,8 @@ def _flash_fwd_offs_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     def body(i, carry):
         acc, m_i, l_i = carry
-        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = q_off + lax.broadcasted_iota(jnp.int32,
@@ -228,7 +234,7 @@ def _flash_fwd_offs_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         alpha = jnp.where(m_i > _NEG_INF / 2, jnp.exp(m_i - m_new), 0.0)
         l_new = l_i * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32)
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     acc0 = jnp.zeros((block_q, d), jnp.float32)
@@ -237,7 +243,8 @@ def _flash_fwd_offs_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     acc, m_i, l_i = lax.fori_loop(0, nblk, body, (acc0, m0, l0))
     l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = jnp.where(l_i > 0.0, m_i + jnp.log(l_safe), _NEG_INF)
+    lse_ref[0] = jnp.where(l_i > 0.0, m_i + jnp.log(l_safe),
+                           _NEG_INF)[:, None]
 
 
 def _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal, block_q, block_k,
@@ -264,7 +271,7 @@ def _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, offs: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j, offs: (i, j)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, offs: (i, j, 0)),
         ],
     )
     # inside shard_map, outputs inherit the inputs' varying-mesh-axes type
@@ -272,12 +279,12 @@ def _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal, block_q, block_k,
         vma = jax.typeof(q).vma
         out_shapes = [
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32, vma=vma),
         ]
     except (AttributeError, TypeError):
         out_shapes = [
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ]
     out, lse = pl.pallas_call(
         kernel,
@@ -345,11 +352,11 @@ def _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)

@@ -128,3 +128,38 @@ def test_hybrid_concurrent_hybridizes():
     net.hybridize()
     jitted = net(x).asnumpy()
     np.testing.assert_allclose(eager, jitted, rtol=1e-5)
+
+
+def test_conv_cell_grads_under_hybridize():
+    """Conv cell weights must receive gradients when the unroll runs inside
+    a hybridized block (regression: weights read via .data() were baked
+    into the cached trace as constants, silently zeroing their grads)."""
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.cell = contrib.rnn.Conv1DLSTMCell(
+                    input_shape=(2, 8), hidden_channels=3,
+                    i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+
+        def hybrid_forward(self, F, x):
+            states = self.cell.begin_state(x.shape[0], func=F.zeros)
+            outs, _ = self.cell.unroll(3, x, begin_state=states,
+                                       layout="NTC", merge_outputs=True)
+            return outs
+
+    for hybridize in (False, True):
+        net = Net()
+        net.initialize()
+        if hybridize:
+            net.hybridize()
+        x = mx.nd.array(np.random.RandomState(0)
+                        .normal(0, 1, (2, 3, 2, 8)).astype(np.float32))
+        with mx.autograd.record():
+            out = net(x)
+            loss = (out * out).sum()
+        loss.backward()
+        g_i2h = net.cell.i2h_weight.grad().asnumpy()
+        g_h2h = net.cell.h2h_weight.grad().asnumpy()
+        assert np.abs(g_i2h).max() > 0, "i2h grad is zero (hybridize=%s)" % hybridize
+        assert np.abs(g_h2h).max() > 0, "h2h grad is zero (hybridize=%s)" % hybridize
