@@ -37,6 +37,11 @@ def _emit(value, vs_baseline, extra):
 def _run_child(force_cpu):
     env = dict(os.environ)
     env["_BENCH_CHILD"] = "1"
+    # persistent XLA compile cache: a retried/repeated run skips the
+    # multi-minute ResNet fwd+bwd compile instead of re-paying it
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
     if force_cpu:
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -113,9 +118,11 @@ def _bench_infer(np, mx, resnet, batch, n_iter):
     return batch * n_iter / (time.time() - tic)
 
 
-def _bench_train(np, jax, resnet, batch, n_iter):
+def _bench_train(np, jax, resnet, batch, n_iter, compute_dtype=None):
     """Fused train step (fwd+bwd+SGD in ONE jitted program, donated buffers)
-    on a 1-device mesh — the `train_imagenet.py --kv-store tpu_sync` path."""
+    on a 1-device mesh — the `train_imagenet.py --kv-store tpu_sync` path.
+    compute_dtype='bfloat16' additionally exercises the mixed-precision
+    path (fp32 master weights, reference mp_sgd analog)."""
     from mxnet_tpu.parallel.mesh import data_parallel_mesh
     from mxnet_tpu.parallel.tpu_step import DataParallelTrainStep
     mesh = data_parallel_mesh(jax.devices()[:1])
@@ -123,7 +130,8 @@ def _bench_train(np, jax, resnet, batch, n_iter):
                             image_shape="3,224,224")
     step = DataParallelTrainStep(sym, mesh, lr=0.05, momentum=0.9,
                                  data_names=("data",),
-                                 label_names=("softmax_label",))
+                                 label_names=("softmax_label",),
+                                 compute_dtype=compute_dtype)
     step.init({"data": (batch, 3, 224, 224), "softmax_label": (batch,)})
     rng = np.random.RandomState(0)
     # distinct device-staged batches (see _bench_infer for why)
@@ -198,6 +206,15 @@ def _run():
         extra["train_vs_baseline"] = round(train_ips / BASELINE_TRAIN_P100, 3)
     except Exception as e:  # train metric is additive; never kill headline
         extra["train_error"] = "%s: %s" % (type(e).__name__, str(e)[:300])
+    if platform == "tpu":
+        try:
+            bf16_ips = _bench_train(np, jax, resnet, batch,
+                                    max(n_iter // 2, 2),
+                                    compute_dtype="bfloat16")
+            extra["train_bf16_img_per_sec"] = round(bf16_ips, 2)
+        except Exception as e:
+            extra["train_bf16_error"] = "%s: %s" % (type(e).__name__,
+                                                    str(e)[:300])
     try:
         extra.update(_bench_flash_attention(np, jax, platform))
     except Exception as e:

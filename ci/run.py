@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""CI harness (reference analog: ci/build.py docker matrix +
+tests/jenkins/run_test_pip_installed.sh — SURVEY.md §2.9).
+
+The reference CI builds libmxnet.so across a docker matrix and fans unit
+tests over language bindings. The TPU-native equivalent is a staged local
+pipeline: build the native runtime, run the Python suite on a virtual
+8-device CPU mesh (how multi-chip sharding is validated without hardware,
+SURVEY.md §4), run the C++ unit tests, then the driver-facing gates
+(multichip dryrun; bench smoke on CPU).
+
+Usage:
+    python ci/run.py                 # full pipeline
+    python ci/run.py build unit      # just those stages
+    python ci/run.py --list
+"""
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env_cpu_mesh(n=8):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # don't register the TPU plugin
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=%d" % n)
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def stage_build(_):
+    """Build the native IO/storage runtime (src/Makefile -> libmxtpu_io.so)."""
+    return subprocess.call(["make", "-C", os.path.join(ROOT, "src")])
+
+
+def stage_unit(args):
+    """Python unit suite on the virtual 8-device CPU mesh."""
+    cmd = [sys.executable, "-m", "pytest",
+           os.path.join(ROOT, "tests", "python", "unittest"), "-q"]
+    if args.fast:
+        cmd += ["-x"]
+    return subprocess.call(cmd, env=_env_cpu_mesh(), cwd=ROOT)
+
+
+def stage_train(args):
+    """Convergence/fp16 training tests (reference tests/python/train)."""
+    return subprocess.call(
+        [sys.executable, "-m", "pytest",
+         os.path.join(ROOT, "tests", "python", "train"), "-q"],
+        env=_env_cpu_mesh(), cwd=ROOT)
+
+
+def stage_cpp(_):
+    """C++ unit tests (tests/cpp via the pytest driver that compiles them)."""
+    return subprocess.call(
+        [sys.executable, "-m", "pytest",
+         os.path.join(ROOT, "tests", "python", "unittest",
+                      "test_cpp_units.py"), "-q"],
+        env=_env_cpu_mesh(), cwd=ROOT)
+
+
+def stage_multichip(_):
+    """Driver gate: full parallelism dryrun on an 8-device CPU mesh."""
+    return subprocess.call(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"], cwd=ROOT)
+
+
+def stage_bench_smoke(_):
+    """bench.py CPU fallback path must emit its JSON line."""
+    env = _env_cpu_mesh(1)
+    env["_BENCH_CHILD"] = "1"
+    return subprocess.call(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--run"],
+        env=env, cwd=ROOT)
+
+
+STAGES = [
+    ("build", stage_build),
+    ("unit", stage_unit),
+    ("train", stage_train),
+    ("cpp", stage_cpp),
+    ("multichip", stage_multichip),
+    ("bench_smoke", stage_bench_smoke),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("stages", nargs="*",
+                    help="subset of stages (default: all)")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--fast", action="store_true",
+                    help="stop unit stage at first failure")
+    args = ap.parse_args()
+    if args.list:
+        for name, fn in STAGES:
+            print("%-12s %s" % (name, fn.__doc__.splitlines()[0]))
+        return 0
+    chosen = [s for s in STAGES if not args.stages or s[0] in args.stages]
+    unknown = set(args.stages) - {n for n, _ in STAGES}
+    if unknown:
+        ap.error("unknown stages: %s" % ", ".join(sorted(unknown)))
+    failed = []
+    for name, fn in chosen:
+        print("[ci] ==> %s" % name, flush=True)
+        t0 = time.time()
+        rc = fn(args)
+        print("[ci] <== %s: %s (%.1fs)"
+              % (name, "OK" if rc == 0 else "FAIL rc=%d" % rc,
+                 time.time() - t0), flush=True)
+        if rc != 0:
+            failed.append(name)
+            if args.fast:
+                break
+    if failed:
+        print("[ci] FAILED: %s" % ", ".join(failed))
+        return 1
+    print("[ci] all stages green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

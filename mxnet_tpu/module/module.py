@@ -382,12 +382,27 @@ class Module(BaseModule):
         mesh = data_parallel_mesh(devices)
         batch_shapes = {d.name: d.shape for d in self._data_shapes}
         batch_shapes.update({l.name: l.shape for l in self._label_shapes})
+        # Mixed precision: optimizer multi_precision=True (reference fp16 +
+        # mp_sgd master weights) or MXNET_FUSED_COMPUTE_DTYPE selects the
+        # in-program compute dtype; masters/opt state/BN aux stay fp32.
+        import os as _os
+        compute_dtype = _os.environ.get("MXNET_FUSED_COMPUTE_DTYPE") or \
+            ("bfloat16" if getattr(opt, "multi_precision", False) else None)
+        if compute_dtype is not None:
+            import jax.numpy as _jnp
+            try:
+                _jnp.dtype(compute_dtype)
+            except TypeError:
+                self.logger.warning(
+                    "MXNET_FUSED_COMPUTE_DTYPE=%r is not a dtype; "
+                    "running the fused step in fp32", compute_dtype)
+                compute_dtype = None
         step = DataParallelTrainStep(
             self._symbol, mesh, lr=opt.lr, wd=opt.wd,
             data_names=self._data_names, label_names=self._label_names,
             rescale_grad=opt.rescale_grad, optimizer=fused_name, opt_hp=hp,
             fixed_param_names=self._fixed_param_names,
-            clip_gradient=opt.clip_gradient)
+            clip_gradient=opt.clip_gradient, compute_dtype=compute_dtype)
         step.init_from(self._arg_params, self._aux_params, batch_shapes)
         self._fused_step = step
         self._fused_dirty = False

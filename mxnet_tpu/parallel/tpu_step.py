@@ -36,7 +36,8 @@ class DataParallelTrainStep:
     def __init__(self, symbol, mesh, lr=0.01, momentum=0.0, wd=0.0,
                  data_names=("data",), label_names=("softmax_label",),
                  sharding_config=None, rescale_grad=None, optimizer="sgd",
-                 opt_hp=None, fixed_param_names=(), clip_gradient=None):
+                 opt_hp=None, fixed_param_names=(), clip_gradient=None,
+                 compute_dtype=None):
         self.symbol = symbol
         self.mesh = mesh
         self.lr = lr
@@ -52,6 +53,15 @@ class DataParallelTrainStep:
             self.opt_hp.setdefault("momentum", momentum)
         self.fixed_param_names = frozenset(fixed_param_names or ())
         self.clip_gradient = clip_gradient
+        # Mixed precision, TPU-native form of the reference's fp16 +
+        # mp_sgd_update path (src/operator/optimizer_op.cc MP_SGD: fp16
+        # weights with an fp32 master copy on the kvstore): master params
+        # and the optimizer update stay fp32; the jitted program casts
+        # params+batch to `compute_dtype` (bf16 on TPU) for fwd+bwd, and
+        # grads are cast back to fp32 before the update. BN aux state
+        # remains fp32 throughout.
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
 
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -156,13 +166,33 @@ class DataParallelTrainStep:
         batch_size = list(batch_shapes.values())[0][0]
         rescale = self._rescale if self._rescale is not None else 1.0 / batch_size
 
+        cdt = self.compute_dtype
+        cast_names = frozenset(self.data_names)  # NEVER labels: class
+        # indices >= 257 are unrepresentable in bf16's 8-bit significand
+
         def step(params, opt_state, aux, batch, rng, lr):
+            if cdt is not None:
+                batch = {n: (v.astype(cdt)
+                             if n in cast_names
+                             and jnp.issubdtype(v.dtype, jnp.floating) else v)
+                         for n, v in batch.items()}
+
             def loss_fn(p):
+                if cdt is not None:
+                    p = {n: v.astype(cdt) for n, v in p.items()}
                 outs, aux_upd = runner._run_graph({**p, **batch}, aux, rng, True)
+                # BN running stats must stay fp32 even when activations
+                # are bf16 (reference keeps moving_mean/var fp32 in fp16
+                # training)
+                if cdt is not None:
+                    aux_upd = {n: v.astype(jnp.float32)
+                               for n, v in aux_upd.items()}
                 return outs, aux_upd
             outs, vjp, aux_upd = jax.vjp(loss_fn, params, has_aux=True)
             seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads = vjp(seeds)[0]
+            if cdt is not None:  # fp32 master update (mp_sgd semantics)
+                grads = {n: g.astype(jnp.float32) for n, g in grads.items()}
             from .optim_update import apply_update
             # reference optimizer order: rescale -> clip -> + wd*weight
             grads = {name: grads[name] * rescale for name in params}

@@ -154,3 +154,101 @@ def test_fused_monitor_falls_back():
             initializer=mx.init.Xavier(),
             optimizer_params={"learning_rate": 0.05})
     assert mod._fused_step is None
+
+
+def test_fused_step_bf16_compute_dtype():
+    """compute_dtype=bfloat16: master params + optimizer state + BN aux
+    stay fp32, the step trains, and params track the fp32 run loosely
+    (reference analog: fp16 training with mp_sgd fp32 master weights)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.mesh import data_parallel_mesh
+    from mxnet_tpu.parallel.tpu_step import DataParallelTrainStep
+
+    X, y = _toy_data(n=64)
+    sym = _mlp()
+    mesh = data_parallel_mesh(jax.devices()[:1])
+    steps = {}
+    for name, cdt in (("fp32", None), ("bf16", "bfloat16")):
+        st = DataParallelTrainStep(sym, mesh, lr=0.05, momentum=0.9,
+                                   data_names=("data",),
+                                   label_names=("softmax_label",),
+                                   compute_dtype=cdt)
+        st.init({"data": (32, 10), "softmax_label": (32,)}, seed=3)
+        for i in range(4):
+            st({"data": X[i % 2 * 32:i % 2 * 32 + 32],
+                "softmax_label": y[i % 2 * 32:i % 2 * 32 + 32]})
+        steps[name] = st
+
+    bf = steps["bf16"]
+    for v in bf.params.values():
+        assert v.dtype == jnp.float32  # master copy
+    for v in bf.aux.values():
+        assert v.dtype == jnp.float32
+    for a, b in zip(jax.tree_util.tree_leaves(steps["fp32"].params),
+                    jax.tree_util.tree_leaves(bf.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.1, atol=0.05)
+
+
+def test_fused_module_multi_precision_flag():
+    """optimizer(multi_precision=True) turns on the bf16 compute path in
+    Module's fused step; training still converges."""
+    X, y = _toy_data()
+    mod = _fit_module("tpu_sync", 1, X, y, num_epoch=8,
+                      opt_params={"learning_rate": 0.05, "momentum": 0.9,
+                                  "multi_precision": True})
+    assert mod._fused_step is not None
+    import jax.numpy as jnp
+    assert mod._fused_step.compute_dtype == jnp.bfloat16
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.85, acc
+
+
+def test_fused_bf16_labels_not_cast():
+    """Labels must stay out of the bf16 batch cast: class indices >= 257
+    are unrepresentable in bf16 (511 -> 512) and would one-hot the wrong
+    class for ~half of ImageNet's 1000 labels."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.mesh import data_parallel_mesh
+    from mxnet_tpu.parallel.tpu_step import DataParallelTrainStep
+
+    k = 1000
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=k, name="fc")
+    sym = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mesh = data_parallel_mesh(jax.devices()[:1])
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (8, 32)).astype(np.float32)
+    # every label in the bf16-unrepresentable range
+    y = np.array([511, 513, 515, 517, 519, 521, 523, 525], np.float32)
+    losses = {}
+    for name, cdt in (("fp32", None), ("bf16", "bfloat16")):
+        st = DataParallelTrainStep(sym, mesh, lr=0.5, momentum=0.0,
+                                   data_names=("data",),
+                                   label_names=("softmax_label",),
+                                   compute_dtype=cdt)
+        st.init({"data": (8, 32), "softmax_label": (8,)}, seed=1)
+        for _ in range(80):
+            st({"data": X, "softmax_label": y})
+        out = np.asarray(st._step(st.params, st.opt_state, st.aux,
+                                  {"data": jnp.asarray(X),
+                                   "softmax_label": jnp.asarray(y)},
+                                  jax.random.PRNGKey(0),
+                                  jnp.float32(0.0))[3][0], np.float32)
+        losses[name] = out
+    # after overfitting 80 steps, argmax must hit the odd (unrepresentable-
+    # in-bf16) labels exactly for BOTH paths
+    assert (losses["fp32"].argmax(1) == y).all()
+    assert (losses["bf16"].argmax(1) == y).all(), \
+        "bf16 path trained against wrong (rounded) labels"
+
+
+def test_fused_bad_env_dtype_falls_back(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_COMPUTE_DTYPE", "not_a_dtype")
+    X, y = _toy_data()
+    mod = _fit_module("tpu_sync", 1, X, y, num_epoch=1)
+    assert mod._fused_step is not None
+    assert mod._fused_step.compute_dtype is None
