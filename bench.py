@@ -43,8 +43,9 @@ def _run_child(force_cpu):
                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".jax_cache"))
     if force_cpu:
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ci.envutil import cpu_mesh_env
+        env = cpu_mesh_env(1, base=env)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--run"],

@@ -22,16 +22,8 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-
-def _env_cpu_mesh(n=8):
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # don't register the TPU plugin
-    flags = [f for f in env.get("XLA_FLAGS", "").split()
-             if not f.startswith("--xla_force_host_platform_device_count")]
-    flags.append("--xla_force_host_platform_device_count=%d" % n)
-    env["XLA_FLAGS"] = " ".join(flags)
-    return env
+sys.path.insert(0, ROOT)
+from ci.envutil import cpu_mesh_env as _env_cpu_mesh  # noqa: E402
 
 
 def stage_build(_):

@@ -36,7 +36,11 @@ def normal(loc=0, scale=1, shape=(1,), dtype=None, ctx=None, out=None, **kwargs)
     return res
 
 
-randn = normal
+def randn(*shape, **kwargs):
+    """reference: python/mxnet/ndarray/random.py randn(*shape, loc=, scale=)."""
+    loc = kwargs.pop("loc", 0)
+    scale = kwargs.pop("scale", 1)
+    return normal(loc=loc, scale=scale, shape=shape or (1,), **kwargs)
 
 
 def gamma(alpha=1, beta=1, shape=(1,), dtype=None, ctx=None, **kwargs):
@@ -54,6 +58,27 @@ def poisson(lam=1.0, shape=(1,), dtype=None, ctx=None, **kwargs):
 
 def randint(low, high, shape=(1,), dtype="int32", ctx=None, **kwargs):
     return _sample(lambda k, s: jax.random.randint(k, s, low, high), shape, ctx, dtype)
+
+
+def negative_binomial(k=1, p=1, shape=(1,), dtype=None, ctx=None, **kwargs):
+    """reference: python/mxnet/ndarray/random.py:291 (NB via gamma-Poisson)."""
+    from . import _random_negative_binomial
+    if isinstance(shape, int):
+        shape = (shape,)
+    res = _random_negative_binomial(k=k, p=p, shape=shape,
+                                    dtype=dtype or "float32")
+    return res.as_in_context(ctx) if ctx is not None else res
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=(1,), dtype=None,
+                                  ctx=None, **kwargs):
+    """reference: python/mxnet/ndarray/random.py:341."""
+    from . import _random_generalized_negative_binomial
+    if isinstance(shape, int):
+        shape = (shape,)
+    res = _random_generalized_negative_binomial(
+        mu=mu, alpha=alpha, shape=shape, dtype=dtype or "float32")
+    return res.as_in_context(ctx) if ctx is not None else res
 
 
 def multinomial(data, shape=(), get_prob=False, dtype="int32", **kwargs):

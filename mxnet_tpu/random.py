@@ -53,3 +53,18 @@ class trace_key_scope:
 
     def __exit__(self, *exc):
         _STATE.trace_key = self.prev
+
+
+_ND_RANDOM_NAMES = ("uniform", "normal", "randn", "gamma", "exponential",
+                    "poisson", "randint", "negative_binomial",
+                    "generalized_negative_binomial", "multinomial", "shuffle")
+
+
+def __getattr__(name):
+    """Re-export the nd.random distributions (reference random.py does
+    `from .ndarray.random import *`; lazy here to avoid the import cycle —
+    ndarray.random imports this module for the key chain)."""
+    if name in _ND_RANDOM_NAMES:
+        from .ndarray import random as _ndr
+        return getattr(_ndr, name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

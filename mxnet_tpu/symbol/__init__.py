@@ -150,3 +150,6 @@ def pow(base, exp):
     if exp_sym:
         return exp._apply_op("_rpower_scalar", scalar=float(base))
     return base ** exp
+
+
+from . import random  # noqa: E402  (mx.sym.random namespace)

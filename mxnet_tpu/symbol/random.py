@@ -1,0 +1,64 @@
+"""mx.sym.random namespace (reference: python/mxnet/symbol/random.py).
+
+Each function builds the registered `_random_*` / `_sample_multinomial`
+symbol node; sampling happens inside the executor's jitted program, drawing
+from the per-step key the runtime threads through (random.py).
+"""
+from __future__ import annotations
+
+import sys
+
+_sym = None
+
+
+def _ops():
+    global _sym
+    if _sym is None:
+        _sym = sys.modules["mxnet_tpu.symbol"]
+    return _sym
+
+
+def uniform(low=0, high=1, shape=(1,), dtype=None, **kwargs):
+    return _ops()._random_uniform(low=low, high=high, shape=shape,
+                                  dtype=dtype or "float32", **kwargs)
+
+
+def normal(loc=0, scale=1, shape=(1,), dtype=None, **kwargs):
+    return _ops()._random_normal(loc=loc, scale=scale, shape=shape,
+                                 dtype=dtype or "float32", **kwargs)
+
+
+def gamma(alpha=1, beta=1, shape=(1,), dtype=None, **kwargs):
+    return _ops()._random_gamma(alpha=alpha, beta=beta, shape=shape,
+                                dtype=dtype or "float32", **kwargs)
+
+
+def exponential(scale=1, shape=(1,), dtype=None, **kwargs):
+    return _ops()._random_exponential(lam=1.0 / scale, shape=shape,
+                                      dtype=dtype or "float32", **kwargs)
+
+
+def poisson(lam=1, shape=(1,), dtype=None, **kwargs):
+    return _ops()._random_poisson(lam=lam, shape=shape,
+                                  dtype=dtype or "float32", **kwargs)
+
+
+def negative_binomial(k=1, p=1, shape=(1,), dtype=None, **kwargs):
+    return _ops()._random_negative_binomial(
+        k=k, p=p, shape=shape, dtype=dtype or "float32", **kwargs)
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=(1,), dtype=None,
+                                  **kwargs):
+    return _ops()._random_generalized_negative_binomial(
+        mu=mu, alpha=alpha, shape=shape, dtype=dtype or "float32", **kwargs)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kwargs):
+    return _ops()._sample_multinomial(data, shape=shape, get_prob=get_prob,
+                                      dtype=dtype, **kwargs)
+
+
+__all__ = ["uniform", "normal", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial",
+           "multinomial"]

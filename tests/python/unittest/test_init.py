@@ -1,0 +1,162 @@
+"""Initializer suite behavior (reference: tests/python/unittest/test_init.py
++ python/mxnet/initializer.py semantics)."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer as init_mod
+from mxnet_tpu.base import MXNetError
+
+
+def _init(initializer, name, shape):
+    arr = mx.nd.zeros(shape)
+    initializer(init_mod.InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_constant_zero_one():
+    assert (_init(mx.init.Zero(), "w_weight", (3, 4)) == 0).all()
+    assert (_init(mx.init.One(), "w_weight", (3, 4)) == 1).all()
+    assert (_init(mx.init.Constant(2.5), "w_weight", (3,)) == 2.5).all()
+
+
+def test_name_dispatch():
+    """Default fillers by suffix: bias/beta/moving_mean -> 0, gamma/
+    moving_var -> 1 (reference Initializer.__call__)."""
+    u = mx.init.Uniform(0.1)
+    assert (_init(u, "fc_bias", (4,)) == 0).all()
+    assert (_init(u, "bn_gamma", (4,)) == 1).all()
+    assert (_init(u, "bn_beta", (4,)) == 0).all()
+    assert (_init(u, "bn_moving_mean", (4,)) == 0).all()
+    assert (_init(u, "bn_moving_var", (4,)) == 1).all()
+    w = _init(u, "fc_weight", (100, 100))
+    assert abs(w).max() <= 0.1 and w.std() > 0.01
+    with pytest.raises(MXNetError):
+        _init(u, "mystery_tensor", (4,))
+
+
+def test_attr_override_init():
+    """__init__ attr on the variable overrides the global initializer."""
+    u = mx.init.Uniform(0.1)
+    arr = mx.nd.zeros((4,))
+    desc = init_mod.InitDesc("x_weight", attrs={"__init__": "ones"})
+    u(desc, arr)
+    assert (arr.asnumpy() == 1).all()
+
+
+def test_normal_std():
+    np.random.seed(0)
+    w = _init(mx.init.Normal(sigma=0.5), "w_weight", (200, 200))
+    assert abs(w.std() - 0.5) < 0.02
+    assert abs(w.mean()) < 0.02
+
+
+@pytest.mark.parametrize("factor,expected_fan", [
+    ("in", "fan_in"), ("out", "fan_out"), ("avg", "avg")])
+def test_xavier_scale(factor, expected_fan):
+    np.random.seed(0)
+    shape = (64, 32)   # fan_in 32, fan_out 64
+    magnitude = 3.0
+    w = _init(mx.init.Xavier(rnd_type="uniform", factor_type=factor,
+                             magnitude=magnitude), "w_weight", shape)
+    fan = {"fan_in": 32, "fan_out": 64, "avg": 48}[expected_fan]
+    bound = np.sqrt(magnitude / fan)
+    assert abs(w).max() <= bound + 1e-6
+    # uniform on [-b, b] has std b/sqrt(3); loose statistical check
+    assert abs(w.std() - bound / np.sqrt(3)) < 0.15 * bound
+
+
+def test_msraprelu_is_gaussian_xavier():
+    np.random.seed(0)
+    w = _init(mx.init.MSRAPrelu(slope=0.0), "w_weight", (128, 64))
+    # magnitude 2/fan_avg -> std sqrt(2/96)
+    assert abs(w.std() - np.sqrt(2.0 / 96)) < 0.02
+
+
+def test_orthogonal_rows_orthonormal():
+    np.random.seed(0)
+    w = _init(mx.init.Orthogonal(scale=1.0), "w_weight", (16, 64))
+    wtw = w @ w.T
+    np.testing.assert_allclose(wtw, np.eye(16), atol=1e-4)
+
+
+def test_bilinear_upsampling_kernel():
+    w = _init(mx.init.Bilinear(), "up_weight", (1, 1, 4, 4))
+    # separable tent filter (f=2, c=0.75): outer([.25,.75,.75,.25])
+    k = w[0, 0]
+    np.testing.assert_allclose(k, k[::-1, ::-1], atol=1e-6)
+    t = np.array([0.25, 0.75, 0.75, 0.25])
+    np.testing.assert_allclose(k, np.outer(t, t), atol=1e-5)
+
+
+def test_lstmbias_forget_gate_only():
+    """LSTMBias routes through the __init__ attr path (how gluon params
+    attach it); suffix dispatch alone would zero a *_bias name."""
+    nh = 8
+    arr = mx.nd.zeros((4 * nh,))
+    desc = init_mod.InitDesc("lstm_i2h_bias",
+                             attrs={"__init__": mx.init.LSTMBias(2.0).dumps()})
+    mx.init.Uniform(0.1)(desc, arr)  # global init defers to the attr
+    b = arr.asnumpy()
+    assert (b[nh:2 * nh] == 2.0).all()
+    assert (b[:nh] == 0).all() and (b[2 * nh:] == 0).all()
+
+
+def test_lstmbias_via_legacy_cell():
+    """legacy rnn.LSTMCell(forget_bias=) lands the bias in the f-gate block
+    (reference rnn_cell.py attaches init.LSTMBias to i2h_bias)."""
+    import mxnet_tpu.rnn as rnn
+    cell = rnn.LSTMCell(4, forget_bias=1.5)
+    outs, _ = cell.unroll(2, [mx.sym.Variable("t0"), mx.sym.Variable("t1")])
+    sym = outs[-1]
+    mod = mx.mod.Module(sym, data_names=("t0", "t1"), label_names=None,
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("t0", (1, 3)), ("t1", (1, 3))])
+    mod.init_params(mx.init.Zero())
+    args, _ = mod.get_params()
+    b = args["lstm_i2h_bias"].asnumpy()
+    assert (b[4:8] == 1.5).all()
+    assert (b[:4] == 0).all() and (b[8:] == 0).all()
+
+
+def test_mixed_patterns():
+    mixed = mx.init.Mixed([".*bias", ".*"],
+                          [mx.init.Zero(), mx.init.Uniform(0.1)])
+    arr = mx.nd.full((4,), 9.0)
+    mixed("fc1_bias", arr)
+    assert (arr.asnumpy() == 0.0).all()
+    arr2 = mx.nd.zeros((4, 4))
+    mixed("fc1_weight", arr2)
+    w = arr2.asnumpy()
+    assert abs(w).max() <= 0.1 and abs(w).max() > 0
+    with pytest.raises(MXNetError):
+        mx.init.Mixed(["^x$"], [mx.init.Zero()])("y", arr)
+
+
+def test_dumps_and_create_roundtrip():
+    u = mx.init.Uniform(0.07)
+    name, kwargs = json.loads(u.dumps())
+    assert name == "uniform"
+    re_u = init_mod.create(name, **kwargs)
+    assert isinstance(re_u, mx.init.Uniform)
+    # registry accepts instances unchanged
+    assert init_mod.create(u) is u
+
+
+def test_initializer_in_module_flow():
+    """Module.init_params applies name-dispatched init over all args."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Constant(0.25))
+    args, auxs = mod.get_params()
+    assert (args["fc_weight"].asnumpy() == 0.25).all()
+    assert (args["fc_bias"].asnumpy() == 0).all()
+    assert (args["bn_gamma"].asnumpy() == 1).all()
+    assert (auxs["bn_moving_var"].asnumpy() == 1).all()

@@ -1,0 +1,156 @@
+"""Gluon loss blocks vs numpy formulas (reference:
+tests/python/unittest/test_loss.py, python/mxnet/gluon/loss.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def _nd(x):
+    return mx.nd.array(np.asarray(x, np.float32))
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def test_l1_l2():
+    pred = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    label = np.array([[0.0, 2.0], [5.0, 1.0]], np.float32)
+    l2 = gluon.loss.L2Loss()(_nd(pred), _nd(label)).asnumpy()
+    np.testing.assert_allclose(l2, ((pred - label) ** 2).mean(1) / 2,
+                               rtol=1e-6)
+    l1 = gluon.loss.L1Loss()(_nd(pred), _nd(label)).asnumpy()
+    np.testing.assert_allclose(l1, np.abs(pred - label).mean(1), rtol=1e-6)
+
+
+def test_l2_sample_weight_and_weight():
+    pred = np.ones((2, 3), np.float32)
+    label = np.zeros((2, 3), np.float32)
+    sw = np.array([[1.0], [0.0]], np.float32)
+    out = gluon.loss.L2Loss(weight=4.0)(
+        _nd(pred), _nd(label), _nd(sw)).asnumpy()
+    np.testing.assert_allclose(out, [2.0, 0.0], rtol=1e-6)
+
+
+def test_sigmoid_bce_stable_matches_naive():
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 3, (4, 5)).astype(np.float32)
+    y = (rng.uniform(size=(4, 5)) > 0.5).astype(np.float32)
+    out = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        _nd(x), _nd(y)).asnumpy()
+    p = 1 / (1 + np.exp(-x))
+    naive = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    np.testing.assert_allclose(out, naive.mean(1), rtol=1e-4)
+    # from_sigmoid path
+    out2 = gluon.loss.SigmoidBCELoss(from_sigmoid=True)(
+        _nd(p), _nd(y)).asnumpy()
+    np.testing.assert_allclose(out2, naive.mean(1), rtol=1e-4)
+
+
+def test_softmax_ce_sparse_and_dense():
+    rng = np.random.RandomState(1)
+    logits = rng.normal(0, 1, (6, 4)).astype(np.float32)
+    labels = rng.randint(0, 4, (6,)).astype(np.float32)
+    lsm = np.log(_softmax(logits))
+    expect = -lsm[np.arange(6), labels.astype(int)]
+    out = gluon.loss.SoftmaxCrossEntropyLoss()(
+        _nd(logits), _nd(labels)).asnumpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    onehot = np.eye(4, dtype=np.float32)[labels.astype(int)]
+    out2 = gluon.loss.SoftmaxCELoss(sparse_label=False)(
+        _nd(logits), _nd(onehot)).asnumpy()
+    np.testing.assert_allclose(out2, expect, rtol=1e-5)
+    out3 = gluon.loss.SoftmaxCELoss(from_logits=True)(
+        _nd(lsm), _nd(labels)).asnumpy()
+    np.testing.assert_allclose(out3, expect, rtol=1e-5)
+
+
+def test_kldiv():
+    rng = np.random.RandomState(2)
+    logits = rng.normal(0, 1, (3, 5)).astype(np.float32)
+    target = _softmax(rng.normal(0, 1, (3, 5))).astype(np.float32)
+    logq = np.log(_softmax(logits))
+    expect = (target * (np.log(target + 1e-12) - logq)).mean(1)
+    out = gluon.loss.KLDivLoss(from_logits=False)(
+        _nd(logits), _nd(target)).asnumpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-6)
+
+
+def test_huber():
+    pred = np.array([0.0, 0.0, 0.0, 0.0], np.float32)
+    label = np.array([0.3, -0.6, 2.0, -3.0], np.float32)
+    rho = 1.0
+    d = np.abs(label - pred)
+    expect = np.where(d > rho, d - rho / 2, d * d / (2 * rho))
+    out = gluon.loss.HuberLoss(rho=rho)(
+        _nd(pred.reshape(4, 1)), _nd(label.reshape(4, 1))).asnumpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_hinge_losses():
+    pred = np.array([[0.6], [-0.4], [0.2]], np.float32)
+    label = np.array([[1], [1], [-1]], np.float32)
+    margin = 1.0
+    expect = np.maximum(0, margin - pred * label)[:, 0]
+    out = gluon.loss.HingeLoss()(_nd(pred), _nd(label)).asnumpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    out2 = gluon.loss.SquaredHingeLoss()(_nd(pred), _nd(label)).asnumpy()
+    np.testing.assert_allclose(out2, expect ** 2, rtol=1e-5)
+
+
+def test_logistic_losses():
+    pred = np.array([[0.5], [-1.0]], np.float32)
+    label = np.array([[1], [-1]], np.float32)
+    expect = np.log1p(np.exp(-pred * label))[:, 0]
+    out = gluon.loss.LogisticLoss(label_format="signed")(
+        _nd(pred), _nd(label)).asnumpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    label01 = np.array([[1], [0]], np.float32)
+    out2 = gluon.loss.LogisticLoss(label_format="binary")(
+        _nd(pred), _nd(label01)).asnumpy()
+    np.testing.assert_allclose(out2, expect, rtol=1e-5)
+
+
+def test_triplet():
+    a = np.array([[0.0, 0.0]], np.float32)
+    p = np.array([[1.0, 0.0]], np.float32)
+    n = np.array([[3.0, 0.0]], np.float32)
+    margin = 1.0
+    expect = max(0.0, 1.0 - 9.0 + margin)
+    out = gluon.loss.TripletLoss(margin=margin)(
+        _nd(a), _nd(p), _nd(n)).asnumpy()
+    np.testing.assert_allclose(out, [expect], rtol=1e-5)
+
+
+def test_ctc_loss_smoke():
+    """CTC against a hand-checkable case: T=2, single label 'a' (class 0,
+    blank=last). P(path emits 'a') summed over alignments."""
+    T, B, C = 2, 1, 3
+    logits = np.zeros((B, T, C), np.float32)  # uniform: each step p=1/3
+    label = np.array([[0, -1]], np.float32)   # padded with -1
+    out = gluon.loss.CTCLoss(layout="NTC")(
+        _nd(logits), _nd(label)).asnumpy()
+    # alignments for 'a' over 2 steps with blank b(=2): (a,a),(a,b),(b,a)
+    p = 3 * (1 / 9)
+    np.testing.assert_allclose(out, [-np.log(p)], rtol=1e-4)
+
+
+def test_losses_backward_and_hybridize():
+    """Every loss is differentiable and hybridizable."""
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(3)
+    pred = mx.nd.array(rng.normal(0, 1, (4, 5)).astype(np.float32))
+    label = mx.nd.array(rng.randint(0, 5, (4,)).astype(np.float32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn.hybridize()
+    pred.attach_grad()
+    with autograd.record():
+        out = loss_fn(pred, label).mean()
+    out.backward()
+    g = pred.grad.asnumpy()
+    sm = _softmax(pred.asnumpy())
+    onehot = np.eye(5, dtype=np.float32)[label.asnumpy().astype(int)]
+    np.testing.assert_allclose(g, (sm - onehot) / 4, rtol=1e-4, atol=1e-6)

@@ -1,0 +1,143 @@
+"""Metric suite vs numpy ground truth (reference:
+tests/python/unittest/test_metric.py + python/mxnet/metric.py)."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _nd(x):
+    return mx.nd.array(np.asarray(x, np.float32))
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    labels = _nd([0, 1, 2, 3])
+    preds = _nd([[0.9, .05, .025, .025],   # 0 ok
+                 [0.1, 0.7, 0.1, 0.1],     # 1 ok
+                 [0.5, 0.2, 0.2, 0.1],     # 0 wrong
+                 [0.0, 0.1, 0.2, 0.7]])    # 3 ok
+    m.update([labels], [preds])
+    assert m.get() == ("accuracy", 0.75)
+    m.update([_nd([1])], [_nd([[0.4, 0.6]])])
+    assert m.get()[1] == pytest.approx(0.8)
+    m.reset()
+    assert math.isnan(m.get()[1])
+
+
+def test_topk_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    labels = _nd([2, 0])
+    preds = _nd([[0.5, 0.3, 0.2, 0.0],    # top2 = {0,1}: miss
+                 [0.3, 0.4, 0.2, 0.1]])   # top2 = {1,0}: hit
+    m.update([labels], [preds])
+    name, val = m.get()
+    assert name == "top_k_accuracy_2"
+    assert val == 0.5
+
+
+def test_f1():
+    m = mx.metric.F1()
+    labels = _nd([1, 0, 1, 1])
+    preds = _nd([[0.2, 0.8],    # predict 1, true 1: TP
+                 [0.9, 0.1],    # predict 0, true 0: TN
+                 [0.7, 0.3],    # predict 0, true 1: FN
+                 [0.3, 0.7]])   # predict 1, true 1: TP
+    m.update([labels], [preds])
+    precision, recall = 2 / 2, 2 / 3
+    expect = 2 * precision * recall / (precision + recall)
+    assert m.get()[1] == pytest.approx(expect)
+
+
+def test_regression_metrics():
+    label = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    pred = np.array([1.5, 2.0, 2.0, 5.0], np.float32)
+    mae = mx.metric.MAE(); mae.update([_nd(label)], [_nd(pred)])
+    assert mae.get()[1] == pytest.approx(np.abs(label - pred).mean())
+    mse = mx.metric.MSE(); mse.update([_nd(label)], [_nd(pred)])
+    assert mse.get()[1] == pytest.approx(((label - pred) ** 2).mean())
+    rmse = mx.metric.RMSE(); rmse.update([_nd(label)], [_nd(pred)])
+    assert rmse.get()[1] == pytest.approx(
+        math.sqrt(((label - pred) ** 2).mean()))
+
+
+def test_cross_entropy_and_perplexity():
+    label = np.array([0, 1, 1], np.float32)
+    pred = np.array([[0.7, 0.3], [0.2, 0.8], [0.5, 0.5]], np.float32)
+    picked = np.array([0.7, 0.8, 0.5])
+    ce = mx.metric.CrossEntropy()
+    ce.update([_nd(label)], [_nd(pred)])
+    expect = -np.log(picked).mean()
+    assert ce.get()[1] == pytest.approx(expect, rel=1e-5)
+    pp = mx.metric.Perplexity(ignore_label=None)
+    pp.update([_nd(label)], [_nd(pred)])
+    assert pp.get()[1] == pytest.approx(math.exp(expect), rel=1e-5)
+
+
+def test_perplexity_ignores_label():
+    label = np.array([0, 1, 9], np.float32)   # 9 = pad
+    pred = np.ones((3, 10), np.float32) / 10
+    pp = mx.metric.Perplexity(ignore_label=9)
+    pp.update([_nd(label)], [_nd(pred)])
+    assert pp.get()[1] == pytest.approx(10.0, rel=1e-4)
+
+
+def test_pearson():
+    rng = np.random.RandomState(0)
+    a = rng.normal(0, 1, 100).astype(np.float32)
+    b = (0.7 * a + 0.3 * rng.normal(0, 1, 100)).astype(np.float32)
+    m = mx.metric.PearsonCorrelation()
+    m.update([_nd(a)], [_nd(b)])
+    assert m.get()[1] == pytest.approx(np.corrcoef(a, b)[0, 1], abs=1e-4)
+
+
+def test_negative_log_likelihood():
+    label = np.array([0, 1], np.float32)
+    pred = np.array([[0.8, 0.2], [0.3, 0.7]], np.float32)
+    m = mx.metric.NegativeLogLikelihood()
+    m.update([_nd(label)], [_nd(pred)])
+    assert m.get()[1] == pytest.approx(-np.log([0.8, 0.7]).mean(), rel=1e-5)
+
+
+def test_loss_metric_and_custom():
+    m = mx.metric.Loss()
+    m.update(None, [_nd([1.0, 3.0])])
+    assert m.get()[1] == pytest.approx(2.0)
+
+    def fmax(label, pred):
+        return float(np.max(pred))
+    c = mx.metric.CustomMetric(fmax, name="fmax")
+    c.update([_nd([0])], [_nd([[0.3, 0.9]])])
+    assert c.get()[1] == pytest.approx(0.9)
+    c2 = mx.metric.np(fmax)
+    assert isinstance(c2, mx.metric.CustomMetric)
+
+
+def test_composite_and_create():
+    m = mx.metric.CompositeEvalMetric()
+    m.add(mx.metric.Accuracy())
+    m.add(mx.metric.MAE())
+    labels = _nd([1])
+    preds = _nd([[0.3, 0.7]])
+    m.update([labels], [preds])
+    names, vals = m.get()
+    assert names == ["accuracy", "mae"]
+    assert vals[0] == 1.0
+    # registry create by name / list / dict
+    assert isinstance(mx.metric.create("acc"), mx.metric.Accuracy)
+    comp = mx.metric.create(["acc", "mae"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+    topk = mx.metric.create("top_k_accuracy", top_k=3)
+    assert topk.get()[0] == "top_k_accuracy_3"
+
+
+def test_update_dict_with_output_names():
+    """update_dict routes by output_names/label_names (module eval path)."""
+    m = mx.metric.Accuracy(output_names=["softmax_output"],
+                           label_names=["softmax_label"])
+    m.update_dict({"softmax_label": _nd([1])},
+                  {"softmax_output": _nd([[0.2, 0.8]]),
+                   "other_output": _nd([[9.9]])})
+    assert m.get()[1] == 1.0

@@ -1,0 +1,369 @@
+"""Per-optimizer numeric tests against independent numpy mirrors of the
+reference update formulas (reference: tests/python/unittest/test_optimizer.py,
+python/mxnet/optimizer.py, src/operator/optimizer_op.cc).
+
+Each test steps the real Optimizer.update() on device and a pure-numpy
+replica side by side for several iterations and asserts the weights track.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt_mod
+
+from mxnet_tpu.util.test_utils import with_seed
+
+
+def _prep(g, w, rescale, clip, wd):
+    g = g * rescale
+    if clip is not None:
+        g = np.clip(g, -clip, clip)
+    return g + wd * w
+
+
+def _run_side_by_side(opt, np_step, n_steps=6, shape=(4, 7), seed=0,
+                      rtol=1e-5, atol=1e-6, dtype=np.float32):
+    """np_step(w, g, state) -> new_w, mutating its own numpy state dict."""
+    rng = np.random.RandomState(seed)
+    w0 = rng.normal(0, 1, shape).astype(dtype)
+    weight = mx.nd.array(w0)
+    state = opt.create_state(0, weight)
+    np_state = {}
+    w_np = w0.astype(np.float64)
+    for t in range(n_steps):
+        g_np = rng.normal(0, 1, shape).astype(dtype)
+        opt.update(0, weight, mx.nd.array(g_np), state)
+        w_np = np_step(w_np, g_np.astype(np.float64), np_state, t + 1)
+        np.testing.assert_allclose(weight.asnumpy(), w_np, rtol=rtol,
+                                   atol=atol,
+                                   err_msg="step %d of %s"
+                                           % (t, type(opt).__name__))
+    return weight
+
+
+@with_seed()
+@pytest.mark.parametrize("momentum,wd,clip,rescale", [
+    (0.0, 0.0, None, 1.0),
+    (0.9, 1e-3, None, 1.0),
+    (0.9, 1e-3, 0.5, 1.0 / 8),
+])
+def test_sgd(momentum, wd, clip, rescale):
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=momentum, wd=wd,
+                      clip_gradient=clip, rescale_grad=rescale)
+
+    def step(w, g, st, t):
+        g = _prep(g, w, rescale, clip, wd)
+        if momentum:
+            st["mom"] = momentum * st.get("mom", 0.0) - 0.1 * g
+            return w + st["mom"]
+        return w - 0.1 * g
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_nag():
+    mom, lr, wd = 0.9, 0.05, 1e-3
+    opt = opt_mod.NAG(learning_rate=lr, momentum=mom, wd=wd)
+
+    def step(w, g, st, t):
+        g = _prep(g, w, 1.0, None, wd)
+        st["mom"] = mom * st.get("mom", 0.0) + g
+        return w - lr * (g + mom * st["mom"])
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_signum_and_signsgd():
+    lr, mom, wd, wd_lh = 0.01, 0.9, 1e-3, 1e-4
+    opt = opt_mod.Signum(learning_rate=lr, momentum=mom, wd=wd, wd_lh=wd_lh)
+
+    def step(w, g, st, t):
+        g = _prep(g, w, 1.0, None, wd)
+        st["mom"] = mom * st.get("mom", 0.0) - (1 - mom) * g
+        return (1 - lr * wd_lh) * w + lr * np.sign(st["mom"])
+
+    _run_side_by_side(opt, step)
+
+    opt2 = opt_mod.Signum(learning_rate=lr, momentum=0.0, wd=wd)
+
+    def step2(w, g, st, t):
+        g = _prep(g, w, 1.0, None, 0.0)
+        return w - lr * (np.sign(g) + wd * w)
+
+    _run_side_by_side(opt2, step2)
+
+
+@with_seed()
+@pytest.mark.parametrize("wd,clip", [(0.0, None), (1e-3, 0.7)])
+def test_adam(wd, clip):
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    opt = opt_mod.Adam(learning_rate=lr, wd=wd, clip_gradient=clip)
+
+    def step(w, g, st, t):
+        g = _prep(g, w, 1.0, clip, wd)
+        st["m"] = b1 * st.get("m", 0.0) + (1 - b1) * g
+        st["v"] = b2 * st.get("v", 0.0) + (1 - b2) * g * g
+        lr_t = lr * math.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        return w - lr_t * st["m"] / (np.sqrt(st["v"]) + eps)
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_adagrad():
+    lr, eps, wd = 0.05, 1e-7, 1e-4
+    opt = opt_mod.AdaGrad(learning_rate=lr, eps=eps, wd=wd)
+
+    def step(w, g, st, t):
+        g = _prep(g, w, 1.0, None, wd)
+        st["h"] = st.get("h", 0.0) + g * g
+        return w - lr * g / (np.sqrt(st["h"]) + eps)
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_rmsprop_plain():
+    lr, g1, eps = 0.01, 0.9, 1e-8
+    opt = opt_mod.RMSProp(learning_rate=lr, gamma1=g1, epsilon=eps)
+
+    def step(w, g, st, t):
+        st["n"] = (1 - g1) * g * g + g1 * st.get("n", 0.0)
+        return w - lr * g / np.sqrt(st["n"] + eps)
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_rmsprop_centered():
+    lr, g1, g2, eps = 0.01, 0.9, 0.85, 1e-8
+    opt = opt_mod.RMSProp(learning_rate=lr, gamma1=g1, gamma2=g2,
+                          epsilon=eps, centered=True)
+
+    def step(w, g, st, t):
+        st["n"] = (1 - g1) * g * g + g1 * st.get("n", 0.0)
+        st["g"] = (1 - g1) * g + g1 * st.get("g", 0.0)
+        st["d"] = (g2 * st.get("d", 0.0)
+                   - lr * g / np.sqrt(st["n"] - st["g"] ** 2 + eps))
+        return w + st["d"]
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_adadelta():
+    rho, eps = 0.9, 1e-5
+    opt = opt_mod.AdaDelta(rho=rho, epsilon=eps)
+
+    def step(w, g, st, t):
+        st["ag"] = rho * st.get("ag", 0.0) + (1 - rho) * g * g
+        delta = (np.sqrt(st.get("ad", 0.0) + eps)
+                 / np.sqrt(st["ag"] + eps)) * g
+        st["ad"] = rho * st.get("ad", 0.0) + (1 - rho) * delta * delta
+        return w - delta
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_ftrl():
+    lr, l1, beta = 0.1, 0.01, 1.0
+    opt = opt_mod.Ftrl(learning_rate=lr, lamda1=l1, beta=beta)
+
+    def step(w, g, st, t):
+        n_prev = st.get("n", np.zeros_like(w))
+        st["n"] = n_prev + g * g
+        sigma = (np.sqrt(st["n"]) - np.sqrt(n_prev)) / lr
+        st["z"] = st.get("z", 0.0) + g - sigma * w
+        z = st["z"]
+        return np.where(
+            np.abs(z) > l1,
+            -(z - np.sign(z) * l1) / ((beta + np.sqrt(st["n"])) / lr),
+            0.0)
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_adamax():
+    lr, b1, b2 = 0.002, 0.9, 0.999
+    opt = opt_mod.Adamax(learning_rate=lr)
+
+    def step(w, g, st, t):
+        st["m"] = b1 * st.get("m", 0.0) + (1 - b1) * g
+        st["u"] = np.maximum(b2 * st.get("u", np.zeros_like(w)), np.abs(g))
+        return w - (lr / (1 - b1 ** t)) * st["m"] / (st["u"] + 1e-8)
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_nadam():
+    lr, b1, b2, eps, sd = 0.001, 0.9, 0.999, 1e-8, 0.004
+    opt = opt_mod.Nadam(learning_rate=lr, schedule_decay=sd)
+
+    def step(w, g, st, t):
+        mt = b1 * (1 - 0.5 * 0.96 ** (t * sd))
+        mt1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * sd))
+        st["sched"] = st.get("sched", 1.0) * mt
+        sched_next = st["sched"] * mt1
+        st["m"] = b1 * st.get("m", 0.0) + (1 - b1) * g
+        st["v"] = b2 * st.get("v", 0.0) + (1 - b2) * g * g
+        g_p = g / (1 - st["sched"])
+        m_p = st["m"] / (1 - sched_next)
+        v_p = st["v"] / (1 - b2 ** t)
+        m_bar = (1 - mt) * g_p + mt1 * m_p
+        return w - lr * m_bar / (np.sqrt(v_p) + eps)
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_dcasgd():
+    lr, lam, wd = 0.05, 0.04, 1e-3
+    opt = opt_mod.DCASGD(learning_rate=lr, lamda=lam, wd=wd)
+
+    def step(w, g, st, t):
+        comp = (g + wd * w
+                + lam * g * g * (w - st.get("prev", w)))
+        st["prev"] = w
+        return w - lr * comp
+
+    _run_side_by_side(opt, step)
+
+
+@with_seed()
+def test_lbsgd_warmup_and_accumulation():
+    """batch_scale=2: every other update applies the accumulated mean grad
+    with the linear-warmup lr multiplier (reference optimizer.py:648)."""
+    lr, mom, bs = 0.1, 0.9, 2
+    opt = opt_mod.LBSGD(learning_rate=lr, momentum=mom, batch_scale=bs,
+                        warmup_epochs=1, updates_per_epoch=4,
+                        warmup_strategy="linear")
+    rng = np.random.RandomState(0)
+    w0 = rng.normal(0, 1, (3, 4)).astype(np.float32)
+    weight = mx.nd.array(w0)
+    state = opt.create_state(0, weight)
+    w_np = w0.astype(np.float64)
+    mom_np = np.zeros_like(w_np)
+    cum = np.zeros_like(w_np)
+    num_cums = 0
+    nwup = 1 * 4
+    for t in range(6):
+        g_np = rng.normal(0, 1, (3, 4)).astype(np.float32)
+        opt.update(0, weight, mx.nd.array(g_np), state)
+        cum = cum + g_np
+        num_cums += 1
+        if num_cums % bs == 0:
+            g = cum / bs
+            mult = (float(bs) if num_cums >= nwup
+                    else 1.0 + (bs - 1) * num_cums / nwup)
+            mom_np = mom * mom_np + lr * mult * g
+            w_np = w_np - mom_np
+            cum = np.zeros_like(w_np)
+        np.testing.assert_allclose(weight.asnumpy(), w_np, rtol=1e-5,
+                                   atol=1e-6, err_msg="step %d" % t)
+
+
+@with_seed()
+def test_sgld_is_stochastic_but_centered():
+    """SGLD adds sqrt(lr) gaussian noise around the half-gradient step."""
+    lr = 0.01
+    opt = opt_mod.SGLD(learning_rate=lr)
+    w0 = np.zeros((2000,), np.float32)
+    weight = mx.nd.array(w0)
+    g = np.ones((2000,), np.float32)
+    opt.update(0, weight, mx.nd.array(g), None)
+    w = weight.asnumpy()
+    # mean step == -lr/2 * g, std == sqrt(lr)
+    assert abs(w.mean() + lr / 2) < 3 * math.sqrt(lr) / math.sqrt(2000)
+    assert abs(w.std() - math.sqrt(lr)) < 0.02
+
+
+def test_lr_wd_mult_via_idx2name():
+    """__lr_mult__/__wd_mult__ and idx2name scaling (reference
+    optimizer.py set_lr_mult/set_wd_mult)."""
+    opt = opt_mod.SGD(learning_rate=0.1, wd=0.01,
+                      param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    opt.set_lr_mult({"fc_weight": 0.5})
+    opt.set_wd_mult({})
+    # bias gets wd_mult 0 automatically (not *_weight/*_gamma)
+    assert opt._get_wd(1) == 0.0
+    assert opt._get_lr(0) == pytest.approx(0.05)
+    w = mx.nd.ones((3,))
+    g = mx.nd.ones((3,))
+    opt.update(0, w, g, opt.create_state(0, w))
+    # w - lr_mult*lr*(g + wd*w) = 1 - 0.05*(1 + 0.01)
+    np.testing.assert_allclose(w.asnumpy(), 1 - 0.05 * 1.01, rtol=1e-6)
+
+
+def test_lr_scheduler_drives_update_lr():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    opt = opt_mod.SGD(learning_rate=0.4, lr_scheduler=sched)
+    w = mx.nd.zeros((1,))
+    g = mx.nd.ones((1,))
+    seen = []
+    prev = 0.0
+    for _ in range(5):
+        opt.update(0, w, g, None)
+        cur = float(w.asnumpy()[0])
+        seen.append(round(prev - cur, 6))
+        prev = cur
+    # lr: 0.4, 0.4, 0.2, 0.2, 0.1 (factor applied every 2 updates)
+    assert seen == [0.4, 0.4, 0.2, 0.2, 0.1]
+
+
+@with_seed()
+def test_multi_precision_fp16_master():
+    """fp16 weights keep an fp32 master copy (reference mp_sgd path)."""
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w16 = mx.nd.array(np.random.RandomState(0).normal(0, 1, (8,)), dtype=np.float16)
+    state = opt.create_state_multi_precision(0, w16)
+    assert isinstance(state, tuple) and state[0].dtype == np.float32
+    w_before = w16.asnumpy().copy()
+    g = mx.nd.array(np.full((8,), 1e-3), dtype=np.float16)
+    for _ in range(4):
+        opt.update_multi_precision(0, w16, g, state)
+    # master moved by ~4 momentum-accumulated steps; fp16 view tracks it
+    np.testing.assert_allclose(w16.asnumpy(),
+                               state[0].asnumpy().astype(np.float16),
+                               rtol=1e-3)
+    assert not np.allclose(w16.asnumpy(), w_before)
+
+
+def test_updater_and_serialization():
+    """get_updater applies per-index states; states survive
+    get_states/set_states (reference: Module.save_optimizer_states)."""
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt_mod.get_updater(opt)
+    w = mx.nd.ones((3,))
+    for _ in range(3):
+        upd(0, mx.nd.ones((3,)), w)
+    blob = upd.get_states()
+    w_snapshot = w.asnumpy().copy()
+
+    # resume in a fresh updater from the serialized momentum
+    opt2 = opt_mod.SGD(learning_rate=0.1, momentum=0.9)
+    # match the update counter so lr/schedule state agrees
+    opt2.begin_num_update = opt.num_update
+    opt2.num_update = opt.num_update
+    opt2._index_update_count = dict(opt._index_update_count)
+    upd2 = opt_mod.get_updater(opt2)
+    upd2.set_states(blob)
+    w2 = mx.nd.array(w_snapshot)
+
+    upd(0, mx.nd.ones((3,)), w)
+    upd2(0, mx.nd.ones((3,)), w2)
+    np.testing.assert_allclose(w2.asnumpy(), w.asnumpy(), rtol=1e-6)
+
+
+def test_create_registry_roundtrip():
+    for name in ("sgd", "adam", "rmsprop", "adagrad", "adadelta", "ftrl",
+                 "adamax", "nadam", "nag", "signum", "sgld", "dcasgd",
+                 "lbsgd"):
+        o = opt_mod.create(name, learning_rate=0.1)
+        assert isinstance(o, opt_mod.Optimizer), name

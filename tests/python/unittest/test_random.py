@@ -1,0 +1,140 @@
+"""RNG behavior (reference: tests/python/unittest/test_random.py):
+seed determinism, distribution moments, multinomial, shuffle, symbolic
+sampling, and stochastic-op (Dropout) seeding."""
+import math
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_seed_determinism():
+    mx.random.seed(128)
+    a = mx.random.normal(0, 1, shape=(50,)).asnumpy()
+    mx.random.seed(128)
+    b = mx.random.normal(0, 1, shape=(50,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    mx.random.seed(129)
+    c = mx.random.normal(0, 1, shape=(50,)).asnumpy()
+    assert not np.allclose(a, c)
+
+
+def test_consecutive_draws_differ():
+    mx.random.seed(0)
+    a = mx.nd.random.uniform(shape=(100,)).asnumpy()
+    b = mx.nd.random.uniform(shape=(100,)).asnumpy()
+    assert not np.allclose(a, b)
+
+
+def test_distribution_moments():
+    mx.random.seed(0)
+    n = 40000
+    cases = [
+        (mx.nd.random.uniform(-4, 4, shape=(n,)), 0.0, 8 / math.sqrt(12)),
+        (mx.nd.random.normal(2.0, 3.0, shape=(n,)), 2.0, 3.0),
+        (mx.nd.random.exponential(scale=2.0, shape=(n,)), 2.0, 2.0),
+        (mx.nd.random.poisson(lam=4.0, shape=(n,)), 4.0, 2.0),
+        (mx.nd.random.gamma(alpha=9.0, beta=0.5, shape=(n,)), 4.5, 1.5),
+    ]
+    for arr, mean, std in cases:
+        x = arr.asnumpy()
+        assert abs(x.mean() - mean) < 0.1 * max(1.0, abs(mean)), (x.mean(), mean)
+        assert abs(x.std() - std) < 0.1 * max(1.0, std), (x.std(), std)
+
+
+def test_negative_binomial_moments():
+    mx.random.seed(1)
+    k, p = 5, 0.4
+    x = mx.random.negative_binomial(k=k, p=p, shape=(40000,)).asnumpy()
+    mean = k * (1 - p) / p
+    var = mean / p
+    assert abs(x.mean() - mean) < 0.15 * mean
+    assert abs(x.var() - var) < 0.2 * var
+    mu, alpha = 2.5, 0.3
+    y = mx.random.generalized_negative_binomial(
+        mu=mu, alpha=alpha, shape=(40000,)).asnumpy()
+    assert abs(y.mean() - mu) < 0.15 * mu
+    assert abs(y.var() - (mu + alpha * mu * mu)) < 0.25 * (mu + alpha * mu * mu)
+
+
+def test_randint_bounds_and_dtype():
+    x = mx.nd.random.randint(5, 15, shape=(1000,))
+    xn = x.asnumpy()
+    assert xn.dtype == np.int32
+    assert xn.min() >= 5 and xn.max() < 15
+    assert len(np.unique(xn)) == 10
+
+
+def test_multinomial_counts_and_prob():
+    mx.random.seed(3)
+    probs = mx.nd.array([[0.1, 0.2, 0.3, 0.4]])
+    s = mx.nd.sample_multinomial(probs, shape=(8000,))
+    xn = s.asnumpy().reshape(-1)
+    freq = np.bincount(xn.astype(np.int64), minlength=4) / xn.size
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.3, 0.4], atol=0.03)
+    samp, logp = mx.nd.sample_multinomial(probs, shape=(16,), get_prob=True)
+    expected = np.log([0.1, 0.2, 0.3, 0.4])[samp.asnumpy().astype(np.int64)]
+    np.testing.assert_allclose(logp.asnumpy(), expected.reshape(logp.shape),
+                               rtol=1e-4)
+
+
+def test_shuffle_is_permutation():
+    x = mx.nd.arange(64)
+    y = mx.nd.random.shuffle(x)
+    assert sorted(y.asnumpy().tolist()) == list(range(64))
+    assert not np.array_equal(y.asnumpy(), x.asnumpy())
+
+
+def test_symbolic_sampling_per_step():
+    """Symbol graphs draw fresh randomness per forward (the executor
+    threads a split key each step) and respect mx.random.seed."""
+    s = mx.sym.random.uniform(shape=(16,))
+    exe = s.bind(mx.cpu(), {})
+    mx.random.seed(11)
+    a = exe.forward()[0].asnumpy().copy()
+    b = exe.forward()[0].asnumpy().copy()
+    assert not np.allclose(a, b)
+    mx.random.seed(11)
+    a2 = exe.forward()[0].asnumpy()
+    np.testing.assert_array_equal(a, a2)
+
+
+def test_dropout_respects_seed():
+    x = mx.nd.ones((400,))
+    from mxnet_tpu import autograd
+    mx.random.seed(5)
+    with autograd.record(train_mode=True):
+        a = mx.nd.Dropout(x, p=0.5).asnumpy()
+    mx.random.seed(5)
+    with autograd.record(train_mode=True):
+        b = mx.nd.Dropout(x, p=0.5).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    # roughly half zeroed, survivors scaled by 2
+    assert 0.35 < (a == 0).mean() < 0.65
+    assert set(np.unique(a)).issubset({0.0, 2.0})
+
+
+def test_sample_family_per_row_params():
+    """_sample_* ops draw one batch per parameter row (reference
+    multisample_op.cc)."""
+    mu = mx.nd.array([1.0, 10.0])
+    sig = mx.nd.array([0.1, 0.1])
+    x = mx.nd._sample_normal(mu, sig, shape=(3000,))
+    xn = x.asnumpy()
+    assert xn.shape == (2, 3000)
+    assert abs(xn[0].mean() - 1.0) < 0.05
+    assert abs(xn[1].mean() - 10.0) < 0.05
+
+
+def test_randn_reference_signature():
+    """randn(*shape, loc=, scale=) — reference ndarray/random.py randn."""
+    x = mx.nd.random.randn(2, 3)
+    assert x.shape == (2, 3)
+    mx.random.seed(0)
+    big = mx.random.randn(5000, loc=1.0, scale=0.5).asnumpy()
+    assert abs(big.mean() - 1.0) < 0.05 and abs(big.std() - 0.5) < 0.05
+
+
+def test_negative_binomial_honors_ctx():
+    x = mx.nd.random.negative_binomial(k=2, p=0.5, shape=(4,), ctx=mx.cpu(0))
+    assert x.context == mx.cpu(0)
