@@ -137,12 +137,9 @@ class NDArrayIter(DataIter):
             _np.random.shuffle(self.idx)
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
-        if last_batch_handle == "discard":
-            self.num_batches = self.num_data // batch_size
-        else:
-            self.num_batches = (self.num_data + batch_size - 1) // batch_size
-        self.cursor = -1
-        self._cache = None
+        # sample cursor, reference io.py:699 semantics: starts one batch
+        # before the data; roll_over carries the wrap offset across resets
+        self.cursor = -batch_size
 
     @property
     def provide_data(self):
@@ -156,17 +153,33 @@ class NDArrayIter(DataIter):
                          getattr(v, "dtype", _np.float32))
                 for k, v in self.label]
 
-    def reset(self):
-        self.cursor = -1
+    def hard_reset(self):
+        """Ignore rolled-over data, restart at the beginning (reference
+        io.py:695)."""
+        self.cursor = -self.batch_size
         if self.shuffle:
             _np.random.shuffle(self.idx)
 
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            # leftover samples of the wrapped batch open the next epoch
+            # (reference io.py:700)
+            self.cursor = (-self.batch_size
+                           + (self.cursor % self.num_data) % self.batch_size)
+        else:
+            self.cursor = -self.batch_size
+            if self.shuffle:
+                _np.random.shuffle(self.idx)
+
     def iter_next(self):
-        self.cursor += 1
-        return self.cursor < self.num_batches
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
 
     def _take(self, arrays):
-        start = self.cursor * self.batch_size
+        start = max(self.cursor, 0)
         end = min(start + self.batch_size, self.num_data)
         sel = self.idx[start:end]
         pad = self.batch_size - len(sel)
@@ -189,12 +202,13 @@ class NDArrayIter(DataIter):
         return self._take(self.label)[0] if self.label else []
 
     def getpad(self):
-        start = self.cursor * self.batch_size
-        end = min(start + self.batch_size, self.num_data)
-        return self.batch_size - (end - start)
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
 
     def getindex(self):
-        start = self.cursor * self.batch_size
+        start = max(self.cursor, 0)
         end = min(start + self.batch_size, self.num_data)
         return self.idx[start:end]
 

@@ -118,7 +118,18 @@ class Module(BaseModule):
     def output_shapes(self):
         assert self.binded
         outputs = self._exec_group.get_outputs()
-        return list(zip(self._output_names, [o.shape for o in outputs]))
+        if outputs:
+            return list(zip(self._output_names, [o.shape for o in outputs]))
+        # before any forward: infer from the bound input shapes
+        # (reference graph_executor infers at bind time)
+        shapes = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            shapes.update({l.name: l.shape for l in self._label_shapes})
+        try:
+            _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        except MXNetError:
+            return []
+        return list(zip(self._output_names, [tuple(s) for s in out_shapes]))
 
     # ------------------------------------------------------------------
     def get_params(self):
@@ -186,6 +197,17 @@ class Module(BaseModule):
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
+        # checkpoint-loading API: surface extra names here (reference does
+        # it in executor copy_params_from); fit(arg_params=...) through
+        # init_params stays permissive so truncated-symbol fine-tuning
+        # keeps working
+        if not allow_extra:
+            extra = set(arg_params or ()) - set(self._param_names)
+            extra |= set(aux_params or ()) - set(self._aux_names)
+            if extra:
+                raise MXNetError(
+                    "parameters %s are not needed by the symbol "
+                    "(pass allow_extra=True to ignore)" % sorted(extra))
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
                              aux_params=aux_params, allow_missing=allow_missing,

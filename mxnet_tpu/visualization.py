@@ -15,13 +15,20 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
         raise TypeError("symbol must be a Symbol")
     show_shape = False
     shape_dict = {}
+    arg_shape_dict = {}
     if shape is not None:
         show_shape = True
         interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
+        arg_shapes, out_shapes, _ = interals.infer_shape(**shape)
         if out_shapes is None:
             raise ValueError("Input shape is incomplete")
         shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+        # learnable args only: aux states (BN moving stats) and labels are
+        # not parameters (reference counts conv/fc weights+bias, bn
+        # gamma+beta)
+        arg_shape_dict = {n: s for n, s
+                          in zip(interals.list_arguments(), arg_shapes)
+                          if not n.endswith("_label")}
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
     if positions[-1] <= 1:
@@ -54,14 +61,19 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
                     if input_node["op"] != "null":
                         pre_node.append(input_name)
         cur_param = 0
-        if op != "null":
+        if op != "null" and show_shape:
+            # parameter count = product of each weight/aux input's
+            # inferred shape (reference print_layer_summary)
+            data_names = set(shape)
             for item in node["inputs"]:
                 input_node = nodes[item[0]]
-                if input_node["op"] == "null" and item[0] in set(conf["arg_nodes"]):
-                    key = input_node["name"] + "_output"
-                    if show_shape:
-                        key = input_node["name"]
-                        # parameter count from inferred arg shapes is unavailable
+                nm = input_node["name"]
+                if input_node["op"] == "null" and nm not in data_names \
+                        and nm in arg_shape_dict:
+                    n = 1
+                    for d in arg_shape_dict[nm]:
+                        n *= int(d)
+                    cur_param += n
         first_connection = pre_node[0] if pre_node else ""
         fields = [node["name"] + "(" + op + ")",
                   str(out_shape) if out_shape is not None else "",
@@ -91,11 +103,20 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                  node_attrs=None, hide_weights=True):
-    """Graphviz dot of the symbol graph (requires python graphviz if rendering)."""
+    """Graphviz dot of the symbol graph (requires python graphviz if
+    rendering). With `shape`, edges carry the tensor shape flowing along
+    them (reference plot_network edge labels)."""
     try:
         from graphviz import Digraph
     except ImportError:
         raise MXNetError("plot_network requires the graphviz python package")
+    shape_dict = {}
+    if shape is not None:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
     dot = Digraph(name=title)
@@ -119,5 +140,11 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
             src = nodes[item[0]]
             if item[0] in hidden:
                 continue
-            dot.edge(tail_name=src["name"], head_name=node["name"])
+            attrs = {"dir": "back", "arrowtail": "open"}
+            key = src["name"] if src["op"] == "null" \
+                else src["name"] + "_output"
+            if key in shape_dict:
+                attrs["label"] = "x".join(
+                    str(int(d)) for d in shape_dict[key][1:])
+            dot.edge(tail_name=node["name"], head_name=src["name"], **attrs)
     return dot

@@ -1,0 +1,147 @@
+"""Python data iterators (reference: tests/python/unittest/test_io.py):
+NDArrayIter padding/last-batch semantics, CSVIter, LibSVMIter, shuffle
+determinism, DataBatch metadata."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _collect(it):
+    it.reset()
+    out, pads = [], []
+    for batch in it:
+        out.append(batch.data[0].asnumpy().copy())
+        pads.append(batch.pad)
+    return out, pads
+
+
+def test_ndarrayiter_exact_batches():
+    X = np.arange(24, dtype=np.float32).reshape(12, 2)
+    it = mx.io.NDArrayIter(X, batch_size=4)
+    batches, pads = _collect(it)
+    assert len(batches) == 3 and all(p == 0 for p in pads)
+    np.testing.assert_array_equal(np.concatenate(batches), X)
+
+
+def test_ndarrayiter_pad_last_batch():
+    X = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(X, batch_size=4)  # default last_batch_handle=pad
+    batches, pads = _collect(it)
+    assert len(batches) == 3
+    assert pads == [0, 0, 2]
+    # padded tail wraps to the head of the epoch (reference semantics)
+    np.testing.assert_array_equal(batches[2][:2], X[8:])
+    # second epoch identical
+    batches2, _ = _collect(it)
+    np.testing.assert_array_equal(np.concatenate(batches),
+                                  np.concatenate(batches2))
+
+
+def test_ndarrayiter_discard_and_rollover():
+    X = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(X, batch_size=4, last_batch_handle="discard")
+    batches, _ = _collect(it)
+    assert len(batches) == 2
+    np.testing.assert_array_equal(np.concatenate(batches), X[:8])
+
+    # roll_over (reference io.py:700): epoch 1 delivers 3 batches, the
+    # last wrapping to the head; epoch 2 opens at the leftover offset
+    # (10 % 4 = 2) and delivers only full batches
+    it2 = mx.io.NDArrayIter(X, batch_size=4, last_batch_handle="roll_over")
+    b1, _ = _collect(it2)
+    assert len(b1) == 3
+    np.testing.assert_array_equal(b1[2], np.concatenate([X[8:], X[:2]]))
+    b2, _ = _collect(it2)
+    assert len(b2) == 2
+    np.testing.assert_array_equal(b2[0], X[2:6])
+    np.testing.assert_array_equal(b2[1], X[6:10])
+    # epoch 3: cursor ended exactly at num_data, full pass again
+    b3, _ = _collect(it2)
+    assert len(b3) == 3
+
+
+def test_ndarrayiter_shuffle_is_epoch_permutation():
+    X = np.arange(32, dtype=np.float32).reshape(32, 1)
+    it = mx.io.NDArrayIter(X, batch_size=8, shuffle=True)
+    b1, _ = _collect(it)
+    seen = np.concatenate(b1).reshape(-1)
+    assert sorted(seen.tolist()) == list(range(32))
+    assert not np.array_equal(seen, np.arange(32))
+
+
+def test_ndarrayiter_provide_data_label_names():
+    X = np.zeros((8, 3), np.float32)
+    y = np.zeros((8,), np.float32)
+    it = mx.io.NDArrayIter({"myd": X}, {"myl": y}, batch_size=4)
+    assert it.provide_data[0][0] == "myd"
+    assert tuple(it.provide_data[0][1]) == (4, 3)
+    assert it.provide_label[0][0] == "myl"
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3)
+    assert batch.label[0].shape == (4,)
+
+
+def test_csviter_roundtrip(tmp_path):
+    data = np.arange(30, dtype=np.float32).reshape(10, 3)
+    labels = np.arange(10, dtype=np.float32)
+    dcsv = os.path.join(str(tmp_path), "d.csv")
+    lcsv = os.path.join(str(tmp_path), "l.csv")
+    np.savetxt(dcsv, data, delimiter=",", fmt="%g")
+    np.savetxt(lcsv, labels, delimiter=",", fmt="%g")
+    it = mx.io.CSVIter(data_csv=dcsv, data_shape=(3,),
+                       label_csv=lcsv, label_shape=(1,), batch_size=5)
+    got_d, got_l = [], []
+    for b in it:
+        got_d.append(b.data[0].asnumpy())
+        got_l.append(b.label[0].asnumpy())
+    np.testing.assert_allclose(np.concatenate(got_d), data)
+    np.testing.assert_allclose(np.concatenate(got_l).reshape(-1), labels)
+
+
+def test_libsvmiter(tmp_path):
+    path = os.path.join(str(tmp_path), "t.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n0 0:2.5\n")
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(4,), batch_size=2)
+    rows, labs = [], []
+    for b in it:
+        rows.append(b.data[0].asnumpy())
+        labs.append(b.label[0].asnumpy())
+    dense = np.concatenate(rows)
+    expect = np.zeros((4, 4), np.float32)
+    expect[0, 0], expect[0, 3] = 1.5, 2.0
+    expect[1, 1] = 0.5
+    expect[2, 2], expect[2, 3] = 3.0, 1.0
+    expect[3, 0] = 2.5
+    np.testing.assert_allclose(dense, expect)
+    np.testing.assert_allclose(np.concatenate(labs).reshape(-1),
+                               [1, 0, 1, 0])
+
+
+def test_iter_data_batch_fields():
+    X = np.zeros((4, 2), np.float32)
+    it = mx.io.NDArrayIter(X, batch_size=2)
+    b = next(iter(it))
+    assert hasattr(b, "data") and hasattr(b, "label")
+    assert hasattr(b, "pad") and hasattr(b, "index")
+    db = mx.io.DataBatch(data=[mx.nd.zeros((1, 2))], pad=1)
+    assert db.pad == 1
+
+
+def test_resize_iter():
+    X = np.arange(12, dtype=np.float32).reshape(12, 1)
+    base = mx.io.NDArrayIter(X, batch_size=3)
+    it = mx.io.ResizeIter(base, 2)
+    batches, _ = _collect(it)
+    assert len(batches) == 2
+
+
+def test_prefetching_iter():
+    X = np.arange(32, dtype=np.float32).reshape(16, 2)
+    base = mx.io.NDArrayIter(X, batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    batches, _ = _collect(it)
+    np.testing.assert_array_equal(np.concatenate(batches), X)

@@ -1,0 +1,202 @@
+"""Module lifecycle beyond the fused path (reference:
+tests/python/unittest/test_module.py): bind/init/set_params semantics,
+reshape, forward with varying batch, save/load, output shapes, multi-device
+executor group slicing, missing/extra params handling."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_bind_and_shapes():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    assert not mod.binded
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    assert mod.binded and not mod.params_initialized
+    mod.init_params()
+    assert mod.params_initialized
+    assert mod.output_names == ["softmax_output"]
+    assert [tuple(s) for _, s in mod.output_shapes] == [(4, 3)]
+    assert dict(mod.data_shapes)["data"] == (4, 6)
+
+
+def test_forward_backward_update_cycle():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.normal(0, 1, (4, 6)).astype(np.float32))],
+        label=[mx.nd.array(np.array([0, 1, 2, 0], np.float32))])
+    before, _ = mod.get_params()
+    before = {k: v.asnumpy().copy() for k, v in before.items()}
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    after, _ = mod.get_params()
+    for k in before:
+        assert not np.allclose(before[k], after[k].asnumpy()), k
+
+
+def test_set_params_allow_missing_extra():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    args, auxs = mod.get_params()
+    partial = {"fc1_weight": mx.nd.ones(args["fc1_weight"].shape)}
+    with pytest.raises((RuntimeError, MXNetError)):
+        mod.set_params(partial, {}, allow_missing=False)
+    mod.set_params(partial, {}, allow_missing=True)
+    got, _ = mod.get_params()
+    assert (got["fc1_weight"].asnumpy() == 1).all()
+    extra = dict(args, bogus_weight=mx.nd.ones((2, 2)))
+    with pytest.raises(MXNetError):
+        mod.set_params(extra, auxs, allow_extra=False)
+    mod.set_params(extra, auxs, allow_extra=True)
+
+
+def test_predict_and_score():
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (30, 6)).astype(np.float32)
+    y = rng.randint(0, 3, (30,)).astype(np.float32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=10, label_name="softmax_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (30, 3)
+    np.testing.assert_allclose(preds.asnumpy().sum(1), 1.0, rtol=1e-4)
+    res = dict(mod.score(it, mx.metric.Accuracy()))
+    assert 0.0 <= res["accuracy"] <= 1.0
+
+
+def test_forward_smaller_last_batch():
+    """forward() accepts a batch whose first dim differs (predict tail)."""
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))], for_training=False)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.zeros((3, 6))], label=None)
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape[0] == 3
+
+
+def test_reshape():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.reshape(data_shapes=[("data", (16, 6))],
+                label_shapes=[("softmax_label", (16,))])
+    batch = mx.io.DataBatch(data=[mx.nd.zeros((16, 6))],
+                            label=[mx.nd.zeros((16,))])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (16, 3)
+
+
+def test_multi_device_slicing():
+    """2 cpu contexts: gradients average across the device slices exactly
+    like a single-device run on the full batch."""
+    rng = np.random.RandomState(1)
+    X = rng.normal(0, 1, (8, 6)).astype(np.float32)
+    y = rng.randint(0, 3, (8,)).astype(np.float32)
+
+    def run(ctxs):
+        mod = mx.mod.Module(_mlp(), context=ctxs)
+        it = mx.io.NDArrayIter(X, y, batch_size=8,
+                               label_name="softmax_label")
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.init.Constant(0.1))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.5})
+        batch = next(iter(it))
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    single = run([mx.cpu(0)])
+    double = run([mx.cpu(0), mx.cpu(1)])
+    for k in single:
+        np.testing.assert_allclose(single[k], double[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)
+
+
+def test_save_load_checkpoint_with_module(tmp_path):
+    prefix = str(tmp_path / "m")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    mod.save_checkpoint(prefix, 3)
+    mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (2, 6))],
+              label_shapes=[("softmax_label", (2,))])
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        np.testing.assert_array_equal(a1[k].asnumpy(), a2[k].asnumpy())
+
+
+def test_get_input_grads():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.ones((2, 6))],
+                            label=[mx.nd.zeros((2,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    assert g.shape == (2, 6)
+    assert np.isfinite(g.asnumpy()).all()
+
+
+def test_label_free_module():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    mod = mx.mod.Module(out, context=mx.cpu(), label_names=None)
+    mod.bind(data_shapes=[("data", (2, 4))], for_training=False)
+    mod.init_params()
+    mod.forward(mx.io.DataBatch(data=[mx.nd.zeros((2, 4))]),
+                is_train=False)
+    assert mod.get_outputs()[0].shape == (2, 2)
+
+
+def test_fit_finetune_with_extra_checkpoint_params():
+    """fit(arg_params=bigger_checkpoint, allow_missing=True) must not
+    reject extra names — the reference fine-tune flow loads a full
+    checkpoint into a truncated symbol."""
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (20, 6)).astype(np.float32)
+    y = rng.randint(0, 3, (20,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=10, label_name="softmax_label")
+    full = mx.mod.Module(_mlp(), context=mx.cpu())
+    full.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    full.init_params()
+    ckpt, _ = full.get_params()
+    ckpt = dict(ckpt, extra_layer_weight=mx.nd.ones((4, 4)))
+    # truncated symbol = just fc1 head
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=1, arg_params=ckpt, allow_missing=True)
+    got, _ = mod.get_params()
+    assert set(got) == {"fc1_weight", "fc1_bias"}

@@ -1,0 +1,140 @@
+"""Visualization (reference: tests/python/unittest/test_viz.py) and gluon
+data pipeline (reference: test_gluon_data.py) behavior."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, name="conv")
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_print_summary(capsys):
+    mx.viz.print_summary(_net(), shape={"data": (1, 3, 16, 16)})
+    out = capsys.readouterr().out
+    assert "conv" in out and "fc" in out
+    assert "Total params" in out
+    # conv params 3*3*3*4+4 = 112; fc input 4*7*7=196 -> 196*10+10 = 1970
+    assert "112" in out and "1970" in out
+
+
+def test_print_summary_requires_shape_for_params():
+    # without shapes the summary still prints structure
+    mx.viz.print_summary(_net())
+
+
+def test_plot_network_nodes():
+    g = mx.viz.plot_network(_net(), shape={"data": (1, 3, 16, 16)},
+                            save_format="dot")
+    src = g.source if hasattr(g, "source") else str(g)
+    for frag in ("conv", "bn", "fc", "softmax"):
+        assert frag in src
+    # shape annotations on edges
+    assert "16x16" in src or "3x16x16" in src
+
+
+# ---------------------------------------------------------------------------
+# gluon.data
+# ---------------------------------------------------------------------------
+
+
+def test_array_dataset_and_transform():
+    X = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(X, y)
+    assert len(ds) == 10
+    xi, yi = ds[3]
+    np.testing.assert_array_equal(np.asarray(xi), X[3])
+    assert float(np.asarray(yi)) == 3.0
+    ds2 = gluon.data.SimpleDataset(list(range(10))).transform(
+        lambda x: x * 2)
+    assert ds2[4] == 8
+    ds3 = gluon.data.SimpleDataset(list(range(10))).transform_first(
+        lambda x: x + 100)
+    assert ds3[4] == 104
+
+
+def test_samplers():
+    seq = list(gluon.data.SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = list(gluon.data.RandomSampler(100))
+    assert sorted(rnd) == list(range(100)) and rnd != list(range(100))
+    bs = gluon.data.BatchSampler(gluon.data.SequentialSampler(10), 3,
+                                 last_batch="keep")
+    batches = list(bs)
+    assert batches[0] == [0, 1, 2] and batches[-1] == [9]
+    assert len(list(gluon.data.BatchSampler(
+        gluon.data.SequentialSampler(10), 3, last_batch="discard"))) == 3
+    roll = gluon.data.BatchSampler(gluon.data.SequentialSampler(10), 3,
+                                   last_batch="rollover")
+    b1 = list(roll)
+    assert len(b1) == 3
+    b2 = list(roll)
+    assert b2[0][0] == 9  # leftover rolls into the next epoch
+
+
+@pytest.mark.parametrize("num_workers", [0, 2])
+def test_dataloader_batches(num_workers):
+    X = np.arange(30, dtype=np.float32).reshape(15, 2)
+    y = np.arange(15, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False,
+                                   num_workers=num_workers)
+    xs, ys = [], []
+    for xb, yb in loader:
+        xs.append(np.asarray(xb.asnumpy()))
+        ys.append(np.asarray(yb.asnumpy()))
+    assert [x.shape[0] for x in xs] == [4, 4, 4, 3]
+    np.testing.assert_array_equal(np.concatenate(xs), X)
+    np.testing.assert_array_equal(np.concatenate(ys), y)
+
+
+def test_dataloader_shuffle_covers_epoch():
+    ds = gluon.data.SimpleDataset(list(range(40)))
+    loader = gluon.data.DataLoader(ds, batch_size=8, shuffle=True)
+    seen = []
+    for b in loader:
+        seen.extend(np.asarray(b.asnumpy()).astype(int).tolist())
+    assert sorted(seen) == list(range(40))
+
+
+def test_record_file_dataset(tmp_path):
+    # RecordFileDataset requires the indexed flavor (reference dataset.py
+    # reads <base>.idx alongside the .rec)
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    payloads = [b"alpha", b"beta", b"gamma-longer-payload"]
+    for i, p in enumerate(payloads):
+        w.write_idx(i, p)
+    w.close()
+    ds = gluon.data.RecordFileDataset(path)
+    assert len(ds) == 3
+    got = [ds[i] for i in range(3)]
+    assert got == payloads
+
+
+def test_vision_transforms_and_datasets():
+    from mxnet_tpu.gluon.data.vision import transforms
+    x = mx.nd.array(np.random.RandomState(0).randint(
+        0, 255, (8, 8, 3)).astype(np.uint8))
+    t = transforms.ToTensor()(x)
+    assert t.shape == (3, 8, 8)
+    assert float(t.asnumpy().max()) <= 1.0
+    norm = transforms.Normalize(mean=0.5, std=0.5)(t)
+    assert float(norm.asnumpy().min()) >= -1.0 - 1e-5
+    comp = transforms.Compose([transforms.ToTensor(),
+                               transforms.Normalize(0.5, 0.5)])
+    assert comp(x).shape == (3, 8, 8)
