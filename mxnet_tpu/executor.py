@@ -62,7 +62,7 @@ class Executor:
         self._aux_names = aux_names
         self._grad_names = [n for n in arg_names
                             if self._grad_req.get(n, "null") != "null"]
-        self.outputs = []
+        self._outputs = None  # lazily materialized (see outputs property)
         self._cached = {}
         self._monitor_cb = None
         self._monitor_active = False
@@ -369,7 +369,29 @@ class Executor:
     def aux_arrays(self):
         return [self.aux_dict[n] for n in self._aux_names]
 
+    @property
+    def outputs(self):
+        """Output NDArrays. Valid before the first forward (reference
+        graph_executor allocates outputs at bind): zeros of the inferred
+        shapes are materialized lazily on first access, so bind itself
+        pays no inference cost."""
+        if self._outputs is None:
+            try:
+                _, out_shapes, _ = self._symbol.infer_shape(
+                    **{n: a.shape for n, a in self.arg_dict.items()})
+                self._outputs = [zeros(tuple(s), ctx=self._ctx)
+                                 for s in out_shapes]
+            except MXNetError:
+                self._outputs = []
+        return self._outputs
+
+    @outputs.setter
+    def outputs(self, value):
+        self._outputs = value
+
+    @property
     def output_dict(self):
+        """reference executor.py output_dict property."""
         return dict(zip(self._symbol.list_outputs(), self.outputs))
 
     def copy_params_from(self, arg_params, aux_params=None,

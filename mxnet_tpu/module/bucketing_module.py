@@ -116,7 +116,8 @@ class BucketingModule(BaseModule):
         assert self.binded
         self._curr_module.set_params(arg_params, aux_params,
                                      allow_missing=allow_missing,
-                                     force_init=force_init)
+                                     force_init=force_init,
+                                     allow_extra=allow_extra)
         self.params_initialized = True
         self._params_dirty = False
 

@@ -117,19 +117,10 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
+        # executors pre-allocate outputs at bind, so this is valid
+        # before the first forward too
         outputs = self._exec_group.get_outputs()
-        if outputs:
-            return list(zip(self._output_names, [o.shape for o in outputs]))
-        # before any forward: infer from the bound input shapes
-        # (reference graph_executor infers at bind time)
-        shapes = {d.name: d.shape for d in self._data_shapes}
-        if self._label_shapes:
-            shapes.update({l.name: l.shape for l in self._label_shapes})
-        try:
-            _, out_shapes, _ = self._symbol.infer_shape(**shapes)
-        except MXNetError:
-            return []
-        return list(zip(self._output_names, [tuple(s) for s in out_shapes]))
+        return list(zip(self._output_names, [o.shape for o in outputs]))
 
     # ------------------------------------------------------------------
     def get_params(self):

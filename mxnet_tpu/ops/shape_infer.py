@@ -17,12 +17,49 @@ from .nn import rnn_param_size
 
 PARAM_SHAPE_HOOKS = {}
 
+# reference-style backward inference: a 0 in a known shape means "unknown
+# dim" (mxnet convention); these hooks fill data dims from known weight
+# shapes (e.g. FullyConnectedShape assigns dshape from wshape)
+BACKFILL_SHAPE_HOOKS = {}
+
 
 def hook(name):
     def deco(fn):
         PARAM_SHAPE_HOOKS[name] = fn
         return fn
     return deco
+
+
+def backfill_hook(name):
+    def deco(fn):
+        BACKFILL_SHAPE_HOOKS[name] = fn
+        return fn
+    return deco
+
+
+@backfill_hook("FullyConnected")
+def _fc_backfill(params, shapes):
+    w = shapes.get("weight")
+    data = shapes.get("data")
+    if w is None or data is None or 0 in w:
+        return {}
+    in_dim = w[1]
+    if params.flatten and len(data) == 2 and data[1] == 0:
+        return {"data": (data[0], in_dim)}
+    if not params.flatten and data[-1] == 0:
+        return {"data": tuple(data[:-1]) + (in_dim,)}
+    return {}
+
+
+@backfill_hook("Convolution")
+def _conv_backfill(params, shapes):
+    w = shapes.get("weight")
+    data = shapes.get("data")
+    if w is None or data is None or 0 in w:
+        return {}
+    if len(data) >= 2 and data[1] == 0:
+        return {"data": (data[0], w[1] * params.num_group) + tuple(data[2:])}
+    return {}
 
 
 @hook("FullyConnected")

@@ -18,7 +18,7 @@ from ..base import MXNetError
 from ..context import current_context
 from ..ops import get_op, find_op
 from ..ops.registry import OPS
-from ..ops.shape_infer import PARAM_SHAPE_HOOKS
+from ..ops.shape_infer import PARAM_SHAPE_HOOKS, BACKFILL_SHAPE_HOOKS
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "fromjson"]
 
@@ -128,6 +128,72 @@ class Symbol:
 
     def list_inputs(self):
         return [n.name for n in self._variables()]
+
+    # ------------------------------------------------------------------
+    # composition (reference: symbol.py __call__/_compose — substitute
+    # free variable inputs with other symbols, returning a new graph)
+    # ------------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        name = kwargs.pop("name", None)
+        if args and kwargs:
+            # reference _compose: positional and keyword inputs are
+            # mutually exclusive (mixing would let kwargs silently
+            # overwrite positional substitutions)
+            raise TypeError("compose accepts input Symbols either as "
+                            "positional or keyword arguments, not both")
+        subs = {}
+        if args:
+            free = self._variables()
+            if len(args) > len(free):
+                raise MXNetError("compose: %d positional inputs for %d free "
+                                 "variables" % (len(args), len(free)))
+            for node, val in zip(free, args):
+                subs[node.name] = val
+        subs.update(kwargs)
+        for key, val in subs.items():
+            if not isinstance(val, Symbol):
+                raise TypeError("compose: input %r must be a Symbol" % key)
+            if len(val._outputs) != 1:
+                raise MXNetError("compose: input %r must be single-output"
+                                 % key)
+        var_names = {n.name for n in self._variables()}
+        unknown = set(subs) - var_names
+        if unknown:
+            raise MXNetError("compose: %s are not free variables of this "
+                             "symbol" % sorted(unknown))
+
+        mapping = {}  # id(old node) -> (new node, out index)
+        for node in self._topo():
+            if node.is_variable:
+                if node.name in subs:
+                    mapping[id(node)] = subs[node.name]._outputs[0]
+                else:
+                    mapping[id(node)] = (node, 0)  # shared, unchanged
+                continue
+            new_inputs = []
+            for (inp, oidx) in node.inputs:
+                m = mapping[id(inp)]
+                if m[0] is inp:
+                    new_inputs.append((inp, oidx))
+                elif inp.is_variable:     # substituted endpoint
+                    new_inputs.append(m)
+                else:                     # cloned op node, same out slot
+                    new_inputs.append((m[0], oidx))
+            clone = Node(node.op, node.attrs, new_inputs, node.name)
+            clone._extra_attrs = dict(node._extra_attrs)
+            mapping[id(node)] = (clone, 0)
+
+        outputs = []
+        for (node, oidx) in self._outputs:
+            m = mapping[id(node)]
+            if node.is_variable:
+                outputs.append(m)
+            else:
+                outputs.append((m[0], oidx))
+        if name is not None and len(outputs) == 1 and \
+                not outputs[0][0].is_variable:
+            outputs[0][0].name = name
+        return Symbol(outputs)
 
     def get_internals(self):
         outs = []
@@ -365,6 +431,8 @@ class Symbol:
                     in_shapes[nm] = var_shape.get(id(inp))
                 else:
                     in_shapes[nm] = shapes.get((id(inp), oidx))
+            def _unknown(s):
+                return s is not None and 0 in s
             # fill unknown weight shapes via hook
             hook = PARAM_SHAPE_HOOKS.get(node.op.name)
             if hook is not None and any(v is None for v in in_shapes.values()):
@@ -373,14 +441,31 @@ class Symbol:
                 except (KeyError, TypeError):
                     filled = {}
                 for nm, (inp, _) in zip(in_names, node.inputs):
-                    if in_shapes[nm] is None and nm in filled:
+                    if in_shapes[nm] is None and nm in filled \
+                            and not _unknown(filled[nm]):
                         in_shapes[nm] = filled[nm]
                         if inp.is_variable:
                             var_shape[id(inp)] = filled[nm]
-            if any(v is None for v in in_shapes.values()):
+            # reference 0-means-unknown dims: backfill data dims from
+            # known weight shapes (FInferShape runs both directions)
+            bhook = BACKFILL_SHAPE_HOOKS.get(node.op.name)
+            if bhook is not None and any(_unknown(v)
+                                         for v in in_shapes.values()):
+                try:
+                    bfilled = bhook(params, in_shapes)
+                except (KeyError, TypeError):
+                    bfilled = {}
+                for nm, (inp, _) in zip(in_names, node.inputs):
+                    if _unknown(in_shapes[nm]) and nm in bfilled \
+                            and not _unknown(bfilled[nm]):
+                        in_shapes[nm] = bfilled[nm]
+                        if inp.is_variable:
+                            var_shape[id(inp)] = bfilled[nm]
+            if any(v is None or _unknown(v) for v in in_shapes.values()):
                 if partial:
                     continue
-                missing = [nm for nm, v in in_shapes.items() if v is None]
+                missing = [nm for nm, v in in_shapes.items()
+                           if v is None or _unknown(v)]
                 raise MXNetError("infer_shape: cannot infer %s for node %s"
                                  % (missing, node.name))
             avals = [jax.ShapeDtypeStruct(in_shapes[nm], _np.float32)

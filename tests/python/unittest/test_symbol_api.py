@@ -1,0 +1,175 @@
+"""Symbol graph API (reference: tests/python/unittest/test_symbol.py,
+test_infer_shape.py, test_attr.py): composition, naming, attributes,
+partial shape/type inference, internals, grouping, JSON round-trips."""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_compose_and_names():
+    data = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net1 = mx.sym.FullyConnected(net1, name="fc2", num_hidden=100)
+    assert net1.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("data2"), name="fc3",
+                                 num_hidden=10)
+    net2 = mx.sym.Activation(net2, act_type="relu")
+    net2 = mx.sym.FullyConnected(net2, name="fc4", num_hidden=20)
+    composed = net2(data2=net1, name="composed")
+    args = composed.list_arguments()
+    assert "fc1_weight" in args and "fc4_weight" in args
+    assert "data2" not in args  # substituted by net1
+
+
+def test_auto_naming_unique():
+    a = mx.sym.Variable("a")
+    fc1 = mx.sym.FullyConnected(a, num_hidden=4)
+    fc2 = mx.sym.FullyConnected(a, num_hidden=4)
+    n1 = fc1.list_outputs()[0]
+    n2 = fc2.list_outputs()[0]
+    assert n1 != n2
+
+
+def test_symbol_attr_get_set():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data, name="conv", kernel=(1, 1),
+                            num_filter=1, attr={"__lr_mult__": "2"})
+    assert data.attr("mood") == "angry"
+    d = op.attr_dict()
+    assert d["conv"]["__lr_mult__"] == "2"
+    assert d["data"]["mood"] == "angry"
+
+
+def test_attr_scope_propagation():
+    from mxnet_tpu.attribute import AttrScope
+    with AttrScope(ctx_group="stage1"):
+        v = mx.sym.Variable("v")
+        fc = mx.sym.FullyConnected(v, num_hidden=2, name="fc")
+    assert v.attr("ctx_group") == "stage1"
+    assert fc.attr_dict()["fc"]["ctx_group"] == "stage1"
+
+
+def test_infer_shape_full_and_partial():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=7, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(4, 3))
+    shapes = dict(zip(fc.list_arguments(), arg_shapes))
+    assert shapes["fc_weight"] == (7, 3)
+    assert shapes["fc_bias"] == (7,)
+    assert out_shapes[0] == (4, 7)
+    # partial: unknown batch propagates what it can
+    arg_shapes_p, out_shapes_p, _ = fc.infer_shape_partial(data=(0, 3))
+    shapes_p = dict(zip(fc.list_arguments(), arg_shapes_p))
+    assert shapes_p["fc_weight"] == (7, 3)
+
+
+def test_infer_shape_backward_from_weight():
+    """Shape info flows backward: knowing the weight pins the data dim."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    arg_shapes, _, _ = fc.infer_shape(data=(2, 0), fc_weight=(5, 11))
+    shapes = dict(zip(fc.list_arguments(), arg_shapes))
+    assert shapes["data"] == (2, 11)
+
+
+def test_infer_shape_conflict_raises():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    with pytest.raises(MXNetError):
+        fc.infer_shape(data=(2, 3), fc_weight=(5, 11))
+
+
+def test_infer_type():
+    data = mx.sym.Variable("data")
+    out = mx.sym.Cast(data, dtype="float16")
+    arg_types, out_types, _ = out.infer_type(data=np.float32)
+    assert arg_types[0] == np.float32
+    assert out_types[0] == np.float16
+
+
+def test_get_internals_and_slice():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="act")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs and "act_output" in outs
+    feat = internals["act_output"]
+    assert feat.list_outputs() == ["act_output"]
+    exe = feat.simple_bind(mx.cpu(), data=(2, 3))
+    assert exe.outputs[0].shape == (2, 4)
+
+
+def test_group_and_multiple_outputs():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    g = mx.sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    exe = g.bind(mx.cpu(), {"a": mx.nd.array([2.0]),
+                            "b": mx.nd.array([3.0])})
+    outs = exe.forward()
+    assert float(outs[0].asnumpy()) == 5.0
+    assert float(outs[1].asnumpy()) == 6.0
+
+
+def test_json_roundtrip_preserves_graph():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=2, name="c")
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    js = net.tojson()
+    parsed = json.loads(js)
+    assert "nodes" in parsed and any(n["op"] == "Convolution"
+                                     for n in parsed["nodes"])
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.tojson() == js
+    s1, _, _ = net.infer_shape(data=(1, 3, 8, 8))
+    s2, _, _ = net2.infer_shape(data=(1, 3, 8, 8))
+    assert [tuple(x) for x in s1] == [tuple(x) for x in s2]
+
+
+def test_arithmetic_operators_build_graph():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    expr = 2 * a + b ** 2 - a / b + (-a)
+    exe = expr.bind(mx.cpu(), {"a": mx.nd.array([4.0]),
+                               "b": mx.nd.array([2.0])})
+    out = float(exe.forward()[0].asnumpy())
+    assert out == pytest.approx(2 * 4 + 4 - 2 + (-4))
+
+
+def test_simple_bind_grad_req_null_and_write():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    out = mx.sym.sum(data * w)
+    exe = out.simple_bind(mx.cpu(), data=(3,), w=(3,),
+                          grad_req={"data": "null", "w": "write"})
+    exe.arg_dict["data"][:] = [1, 2, 3]
+    exe.arg_dict["w"][:] = [1, 1, 1]
+    exe.forward(is_train=True)
+    exe.backward()
+    assert "data" not in exe.grad_dict  # grad_req null allocates no grad
+    np.testing.assert_allclose(exe.grad_dict["w"].asnumpy(), [1, 2, 3])
+
+
+def test_symbol_save_load_file(tmp_path):
+    path = str(tmp_path / "net.json")
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="fc")
+    net.save(path)
+    net2 = mx.sym.load(path)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_compose_mixed_args_rejected():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    with pytest.raises(TypeError):
+        net(mx.sym.Variable("x"), data=mx.sym.Variable("y"))
