@@ -107,6 +107,9 @@ def fit(args, network, data_loader, **kwargs):
     sym, arg_params, aux_params = _load_model(args, kv.rank)
     if sym is not None:
         assert sym.tojson() == network.tojson()
+    # fine-tune path (reference fit.py): caller-provided params win
+    arg_params = kwargs.pop("arg_params", arg_params)
+    aux_params = kwargs.pop("aux_params", aux_params)
 
     checkpoint = _save_model(args, kv.rank)
 

@@ -1,0 +1,57 @@
+"""Fine-tune a checkpoint: replace the last fully-connected layer and
+train the rest from pretrained weights (reference:
+example/image-classification/fine-tune.py)."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+logging.basicConfig(level=logging.INFO)
+
+import mxnet_tpu as mx
+from common import fit
+
+
+def get_fine_tune_model(symbol, arg_params, num_classes,
+                        layer_name="flatten0"):
+    """Chop the graph at `layer_name` and attach a fresh classifier.
+    reference: fine-tune.py get_fine_tune_model."""
+    all_layers = symbol.get_internals()
+    net = all_layers[layer_name + "_output"]
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc_new")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    new_args = {k: v for k, v in arg_params.items()
+                if k in net.list_arguments()}
+    return net, new_args
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="fine-tune a pretrained model",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    parser.add_argument("--pretrained-model", type=str, required=True,
+                        help="checkpoint prefix to start from")
+    parser.add_argument("--pretrained-epoch", type=int, default=0)
+    parser.add_argument("--layer-before-fullc", type=str, default="flatten0",
+                        help="layer to attach the new classifier to")
+    parser.set_defaults(network="resnet", num_layers=20, num_classes=10,
+                        image_shape="3,28,28", num_examples=512,
+                        batch_size=64, num_epochs=2, lr=0.01,
+                        lr_step_epochs="20")
+    args = parser.parse_args()
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.pretrained_model, args.pretrained_epoch)
+    net, new_args = get_fine_tune_model(sym, arg_params, args.num_classes,
+                                        args.layer_before_fullc)
+
+    from train_cifar10 import get_cifar_iter
+
+    def loader(a, kv):
+        return get_cifar_iter(a, kv)
+
+    model = fit.fit(args, net, loader,
+                    arg_params=new_args, aux_params=aux_params)

@@ -1,0 +1,60 @@
+"""Score a saved checkpoint on a validation set (reference:
+example/image-classification/score.py)."""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(__file__))
+logging.basicConfig(level=logging.INFO)
+
+import mxnet_tpu as mx
+
+
+def score(model_prefix, epoch, data_iter, metrics, ctx, batch_size):
+    sym, arg_params, aux_params = mx.model.load_checkpoint(model_prefix,
+                                                           epoch)
+    mod = mx.mod.Module(symbol=sym, context=ctx, label_names=None
+                        if not data_iter.provide_label else
+                        [data_iter.provide_label[0][0]])
+    mod.bind(for_training=False, data_shapes=data_iter.provide_data,
+             label_shapes=data_iter.provide_label or None)
+    mod.set_params(arg_params, aux_params, allow_extra=True)
+    if not isinstance(metrics, list):
+        metrics = [metrics]
+    tic = time.time()
+    num = 0
+    for batch in data_iter:
+        mod.forward(batch, is_train=False)
+        for m in metrics:
+            mod.update_metric(m, batch.label)
+        num += batch_size
+    speed = num / (time.time() - tic)
+    logging.info("Finished with %f images per second", speed)
+    return [m.get() for m in metrics]
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="score a model on a dataset")
+    parser.add_argument("--model-prefix", type=str, required=True)
+    parser.add_argument("--load-epoch", type=int, required=True)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--tpus", type=str, default="")
+    parser.add_argument("--data-shape", type=str, default="3,28,28")
+    parser.add_argument("--synth-n", type=int, default=256)
+    args = parser.parse_args()
+    shape = tuple(int(x) for x in args.data_shape.split(","))
+    rng = np.random.RandomState(0)
+    X = rng.uniform(0, 1, (args.synth_n,) + shape).astype(np.float32)
+    y = rng.randint(0, 10, (args.synth_n,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, args.batch_size,
+                           label_name="softmax_label")
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] \
+        if args.tpus else [mx.cpu()]
+    res = score(args.model_prefix, args.load_epoch, it,
+                [mx.metric.create("acc")], ctx, args.batch_size)
+    logging.info("%s", res)

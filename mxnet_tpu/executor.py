@@ -277,7 +277,14 @@ class Executor:
                 _time.perf_counter() - _t0, category="executor")
         for name, val in aux_upd.items():
             self.aux_dict[name]._data = val
-        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        # swap buffers into the EXISTING output NDArrays when possible:
+        # reference executors write bind-allocated outputs in place, so
+        # references held across forwards must see the new values
+        if self._outputs is not None and len(self._outputs) == len(outs):
+            for nd_obj, val in zip(self._outputs, outs):
+                nd_obj._data = val
+        else:
+            self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         if self._monitor_cb is not None and self._monitor_active:
             self._collect_monitor(is_train, rng)
         return self.outputs

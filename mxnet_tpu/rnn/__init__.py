@@ -5,12 +5,15 @@ lives in mxnet_tpu.gluon.rnn.
 """
 from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
                        SequentialRNNCell, BidirectionalCell, DropoutCell,
-                       ResidualCell, ZoneoutCell, ModifierCell, RNNParams)
+                       ResidualCell, ZoneoutCell, ModifierCell, RNNParams,
+                       BaseConvRNNCell, ConvRNNCell, ConvLSTMCell,
+                       ConvGRUCell)
 from .io import BucketSentenceIter, encode_sentences
 from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint)
 
 __all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
            "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
            "ResidualCell", "ZoneoutCell", "ModifierCell", "RNNParams",
+           "BaseConvRNNCell", "ConvRNNCell", "ConvLSTMCell", "ConvGRUCell",
            "BucketSentenceIter", "encode_sentences", "save_rnn_checkpoint",
            "load_rnn_checkpoint", "do_rnn_checkpoint"]

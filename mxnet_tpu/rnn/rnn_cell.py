@@ -7,6 +7,8 @@ bucketing is the compile-cache-friendly formulation.
 """
 from __future__ import annotations
 
+from ..base import MXNetError
+
 sym = None  # set lazily to avoid import cycle
 
 
@@ -116,11 +118,14 @@ class BaseRNNCell(object):
 
 def _zeros_like_state(first_input):
     """Build batch-matched zero states from the first input symbol: shape-0
-    axes of state_info inherit the batch dim via broadcast_to."""
+    axes of state_info inherit the batch dim via broadcast_to. Reduces ALL
+    non-batch axes so the state's spatial dims are free to differ from the
+    input's (strided conv cells)."""
     def func(name=None, shape=None, **kwargs):
         s = _s()
-        base = s.sum(first_input, axis=1, keepdims=True) * 0  # [N, 1], zeros
-        return s.broadcast_to(base, shape=shape)
+        z = s.sum(first_input, axis=0, exclude=True, keepdims=False) * 0
+        z = s.Reshape(z, shape=(-1,) + (1,) * (len(shape) - 1))
+        return s.broadcast_to(z, shape=shape)
     return func
 
 
@@ -620,3 +625,175 @@ class ZoneoutCell(ModifierCell):
                       if p_states != 0.0 else next_states)
         self.prev_output = output
         return output, new_states
+
+
+class BaseConvRNNCell(BaseRNNCell):
+    """Symbolic convolutional recurrent base (reference: rnn_cell.py:1094).
+
+    Gates are 2D convolutions over NCHW feature maps; h2h convs use
+    'same' padding (odd kernels, dilation-aware) so states keep their
+    spatial shape across steps.
+    """
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                 i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                 activation, prefix="", params=None, conv_layout="NCHW",
+                 i2h_bias_init=None):
+        super().__init__(prefix=prefix, params=params)
+        if conv_layout != "NCHW":
+            raise MXNetError("conv cells support conv_layout='NCHW' only")
+        self._input_shape = tuple(input_shape)  # (C, H, W)
+        self._num_hidden = num_hidden
+        self._h2h_kernel = tuple(h2h_kernel)
+        if any(k % 2 == 0 for k in self._h2h_kernel):
+            raise MXNetError("h2h_kernel must be odd (shape-preserving)")
+        self._h2h_dilate = tuple(h2h_dilate)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+        self._i2h_kernel = tuple(i2h_kernel)
+        self._i2h_stride = tuple(i2h_stride)
+        self._i2h_pad = tuple(i2h_pad)
+        self._i2h_dilate = tuple(i2h_dilate)
+        self._activation = activation
+        C, H, W = self._input_shape
+        oh = (H + 2 * self._i2h_pad[0]
+              - self._i2h_dilate[0] * (self._i2h_kernel[0] - 1) - 1) \
+            // self._i2h_stride[0] + 1
+        ow = (W + 2 * self._i2h_pad[1]
+              - self._i2h_dilate[1] * (self._i2h_kernel[1] - 1) - 1) \
+            // self._i2h_stride[1] + 1
+        self._state_shape = (num_hidden, oh, ow)
+        self._iW = self.params.get("i2h_weight")
+        # init must attach on FIRST get (RNNParams.get ignores kwargs for
+        # an existing name), so subclasses pass it through the constructor
+        self._iB = self.params.get("i2h_bias", init=i2h_bias_init) \
+            if i2h_bias_init is not None else self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    @property
+    def state_info(self):
+        return [{"shape": (0,) + self._state_shape, "__layout__": "NCHW"}]
+
+    def _conv_gates(self, inputs, states, name):
+        s = _s()
+        ng = self._num_gates
+        i2h = s.Convolution(inputs, weight=self._iW, bias=self._iB,
+                            kernel=self._i2h_kernel,
+                            stride=self._i2h_stride, pad=self._i2h_pad,
+                            dilate=self._i2h_dilate,
+                            num_filter=ng * self._num_hidden,
+                            name="%si2h" % name)
+        h2h = s.Convolution(states[0], weight=self._hW, bias=self._hB,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            dilate=self._h2h_dilate,
+                            num_filter=ng * self._num_hidden,
+                            name="%sh2h" % name)
+        return i2h, h2h
+
+    def _act(self, x, name=None):
+        s = _s()
+        if self._activation == "leaky":
+            return s.LeakyReLU(x, act_type="leaky")
+        return s.Activation(x, act_type=self._activation)
+
+
+class ConvRNNCell(BaseConvRNNCell):
+    """reference: rnn_cell.py:1176 ConvRNNCell."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="ConvRNN_", params=None, conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix=prefix, params=params,
+                         conv_layout=conv_layout)
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_gates(inputs, states, name)
+        output = self._act(i2h + h2h)
+        return output, [output]
+
+
+class ConvLSTMCell(BaseConvRNNCell):
+    """reference: rnn_cell.py:1253 ConvLSTMCell (Shi et al. 2015)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="leaky",
+                 prefix="ConvLSTM_", params=None, conv_layout="NCHW",
+                 forget_bias=1.0):
+        from ..initializer import LSTMBias
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix=prefix, params=params,
+                         conv_layout=conv_layout,
+                         i2h_bias_init=LSTMBias(forget_bias=forget_bias))
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0,) + self._state_shape, "__layout__": "NCHW"},
+                {"shape": (0,) + self._state_shape, "__layout__": "NCHW"}]
+
+    def __call__(self, inputs, states):
+        s = _s()
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_gates(inputs, states, name)
+        gates = i2h + h2h
+        slices = list(s.SliceChannel(gates, num_outputs=4, axis=1,
+                                     name="%sslice" % name))
+        in_gate = s.Activation(slices[0], act_type="sigmoid")
+        forget_gate = s.Activation(slices[1], act_type="sigmoid")
+        in_trans = self._act(slices[2])
+        out_gate = s.Activation(slices[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_trans
+        next_h = out_gate * self._act(next_c)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(BaseConvRNNCell):
+    """reference: rnn_cell.py:1348 ConvGRUCell."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="leaky",
+                 prefix="ConvGRU_", params=None, conv_layout="NCHW"):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix=prefix, params=params,
+                         conv_layout=conv_layout)
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        s = _s()
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_gates(inputs, states, name)
+        i2h_s = list(s.SliceChannel(i2h, num_outputs=3, axis=1,
+                                    name="%si2h_slice" % name))
+        h2h_s = list(s.SliceChannel(h2h, num_outputs=3, axis=1,
+                                    name="%sh2h_slice" % name))
+        reset_gate = s.Activation(i2h_s[0] + h2h_s[0], act_type="sigmoid")
+        update_gate = s.Activation(i2h_s[1] + h2h_s[1], act_type="sigmoid")
+        next_h_tmp = self._act(i2h_s[2] + reset_gate * h2h_s[2])
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * states[0]
+        return next_h, [next_h]

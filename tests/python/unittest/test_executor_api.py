@@ -119,3 +119,19 @@ def test_shared_weight_between_executors():
     w[:] = 2.0  # mutate the shared buffer
     np.testing.assert_allclose(e2.forward()[0].asnumpy(),
                                np.full((4, 2), 6.0))
+
+
+def test_held_output_reference_sees_forward_results():
+    """Output NDArrays obtained before/between forwards track new values
+    (reference bind-allocated outputs are written in place)."""
+    x = mx.sym.Variable("x")
+    y = x * 2.0
+    exe = y.simple_bind(mx.cpu(), x=(2,))
+    held = exe.outputs[0]          # pre-forward (zeros)
+    assert (held.asnumpy() == 0).all()
+    exe.arg_dict["x"][:] = [1.0, 3.0]
+    exe.forward()
+    np.testing.assert_allclose(held.asnumpy(), [2.0, 6.0])
+    exe.arg_dict["x"][:] = [5.0, 5.0]
+    exe.forward()
+    np.testing.assert_allclose(held.asnumpy(), [10.0, 10.0])

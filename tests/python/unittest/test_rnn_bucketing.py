@@ -240,3 +240,92 @@ def test_rnn_checkpoint_roundtrip(tmp_path):
     assert set(args2.keys()) == set(args.keys())
     np.testing.assert_allclose(args2["embed_weight"].asnumpy(),
                                args["embed_weight"].asnumpy())
+
+
+def test_legacy_conv_rnn_cells_shapes():
+    """Symbolic Conv{RNN,LSTM,GRU}Cell (reference rnn_cell.py:1094-1430):
+    unrolled shapes preserve spatial dims with same-padding."""
+    import numpy as np
+    for cls, n_states in ((mx.rnn.ConvRNNCell, 1),
+                          (mx.rnn.ConvLSTMCell, 2),
+                          (mx.rnn.ConvGRUCell, 1)):
+        cell = cls(input_shape=(2, 8, 8), num_hidden=3)
+        inputs = [mx.sym.Variable("t%d" % i) for i in range(2)]
+        outputs, states = cell.unroll(2, inputs)
+        assert len(states) == n_states
+        out = mx.sym.Group(outputs)
+        shapes = {"t0": (4, 2, 8, 8), "t1": (4, 2, 8, 8)}
+        _, out_shapes, _ = out.infer_shape(**shapes)
+        assert all(tuple(s) == (4, 3, 8, 8) for s in out_shapes), cls
+        exe = out.simple_bind(mx.cpu(), **shapes)
+        rng = np.random.RandomState(0)
+        for name, arr in exe.arg_dict.items():
+            arr[:] = rng.normal(0, 0.1, arr.shape).astype(np.float32)
+        outs = exe.forward()
+        assert all(np.isfinite(o.asnumpy()).all() for o in outs)
+
+
+def test_legacy_conv_lstm_strided_state_shape():
+    cell = mx.rnn.ConvLSTMCell(input_shape=(1, 8, 8), num_hidden=2,
+                               i2h_kernel=(3, 3), i2h_stride=(2, 2),
+                               i2h_pad=(1, 1))
+    info = cell.state_info
+    assert info[0]["shape"] == (0, 2, 4, 4)
+    assert len(info) == 2
+
+
+def test_legacy_conv_rnn_trains_in_module():
+    """ConvRNN unroll -> pooled head trains through Module.fit."""
+    import numpy as np
+    cell = mx.rnn.ConvRNNCell(input_shape=(1, 6, 6), num_hidden=2,
+                              activation="tanh")
+    outputs, _ = cell.unroll(1, [mx.sym.Variable("data")])
+    net = mx.sym.Pooling(outputs[-1], global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=2,
+                                name="head")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (40, 1, 6, 6)).astype(np.float32)
+    y = (X.mean(axis=(1, 2, 3)) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=10, label_name="softmax_label")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=15, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.8, acc
+
+
+def test_legacy_conv_lstm_strided_unrolls():
+    """Strided conv cells must unroll with the DEFAULT begin_state (the
+    zero-state builder reduces all non-batch axes, so state spatial dims
+    may differ from the input's)."""
+    import numpy as np
+    cell = mx.rnn.ConvLSTMCell(input_shape=(1, 8, 8), num_hidden=2,
+                               i2h_kernel=(3, 3), i2h_stride=(2, 2),
+                               i2h_pad=(1, 1))
+    outputs, states = cell.unroll(2, [mx.sym.Variable("t0"),
+                                      mx.sym.Variable("t1")])
+    out = mx.sym.Group(outputs)
+    exe = out.simple_bind(mx.cpu(), t0=(3, 1, 8, 8), t1=(3, 1, 8, 8))
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        arr[:] = rng.normal(0, 0.1, arr.shape).astype(np.float32)
+    outs = exe.forward()
+    assert all(o.shape == (3, 2, 4, 4) for o in outs)
+
+
+def test_legacy_conv_lstm_forget_bias_applied():
+    """forget_bias must land in the f-gate block of i2h_bias through
+    Module.init_params (init attaches on the FIRST params.get)."""
+    cell = mx.rnn.ConvLSTMCell(input_shape=(1, 4, 4), num_hidden=2,
+                               forget_bias=1.5)
+    outputs, _ = cell.unroll(1, [mx.sym.Variable("data")])
+    mod = mx.mod.Module(outputs[0], data_names=("data",), label_names=None,
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1, 1, 4, 4))])
+    mod.init_params(mx.init.Zero())
+    args, _ = mod.get_params()
+    b = args["ConvLSTM_i2h_bias"].asnumpy()
+    assert (b[2:4] == 1.5).all(), b
+    assert (b[:2] == 0).all() and (b[4:] == 0).all()
