@@ -104,12 +104,16 @@ def fit(args, network, data_loader, **kwargs):
 
     lr, lr_scheduler = _get_lr_scheduler(args, kv)
 
-    sym, arg_params, aux_params = _load_model(args, kv.rank)
-    if sym is not None:
-        assert sym.tojson() == network.tojson()
-    # fine-tune path (reference fit.py): caller-provided params win
-    arg_params = kwargs.pop("arg_params", arg_params)
-    aux_params = kwargs.pop("aux_params", aux_params)
+    # fine-tune path (reference fit.py): caller-provided params take the
+    # place of checkpoint loading entirely — checking FIRST also keeps
+    # `--load-epoch` resume from silently discarding resumed weights
+    if "arg_params" in kwargs or "aux_params" in kwargs:
+        arg_params = kwargs.pop("arg_params", None)
+        aux_params = kwargs.pop("aux_params", None)
+    else:
+        sym, arg_params, aux_params = _load_model(args, kv.rank)
+        if sym is not None:
+            assert sym.tojson() == network.tojson()
 
     checkpoint = _save_model(args, kv.rank)
 

@@ -7,7 +7,7 @@ against `import mxnet as mx` run with only the import line changed (or via
 `sys.modules` aliasing in examples/).
 """
 
-__version__ = "0.1.0"
+__version__ = "1.2.0.tpu"  # tracks libinfo.__version__
 
 # Join the launcher's process group BEFORE anything can touch a backend
 # (several op modules build small jnp constants at import). The analog of
@@ -77,6 +77,12 @@ from . import image
 from . import rtc
 from . import contrib
 from . import storage
-from .util import test_utils
+from . import name
+from . import log
+from . import engine
+from . import registry
+from . import libinfo
+from . import test_utils
+from . import random as rnd  # reference: mx.rnd alias
 
 viz = visualization

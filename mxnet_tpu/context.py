@@ -119,7 +119,7 @@ def current_context():
     stack = getattr(Context._local, "stack", None)
     if stack:
         return stack[-1]
-    return Context("cpu", 0)
+    return Context.default_ctx or Context("cpu", 0)
 
 
-Context.default_ctx = None  # populated lazily by current_context callers
+Context.default_ctx = None  # settable via mx.test_utils.set_default_context

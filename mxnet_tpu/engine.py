@@ -1,0 +1,32 @@
+"""Engine control surface (reference: python/mxnet/engine.py bulk /
+set_bulk_size).
+
+The reference batches small async-engine ops into bulks to cut dispatch
+overhead. There is no engine here — whole graphs compile into single XLA
+programs, which IS the bulk — so these knobs keep their API contract
+(returning the previous size, scoping correctly) while the real batching
+decision lives with the compiler."""
+from __future__ import annotations
+
+import contextlib
+
+_bulk_size = 0
+
+
+def set_bulk_size(size):
+    """Set the bulk-execution cap; returns the previous value (reference
+    engine.py set_bulk_size). Advisory under XLA: fusion already bulks
+    every traced program."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """`with mx.engine.bulk(N):` scope (reference engine.py bulk)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,0 +1,18 @@
+"""Library information (reference: python/mxnet/libinfo.py)."""
+from __future__ import annotations
+
+import os
+
+__version__ = "1.2.0.tpu"
+
+
+def find_lib_path():
+    """Path(s) to the native runtime library (reference find_lib_path
+    locates libmxnet.so; here the C++ IO/storage runtime). The canonical
+    location lives in _native.py."""
+    from ._native import _LIB_PATH
+    if not os.path.exists(_LIB_PATH):
+        raise RuntimeError(
+            "Cannot find the native library at %s; build it with "
+            "`make -C src`" % _LIB_PATH)
+    return [_LIB_PATH]

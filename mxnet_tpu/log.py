@@ -1,0 +1,59 @@
+"""Logging utilities (reference: python/mxnet/log.py)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+PY3 = True
+
+
+class _Formatter(logging.Formatter):
+    """Colored level-letter formatter (reference log.py _Formatter)."""
+
+    def __init__(self, colored=True):
+        self.colored = colored
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def _get_color(self, level):
+        if level >= ERROR:
+            return "\x1b[31m"
+        if level >= WARNING:
+            return "\x1b[33m"
+        return "\x1b[32m"
+
+    def format(self, record):
+        letter = record.levelname[0]
+        if self.colored and sys.stderr.isatty():
+            self._style._fmt = (self._get_color(record.levelno) + letter
+                                + "%(asctime)s %(process)d %(pathname)s:"
+                                  "%(funcName)s:%(lineno)d\x1b[0m"
+                                  " %(message)s")
+        else:
+            self._style._fmt = (letter + "%(asctime)s %(process)d "
+                                "%(pathname)s:%(funcName)s:%(lineno)d "
+                                "%(message)s")
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """A logger with the mxnet formatter attached (reference log.py
+    get_logger)."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", None):
+        logger._init_done = True
+        if filename:
+            mode = filemode if filemode else "a"
+            hdlr = logging.FileHandler(filename, mode)
+        else:
+            hdlr = logging.StreamHandler()
+        hdlr.setFormatter(_Formatter(colored=not filename))
+        logger.addHandler(hdlr)
+        logger.setLevel(level)
+    return logger

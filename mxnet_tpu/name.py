@@ -1,0 +1,64 @@
+"""Automatic symbol naming (reference: python/mxnet/name.py NameManager +
+Prefix). `with mx.name.Prefix("mynet_"):` prefixes every auto-generated
+op name created in the scope; symbol.py consults `current()` for every
+unnamed node."""
+from __future__ import annotations
+
+import threading
+
+
+class NameManager:
+    """Sequential hint-based naming ("fc0", "fc1", ...)."""
+
+    _state = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        """Name to use: explicit `name` wins, else hint + counter."""
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._state, "stack"):
+            NameManager._state.stack = []
+        self._old_manager = current()
+        NameManager._state.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._state.stack.pop()
+        self._old_manager = None
+
+
+class Prefix(NameManager):
+    """reference name.py:74 — auto names gain a prefix inside the scope:
+
+    >>> with mx.name.Prefix("mynet_"):
+    ...     mx.sym.FullyConnected(data, num_hidden=1)  # "mynet_fullyconnected0"
+    """
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current():
+    stack = getattr(NameManager._state, "stack", None)
+    if stack:
+        return stack[-1]
+    # per-thread default counter: concurrent graph building in two
+    # threads must not race one shared dict into duplicate names
+    if not hasattr(NameManager._state, "default"):
+        NameManager._state.default = NameManager()
+    return NameManager._state.default

@@ -1,0 +1,78 @@
+"""Generic object-registry factory (reference: python/mxnet/registry.py):
+get_register_func / get_alias_func / get_create_func power the optimizer,
+initializer, and metric registries and accept name-string, JSON-dumps
+([name, kwargs]), or instance inputs."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+_REGISTRIES = {}
+
+
+def _registry(base_class, nickname):
+    key = (base_class, nickname)
+    if key not in _REGISTRIES:
+        _REGISTRIES[key] = {}
+    return _REGISTRIES[key]
+
+
+def get_register_func(base_class, nickname):
+    """A register() decorator for subclasses of base_class."""
+    registry = _registry(base_class, nickname)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError("Can only register subclass of %s"
+                             % base_class.__name__)
+        registry[(name or klass.__name__).lower()] = klass
+        return klass
+
+    register.__doc__ = "Register %s to the %s factory" % (
+        base_class.__name__, nickname)
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    registry = _registry(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                if not issubclass(klass, base_class):
+                    raise MXNetError("Can only register subclass of %s"
+                                     % base_class.__name__)
+                registry[name.lower()] = klass
+            return klass
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """A create() accepting: instance (returned as-is), "name",
+    '["name", {kwargs}]' JSON (the .dumps() format), or name + kwargs."""
+    registry = _registry(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            if len(args) > 1 or kwargs:
+                raise MXNetError("%s instance given; no further arguments "
+                                 "allowed" % nickname)
+            return args[0]
+        if not args:
+            raise MXNetError("%s name required" % nickname)
+        name, args = args[0], args[1:]
+        if name.startswith("["):
+            if args or kwargs:
+                raise MXNetError("%s JSON spec given; no further arguments "
+                                 "allowed" % nickname)
+            name, kwargs = json.loads(name)
+        key = name.lower()
+        if key not in registry:
+            raise MXNetError("%s %r is not registered. Registered: %s"
+                             % (nickname, name, sorted(registry)))
+        return registry[key](*args, **kwargs)
+
+    return create

@@ -24,14 +24,12 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "fromjson"
 
 
 class _NameManager(threading.local):
-    def __init__(self):
-        self.counts = {}
+    """Thin adapter onto the public mx.name manager stack (name.py):
+    `with mx.name.Prefix(...)` scopes affect symbol auto-naming."""
 
     def get(self, hint):
-        hint = hint.lower()
-        idx = self.counts.get(hint, 0)
-        self.counts[hint] = idx + 1
-        return "%s%d" % (hint, idx)
+        from ..name import current
+        return current().get(None, hint.lower())
 
 
 _NAMES = _NameManager()

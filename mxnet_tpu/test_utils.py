@@ -1,0 +1,53 @@
+"""Public test utilities (reference: python/mxnet/test_utils.py — the
+module user test-suites import as `mx.test_utils`). The implementation
+lives in util/test_utils; this module is the reference-named surface."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .util.test_utils import (  # noqa: F401
+    default_context, default_dtype, same, almost_equal,
+    assert_almost_equal, find_max_violation, rand_shape_2d, rand_shape_3d,
+    rand_shape_nd, rand_ndarray, simple_forward, check_numeric_gradient,
+    check_consistency, with_seed)
+
+from .context import Context, cpu
+
+
+def set_default_context(ctx):
+    """reference test_utils.py set_default_context."""
+    Context.default_ctx = ctx
+
+
+def list_gpus():
+    """Indices of usable accelerator devices (reference test_utils.py
+    list_gpus enumerates CUDA devices; here: jax non-CPU devices)."""
+    try:
+        import jax
+        return [d.id for d in jax.devices() if d.platform != "cpu"]
+    except Exception:
+        return []
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None):
+    """reference test_utils.py rand_sparse_ndarray (subset)."""
+    arr = rand_ndarray(shape, stype=stype, density=density, dtype=dtype)
+    return arr, None
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """reference test_utils.py np_reduce — axis/keepdims-normalized
+    reduction used by reduce-op tests."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret

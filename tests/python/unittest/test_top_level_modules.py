@@ -1,0 +1,104 @@
+"""Top-level utility modules (reference: name.py, log.py, engine.py,
+registry.py, test_utils.py, libinfo.py)."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_name_prefix_scopes_auto_names():
+    data = mx.sym.Variable("data")
+    with mx.name.Prefix("mynet_"):
+        fc = mx.sym.FullyConnected(data, num_hidden=2)
+    assert fc.name.startswith("mynet_fullyconnected")
+    # nested scope wins; outer resumes afterwards
+    with mx.name.Prefix("a_"):
+        s1 = mx.sym.Activation(data, act_type="relu")
+        with mx.name.Prefix("b_"):
+            s2 = mx.sym.Activation(data, act_type="relu")
+        s3 = mx.sym.Activation(data, act_type="relu")
+    assert s1.name.startswith("a_") and s2.name.startswith("b_")
+    assert s3.name.startswith("a_")
+    # outside any scope: no prefix
+    s4 = mx.sym.Activation(data, act_type="relu")
+    assert not s4.name.startswith("a_")
+
+
+def test_name_manager_explicit_name_wins():
+    m = mx.name.NameManager()
+    assert m.get("explicit", "fc") == "explicit"
+    assert m.get(None, "fc") == "fc0"
+    assert m.get(None, "fc") == "fc1"
+
+
+def test_log_get_logger(tmp_path):
+    logger = mx.log.get_logger("mxtpu_test", level=mx.log.DEBUG)
+    assert logger.level == logging.DEBUG
+    assert logger.handlers
+    # idempotent: second call must not duplicate handlers
+    again = mx.log.get_logger("mxtpu_test")
+    assert len(again.handlers) == len(logger.handlers)
+    flog = mx.log.get_logger("mxtpu_file_test",
+                             filename=str(tmp_path / "l.log"), level=mx.log.INFO)
+    flog.info("hello-log")
+    for h in flog.handlers:
+        h.flush()
+    assert "hello-log" in open(str(tmp_path / "l.log")).read()
+
+
+def test_engine_bulk_scoping():
+    assert mx.engine.set_bulk_size(15) == 0
+    with mx.engine.bulk(30):
+        assert mx.engine.set_bulk_size(30) == 30
+    assert mx.engine.set_bulk_size(0) == 15
+
+
+def test_registry_factory_roundtrip():
+    class Base:
+        pass
+
+    register = mx.registry.get_register_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+
+    @register
+    @alias("other_name")
+    class MyThing(Base):
+        def __init__(self, x=1):
+            self.x = x
+
+    t = create("mything", x=5)
+    assert isinstance(t, MyThing) and t.x == 5
+    t2 = create("other_name")
+    assert isinstance(t2, MyThing)
+    assert create(t) is t
+    t3 = create('["mything", {"x": 9}]')
+    assert t3.x == 9
+    with pytest.raises(MXNetError):
+        create("nope")
+    with pytest.raises(MXNetError):
+        register(int)  # not a subclass
+
+
+def test_test_utils_surface():
+    from mxnet_tpu import test_utils as tu
+    assert tu.same(np.ones(3), np.ones(3))
+    tu.assert_almost_equal(np.ones(3), np.ones(3) + 1e-9)
+    a = tu.rand_ndarray((3, 4))
+    assert a.shape == (3, 4)
+    red = tu.np_reduce(np.arange(12).reshape(3, 4), axis=1, keepdims=True,
+                       numpy_reduce_func=np.sum)
+    assert red.shape == (3, 1)
+    ctx = tu.default_context()
+    tu.set_default_context(mx.cpu(1))
+    assert mx.current_context() == mx.cpu(1)
+    tu.set_default_context(None)
+
+
+def test_libinfo():
+    assert mx.__version__ == mx.libinfo.__version__
+    paths = mx.libinfo.find_lib_path()
+    assert paths and paths[0].endswith(".so")
