@@ -107,9 +107,9 @@ def fit(args, network, data_loader, **kwargs):
     # fine-tune path (reference fit.py): caller-provided params take the
     # place of checkpoint loading entirely — checking FIRST also keeps
     # `--load-epoch` resume from silently discarding resumed weights
-    if "arg_params" in kwargs or "aux_params" in kwargs:
-        arg_params = kwargs.pop("arg_params", None)
-        aux_params = kwargs.pop("aux_params", None)
+    if "arg_params" in kwargs and "aux_params" in kwargs:
+        arg_params = kwargs.pop("arg_params")
+        aux_params = kwargs.pop("aux_params")
     else:
         sym, arg_params, aux_params = _load_model(args, kv.rank)
         if sym is not None:

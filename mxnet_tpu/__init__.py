@@ -7,7 +7,7 @@ against `import mxnet as mx` run with only the import line changed (or via
 `sys.modules` aliasing in examples/).
 """
 
-__version__ = "1.2.0.tpu"  # tracks libinfo.__version__
+from .libinfo import __version__  # noqa: E402
 
 # Join the launcher's process group BEFORE anything can touch a backend
 # (several op modules build small jnp constants at import). The analog of

@@ -295,34 +295,193 @@ def _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal, block_q, block_k,
     return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
 
 
+# --- offset-aware backward kernels (ring inner step) -----------------------
+# Ring chunks can be FULLY masked (lse pinned to _NEG_INF), so p must be
+# guarded against exp(-inf - -inf) = 1; and the lse output feeds
+# merge_attention, so its cotangent is real: d lse_i/d s_ij = p_ij folds
+# into the per-row scalar as delta_eff = delta - dlse.
+
+
+def _flash_bwd_dq_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
+                              lse_ref, deff_ref, dq_ref, *, sm_scale,
+                              causal, block_k, kv_len):
+    q = q_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, 0]
+    deff = deff_ref[0][:, 0]
+    block_q, d = q.shape
+    qi = pl.program_id(1)
+    q_off = offs_ref[0] + qi * block_q
+    k_base = offs_ref[1]
+    nblk = kv_len // block_k
+
+    def body(i, dq):
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_off + lax.broadcasted_iota(jnp.int32,
+                                                 (block_q, block_k), 0)
+            k_pos = k_base + i * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.where((s > _NEG_INF / 2) & (lse[:, None] > _NEG_INF / 2),
+                      jnp.exp(s - lse[:, None]), 0.0)
+        dp = jnp.dot(do.astype(v_blk.dtype), v_blk.T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - deff[:, None]) * sm_scale
+        return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk,
+                            preferred_element_type=jnp.float32)
+
+    if causal:
+        # kv blocks entirely past the causal frontier contribute nothing
+        hi = jnp.clip(lax.div(q_off + block_q - k_base + block_k - 1,
+                              block_k), 0, nblk)
+    else:
+        hi = nblk
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
+                               lse_ref, deff_ref, dk_ref, dv_ref, *,
+                               sm_scale, causal, block_q, q_len):
+    k = k_ref[0]
+    v = v_ref[0]
+    block_k, d = k.shape
+    ki = pl.program_id(1)
+    k_off = offs_ref[1] + ki * block_k
+    q_base = offs_ref[0]
+    nblk = q_len // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        deff_blk = deff_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_base + i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_off + lax.broadcasted_iota(jnp.int32,
+                                                 (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.where((s > _NEG_INF / 2)
+                      & (lse_blk[:, None] > _NEG_INF / 2),
+                      jnp.exp(s - lse_blk[:, None]), 0.0)
+        dv = dv + jnp.dot(p.astype(do_blk.dtype).T, do_blk,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk.astype(v.dtype), v.T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - deff_blk[:, None]) * sm_scale
+        dk = dk + jnp.dot(ds.astype(q_blk.dtype).T, q_blk,
+                          preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # q blocks entirely before this kv block never attend to it
+        lo = jnp.clip(lax.div(k_off - q_base, block_q), 0, nblk)
+    else:
+        lo = 0
+    dk, dv = lax.fori_loop(lo, nblk, body,
+                           (jnp.zeros((block_k, d), jnp.float32),
+                            jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_offs_pallas(q, k, v, offs, do, dlse, out, lse, sm_scale,
+                           causal, block_q, block_k, interpret=False):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    dof = do.reshape(b * h, sq, d)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    # fold the lse cotangent into the per-row scalar (see note above)
+    deff = (delta - dlse.astype(jnp.float32)).reshape(b * h, sq, 1)
+    lsef = lse.reshape(b * h, sq, 1)
+    offs = offs.astype(jnp.int32)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_offs_kernel, sm_scale=sm_scale,
+                          causal=causal, block_k=block_k, kv_len=sk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, sq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j, o: (i, j, 0)),
+                pl.BlockSpec((1, sk, d), lambda i, j, o: (i, 0, 0)),
+                pl.BlockSpec((1, sk, d), lambda i, j, o: (i, 0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda i, j, o: (i, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda i, j, o: (i, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda i, j, o: (i, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda i, j, o: (i, j, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(offs, qf, kf, vf, dof, lsef, deff)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_offs_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, q_len=sq),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, sk // block_k),
+            in_specs=[
+                pl.BlockSpec((1, sq, d), lambda i, j, o: (i, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, j, o: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, j, o: (i, j, 0)),
+                pl.BlockSpec((1, sq, d), lambda i, j, o: (i, 0, 0)),
+                pl.BlockSpec((1, sq, 1), lambda i, j, o: (i, 0, 0)),
+                pl.BlockSpec((1, sq, 1), lambda i, j, o: (i, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda i, j, o: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, j, o: (i, j, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(offs, qf, kf, vf, dof, lsef, deff)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention_with_lse(q, k, v, offs, sm_scale, causal, block_q,
                              block_k, interpret):
     """Pallas fused (out, lse) attention with dynamic global offsets —
-    the ring-attention inner step. Backward recomputes blockwise
-    (FlashAttention-2 strategy) under jax.vjp."""
+    the ring-attention inner step. Backward runs the offset-aware
+    FlashAttention-2 Pallas kernels (lse cotangent included)."""
     return _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal,
                                   block_q, block_k, interpret)
 
 
 def _flash_lse_fwd_rule(q, k, v, offs, sm_scale, causal, block_q, block_k,
                         interpret):
-    out = _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal,
-                                 block_q, block_k, interpret)
-    return out, (q, k, v, offs)
+    out, lse = _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal,
+                                      block_q, block_k, interpret)
+    return (out, lse), (q, k, v, offs, out, lse)
 
 
 def _flash_lse_bwd_rule(sm_scale, causal, block_q, block_k, interpret,
                         res, cts):
-    q, k, v, offs = res
-
-    def f(q, k, v):
-        return blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                                   block_k=block_k, q_offset=offs[0],
-                                   k_offset=offs[1])
-
-    _, vjp = jax.vjp(f, q, k, v)
-    dq, dk, dv = vjp(cts)
+    q, k, v, offs, out, lse = res
+    do, dlse = cts
+    dq, dk, dv = _flash_bwd_offs_pallas(q, k, v, offs, do, dlse, out, lse,
+                                        sm_scale, causal, block_q, block_k,
+                                        interpret)
     return dq, dk, dv, jnp.zeros_like(offs)
 
 
@@ -369,144 +528,15 @@ def _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, sm_scale, causal, block_k, kv_len):
-    q = q_ref[0]                           # [block_q, d]
-    do = do_ref[0].astype(jnp.float32)     # [block_q, d]
-    lse = lse_ref[0][:, 0]                 # [block_q]
-    delta = delta_ref[0][:, 0]             # [block_q] = rowsum(do * o)
-    block_q, d = q.shape
-    qi = pl.program_id(1)
-    q_off = qi * block_q
-    nblk = kv_len // block_k
-
-    def body(i, dq):
-        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = q_off + lax.broadcasted_iota(jnp.int32,
-                                                 (block_q, block_k), 0)
-            k_pos = i * block_k + lax.broadcasted_iota(jnp.int32,
-                                                       (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])      # [block_q, block_k]
-        dp = jnp.dot(do.astype(v_blk.dtype), v_blk.T,
-                     preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk,
-                            preferred_element_type=jnp.float32)
-
-    if causal:
-        hi = jnp.minimum(lax.div(q_off + block_q + block_k - 1, block_k),
-                         nblk)
-    else:
-        hi = nblk
-    dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-
-
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, sm_scale, causal, block_q,
-                          q_len):
-    k = k_ref[0]                           # [block_k, d]
-    v = v_ref[0]
-    block_k, d = k.shape
-    ki = pl.program_id(1)
-    k_off = ki * block_k
-    nblk = q_len // block_q
-
-    def body(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), 0]
-        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), 0]
-        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = i * block_q + lax.broadcasted_iota(jnp.int32,
-                                                       (block_q, block_k), 0)
-            k_pos = k_off + lax.broadcasted_iota(jnp.int32,
-                                                 (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_blk[:, None])
-        dv = dv + jnp.dot(p.astype(do_blk.dtype).T, do_blk,
-                          preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk.astype(v.dtype), v.T,
-                     preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk[:, None]) * sm_scale
-        dk = dk + jnp.dot(ds.astype(q_blk.dtype).T, q_blk,
-                          preferred_element_type=jnp.float32)
-        return dk, dv
-
-    if causal:
-        # q blocks before the kv block's start never attend to it
-        lo = lax.div(k_off, block_q)
-    else:
-        lo = 0
-    dk, dv = lax.fori_loop(lo, nblk, body,
-                           (jnp.zeros((block_k, d), jnp.float32),
-                            jnp.zeros((block_k, d), jnp.float32)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
-
-
 def _flash_bwd_pallas(q, k, v, do, out, lse, sm_scale, causal, block_q,
                       block_k, interpret=False):
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, sk, d)
-    vf = v.reshape(b * h, sk, d)
-    dof = do.reshape(b * h, sq, d)
-    # delta = rowsum(do * o): cheap XLA reduction outside the kernels
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(b * h, sq, 1)
-    lsef = lse.reshape(b * h, sq, 1)
-
-    dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
-                          causal=causal, block_k=block_k, kv_len=sk),
-        grid=(b * h, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, q_len=sq),
-        grid=(b * h, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
-        ],
-        interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
-    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+    """Backward for the non-offset path: the offset-aware kernels with
+    offs = [0, 0] and no lse cotangent (one kernel pair to maintain)."""
+    offs = jnp.zeros((2,), jnp.int32)
+    dlse = jnp.zeros(lse.shape, jnp.float32)
+    return _flash_bwd_offs_pallas(q, k, v, offs, do, dlse, out, lse,
+                                  sm_scale, causal, block_q, block_k,
+                                  interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

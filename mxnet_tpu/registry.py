@@ -64,11 +64,19 @@ def get_create_func(base_class, nickname):
         if not args:
             raise MXNetError("%s name required" % nickname)
         name, args = args[0], args[1:]
+        if not isinstance(name, str):
+            raise MXNetError("%s must be created with a %s instance or a "
+                             "name string, got %r"
+                             % (nickname, nickname, type(name).__name__))
         if name.startswith("["):
             if args or kwargs:
                 raise MXNetError("%s JSON spec given; no further arguments "
                                  "allowed" % nickname)
-            name, kwargs = json.loads(name)
+            try:
+                name, kwargs = json.loads(name)
+            except (ValueError, TypeError) as e:
+                raise MXNetError("invalid %s JSON spec %r: %s"
+                                 % (nickname, name, e))
         key = name.lower()
         if key not in registry:
             raise MXNetError("%s %r is not registered. Registered: %s"

@@ -337,7 +337,6 @@ def test_pallas_backward_kernels_interpret(causal):
     P recomputed from saved lse) matches analytic attention gradients."""
     import jax
     import jax.numpy as jnp
-    from mxnet_tpu.kernels import flash_attention as _fa_fn  # noqa: F401
     import importlib
     fa = importlib.import_module("mxnet_tpu.kernels.flash_attention")
     rng = np.random.RandomState(0)
@@ -361,3 +360,38 @@ def test_pallas_backward_kernels_interpret(causal):
                                atol=5e-5, rtol=1e-3)
     np.testing.assert_allclose(np.asarray(dv), np.asarray(dvr),
                                atol=5e-5, rtol=1e-3)
+
+
+def test_pallas_offs_backward_with_lse_cotangent():
+    """Offset-aware Pallas backward: gradients (incl. the lse cotangent
+    that ring merging produces) match analytic attention; fully-masked
+    chunks (kv ahead of the causal frontier) give exact zeros."""
+    import importlib
+    import jax
+    import jax.numpy as jnp
+    fa = importlib.import_module("mxnet_tpu.kernels.flash_attention")
+    rng = np.random.RandomState(0)
+    b, h, s, d = 1, 2, 128, 16
+    q = jnp.asarray(rng.normal(0, 1, (b, h, s, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (b, h, s, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (b, h, s, d)).astype(np.float32))
+    do = jnp.asarray(rng.normal(0, 1, (b, h, s, d)).astype(np.float32))
+    dl = jnp.asarray(rng.normal(0, 1, (b, h, s)).astype(np.float32))
+    sc = 1.0 / np.sqrt(d)
+    for (qo, ko) in [(0, 0), (128, 0), (64, 64), (0, 256)]:
+        offs = jnp.asarray([qo, ko], jnp.int32)
+        f = lambda q, k, v: fa.flash_attention_with_lse(
+            q, k, v, offs, sc, True, 64, 64, True)
+        (out, lse), vjp = jax.vjp(f, q, k, v)
+        dq, dk, dv = vjp((do, dl))
+        fr = lambda q, k, v: fa.attention_with_lse(
+            q, k, v, causal=True, sm_scale=sc, q_offset=qo, k_offset=ko)
+        (outr, lser), vjpr = jax.vjp(fr, q, k, v)
+        dqr, dkr, dvr = vjpr((do, dl))
+        for a, bb in ((out, outr), (lse, lser), (dq, dqr), (dk, dkr),
+                      (dv, dvr)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       atol=5e-5, rtol=1e-3)
+        if ko == 256:  # fully masked chunk: exact zeros
+            assert float(jnp.abs(dq).max()) == 0.0
+            assert float(jnp.abs(dk).max()) == 0.0

@@ -50,10 +50,13 @@ def test_log_get_logger(tmp_path):
 
 
 def test_engine_bulk_scoping():
-    assert mx.engine.set_bulk_size(15) == 0
-    with mx.engine.bulk(30):
-        assert mx.engine.set_bulk_size(30) == 30
-    assert mx.engine.set_bulk_size(0) == 15
+    initial = mx.engine.set_bulk_size(15)
+    try:
+        with mx.engine.bulk(30):
+            assert mx.engine.set_bulk_size(30) == 30
+        assert mx.engine.set_bulk_size(15) == 15
+    finally:
+        mx.engine.set_bulk_size(initial)
 
 
 def test_registry_factory_roundtrip():
