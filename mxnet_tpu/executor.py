@@ -354,8 +354,17 @@ class Executor:
             if getattr(self, "_pending_grads", None) is None:
                 raise MXNetError("backward() called before forward(is_train=True)")
             grads = self._pending_grads
+        gather = None
+        if self._group_shardings is not None:
+            # grads of group-sharded params come back on the mp mesh;
+            # gather them to the bind context so the eager optimizer
+            # update (single-device arrays) composes
+            dev = self._ctx.jax_device
+            gather = lambda a: jax.device_put(a, dev)
         for name in self._grad_names:
             g = grads[name]
+            if gather is not None:
+                g = gather(g)
             dst = self.grad_dict[name]
             if self._grad_req.get(name) == "add":
                 dst._data = dst._data + g

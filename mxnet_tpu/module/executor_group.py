@@ -36,9 +36,10 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad, shared_group=None,
                  logger=None, fixed_param_names=None, grad_req="write",
-                 state_names=None):
+                 state_names=None, group2ctxs=None):
         self.symbol = symbol
         self.contexts = contexts
+        self.group2ctxs = group2ctxs
         self.workload = workload or [1] * len(contexts)
         self.param_names = param_names
         self.for_training = for_training
@@ -92,6 +93,7 @@ class DataParallelExecutorGroup:
             for name, shape in input_shapes.items():
                 dev_shapes[name] = (sl.stop - sl.start,) + tuple(shape[1:])
             exec_ = self.symbol.simple_bind(ctx, grad_req=self.grad_req,
+                                            group2ctx=self.group2ctxs,
                                             **dev_shapes)
             self.execs.append(exec_)
 

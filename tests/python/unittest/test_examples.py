@@ -104,3 +104,15 @@ def test_cifar10_score_finetune_chain(tmp_path):
     # the chopped net re-learns from weak 2-epoch features: just assert
     # it trains clearly above chance
     assert accs and accs[-1] > 0.3, out[-2000:]
+
+
+def test_model_parallel_lstm_example():
+    """Model-parallel stacked LSTM (reference example/model-parallel/lstm):
+    layers placed in ctx groups over 2 virtual devices; perplexity drops."""
+    out = _run([os.path.join(EX, "model-parallel", "lstm", "lstm_ptb.py"),
+                "--num-epochs", "3", "--num-layers", "2",
+                "--num-hidden", "32", "--seq-len", "8"], timeout=1200)
+    ppls = [float(m) for m in
+            re.findall(r"Train-perplexity=([0-9.]+)", out)]
+    assert len(ppls) == 3, out[-2000:]
+    assert ppls[-1] < ppls[0] * 0.5, ppls

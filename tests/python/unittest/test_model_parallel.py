@@ -119,3 +119,21 @@ def test_group2ctx_model_parallel_lstm_pattern():
     assert out.shape == (20, 4)
     assert all(np.isfinite(g.asnumpy()).all()
                for g in ex.grad_dict.values() if g is not None)
+
+
+def test_module_forwards_group2ctxs():
+    """Module(group2ctxs=...) must reach the executors (regression: it was
+    stored and silently dropped, so examples ran without any sharding)."""
+    net = _grouped_net()
+    group2ctx = {"dev1": mx.tpu(0), "dev2": mx.tpu(1)}
+    mod = mx.mod.Module(net, context=mx.tpu(0), group2ctxs=group2ctx)
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    exe = mod._exec_group.execs[0]
+    assert exe._group_shardings is not None
+    batch = mx.io.DataBatch(data=[mx.nd.ones((8, 16))],
+                            label=[mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    assert np.isfinite(mod.get_outputs()[0].asnumpy()).all()

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Parse training-log output into a markdown table (reference:
+tools/parse_log.py — same Epoch[N] Train-/Validation-/Time patterns the
+fit path emits)."""
+import argparse
+import re
+
+
+def parse(lines, metric_names):
+    pats = ([("train-" + s,
+              re.compile(r".*Epoch\[(\d+)\] Train-" + re.escape(s)
+                         + r".*=([.\d]+)"))
+             for s in metric_names]
+            + [("val-" + s,
+                re.compile(r".*Epoch\[(\d+)\] Validation-" + re.escape(s)
+                           + r".*=([.\d]+)"))
+               for s in metric_names]
+            + [("time", re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)"))])
+    data = {}
+    for line in lines:
+        for name, pat in pats:
+            m = pat.match(line)
+            if m is None:
+                continue
+            epoch = int(m.group(1))
+            val = float(m.group(2))
+            entry = data.setdefault(epoch, {})
+            acc = entry.setdefault(name, [0.0, 0])
+            acc[0] += val
+            acc[1] += 1
+    return data
+
+
+def to_markdown(data, metric_names):
+    cols = (["train-" + s for s in metric_names]
+            + ["val-" + s for s in metric_names] + ["time"])
+    out = ["| epoch | " + " | ".join(cols) + " |",
+           "| --- " * (len(cols) + 1) + "|"]
+    for epoch in sorted(data):
+        row = ["%d" % epoch]
+        for c in cols:
+            if c in data[epoch]:
+                tot, n = data[epoch][c]
+                row.append("%f" % (tot / n))
+            else:
+                row.append("")
+        out.append("| " + " | ".join(row) + " |")
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description="Parse training output log")
+    ap.add_argument("logfile", nargs=1, type=str)
+    ap.add_argument("--format", type=str, default="markdown",
+                    choices=["markdown", "none"])
+    ap.add_argument("--metric-names", type=str, nargs="+",
+                    default=["accuracy"])
+    args = ap.parse_args()
+    with open(args.logfile[0]) as f:
+        data = parse(f.readlines(), args.metric_names)
+    if args.format == "markdown":
+        print(to_markdown(data, args.metric_names))
