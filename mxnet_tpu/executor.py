@@ -346,19 +346,29 @@ class Executor:
                 out_grads = [out_grads]
             arg_vals = {n: a._data for n, a in self.arg_dict.items()}
             aux_vals = {n: a._data for n, a in self.aux_dict.items()}
-            grad_args = {n: arg_vals.pop(n) for n in self._grad_names}
             rng = _rnd.next_key()
-            _, _, grads = self._fb_fn(True)(grad_args, arg_vals, aux_vals, rng,
-                                            tuple(g._data for g in out_grads))
+            og = tuple(g._data for g in out_grads)
+            if self._group_shardings is not None:
+                arg_vals, aux_vals = self._apply_group_shardings(arg_vals,
+                                                                 aux_vals)
+                repl = self._group_shardings["__default__"]
+                rng = jax.device_put(rng, repl)
+                og = tuple(jax.device_put(g, repl) for g in og)
+            grad_args = {n: arg_vals.pop(n) for n in self._grad_names}
+            _, _, grads = self._fb_fn(True)(grad_args, arg_vals, aux_vals,
+                                            rng, og)
         else:
             if getattr(self, "_pending_grads", None) is None:
                 raise MXNetError("backward() called before forward(is_train=True)")
             grads = self._pending_grads
         gather = None
         if self._group_shardings is not None:
-            # grads of group-sharded params come back on the mp mesh;
-            # gather them to the bind context so the eager optimizer
-            # update (single-device arrays) composes
+            # EVERY grad from a mesh-sharded program is committed to the
+            # mp mesh (replicated ones included), so all must move to the
+            # bind context before the eager optimizer update mixes them
+            # with single-device weights. For replicated grads this is a
+            # local copy (the full array already lives on each device);
+            # only genuinely sharded grads pay a cross-device gather.
             dev = self._ctx.jax_device
             gather = lambda a: jax.device_put(a, dev)
         for name in self._grad_names:

@@ -92,8 +92,13 @@ class DataParallelExecutorGroup:
             dev_shapes = {}
             for name, shape in input_shapes.items():
                 dev_shapes[name] = (sl.stop - sl.start,) + tuple(shape[1:])
+            # upstream allows a list of dicts: one ctx-group mapping per
+            # data-parallel context (each replica gets its own devices)
+            g2c = self.group2ctxs
+            if isinstance(g2c, (list, tuple)):
+                g2c = g2c[i]
             exec_ = self.symbol.simple_bind(ctx, grad_req=self.grad_req,
-                                            group2ctx=self.group2ctxs,
+                                            group2ctx=g2c,
                                             **dev_shapes)
             self.execs.append(exec_)
 
@@ -185,6 +190,16 @@ class DataParallelExecutorGroup:
         outputs = [[e.outputs[i] for e in self.execs]
                    for i in range(len(self.execs[0].outputs))]
         if merge_multi_context:
+            if self.group2ctxs is not None and len(self.execs) > 1:
+                # per-replica ctx groups commit each executor's outputs
+                # to ITS mesh; stage everything on the first replica's
+                # bind device so the cross-replica concat has one device
+                import jax as _jax
+                ctx0 = self.execs[0]._ctx
+                dev0 = ctx0.jax_device
+                outputs = [[type(o)(_jax.device_put(o._data, dev0),
+                                    ctx=ctx0) for o in outs]
+                           for outs in outputs]
             return [outs[0] if len(outs) == 1 else concatenate(outs, axis=0)
                     for outs in outputs]
         return outputs

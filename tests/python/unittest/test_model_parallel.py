@@ -137,3 +137,42 @@ def test_module_forwards_group2ctxs():
     mod.forward(batch, is_train=True)
     mod.backward()
     assert np.isfinite(mod.get_outputs()[0].asnumpy()).all()
+
+
+def test_group2ctxs_list_of_dicts():
+    """Upstream form: one ctx-group dict per data-parallel context."""
+    net = _grouped_net()
+    g2c = [{"dev1": mx.tpu(0), "dev2": mx.tpu(1)},
+           {"dev1": mx.tpu(2), "dev2": mx.tpu(3)}]
+    mod = mx.mod.Module(net, context=[mx.tpu(0), mx.tpu(2)],
+                        group2ctxs=g2c)
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for exe in mod._exec_group.execs:
+        assert exe._group_shardings is not None
+    batch = mx.io.DataBatch(data=[mx.nd.ones((8, 16))],
+                            label=[mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()  # eager optimizer over gathered grads must compose
+    assert np.isfinite(mod.get_outputs()[0].asnumpy()).all()
+
+
+def test_group2ctx_backward_with_out_grads_sharded():
+    """backward(out_grads=...) recompute path must also apply the group
+    shardings (regression: it built arg_vals straight from arg_dict)."""
+    net = _grouped_net()
+    group2ctx = {"dev1": mx.tpu(0), "dev2": mx.tpu(1)}
+    # bind WITHOUT the loss head so out_grads drive backward
+    feat = net.get_internals()["fc3_output"]
+    exe = feat.simple_bind(mx.tpu(0), group2ctx=group2ctx, data=(4, 16))
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        arr[:] = rng.normal(0, 0.1, arr.shape).astype(np.float32)
+    exe.forward(is_train=True)
+    exe.backward(out_grads=mx.nd.ones((4, 4)))
+    g = exe.grad_dict["fc1_weight"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0

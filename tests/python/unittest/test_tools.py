@@ -94,3 +94,22 @@ def test_bandwidth_harness_runs(tmp_path):
     import re as _re
     m = _re.search(r"([0-9.]+)\s*GB/s", out.stdout)
     assert m and float(m.group(1)) > 0, out.stdout
+
+
+def test_parse_log_markdown(tmp_path):
+    """tools/parse_log.py renders the fit path's log lines as a markdown
+    table (reference tools/parse_log.py)."""
+    log = ("INFO:root:Epoch[0] Train-accuracy=0.5\n"
+           "INFO:root:Epoch[0] Time cost=1.5\n"
+           "INFO:root:Epoch[0] Validation-accuracy=0.4\n"
+           "INFO:root:Epoch[1] Train-accuracy=0.8\n"
+           "INFO:root:Epoch[1] Time cost=1.4\n"
+           "INFO:root:Epoch[1] Validation-accuracy=0.7\n")
+    p = str(tmp_path / "t.log")
+    with open(p, "w") as f:
+        f.write(log)
+    out = subprocess.check_output(
+        [sys.executable, os.path.join(_REPO, "tools", "parse_log.py"), p],
+        text=True)
+    assert "| 0 | 0.500000 | 0.400000 | 1.500000 |" in out
+    assert "| 1 | 0.800000 | 0.700000 | 1.400000 |" in out
