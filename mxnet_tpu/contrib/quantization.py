@@ -15,7 +15,7 @@ import numpy as _np
 
 from ..base import MXNetError
 
-__all__ = ["quantize_params", "calib_thresholds_minmax",
+__all__ = ["quantize_graph", "quantize_params", "calib_thresholds_minmax",
            "calib_threshold_kl", "quantize_model", "CalibrationCollector"]
 
 
@@ -25,23 +25,172 @@ def _quantize_array(arr, threshold):
     return q, 1.0 / scale
 
 
-def quantize_params(arg_params, quantized_names=None):
-    """Symmetric per-tensor int8 quantization of weights.
+# -------------------------------------------------------------------------
+# graph rewrite (reference: src/operator/quantization/quantize_graph_pass.cc
+# — insert _contrib_quantize/_contrib_requantize/_contrib_dequantize around
+# quantizable nodes and swap them for their _contrib_quantized_* forms)
+# -------------------------------------------------------------------------
 
-    Returns (qparams: name -> (int8 array, scale), passthrough params)."""
-    qparams = {}
-    rest = {}
-    for name, arr in arg_params.items():
-        v = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
-        if quantized_names is not None and name not in quantized_names:
-            rest[name] = arr
+#: fp32 op name -> quantized op name. Pooling/Flatten are range-passthrough;
+#: Convolution/FullyConnected requantize their int32 accumulators.
+_QUANTIZED_OP = {
+    "Convolution": "_contrib_quantized_conv",
+    "FullyConnected": "_contrib_quantized_fully_connected",
+    "Pooling": "_contrib_quantized_pooling",
+    "Flatten": "_contrib_quantized_flatten",
+}
+_NEEDS_REQUANTIZE = {"Convolution", "FullyConnected"}
+
+
+def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
+                   offline_params=None):
+    """Rewrite a fp32 Symbol into an int8 inference graph.
+
+    Every non-excluded Convolution/FullyConnected becomes its
+    `_contrib_quantized_*` form fed by int8 tensors; int32 accumulators pass
+    through `_contrib_requantize` (with calibrated ranges from `th_dict`,
+    keyed by fp32 node name) back to int8, and `_contrib_dequantize` bridges
+    to any fp32 consumer. Pooling/Flatten between quantized layers stay in
+    int8 (range passthrough). A quantize of a variable named in
+    `offline_params` (pass the param-dict keys; runtime inputs like `data`
+    must NOT be in it) collapses into three new arguments —
+    `<name>_quantize` (int8), `<name>_min`, `<name>_max` — which
+    `quantize_params` fills from the fp32 params, so no weight quantization
+    runs at inference time.
+
+    TPU formulation of reference quantize_graph_pass.cc:1: same insertion
+    algorithm, but the result is still a plain Symbol — XLA fuses the
+    dequant/requant arithmetic into the int8 matmul/conv MXU ops.
+    """
+    from ..symbol.symbol import Node, Symbol
+    from ..ops.registry import find_op
+    th_dict = th_dict or {}
+    offline = set(offline_params or ())
+    excluded = set(excluded_sym_names)
+    op_q = {name: find_op(qname) for name, qname in _QUANTIZED_OP.items()}
+    op_quantize = find_op("_contrib_quantize")
+    op_requantize = find_op("_contrib_requantize")
+    op_dequantize = find_op("_contrib_dequantize")
+    op_min, op_max = find_op("min"), find_op("max")
+
+    fp32 = {}    # id(old node) -> fp32-producing new node
+    qform = {}   # id(old node) -> [(qnode, oidx), (min src), (max src)]
+    quantize_cache = {}  # (id(old node), oidx) -> inserted quantize triple
+
+    def fp32_in(old_pair):
+        node, oidx = old_pair
+        return (fp32[id(node)], oidx)
+
+    def as_int8(old_pair):
+        """Quantized (data, min, max) sources for an old node's output —
+        reusing the producer's int8 form when it has one, else inserting
+        (or folding offline) a _contrib_quantize."""
+        node, oidx = old_pair
+        if id(node) in qform and oidx == 0:
+            return qform[id(node)]
+        if (id(node), oidx) in quantize_cache:
+            return quantize_cache[(id(node), oidx)]
+        if node.is_variable and node.name in offline:
+            qvar = Node(None, {}, [], node.name + "_quantize")
+            qvar._extra_attrs = {"__dtype__": "int8"}
+            vmin = Node(None, {}, [], node.name + "_min")
+            vmax = Node(None, {}, [], node.name + "_max")
+            triple = [(qvar, 0), (vmin, 0), (vmax, 0)]
+        else:
+            src = fp32_in(old_pair)
+            mn = Node(op_min, {}, [src], node.name + "_amin")
+            mx = Node(op_max, {}, [src], node.name + "_amax")
+            q = Node(op_quantize, {"out_type": "int8"},
+                     [src, (mn, 0), (mx, 0)], node.name + "_quantize")
+            triple = [(q, 0), (q, 1), (q, 2)]
+        quantize_cache[(id(node), oidx)] = triple
+        return triple
+
+    def attach_dequantize(old, triple):
+        """fp32 view of a quantized output, for any non-quantized consumer."""
+        deq = Node(op_dequantize, {}, list(triple), old.name + "_dequantize")
+        fp32[id(old)] = deq
+
+    for old in sym._topo():
+        if old.is_variable:
+            var = Node(None, {}, [], old.name)
+            var._extra_attrs = dict(old._extra_attrs)
+            fp32[id(old)] = var
             continue
-        if not name.endswith("_weight"):
-            rest[name] = arr
+        opname = old.op.name
+        quantizable = (opname in _QUANTIZED_OP and old.name not in excluded
+                       and not (opname == "Convolution"
+                                and len(old.make_params().kernel) != 2))
+        if quantizable and opname in ("Pooling", "Flatten"):
+            # only worth keeping in int8 when the producer already is —
+            # quantizing solely for a pooling layer adds round-trips
+            quantizable = id(old.inputs[0][0]) in qform
+        if quantizable and opname == "Pooling":
+            quantizable = old.make_params().pool_type in ("max", "avg")
+        if not quantizable:
+            new = Node(old.op, dict(old.attrs),
+                       [fp32_in(p) for p in old.inputs], old.name)
+            new._extra_attrs = dict(old._extra_attrs)
+            fp32[id(old)] = new
             continue
-        q, scale = _quantize_array(v, _np.abs(v).max())
-        qparams[name] = (q, scale)
-    return qparams, rest
+
+        if opname in ("Pooling", "Flatten"):
+            d, mn, mx = as_int8(old.inputs[0])
+            qnode = Node(op_q[opname], dict(old.attrs), [d, mn, mx],
+                         "quantized_" + old.name)
+            triple = [(qnode, 0), (qnode, 1), (qnode, 2)]
+        else:  # Convolution / FullyConnected
+            data_t = as_int8(old.inputs[0])
+            weight_t = as_int8(old.inputs[1])
+            with_bias = len(old.inputs) > 2
+            inputs = [data_t[0], weight_t[0]]
+            if with_bias:
+                bias_t = as_int8(old.inputs[2])
+                inputs.append(bias_t[0])
+            inputs += [data_t[1], data_t[2], weight_t[1], weight_t[2]]
+            if with_bias:
+                inputs += [bias_t[1], bias_t[2]]
+            qnode = Node(op_q[opname], dict(old.attrs), inputs,
+                         "quantized_" + old.name)
+            rq_attrs = {}
+            th = th_dict.get(old.name, th_dict.get(old.name + "_output"))
+            if th is not None:
+                rq_attrs = {"min_calib_range": str(-float(th)),
+                            "max_calib_range": str(float(th))}
+            rq = Node(op_requantize, rq_attrs,
+                      [(qnode, 0), (qnode, 1), (qnode, 2)],
+                      old.name + "_requantize")
+            triple = [(rq, 0), (rq, 1), (rq, 2)]
+        qform[id(old)] = triple
+        attach_dequantize(old, triple)
+
+    return Symbol([fp32_in(p) for p in sym._outputs])
+
+
+def quantize_params(qsym, arg_params):
+    """Fill the offline-quantized arguments of a `quantize_graph` output.
+
+    For every `<name>_quantize` argument the fp32 param `<name>` is
+    symmetric-int8 quantized, with its range in `<name>_min`/`<name>_max`
+    (reference: quantization.py _quantize_params). Other arguments pass
+    through. Returns the new arg dict."""
+    from ..ndarray.ndarray import array as nd_array
+    out = {}
+    for name in qsym.list_arguments():
+        if name.endswith("_quantize"):
+            base = name[:-len("_quantize")]
+            v = arg_params[base]
+            v = v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
+            absmax = float(_np.abs(v).max())
+            q, _scale = _quantize_array(v, absmax)
+            out[name] = nd_array(q)
+            out[base + "_min"] = nd_array(_np.array([-absmax], _np.float32))
+            out[base + "_max"] = nd_array(_np.array([absmax], _np.float32))
+        elif name.endswith("_min") or name.endswith("_max"):
+            continue  # filled alongside their _quantize partner
+        elif name in arg_params:
+            out[name] = arg_params[name]
+    return out
 
 
 def calib_thresholds_minmax(collected):
@@ -136,24 +285,10 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    num_calib_examples=None, ctx=None, logger=logging):
     """Post-training quantization (reference: quantization.py quantize_model).
 
-    Weights of Convolution/FullyConnected layers are replaced by symmetric
-    int8 fake-quantized values (dequantized fp32 in the returned params — the
-    numerics of int8 inference with fp accumulation). Activation calibration
-    thresholds, when requested, are returned in aux attributes.
-    """
-    quant_names = []
-    for name in arg_params:
-        if name.endswith("_weight"):
-            layer = name[:-len("_weight")]
-            if layer in excluded_sym_names:
-                continue
-            quant_names.append(name)
-    qparams, rest = quantize_params(arg_params, quantized_names=quant_names)
-    new_args = dict(rest)
-    from ..ndarray.ndarray import array as nd_array
-    for name, (q, scale) in qparams.items():
-        new_args[name] = nd_array(q.astype(_np.float32) * scale)
-
+    Runs calibration (when requested), rewrites the graph via
+    `quantize_graph` so conv/FC execute as int8 `_contrib_quantized_*` ops,
+    and offline-quantizes their weights/biases via `quantize_params`.
+    Returns (qsym, qarg_params, aux_params, th_dict)."""
     th = {}
     if calib_mode != "none":
         if calib_data is None:
@@ -179,5 +314,7 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
         th = collector.thresholds()
         logger.info("calibrated %d layer outputs", len(th))
 
-    qsym = sym  # fake-quant keeps the graph; thresholds attach as attrs
+    qsym = quantize_graph(sym, excluded_sym_names=excluded_sym_names,
+                          th_dict=th, offline_params=set(arg_params))
+    new_args = quantize_params(qsym, arg_params)
     return qsym, new_args, aux_params, th

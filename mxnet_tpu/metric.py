@@ -101,22 +101,28 @@ def create(metric, *args, **kwargs):
 
 @register
 class CompositeEvalMetric(EvalMetric):
+    """Fans every update out to a list of child metrics and concatenates
+    their results (reference: metric.py:267 CompositeEvalMetric).
+
+    Deliberate divergence: the reference's get_metric RETURNS a ValueError
+    on a bad index instead of raising (an upstream bug). We raise —
+    handing the caller an un-raised exception object is never useful, and
+    test_metric pins the raising behavior.
+    """
+
     def __init__(self, metrics=None, name="composite", output_names=None,
                  label_names=None):
         super().__init__(name, output_names=output_names, label_names=label_names)
-        if metrics is None:
-            metrics = []
-        self.metrics = [create(i) for i in metrics]
+        self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric))
 
     def get_metric(self, index):
-        try:
-            return self.metrics[index]
-        except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}".format(
-                index, len(self.metrics)))
+        if not 0 <= index < len(self.metrics):
+            raise ValueError("Metric index %d is out of range 0 and %d"
+                             % (index, len(self.metrics)))
+        return self.metrics[index]
 
     def update_dict(self, labels, preds):
         for metric in self.metrics:
@@ -127,24 +133,17 @@ class CompositeEvalMetric(EvalMetric):
             metric.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        # base __init__ calls reset() before self.metrics is assigned
+        for metric in getattr(self, "metrics", ()):
+            metric.reset()
 
     def get(self):
-        names = []
-        values = []
+        names, values = [], []
         for metric in self.metrics:
             name, value = metric.get()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (float, int, _np.generic)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
-        return (names, values)
+            names.extend([name] if isinstance(name, str) else name)
+            values.extend([value] if _np.isscalar(value) else value)
+        return names, values
 
 
 @register

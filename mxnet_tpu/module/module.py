@@ -443,7 +443,18 @@ class Module(BaseModule):
             batch[desc.name] = arr.asnumpy() if hasattr(arr, "asnumpy") \
                 else _np2.asarray(arr)
         batch = {k: v for k, v in batch.items() if k in fused.arg_names}
-        outs = fused(batch, lr=self._fused_lr())
+        from .. import profiler as _prof
+        if _prof.is_running():
+            import time as _time
+            _t0 = _time.perf_counter()
+            outs = fused(batch, lr=self._fused_lr())
+            import jax as _jax
+            _jax.block_until_ready(outs)
+            _prof.record_op_event("tpu_sync_fused_step",
+                                  _time.perf_counter() - _t0,
+                                  category="xla_graph_exec")
+        else:
+            outs = fused(batch, lr=self._fused_lr())
         from ..ndarray.ndarray import _new_from_jax
         self._fused_outputs = [_new_from_jax(o) for o in outs]
         self._fused_active = True

@@ -41,24 +41,24 @@ class Optimizer:
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  sym=None, begin_num_update=0, multi_precision=False,
                  param_dict=None):
+        # hyperparameters shared by every update op
+        self.lr, self.wd = learning_rate, wd
         self.rescale_grad = rescale_grad
-        self.lr = learning_rate
-        self.lr_scheduler = lr_scheduler
-        if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
-        self.wd = wd
-        self.lr_mult = {}
-        self.wd_mult = {}
-        self.begin_num_update = begin_num_update
-        self.num_update = begin_num_update
-        self._index_update_count = {}
         self.clip_gradient = clip_gradient
         self.multi_precision = multi_precision
-        if param_idx2name is None:
-            param_idx2name = {}
-        self.idx2name = param_idx2name.copy()
-        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
-        self.param_dict = param_dict if param_dict else {}
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            lr_scheduler.base_lr = learning_rate
+        # step accounting: per-index counters, all starting at
+        # begin_num_update (nonzero when resuming from a checkpoint)
+        self.begin_num_update = self.num_update = begin_num_update
+        self._index_update_count = {}
+        # per-parameter multiplier sources, in resolution order (see _mult)
+        self.param_dict = dict(param_dict or {})
+        self.lr_mult, self.wd_mult = {}, {}
+        self.idx2name = dict(param_idx2name or {})
+        self.sym_info = () if sym is None else (sym.attr_dict(),
+                                                sym.list_arguments())
 
     create_optimizer = staticmethod(create)
 
@@ -112,33 +112,31 @@ class Optimizer:
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):
-        if index not in self._index_update_count:
-            self._index_update_count[index] = self.begin_num_update
-        self._index_update_count[index] += 1
-        self.num_update = max(self._index_update_count[index], self.num_update)
+        count = self._index_update_count.get(index, self.begin_num_update) + 1
+        self._index_update_count[index] = count
+        if count > self.num_update:
+            self.num_update = count
+
+    def _mult(self, index, attr):
+        """Resolve the per-parameter multiplier named `attr` ('lr_mult' or
+        'wd_mult') for `index`. Precedence: a Gluon Parameter in param_dict
+        wins; then an explicit set_*_mult entry under the index; then one
+        under the parameter's name (via idx2name); else 1."""
+        if index in self.param_dict:
+            return getattr(self.param_dict[index], attr)
+        table = getattr(self, attr)
+        if index in table:
+            return table[index]
+        name = self.idx2name.get(index)
+        return table.get(name, 1.0) if name is not None else 1.0
 
     def _get_lr(self, index):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
-        if index in self.param_dict:
-            lr *= self.param_dict[index].lr_mult
-        elif index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+        base = self.lr if self.lr_scheduler is None \
+            else self.lr_scheduler(self.num_update)
+        return base * self._mult(index, "lr_mult")
 
     def _get_wd(self, index):
-        wd = self.wd
-        if index in self.param_dict:
-            wd *= self.param_dict[index].wd_mult
-        elif index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
+        return self.wd * self._mult(index, "wd_mult")
 
     def _common_kwargs(self, index):
         kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),

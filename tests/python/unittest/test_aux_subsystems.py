@@ -268,10 +268,15 @@ def test_contrib_quantization_roundtrip():
             "fc2_bias": mx.nd.array(np.zeros(2, np.float32))}
     qsym, qargs, _, th = mx.contrib.quantization.quantize_model(
         net, args, {}, calib_mode="none")
+    # weights are offline-quantized into <name>_quantize/_min/_max args
     for name in ("fc1_weight", "fc2_weight"):
+        assert name not in qargs
+        q = qargs[name + "_quantize"].asnumpy()
+        assert q.dtype == np.int8
+        absmax = float(qargs[name + "_max"].asnumpy()[0])
         orig = args[name].asnumpy()
-        quant = qargs[name].asnumpy()
-        assert np.abs(orig - quant).max() <= np.abs(orig).max() / 127 + 1e-6
+        dequant = q.astype(np.float32) * (absmax / 127.0)
+        assert np.abs(orig - dequant).max() <= absmax / 127 + 1e-6
     # with naive calibration
     X = rng.normal(0, 1, (16, 4)).astype(np.float32)
     it = mx.io.NDArrayIter(X, None, batch_size=8)

@@ -141,3 +141,16 @@ def test_update_dict_with_output_names():
                   {"softmax_output": _nd([[0.2, 0.8]]),
                    "other_output": _nd([[9.9]])})
     assert m.get()[1] == 1.0
+
+
+def test_composite_get_metric_raises():
+    """Deliberate divergence from the reference: its get_metric RETURNS a
+    ValueError object on a bad index (upstream bug, reference metric.py:
+    CompositeEvalMetric.get_metric); ours raises."""
+    import pytest
+    comp = mx.metric.create(["acc", "mae"])
+    assert isinstance(comp.get_metric(1), mx.metric.MAE)
+    with pytest.raises(ValueError):
+        comp.get_metric(2)
+    with pytest.raises(ValueError):
+        comp.get_metric(-1)

@@ -252,3 +252,46 @@ def test_fused_bad_env_dtype_falls_back(monkeypatch):
     mod = _fit_module("tpu_sync", 1, X, y, num_epoch=1)
     assert mod._fused_step is not None
     assert mod._fused_step.compute_dtype is None
+
+
+def test_fused_single_dispatch_per_step(tmp_path):
+    """The architecture's central claim as a regression guard: one fused
+    tpu_sync fit iteration = exactly ONE XLA program execution (the fused
+    fwd+bwd+psum+update step) and ZERO imperative-op or per-executor graph
+    dispatches (reference contrast: model.py:126-136 per-param push/pull).
+
+    Every dispatch layer in the framework records a profiler event when the
+    profiler runs (imperative.py, executor.py, module._fused_forward), so
+    the recorded event stream IS the dispatch count."""
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=[mx.tpu(0), mx.tpu(1)])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=1.0))
+    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    assert mod._fused_step is not None
+    batches = list(it)
+    # warmup: compile the fused program outside the profiled window
+    mod.forward(batches[0], is_train=True)
+    mod.backward()
+    mod.update()
+
+    mx.profiler.set_config(filename=str(tmp_path / "profile.json"))
+    mx.profiler.set_state("run")
+    try:
+        for batch in batches[1:4]:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    finally:
+        mx.profiler.set_state("stop")
+    events = [e for e in mx.profiler._state["events"]
+              if e.get("cat") in ("operator", "executor", "xla_graph_exec")]
+    mx.profiler._state["events"] = []
+    fused = [e for e in events if e["name"] == "tpu_sync_fused_step"]
+    assert len(fused) == 3, events  # one dispatch per iteration
+    others = [e for e in events if e["name"] != "tpu_sync_fused_step"]
+    assert not others, "extra dispatches rode along: %r" % (
+        [(e["cat"], e["name"]) for e in others],)

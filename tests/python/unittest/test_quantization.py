@@ -1,0 +1,150 @@
+"""INT8 quantization: graph rewrite + execution (reference:
+tests/python/quantization/test_quantization.py, quantize_graph_pass.cc).
+
+The fp32 graph is rewritten so Convolution/FullyConnected execute as
+`_contrib_quantized_*` ops on int8 inputs with int32 accumulation; these
+tests assert the rewritten graph's op structure AND that the int8 forward
+tracks the fp32 forward."""
+import json
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantization as Q
+from mxnet_tpu.util.test_utils import with_seed
+
+
+def _ops(sym):
+    return [n["op"] for n in json.loads(sym.tojson())["nodes"]
+            if n["op"] != "null"]
+
+
+def _convnet():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="conv0")
+    net = mx.sym.Activation(net, act_type="relu", name="relu0")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                         name="pool0")
+    net = mx.sym.Convolution(net, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                             name="conv1", no_bias=True)
+    net = mx.sym.Flatten(net, name="flat0")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc0")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _convnet_params(rng):
+    return {
+        "conv0_weight": mx.nd.array(rng.normal(0, 0.3, (8, 3, 3, 3)).astype(np.float32)),
+        "conv0_bias": mx.nd.array(rng.normal(0, 0.1, (8,)).astype(np.float32)),
+        "conv1_weight": mx.nd.array(rng.normal(0, 0.2, (16, 8, 3, 3)).astype(np.float32)),
+        "fc0_weight": mx.nd.array(rng.normal(0, 0.1, (10, 16 * 16 * 16)).astype(np.float32)),
+        "fc0_bias": mx.nd.array(np.zeros(10, np.float32)),
+    }
+
+
+def test_quantize_graph_structure():
+    """Conv/FC nodes become _contrib_quantized_* with quantize/requantize/
+    dequantize plumbing; weights fold into offline int8 args."""
+    net = _convnet()
+    params = ["conv0_weight", "conv0_bias", "conv1_weight",
+              "fc0_weight", "fc0_bias"]
+    qsym = Q.quantize_graph(net, offline_params=params)
+    ops = _ops(qsym)
+    assert ops.count("_contrib_quantized_conv") == 2
+    assert ops.count("_contrib_quantized_fully_connected") == 1
+    assert ops.count("_contrib_requantize") == 3
+    assert "Convolution" not in ops and "FullyConnected" not in ops
+    # runtime activation quantization stays in-graph; params don't
+    assert "_contrib_quantize" in ops
+    args = qsym.list_arguments()
+    for p in params:
+        assert p not in args
+        assert p + "_quantize" in args
+        assert p + "_min" in args and p + "_max" in args
+    assert "data" in args  # runtime input NOT offline-folded
+
+
+def test_quantize_graph_excluded_and_chain():
+    """excluded_sym_names keeps a layer fp32; pooling/flatten directly after
+    a quantized conv ride the int8 chain (no dequant/requant round trip)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=4, kernel=(1, 1), name="c0")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                         name="p0")
+    net = mx.sym.Flatten(net, name="f0")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc0")
+    qsym = Q.quantize_graph(net, offline_params=["c0_weight", "c0_bias",
+                                                 "fc0_weight", "fc0_bias"])
+    ops = _ops(qsym)
+    assert "_contrib_quantized_pooling" in ops
+    assert "_contrib_quantized_flatten" in ops
+    # the whole chain is int8: exactly one runtime quantize (of data), and
+    # the only dequantize is after the final fc
+    assert ops.count("_contrib_quantize") == 1
+    assert ops.count("_contrib_dequantize") == 1
+    # exclusion: fc kept fp32
+    q2 = Q.quantize_graph(net, excluded_sym_names=["fc0"],
+                          offline_params=["c0_weight", "c0_bias"])
+    ops2 = _ops(q2)
+    assert "FullyConnected" in ops2
+    assert ops2.count("_contrib_quantized_fully_connected") == 0
+
+
+@with_seed()
+def test_quantized_model_matches_fp32():
+    """quantize_model with naive calibration: int8 forward tracks fp32."""
+    rng = np.random.RandomState(7)
+    net = _convnet()
+    args = _convnet_params(rng)
+    calib = rng.uniform(-1, 1, (16, 3, 32, 32)).astype(np.float32)
+    it = mx.io.NDArrayIter(calib, None, batch_size=8)
+    qsym, qargs, qaux, th = Q.quantize_model(
+        net, args, {}, calib_mode="naive", calib_data=it)
+    assert any(k.startswith("conv0") for k in th)
+    x = rng.uniform(-1, 1, (4, 3, 32, 32)).astype(np.float32)
+    lbl = mx.nd.array(np.zeros(4, np.float32))
+    qargs = dict(qargs, data=mx.nd.array(x), softmax_label=lbl)
+    out_q = qsym.bind(mx.cpu(), qargs, grad_req="null") \
+        .forward(is_train=False)[0].asnumpy()
+    fargs = dict(args, data=mx.nd.array(x), softmax_label=lbl)
+    out_f = net.bind(mx.cpu(), fargs, grad_req="null") \
+        .forward(is_train=False)[0].asnumpy()
+    assert (out_f.argmax(axis=1) == out_q.argmax(axis=1)).mean() >= 0.75
+    assert np.abs(out_f - out_q).max() < 0.1  # softmax-space tolerance
+
+
+@with_seed()
+def test_quantized_ops_direct():
+    """quantize -> quantized_conv -> requantize -> dequantize numerics
+    against a plain fp32 conv (per-op analog of reference
+    test_quantized_conv)."""
+    rng = np.random.RandomState(3)
+    x = rng.uniform(-1, 1, (2, 4, 8, 8)).astype(np.float32)
+    w = rng.normal(0, 0.3, (6, 4, 3, 3)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, num_filter=6, kernel=(3, 3), pad=(1, 1),
+                              no_bias=True, name="c")
+    ref = conv.bind(mx.cpu(), {"data": mx.nd.array(x),
+                               "c_weight": mx.nd.array(w)},
+                    grad_req="null").forward(is_train=False)[0].asnumpy()
+    qsym = Q.quantize_graph(conv, offline_params=["c_weight"])
+    qargs = Q.quantize_params(qsym, {"c_weight": mx.nd.array(w)})
+    qargs["data"] = mx.nd.array(x)
+    out = qsym.bind(mx.cpu(), qargs, grad_req="null") \
+        .forward(is_train=False)[0].asnumpy()
+    # int8 x int8 conv: ~1% relative error budget
+    assert np.abs(out - ref).max() < 0.03 * np.abs(ref).max() + 0.02
+
+
+def test_quantize_params_roundtrip_values():
+    w = np.array([[-2.0, -1.0, 0.0, 0.5, 2.0]], np.float32)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=1, no_bias=True, name="f")
+    qsym = Q.quantize_graph(fc, offline_params=["f_weight"])
+    qargs = Q.quantize_params(qsym, {"f_weight": mx.nd.array(w)})
+    q = qargs["f_weight_quantize"].asnumpy()
+    assert q.dtype == np.int8
+    np.testing.assert_array_equal(q, [[-127, -64, 0, 32, 127]])
+    assert qargs["f_weight_min"].asnumpy()[0] == -2.0
+    assert qargs["f_weight_max"].asnumpy()[0] == 2.0
