@@ -32,7 +32,7 @@ BASELINE_TRAIN_P100 = 181.53   # ResNet-50 train b32, docs/faq/perf.md:178-185
 PROBE_TIMEOUT_S = 75
 PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy)
     "infer": 700, "train_fp32": 700, "train_bf16": 600,
-    "jax_baseline": 700, "flash": 450,
+    "jax_baseline": 700, "flash": 450, "io_train": 600,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -106,7 +106,8 @@ def main():
         extra["platform"] = "cpu"
 
     # 2) measurement phases, each in its own budgeted child
-    phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash"]
+    phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
+              "io_train"]
     if os.environ.get("BENCH_SKIP_BF16") or force_cpu:
         phases.remove("train_bf16")
     results = {}
@@ -148,7 +149,8 @@ def main():
     # 4) merge
     infer = results.get("infer", {})
     value = infer.get("img_per_sec", 0.0)
-    for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash"):
+    for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
+                  "io_train"):
         extra.update(results.get(phase, {}))
     if "train_img_per_sec" in extra:
         extra["train_vs_baseline"] = round(
@@ -290,9 +292,11 @@ def _phase_flash():
     import numpy as np
     import jax
     import jax.numpy as jnp
-    from mxnet_tpu.kernels.flash_attention import flash_attention
+    from mxnet_tpu.kernels.flash_attention import (flash_attention,
+                                                   default_use_pallas)
     platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
+    on_tpu = platform != "cpu"
+    use_pallas = default_use_pallas()  # the framework's own kernel gate
     B, H, S, D = (4, 8, 4096, 128) if on_tpu else (2, 2, 512, 64)
     rng = np.random.RandomState(0)
     # distinct q per timed call: identical dispatches can be deduped by the
@@ -305,7 +309,7 @@ def _phase_flash():
     v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32), dt_)
     fn = jax.jit(lambda q, k, v: flash_attention(
         q, k, v, causal=True, block_q=1024 if on_tpu else 256,
-        block_k=512 if on_tpu else 256, use_pallas=on_tpu))
+        block_k=512 if on_tpu else 256, use_pallas=use_pallas))
     jax.block_until_ready([fn(qs[0], k, v)] + qs)  # compile + stage
     tic = time.time()
     outs = [fn(q, k, v) for q in qs]
@@ -314,7 +318,65 @@ def _phase_flash():
     # causal attention flops: 2 matmuls * B*H*S^2*D, halved by causality
     flops = 2 * 2 * B * H * S * S * D * 0.5 * n_iter
     return {"flash_attn_tflops": round(flops / dt / 1e12, 2),
-            "flash_attn_pallas": bool(on_tpu)}
+            "flash_attn_pallas": bool(use_pallas)}
+
+
+def _phase_io_train():
+    """End-to-end input-pipeline + train throughput: synthetic JPEG .rec ->
+    C++ ImageRecordIter (sharded read, threaded decode/augment, prefetch;
+    src/io/image_record_iter.cc) -> Module.fit on the fused tpu_sync step.
+    This is the judged `train_imagenet.py` path WITH its IO half, where the
+    other train phases pre-stage device tensors. Also reports the pure
+    pipeline drain rate. Reference anchor: iter_image_recordio_2.cc:50."""
+    import tempfile
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+    from mxnet_tpu.models import resnet
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+    side = 224 if on_tpu else 64
+    n_img = 512 if on_tpu else 192
+    batch = 32
+    rng = np.random.RandomState(0)
+    import atexit
+    import shutil
+    tmpdir = tempfile.mkdtemp()
+    atexit.register(shutil.rmtree, tmpdir, True)  # child exits -> cleanup
+    path = os.path.join(tmpdir, "synthetic.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(n_img):
+        img = rng.randint(0, 255, (side, side, 3), dtype=np.uint8)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 10), i, 0), img, quality=90))
+    rec.close()
+    it = mx.io.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, side, side), batch_size=batch,
+        shuffle=True, preprocess_threads=8, rand_mirror=True,
+        mean_r=123.0, mean_g=117.0, mean_b=104.0, std_r=58.0, std_g=57.0,
+        std_b=57.0)
+    n = 0
+    tic = time.time()
+    for _ in it:  # pure pipeline drain: decode+augment+batch, no compute
+        n += batch
+    pipeline_ips = n / (time.time() - tic)
+    it.reset()
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50 if on_tpu else 18,
+                            image_shape="3,%d,%d" % (side, side))
+    mod = mx.mod.Module(sym, context=mx.tpu(0))
+    step_times = []
+    mod.fit(it, num_epoch=3 if on_tpu else 2, kvstore="tpu_sync",
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2.0),
+            batch_end_callback=lambda p: step_times.append(time.time()))
+    assert mod._fused_step is not None  # must measure the fused path
+    half = len(step_times) // 2  # steady state: drop compile + warmup half
+    ips = batch * (len(step_times) - half) \
+        / max(step_times[-1] - step_times[half - 1], 1e-9)
+    return {"io_train_img_per_sec": round(ips, 2),
+            "io_pipeline_img_per_sec": round(pipeline_ips, 2)}
 
 
 PHASES = {
@@ -324,6 +386,7 @@ PHASES = {
     "train_bf16": _phase_train_bf16,
     "jax_baseline": _phase_jax_baseline,
     "flash": _phase_flash,
+    "io_train": _phase_io_train,
 }
 
 

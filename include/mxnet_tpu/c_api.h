@@ -70,6 +70,40 @@ long long MXTIONumSamples(void* handle);
 /* Destroy the iterator and join its worker threads. */
 void MXTIOFree(void* handle);
 
+/* ---- predict API: inference for C embedders --------------------------- */
+/* (reference analog: include/mxnet/c_predict_api.h — MXPredCreate /
+ * MXPredSetInput / MXPredForward / MXPredGetOutput). Implemented in
+ * libmxtpu_predict.so, which embeds CPython and executes through the
+ * XLA-backed executor; the embedder's process needs PYTHONPATH to reach
+ * mxnet_tpu (see src/predict/predict.cc). float32 in/out. */
+
+/* Last predict error of the calling thread (empty string if none). */
+const char* MXTPredGetLastError(void);
+
+/* Load an exported symbol JSON + params file and bind one executor.
+ * input_shapes is the concatenation of every input's dims; input_ndims[i]
+ * gives input i's rank. Returns an opaque handle or NULL. */
+void* MXTPredCreate(const char* symbol_json_path, const char* params_path,
+                    int num_inputs, const char* const* input_names,
+                    const int* input_ndims, const int* input_shapes);
+
+/* Copy a C-layout float32 buffer into the named input. 0 or -1. */
+int MXTPredSetInput(void* handle, const char* name, const float* data,
+                    const int* shape, int ndim);
+
+/* Run forward. Returns the number of outputs, or -1. */
+int MXTPredForward(void* handle);
+
+/* shape_out must hold >= 8 ints. 0 or -1. */
+int MXTPredGetOutputShape(void* handle, int index, int* shape_out,
+                          int* ndim_out);
+
+/* Copy output `index` into out_buf (capacity `size` floats). 0 or -1. */
+int MXTPredGetOutput(void* handle, int index, float* out_buf, size_t size);
+
+/* Release the predictor. */
+void MXTPredFree(void* handle);
+
 /* ---- pooled host staging allocator ------------------------------------ */
 
 /* Page-aligned allocation from the size-class pool (never returns memory

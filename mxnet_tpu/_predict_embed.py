@@ -1,0 +1,94 @@
+"""In-process helper behind the C predict ABI (src/predict/predict.cc).
+
+The reference ships an inference-only C surface (reference:
+include/mxnet/c_predict_api.h:1) so embedders can run exported models
+without Python *source* — its implementation still carries the whole
+engine. The TPU-native equivalent keeps XLA as the compute path: the C
+library embeds a CPython interpreter, and this module is the minimal
+bridge it drives — load an exported symbol JSON + params file, bind one
+executor, copy inputs in, run forward, copy outputs out. No other part of
+the framework imports this module.
+
+All functions return plain ints/tuples; exceptions propagate to C where
+they become error codes + MXTPredGetLastError() text.
+"""
+import numpy as _np
+
+_handles = {}
+_next_id = [1]
+
+
+def create(symbol_json_path, params_path, input_names, input_shapes):
+    """Load + bind. Returns an integer handle.
+
+    input_names: list[str]; input_shapes: list[tuple[int]] matching it.
+    Params files accept both the legacy `arg:`/`aux:` prefixed save format
+    (Module.save_checkpoint / nd.save) and unprefixed dicts (gluon
+    export)."""
+    import mxnet_tpu as mx
+    sym = mx.sym.load(symbol_json_path)
+    loaded = mx.nd.load(params_path)
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    shape_kwargs = {n: tuple(int(d) for d in s)
+                    for n, s in zip(input_names, input_shapes)}
+    exe = sym.simple_bind(mx.tpu(0), grad_req="null", **shape_kwargs)
+    # every non-input weight must come from the params file — a silent
+    # mismatch would mean garbage predictions with rc=0
+    missing = [n for n in exe.arg_dict
+               if n not in arg_params and n not in input_names]
+    missing += [n for n in exe.aux_dict if n not in aux_params]
+    if missing:
+        raise KeyError("params file %r lacks weights for %s (symbol args "
+                       "must match the file's arg:/aux: names)"
+                       % (params_path, sorted(missing)))
+    for name, arr in exe.arg_dict.items():
+        if name in arg_params:
+            arr[:] = arg_params[name]
+    for name, arr in exe.aux_dict.items():
+        arr[:] = aux_params[name]
+    h = _next_id[0]
+    _next_id[0] += 1
+    _handles[h] = (exe, list(input_names))
+    return h
+
+
+def set_input(h, name, buf, shape):
+    exe, _ = _handles[h]
+    arr = _np.frombuffer(buf, dtype=_np.float32).reshape(
+        tuple(int(d) for d in shape))
+    exe.arg_dict[name][:] = arr
+    return 0
+
+
+def forward(h):
+    exe, _ = _handles[h]
+    exe.forward(is_train=False)
+    return len(exe.outputs)
+
+
+def output_shape(h, index):
+    exe, _ = _handles[h]
+    return tuple(int(d) for d in exe.outputs[index].shape)
+
+
+def get_output(h, index, buf):
+    exe, _ = _handles[h]
+    out = exe.outputs[index].asnumpy().astype(_np.float32, copy=False)
+    view = _np.frombuffer(buf, dtype=_np.float32)
+    if view.size < out.size:  # header contract: `size` is a CAPACITY
+        raise ValueError("output buffer holds %d floats, need %d"
+                         % (view.size, out.size))
+    view[:out.size] = out.ravel()
+    return 0
+
+
+def free(h):
+    _handles.pop(h, None)
+    return 0

@@ -35,15 +35,45 @@ __all__ = ["flash_attention", "blockwise_attention", "attention_with_lse",
 
 
 def default_use_pallas():
-    """Single policy for kernel selection: Pallas on any TPU PJRT platform
-    (including experimental plugins whose backend NAME isn't 'tpu'),
-    provided the Pallas import succeeded."""
+    """Single policy for kernel selection: Pallas on any TPU PJRT platform,
+    provided the Pallas import succeeded. Experimental plugins can report a
+    platform name that isn't 'tpu' (the tunneled backend here has been
+    observed as 'tpu', but don't bet the kernel path on it): accept a
+    device whose platform OR device_kind mentions TPU."""
     try:
-        return _HAS_PALLAS and jax.devices()[0].platform == "tpu"
+        dev = jax.devices()[0]
+        if not _HAS_PALLAS:
+            return False
+        if dev.platform == "tpu":
+            return True
+        kind = (getattr(dev, "device_kind", "") or "").lower()
+        return "tpu" in kind or "tpu" in dev.platform.lower()
     except Exception:
         return False
 
 _NEG_INF = -1e30
+
+
+def _mxu_qk(a, b):
+    """[m, d] x [n, d] -> [m, n] contracting d WITHOUT materializing b.T —
+    Mosaic feeds the MXU the transposed operand directly; an explicit
+    `.T` costs a VMEM relayout first."""
+    return lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+def _mxu_tn(a, b):
+    """[m, n] x [m, d] -> [n, d] contracting m (a.T @ b without the .T)."""
+    return lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+def _grid_parallel():
+    """Both grid axes of every flash kernel write disjoint output blocks —
+    tell Mosaic so it can pipeline/parallelize instead of assuming a
+    sequential grid."""
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel"))
 
 
 def _causal_mask(q_len, k_len, q_offset, k_offset, dtype=jnp.float32):
@@ -161,7 +191,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         acc, m_i, l_i = carry
         k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        s = _mxu_qk(q, k_blk) * sm_scale
         if causal:
             q_pos = q_off + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + lax.broadcasted_iota(jnp.int32,
@@ -219,7 +249,7 @@ def _flash_fwd_offs_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         acc, m_i, l_i = carry
         k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        s = _mxu_qk(q, k_blk) * sm_scale
         if causal:
             q_pos = q_off + lax.broadcasted_iota(jnp.int32,
                                                  (block_q, block_k), 0)
@@ -290,6 +320,7 @@ def _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal, block_q, block_k,
         kernel,
         grid_spec=grid_spec,
         out_shape=out_shapes,
+        compiler_params=None if interpret else _grid_parallel(),
         interpret=interpret,
     )(offs.astype(jnp.int32), qf, kf, vf)
     return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
@@ -318,7 +349,7 @@ def _flash_bwd_dq_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
     def body(i, dq):
         k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        s = _mxu_qk(q, k_blk) * sm_scale
         if causal:
             q_pos = q_off + lax.broadcasted_iota(jnp.int32,
                                                  (block_q, block_k), 0)
@@ -327,8 +358,7 @@ def _flash_bwd_dq_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.where((s > _NEG_INF / 2) & (lse[:, None] > _NEG_INF / 2),
                       jnp.exp(s - lse[:, None]), 0.0)
-        dp = jnp.dot(do.astype(v_blk.dtype), v_blk.T,
-                     preferred_element_type=jnp.float32)
+        dp = _mxu_qk(do.astype(v_blk.dtype), v_blk)
         ds = p * (dp - deff[:, None]) * sm_scale
         return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk,
                             preferred_element_type=jnp.float32)
@@ -360,7 +390,7 @@ def _flash_bwd_dkv_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
         do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), 0]
         deff_blk = deff_ref[0, pl.ds(i * block_q, block_q), 0]
-        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * sm_scale
+        s = _mxu_qk(q_blk, k) * sm_scale
         if causal:
             q_pos = q_base + i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -370,13 +400,10 @@ def _flash_bwd_dkv_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
         p = jnp.where((s > _NEG_INF / 2)
                       & (lse_blk[:, None] > _NEG_INF / 2),
                       jnp.exp(s - lse_blk[:, None]), 0.0)
-        dv = dv + jnp.dot(p.astype(do_blk.dtype).T, do_blk,
-                          preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk.astype(v.dtype), v.T,
-                     preferred_element_type=jnp.float32)
+        dv = dv + _mxu_tn(p.astype(do_blk.dtype), do_blk)
+        dp = _mxu_qk(do_blk.astype(v.dtype), v)
         ds = p * (dp - deff_blk[:, None]) * sm_scale
-        dk = dk + jnp.dot(ds.astype(q_blk.dtype).T, q_blk,
-                          preferred_element_type=jnp.float32)
+        dk = dk + _mxu_tn(ds.astype(q_blk.dtype), q_blk)
         return dk, dv
 
     if causal:
@@ -426,6 +453,7 @@ def _flash_bwd_offs_pallas(q, k, v, offs, do, dlse, out, lse, sm_scale,
                                    lambda i, j, o: (i, j, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        compiler_params=None if interpret else _grid_parallel(),
         interpret=interpret,
     )(offs, qf, kf, vf, dof, lsef, deff)
 
@@ -452,6 +480,7 @@ def _flash_bwd_offs_pallas(q, k, v, offs, do, dlse, out, lse, sm_scale,
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
+        compiler_params=None if interpret else _grid_parallel(),
         interpret=interpret,
     )(offs, qf, kf, vf, dof, lsef, deff)
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
@@ -517,6 +546,7 @@ def _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ],
+        compiler_params=None if interpret else _grid_parallel(),
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)

@@ -179,3 +179,10 @@ def _maereg_output(params, shapes):
 def _logreg_output(params, shapes):
     data = shapes.get("data")
     return {"label": tuple(data)} if data else {}
+
+
+@hook("IdentityAttachKLSparseReg")
+def _kl_sparse_reg(params, shapes):
+    # moving_avg tracks the per-unit activation mean: data shape sans batch
+    data = shapes.get("data")
+    return {"moving_avg": tuple(data[1:])} if data else {}

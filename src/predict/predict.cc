@@ -1,0 +1,200 @@
+// C predict ABI — inference for C embedders without writing Python source.
+// Reference analog: include/mxnet/c_predict_api.h:1 (MXPredCreate /
+// MXPredSetInput / MXPredForward / MXPredGetOutput) and its amalgamation
+// build. TPU-native split: compute stays on XLA/PJRT; this library embeds a
+// CPython interpreter and drives mxnet_tpu/_predict_embed.py, so the C
+// surface stays tiny while the full op catalog + executor remain one
+// implementation. The embedder links -lmxtpu_predict (plus libpython at
+// load time) and needs PYTHONPATH to reach mxnet_tpu and its deps.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+std::mutex g_init_mu;
+PyObject* g_mod = nullptr;          // mxnet_tpu._predict_embed
+PyThreadState* g_main_tstate = nullptr;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value) {
+    if (PyObject* s = PyObject_Str(value)) {
+      if (const char* c = PyUnicode_AsUTF8(s)) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Initialize the interpreter (idempotent) and import the bridge module.
+// Returns false with g_last_error set on failure.
+bool ensure_python() {
+  std::lock_guard<std::mutex> lock(g_init_mu);
+  if (g_mod) return true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(/*initsigs=*/0);  // embedders keep their signal handlers
+    g_main_tstate = PyEval_SaveThread();
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu._predict_embed");
+  if (!mod) {
+    set_error_from_python();
+    PyGILState_Release(gil);
+    return false;
+  }
+  g_mod = mod;  // kept for the process lifetime
+  PyGILState_Release(gil);
+  return true;
+}
+
+// Call g_mod.<fn>(*args); returns new reference or nullptr (error set).
+PyObject* call(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_mod, fn);
+  if (!f) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!out) set_error_from_python();
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTPredGetLastError(void) { return g_last_error.c_str(); }
+
+// Create a predictor from an exported symbol JSON and a params file.
+// input_shapes is flattened; input_ndims[i] gives each input's rank.
+// Returns an opaque handle (>0 cast to void*) or NULL.
+void* MXTPredCreate(const char* symbol_json_path, const char* params_path,
+                    int num_inputs, const char* const* input_names,
+                    const int* input_ndims, const int* input_shapes) {
+  if (!ensure_python()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* names = PyList_New(num_inputs);
+  PyObject* shapes = PyList_New(num_inputs);
+  const int* dims = input_shapes;
+  for (int i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_names[i]));
+    PyObject* shp = PyTuple_New(input_ndims[i]);
+    for (int d = 0; d < input_ndims[i]; ++d)
+      PyTuple_SetItem(shp, d, PyLong_FromLong(*dims++));
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject* out = call("create", Py_BuildValue(
+      "(ssNN)", symbol_json_path, params_path, names, shapes));
+  void* handle = nullptr;
+  if (out) {
+    handle = reinterpret_cast<void*>(PyLong_AsLongLong(out));
+    Py_DECREF(out);
+  }
+  PyGILState_Release(gil);
+  return handle;
+}
+
+// True when the interpreter + bridge are up; otherwise sets the error the
+// header's -1/NULL contract promises instead of crashing on a null module.
+static bool pred_ready() {
+  if (g_mod) return true;
+  g_last_error = "predictor not initialized (MXTPredCreate must succeed first)";
+  return false;
+}
+
+// Copy a float32 input buffer (size floats, C layout) into input `name`.
+// Returns 0, or -1 with MXTPredGetLastError() set.
+int MXTPredSetInput(void* handle, const char* name, const float* data,
+                    const int* shape, int ndim) {
+  if (!pred_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  size_t n = 1;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int d = 0; d < ndim; ++d) {
+    n *= shape[d];
+    PyTuple_SetItem(shp, d, PyLong_FromLong(shape[d]));
+  }
+  PyObject* view = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<float*>(data)),
+      n * sizeof(float), PyBUF_READ);
+  PyObject* out = call("set_input", Py_BuildValue(
+      "(LsNN)", reinterpret_cast<long long>(handle), name, view, shp));
+  int rc = out ? 0 : -1;
+  Py_XDECREF(out);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Run the bound executor's forward. Returns the output count, or -1.
+int MXTPredForward(void* handle) {
+  if (!pred_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* out = call("forward", Py_BuildValue(
+      "(L)", reinterpret_cast<long long>(handle)));
+  int rc = -1;
+  if (out) {
+    rc = static_cast<int>(PyLong_AsLong(out));
+    Py_DECREF(out);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// shape_out must hold >= 8 ints; *ndim_out receives the rank. Returns 0/-1.
+int MXTPredGetOutputShape(void* handle, int index, int* shape_out,
+                          int* ndim_out) {
+  if (!pred_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* out = call("output_shape", Py_BuildValue(
+      "(Li)", reinterpret_cast<long long>(handle), index));
+  int rc = -1;
+  if (out) {
+    Py_ssize_t nd = PyTuple_Size(out);
+    *ndim_out = static_cast<int>(nd);
+    for (Py_ssize_t d = 0; d < nd && d < 8; ++d)
+      shape_out[d] = static_cast<int>(
+          PyLong_AsLong(PyTuple_GetItem(out, d)));
+    Py_DECREF(out);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Copy output `index` into out (capacity `size` floats). Returns 0/-1.
+int MXTPredGetOutput(void* handle, int index, float* out_buf, size_t size) {
+  if (!pred_ready()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* view = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(out_buf), size * sizeof(float), PyBUF_WRITE);
+  PyObject* out = call("get_output", Py_BuildValue(
+      "(LiN)", reinterpret_cast<long long>(handle), index, view));
+  int rc = out ? 0 : -1;
+  Py_XDECREF(out);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Release the predictor's executor and params.
+void MXTPredFree(void* handle) {
+  if (!g_mod) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* out = call("free", Py_BuildValue(
+      "(L)", reinterpret_cast<long long>(handle)));
+  Py_XDECREF(out);
+  PyGILState_Release(gil);
+}
+
+}  // extern "C"

@@ -1,0 +1,139 @@
+"""C predict ABI end-to-end: export a model from Python, then a real C
+program (no Python source) loads it via MXTPred* and must reproduce the
+Python forward bit-for-bit-ish (reference analog: c_predict_api.h's
+image-classification/predict-cpp flow)."""
+import os
+import shutil
+import struct
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+
+C_PROG = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <mxnet_tpu/c_api.h>
+
+static float* read_floats(const char* path, long* n_out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  long bytes = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  float* buf = (float*)malloc(bytes);
+  if (fread(buf, 1, bytes, f) != (size_t)bytes) { fclose(f); return NULL; }
+  fclose(f);
+  *n_out = bytes / (long)sizeof(float);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  /* argv: symbol.json params input.bin output.bin batch dim */
+  int batch = atoi(argv[5]), dim = atoi(argv[6]);
+  const char* names[1] = {"data"};
+  int ndims[1] = {2};
+  int shapes[2]; shapes[0] = batch; shapes[1] = dim;
+  void* pred = MXTPredCreate(argv[1], argv[2], 1, names, ndims, shapes);
+  if (!pred) { fprintf(stderr, "create: %s\n", MXTPredGetLastError()); return 1; }
+  long n_in = 0;
+  float* input = read_floats(argv[3], &n_in);
+  if (!input || n_in != (long)batch * dim) { fprintf(stderr, "bad input\n"); return 2; }
+  if (MXTPredSetInput(pred, "data", input, shapes, 2) != 0) {
+    fprintf(stderr, "set_input: %s\n", MXTPredGetLastError()); return 3;
+  }
+  int n_out = MXTPredForward(pred);
+  if (n_out < 1) { fprintf(stderr, "forward: %s\n", MXTPredGetLastError()); return 4; }
+  int oshape[8], ondim = 0;
+  if (MXTPredGetOutputShape(pred, 0, oshape, &ondim) != 0) return 5;
+  long total = 1;
+  for (int d = 0; d < ondim; ++d) total *= oshape[d];
+  float* out = (float*)malloc(total * sizeof(float));
+  if (MXTPredGetOutput(pred, 0, out, (size_t)total) != 0) {
+    fprintf(stderr, "get_output: %s\n", MXTPredGetLastError()); return 6;
+  }
+  FILE* f = fopen(argv[4], "wb");
+  fwrite(&ondim, sizeof(int), 1, f);
+  fwrite(oshape, sizeof(int), ondim, f);
+  fwrite(out, sizeof(float), total, f);
+  fclose(f);
+  MXTPredFree(pred);
+  printf("C_PREDICT_OK outputs=%d ndim=%d\n", n_out, ondim);
+  free(input); free(out);
+  return 0;
+}
+"""
+
+
+def _compiler():
+    return shutil.which("gcc") or shutil.which("cc")
+
+
+@pytest.mark.skipif(_compiler() is None, reason="no C compiler")
+def test_c_predict_end_to_end(tmp_path):
+    lib_dir = os.path.join(REPO, "mxnet_tpu", "_lib")
+    so = os.path.join(lib_dir, "libmxtpu_predict.so")
+    if not os.path.exists(so):
+        pytest.skip("libmxtpu_predict.so not built (run make -C src)")
+
+    # 1) export a model the reference way (save_checkpoint format)
+    rng = np.random.RandomState(5)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.softmax(net)
+    args = {"fc1_weight": mx.nd.array(rng.normal(0, 0.5, (8, 4)).astype(np.float32)),
+            "fc1_bias": mx.nd.array(rng.normal(0, 0.1, (8,)).astype(np.float32)),
+            "fc2_weight": mx.nd.array(rng.normal(0, 0.5, (3, 8)).astype(np.float32)),
+            "fc2_bias": mx.nd.array(np.zeros(3, np.float32))}
+    sym_path = str(tmp_path / "model-symbol.json")
+    params_path = str(tmp_path / "model-0000.params")
+    net.save(sym_path)
+    mx.nd.save(params_path, {"arg:" + k: v for k, v in args.items()})
+
+    x = rng.uniform(-1, 1, (2, 4)).astype(np.float32)
+    expected = net.bind(mx.cpu(), dict(args, data=mx.nd.array(x)),
+                        grad_req="null").forward(is_train=False)[0].asnumpy()
+    in_path = str(tmp_path / "input.bin")
+    x.ravel().tofile(in_path)
+
+    # 2) compile the embedder
+    src = tmp_path / "embed.c"
+    src.write_text(C_PROG)
+    exe = str(tmp_path / "embed")
+    subprocess.run(
+        [_compiler(), str(src), "-o", exe,
+         "-I", os.path.join(REPO, "include"),
+         "-L", lib_dir, "-lmxtpu_predict",
+         "-Wl,-rpath," + lib_dir,
+         "-Wl,-rpath," + sysconfig.get_config_var("LIBDIR")],
+        check=True)
+
+    # 3) run it on a forced-CPU mesh with the venv on PYTHONPATH
+    sys.path.insert(0, REPO)
+    from ci.envutil import cpu_mesh_env
+    env = cpu_mesh_env(1)
+    site = [p for p in sys.path if p.endswith("site-packages")]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + site)
+    out_path = str(tmp_path / "output.bin")
+    proc = subprocess.run(
+        [exe, sym_path, params_path, in_path, out_path, "2", "4"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "C_PREDICT_OK" in proc.stdout
+
+    # 4) C output == Python output
+    with open(out_path, "rb") as f:
+        ndim = struct.unpack("i", f.read(4))[0]
+        shape = struct.unpack("%di" % ndim, f.read(4 * ndim))
+        got = np.fromfile(f, dtype=np.float32).reshape(shape)
+    assert shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,423 @@
+"""Shape/dtype/edge-case matrices for op families that previously had one
+smoke test each (reference: tests/python/unittest/test_operator.py — the
+broadcast/ordering/take/la_op/box matrices; behavior ported, not code).
+
+Everything here is a VALUE test against numpy/scipy ground truth; gradient
+coverage lives in test_numeric_gradients*.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+RNG = np.random.RandomState(13)
+
+
+def _nd(a):
+    return mx.nd.array(np.asarray(a, np.float32))
+
+
+# ---------------------------------------------------------------- broadcast
+
+BROADCAST_SHAPES = [
+    ((2, 3), (2, 3)),        # no broadcast
+    ((2, 1), (2, 3)),        # rhs wider
+    ((2, 3), (2, 1)),        # lhs wider
+    ((1, 3), (2, 1)),        # both sides broadcast
+    ((2, 1, 4), (1, 3, 1)),  # both, 3d
+    ((1, 1), (3, 4)),        # effectively scalar lhs
+    ((5,), (2, 5)),          # rank promotion
+]
+
+BROADCAST_OPS = [
+    ("broadcast_add", np.add),
+    ("broadcast_sub", np.subtract),
+    ("broadcast_mul", np.multiply),
+    ("broadcast_div", np.divide),
+    ("broadcast_maximum", np.maximum),
+    ("broadcast_minimum", np.minimum),
+    ("broadcast_power", np.power),
+    ("broadcast_hypot", np.hypot),
+    ("broadcast_equal", lambda a, b: (a == b).astype(np.float32)),
+    ("broadcast_not_equal", lambda a, b: (a != b).astype(np.float32)),
+    ("broadcast_greater", lambda a, b: (a > b).astype(np.float32)),
+    ("broadcast_greater_equal", lambda a, b: (a >= b).astype(np.float32)),
+    ("broadcast_lesser", lambda a, b: (a < b).astype(np.float32)),
+    ("broadcast_lesser_equal", lambda a, b: (a <= b).astype(np.float32)),
+]
+
+
+@pytest.mark.parametrize("opname,npop", BROADCAST_OPS,
+                         ids=[o[0] for o in BROADCAST_OPS])
+def test_broadcast_forward_matrix(opname, npop):
+    if not hasattr(mx.nd, opname):
+        pytest.skip("%s not exposed" % opname)
+    fn = getattr(mx.nd, opname)
+    for sa, sb in BROADCAST_SHAPES:
+        a = RNG.uniform(0.4, 1.8, sa).astype(np.float32)
+        b = RNG.uniform(0.4, 1.8, sb).astype(np.float32)
+        out = fn(_nd(a), _nd(b)).asnumpy()
+        np.testing.assert_allclose(out, npop(a, b).astype(np.float32),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg="%s %s %s" % (opname, sa, sb))
+
+
+def test_broadcast_backward_reduces():
+    """Gradient of a broadcast op must SUM over the broadcast axes
+    (reference broadcast_op backward uses reduce-to-shape)."""
+    for sa, sb in BROADCAST_SHAPES:
+        x = mx.sym.Variable("x")
+        y = mx.sym.Variable("y")
+        out = mx.sym.broadcast_mul(x, y)
+        a = RNG.uniform(0.5, 1.5, sa).astype(np.float32)
+        b = RNG.uniform(0.5, 1.5, sb).astype(np.float32)
+        ex = out.simple_bind(mx.cpu(), x=sa, y=sb)
+        ex.arg_dict["x"][:] = a
+        ex.arg_dict["y"][:] = b
+        ex.forward(is_train=True)
+        head = RNG.uniform(-1, 1, ex.outputs[0].shape).astype(np.float32)
+        ex.backward([_nd(head)])
+        # d/dx sum(head * x*b) = reduce(head*b) to x's shape
+        full = head * np.broadcast_to(b, head.shape)
+        expect = full
+        # reduce to shape sa (sum over broadcast axes, then reshape)
+        while expect.ndim > len(sa):
+            expect = expect.sum(axis=0)
+        for ax, n in enumerate(sa):
+            if n == 1:
+                expect = expect.sum(axis=ax, keepdims=True)
+        np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), expect,
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg="shapes %s %s" % (sa, sb))
+
+
+# ---------------------------------------------------------------- reductions
+
+REDUCE_OPS = [("sum", np.sum), ("mean", np.mean), ("prod", np.prod),
+              ("max", np.max), ("min", np.min)]
+REDUCE_AXES = [None, 0, 1, -1, (0, 1), (0, 2), (1, 2)]
+
+
+@pytest.mark.parametrize("opname,npop", REDUCE_OPS,
+                         ids=[o[0] for o in REDUCE_OPS])
+def test_reduce_axis_matrix(opname, npop):
+    a = RNG.uniform(0.5, 1.5, (2, 3, 4)).astype(np.float32)
+    for axis in REDUCE_AXES:
+        for keepdims in (False, True):
+            out = getattr(mx.nd, opname)(_nd(a), axis=axis,
+                                         keepdims=keepdims).asnumpy()
+            expect = npop(a, axis=axis, keepdims=keepdims)
+            np.testing.assert_allclose(
+                out, np.asarray(expect, np.float32), rtol=1e-5, atol=1e-6,
+                err_msg="%s axis=%s keepdims=%s" % (opname, axis, keepdims))
+
+
+def test_reduce_exclude_flag():
+    """exclude=True reduces over every axis NOT listed (reference
+    broadcast_reduce-inl.h exclude semantics)."""
+    a = RNG.uniform(0, 1, (2, 3, 4)).astype(np.float32)
+    out = mx.nd.sum(_nd(a), axis=1, exclude=True).asnumpy()
+    np.testing.assert_allclose(out, a.sum(axis=(0, 2)), rtol=1e-5)
+    out = mx.nd.sum(_nd(a), axis=(0, 2), exclude=True, keepdims=True).asnumpy()
+    np.testing.assert_allclose(out, a.sum(axis=1, keepdims=True), rtol=1e-5)
+
+
+# ---------------------------------------------------------------- ordering
+
+def test_topk_matrix():
+    a = RNG.uniform(-5, 5, (3, 6)).astype(np.float32)
+    for axis in (0, 1, -1):
+        for k in (1, 2):
+            for is_ascend in (False, True):
+                vals = mx.nd.topk(_nd(a), axis=axis, k=k, ret_typ="value",
+                                  is_ascend=is_ascend).asnumpy()
+                srt = np.sort(a, axis=axis)
+                if not is_ascend:
+                    srt = np.flip(srt, axis=axis)
+                expect = np.take(srt, np.arange(k), axis=axis if axis >= 0
+                                 else a.ndim + axis)
+                np.testing.assert_allclose(
+                    vals, expect, rtol=1e-6,
+                    err_msg="axis=%s k=%d asc=%s" % (axis, k, is_ascend))
+    # indices typ must index back to the values
+    idx = mx.nd.topk(_nd(a), axis=1, k=3, ret_typ="indices").asnumpy()
+    vals = mx.nd.topk(_nd(a), axis=1, k=3, ret_typ="value").asnumpy()
+    np.testing.assert_allclose(
+        np.take_along_axis(a, idx.astype(int), axis=1), vals, rtol=1e-6)
+    # mask typ: k ones per row
+    mask = mx.nd.topk(_nd(a), axis=1, k=2, ret_typ="mask").asnumpy()
+    assert mask.shape == a.shape
+    np.testing.assert_array_equal(mask.sum(axis=1), np.full(3, 2.0))
+    assert set(np.unique(mask)) <= {0.0, 1.0}
+    # both: (values, indices) pair
+    out = mx.nd.topk(_nd(a), axis=1, k=2, ret_typ="both")
+    v, i = out[0].asnumpy(), out[1].asnumpy()
+    np.testing.assert_allclose(
+        np.take_along_axis(a, i.astype(int), axis=1), v, rtol=1e-6)
+
+
+def test_sort_argsort_matrix():
+    a = RNG.uniform(-5, 5, (3, 5)).astype(np.float32)
+    for axis in (0, 1, -1):
+        for is_ascend in (True, False):
+            out = mx.nd.sort(_nd(a), axis=axis, is_ascend=is_ascend).asnumpy()
+            expect = np.sort(a, axis=axis)
+            if not is_ascend:
+                expect = np.flip(expect, axis=axis)
+            np.testing.assert_allclose(out, expect, rtol=1e-6)
+            idx = mx.nd.argsort(_nd(a), axis=axis,
+                                is_ascend=is_ascend).asnumpy()
+            np.testing.assert_allclose(
+                np.take_along_axis(a, idx.astype(int),
+                                   axis=axis if axis >= 0 else a.ndim + axis),
+                expect, rtol=1e-6)
+    # axis=None flattens (reference sort axis=None)
+    out = mx.nd.sort(_nd(a), axis=None).asnumpy()
+    np.testing.assert_allclose(out.ravel(), np.sort(a, axis=None), rtol=1e-6)
+
+
+def test_argmax_argmin_matrix():
+    a = RNG.uniform(-5, 5, (3, 4)).astype(np.float32)
+    for axis in (0, 1):
+        for keepdims in (False, True):
+            out = mx.nd.argmax(_nd(a), axis=axis, keepdims=keepdims).asnumpy()
+            expect = a.argmax(axis=axis)
+            if keepdims:
+                expect = np.expand_dims(expect, axis)
+            np.testing.assert_array_equal(out, expect.astype(np.float32))
+            out = mx.nd.argmin(_nd(a), axis=axis, keepdims=keepdims).asnumpy()
+            expect = a.argmin(axis=axis)
+            if keepdims:
+                expect = np.expand_dims(expect, axis)
+            np.testing.assert_array_equal(out, expect.astype(np.float32))
+    # ties resolve to the FIRST occurrence (reference semantics)
+    t = np.array([[1.0, 3.0, 3.0]], np.float32)
+    assert mx.nd.argmax(_nd(t), axis=1).asnumpy()[0] == 1.0
+    # argmax_channel == argmax over axis 1
+    c = RNG.uniform(-1, 1, (2, 3, 2)).astype(np.float32)
+    np.testing.assert_array_equal(
+        mx.nd.argmax_channel(_nd(c)).asnumpy(),
+        c.argmax(axis=1).astype(np.float32))
+
+
+# ---------------------------------------------------------------- take/scatter
+
+def test_take_mode_matrix():
+    a = RNG.uniform(-1, 1, (4, 3)).astype(np.float32)
+    # in-range, axis 0 (default)
+    idx = np.array([0, 3, 1], np.float32)
+    np.testing.assert_allclose(
+        mx.nd.take(_nd(a), _nd(idx)).asnumpy(), a[[0, 3, 1]], rtol=1e-6)
+    # clip mode: out-of-range clamps to the edge
+    idx = np.array([-2, 9], np.float32)
+    np.testing.assert_allclose(
+        mx.nd.take(_nd(a), _nd(idx), mode="clip").asnumpy(), a[[0, 3]],
+        rtol=1e-6)
+    # wrap mode: modular indexing
+    np.testing.assert_allclose(
+        mx.nd.take(_nd(a), _nd(np.array([5, -1], np.float32)),
+                   mode="wrap").asnumpy(),
+        a[[1, 3]], rtol=1e-6)
+    # axis=1
+    np.testing.assert_allclose(
+        mx.nd.take(_nd(a), _nd(np.array([2, 0], np.float32)),
+                   axis=1).asnumpy(),
+        a[:, [2, 0]], rtol=1e-6)
+    # 2-d indices produce stacked slices
+    idx2 = np.array([[0, 1], [2, 3]], np.float32)
+    np.testing.assert_allclose(
+        mx.nd.take(_nd(a), _nd(idx2)).asnumpy(), a[idx2.astype(int)],
+        rtol=1e-6)
+
+
+def test_gather_scatter_nd_roundtrip():
+    a = RNG.uniform(-1, 1, (3, 4)).astype(np.float32)
+    idx = np.array([[0, 1, 2], [1, 3, 0]], np.float32)  # (index-ndim, N)
+    picked = mx.nd.gather_nd(_nd(a), _nd(idx)).asnumpy()
+    np.testing.assert_allclose(picked, a[[0, 1, 2], [1, 3, 0]], rtol=1e-6)
+    back = mx.nd.scatter_nd(_nd(picked), _nd(idx), shape=(3, 4)).asnumpy()
+    expect = np.zeros((3, 4), np.float32)
+    expect[[0, 1, 2], [1, 3, 0]] = picked
+    np.testing.assert_allclose(back, expect, rtol=1e-6)
+
+
+def test_one_hot_matrix():
+    idx = np.array([1, 0, 3], np.float32)
+    out = mx.nd.one_hot(_nd(idx), depth=4).asnumpy()
+    np.testing.assert_array_equal(out, np.eye(4, dtype=np.float32)[[1, 0, 3]])
+    out = mx.nd.one_hot(_nd(idx), depth=4, on_value=2.0,
+                        off_value=-1.0).asnumpy()
+    expect = np.full((3, 4), -1.0, np.float32)
+    expect[np.arange(3), [1, 0, 3]] = 2.0
+    np.testing.assert_array_equal(out, expect)
+
+
+# ---------------------------------------------------------------- linalg
+
+def test_linalg_gemm_transpose_matrix():
+    a = RNG.uniform(-1, 1, (2, 3)).astype(np.float32)
+    b = RNG.uniform(-1, 1, (3, 4)).astype(np.float32)
+    c = RNG.uniform(-1, 1, (2, 4)).astype(np.float32)
+    for ta in (False, True):
+        for tb in (False, True):
+            aa = a.T if ta else a
+            bb = b.T if tb else b
+            out = mx.nd.linalg_gemm(
+                _nd(aa), _nd(bb), _nd(c), transpose_a=ta, transpose_b=tb,
+                alpha=1.3, beta=0.6).asnumpy()
+            np.testing.assert_allclose(out, 1.3 * (a @ b) + 0.6 * c,
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg="ta=%s tb=%s" % (ta, tb))
+    # batched (reference la_op supports leading batch dims)
+    ab = RNG.uniform(-1, 1, (2, 2, 3)).astype(np.float32)
+    bb = RNG.uniform(-1, 1, (2, 3, 2)).astype(np.float32)
+    out = mx.nd.linalg_gemm2(_nd(ab), _nd(bb)).asnumpy()
+    np.testing.assert_allclose(out, ab @ bb, rtol=1e-4, atol=1e-5)
+
+
+def test_linalg_triangular_matrix():
+    L = np.tril(RNG.uniform(0.5, 1.5, (3, 3))).astype(np.float32)
+    B = RNG.uniform(-1, 1, (3, 3)).astype(np.float32)
+    for rightside in (False, True):
+        for transpose in (False, True):
+            Lop = L.T if transpose else L
+            expect = (B @ Lop) if rightside else (Lop @ B)
+            out = mx.nd.linalg_trmm(_nd(L), _nd(B), transpose=transpose,
+                                    rightside=rightside).asnumpy()
+            np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5,
+                                       err_msg="r=%s t=%s"
+                                       % (rightside, transpose))
+            out = mx.nd.linalg_trsm(_nd(L), _nd(expect), transpose=transpose,
+                                    rightside=rightside).asnumpy()
+            np.testing.assert_allclose(out, B, rtol=1e-3, atol=1e-4)
+
+
+def test_linalg_chol_family():
+    a = RNG.uniform(-1, 1, (3, 3)).astype(np.float32)
+    spd = (a @ a.T + 3 * np.eye(3)).astype(np.float32)
+    L = mx.nd.linalg_potrf(_nd(spd)).asnumpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    assert np.allclose(L, np.tril(L))
+    inv = mx.nd.linalg_potri(_nd(L)).asnumpy()
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    sld = mx.nd.linalg_sumlogdiag(_nd(L)).asnumpy()
+    np.testing.assert_allclose(sld, np.log(np.diag(L)).sum(), rtol=1e-5)
+    # syrk: alpha * A A^T / A^T A
+    out = mx.nd.linalg_syrk(_nd(a), transpose=False, alpha=0.5).asnumpy()
+    np.testing.assert_allclose(out, 0.5 * (a @ a.T), rtol=1e-4, atol=1e-5)
+    out = mx.nd.linalg_syrk(_nd(a), transpose=True).asnumpy()
+    np.testing.assert_allclose(out, a.T @ a, rtol=1e-4, atol=1e-5)
+
+
+def test_linalg_factorizations():
+    a = RNG.uniform(-1, 1, (2, 4)).astype(np.float32)
+    q, l = mx.nd.linalg_gelqf(_nd(a))
+    q, l = q.asnumpy(), l.asnumpy()
+    np.testing.assert_allclose(l @ q, a, rtol=1e-4, atol=1e-5)  # A = L Q
+    np.testing.assert_allclose(q @ q.T, np.eye(2), rtol=1e-4, atol=1e-5)
+    assert np.allclose(l, np.tril(l), atol=1e-6)
+    spd = a @ a.T + 2 * np.eye(2, dtype=np.float32)
+    u, w = mx.nd.linalg_syevd(_nd(spd))
+    u, w = u.asnumpy(), w.asnumpy()
+    # A = U^T diag(w) U, eigenvalues ascending
+    np.testing.assert_allclose(u.T @ np.diag(w) @ u, spd, rtol=1e-4,
+                               atol=1e-4)
+    assert w[0] <= w[1]
+    # diag helpers with offsets
+    m = RNG.uniform(-1, 1, (3, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        mx.nd.linalg_extractdiag(_nd(m), offset=1).asnumpy(),
+        np.diagonal(m, offset=1), rtol=1e-6)
+    v = np.array([1.0, 2.0], np.float32)
+    np.testing.assert_allclose(
+        mx.nd.linalg_makediag(_nd(v), offset=-1).asnumpy(),
+        np.diag(v, k=-1), rtol=1e-6)
+
+
+# ---------------------------------------------------------------- boxes/NMS
+
+def test_box_iou_values():
+    # corner format (x1,y1,x2,y2)
+    a = np.array([[0.0, 0.0, 2.0, 2.0]], np.float32)
+    b = np.array([[1.0, 1.0, 3.0, 3.0],    # overlap area 1, union 7
+                  [0.0, 0.0, 2.0, 2.0],    # identical
+                  [5.0, 5.0, 6.0, 6.0]],   # disjoint
+                 np.float32)
+    iou = mx.nd.contrib.box_iou(_nd(a), _nd(b), format="corner").asnumpy()
+    np.testing.assert_allclose(iou[0], [1.0 / 7.0, 1.0, 0.0], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_box_nms_suppression():
+    # rows: [class_id, score, x1, y1, x2, y2]
+    boxes = np.array([
+        [0, 0.9, 0.0, 0.0, 2.0, 2.0],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],   # heavy overlap with #1 -> suppressed
+        [0, 0.7, 5.0, 5.0, 7.0, 7.0],   # far away -> kept
+        [1, 0.6, 0.0, 0.0, 2.0, 2.0],   # other class -> kept (no force)
+    ], np.float32)
+    out = mx.nd.contrib.box_nms(
+        _nd(boxes[None]), overlap_thresh=0.5, coord_start=2, score_index=1,
+        id_index=0, force_suppress=False).asnumpy()[0]
+    kept_scores = sorted(out[out[:, 1] > 0][:, 1].tolist(), reverse=True)
+    np.testing.assert_allclose(kept_scores, [0.9, 0.7, 0.6], rtol=1e-5)
+    # force_suppress ignores class ids -> the 0.6 box dies too
+    out = mx.nd.contrib.box_nms(
+        _nd(boxes[None]), overlap_thresh=0.5, coord_start=2, score_index=1,
+        id_index=0, force_suppress=True).asnumpy()[0]
+    kept_scores = sorted(out[out[:, 1] > 0][:, 1].tolist(), reverse=True)
+    np.testing.assert_allclose(kept_scores, [0.9, 0.7], rtol=1e-5)
+    # valid_thresh drops low scores before NMS
+    out = mx.nd.contrib.box_nms(
+        _nd(boxes[None]), overlap_thresh=0.5, valid_thresh=0.65,
+        coord_start=2, score_index=1, id_index=0).asnumpy()[0]
+    assert (out[:, 1] > 0).sum() == 2  # 0.9 and 0.7 survive
+
+
+def test_bipartite_matching_values():
+    score = np.array([[0.9, 0.1], [0.8, 0.85]], np.float32)
+    rows, cols = mx.nd.contrib.bipartite_matching(_nd(score), threshold=0.05)
+    rows = rows.asnumpy()
+    # greedy: (0,0)=0.9 first, then (1,1)=0.85
+    assert rows[0] == 0 and rows[1] == 1
+
+
+# ---------------------------------------------------------------- dtypes
+
+def test_dtype_propagation_matrix():
+    """Key compute ops preserve fp16/fp32 input dtype end to end (reference
+    test_operator's fp16 consistency checks). float64 is deliberately out:
+    TPUs have no f64 path and the framework downcasts unless the user
+    opts into jax_enable_x64 (documented in docs/faq/env_var.md)."""
+    for dt in ("float16", "float32"):
+        a = mx.nd.array(RNG.uniform(-1, 1, (2, 8)), dtype=dt)
+        w = mx.nd.array(RNG.uniform(-1, 1, (4, 8)), dtype=dt)
+        b = mx.nd.zeros((4,), dtype=dt)
+        out = mx.nd.FullyConnected(a, w, b, num_hidden=4)
+        assert out.dtype == np.dtype(dt), (dt, out.dtype)
+        out = mx.nd.softmax(a)
+        assert out.dtype == np.dtype(dt)
+        out = mx.nd.sum(a, axis=1)
+        assert out.dtype == np.dtype(dt)
+    # fp16 conv keeps fp16 out
+    x = mx.nd.array(RNG.uniform(-1, 1, (1, 2, 4, 4)), dtype="float16")
+    w = mx.nd.array(RNG.uniform(-1, 1, (2, 2, 3, 3)), dtype="float16")
+    b = mx.nd.zeros((2,), dtype="float16")
+    out = mx.nd.Convolution(x, w, b, kernel=(3, 3), num_filter=2)
+    assert out.dtype == np.float16
+    # Cast round-trips
+    x32 = mx.nd.array([[1.5, -2.25]], dtype="float32")
+    assert mx.nd.Cast(x32, dtype="float16").dtype == np.float16
+    np.testing.assert_allclose(
+        mx.nd.Cast(mx.nd.Cast(x32, dtype="float16"),
+                   dtype="float32").asnumpy(),
+        [[1.5, -2.25]])
+
+
+def test_embedding_and_take_dtype():
+    idx = mx.nd.array([0, 2], dtype="int32")
+    w = mx.nd.array(RNG.uniform(-1, 1, (4, 3)), dtype="float32")
+    out = mx.nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out.asnumpy(), w.asnumpy()[[0, 2]], rtol=1e-6)

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Flash-attention kernel validation + block-size sweep on real TPU.
+
+Run when a chip is available:
+    python tools/flash_tune.py            # full sweep @ S=4096
+    python tools/flash_tune.py --quick    # one config, parity only
+
+Per config it (1) compiles the Pallas fwd AND bwd kernels non-interpret,
+(2) checks parity against the blockwise jnp path at fp32 and bf16, and
+(3) reports fwd / fwd+bwd TFLOP/s — the numbers VERDICT r2 asked for
+(target >=70 TFLOP/s bf16 fwd at S=4096, D=128 on a v5e).
+
+Dedup-safe: every timed call gets a distinct q (the tunneled runtime
+caches byte-identical executions).
+"""
+import argparse
+import itertools
+import json
+import time
+
+import numpy as np
+
+
+def _parity(jax, jnp, flash, blockwise, dtype, tol):
+    """fwd+bwd agreement between the Pallas kernel and the jnp path."""
+    rng = np.random.RandomState(0)
+    B, H, S, D = 1, 2, 1024, 128
+    q, k, v = (jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32),
+                           dtype=dtype) for _ in range(3))
+
+    def loss_pallas(q, k, v):
+        return (flash(q, k, v, causal=True, use_pallas=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        out, _ = blockwise(q, k, v, causal=True, block_k=256)
+        return (out ** 2).sum()
+
+    gp = jax.jit(jax.grad(loss_pallas, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("q k v".split(), gp, gr):
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                    - b.astype(jnp.float32))))
+        scale = float(jnp.max(jnp.abs(b.astype(jnp.float32)))) + 1e-6
+        assert err / scale < tol, ("d%s rel err %.3g (tol %.3g, %s)"
+                                   % (name, err / scale, tol, dtype))
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--seq", type=int, default=4096)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.kernels.flash_attention import (
+        flash_attention, blockwise_attention, default_use_pallas)
+
+    dev = jax.devices()[0]
+    print("device:", dev.platform, getattr(dev, "device_kind", ""))
+    print("default_use_pallas:", default_use_pallas())
+    assert default_use_pallas(), "not on a TPU backend — nothing to tune"
+
+    print("parity fp32:", _parity(jax, jnp, flash_attention,
+                                  blockwise_attention, jnp.float32, 2e-3))
+    print("parity bf16:", _parity(jax, jnp, flash_attention,
+                                  blockwise_attention, jnp.bfloat16, 4e-2))
+    if args.quick:
+        return
+
+    B, H, S, D = 4, 8, args.seq, 128
+    rng = np.random.RandomState(0)
+    n_iter = 16
+    k = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32),
+                    jnp.bfloat16)
+    v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32),
+                    jnp.bfloat16)
+    qs = [jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32),
+                      jnp.bfloat16) for _ in range(n_iter)]
+    flops_fwd = 2 * 2 * B * H * S * S * D * 0.5  # causal halves the work
+
+    results = []
+    for bq, bk in itertools.product((256, 512, 1024, 2048), repeat=2):
+        if bq > S or bk > S:
+            continue
+        try:
+            fwd = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk,
+                use_pallas=True))
+            grad = jax.jit(jax.grad(
+                lambda q, k, v, bq=bq, bk=bk: (flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk,
+                    use_pallas=True) ** 2).sum(), argnums=(0, 1, 2)))
+            jax.block_until_ready([fwd(qs[0], k, v), grad(qs[0], k, v)])
+            tic = time.time()
+            jax.block_until_ready([fwd(q, k, v) for q in qs])
+            t_fwd = (time.time() - tic) / n_iter
+            tic = time.time()
+            jax.block_until_ready([grad(q, k, v) for q in qs])
+            t_bwd = (time.time() - tic) / n_iter
+            row = {"block_q": bq, "block_k": bk,
+                   "fwd_tflops": round(flops_fwd / t_fwd / 1e12, 2),
+                   "fwd_bwd_tflops": round(3.5 * flops_fwd / t_bwd / 1e12, 2)}
+        except Exception as e:
+            row = {"block_q": bq, "block_k": bk,
+                   "error": "%s: %s" % (type(e).__name__, str(e)[:120])}
+        print(json.dumps(row), flush=True)
+        results.append(row)
+
+    ok = [r for r in results if "fwd_tflops" in r]
+    if ok:
+        best = max(ok, key=lambda r: r["fwd_tflops"])
+        print("BEST:", json.dumps(best))
+
+
+if __name__ == "__main__":
+    main()
