@@ -394,8 +394,8 @@ EXCLUDED = {
     # sparse-storage plumbing (exercised in test_sparse)
     "cast_storage": "storage-format cast", "sparse_retain": "sparse-only",
     "_square_sum": "row_sparse reduction, tested in test_sparse",
-    # NDArray indexed-assignment plumbing (exercised via test_ndarray
-    # __setitem__ / autograd-through-assignment cases)
+    # NDArray indexed-assignment plumbing (exercised via
+    # test_operator_compat's setitem round trips)
     "_slice_assign": "ndarray setitem plumbing",
     "_slice_assign_scalar": "ndarray setitem plumbing",
     "_scatter_set_nd": "ndarray setitem plumbing",
@@ -424,7 +424,8 @@ EXCLUDED = {
     # test_operator_contrib_extra
     "_contrib_DeformableConvolution": "kinked sampling; fwd-parity-tested",
     "_contrib_DeformablePSROIPooling": "kinked sampling; fwd-parity-tested",
-    # image preprocessing (linear; value-tested in test_viz_and_data)
+    # image preprocessing (linear; value-tested in test_operator_compat's
+    # test_image_to_tensor_and_normalize)
     "_image_normalize": "linear preprocessing, value-tested",
     "_image_to_tensor": "layout cast, value-tested",
     # loss layers with custom head-gradient semantics — analytic checks in
@@ -435,10 +436,11 @@ EXCLUDED = {
     "MAERegressionOutput": "analytic (test_numeric_gradients)",
     "SVMOutput": "analytic grad test here",
     "WeightedL1": "analytic (test_numeric_gradients)",
-    "MultiLogistic": "loss output; analytic semantics in test_operator_extra",
-    "LSoftmax": "margin-softmax training op; convergence-tested in "
-                "test_operator_extra",
-    "CTCLoss": "grad vs torch.ctc_loss pinned in test_op_families",
+    "MultiLogistic": "loss output; forward+grad pinned in test_operator",
+    "LSoftmax": "margin-softmax training op; semantics pinned in "
+                "test_operator",
+    "CTCLoss": "loss vs torch.ctc_loss pinned in test_operator_extra "
+               "(test_ctc_loss_vs_torch)",
     # legacy step-function forwards: zero-grad asserted here
     "ceil": "zero-grad (test_zero_gradient_step_ops)",
     "floor": "zero-grad (test_zero_gradient_step_ops)",
