@@ -78,10 +78,13 @@ for _name, _opdef in list(OPS.items()):
     _GENERATED[_name] = _fn
     setattr(_this, _name, _fn)
 
-# aliases registered in the op registry
+# aliases registered in the op registry — also into _GENERATED so the
+# contrib namespace (keyed on "_contrib_<name>") resolves alias-only
+# contrib spellings like nd.contrib.ctc_loss
 from ..ops.registry import _ALIASES as _OP_ALIASES  # noqa: E402
 for _al, _target in _OP_ALIASES.items():
     if _target in _GENERATED:
+        _GENERATED.setdefault(_al, _GENERATED[_target])
         setattr(_this, _al, _GENERATED[_target])
 
 # snake_case mirrors of CamelCase ops that mxnet also exposes

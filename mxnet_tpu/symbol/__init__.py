@@ -105,6 +105,9 @@ for _name, _opdef in list(OPS.items()):
 
 for _al, _target in _OP_ALIASES.items():
     if _target in _GENERATED:
+        # into _GENERATED too: sym.contrib resolves "_contrib_<name>" keys,
+        # which may exist only as aliases (e.g. _contrib_ctc_loss)
+        _GENERATED.setdefault(_al, _GENERATED[_target])
         setattr(_this, _al, _GENERATED[_target])
 
 

@@ -334,6 +334,15 @@ def test_loss_output_layers_analytic():
     assert np.isfinite(g).all()
     # gradient must push the true-class score up (negative grad component)
     assert (g[np.arange(3), label.astype(int)] <= 0).all()
+    # MultiLogistic (fork op): backward = scale*(sig-l)*(l*w + (1-l))
+    # — multi_logistic-inl.h Backward with per-positive weighting
+    label3 = (RNG.uniform(0, 1, (3, 4)) > 0.5).astype(np.float32)
+    g = run(mx.sym.MultiLogistic(X, lab, name="m", grad_scale=0.5,
+                                 weight=3.0), label3, (3, 4))
+    sig = 1 / (1 + np.exp(-x))
+    want = 0.5 * ((sig - label3) * label3 * 3.0
+                  + (sig - label3) * (1 - label3))
+    np.testing.assert_allclose(g, want, rtol=1e-4, atol=1e-5)
 
 
 # --------------------------------------------------------------------------
@@ -436,7 +445,7 @@ EXCLUDED = {
     "MAERegressionOutput": "analytic (test_numeric_gradients)",
     "SVMOutput": "analytic grad test here",
     "WeightedL1": "analytic (test_numeric_gradients)",
-    "MultiLogistic": "loss output; forward+grad pinned in test_operator",
+    "MultiLogistic": "analytic grad test here",
     "LSoftmax": "margin-softmax training op; semantics pinned in "
                 "test_operator",
     "CTCLoss": "loss vs torch.ctc_loss pinned in test_operator_extra "

@@ -54,10 +54,13 @@ def test_get_rec_iter_benchmark_mode():
 def test_launch_local_spawns_workers(tmp_path):
     """local launcher must run N processes with rank envs set."""
     script = tmp_path / "worker.py"
+    # both workers share the parent's stdout pipe: emit the line as ONE
+    # write() (atomic for < PIPE_BUF) so concurrent workers can't interleave
+    # mid-line the way multi-arg print()'s several writes can under load
     script.write_text(
-        "import os\n"
-        "print('RANK', os.environ['JAX_PROCESS_ID'],\n"
-        "      os.environ['JAX_NUM_PROCESSES'])\n")
+        "import os, sys\n"
+        "sys.stdout.write('RANK %s %s\\n' % (os.environ['JAX_PROCESS_ID'],\n"
+        "                 os.environ['JAX_NUM_PROCESSES']))\n")
     for attempt in range(2):  # retried once: interpreter start is
         try:                  # load-sensitive when the suite runs parallel
             out = subprocess.run(

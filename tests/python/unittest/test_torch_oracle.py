@@ -1,0 +1,394 @@
+"""Independent-numerics oracle: core NN ops vs torch (CPU) — forward AND
+backward. The reference validated its C++/CUDA kernels against hand-written
+CPU references (tests/python/unittest/test_operator.py patterns); here the
+oracle is an entirely separate framework, which also pins the *conventions*
+(padding, pooling ceil-mode, normalization axes, gate math) rather than just
+the arithmetic.
+
+Every case runs the symbol through a simple_bind executor (fwd train +
+backward with a fixed head gradient) and the analogous torch graph, then
+compares outputs and input/weight gradients.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+_RTOL, _ATOL = 2e-4, 2e-4
+
+
+def _run_mx(sym, arrays, out_grad):
+    """fwd(train) + bwd; returns (out, {name: grad})."""
+    exe = sym.simple_bind(mx.cpu(), grad_req="write",
+                          **{k: v.shape for k, v in arrays.items()})
+    for k, v in arrays.items():
+        exe.arg_dict[k][:] = v
+    out = exe.forward(is_train=True)[0]
+    exe.backward(out_grads=mx.nd.array(out_grad))
+    return (out.asnumpy(),
+            {k: g.asnumpy() for k, g in exe.grad_dict.items()})
+
+
+def _torch_leaf(v):
+    t = torch.tensor(v, dtype=torch.float32, requires_grad=True)
+    return t
+
+
+def _assert_close(a, b, what, rtol=_RTOL, atol=_ATOL):
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol, err_msg=what)
+
+
+# ---------------------------------------------------------------- conv ----
+
+
+@pytest.mark.parametrize("stride,pad,dilate,groups", [
+    ((1, 1), (0, 0), (1, 1), 1),
+    ((2, 2), (1, 1), (1, 1), 1),
+    ((1, 2), (2, 1), (1, 1), 1),
+    ((1, 1), (1, 1), (2, 2), 1),
+    ((1, 1), (1, 1), (1, 1), 2),
+    ((2, 1), (0, 2), (2, 1), 2),
+])
+def test_convolution_vs_torch(stride, pad, dilate, groups):
+    rng = np.random.RandomState(hash((stride, pad, dilate, groups)) % 2**31)
+    n, cin, cout, hw, k = 2, 4, 6, 9, 3
+    x = rng.normal(size=(n, cin, hw, hw)).astype(np.float32)
+    w = rng.normal(size=(cout, cin // groups, k, k)).astype(np.float32)
+    b = rng.normal(size=(cout,)).astype(np.float32)
+
+    sym = mx.sym.Convolution(mx.sym.Variable("x"), kernel=(k, k),
+                             num_filter=cout, stride=stride, pad=pad,
+                             dilate=dilate, num_group=groups, name="c")
+    tx, tw, tb = _torch_leaf(x), _torch_leaf(w), _torch_leaf(b)
+    ty = F.conv2d(tx, tw, tb, stride=stride, padding=pad, dilation=dilate,
+                  groups=groups)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+
+    out, grads = _run_mx(sym, {"x": x, "c_weight": w, "c_bias": b}, og)
+    _assert_close(out, ty.detach().numpy(), "conv fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), "conv dx")
+    _assert_close(grads["c_weight"], tw.grad.numpy(), "conv dw")
+    _assert_close(grads["c_bias"], tb.grad.numpy(), "conv db")
+
+
+def test_convolution_1d_3d_vs_torch():
+    rng = np.random.RandomState(7)
+    # 1d
+    x = rng.normal(size=(2, 3, 12)).astype(np.float32)
+    w = rng.normal(size=(5, 3, 4)).astype(np.float32)
+    sym = mx.sym.Convolution(mx.sym.Variable("x"), kernel=(4,), num_filter=5,
+                             stride=(2,), pad=(1,), no_bias=True, name="c")
+    tx, tw = _torch_leaf(x), _torch_leaf(w)
+    ty = F.conv1d(tx, tw, stride=2, padding=1)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x, "c_weight": w}, og)
+    _assert_close(out, ty.detach().numpy(), "conv1d fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), "conv1d dx")
+    # 3d
+    x = rng.normal(size=(1, 2, 5, 6, 6)).astype(np.float32)
+    w = rng.normal(size=(3, 2, 2, 3, 3)).astype(np.float32)
+    sym = mx.sym.Convolution(mx.sym.Variable("x"), kernel=(2, 3, 3),
+                             num_filter=3, pad=(0, 1, 1), no_bias=True,
+                             name="c")
+    tx, tw = _torch_leaf(x), _torch_leaf(w)
+    ty = F.conv3d(tx, tw, padding=(0, 1, 1))
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x, "c_weight": w}, og)
+    _assert_close(out, ty.detach().numpy(), "conv3d fwd")
+    _assert_close(grads["c_weight"], tw.grad.numpy(), "conv3d dw")
+
+
+@pytest.mark.parametrize("stride,pad,adj", [
+    ((1, 1), (0, 0), (0, 0)),
+    ((2, 2), (1, 1), (0, 0)),
+    ((2, 2), (1, 1), (1, 1)),
+    ((3, 2), (0, 1), (1, 0)),
+])
+def test_deconvolution_vs_torch(stride, pad, adj):
+    rng = np.random.RandomState(11)
+    n, cin, cout, hw, k = 2, 4, 3, 6, 3
+    x = rng.normal(size=(n, cin, hw, hw)).astype(np.float32)
+    w = rng.normal(size=(cin, cout, k, k)).astype(np.float32)
+    sym = mx.sym.Deconvolution(mx.sym.Variable("x"), kernel=(k, k),
+                               num_filter=cout, stride=stride, pad=pad,
+                               adj=adj, no_bias=True, name="d")
+    tx, tw = _torch_leaf(x), _torch_leaf(w)
+    ty = F.conv_transpose2d(tx, tw, stride=stride, padding=pad,
+                            output_padding=adj)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x, "d_weight": w}, og)
+    _assert_close(out, ty.detach().numpy(), "deconv fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), "deconv dx")
+    _assert_close(grads["d_weight"], tw.grad.numpy(), "deconv dw")
+
+
+# ------------------------------------------------------------- pooling ----
+
+
+@pytest.mark.parametrize("pool_type,kernel,stride,pad,convention", [
+    ("max", (2, 2), (2, 2), (0, 0), "valid"),
+    ("max", (3, 3), (2, 2), (1, 1), "valid"),
+    ("max", (3, 3), (2, 2), (0, 0), "full"),
+    ("avg", (2, 2), (2, 2), (0, 0), "valid"),
+    ("avg", (3, 3), (2, 2), (1, 1), "valid"),
+    ("avg", (3, 3), (2, 2), (1, 1), "full"),
+])
+def test_pooling_vs_torch(pool_type, kernel, stride, pad, convention):
+    rng = np.random.RandomState(3)
+    x = rng.normal(size=(2, 3, 9, 9)).astype(np.float32)
+    sym = mx.sym.Pooling(mx.sym.Variable("x"), pool_type=pool_type,
+                         kernel=kernel, stride=stride, pad=pad,
+                         pooling_convention=convention)
+    tx = _torch_leaf(x)
+    ceil = convention == "full"
+    if pool_type == "max":
+        ty = F.max_pool2d(tx, kernel, stride, pad, ceil_mode=ceil)
+    else:
+        # reference avg pooling divides by the full kernel area incl. pad
+        ty = F.avg_pool2d(tx, kernel, stride, pad, ceil_mode=ceil,
+                          count_include_pad=True)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x}, og)
+    _assert_close(out, ty.detach().numpy(), "pool fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), "pool dx")
+
+
+def test_global_pooling_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.normal(size=(2, 5, 7, 7)).astype(np.float32)
+    for pool_type, tfn in (("max", F.adaptive_max_pool2d),
+                           ("avg", F.adaptive_avg_pool2d)):
+        sym = mx.sym.Pooling(mx.sym.Variable("x"), global_pool=True,
+                             pool_type=pool_type, kernel=(1, 1))
+        tx = _torch_leaf(x)
+        ty = tfn(tx, 1)
+        og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+        ty.backward(torch.tensor(og))
+        out, grads = _run_mx(sym, {"x": x}, og)
+        _assert_close(out, ty.detach().numpy(), "gpool fwd " + pool_type)
+        _assert_close(grads["x"], tx.grad.numpy(), "gpool dx " + pool_type)
+
+
+# ---------------------------------------------------------------- norms ----
+
+
+def test_batchnorm_train_vs_torch():
+    rng = np.random.RandomState(5)
+    x = rng.normal(size=(4, 3, 5, 5)).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, (3,)).astype(np.float32)
+    beta = rng.normal(size=(3,)).astype(np.float32)
+    eps = 1e-3
+    sym = mx.sym.BatchNorm(mx.sym.Variable("x"), fix_gamma=False, eps=eps,
+                           name="bn")
+    tx, tg, tb = _torch_leaf(x), _torch_leaf(gamma), _torch_leaf(beta)
+    ty = F.batch_norm(tx, torch.zeros(3), torch.ones(3), tg, tb,
+                      training=True, eps=eps)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(
+        sym, {"x": x, "bn_gamma": gamma, "bn_beta": beta}, og)
+    _assert_close(out, ty.detach().numpy(), "bn fwd", rtol=1e-3, atol=1e-3)
+    _assert_close(grads["x"], tx.grad.numpy(), "bn dx", rtol=1e-3, atol=1e-3)
+    _assert_close(grads["bn_gamma"], tg.grad.numpy(), "bn dgamma",
+                  rtol=1e-3, atol=1e-3)
+    _assert_close(grads["bn_beta"], tb.grad.numpy(), "bn dbeta",
+                  rtol=1e-3, atol=1e-3)
+
+
+def test_layernorm_vs_torch():
+    rng = np.random.RandomState(6)
+    x = rng.normal(size=(4, 10)).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, (10,)).astype(np.float32)
+    beta = rng.normal(size=(10,)).astype(np.float32)
+    eps = 1e-5
+    sym = mx.sym.LayerNorm(mx.sym.Variable("x"), eps=eps, name="ln")
+    tx, tg, tb = _torch_leaf(x), _torch_leaf(gamma), _torch_leaf(beta)
+    ty = F.layer_norm(tx, (10,), tg, tb, eps=eps)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(
+        sym, {"x": x, "ln_gamma": gamma, "ln_beta": beta}, og)
+    _assert_close(out, ty.detach().numpy(), "ln fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), "ln dx")
+    _assert_close(grads["ln_gamma"], tg.grad.numpy(), "ln dgamma")
+    _assert_close(grads["ln_beta"], tb.grad.numpy(), "ln dbeta")
+
+
+def test_instancenorm_vs_torch():
+    rng = np.random.RandomState(8)
+    x = rng.normal(size=(3, 4, 6, 6)).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, (4,)).astype(np.float32)
+    beta = rng.normal(size=(4,)).astype(np.float32)
+    sym = mx.sym.InstanceNorm(mx.sym.Variable("x"), name="in_")
+    tx, tg, tb = _torch_leaf(x), _torch_leaf(gamma), _torch_leaf(beta)
+    ty = F.instance_norm(tx, weight=tg, bias=tb, eps=1e-3)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(
+        sym, {"x": x, "in__gamma": gamma, "in__beta": beta}, og)
+    _assert_close(out, ty.detach().numpy(), "in fwd", rtol=1e-3, atol=1e-3)
+    _assert_close(grads["x"], tx.grad.numpy(), "in dx", rtol=1e-3, atol=1e-3)
+
+
+def test_lrn_vs_torch():
+    rng = np.random.RandomState(9)
+    x = rng.normal(size=(2, 8, 5, 5)).astype(np.float32)
+    nsize, alpha, beta_p, knorm = 5, 1e-3, 0.75, 2.0
+    sym = mx.sym.LRN(mx.sym.Variable("x"), nsize=nsize, alpha=alpha,
+                     beta=beta_p, knorm=knorm)
+    tx = _torch_leaf(x)
+    ty = F.local_response_norm(tx, nsize, alpha=alpha, beta=beta_p, k=knorm)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x}, og)
+    _assert_close(out, ty.detach().numpy(), "lrn fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), "lrn dx")
+
+
+# ------------------------------------------------------ softmax / loss ----
+
+
+@pytest.mark.parametrize("axis", [-1, 0, 1])
+def test_softmax_log_softmax_vs_torch(axis):
+    rng = np.random.RandomState(10)
+    x = rng.normal(size=(4, 7)).astype(np.float32)
+    for mx_op, t_fn in ((mx.sym.softmax, F.softmax),
+                        (mx.sym.log_softmax, F.log_softmax)):
+        sym = mx_op(mx.sym.Variable("x"), axis=axis)
+        tx = _torch_leaf(x)
+        ty = t_fn(tx, dim=axis)
+        og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+        ty.backward(torch.tensor(og))
+        out, grads = _run_mx(sym, {"x": x}, og)
+        _assert_close(out, ty.detach().numpy(), "softmax fwd")
+        _assert_close(grads["x"], tx.grad.numpy(), "softmax dx")
+
+
+def test_softmax_cross_entropy_grad_vs_torch():
+    """SoftmaxOutput's fused backward (p - onehot) vs torch's
+    cross_entropy autograd through log_softmax+nll."""
+    rng = np.random.RandomState(12)
+    x = rng.normal(size=(6, 5)).astype(np.float32)
+    label = rng.randint(0, 5, (6,)).astype(np.float32)
+    sym = mx.sym.SoftmaxOutput(mx.sym.Variable("x"),
+                               mx.sym.Variable("softmax_label"))
+    exe = sym.simple_bind(mx.cpu(), grad_req="write", x=x.shape,
+                          softmax_label=label.shape)
+    exe.arg_dict["x"][:] = x
+    exe.arg_dict["softmax_label"][:] = label
+    exe.forward(is_train=True)
+    exe.backward()
+    tx = _torch_leaf(x)
+    loss = F.cross_entropy(tx, torch.tensor(label, dtype=torch.long),
+                           reduction="sum")
+    loss.backward()
+    # SoftmaxOutput backward is (p - onehot), un-normalized by default
+    _assert_close(exe.grad_dict["x"].asnumpy(), tx.grad.numpy(),
+                  "softmax_output dx")
+
+
+# ---------------------------------------------------- misc core layers ----
+
+
+def test_fully_connected_vs_torch():
+    rng = np.random.RandomState(13)
+    x = rng.normal(size=(5, 8)).astype(np.float32)
+    w = rng.normal(size=(4, 8)).astype(np.float32)
+    b = rng.normal(size=(4,)).astype(np.float32)
+    sym = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=4, name="fc")
+    tx, tw, tb = _torch_leaf(x), _torch_leaf(w), _torch_leaf(b)
+    ty = F.linear(tx, tw, tb)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x, "fc_weight": w, "fc_bias": b}, og)
+    _assert_close(out, ty.detach().numpy(), "fc fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), "fc dx")
+    _assert_close(grads["fc_weight"], tw.grad.numpy(), "fc dw")
+    _assert_close(grads["fc_bias"], tb.grad.numpy(), "fc db")
+
+
+def test_embedding_grad_vs_torch():
+    rng = np.random.RandomState(14)
+    idx = rng.randint(0, 10, (4, 3)).astype(np.float32)
+    w = rng.normal(size=(10, 6)).astype(np.float32)
+    sym = mx.sym.Embedding(mx.sym.Variable("x"), input_dim=10, output_dim=6,
+                           name="emb")
+    tw = _torch_leaf(w)
+    ty = F.embedding(torch.tensor(idx, dtype=torch.long), tw)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    exe = sym.simple_bind(mx.cpu(), grad_req="write", x=idx.shape,
+                          emb_weight=w.shape)
+    exe.arg_dict["x"][:] = idx
+    exe.arg_dict["emb_weight"][:] = w
+    out = exe.forward(is_train=True)[0]
+    exe.backward(out_grads=mx.nd.array(og))
+    _assert_close(out.asnumpy(), ty.detach().numpy(), "embedding fwd")
+    _assert_close(exe.grad_dict["emb_weight"].asnumpy(), tw.grad.numpy(),
+                  "embedding dw")
+
+
+@pytest.mark.parametrize("act,t_fn", [
+    ("relu", F.relu),
+    ("sigmoid", torch.sigmoid),
+    ("tanh", torch.tanh),
+    ("softrelu", F.softplus),
+])
+def test_activation_vs_torch(act, t_fn):
+    rng = np.random.RandomState(15)
+    x = rng.normal(size=(4, 9)).astype(np.float32)
+    sym = mx.sym.Activation(mx.sym.Variable("x"), act_type=act)
+    tx = _torch_leaf(x)
+    ty = t_fn(tx)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x}, og)
+    _assert_close(out, ty.detach().numpy(), act + " fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), act + " dx")
+
+
+def test_leaky_elu_vs_torch():
+    rng = np.random.RandomState(16)
+    x = rng.normal(size=(4, 9)).astype(np.float32)
+    for act, t_fn in (("leaky", lambda t: F.leaky_relu(t, 0.25)),
+                      ("elu", lambda t: F.elu(t, 0.25))):
+        sym = mx.sym.LeakyReLU(mx.sym.Variable("x"), act_type=act,
+                               slope=0.25)
+        tx = _torch_leaf(x)
+        ty = t_fn(tx)
+        og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+        ty.backward(torch.tensor(og))
+        out, grads = _run_mx(sym, {"x": x}, og)
+        _assert_close(out, ty.detach().numpy(), act + " fwd")
+        _assert_close(grads["x"], tx.grad.numpy(), act + " dx")
+
+
+def test_smooth_l1_vs_torch():
+    rng = np.random.RandomState(17)
+    x = rng.normal(scale=2.0, size=(5, 4)).astype(np.float32)
+    sym = mx.sym.smooth_l1(mx.sym.Variable("x"), scalar=1.0)
+    tx = _torch_leaf(x)
+    ty = F.smooth_l1_loss(tx, torch.zeros_like(tx), reduction="none",
+                          beta=1.0)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x}, og)
+    _assert_close(out, ty.detach().numpy(), "smooth_l1 fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), "smooth_l1 dx")
+
+
+def test_contrib_ctc_namespace_resolves():
+    """nd.contrib.ctc_loss / sym.contrib.CTCLoss resolve through the alias
+    table (full numerics vs torch.ctc_loss live in test_operator_extra's
+    test_ctc_loss_vs_torch)."""
+    assert callable(mx.nd.contrib.ctc_loss)
+    assert callable(mx.nd.contrib.CTCLoss)
+    assert callable(mx.sym.contrib.ctc_loss)
