@@ -178,8 +178,7 @@ def main():
     if not force_cpu and wedged:
         _cpu_rescue(phases, "TPU wedged mid-run; cpu rescue")
     elif not force_cpu and "infer" not in results:
-        _cpu_rescue(["infer", "train_fp32", "jax_baseline", "flash",
-                     "io_train"], "TPU died after probe; cpu rescue")
+        _cpu_rescue(phases, "TPU died after probe; cpu rescue")
 
     # 4) merge
     infer = results.get("infer", {})
@@ -188,6 +187,10 @@ def main():
                   "io_train"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
                       if k != "_platform"})
+    # mixed-platform runs (partial rescue): say which metric ran where
+    plats = {ph: r.get("_platform") for ph, r in results.items()}
+    if len(set(plats.values())) > 1:
+        extra["phase_platforms"] = plats
     if "train_img_per_sec" in extra:
         extra["train_vs_baseline"] = round(
             extra["train_img_per_sec"] / BASELINE_TRAIN_P100, 3)

@@ -134,3 +134,14 @@ def test_onehot_encode_out_of_range_raises():
     out = mx.nd.empty((1, 4))
     with pytest.raises(Exception):
         mx.nd.onehot_encode(mx.nd.array([5.0]), out)
+
+
+def test_contrib_alias_namespace_resolves():
+    """Ops registered only under `_contrib_*` ALIASES (not primary names)
+    must still resolve through nd.contrib/sym.contrib — regression guard
+    for the alias->_GENERATED wiring in ndarray/__init__ and
+    symbol/__init__ (e.g. CTCLoss's `_contrib_ctc_loss` spelling)."""
+    assert callable(mx.nd.contrib.ctc_loss)
+    assert callable(mx.nd.contrib.CTCLoss)
+    assert callable(mx.sym.contrib.ctc_loss)
+    assert callable(mx.sym.contrib.CTCLoss)

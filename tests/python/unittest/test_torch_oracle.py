@@ -385,10 +385,75 @@ def test_smooth_l1_vs_torch():
     _assert_close(grads["x"], tx.grad.numpy(), "smooth_l1 dx")
 
 
-def test_contrib_ctc_namespace_resolves():
-    """nd.contrib.ctc_loss / sym.contrib.CTCLoss resolve through the alias
-    table (full numerics vs torch.ctc_loss live in test_operator_extra's
-    test_ctc_loss_vs_torch)."""
-    assert callable(mx.nd.contrib.ctc_loss)
-    assert callable(mx.nd.contrib.CTCLoss)
-    assert callable(mx.sym.contrib.ctc_loss)
+# (contrib alias resolution for nd.contrib.ctc_loss & co. is pinned in
+# test_api_parity.py::test_contrib_alias_namespace_resolves — torch-free,
+# so it survives environments where this whole module importorskips)
+
+
+# ------------------------------------------------------------- fused RNN ----
+
+
+def _pack_torch_rnn(mod, layers, dirs):
+    """Flatten torch RNN weights into the reference packed-parameter layout:
+    all weights (layer-major, direction-minor, i2h then h2h), then all
+    biases in the same order (rnn-inl.h packing; gate orders already agree:
+    LSTM i,f,g,o / GRU r,z,n)."""
+    flats, names = [], []
+    for kind in ("weight", "bias"):
+        for li in range(layers):
+            for suffix in ([""] if dirs == 1 else ["", "_reverse"]):
+                for part in ("ih", "hh"):
+                    names.append("%s_%s_l%d%s" % (kind, part, li, suffix))
+    for n in names:
+        flats.append(getattr(mod, n).detach().numpy().ravel())
+    return np.concatenate(flats).astype(np.float32), names
+
+
+@pytest.mark.parametrize("mode,layers,bidirectional", [
+    ("lstm", 1, False),
+    ("lstm", 2, False),
+    ("lstm", 1, True),
+    ("gru", 1, False),
+    ("gru", 2, True),
+    ("rnn_tanh", 1, False),
+    ("rnn_relu", 1, True),
+])
+def test_fused_rnn_vs_torch(mode, layers, bidirectional):
+    rng = np.random.RandomState(19)
+    T_, N, I, H = 5, 3, 4, 6
+    D = 2 if bidirectional else 1
+    x = rng.normal(size=(T_, N, I)).astype(np.float32)
+    h0 = rng.normal(size=(layers * D, N, H)).astype(np.float32)
+    c0 = rng.normal(size=(layers * D, N, H)).astype(np.float32)
+
+    tcls = {"lstm": torch.nn.LSTM, "gru": torch.nn.GRU,
+            "rnn_tanh": torch.nn.RNN, "rnn_relu": torch.nn.RNN}[mode]
+    kw = {} if mode in ("lstm", "gru") else {
+        "nonlinearity": mode.split("_")[1]}
+    tmod = tcls(I, H, num_layers=layers, bidirectional=bidirectional, **kw)
+    flat, names = _pack_torch_rnn(tmod, layers, D)
+
+    tx = _torch_leaf(x)
+    th0 = torch.tensor(h0)
+    if mode == "lstm":
+        ty, _ = tmod(tx, (th0, torch.tensor(c0)))
+    else:
+        ty, _ = tmod(tx, th0)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+
+    inputs = {"data": x, "parameters": flat, "state": h0}
+    if mode == "lstm":
+        inputs["state_cell"] = c0
+    sym = mx.sym.RNN(*[mx.sym.Variable(k) for k in inputs],
+                     state_size=H, num_layers=layers, mode=mode,
+                     bidirectional=bidirectional, name="rnn")
+    out, grads = _run_mx(sym, inputs, og)
+    _assert_close(out, ty.detach().numpy(), mode + " fwd",
+                  rtol=1e-3, atol=1e-3)
+    _assert_close(grads["data"], tx.grad.numpy(), mode + " dx",
+                  rtol=1e-3, atol=1e-3)
+    tgrad = np.concatenate([getattr(tmod, n).grad.numpy().ravel()
+                            for n in names]).astype(np.float32)
+    _assert_close(grads["parameters"], tgrad, mode + " dparams",
+                  rtol=1e-3, atol=2e-3)
