@@ -57,9 +57,24 @@ void* MXTIOCreateImageRecordIterEx(
     int rand_crop, int rand_mirror, int resize, int label_width,
     int round_batch, int prefetch_depth, const float* aug);
 
+/* Ex + output_uint8: when nonzero the iterator emits raw uint8 RGB planes
+ * (no normalization pass; 4x fewer bytes across the host->device link) and
+ * batches must be drained with MXTIONextU8. mean/stdv are recorded but the
+ * consumer is expected to fold them into the accelerator graph. */
+void* MXTIOCreateImageRecordIterEx2(
+    const char* path_imgrec, int batch_size, int channels, int height,
+    int width, int preprocess_threads, int shuffle, unsigned seed,
+    int num_parts, int part_index, const float* mean, const float* stdv,
+    int rand_crop, int rand_mirror, int resize, int label_width,
+    int round_batch, int prefetch_depth, const float* aug,
+    int output_uint8);
+
 /* Fill data_out [batch*c*h*w] and label_out [batch*label_width].
  * Returns pad count (>=0), -1 at epoch end, -2 on error. */
 int MXTIONext(void* handle, float* data_out, float* label_out);
+
+/* uint8-mode drain (iterator created with output_uint8 != 0). */
+int MXTIONextU8(void* handle, unsigned char* data_out, float* label_out);
 
 /* Rewind to the start of the epoch (reshuffles if enabled). */
 void MXTIOReset(void* handle);

@@ -60,10 +60,17 @@ def _load():
         lib.MXTIOCreateImageRecordIterEx.argtypes = (
             lib.MXTIOCreateImageRecordIter.argtypes
             + [ctypes.POINTER(ctypes.c_float)])
+        lib.MXTIOCreateImageRecordIterEx2.restype = ctypes.c_void_p
+        lib.MXTIOCreateImageRecordIterEx2.argtypes = (
+            lib.MXTIOCreateImageRecordIterEx.argtypes + [ctypes.c_int])
         lib.MXTIONext.restype = ctypes.c_int
         lib.MXTIONext.argtypes = [ctypes.c_void_p,
                                   ctypes.POINTER(ctypes.c_float),
                                   ctypes.POINTER(ctypes.c_float)]
+        lib.MXTIONextU8.restype = ctypes.c_int
+        lib.MXTIONextU8.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint8),
+                                    ctypes.POINTER(ctypes.c_float)]
         lib.MXTIOReset.argtypes = [ctypes.c_void_p]
         lib.MXTIONumSamples.restype = ctypes.c_longlong
         lib.MXTIONumSamples.argtypes = [ctypes.c_void_p]

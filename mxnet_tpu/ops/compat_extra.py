@@ -297,10 +297,12 @@ class ImageNormalizeParam(Params):
 @register_op("_image_normalize", param_cls=ImageNormalizeParam,
              input_names=("data",))
 def _image_normalize(params, data):
-    """(data - mean) / std over the leading channel axis (CHW)."""
+    """(data - mean) / std over the channel axis: CHW for 3-d input,
+    NCHW for 4-d (reference image_random.cc Normalize supports both)."""
     mean = jnp.asarray(params.mean, data.dtype)
     std = jnp.asarray(params.std, data.dtype)
-    shape = (-1,) + (1,) * (data.ndim - 1)
+    # channel axis is ndim-3 (0 for CHW, 1 for NCHW)
+    shape = (1,) * (data.ndim - 3) + (-1, 1, 1)
     return (data - mean.reshape(shape)) / std.reshape(shape)
 
 

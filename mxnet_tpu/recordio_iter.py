@@ -36,7 +36,8 @@ class ImageRecordIter(DataIter):
                  brightness=0.0, contrast=0.0, saturation=0.0,
                  pca_noise=0.0, max_rotate_angle=0.0,
                  min_random_scale=1.0, max_random_scale=1.0,
-                 data_name="data", label_name="softmax_label", **kwargs):
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32", **kwargs):
         super().__init__(batch_size)
         if preprocess_threads is None:
             # reference: MXNET_CPU_WORKER_NTHREADS sizes the decode pool
@@ -51,25 +52,36 @@ class ImageRecordIter(DataIter):
         self.label_width = int(label_width)
         self.data_name = data_name
         self.label_name = label_name
+        if dtype not in ("float32", "uint8"):
+            raise MXNetError("ImageRecordIter: dtype must be float32 or "
+                             "uint8, got %r" % (dtype,))
+        # uint8 mode: raw RGB bytes over the host->device link (4x fewer
+        # bytes, no host normalization pass); mean/std are kept on
+        # `normalize_mean`/`normalize_std` for the consumer to fold into
+        # the device graph (e.g. via sym.cast + _image_normalize)
+        self.dtype = dtype
+        self.normalize_mean = (mean_r, mean_g, mean_b)
+        self.normalize_std = (std_r, std_g, std_b)
         c, h, w = data_shape
         mean = (ctypes.c_float * 3)(mean_r, mean_g, mean_b)
         std = (ctypes.c_float * 3)(std_r, std_g, std_b)
         aug = (ctypes.c_float * 7)(brightness, contrast, saturation,
                                    pca_noise, max_rotate_angle,
                                    min_random_scale, max_random_scale)
-        self._handle = self._lib.MXTIOCreateImageRecordIterEx(
+        self._handle = self._lib.MXTIOCreateImageRecordIterEx2(
             str(path_imgrec).encode(), int(batch_size), c, h, w,
             int(preprocess_threads), int(bool(shuffle)), int(seed),
             int(num_parts), int(part_index), mean, std,
             int(bool(rand_crop)), int(bool(rand_mirror)), int(resize),
             self.label_width, int(bool(round_batch)), int(prefetch_buffer),
-            aug)
+            aug, int(dtype == "uint8"))
         if not self._handle:
             raise MXNetError("ImageRecordIter: %s" % _native.last_error())
         # staging buffers from the pooled host allocator (storage.py /
         # src/storage/host_pool.cc) — page-aligned, reused across batches
         from . import storage as _storage
-        self._data_buf = _storage.empty((batch_size, c, h, w), _np.float32)
+        self._data_buf = _storage.empty((batch_size, c, h, w),
+                                        _np.dtype(dtype))
         self._label_buf = _storage.empty((batch_size, self.label_width),
                                          _np.float32)
         self._exhausted = False
@@ -77,7 +89,8 @@ class ImageRecordIter(DataIter):
     @property
     def provide_data(self):
         return [DataDesc(self.data_name,
-                         (self.batch_size,) + self.data_shape)]
+                         (self.batch_size,) + self.data_shape,
+                         dtype=_np.dtype(self.dtype))]
 
     @property
     def provide_label(self):
@@ -96,10 +109,18 @@ class ImageRecordIter(DataIter):
     def next(self):
         if self._exhausted:
             raise StopIteration
-        pad = self._lib.MXTIONext(
-            self._handle,
-            self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if self.dtype == "uint8":
+            pad = self._lib.MXTIONextU8(
+                self._handle,
+                self._data_buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint8)),
+                self._label_buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)))
+        else:
+            pad = self._lib.MXTIONext(
+                self._handle,
+                self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         if pad == -2:
             from . import _native
             raise MXNetError("ImageRecordIter: %s" % _native.last_error())

@@ -69,13 +69,19 @@ struct ImageRecParams {
   float max_rotate_angle = 0.f;  // degrees
   float min_random_scale = 1.f;  // shorter-side resize scale jitter
   float max_random_scale = 1.f;
+  // emit raw uint8 RGB planes instead of normalized float32: 4x fewer
+  // host->device bytes, one less per-pixel pass on the (single-core) host;
+  // mean/std are then folded into the accelerator graph by the consumer
+  bool output_uint8 = false;
 };
 
 struct Batch {
-  std::vector<float> data;    // [batch, c, h, w]
-  std::vector<float> label;   // [batch, label_width]
+  std::vector<float> data;      // [batch, c, h, w] (float32 mode)
+  std::vector<uint8_t> data_u8; // [batch, c, h, w] (uint8 mode: raw RGB,
+                                //  mean/std left for on-device folding)
+  std::vector<float> label;     // [batch, label_width]
   int pad = 0;
-  bool last = false;          // epoch-end sentinel
+  bool last = false;            // epoch-end sentinel
 };
 
 class ImageRecordIter {
@@ -98,9 +104,12 @@ class ImageRecordIter {
 
   int64_t num_samples() const { return static_cast<int64_t>(shard_.size()); }
 
+  bool uint8_mode() const { return p_.output_uint8; }
+
   // Copies the next batch into out pointers. Returns pad count, or -1 at
-  // epoch end (call Reset for the next epoch).
-  int Next(float* data_out, float* label_out) {
+  // epoch end (call Reset for the next epoch). `data_out` must match the
+  // configured output dtype (float32 by default, uint8 when output_uint8).
+  int Next(void* data_out, float* label_out) {
     std::unique_ptr<Batch> b;
     {
       std::unique_lock<std::mutex> lk(out_mu_);
@@ -111,7 +120,10 @@ class ImageRecordIter {
     }
     out_space_cv_.notify_all();
     if (b->last) { MXTPU_DLOG("Next: eof delivered"); return -1; }
-    std::memcpy(data_out, b->data.data(), b->data.size() * sizeof(float));
+    if (p_.output_uint8)
+      std::memcpy(data_out, b->data_u8.data(), b->data_u8.size());
+    else
+      std::memcpy(data_out, b->data.data(), b->data.size() * sizeof(float));
     std::memcpy(label_out, b->label.data(), b->label.size() * sizeof(float));
     return b->pad;
   }
@@ -274,7 +286,10 @@ class ImageRecordIter {
   void FillBatch(const std::vector<std::string>& recs, int pad,
                  std::mt19937& rng, Batch* b) {
     const int c = p_.channels, h = p_.height, w = p_.width;
-    b->data.assign(recs.size() * c * h * w, 0.f);
+    if (p_.output_uint8)
+      b->data_u8.assign(recs.size() * c * h * w, 0);
+    else
+      b->data.assign(recs.size() * c * h * w, 0.f);
     b->label.assign(recs.size() * p_.label_width, 0.f);
     b->pad = pad;
     for (size_t i = 0; i < recs.size(); ++i) {
@@ -301,12 +316,14 @@ class ImageRecordIter {
         lab[0] = hdr.label;
       }
       DecodeAugment(payload, payload_len, rng,
-                    &b->data[i * c * h * w]);
+                    p_.output_uint8 ? nullptr : &b->data[i * c * h * w],
+                    p_.output_uint8 ? &b->data_u8[i * c * h * w] : nullptr);
     }
   }
 
+  // Exactly one of out/out_u8 is non-null (float32 vs uint8 output mode).
   void DecodeAugment(const char* buf, size_t len, std::mt19937& rng,
-                     float* out) {
+                     float* out, uint8_t* out_u8) {
     const int c = p_.channels, h = p_.height, w = p_.width;
     cv::Mat raw(1, static_cast<int>(len), CV_8U,
                 const_cast<char*>(buf));
@@ -419,8 +436,24 @@ class ImageRecordIter {
             v = v * calpha + (1.f - calpha) * mean1;      // contrast
             v = v * salpha + (1.f - salpha) * gray2;      // saturation
             v += pca[k];                                  // lighting noise
-            out[k * h * w + y * w + x] = (v - mean_out[k]) * inv[k];
+            if (out_u8 != nullptr)
+              out_u8[k * h * w + y * w + x] = static_cast<uint8_t>(
+                  std::min(255.f, std::max(0.f, v + 0.5f)));
+            else
+              out[k * h * w + y * w + x] = (v - mean_out[k]) * inv[k];
           }
+        }
+      }
+      return;
+    }
+    if (out_u8 != nullptr) {
+      // raw RGB bytes, no normalization pass (folded on-device by consumer)
+      for (int k = 0; k < c; ++k) {
+        int src_ch = (c == 3) ? 2 - k : k;
+        uint8_t* plane = out_u8 + k * h * w;
+        for (int y = 0; y < h; ++y) {
+          const uint8_t* row = crop.ptr<uint8_t>(y);
+          for (int x = 0; x < w; ++x) plane[y * w + x] = row[x * c + src_ch];
         }
       }
       return;
@@ -508,12 +541,13 @@ extern "C" {
 
 const char* MXTIOGetLastError() { return g_last_error.c_str(); }
 
-void* MXTIOCreateImageRecordIterEx(
+void* MXTIOCreateImageRecordIterEx2(
     const char* path_imgrec, int batch_size, int channels, int height,
     int width, int preprocess_threads, int shuffle, unsigned seed,
     int num_parts, int part_index, const float* mean, const float* stdv,
     int rand_crop, int rand_mirror, int resize, int label_width,
-    int round_batch, int prefetch_depth, const float* aug) {
+    int round_batch, int prefetch_depth, const float* aug,
+    int output_uint8) {
   try {
     mxtpu::ImageRecParams p;
     p.path_imgrec = path_imgrec;
@@ -546,11 +580,25 @@ void* MXTIOCreateImageRecordIterEx(
       p.min_random_scale = aug[5];
       p.max_random_scale = aug[6];
     }
+    p.output_uint8 = output_uint8 != 0;
     return new mxtpu::ImageRecordIter(p);
   } catch (const std::exception& e) {
     g_last_error = e.what();
     return nullptr;
   }
+}
+
+void* MXTIOCreateImageRecordIterEx(
+    const char* path_imgrec, int batch_size, int channels, int height,
+    int width, int preprocess_threads, int shuffle, unsigned seed,
+    int num_parts, int part_index, const float* mean, const float* stdv,
+    int rand_crop, int rand_mirror, int resize, int label_width,
+    int round_batch, int prefetch_depth, const float* aug) {
+  return MXTIOCreateImageRecordIterEx2(
+      path_imgrec, batch_size, channels, height, width, preprocess_threads,
+      shuffle, seed, num_parts, part_index, mean, stdv, rand_crop,
+      rand_mirror, resize, label_width, round_batch, prefetch_depth, aug,
+      /*output_uint8=*/0);
 }
 
 void* MXTIOCreateImageRecordIter(
@@ -568,8 +616,33 @@ void* MXTIOCreateImageRecordIter(
 
 int MXTIONext(void* handle, float* data_out, float* label_out) {
   try {
-    return static_cast<mxtpu::ImageRecordIter*>(handle)->Next(data_out,
-                                                              label_out);
+    auto* it = static_cast<mxtpu::ImageRecordIter*>(handle);
+    if (it->uint8_mode()) {
+      // caller's buffer is batch*c*h*w floats but the iterator holds uint8
+      // batches — dispatching would reinterpret bytes; fail loudly instead
+      g_last_error = "MXTIONext called on a uint8-mode iterator "
+                     "(use MXTIONextU8)";
+      return -2;
+    }
+    return it->Next(data_out, label_out);
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -2;
+  }
+}
+
+/* uint8-mode variant: data_out receives raw RGB bytes (batch,c,h,w). */
+int MXTIONextU8(void* handle, unsigned char* data_out, float* label_out) {
+  try {
+    auto* it = static_cast<mxtpu::ImageRecordIter*>(handle);
+    if (!it->uint8_mode()) {
+      // float batches are 4x the caller's uint8 buffer: memcpy would be a
+      // heap overflow; fail loudly instead
+      g_last_error = "MXTIONextU8 called on a float32-mode iterator "
+                     "(use MXTIONext)";
+      return -2;
+    }
+    return it->Next(data_out, label_out);
   } catch (const std::exception& e) {
     g_last_error = e.what();
     return -2;
