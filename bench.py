@@ -33,6 +33,7 @@ PROBE_TIMEOUT_S = 75
 PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy)
     "infer": 900, "train_fp32": 800, "train_bf16": 600,
     "jax_baseline": 700, "flash": 450, "io_train": 600,
+    "infer_int8": 600,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -107,7 +108,7 @@ def main():
 
     # 2) measurement phases, each in its own budgeted child
     phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
-              "io_train"]
+              "io_train", "infer_int8"]
     if os.environ.get("BENCH_SKIP_BF16") or force_cpu:
         phases.remove("train_bf16")
     results = {}
@@ -184,7 +185,7 @@ def main():
     infer = results.get("infer", {})
     value = infer.get("img_per_sec", 0.0)
     for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
-                  "io_train"):
+                  "io_train", "infer_int8"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
                       if k != "_platform"})
     # mixed-platform runs (partial rescue): say which metric ran where
@@ -369,6 +370,61 @@ def _phase_flash():
             "flash_attn_pallas": bool(use_pallas)}
 
 
+def _phase_infer_int8():
+    """Post-training int8 inference: quantize_model rewrites ResNet-50
+    conv/FC into `_contrib_quantized_*` ops (int8 MXU compute, int32
+    accumulation — the reference quantize_graph_pass.cc flow) and the
+    quantized graph is scored like _phase_infer. The reference published
+    no GPU int8 numbers for this model (its int8 path was MKLDNN/CPU-era),
+    so this is reported as an absolute img/s differentiator."""
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib import quantization as Q
+    from mxnet_tpu.models import resnet
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+    batch, n_iter = 32, (30 if on_tpu else 2)
+    side = 224 if on_tpu else 64
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50 if on_tpu else 18,
+                            image_shape="3,%d,%d" % (side, side))
+    rng = np.random.RandomState(0)
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(batch, 3, side, side), softmax_label=(batch,))
+    args = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name not in ("data", "softmax_label"):
+            args[name] = mx.nd.array(
+                rng.normal(0, 0.01, shape).astype(np.float32))
+    aux = {n: mx.nd.array(np.ones(s, np.float32) if "var" in n
+                          else np.zeros(s, np.float32))
+           for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    calib = rng.uniform(-1, 1, (batch * 2, 3, side, side)).astype(np.float32)
+    it = mx.io.NDArrayIter(calib, None, batch_size=batch)
+    qsym, qargs, qaux, _ = Q.quantize_model(
+        sym, args, aux, calib_mode="naive", calib_data=it,
+        ctx=mx.tpu(0))  # calibrate on the device being benchmarked
+    bind_args = dict(qargs)
+    bind_args["data"] = mx.nd.zeros((batch, 3, side, side))
+    bind_args["softmax_label"] = mx.nd.zeros((batch,))
+    exe = qsym.bind(mx.tpu(0), bind_args, grad_req="null",
+                    aux_states=qaux)
+    from mxnet_tpu.ndarray.ndarray import _new_from_jax
+    datas = [_new_from_jax(jax.device_put(rng.uniform(
+        -1, 1, (batch, 3, side, side)).astype(np.float32)))
+        for _ in range(n_iter)]
+    jax.block_until_ready([d._data for d in datas])
+    for _ in range(3):
+        exe.forward(is_train=False, data=datas[0])
+    exe.outputs[0].wait_to_read()
+    tic = time.time()
+    for d in datas:
+        exe.forward(is_train=False, data=d)
+    exe.outputs[0].wait_to_read()
+    return {"int8_infer_img_per_sec": round(
+        batch * n_iter / (time.time() - tic), 2)}
+
+
 def _phase_io_train():
     """End-to-end input-pipeline + train throughput: synthetic JPEG .rec ->
     C++ ImageRecordIter (sharded read, threaded decode/augment, prefetch;
@@ -452,6 +508,7 @@ PHASES = {
     "jax_baseline": _phase_jax_baseline,
     "flash": _phase_flash,
     "io_train": _phase_io_train,
+    "infer_int8": _phase_infer_int8,
 }
 
 

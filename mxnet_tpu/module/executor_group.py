@@ -82,8 +82,19 @@ class DataParallelExecutorGroup:
         self.label_shapes = label_shapes
         self.execs = []
         input_shapes = {}
+        input_types = {}
         for d in data_shapes:
             input_shapes[d.name] = d.shape
+            # honor DataDesc.dtype on DATA inputs (e.g. a uint8
+            # ImageRecordIter): binding the input buffer at the iterator's
+            # dtype keeps the cast on DEVICE (graph prelude) instead of
+            # upcasting host-side — the uint8 pipeline's bandwidth win.
+            # Labels are deliberately NOT plumbed: an integer label dtype
+            # would back-propagate through infer_type's unification into
+            # the parameter dtypes of Embedding-front nets.
+            if getattr(d, "dtype", None) is not None \
+                    and _np.dtype(d.dtype) != _np.float32:
+                input_types[d.name] = _np.dtype(d.dtype)
         for l in (label_shapes or []):
             input_shapes[l.name] = l.shape
 
@@ -99,6 +110,7 @@ class DataParallelExecutorGroup:
                 g2c = g2c[i]
             exec_ = self.symbol.simple_bind(ctx, grad_req=self.grad_req,
                                             group2ctx=g2c,
+                                            type_dict=input_types or None,
                                             **dev_shapes)
             self.execs.append(exec_)
 

@@ -146,7 +146,7 @@ class ImageRecordIter {
     raw_pad_.clear();
     producer_ = std::thread([this] { Produce(); });
     for (int i = 0; i < p_.preprocess_threads; ++i)
-      workers_.emplace_back([this] { Work(); });
+      workers_.emplace_back([this, i] { Work(i); });
   }
 
   void Stop() {
@@ -242,10 +242,16 @@ class ImageRecordIter {
   }
 
   // ---- stage 2: decode + augment + pack ---------------------------------
-  void Work() {
+  void Work(int worker_idx) {
     try {
-      std::mt19937 rng(p_.seed ^ std::hash<std::thread::id>()(
-                                     std::this_thread::get_id()));
+      // per-(worker, epoch) stream: epoch_ keeps augmentation draws fresh
+      // across epochs; the index keeps fixed-seed runs reproducible at
+      // preprocess_threads=1 (with more workers, batch-to-worker
+      // assignment is a scheduling race, as in the reference). The old
+      // thread::id hash made even single-worker fixed-seed runs
+      // irreproducible.
+      std::mt19937 rng(p_.seed ^ (0x9e3779b9u * (worker_idx + 1))
+                       ^ (0x85ebca6bu * epoch_));
       for (;;) {
         std::pair<uint64_t, std::vector<std::string>> item;
         int pad;

@@ -32,6 +32,9 @@ def _toy_data(n=256, d=10, k=4, seed=0):
 
 def _fit_module(kv, nctx, X, y, arg_params=None, num_epoch=3, momentum=0.9,
                 optimizer="sgd", opt_params=None):
+    # initializers draw from the global mx.random key chain: pin it so
+    # convergence-threshold asserts don't depend on which tests ran before
+    mx.random.seed(42)
     it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=False,
                            label_name="softmax_label")
     mod = mx.mod.Module(_mlp(), context=[mx.tpu(i) for i in range(nctx)])

@@ -457,3 +457,71 @@ def test_fused_rnn_vs_torch(mode, layers, bidirectional):
                             for n in names]).astype(np.float32)
     _assert_close(grads["parameters"], tgrad, mode + " dparams",
                   rtol=1e-3, atol=2e-3)
+
+
+# ------------------------------------------- spatial transformer stack ----
+
+
+def test_grid_generator_affine_vs_torch():
+    """GridGenerator(affine) == torch.affine_grid(align_corners=True),
+    modulo layout ([N,2,H,W] vs [N,H,W,2])."""
+    rng = np.random.RandomState(20)
+    theta = rng.normal(0, 0.5, (2, 6)).astype(np.float32)
+    h, w = 5, 7
+    out = mx.nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                              target_shape=(h, w)).asnumpy()
+    tgrid = F.affine_grid(torch.tensor(theta).view(2, 2, 3),
+                          size=(2, 1, h, w), align_corners=True)
+    want = tgrid.numpy().transpose(0, 3, 1, 2)  # [N,H,W,2] -> [N,2,H,W]
+    _assert_close(out, want, "affine grid")
+
+
+def test_bilinear_sampler_vs_torch():
+    """BilinearSampler == grid_sample(bilinear, zeros, align_corners=True)
+    fwd + input/grid gradients, including out-of-range grid points."""
+    rng = np.random.RandomState(21)
+    n, c, h, w, ho, wo = 2, 3, 6, 6, 4, 5
+    data = rng.normal(size=(n, c, h, w)).astype(np.float32)
+    # grid partly outside [-1,1] to exercise zero padding
+    grid = rng.uniform(-1.3, 1.3, (n, 2, ho, wo)).astype(np.float32)
+
+    sym = mx.sym.BilinearSampler(mx.sym.Variable("data"),
+                                 mx.sym.Variable("grid"))
+    td = _torch_leaf(data)
+    tg = _torch_leaf(grid.transpose(0, 2, 3, 1))  # [N,Ho,Wo,2]
+    ty = F.grid_sample(td, tg, mode="bilinear", padding_mode="zeros",
+                       align_corners=True)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"data": data, "grid": grid}, og)
+    _assert_close(out, ty.detach().numpy(), "bilinear sample fwd")
+    _assert_close(grads["data"], td.grad.numpy(), "bilinear sample ddata")
+    _assert_close(grads["grid"],
+                  tg.grad.numpy().transpose(0, 3, 1, 2), "bilinear dgrid")
+
+
+def test_spatial_transformer_vs_torch():
+    """SpatialTransformer(affine, bilinear) == affine_grid + grid_sample,
+    with gradients through both data and the 6-param localization."""
+    rng = np.random.RandomState(22)
+    n, c, h, w, ho, wo = 2, 2, 8, 8, 6, 6
+    data = rng.normal(size=(n, c, h, w)).astype(np.float32)
+    theta = (np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (n, 1))
+             + rng.normal(0, 0.1, (n, 6)).astype(np.float32))
+
+    sym = mx.sym.SpatialTransformer(
+        mx.sym.Variable("data"), mx.sym.Variable("loc"),
+        transform_type="affine", sampler_type="bilinear",
+        target_shape=(ho, wo))
+    td, tt = _torch_leaf(data), _torch_leaf(theta)
+    tgrid = F.affine_grid(tt.view(n, 2, 3), size=(n, c, ho, wo),
+                          align_corners=True)
+    ty = F.grid_sample(td, tgrid, mode="bilinear", padding_mode="zeros",
+                       align_corners=True)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"data": data, "loc": theta}, og)
+    _assert_close(out, ty.detach().numpy(), "stn fwd")
+    _assert_close(grads["data"], td.grad.numpy(), "stn ddata")
+    _assert_close(grads["loc"], tt.grad.numpy(), "stn dloc",
+                  rtol=1e-3, atol=1e-3)
