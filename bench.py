@@ -232,6 +232,32 @@ def _phase_probe():
     return {"platform": d.platform, "device_kind": getattr(d, "device_kind", "")}
 
 
+def _timed_score_loop(exe, batch, side, n_iter, seed=0):
+    """Shared scoring protocol for the fp32 and int8 inference phases.
+
+    Pre-stages DISTINCT device batches and cycles through them: repeated
+    identical executions can be deduped by the runtime (observed on the
+    tunneled TPU backend), and per-step host->device copies would measure
+    the tunnel, not the chip. The reference score benchmark also measures
+    compute only. 3-iter warmup, wait_to_read-bounded timing."""
+    import numpy as np
+    import jax
+    from mxnet_tpu.ndarray.ndarray import _new_from_jax
+    rng = np.random.RandomState(seed)
+    datas = [_new_from_jax(jax.device_put(rng.uniform(
+        -1, 1, (batch, 3, side, side)).astype(np.float32)))
+        for _ in range(n_iter)]
+    jax.block_until_ready([d._data for d in datas])
+    for _ in range(3):  # warmup: compile + steady-state
+        exe.forward(is_train=False, data=datas[0])
+    exe.outputs[0].wait_to_read()
+    tic = time.time()
+    for d in datas:
+        exe.forward(is_train=False, data=d)
+    exe.outputs[0].wait_to_read()
+    return round(batch * n_iter / (time.time() - tic), 2)
+
+
 def _phase_infer():
     """Reference benchmark_score.py analog: jitted forward, random params."""
     import numpy as np
@@ -248,24 +274,7 @@ def _phase_infer():
     for name, arr in exe.arg_dict.items():
         if name not in ("data", "softmax_label"):
             arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
-    # Pre-stage DISTINCT batches on device and cycle through them: repeated
-    # identical executions can be deduped by the runtime (observed on the
-    # tunneled TPU backend), and per-step host->device copies would measure
-    # the tunnel, not the chip. The reference score benchmark also measures
-    # compute only.
-    from mxnet_tpu.ndarray.ndarray import _new_from_jax
-    datas = [_new_from_jax(jax.device_put(rng.uniform(
-        -1, 1, (batch, 3, 224, 224)).astype(np.float32)))
-        for _ in range(n_iter)]
-    jax.block_until_ready([d._data for d in datas])
-    for _ in range(3):  # warmup: compile + steady-state
-        exe.forward(is_train=False, data=datas[0])
-    exe.outputs[0].wait_to_read()
-    tic = time.time()
-    for d in datas:
-        exe.forward(is_train=False, data=d)
-    exe.outputs[0].wait_to_read()
-    return {"img_per_sec": round(batch * n_iter / (time.time() - tic), 2)}
+    return {"img_per_sec": _timed_score_loop(exe, batch, 224, n_iter)}
 
 
 def _fused_train_ips(compute_dtype=None):
@@ -347,26 +356,20 @@ def _phase_flash():
     on_tpu = platform != "cpu"
     use_pallas = default_use_pallas()  # the framework's own kernel gate
     B, H, S, D = (4, 8, 4096, 128) if on_tpu else (2, 2, 512, 64)
-    rng = np.random.RandomState(0)
-    # distinct q per timed call: identical dispatches can be deduped by the
-    # runtime, which would inflate the number past chip peak
+    # methodology (dedup-proof, single-dispatch lax.map) is shared with
+    # tools/flash_tune.py via tools/attn_timing so the tuner's block-size
+    # choice and this reported number can never drift apart
+    sys.path.insert(0, _HERE)
+    from tools import attn_timing
     n_iter = 16 if on_tpu else 2
     dt_ = jnp.bfloat16 if on_tpu else jnp.float32
-    qs = [jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32),
-                      dtype=dt_) for _ in range(n_iter)]
-    k = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32), dt_)
-    v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32), dt_)
-    fn = jax.jit(lambda q, k, v: flash_attention(
-        q, k, v, causal=True, block_q=1024 if on_tpu else 256,
-        block_k=512 if on_tpu else 256, use_pallas=use_pallas))
-    jax.block_until_ready([fn(qs[0], k, v)] + qs)  # compile + stage
-    tic = time.time()
-    outs = [fn(q, k, v) for q in qs]
-    jax.block_until_ready(outs)
-    dt = time.time() - tic
-    # causal attention flops: 2 matmuls * B*H*S^2*D, halved by causality
-    flops = 2 * 2 * B * H * S * S * D * 0.5 * n_iter
-    return {"flash_attn_tflops": round(flops / dt / 1e12, 2),
+    qs, k, v = attn_timing.make_inputs(B, H, S, D, n_iter, dt_)
+    bq, bk = (1024, 512) if on_tpu else (256, 256)
+    tflops, _ = attn_timing.timed_map_tflops(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=bq,
+                                        block_k=bk, use_pallas=use_pallas),
+        qs, k, v, attn_timing.causal_flops(B, H, S, D, n_iter))
+    return {"flash_attn_tflops": round(tflops, 2),
             "flash_attn_pallas": bool(use_pallas)}
 
 
@@ -409,20 +412,8 @@ def _phase_infer_int8():
     bind_args["softmax_label"] = mx.nd.zeros((batch,))
     exe = qsym.bind(mx.tpu(0), bind_args, grad_req="null",
                     aux_states=qaux)
-    from mxnet_tpu.ndarray.ndarray import _new_from_jax
-    datas = [_new_from_jax(jax.device_put(rng.uniform(
-        -1, 1, (batch, 3, side, side)).astype(np.float32)))
-        for _ in range(n_iter)]
-    jax.block_until_ready([d._data for d in datas])
-    for _ in range(3):
-        exe.forward(is_train=False, data=datas[0])
-    exe.outputs[0].wait_to_read()
-    tic = time.time()
-    for d in datas:
-        exe.forward(is_train=False, data=d)
-    exe.outputs[0].wait_to_read()
-    return {"int8_infer_img_per_sec": round(
-        batch * n_iter / (time.time() - tic), 2)}
+    return {"int8_infer_img_per_sec": _timed_score_loop(
+        exe, batch, side, n_iter)}
 
 
 def _phase_io_train():

@@ -95,6 +95,26 @@ class DataParallelExecutorGroup:
             if getattr(d, "dtype", None) is not None \
                     and _np.dtype(d.dtype) != _np.float32:
                 input_types[d.name] = _np.dtype(d.dtype)
+        if input_types:
+            # guard: only bind non-float inputs when the graph actually
+            # isolates them (a cast/Embedding front). If infer_type would
+            # unify the input dtype into any PARAMETER, fall back to the
+            # pre-existing float32 binding + host-side upcast — binding
+            # uint8 weights would truncate float initializers to zeros.
+            try:
+                arg_types, _, _ = self.symbol.infer_type(**{
+                    k: v for k, v in input_types.items()})
+                names = self.symbol.list_arguments()
+                data_like = set(input_types) | {
+                    l.name for l in (label_shapes or [])}
+                for name, t in zip(names, arg_types):
+                    if name in data_like or t is None:
+                        continue
+                    if not _np.issubdtype(_np.dtype(t), _np.floating):
+                        input_types = {}
+                        break
+            except Exception:
+                input_types = {}
         for l in (label_shapes or []):
             input_shapes[l.name] = l.shape
 

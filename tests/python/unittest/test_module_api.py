@@ -200,3 +200,35 @@ def test_fit_finetune_with_extra_checkpoint_params():
     mod.fit(it, num_epoch=1, arg_params=ckpt, allow_missing=True)
     got, _ = mod.get_params()
     assert set(got) == {"fc1_weight", "fc1_bias"}
+
+
+def test_non_float_data_without_cast_front_binds_float32():
+    """A uint8 NDArrayIter feeding an MLP with NO cast prelude must fall
+    back to float32 binding (host-side upcast) — plumbing uint8 through
+    infer_type would unify parameter dtypes to uint8 and truncate float
+    initializers to zeros. Only graphs that isolate the input (cast /
+    Embedding front) bind the raw dtype."""
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, 255, (64, 8)).astype(np.uint8)
+    y = (X.astype(np.float32).sum(axis=1) > 1000).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8)
+    net = mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(0))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    assert mod._exec_group.execs[0].arg_dict["data"].dtype == np.float32
+    args, _ = mod.get_params()
+    # parameters stayed float and non-degenerate
+    w = args["fullyconnected0_weight"].asnumpy()
+    assert w.dtype == np.float32 and np.abs(w).max() > 0
+
+    # and with a cast front, the same iter binds uint8 (device-side cast)
+    net2 = mx.sym.cast(mx.sym.Variable("data"), dtype="float32")
+    net2 = mx.sym.FullyConnected(net2, num_hidden=8)
+    net2 = mx.sym.SoftmaxOutput(net2, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+    mod2 = mx.mod.Module(net2, context=mx.cpu(0))
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    assert mod2._exec_group.execs[0].arg_dict["data"].dtype == np.uint8

@@ -1,0 +1,44 @@
+"""Shared flash-attention timing methodology (bench.py + flash_tune.py).
+
+One place defines how attention throughput is measured so the tuner's
+block-size choice and the bench's reported TFLOP/s can never drift apart:
+
+* distinct q per iteration — byte-identical dispatches can be deduped by
+  the tunneled runtime, inflating numbers past chip peak;
+* ALL iterations inside ONE jitted `lax.map` dispatch — per-dispatch
+  tunnel latency otherwise dominates the timing and caps the apparent
+  TFLOP/s far below the kernel's real throughput;
+* causal flops = 2 matmuls x 2 flops x B*H*S^2*D, halved by causality.
+"""
+import time
+
+import numpy as np
+
+
+def causal_flops(B, H, S, D, n_iter=1):
+    return 2 * 2 * B * H * S * S * D * 0.5 * n_iter
+
+
+def make_inputs(B, H, S, D, n_iter, dtype, seed=0):
+    """(qs [n_iter,B,H,S,D], k, v) staged on device in `dtype`."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    qs = jnp.asarray(rng.normal(0, 1, (n_iter, B, H, S, D))
+                     .astype(np.float32), dtype=dtype)
+    k = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32), dtype)
+    v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32), dtype)
+    return qs, k, v
+
+
+def timed_map_tflops(per_q_fn, qs, k, v, flops_total):
+    """Compile + warm `lax.map(per_q_fn, qs)` as ONE dispatch, return
+    (tflops, seconds_per_iter)."""
+    import jax
+
+    fn = jax.jit(lambda qs, k, v: jax.lax.map(
+        lambda q: per_q_fn(q, k, v), qs))
+    jax.block_until_ready([fn(qs, k, v), qs])  # compile + stage
+    tic = time.time()
+    jax.block_until_ready(fn(qs, k, v))
+    dt = time.time() - tic
+    return flops_total / dt / 1e12, dt / qs.shape[0]

@@ -69,39 +69,39 @@ def main():
     if args.quick:
         return
 
+    import os
+    import sys as _sys
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools import attn_timing  # shared methodology with bench.py
+
     B, H, S, D = 4, 8, args.seq, 128
-    rng = np.random.RandomState(0)
     n_iter = 16
-    k = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32),
-                    jnp.bfloat16)
-    v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32),
-                    jnp.bfloat16)
-    qs = [jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32),
-                      jnp.bfloat16) for _ in range(n_iter)]
-    flops_fwd = 2 * 2 * B * H * S * S * D * 0.5  # causal halves the work
+    qs, k, v = attn_timing.make_inputs(B, H, S, D, n_iter, jnp.bfloat16)
+    flops_fwd = attn_timing.causal_flops(B, H, S, D)
 
     results = []
     for bq, bk in itertools.product((256, 512, 1024, 2048), repeat=2):
         if bq > S or bk > S:
             continue
         try:
-            fwd = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
-                q, k, v, causal=True, block_q=bq, block_k=bk,
-                use_pallas=True))
-            grad = jax.jit(jax.grad(
-                lambda q, k, v, bq=bq, bk=bk: (flash_attention(
-                    q, k, v, causal=True, block_q=bq, block_k=bk,
-                    use_pallas=True) ** 2).sum(), argnums=(0, 1, 2)))
-            jax.block_until_ready([fwd(qs[0], k, v), grad(qs[0], k, v)])
-            tic = time.time()
-            jax.block_until_ready([fwd(q, k, v) for q in qs])
-            t_fwd = (time.time() - tic) / n_iter
-            tic = time.time()
-            jax.block_until_ready([grad(q, k, v) for q in qs])
-            t_bwd = (time.time() - tic) / n_iter
+            fwd_tf, _ = attn_timing.timed_map_tflops(
+                lambda q, k_, v_, bq=bq, bk=bk: flash_attention(
+                    q, k_, v_, causal=True, block_q=bq, block_k=bk,
+                    use_pallas=True),
+                qs, k, v, flops_fwd * n_iter)
+
+            def loss(q_, k_, v_, bq=bq, bk=bk):
+                return (flash_attention(q_, k_, v_, causal=True, block_q=bq,
+                                        block_k=bk, use_pallas=True)
+                        ** 2).sum()
+            bwd_tf, _ = attn_timing.timed_map_tflops(
+                lambda q, k_, v_, bq=bq, bk=bk: jax.grad(
+                    loss, argnums=(0, 1, 2))(q, k_, v_),
+                qs, k, v, 3.5 * flops_fwd * n_iter)
             row = {"block_q": bq, "block_k": bk,
-                   "fwd_tflops": round(flops_fwd / t_fwd / 1e12, 2),
-                   "fwd_bwd_tflops": round(3.5 * flops_fwd / t_bwd / 1e12, 2)}
+                   "fwd_tflops": round(fwd_tf, 2),
+                   "fwd_bwd_tflops": round(bwd_tf, 2)}
         except Exception as e:
             row = {"block_q": bq, "block_k": bk,
                    "error": "%s: %s" % (type(e).__name__, str(e)[:120])}
