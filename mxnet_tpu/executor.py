@@ -72,6 +72,11 @@ class Executor:
         self._topo = [n for n in symbol._topo() if not n.is_variable]
         self._var_nodes = symbol._variables()
         self._aux_var_ids = symbol._aux_set()
+        # deterministic graphs skip the per-forward key split — at ~150us
+        # of jax.random dispatch per call it dominated small-graph forward
+        # overhead (the jitted fn still takes a key arg; reuse a fixed one)
+        self._needs_rng = any(n.op.need_rng for n in self._topo)
+        self._fixed_key = None
 
         if group2ctx:
             self._group_shardings = self._build_group_shardings(group2ctx)
@@ -238,6 +243,16 @@ class Executor:
             self._cached[key] = jax.jit(f)
         return self._cached[key]
 
+    def _next_key(self):
+        """Fresh PRNG key for stochastic graphs; a cached constant key for
+        deterministic ones (jax.random.split costs ~150us of host dispatch
+        per call — most of a small graph's forward time)."""
+        if self._needs_rng:
+            return _rnd.next_key()
+        if self._fixed_key is None:
+            self._fixed_key = _rnd.next_key()
+        return self._fixed_key
+
     # ------------------------------------------------------------------
     # public API (reference: executor.py forward/backward/outputs)
     # ------------------------------------------------------------------
@@ -254,7 +269,7 @@ class Executor:
         aux_vals = {n: a._data for n, a in self.aux_dict.items()}
         if self._group_shardings is not None:
             arg_vals, aux_vals = self._apply_group_shardings(arg_vals, aux_vals)
-        rng = _rnd.next_key()
+        rng = self._next_key()
 
         from . import profiler as _prof
         _profiling = _prof.is_running()
@@ -346,7 +361,7 @@ class Executor:
                 out_grads = [out_grads]
             arg_vals = {n: a._data for n, a in self.arg_dict.items()}
             aux_vals = {n: a._data for n, a in self.aux_dict.items()}
-            rng = _rnd.next_key()
+            rng = self._next_key()
             og = tuple(g._data for g in out_grads)
             if self._group_shardings is not None:
                 arg_vals, aux_vals = self._apply_group_shardings(arg_vals,

@@ -232,6 +232,7 @@ class HybridBlock(Block):
         super().__init__(prefix=prefix, params=params)
         self._active = False
         self._cached_fns = {}   # (is_train, shapes-key) -> jitted fn
+        self._cached_rng = None  # fixed key for deterministic graphs
         self._flags = {}
 
     def hybridize(self, active=True, **kwargs):
@@ -306,10 +307,16 @@ class HybridBlock(Block):
         if entry is None:
             entry = self._build_cached(params_items, inputs, is_train)
             self._cached_fns[key] = entry
-        jit_fn, n_out, out_tree, aux_refs = entry
+        jit_fn, n_out, out_tree, aux_refs, needs_rng = entry
 
         param_arrays = [p.data() for _, p in params_items]
-        rng_val = _rnd.next_key()
+        if needs_rng:
+            rng_val = _rnd.next_key()
+        else:
+            # deterministic graph: reuse one key, skip the per-call split
+            if self._cached_rng is None:
+                self._cached_rng = _rnd.next_key()
+            rng_val = self._cached_rng
 
         def fn(*vals):
             return jit_fn(rng_val, vals[:len(param_arrays)],
@@ -326,7 +333,8 @@ class HybridBlock(Block):
 
     def _build_cached(self, params_items, inputs, is_train):
         """Trace hybrid_forward into a jitted function (reference: _build_cache
-        block.py:564 -> CachedOp). Returns (jit_fn, n_out, out_treedef, aux_refs)."""
+        block.py:564 -> CachedOp). Returns (jit_fn, n_out, out_treedef,
+        aux_refs, needs_rng)."""
         block = self
         names = [n for n, _ in params_items]
         # aux = non-differentiable params whose buffers the forward mutates
@@ -355,14 +363,18 @@ class HybridBlock(Block):
             return tuple(l._data for l in leaves) + tuple(aux_new)
 
         # probe output count + tree structure once (abstract); pure() records
-        # the treedef on the block at trace time
+        # the treedef on the block at trace time, and the rng-consumption
+        # flag tells us whether this graph is stochastic at all
+        _rnd.reset_trace_consumed()
         probe = jax.eval_shape(
             pure, jax.random.PRNGKey(0),
             tuple(jax.ShapeDtypeStruct(p.data().shape, p.data().dtype)
                   for _, p in params_items),
             tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in inputs))
+        needs_rng = _rnd.trace_consumed()
         n_out = len(probe) - len(aux_idx)
-        return (jax.jit(pure), n_out, self._cached_out_tree, aux_refs)
+        return (jax.jit(pure), n_out, self._cached_out_tree, aux_refs,
+                needs_rng)
 
     def _traced_forward(self, names, param_wrappers, input_wrappers):
         """Run hybrid_forward with this block's params bound from wrappers,

@@ -102,8 +102,7 @@ class DataParallelExecutorGroup:
             # pre-existing float32 binding + host-side upcast — binding
             # uint8 weights would truncate float initializers to zeros.
             try:
-                arg_types, _, _ = self.symbol.infer_type(**{
-                    k: v for k, v in input_types.items()})
+                arg_types, _, _ = self.symbol.infer_type(**input_types)
                 names = self.symbol.list_arguments()
                 data_like = set(input_types) | {
                     l.name for l in (label_shapes or [])}

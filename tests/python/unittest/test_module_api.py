@@ -212,16 +212,17 @@ def test_non_float_data_without_cast_front_binds_float32():
     X = rng.randint(0, 255, (64, 8)).astype(np.uint8)
     y = (X.astype(np.float32).sum(axis=1) > 1000).astype(np.float32)
     it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
-    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name="fcg")  # explicit: auto-counter is
     net = mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
-                               name="softmax")
+                               name="softmax")  # process-global
     mod = mx.mod.Module(net, context=mx.cpu(0))
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mod.init_params(mx.init.Xavier())
     assert mod._exec_group.execs[0].arg_dict["data"].dtype == np.float32
     args, _ = mod.get_params()
     # parameters stayed float and non-degenerate
-    w = args["fullyconnected0_weight"].asnumpy()
+    w = args["fcg_weight"].asnumpy()
     assert w.dtype == np.float32 and np.abs(w).max() > 0
 
     # and with a cast front, the same iter binds uint8 (device-side cast)

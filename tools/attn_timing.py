@@ -20,11 +20,17 @@ def causal_flops(B, H, S, D, n_iter=1):
 
 
 def make_inputs(B, H, S, D, n_iter, dtype, seed=0):
-    """(qs [n_iter,B,H,S,D], k, v) staged on device in `dtype`."""
+    """(qs [n_iter,B,H,S,D], k, v) staged on device in `dtype`.
+
+    qs is filled per-iteration into a preallocated float32 buffer — one
+    big rng.normal draw would transiently hold n_iter x the array in
+    float64 (~2 GB at the TPU defaults)."""
     import jax.numpy as jnp
     rng = np.random.RandomState(seed)
-    qs = jnp.asarray(rng.normal(0, 1, (n_iter, B, H, S, D))
-                     .astype(np.float32), dtype=dtype)
+    qs_host = np.empty((n_iter, B, H, S, D), np.float32)
+    for i in range(n_iter):
+        qs_host[i] = rng.normal(0, 1, (B, H, S, D)).astype(np.float32)
+    qs = jnp.asarray(qs_host, dtype=dtype)
     k = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32), dtype)
     v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32), dtype)
     return qs, k, v
