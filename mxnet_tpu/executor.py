@@ -75,8 +75,7 @@ class Executor:
         # deterministic graphs skip the per-forward key split — at ~150us
         # of jax.random dispatch per call it dominated small-graph forward
         # overhead (the jitted fn still takes a key arg; reuse a fixed one)
-        self._needs_rng = any(n.op.need_rng for n in self._topo)
-        self._fixed_key = None
+        self._needs_rng = symbol._needs_rng()
 
         if group2ctx:
             self._group_shardings = self._build_group_shardings(group2ctx)
@@ -244,14 +243,11 @@ class Executor:
         return self._cached[key]
 
     def _next_key(self):
-        """Fresh PRNG key for stochastic graphs; a cached constant key for
-        deterministic ones (jax.random.split costs ~150us of host dispatch
-        per call — most of a small graph's forward time)."""
-        if self._needs_rng:
-            return _rnd.next_key()
-        if self._fixed_key is None:
-            self._fixed_key = _rnd.next_key()
-        return self._fixed_key
+        """Fresh PRNG key for stochastic graphs; the shared constant key
+        for deterministic ones (jax.random.split costs ~150us of host
+        dispatch per call — most of a small graph's forward time — and
+        drawing from the global chain would perturb user-visible state)."""
+        return _rnd.next_key() if self._needs_rng else _rnd.fixed_key()
 
     # ------------------------------------------------------------------
     # public API (reference: executor.py forward/backward/outputs)

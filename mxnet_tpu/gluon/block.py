@@ -232,7 +232,6 @@ class HybridBlock(Block):
         super().__init__(prefix=prefix, params=params)
         self._active = False
         self._cached_fns = {}   # (is_train, shapes-key) -> jitted fn
-        self._cached_rng = None  # fixed key for deterministic graphs
         self._flags = {}
 
     def hybridize(self, active=True, **kwargs):
@@ -310,13 +309,9 @@ class HybridBlock(Block):
         jit_fn, n_out, out_tree, aux_refs, needs_rng = entry
 
         param_arrays = [p.data() for _, p in params_items]
-        if needs_rng:
-            rng_val = _rnd.next_key()
-        else:
-            # deterministic graph: reuse one key, skip the per-call split
-            if self._cached_rng is None:
-                self._cached_rng = _rnd.next_key()
-            rng_val = self._cached_rng
+        # deterministic graph: shared constant key, no per-call split and
+        # no perturbation of the user-visible global chain
+        rng_val = _rnd.next_key() if needs_rng else _rnd.fixed_key()
 
         def fn(*vals):
             return jit_fn(rng_val, vals[:len(param_arrays)],

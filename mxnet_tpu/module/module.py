@@ -434,14 +434,24 @@ class Module(BaseModule):
 
     def _fused_forward(self, data_batch):
         import numpy as _np2
+        from ..ndarray.ndarray import NDArray as _ND
         fused = self._fused_step
+
+        def _raw(arr):
+            # hand the step the device buffer itself: .asnumpy() would pull
+            # an already-staged batch device->host only for the step to push
+            # it straight back (3 tunnel transfers per batch instead of 1).
+            # jax arrays are immutable and NDArray mutation swaps buffers,
+            # so the captured array can't change under the step.
+            if isinstance(arr, _ND):
+                return arr._data
+            return _np2.asarray(arr)
+
         batch = {}
         for desc, arr in zip(self._data_shapes, data_batch.data):
-            batch[desc.name] = arr.asnumpy() if hasattr(arr, "asnumpy") \
-                else _np2.asarray(arr)
+            batch[desc.name] = _raw(arr)
         for desc, arr in zip(self._label_shapes or [], data_batch.label or []):
-            batch[desc.name] = arr.asnumpy() if hasattr(arr, "asnumpy") \
-                else _np2.asarray(arr)
+            batch[desc.name] = _raw(arr)
         batch = {k: v for k, v in batch.items() if k in fused.arg_names}
         from .. import profiler as _prof
         if _prof.is_running():

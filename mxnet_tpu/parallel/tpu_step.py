@@ -39,6 +39,10 @@ class DataParallelTrainStep:
                  opt_hp=None, fixed_param_names=(), clip_gradient=None,
                  compute_dtype=None):
         self.symbol = symbol
+        # stochastic-op scan decides whether steps draw fresh keys or reuse
+        # one cached replicated key (see __call__)
+        self._needs_rng = symbol._needs_rng()
+        self._fixed_rng = None  # device-put copy of random.fixed_key()
         self.mesh = mesh
         self.lr = lr
         self.momentum = momentum
@@ -231,19 +235,34 @@ class DataParallelTrainStep:
             raise MXNetError("call init() first")
         batch = {}
         for name, arr in batch_np.items():
-            if isinstance(arr, jax.Array):  # already staged on device
+            if isinstance(arr, jax.Array):  # already on device: reshard
+                if arr.sharding != self._batch_shard:  # device-side, no
+                    arr = jax.device_put(arr, self._batch_shard)  # host hop
                 batch[name] = arr
             else:
                 batch[name] = jax.device_put(jnp.asarray(arr),
                                              self._batch_shard)
         if rng is None:
-            rng = jax.random.PRNGKey(_np.random.randint(0, 2 ** 31))
-        rng = jax.device_put(rng, self._repl)
+            if self._needs_rng:
+                rng = jax.device_put(
+                    jax.random.PRNGKey(_np.random.randint(0, 2 ** 31)),
+                    self._repl)
+            else:
+                # deterministic graph (no dropout/sample ops): one cached
+                # replicated key — fresh-key construction + device_put cost
+                # ~150us of host dispatch per step otherwise
+                if self._fixed_rng is None:
+                    from .. import random as _rnd
+                    self._fixed_rng = jax.device_put(
+                        _rnd.fixed_key(), self._repl)
+                rng = self._fixed_rng
+        else:
+            rng = jax.device_put(rng, self._repl)
         if lr is None:
             lr = self.lr
         self.params, self.opt_state, aux_upd, outs = self._step(
             self.params, self.opt_state, self.aux, batch,
-            rng, jnp.float32(lr))
+            rng, _np.float32(lr))
         self.moms = self.opt_state.get("mom") or {}
         self.aux.update(aux_upd)
         return outs

@@ -53,6 +53,20 @@ def current_key():
     return _STATE.key
 
 
+_FIXED_KEY = None
+
+
+def fixed_key():
+    """Constant key for DETERMINISTIC jitted graphs (their key argument is
+    never consumed). One shared accessor so executor / CachedOp / fused
+    step all follow the same policy, and so running a deterministic graph
+    never consumes a split from the user-visible global chain."""
+    global _FIXED_KEY
+    if _FIXED_KEY is None:
+        _FIXED_KEY = jax.random.PRNGKey(0)
+    return _FIXED_KEY
+
+
 class trace_key_scope:
     """Context manager installing a traced key while building a jitted program."""
 

@@ -84,6 +84,12 @@ class Symbol:
     def _variables(self):
         return [n for n in self._topo() if n.is_variable]
 
+    def _needs_rng(self):
+        """True if any op in the graph draws randomness — deterministic
+        graphs let executors reuse one fixed key instead of paying a
+        ~150us jax.random.split per dispatch (random.fixed_key)."""
+        return any(n.op.need_rng for n in self._topo() if not n.is_variable)
+
     def _aux_set(self):
         """Variable nodes that are op aux states (e.g. BatchNorm moving_mean)."""
         aux = set()
