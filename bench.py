@@ -472,10 +472,7 @@ def _phase_io_train():
     body = resnet.get_symbol(num_classes=1000,
                              num_layers=50 if on_tpu else 18,
                              image_shape="3,%d,%d" % (side, side))
-    x = mx.sym.cast(mx.sym.Variable("data"), dtype="float32")
-    x = mx.sym._image_normalize(x, mean=it.normalize_mean,
-                                std=it.normalize_std)
-    sym = body(data=x)
+    sym = it.normalize_prelude(body)
     mod = mx.mod.Module(sym, context=mx.tpu(0))
     step_times = []
     mod.fit(it, num_epoch=3 if on_tpu else 2, kvstore="tpu_sync",

@@ -23,23 +23,46 @@ def add_data_args(parser):
     data.add_argument("--data-nthreads", type=int, default=4)
     data.add_argument("--benchmark", type=int, default=0,
                       help="1 = synthetic data (reference --benchmark mode)")
+    data.add_argument("--data-dtype", type=str, default="float32",
+                      choices=("float32", "uint8"),
+                      help="uint8: iterator ships raw RGB bytes (4x fewer "
+                           "host->device bytes, no host normalize pass); "
+                           "mean/std fold into the device graph")
     return data
 
 
 class SyntheticDataIter(DataIter):
-    """Dummy-data mode (reference: common/data.py SyntheticDataIter)."""
+    """Dummy-data mode (reference: common/data.py SyntheticDataIter).
 
-    def __init__(self, num_classes, data_shape, max_iter, dtype=np.float32):
+    dtype='uint8' mirrors the real ImageRecordIter contract (raw bytes +
+    normalize_mean/std + normalize_prelude) so --benchmark 1 measures the
+    same graph/link behavior as the record pipeline."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype=np.float32,
+                 mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0)):
         super().__init__(data_shape[0])
         self.cur_iter = 0
         self.max_iter = max_iter
+        self.dtype = np.dtype(dtype).name
+        self.normalize_mean = tuple(mean)
+        self.normalize_std = tuple(std)
         rng = np.random.RandomState(0)
-        self.data = mx.nd.array(
-            rng.uniform(-1, 1, data_shape).astype(dtype))
+        if self.dtype == "uint8":
+            self.data = mx.nd.array(
+                rng.randint(0, 256, data_shape).astype(np.uint8))
+        else:
+            self.data = mx.nd.array(
+                rng.uniform(-1, 1, data_shape).astype(dtype))
         self.label = mx.nd.array(
-            rng.randint(0, num_classes, (data_shape[0],)).astype(dtype))
-        self.provide_data = [DataDesc("data", data_shape)]
+            rng.randint(0, num_classes,
+                        (data_shape[0],)).astype(np.float32))
+        self.provide_data = [DataDesc("data", data_shape,
+                                      dtype=np.dtype(self.dtype))]
         self.provide_label = [DataDesc("softmax_label", (data_shape[0],))]
+
+    def normalize_prelude(self, network):
+        from mxnet_tpu.recordio_iter import normalize_prelude
+        return normalize_prelude(self, network)
 
     def reset(self):
         self.cur_iter = 0
@@ -60,16 +83,22 @@ def get_rec_iter(args, kv=None):
     if args.benchmark or not args.data_train:
         batch = args.batch_size
         data_shape = (batch,) + image_shape
+        mean_b = [float(x) for x in args.rgb_mean.split(",")]
+        std_b = [float(x) for x in args.rgb_std.split(",")]
         train = SyntheticDataIter(args.num_classes, data_shape,
                                   max_iter=max(1, args.num_examples
-                                               // max(batch, 1)))
+                                               // max(batch, 1)),
+                                  dtype=getattr(args, "data_dtype",
+                                                "float32"),
+                                  mean=mean_b, std=std_b)
         return train, None
     rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
     mean = [float(x) for x in args.rgb_mean.split(",")]
     std = [float(x) for x in args.rgb_std.split(",")]
+    dtype = getattr(args, "data_dtype", "float32")
     train = mx.io.ImageRecordIter(
         path_imgrec=args.data_train, data_shape=image_shape,
-        batch_size=args.batch_size, shuffle=True,
+        batch_size=args.batch_size, shuffle=True, dtype=dtype,
         preprocess_threads=args.data_nthreads, rand_crop=True,
         rand_mirror=True, mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
         std_r=std[0], std_g=std[1], std_b=std[2],
@@ -78,7 +107,7 @@ def get_rec_iter(args, kv=None):
     if args.data_val:
         val = mx.io.ImageRecordIter(
             path_imgrec=args.data_val, data_shape=image_shape,
-            batch_size=args.batch_size, shuffle=False,
+            batch_size=args.batch_size, shuffle=False, dtype=dtype,
             preprocess_threads=args.data_nthreads,
             mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
             std_r=std[0], std_g=std[1], std_b=std[2],

@@ -102,6 +102,12 @@ def fit(args, network, data_loader, **kwargs):
     (train, val) = data_loader(args, kv)
     devs = _parse_ctx(args)
 
+    # uint8 input pipeline: the iterator ships raw RGB bytes and exposes
+    # its mean/std — fold cast + per-channel normalize into the device
+    # graph (XLA fuses it into the first conv)
+    if train is not None and getattr(train, "dtype", "float32") == "uint8":
+        network = train.normalize_prelude(network)
+
     lr, lr_scheduler = _get_lr_scheduler(args, kv)
 
     # fine-tune path (reference fit.py): caller-provided params take the

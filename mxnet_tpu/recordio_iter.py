@@ -22,7 +22,22 @@ from .base import MXNetError
 from .io import DataIter, DataBatch, DataDesc
 from .ndarray.ndarray import array as nd_array
 
-__all__ = ["ImageRecordIter"]
+__all__ = ["ImageRecordIter", "normalize_prelude"]
+
+
+def normalize_prelude(it, network):
+    """Compose `network` over a cast + per-channel-normalize prelude on
+    `it`'s data input — THE consumer-side contract of a dtype='uint8'
+    iterator (raw bytes over the link, mean/std folded into the device
+    graph where XLA fuses them into the first conv). One definition
+    shared by example/common/fit.py, bench.py and tests. `it` needs
+    data_name / normalize_mean / normalize_std attributes."""
+    from . import symbol as sym
+    name = getattr(it, "data_name", "data")
+    x = sym.cast(sym.Variable(name), dtype="float32")
+    x = sym._image_normalize(x, mean=it.normalize_mean,
+                             std=it.normalize_std)
+    return network(**{name: x})
 
 
 class ImageRecordIter(DataIter):
@@ -134,6 +149,11 @@ class ImageRecordIter(DataIter):
                          pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+    def normalize_prelude(self, network):
+        """Compose `network` over a cast + per-channel-normalize prelude on
+        the data input — THE consumer-side contract of dtype='uint8'."""
+        return normalize_prelude(self, network)
 
     def __del__(self):
         handle = getattr(self, "_handle", None)

@@ -116,3 +116,26 @@ def test_model_parallel_lstm_example():
             re.findall(r"Train-perplexity=([0-9.]+)", out)]
     assert len(ppls) == 3, out[-2000:]
     assert ppls[-1] < ppls[0] * 0.5, ppls
+
+
+def test_train_imagenet_uint8_pipeline(tmp_path):
+    """train_imagenet.py --data-dtype uint8: raw-byte ImageRecordIter +
+    device-side normalize prelude through the judged tpu_sync fit path."""
+    import numpy as np
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "tiny.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(64):
+        img = rng.randint(0, 255, (36, 36, 3), dtype=np.uint8)
+        rec.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img, quality=90))
+    rec.close()
+    out = _run([os.path.join(EX, "image-classification",
+                             "train_imagenet.py"),
+                "--data-train", rec_path, "--data-dtype", "uint8",
+                "--image-shape", "3,32,32", "--num-classes", "4",
+                "--num-layers", "18", "--batch-size", "16",
+                "--num-epochs", "2", "--num-examples", "64",
+                "--kv-store", "tpu_sync", "--lr", "0.05"])
+    assert re.search(r"Epoch\[1\]", out), out[-2000:]
