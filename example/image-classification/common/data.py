@@ -80,22 +80,18 @@ def get_rec_iter(args, kv=None):
     """reference: common/data.py get_rec_iter — ImageRecordIter pair sharded
     by kv rank (num_parts=kv.num_workers, part_index=kv.rank)."""
     image_shape = tuple(int(x) for x in args.image_shape.split(","))
-    if args.benchmark or not args.data_train:
-        batch = args.batch_size
-        data_shape = (batch,) + image_shape
-        mean_b = [float(x) for x in args.rgb_mean.split(",")]
-        std_b = [float(x) for x in args.rgb_std.split(",")]
-        train = SyntheticDataIter(args.num_classes, data_shape,
-                                  max_iter=max(1, args.num_examples
-                                               // max(batch, 1)),
-                                  dtype=getattr(args, "data_dtype",
-                                                "float32"),
-                                  mean=mean_b, std=std_b)
-        return train, None
-    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
     mean = [float(x) for x in args.rgb_mean.split(",")]
     std = [float(x) for x in args.rgb_std.split(",")]
     dtype = getattr(args, "data_dtype", "float32")
+    if args.benchmark or not args.data_train:
+        batch = args.batch_size
+        data_shape = (batch,) + image_shape
+        train = SyntheticDataIter(args.num_classes, data_shape,
+                                  max_iter=max(1, args.num_examples
+                                               // max(batch, 1)),
+                                  dtype=dtype, mean=mean, std=std)
+        return train, None
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
     train = mx.io.ImageRecordIter(
         path_imgrec=args.data_train, data_shape=image_shape,
         batch_size=args.batch_size, shuffle=True, dtype=dtype,

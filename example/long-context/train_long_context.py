@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Long-context training demo: sequence-parallel ring attention.
+
+The reference framework (MXNet 1.2, `example/rnn/`) handles long
+sequences with truncated-BPTT RNNs; this TPU-native stack replaces that
+with a transformer whose attention is SHARDED OVER THE SEQUENCE axis
+(`sp` mesh axis): each device holds S/sp of the tokens, KV blocks rotate
+around the ring via `ppermute` (ICI-neighbor traffic only), and the
+per-chunk flash kernel merges partial softmax statistics exactly
+(mxnet_tpu/parallel/ring_attention.py). Memory per device is O(S/sp),
+so context length scales linearly with the ring size.
+
+Runs on real multi-chip meshes or a virtual CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python train_long_context.py --dp 2 --sp 4 --seq-len 512
+
+The corpus is a fixed pool of periodic sequences (each token repeats
+`lag` positions later), sampled per step like an epoch over a small
+dataset: every answer is present in-context `lag` tokens back, and the
+pool is small enough that loss collapses within ~150 steps — fast
+convergence evidence that the sharded-attention training loop learns.
+(Fully-random copy batches also train, but induction-head formation
+takes thousands of steps — too slow for a demo.)
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser(description="ring-attention LM demo")
+    ap.add_argument("--dp", type=int, default=2, help="data-parallel ways")
+    ap.add_argument("--sp", type=int, default=4,
+                    help="sequence-parallel ways (ring size)")
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=32)
+    ap.add_argument("--lag", type=int, default=96,
+                    help="copy distance (must be < seq-len)")
+    ap.add_argument("--pool", type=int, default=32,
+                    help="corpus size (distinct sequences)")
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--attn", choices=("ring", "ulysses"), default="ring")
+    args = ap.parse_args()
+    if not 0 < args.lag < args.seq_len:
+        ap.error("--lag must be in (0, seq-len): the copy structure only "
+                 "exists when the answer fits inside the context")
+
+    import numpy as np
+    import jax
+
+    from mxnet_tpu.parallel.mesh import get_mesh
+    from mxnet_tpu.parallel.sharded_step import ShardedTrainStep
+    from mxnet_tpu.models.transformer import (
+        TransformerConfig, init_transformer, transformer_loss,
+        transformer_sharding_rules)
+
+    n_needed = args.dp * args.sp
+    if len(jax.devices()) < n_needed:
+        raise SystemExit("need %d devices (dp*sp); have %d — set "
+                         "XLA_FLAGS=--xla_force_host_platform_device_count"
+                         % (n_needed, len(jax.devices())))
+
+    mesh = get_mesh(dp=args.dp, tp=1, pp=1, sp=args.sp,
+                    devices=jax.devices()[:n_needed])
+    cfg = TransformerConfig(vocab_size=args.vocab,
+                            num_layers=args.num_layers,
+                            num_heads=args.num_heads, d_model=args.d_model,
+                            max_len=args.seq_len, attn_impl=args.attn,
+                            block_k=max(16, args.seq_len // (4 * args.sp)))
+    params = init_transformer(cfg, jax.random.PRNGKey(0))
+    rules = transformer_sharding_rules(cfg, mesh)
+    step = ShardedTrainStep(
+        lambda p, b: transformer_loss(p, b["tokens"], b["targets"], cfg,
+                                      mesh=mesh),
+        mesh, rules, optimizer="adam", lr=args.lr, grad_clip=1.0)
+    step.init(params)
+
+    rng = np.random.RandomState(0)
+    # fixed pool of periodic sequences: token t reappears at t + lag
+    pool = rng.randint(1, args.vocab, (args.pool, args.seq_len + 1),
+                       dtype=np.int64)
+    pool[:, args.lag:] = pool[:, :-args.lag]
+    pool = pool.astype(np.int32)
+
+    def make_batch():
+        toks = pool[rng.randint(0, args.pool, args.batch)]
+        return {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+    first = last = None
+    for i in range(args.steps):
+        loss = float(step(make_batch()))
+        if first is None:
+            first = loss
+        last = loss
+        if i % 10 == 0 or i == args.steps - 1:
+            print("step %3d  loss %.4f  (mesh dp=%d sp=%d, %s attention)"
+                  % (i, loss, args.dp, args.sp, args.attn), flush=True)
+    print("first->last loss: %.4f -> %.4f" % (first, last))
+    assert last < first * 0.7, "no learning signal"
+    print("long-context %s attention training OK" % args.attn)
+
+
+if __name__ == "__main__":
+    main()

@@ -139,3 +139,13 @@ def test_train_imagenet_uint8_pipeline(tmp_path):
                 "--num-epochs", "2", "--num-examples", "64",
                 "--kv-store", "tpu_sync", "--lr", "0.05"])
     assert re.search(r"Epoch\[1\]", out), out[-2000:]
+
+
+def test_long_context_ring_attention_example():
+    """Sequence-parallel ring-attention LM demo over a dp=2 x sp=4 virtual
+    mesh (SURVEY 5.7 first-class long-context path, user-facing)."""
+    out = _run([os.path.join(EX, "long-context", "train_long_context.py"),
+                "--dp", "2", "--sp", "4", "--seq-len", "192",
+                "--lag", "48", "--steps", "120", "--batch", "8"],
+               timeout=1500)
+    assert "long-context ring attention training OK" in out, out[-2000:]

@@ -525,3 +525,96 @@ def test_spatial_transformer_vs_torch():
     _assert_close(grads["data"], td.grad.numpy(), "stn ddata")
     _assert_close(grads["loc"], tt.grad.numpy(), "stn dloc",
                   rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------- batchnorm modes ----
+
+
+def test_batchnorm_inference_vs_torch():
+    """BatchNorm eval mode / use_global_stats: normalizes with the moving
+    stats, torch's eval-mode batch_norm is the oracle."""
+    rng = np.random.RandomState(23)
+    x = rng.normal(size=(4, 3, 5, 5)).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, (3,)).astype(np.float32)
+    beta = rng.normal(size=(3,)).astype(np.float32)
+    mmean = rng.normal(size=(3,)).astype(np.float32)
+    mvar = rng.uniform(0.5, 2.0, (3,)).astype(np.float32)
+    eps = 1e-3
+    for use_global in (False, True):
+        # is_train=False OR use_global_stats=True both take the
+        # moving-stats path (reference batch_norm-inl.h)
+        sym = mx.sym.BatchNorm(mx.sym.Variable("x"), fix_gamma=False,
+                               eps=eps, use_global_stats=use_global,
+                               name="bn")
+        exe = sym.simple_bind(mx.cpu(), grad_req="null", x=x.shape)
+        exe.arg_dict["x"][:] = x
+        exe.arg_dict["bn_gamma"][:] = gamma
+        exe.arg_dict["bn_beta"][:] = beta
+        exe.aux_dict["bn_moving_mean"][:] = mmean
+        exe.aux_dict["bn_moving_var"][:] = mvar
+        out = exe.forward(is_train=use_global)[0].asnumpy()
+        ty = F.batch_norm(torch.tensor(x), torch.tensor(mmean),
+                          torch.tensor(mvar), torch.tensor(gamma),
+                          torch.tensor(beta), training=False, eps=eps)
+        _assert_close(out, ty.numpy(),
+                      "bn eval (use_global=%s)" % use_global,
+                      rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_fix_gamma_semantics():
+    """fix_gamma=True (the reference DEFAULT) scales by 1 regardless of
+    the gamma buffer's contents."""
+    rng = np.random.RandomState(24)
+    x = rng.normal(size=(4, 3, 5, 5)).astype(np.float32)
+    beta = rng.normal(size=(3,)).astype(np.float32)
+    eps = 1e-3
+    sym = mx.sym.BatchNorm(mx.sym.Variable("x"), fix_gamma=True, eps=eps,
+                           name="bn")
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", x=x.shape)
+    exe.arg_dict["x"][:] = x
+    exe.arg_dict["bn_gamma"][:] = np.full((3,), 7.7, np.float32)  # ignored
+    exe.arg_dict["bn_beta"][:] = beta
+    out = exe.forward(is_train=True)[0].asnumpy()
+    ty = F.batch_norm(torch.tensor(x), torch.zeros(3), torch.ones(3),
+                      torch.ones(3), torch.tensor(beta), training=True,
+                      eps=eps)
+    _assert_close(out, ty.numpy(), "bn fix_gamma", rtol=1e-3, atol=1e-3)
+
+
+# ------------------------------------------------------- correlation ----
+
+
+def _naive_correlation(d1, d2, max_disp, stride2, pad, is_multiply):
+    """Literal per-pixel reference implementation (kernel_size=1)."""
+    n, c, h, w = d1.shape
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = p1.shape[2:]
+    disps = list(range(-max_disp, max_disp + 1, stride2))
+    out = np.zeros((n, len(disps) ** 2, ph, pw), np.float32)
+    for oi, dy in enumerate(disps):
+        for oj, dx in enumerate(disps):
+            for y in range(ph):
+                for xx in range(pw):
+                    y2, x2 = y + dy, xx + dx
+                    if 0 <= y2 < ph and 0 <= x2 < pw:
+                        a = p1[:, :, y, xx]
+                        b = p2[:, :, y2, x2]
+                        v = (a * b if is_multiply
+                             else np.abs(a - b)).mean(axis=1)
+                        out[:, oi * len(disps) + oj, y, xx] = v
+    return out[:, :, pad:pad + h, pad:pad + w]
+
+
+@pytest.mark.parametrize("is_multiply", [True, False])
+def test_correlation_vs_naive(is_multiply):
+    """Correlation cost volume (FlowNet op) vs a literal per-pixel loop."""
+    rng = np.random.RandomState(25)
+    d1 = rng.normal(size=(2, 3, 6, 6)).astype(np.float32)
+    d2 = rng.normal(size=(2, 3, 6, 6)).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2),
+                            kernel_size=1, max_displacement=2, stride1=1,
+                            stride2=1, pad_size=2,
+                            is_multiply=is_multiply).asnumpy()
+    want = _naive_correlation(d1, d2, 2, 1, 2, is_multiply)
+    _assert_close(out, want, "correlation mult=%s" % is_multiply)
