@@ -15,13 +15,14 @@ Runs on real multi-chip meshes or a virtual CPU mesh:
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python train_long_context.py --dp 2 --sp 4 --seq-len 512
 
-The corpus is a fixed pool of periodic sequences (each token repeats
-`lag` positions later), sampled per step like an epoch over a small
-dataset: every answer is present in-context `lag` tokens back, and the
-pool is small enough that loss collapses within ~150 steps — fast
-convergence evidence that the sharded-attention training loop learns.
-(Fully-random copy batches also train, but induction-head formation
-takes thousands of steps — too slow for a demo.)
+The corpus is a fixed pool of periodic sequences (a lag-length random
+base tiled along the sequence), sampled per step like an epoch over a
+small dataset: every target at position >= lag is present in-context
+exactly `lag` tokens back, and the pool is small enough that loss
+collapses within ~150 steps — fast convergence evidence that the
+sharded-attention training loop learns. (Fully-random copy batches
+also train, but induction-head formation takes thousands of steps —
+too slow for a demo.)
 """
 import argparse
 import os
@@ -84,11 +85,12 @@ def main():
     step.init(params)
 
     rng = np.random.RandomState(0)
-    # fixed pool of periodic sequences: token t reappears at t + lag
-    pool = rng.randint(1, args.vocab, (args.pool, args.seq_len + 1),
-                       dtype=np.int64)
-    pool[:, args.lag:] = pool[:, :-args.lag]
-    pool = pool.astype(np.int32)
+    # fixed pool of TRULY periodic sequences (tiled lag-length base): every
+    # target at position >= lag equals the token exactly `lag` back, so
+    # the whole tail of each sequence is answerable from context
+    base = rng.randint(1, args.vocab, (args.pool, args.lag), dtype=np.int64)
+    reps = args.seq_len // args.lag + 2
+    pool = np.tile(base, (1, reps))[:, :args.seq_len + 1].astype(np.int32)
 
     def make_batch():
         toks = pool[rng.randint(0, args.pool, args.batch)]

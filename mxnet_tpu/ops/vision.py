@@ -44,12 +44,17 @@ def _roi_pooling(params, data, rois):
     ys = jnp.arange(H, dtype=jnp.float32)
     xs = jnp.arange(W, dtype=jnp.float32)
 
+    def _round_half_away(v):
+        # reference roi_pooling uses C round() = half AWAY from zero;
+        # jnp.round is half-to-even and diverges at .5 coordinates
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1] * scale)
-        y1 = jnp.round(roi[2] * scale)
-        x2 = jnp.round(roi[3] * scale)
-        y2 = jnp.round(roi[4] * scale)
+        x1 = _round_half_away(roi[1] * scale)
+        y1 = _round_half_away(roi[2] * scale)
+        x2 = _round_half_away(roi[3] * scale)
+        y2 = _round_half_away(roi[4] * scale)
         rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
         rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
         bin_h = rh / ph

@@ -618,3 +618,66 @@ def test_correlation_vs_naive(is_multiply):
                             is_multiply=is_multiply).asnumpy()
     want = _naive_correlation(d1, d2, 2, 1, 2, is_multiply)
     _assert_close(out, want, "correlation mult=%s" % is_multiply)
+
+
+# -------------------------------------------------------- roi pooling ----
+
+
+def test_roi_pooling_vs_naive():
+    """ROIPooling max-pool bins vs a literal loop with the reference
+    rounding conventions (round coords, floor/ceil bin edges, clamp to
+    >=1 cell, empty bin -> 0)."""
+    rng = np.random.RandomState(26)
+    data = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7],
+                     [0, 2, 2, 11, 9],     # exceeds bounds pre-scale
+                     [1, 4, 1, 6, 6],
+                     [1, 0, 0, 0, 0]],     # degenerate 1-cell roi
+                    np.float32)
+    ph, pw, scale = 3, 3, 0.75
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(ph, pw),
+                           spatial_scale=scale).asnumpy()
+
+    H = W = 8
+
+    def round_half_away(v):  # C round(): reference roi_pooling convention
+        return np.sign(v) * np.floor(np.abs(v) + 0.5)
+
+    want = np.zeros((len(rois), 3, ph, pw), np.float32)
+    for r, roi in enumerate(rois):
+        b = int(roi[0])
+        x1, y1, x2, y2 = [round_half_away(float(v) * scale)
+                          for v in roi[1:]]
+        rh = max(y2 - y1 + 1.0, 1.0)
+        rw = max(x2 - x1 + 1.0, 1.0)
+        for i in range(ph):
+            for j in range(pw):
+                ys_ = int(np.floor(y1 + i * rh / ph))
+                ye = int(np.ceil(y1 + (i + 1) * rh / ph))
+                xs_ = int(np.floor(x1 + j * rw / pw))
+                xe = int(np.ceil(x1 + (j + 1) * rw / pw))
+                ys_c, ye_c = max(ys_, 0), min(ye, H)
+                xs_c, xe_c = max(xs_, 0), min(xe, W)
+                if ys_c >= ye_c or xs_c >= xe_c:
+                    continue  # empty bin stays 0
+                want[r, :, i, j] = data[b, :, ys_c:ye_c,
+                                        xs_c:xe_c].max(axis=(1, 2))
+    _assert_close(out, want, "roi pooling")
+
+
+def test_dropout_statistics():
+    """Dropout train mode: empirical keep rate ~ (1-p) and kept values
+    scaled by 1/(1-p) (inverted dropout, reference dropout-inl.h)."""
+    from mxnet_tpu import autograd
+    p = 0.3
+    x = mx.nd.ones((200, 200))
+    with autograd.record(train_mode=True):
+        y = mx.nd.Dropout(x, p=p).asnumpy()
+    kept = y != 0
+    rate = kept.mean()
+    assert abs(rate - (1 - p)) < 0.02, rate
+    np.testing.assert_allclose(y[kept], 1.0 / (1 - p), rtol=1e-5)
+    # inference mode: identity
+    np.testing.assert_array_equal(
+        mx.nd.Dropout(x, p=p).asnumpy(), x.asnumpy())
