@@ -18,6 +18,7 @@ from jax import lax
 
 from ..base import Params, param_field, np_dtype, MXNetError
 from .registry import register_op, OPS, _ALIASES
+from .elemwise import round_half_away
 
 
 # ---------------------------------------------------------------------------
@@ -47,10 +48,11 @@ def _psroi_pooling(params, data, rois):
 
     def one_roi(roi):
         img = data[roi[0].astype(jnp.int32)]
-        x1 = jnp.round(roi[1]) * scale
-        y1 = jnp.round(roi[2]) * scale
-        x2 = (jnp.round(roi[3]) + 1.0) * scale
-        y2 = (jnp.round(roi[4]) + 1.0) * scale
+        # C-round ties-away (reference psroi_pooling.cc round())
+        x1 = round_half_away(roi[1]) * scale
+        y1 = round_half_away(roi[2]) * scale
+        x2 = (round_half_away(roi[3]) + 1.0) * scale
+        y2 = (round_half_away(roi[4]) + 1.0) * scale
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bh, bw = rh / k, rw / k

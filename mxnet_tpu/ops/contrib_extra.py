@@ -22,6 +22,7 @@ from jax import lax
 
 from ..base import Params, param_field, MXNetError
 from .registry import register_op
+from .elemwise import round_half_away
 
 
 # ---------------------------------------------------------------------------
@@ -213,10 +214,10 @@ def _deformable_psroi_pooling(params, data, rois, trans=None):
     def one_roi(roi, tr):
         img = data[roi[0].astype(jnp.int32)]
         # reference shifts roi by rounding to a 0.5-aligned grid
-        x1 = jnp.round(roi[1]) * scale - 0.5
-        y1 = jnp.round(roi[2]) * scale - 0.5
-        x2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
-        y2 = (jnp.round(roi[4]) + 1.0) * scale - 0.5
+        x1 = round_half_away(roi[1]) * scale - 0.5
+        y1 = round_half_away(roi[2]) * scale - 0.5
+        x2 = (round_half_away(roi[3]) + 1.0) * scale - 0.5
+        y2 = (round_half_away(roi[4]) + 1.0) * scale - 0.5
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bin_h = rh / k

@@ -13,6 +13,13 @@ import jax.numpy as jnp
 from ..base import Params, param_field, np_dtype
 from .registry import register_op
 
+
+def round_half_away(x):
+    """C round(): ties away from zero — the reference's `round` op and the
+    ROI-family coordinate convention (jnp.round is ties-to-even)."""
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
 # ---------------------------------------------------------------------------
 # unary
 # ---------------------------------------------------------------------------
@@ -29,8 +36,15 @@ _UNARY = {
     "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
     "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
     "degrees": jnp.degrees, "radians": jnp.radians,
-    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
-    "rint": jnp.rint, "trunc": jnp.trunc, "fix": jnp.trunc,
+    # rounding family follows the reference exactly (mshadow_op.h:335-356):
+    # round = C round() (ties AWAY from zero; jnp.round is ties-to-even),
+    # rint  = custom "(a-floor) <= (ceil-a) ? floor : ceil" (ties to FLOOR),
+    # fix   = trunc toward zero
+    "floor": jnp.floor, "ceil": jnp.ceil,
+    "round": lambda x: round_half_away(x),
+    "rint": lambda x: jnp.where(x - jnp.floor(x) <= jnp.ceil(x) - x,
+                                jnp.floor(x), jnp.ceil(x)),
+    "trunc": jnp.trunc, "fix": jnp.trunc,
     "sigmoid": jax.nn.sigmoid, "relu": jax.nn.relu,
     "softsign": jax.nn.soft_sign,
     "gamma": lambda x: jnp.exp(jax.lax.lgamma(x)),

@@ -421,3 +421,17 @@ def test_embedding_and_take_dtype():
     out = mx.nd.Embedding(idx, w, input_dim=4, output_dim=3)
     assert out.dtype == np.float32
     np.testing.assert_allclose(out.asnumpy(), w.asnumpy()[[0, 2]], rtol=1e-6)
+
+
+def test_round_rint_fix_tie_semantics():
+    """Reference rounding family (mshadow_op.h:335-356): round = C round()
+    (ties AWAY from zero), rint = ties toward FLOOR, fix = toward zero.
+    numpy's np.round (ties-to-even) differs at every odd half — pinned
+    here so nobody 'simplifies' back to jnp.round/jnp.rint."""
+    x = mx.nd.array([-2.5, -1.5, -0.5, 0.5, 1.5, 2.5, 1.4, -1.4])
+    np.testing.assert_array_equal(
+        mx.nd.round(x).asnumpy(), [-3., -2., -1., 1., 2., 3., 1., -1.])
+    np.testing.assert_array_equal(
+        mx.nd.rint(x).asnumpy(), [-3., -2., -1., 0., 1., 2., 1., -1.])
+    np.testing.assert_array_equal(
+        mx.nd.fix(x).asnumpy(), [-2., -1., -0., 0., 1., 2., 1., -1.])
