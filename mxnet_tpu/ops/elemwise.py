@@ -16,8 +16,14 @@ from .registry import register_op
 
 def round_half_away(x):
     """C round(): ties away from zero — the reference's `round` op and the
-    ROI-family coordinate convention (jnp.round is ties-to-even)."""
-    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+    ROI-family coordinate convention (jnp.round is ties-to-even).
+
+    lax.round's AWAY_FROM_ZERO mode is exact; a floor(|x|+0.5) composition
+    would mis-round wherever |x|+0.5 is inexact (e.g. 0.49999997f -> 1.0).
+    Integer dtypes pass through unchanged like the mshadow template."""
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer):
+        return x
+    return jax.lax.round(x, jax.lax.RoundingMethod.AWAY_FROM_ZERO)
 
 
 # ---------------------------------------------------------------------------
@@ -41,7 +47,7 @@ _UNARY = {
     # rint  = custom "(a-floor) <= (ceil-a) ? floor : ceil" (ties to FLOOR),
     # fix   = trunc toward zero
     "floor": jnp.floor, "ceil": jnp.ceil,
-    "round": lambda x: round_half_away(x),
+    "round": round_half_away,
     "rint": lambda x: jnp.where(x - jnp.floor(x) <= jnp.ceil(x) - x,
                                 jnp.floor(x), jnp.ceil(x)),
     "trunc": jnp.trunc, "fix": jnp.trunc,

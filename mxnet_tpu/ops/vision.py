@@ -23,6 +23,7 @@ from jax import lax
 
 from ..base import Params, param_field, MXNetError
 from .registry import register_op
+from .elemwise import round_half_away
 
 # ---------------------------------------------------------------------------
 # ROIPooling (roi_pooling.cc)
@@ -44,17 +45,13 @@ def _roi_pooling(params, data, rois):
     ys = jnp.arange(H, dtype=jnp.float32)
     xs = jnp.arange(W, dtype=jnp.float32)
 
-    def _round_half_away(v):
-        # reference roi_pooling uses C round() = half AWAY from zero;
-        # jnp.round is half-to-even and diverges at .5 coordinates
-        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
-
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
-        x1 = _round_half_away(roi[1] * scale)
-        y1 = _round_half_away(roi[2] * scale)
-        x2 = _round_half_away(roi[3] * scale)
-        y2 = _round_half_away(roi[4] * scale)
+        # reference roi_pooling uses C round() = ties AWAY from zero
+        x1 = round_half_away(roi[1] * scale)
+        y1 = round_half_away(roi[2] * scale)
+        x2 = round_half_away(roi[3] * scale)
+        y2 = round_half_away(roi[4] * scale)
         rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
         rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
         bin_h = rh / ph
