@@ -310,8 +310,12 @@ class NDArray:
     def __rdiv__(self, o): return self._binary(o, jnp.divide, True)
     def __truediv__(self, o):  return self._binary(o, jnp.divide)
     def __rtruediv__(self, o): return self._binary(o, jnp.divide, True)
-    def __mod__(self, o):  return self._binary(o, jnp.mod)
-    def __rmod__(self, o): return self._binary(o, jnp.mod, True)
+    def __mod__(self, o):  # reference mod: b==0 -> 0, not NaN
+        from ..ops.elemwise import _ref_mod
+        return self._binary(o, _ref_mod)
+    def __rmod__(self, o):
+        from ..ops.elemwise import _ref_mod
+        return self._binary(o, _ref_mod, True)
     def __pow__(self, o):  return self._binary(o, jnp.power)
     def __rpow__(self, o): return self._binary(o, jnp.power, True)
     def __neg__(self):     return _imp.apply_fn(jnp.negative, [self])[0]

@@ -14,6 +14,17 @@ from ..base import Params, param_field, np_dtype
 from .registry import register_op
 
 
+def _ref_mod(a, b):
+    """Reference mod (mshadow_op.h:394): floored modulo like numpy, except
+    b == 0 yields 0 rather than numpy's NaN (the reference guards it).
+
+    Double-where so the b==0 lanes never see mod's a/b term in the VJP
+    either — one where would leave 0 * inf = NaN in the divisor grad."""
+    zero = b == 0
+    safe = jnp.where(zero, jnp.ones_like(b), b)
+    return jnp.where(zero, 0.0, jnp.mod(a, safe)).astype(jnp.result_type(a, b))
+
+
 def round_half_away(x):
     """C round(): ties away from zero — the reference's `round` op and the
     ROI-family coordinate convention (jnp.round is ties-to-even).
@@ -82,7 +93,7 @@ register_op("softrelu")(lambda params, x: jnp.logaddexp(x, 0.0))
 
 _BINARY = {
     "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply, "div": jnp.divide,
-    "mod": jnp.mod, "power": jnp.power,
+    "mod": _ref_mod, "power": jnp.power,
     "maximum": jnp.maximum, "minimum": jnp.minimum,
     "hypot": jnp.hypot,
     "equal": lambda a, b: (a == b).astype(a.dtype),
@@ -134,8 +145,8 @@ _SCALAR = {
     "_mul_scalar": lambda x, s: x * s,
     "_div_scalar": lambda x, s: x / s,
     "_rdiv_scalar": lambda x, s: s / x,
-    "_mod_scalar": lambda x, s: jnp.mod(x, s),
-    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_mod_scalar": lambda x, s: _ref_mod(x, jnp.asarray(s, x.dtype)),
+    "_rmod_scalar": lambda x, s: _ref_mod(jnp.asarray(s, x.dtype), x),
     "_power_scalar": lambda x, s: jnp.power(x, s),
     "_rpower_scalar": lambda x, s: jnp.power(s, x),
     "_maximum_scalar": lambda x, s: jnp.maximum(x, s),

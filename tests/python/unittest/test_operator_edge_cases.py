@@ -435,3 +435,29 @@ def test_round_rint_fix_tie_semantics():
         mx.nd.rint(x).asnumpy(), [-3., -2., -1., 0., 1., 2., 1., -1.])
     np.testing.assert_array_equal(
         mx.nd.fix(x).asnumpy(), [-2., -1., -0., 0., 1., 2., 1., -1.])
+
+
+def test_mod_zero_divisor_and_signs():
+    """Reference mod (mshadow_op.h:394): floored modulo (sign of b) with
+    the b==0 guard returning 0 — numpy would give NaN there."""
+    a = mx.nd.array([5.0, -5.0, 5.0, -5.0, 3.0, -3.0])
+    b = mx.nd.array([3.0, 3.0, -3.0, -3.0, 0.0, 0.0])
+    want = [2.0, 1.0, -1.0, -2.0, 0.0, 0.0]
+    np.testing.assert_array_equal((a % b).asnumpy(), want)
+    np.testing.assert_array_equal(mx.nd.broadcast_mod(a, b).asnumpy(), want)
+    np.testing.assert_array_equal(
+        mx.nd._internal._mod_scalar(a, scalar=0.0).asnumpy(), np.zeros(6))
+
+
+def test_mod_zero_divisor_gradient_finite():
+    """b==0 lanes must not leak NaN into either operand's gradient
+    (double-where guard in _ref_mod)."""
+    from mxnet_tpu import autograd
+    a = mx.nd.array([5.0, 3.0])
+    b = mx.nd.array([2.0, 0.0])
+    a.attach_grad(); b.attach_grad()
+    with autograd.record():
+        out = mx.nd.broadcast_mod(a, b).sum()
+    out.backward()
+    assert np.isfinite(a.grad.asnumpy()).all(), a.grad.asnumpy()
+    assert np.isfinite(b.grad.asnumpy()).all(), b.grad.asnumpy()
