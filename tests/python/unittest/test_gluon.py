@@ -235,3 +235,28 @@ def test_split_and_load():
     assert norm > 1.0
     total = sum((a.asnumpy() ** 2).sum() for a in arrays)
     assert abs(np.sqrt(total) - 1.0) < 1e-4
+
+
+def test_model_zoo_all_families():
+    """One representative of EVERY zoo family builds, initializes, and
+    forwards (reference model_zoo surface: alexnet, densenet, inception,
+    mobilenet v1/v2, resnet v1/v2, squeezenet, vgg +-bn)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    cases = [
+        ("alexnet", 64),
+        ("densenet121", 32),
+        ("inceptionv3", 299),
+        ("mobilenet0.5", 32),
+        ("mobilenetv2_0.5", 32),
+        ("resnet50_v1", 32),
+        ("resnet34_v2", 32),
+        ("squeezenet1.1", 64),
+        ("vgg11", 32),
+        ("vgg11_bn", 32),
+    ]
+    for name, side in cases:
+        net = vision.get_model(name, classes=7)
+        net.initialize()
+        n = 1 if side > 100 else 2  # inception needs 299^2 (AvgPool(8))
+        out = net(mx.nd.random.uniform(shape=(n, 3, side, side)))
+        assert out.shape == (n, 7), (name, out.shape)
