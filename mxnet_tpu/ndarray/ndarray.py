@@ -202,7 +202,10 @@ class NDArray:
             shape = tuple(shape[0])
         if kwargs.get("shape"):
             shape = tuple(kwargs["shape"])
-        shape = _infer_reshape(self.shape, shape)
+        # full reference special-code semantics, shared with the Reshape op
+        from ..ops.tensor import _infer_reshape_shape
+        shape = _infer_reshape_shape(shape, self.shape,
+                                     bool(kwargs.get("reverse", False)))
         return _imp.apply_fn(lambda x: jnp.reshape(x, shape), [self])[0]
 
     def reshape_like(self, other):
@@ -435,13 +438,6 @@ class NDArray:
 
 
 NDArray.__le__ = lambda self, o: self._binary_cmp(o, jnp.less_equal)
-
-
-def _infer_reshape(cur_shape, shape):
-    """Support mxnet reshape special codes 0 (copy dim) and -1 (infer)."""
-    if 0 in shape:
-        shape = tuple(cur_shape[i] if s == 0 else s for i, s in enumerate(shape))
-    return shape
 
 
 def _new_from_jax(data, ctx=None):

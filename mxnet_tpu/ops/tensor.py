@@ -9,7 +9,7 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
-from ..base import Params, param_field, np_dtype
+from ..base import Params, param_field, np_dtype, MXNetError
 from .registry import register_op
 
 # ---------------------------------------------------------------------------
@@ -22,16 +22,90 @@ class ReshapeParam(Params):
     reverse = param_field(bool, default=False)
 
 
+def _infer_reshape_shape(spec, ishape, reverse=False):
+    """Full reference special-code semantics (matrix_op-inl.h:73
+    InferReshapeShape): 0 copy dim, -1 infer one dim, -2 copy all
+    remaining dims, -3 merge two consecutive dims, -4 split one dim into
+    the next two spec values (either may be -1). reverse=True literally
+    reverses input dims and spec before/after, exactly as the reference
+    does (which means -4 groups don't survive reversal there either)."""
+    ishape = list(ishape)
+    spec = list(spec)
+    if reverse:
+        ishape.reverse()
+        spec.reverse()
+    out, src, inf = [], 0, -1
+    i = 0
+    while i < len(spec):
+        s = spec[i]
+        if s == 0:
+            if src >= len(ishape):
+                raise MXNetError("Reshape: spec %s consumes more dims than "
+                                 "input shape %s has" % (spec, ishape))
+            out.append(ishape[src])
+            src += 1
+        elif s == -1:
+            if inf >= 0:
+                raise MXNetError("Reshape: one and only one dim can be -1")
+            inf = len(out)
+            out.append(1)
+            src += 1  # reference consumes an input dim here too
+        elif s == -2:
+            out.extend(ishape[src:])
+            src = len(ishape)
+        elif s == -3:
+            if src + 1 >= len(ishape):
+                raise MXNetError("Reshape -3: needs two input dims to merge")
+            out.append(ishape[src] * ishape[src + 1])
+            src += 2
+        elif s == -4:
+            if i + 2 >= len(spec) or src >= len(ishape):
+                raise MXNetError("Reshape -4: needs a source dim and two "
+                                 "split values")
+            d0 = ishape[src]
+            src += 1
+            d1, d2 = spec[i + 1], spec[i + 2]
+            i += 2
+            if d1 == -1 and d2 == -1:
+                raise MXNetError("Reshape -4: split dims cannot both be -1")
+            if 0 in (d1, d2):
+                raise MXNetError("Reshape -4: split dims must be positive "
+                                 "or -1, got (%s, %s)" % (d1, d2))
+            if d1 == -1:
+                d1 = d0 // d2
+            if d2 == -1:
+                d2 = d0 // d1
+            if d1 * d2 != d0:
+                raise MXNetError("Reshape -4: %d x %d != source dim %d"
+                                 % (d1, d2, d0))
+            out.extend([d1, d2])
+        else:
+            out.append(int(s))
+            src += 1
+        i += 1
+    if inf >= 0:
+        known = 1
+        for v in out:
+            known *= v
+        total = 1
+        for v in ishape:
+            total *= v
+        if known == 0 or total % known:
+            raise MXNetError("Reshape: cannot infer -1 (total %d vs known "
+                             "%d) for spec %s on %s"
+                             % (total, known, spec, ishape))
+        out[inf] = total // known
+    if reverse:
+        out.reverse()
+    return tuple(out)
+
+
 @register_op("Reshape", aliases=("reshape",), param_cls=ReshapeParam)
 def _reshape(params, x):
-    """Supports mxnet special codes 0 (keep) and -1 (infer); -2/-3/-4 unsupported→error."""
-    shape = list(params.shape)
-    for i, s in enumerate(shape):
-        if s == 0:
-            shape[i] = x.shape[i]
-        elif s in (-2, -3, -4):
-            raise NotImplementedError("reshape special code %d" % s)
-    return jnp.reshape(x, tuple(shape))
+    """All mxnet special codes (0/-1/-2/-3/-4, reverse) supported —
+    see _infer_reshape_shape."""
+    return jnp.reshape(x, _infer_reshape_shape(params.shape, x.shape,
+                                               params.reverse))
 
 
 class TransposeParam(Params):

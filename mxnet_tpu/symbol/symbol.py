@@ -366,7 +366,13 @@ class Symbol:
     def reshape(self, shape=None, **kwargs):
         if shape is None:
             shape = kwargs.pop("shape", None)
-        return self._apply_op("Reshape", shape=tuple(shape))
+        # NOT via _apply_op: its own `reverse` kwarg (operand ordering)
+        # would swallow Reshape's reverse attr
+        from . import _invoke_symbol
+        return _invoke_symbol(
+            get_op("Reshape"), [self],
+            {"shape": tuple(shape),
+             "reverse": bool(kwargs.pop("reverse", False))})
 
     def transpose(self, axes=()):
         return self._apply_op("transpose", axes=tuple(axes))

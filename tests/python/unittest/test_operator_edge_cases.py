@@ -461,3 +461,53 @@ def test_mod_zero_divisor_gradient_finite():
     out.backward()
     assert np.isfinite(a.grad.asnumpy()).all(), a.grad.asnumpy()
     assert np.isfinite(b.grad.asnumpy()).all(), b.grad.asnumpy()
+
+
+def test_reshape_special_codes_full_matrix():
+    """All reference reshape codes (matrix_op-inl.h InferReshapeShape):
+    0 keep, -1 infer (consumes an input slot like the reference), -2 copy
+    rest, -3 merge two, -4 split with one inferable side; plus reverse."""
+    x = mx.nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    cases = [
+        ((-1,), (24,)),
+        ((0, -1), (2, 12)),
+        ((-2,), (2, 3, 4)),
+        ((0, -2), (2, 3, 4)),
+        ((-3, 4), (6, 4)),
+        ((0, -3), (2, 12)),
+        ((-4, 1, 2, -2), (1, 2, 3, 4)),
+        ((-4, -1, 2, 0, 0), (1, 2, 3, 4)),
+        ((2, -4, 3, 1, 4), (2, 3, 1, 4)),
+    ]
+    for spec, want in cases:
+        out = mx.nd.reshape(x, shape=spec)
+        assert out.shape == want, (spec, out.shape, want)
+        np.testing.assert_array_equal(out.asnumpy().ravel(),
+                                      x.asnumpy().ravel())
+    # reverse=True matches from the right (reference example:
+    # (10, 5, 4) -> shape=(-1, 0), reverse -> (50, 4))
+    y = mx.nd.array(np.zeros((10, 5, 4), np.float32))
+    assert mx.nd.reshape(y, shape=(-1, 0), reverse=True).shape == (50, 4)
+    # errors: two -1s, bad -4 split
+    with pytest.raises(Exception):
+        mx.nd.reshape(x, shape=(-1, -1))
+    with pytest.raises(Exception):
+        mx.nd.reshape(x, shape=(-4, 5, 5, 0, 0))
+
+
+def test_reshape_method_paths_share_semantics():
+    """NDArray.reshape and Symbol.reshape route through the same
+    special-code inference as the Reshape op (incl. reverse)."""
+    from mxnet_tpu.base import MXNetError
+    x = mx.nd.array(np.zeros((2, 3, 4), np.float32))
+    assert x.reshape(-3, 4).shape == (6, 4)
+    assert x.reshape(shape=(0, -2)).shape == (2, 3, 4)
+    y = mx.nd.array(np.zeros((10, 5, 4), np.float32))
+    assert y.reshape(shape=(-1, 0), reverse=True).shape == (50, 4)
+    s = mx.sym.Variable("d").reshape(shape=(-1, 0), reverse=True)
+    _, outs, _ = s.infer_shape(d=(10, 5, 4))
+    assert outs[0] == (50, 4)
+    # malformed specs raise MXNetError, not IndexError/ZeroDivisionError
+    for bad in [(0, 0, 0, 0), (-4, 0, -1)]:
+        with pytest.raises(MXNetError):
+            mx.nd.reshape(x, shape=bad)
