@@ -1,0 +1,113 @@
+"""Deterministic random-graph fuzz: build small random symbolic graphs
+from a mixed op pool and cross-check the EXECUTOR path (one jitted
+program, symbol composition) against the EAGER path (imperative ops on
+NDArrays) — outputs AND input gradients must agree.
+
+This is integration coverage no per-op test provides: op chaining,
+broadcast interactions, shape inference through mixed chains, and the
+executor's fused fwd+bwd against imperative autograd.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+# (name, symbolic fn, eager fn, needs_positive_input)
+_UNARY_POOL = [
+    ("relu", lambda s: mx.sym.relu(s), lambda a: mx.nd.relu(a), False),
+    ("tanh", lambda s: mx.sym.tanh(s), lambda a: mx.nd.tanh(a), False),
+    ("sigmoid", lambda s: mx.sym.sigmoid(s), lambda a: mx.nd.sigmoid(a),
+     False),
+    ("exp", lambda s: mx.sym.exp(s * 0.1), lambda a: mx.nd.exp(a * 0.1),
+     False),
+    # self-safe domains: chains can make values negative, so feed
+    # x^2 + 0.5 into the domain-restricted ops
+    ("log", lambda s: mx.sym.log(mx.sym.square(s) + 0.5),
+     lambda a: mx.nd.log(mx.nd.square(a) + 0.5), False),
+    ("sqrt", lambda s: mx.sym.sqrt(mx.sym.square(s) + 0.5),
+     lambda a: mx.nd.sqrt(mx.nd.square(a) + 0.5), False),
+    ("square", lambda s: mx.sym.square(s), lambda a: mx.nd.square(a), False),
+    ("neg", lambda s: 0.0 - s, lambda a: 0.0 - a, False),
+    ("scale", lambda s: s * 1.7 + 0.3, lambda a: a * 1.7 + 0.3, False),
+    ("flatten_dense",
+     lambda s: mx.sym.FullyConnected(mx.sym.Flatten(s), num_hidden=6,
+                                     no_bias=True),
+     None, False),  # executor-only step (introduces a weight)
+    ("softmax", lambda s: mx.sym.softmax(s, axis=-1),
+     lambda a: mx.nd.softmax(a, axis=-1), False),
+    ("ln", lambda s: mx.sym.LayerNorm(s), None, False),
+    ("sum_keep", lambda s: mx.sym.sum(s, axis=-1, keepdims=True),
+     lambda a: mx.nd.sum(a, axis=-1, keepdims=True), False),
+    ("mean_bcast",
+     lambda s: mx.sym.broadcast_sub(s, mx.sym.mean(s, axis=-1,
+                                                   keepdims=True)),
+     lambda a: mx.nd.broadcast_sub(a, mx.nd.mean(a, axis=-1,
+                                                 keepdims=True)), False),
+    ("clip", lambda s: mx.sym.clip(s, -2.0, 2.0),
+     lambda a: mx.nd.clip(a, -2.0, 2.0), False),
+]
+
+
+def _build_chain(rng, depth):
+    """Random unary chain; returns (sym_fn applied to Variable, eager ops
+    list, needs_positive)."""
+    picks = [
+        _UNARY_POOL[rng.randint(0, len(_UNARY_POOL))] for _ in range(depth)]
+    return picks, False
+
+
+@pytest.mark.parametrize("seed", range(24))
+def test_random_chain_executor_matches_eager(seed):
+    rng = np.random.RandomState(100 + seed)
+    depth = rng.randint(2, 6)
+    picks, needs_pos = _build_chain(rng, depth)
+    shape = (int(rng.randint(2, 5)), int(rng.randint(2, 7)))
+    x = rng.uniform(0.2 if needs_pos else -1.0, 1.0,
+                    shape).astype(np.float32)
+
+    # symbolic
+    s = mx.sym.Variable("x")
+    for name, sym_fn, eager_fn, _ in picks:
+        s = sym_fn(s)
+    s_loss = mx.sym.sum(s)
+    exe = s_loss.simple_bind(mx.cpu(), grad_req="write", x=shape)
+    exe.arg_dict["x"][:] = x
+    rngw = np.random.RandomState(7)
+    for n, arr in exe.arg_dict.items():
+        if n != "x":
+            arr[:] = rngw.normal(0, 0.5, arr.shape).astype(np.float32)
+    out_exec = exe.forward(is_train=True)[0].asnumpy()
+    exe.backward()
+    gx_exec = exe.grad_dict["x"].asnumpy()
+
+    # eager replay — only when every op has an eager twin
+    if all(eager_fn is not None for _, _, eager_fn, _ in picks):
+        a = mx.nd.array(x)
+        a.attach_grad()
+        with autograd.record():
+            v = a
+            for _, _, eager_fn, _ in picks:
+                v = eager_fn(v)
+            loss = mx.nd.sum(v)
+        loss.backward()
+        np.testing.assert_allclose(out_exec, loss.asnumpy(), rtol=2e-5,
+                                   atol=2e-5, err_msg=str(picks))
+        np.testing.assert_allclose(gx_exec, a.grad.asnumpy(), rtol=2e-5,
+                                   atol=2e-5, err_msg=str(picks))
+    else:
+        # weightful chain: executor self-consistency via finite differences
+        eps = 1e-3
+        i, j = np.unravel_index(int(np.argmax(np.abs(gx_exec))), shape)
+        xp = x.copy()
+        xp[i, j] += eps
+        exe.arg_dict["x"][:] = xp
+        up = float(exe.forward(is_train=True)[0].asnumpy())
+        xm = x.copy()
+        xm[i, j] -= eps
+        exe.arg_dict["x"][:] = xm
+        down = float(exe.forward(is_train=True)[0].asnumpy())
+        fd = (up - down) / (2 * eps)
+        assert abs(fd - gx_exec[i, j]) < 5e-2 * max(1.0, abs(fd)), \
+            (picks, fd, gx_exec[i, j])
+    assert np.isfinite(out_exec).all() and np.isfinite(gx_exec).all()
