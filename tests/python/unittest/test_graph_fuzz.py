@@ -107,3 +107,35 @@ def test_random_chain_executor_matches_eager(seed):
         assert abs(fd - gx_exec[i, j]) < 5e-2 * max(1.0, abs(fd)), \
             (picks, fd, gx_exec[i, j])
     assert np.isfinite(out_exec).all() and np.isfinite(gx_exec).all()
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_chain_survives_json_roundtrip(seed):
+    """tojson -> load_json of a random chain reproduces identical outputs
+    (serialization parity over arbitrary op/attr combinations)."""
+    rng = np.random.RandomState(500 + seed)
+    picks = _build_chain(rng, rng.randint(2, 6))
+    shape = (3, 4)
+    x = rng.uniform(-1, 1, shape).astype(np.float32)
+
+    s = mx.sym.Variable("x")
+    for name, sym_fn, _ in picks:
+        s = sym_fn(s)
+    s2 = mx.sym.load_json(s.tojson())
+    assert s2.tojson() == s.tojson()  # stable fixed point
+
+    rngw = np.random.RandomState(11)
+
+    def run(sym):
+        exe = sym.simple_bind(mx.cpu(), grad_req="null", x=shape)
+        exe.arg_dict["x"][:] = x
+        for n, arr in exe.arg_dict.items():
+            if n != "x":
+                arr[:] = rngw.normal(0, 0.5, arr.shape).astype(np.float32)
+        return exe.forward(is_train=False)[0].asnumpy()
+
+    rngw = np.random.RandomState(11)
+    a = run(s)
+    rngw = np.random.RandomState(11)
+    b = run(s2)
+    np.testing.assert_array_equal(a, b, err_msg=str([p[0] for p in picks]))
