@@ -111,6 +111,14 @@ def load_params(fname):
     from earlier rounds of this repo still load)."""
     from .ndarray.utils import load as _nd_load
     data = _nd_load(fname)
+    if isinstance(data, (list, tuple)):
+        # the binary format can't distinguish an EMPTY named save from an
+        # empty list save (zero names, zero arrays) — a weightless graph's
+        # checkpoint round-trips through here
+        if data:
+            raise MXNetError("load_params: %s holds unnamed arrays, not "
+                             "arg:/aux: params" % fname)
+        return {}, {}
     arg_params, aux_params = {}, {}
     for k, v in data.items():
         if k.startswith("arg:"):

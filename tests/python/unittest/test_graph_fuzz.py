@@ -124,8 +124,6 @@ def test_random_chain_survives_json_roundtrip(seed):
     s2 = mx.sym.load_json(s.tojson())
     assert s2.tojson() == s.tojson()  # stable fixed point
 
-    rngw = np.random.RandomState(11)
-
     def run(sym):
         exe = sym.simple_bind(mx.cpu(), grad_req="null", x=shape)
         exe.arg_dict["x"][:] = x
@@ -139,3 +137,39 @@ def test_random_chain_survives_json_roundtrip(seed):
     rngw = np.random.RandomState(11)
     b = run(s2)
     np.testing.assert_array_equal(a, b, err_msg=str([p[0] for p in picks]))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_chain_checkpoint_roundtrip(seed, tmp_path):
+    """save_checkpoint/load_checkpoint on a random chain: reloaded symbol
+    + params predict identically (graph JSON + legacy .params binary)."""
+    import os
+    rng = np.random.RandomState(900 + seed)
+    picks = _build_chain(rng, rng.randint(2, 5))
+    shape = (4, 5)
+    x = rng.uniform(-1, 1, shape).astype(np.float32)
+
+    s = mx.sym.Variable("data")
+    for _, sym_fn, _ in picks:
+        s = sym_fn(s)
+    exe = s.simple_bind(mx.cpu(), grad_req="null", data=shape)
+    rngw = np.random.RandomState(13)
+    args = {}
+    for n, arr in exe.arg_dict.items():
+        if n != "data":
+            args[n] = mx.nd.array(
+                rngw.normal(0, 0.5, arr.shape).astype(np.float32))
+            arr[:] = args[n]
+    exe.arg_dict["data"][:] = x
+    want = exe.forward(is_train=False)[0].asnumpy()
+
+    prefix = os.path.join(str(tmp_path), "fz")
+    mx.model.save_checkpoint(prefix, 3, s, args, {})
+    s2, args2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    exe2 = s2.simple_bind(mx.cpu(), grad_req="null", data=shape)
+    for n, v in args2.items():
+        exe2.arg_dict[n][:] = v
+    exe2.arg_dict["data"][:] = x
+    got = exe2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(want, got,
+                                  err_msg=str([p[0] for p in picks]))
