@@ -173,3 +173,55 @@ def test_random_chain_checkpoint_roundtrip(seed, tmp_path):
     got = exe2.forward(is_train=False)[0].asnumpy()
     np.testing.assert_array_equal(want, got,
                                   err_msg=str([p[0] for p in picks]))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_gluon_net_hybridize_matches_eager(seed):
+    """Random HybridSequential stacks: hybridized (CachedOp/jit) output
+    and parameter gradients equal the eager run with identical params."""
+    from mxnet_tpu import gluon
+    rng = np.random.RandomState(700 + seed)
+    layers = []
+    width = int(rng.randint(3, 9))
+    for _ in range(rng.randint(1, 4)):
+        kind = rng.randint(0, 4)
+        if kind == 0:
+            layers.append(gluon.nn.Dense(width, activation="relu"))
+        elif kind == 1:
+            layers.append(gluon.nn.Dense(width))
+        elif kind == 2:
+            layers.append(gluon.nn.BatchNorm())
+        else:
+            layers.append(gluon.nn.LeakyReLU(0.2))
+    layers.append(gluon.nn.Dense(3))
+
+    def build():
+        net = gluon.nn.HybridSequential()
+        for l in layers:
+            net.add(l)
+        return net
+
+    x = mx.nd.array(rng.uniform(-1, 1, (5, 6)).astype(np.float32))
+    net = build()
+    net.initialize(mx.init.Xavier())
+
+    def run(hybrid):
+        if hybrid:
+            net.hybridize()
+        else:
+            net.hybridize(False)
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        grads = {k: p.grad().asnumpy().copy()
+                 for k, p in net.collect_params().items()
+                 if p.grad_req != "null"}
+        return loss.asnumpy().copy(), grads
+
+    l_eager, g_eager = run(False)
+    l_hyb, g_hyb = run(True)
+    np.testing.assert_allclose(l_eager, l_hyb, rtol=2e-5, atol=2e-5)
+    assert set(g_eager) == set(g_hyb)
+    for k in g_eager:
+        np.testing.assert_allclose(g_eager[k], g_hyb[k], rtol=2e-5,
+                                   atol=2e-5, err_msg=k)
