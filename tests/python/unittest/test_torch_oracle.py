@@ -681,3 +681,33 @@ def test_dropout_statistics():
     # inference mode: identity
     np.testing.assert_array_equal(
         mx.nd.Dropout(x, p=p).asnumpy(), x.asnumpy())
+
+
+# ------------------------------------------------ resize / upsampling ----
+
+
+def test_bilinear_resize_vs_torch():
+    """_contrib_BilinearResize2D uses align_corners=True (the reference
+    bilinear_resize-inl.h convention) — torch interpolate is the oracle."""
+    rng = np.random.RandomState(27)
+    x = rng.normal(size=(2, 3, 5, 7)).astype(np.float32)
+    for h, w in ((10, 14), (3, 4), (5, 7), (9, 5)):
+        out = mx.nd.contrib.BilinearResize2D(mx.nd.array(x), height=h,
+                                             width=w).asnumpy()
+        want = F.interpolate(torch.tensor(x), size=(h, w), mode="bilinear",
+                             align_corners=True).numpy()
+        _assert_close(out, want, "resize %dx%d" % (h, w))
+
+
+def test_upsampling_nearest_vs_torch():
+    rng = np.random.RandomState(28)
+    x = rng.normal(size=(2, 3, 4, 5)).astype(np.float32)
+    sym = mx.sym.UpSampling(mx.sym.Variable("x"), scale=3,
+                            sample_type="nearest", num_args=1)
+    tx = _torch_leaf(x)
+    ty = F.interpolate(tx, scale_factor=3, mode="nearest")
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x}, og)
+    _assert_close(out, ty.detach().numpy(), "upsample fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), "upsample dx")
