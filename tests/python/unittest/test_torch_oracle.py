@@ -711,3 +711,27 @@ def test_upsampling_nearest_vs_torch():
     out, grads = _run_mx(sym, {"x": x}, og)
     _assert_close(out, ty.detach().numpy(), "upsample fwd")
     _assert_close(grads["x"], tx.grad.numpy(), "upsample dx")
+
+
+@pytest.mark.parametrize("mode,tmode", [("constant", "constant"),
+                                        ("edge", "replicate"),
+                                        ("reflect", "reflect")])
+def test_pad_modes_vs_torch(mode, tmode):
+    """Pad constant/edge/reflect on NCHW spatial dims vs torch.nn.F.pad
+    (reference pad.cc supports spatial padding only)."""
+    rng = np.random.RandomState(29)
+    x = rng.normal(size=(2, 3, 5, 6)).astype(np.float32)
+    pw = (0, 0, 0, 0, 1, 2, 2, 1)  # (n, c, top, bottom, left, right) pairs
+    sym = mx.sym.Pad(mx.sym.Variable("x"), mode=mode, pad_width=pw,
+                     constant_value=0.7 if mode == "constant" else 0.0)
+    tx = _torch_leaf(x)
+    targs = (2, 1, 1, 2)  # torch order: (left, right, top, bottom)
+    if tmode == "constant":
+        ty = F.pad(tx, targs, mode="constant", value=0.7)
+    else:
+        ty = F.pad(tx, targs, mode=tmode)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x}, og)
+    _assert_close(out, ty.detach().numpy(), "pad fwd " + mode)
+    _assert_close(grads["x"], tx.grad.numpy(), "pad dx " + mode)
