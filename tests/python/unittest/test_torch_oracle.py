@@ -735,3 +735,23 @@ def test_pad_modes_vs_torch(mode, tmode):
     out, grads = _run_mx(sym, {"x": x}, og)
     _assert_close(out, ty.detach().numpy(), "pad fwd " + mode)
     _assert_close(grads["x"], tx.grad.numpy(), "pad dx " + mode)
+
+
+def test_deconvolution_grouped_vs_torch():
+    """num_group>1 Deconvolution: weight layout (C_in, F/g, kh, kw) with
+    per-group transposed conv — torch conv_transpose2d(groups=g) oracle."""
+    rng = np.random.RandomState(30)
+    n, cin, cout, g, hw, k = 2, 6, 4, 2, 5, 3
+    x = rng.normal(size=(n, cin, hw, hw)).astype(np.float32)
+    w = rng.normal(size=(cin, cout // g, k, k)).astype(np.float32)
+    sym = mx.sym.Deconvolution(mx.sym.Variable("x"), kernel=(k, k),
+                               num_filter=cout, num_group=g, stride=(2, 2),
+                               pad=(1, 1), no_bias=True, name="d")
+    tx, tw = _torch_leaf(x), _torch_leaf(w)
+    ty = F.conv_transpose2d(tx, tw, stride=2, padding=1, groups=g)
+    og = rng.normal(size=tuple(ty.shape)).astype(np.float32)
+    ty.backward(torch.tensor(og))
+    out, grads = _run_mx(sym, {"x": x, "d_weight": w}, og)
+    _assert_close(out, ty.detach().numpy(), "grouped deconv fwd")
+    _assert_close(grads["x"], tx.grad.numpy(), "grouped deconv dx")
+    _assert_close(grads["d_weight"], tw.grad.numpy(), "grouped deconv dw")
