@@ -116,3 +116,20 @@ def test_parse_log_markdown(tmp_path):
         text=True)
     assert "| 0 | 0.500000 | 0.400000 | 1.500000 |" in out
     assert "| 1 | 0.800000 | 0.700000 | 1.400000 |" in out
+
+
+def test_tpu_grind_resumes_from_results(tmp_path):
+    """tpu_grind skips phases already banked in --results (it must be
+    restartable without redoing work)."""
+    import json
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    from tpu_grind import PHASES  # single source of phase names
+    results = tmp_path / "r.jsonl"
+    lines = [json.dumps({"phase": p, "result": {"x": 1}}) for p in PHASES]
+    results.write_text("\n".join(lines) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "tpu_grind.py"),
+         "--results", str(results)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "all phases banked" in out.stdout
