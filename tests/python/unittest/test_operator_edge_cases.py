@@ -511,3 +511,32 @@ def test_reshape_method_paths_share_semantics():
     for bad in [(0, 0, 0, 0), (-4, 0, -1)]:
         with pytest.raises(MXNetError):
             mx.nd.reshape(x, shape=bad)
+
+
+def test_binary_op_duplicate_input_grad_accumulates():
+    """x used as BOTH operands (reference test_binary_op_duplicate_input):
+    d(x*x)/dx must accumulate to 2x through executor and autograd."""
+    from mxnet_tpu import autograd
+    xv = np.array([1.0, -2.0, 3.0], np.float32)
+    x = mx.sym.Variable("x")
+    y = mx.sym.elemwise_mul(x, x)
+    exe = y.simple_bind(mx.cpu(), grad_req="write", x=(3,))
+    exe.arg_dict["x"][:] = xv
+    exe.forward(is_train=True)
+    exe.backward(out_grads=mx.nd.ones(3))
+    np.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), 2 * xv)
+
+    a = mx.nd.array(xv)
+    a.attach_grad()
+    with autograd.record():
+        out = a * a
+    out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 2 * xv)
+
+    # and via three-fold use: x*x + x -> grad 2x + 1
+    b = mx.nd.array(xv)
+    b.attach_grad()
+    with autograd.record():
+        out = b * b + b
+    out.backward()
+    np.testing.assert_allclose(b.grad.asnumpy(), 2 * xv + 1)
