@@ -540,3 +540,21 @@ def test_binary_op_duplicate_input_grad_accumulates():
         out = b * b + b
     out.backward()
     np.testing.assert_allclose(b.grad.asnumpy(), 2 * xv + 1)
+
+
+def test_pick_axis_keepdims_matrix():
+    """pick value semantics across axes/keepdims (reference test_pick):
+    out[i] = data[i, idx[i]] along the picked axis."""
+    rng = np.random.RandomState(31)
+    x = rng.normal(size=(4, 5)).astype(np.float32)
+    idx = np.array([1, 0, 4, 2], np.float32)
+    out = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx), axis=1).asnumpy()
+    np.testing.assert_allclose(out, x[np.arange(4), idx.astype(int)])
+    outk = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx), axis=1,
+                      keepdims=True).asnumpy()
+    assert outk.shape == (4, 1)
+    np.testing.assert_allclose(outk[:, 0], out)
+    # axis=0 picks along rows
+    idx0 = np.array([0, 3, 1, 2, 0], np.float32)
+    out0 = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx0), axis=0).asnumpy()
+    np.testing.assert_allclose(out0, x[idx0.astype(int), np.arange(5)])
