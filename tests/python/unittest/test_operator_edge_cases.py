@@ -558,3 +558,20 @@ def test_pick_axis_keepdims_matrix():
     idx0 = np.array([0, 3, 1, 2, 0], np.float32)
     out0 = mx.nd.pick(mx.nd.array(x), mx.nd.array(idx0), axis=0).asnumpy()
     np.testing.assert_allclose(out0, x[idx0.astype(int), np.arange(5)])
+
+
+def test_reverse_flip_swapaxes_values():
+    """reverse/flip along axes + SwapAxis vs numpy (reference test_flip /
+    test_swapaxes value semantics)."""
+    rng = np.random.RandomState(32)
+    x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    np.testing.assert_array_equal(
+        mx.nd.reverse(mx.nd.array(x), axis=1).asnumpy(), x[:, ::-1])
+    np.testing.assert_array_equal(
+        mx.nd.flip(mx.nd.array(x), axis=2).asnumpy(), x[:, :, ::-1])
+    np.testing.assert_array_equal(
+        mx.nd.SwapAxis(mx.nd.array(x), dim1=0, dim2=2).asnumpy(),
+        np.swapaxes(x, 0, 2))
+    np.testing.assert_array_equal(
+        mx.nd.swapaxes(mx.nd.array(x), dim1=1, dim2=2).asnumpy(),
+        np.swapaxes(x, 1, 2))
