@@ -575,3 +575,22 @@ def test_reverse_flip_swapaxes_values():
     np.testing.assert_array_equal(
         mx.nd.swapaxes(mx.nd.array(x), dim1=1, dim2=2).asnumpy(),
         np.swapaxes(x, 1, 2))
+
+
+def test_where_and_maximum_minimum_scalar_values():
+    """where + maximum/minimum scalar forms (reference
+    test_maximum_minimum_scalar / test_where value semantics)."""
+    rng = np.random.RandomState(33)
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_array_equal(
+        mx.nd.maximum(mx.nd.array(x), 0.25).asnumpy(),
+        np.maximum(x, 0.25))
+    np.testing.assert_array_equal(
+        mx.nd.minimum(mx.nd.array(x), -0.25).asnumpy(),
+        np.minimum(x, -0.25))
+    cond = (x > 0).astype(np.float32)
+    y = rng.normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_array_equal(
+        mx.nd.where(mx.nd.array(cond), mx.nd.array(x),
+                    mx.nd.array(y)).asnumpy(),
+        np.where(cond != 0, x, y))
