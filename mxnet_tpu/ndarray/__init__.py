@@ -112,7 +112,35 @@ subtract = _binary_free_fn("__sub__")
 multiply = _binary_free_fn("__mul__")
 divide = _binary_free_fn("__truediv__")
 true_divide = divide
-modulo = _binary_free_fn("__mod__")  # `power` already op-generated above
+modulo = _binary_free_fn("__mod__")
+
+
+def _binary_or_scalar(tensor_op, jnp_fn, py_fn):
+    """Reference ndarray.py maximum/minimum/power free functions: NDArray
+    pairs use the tensor op; a scalar operand is applied as a raw python
+    number (jax weak typing keeps int arrays int); two plain scalars
+    return the plain python result, as the reference does."""
+    import jax.numpy as jnp_mod
+
+    def fn(lhs, rhs):
+        if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+            return _GENERATED[tensor_op](lhs, rhs)
+        if isinstance(lhs, NDArray):
+            return _imp.apply_fn(lambda t: jnp_fn(jnp_mod, t, rhs), [lhs])[0]
+        if isinstance(rhs, NDArray):
+            return _imp.apply_fn(lambda t: jnp_fn(jnp_mod, lhs, t), [rhs])[0]
+        return py_fn(lhs, rhs)
+    return fn
+
+
+import builtins as _builtins  # noqa: E402  (module attrs `max`/`min` are
+#                               the generated REDUCE ops — don't capture them)
+maximum = _binary_or_scalar("maximum", lambda m, a, b: m.maximum(a, b),
+                            lambda a, b: _builtins.max(a, b))
+minimum = _binary_or_scalar("minimum", lambda m, a, b: m.minimum(a, b),
+                            lambda a, b: _builtins.min(a, b))
+power = _binary_or_scalar("power", lambda m, a, b: m.power(a, b),
+                          lambda a, b: a ** b)
 
 
 def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0,

@@ -594,3 +594,30 @@ def test_where_and_maximum_minimum_scalar_values():
         mx.nd.where(mx.nd.array(cond), mx.nd.array(x),
                     mx.nd.array(y)).asnumpy(),
         np.where(cond != 0, x, y))
+
+
+def test_maximum_minimum_power_scalar_dispatch():
+    """free-fn maximum/minimum/power accept scalar operands on either side
+    (reference ndarray.py free functions dispatch to *_scalar ops)."""
+    rng = np.random.RandomState(34)
+    x = rng.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    np.testing.assert_allclose(mx.nd.power(mx.nd.array(x), 2.0).asnumpy(),
+                               x ** 2, rtol=1e-6)
+    np.testing.assert_allclose(mx.nd.power(2.0, mx.nd.array(x)).asnumpy(),
+                               2.0 ** x, rtol=1e-6)
+    np.testing.assert_array_equal(
+        mx.nd.maximum(0.9, mx.nd.array(x)).asnumpy(), np.maximum(0.9, x))
+    np.testing.assert_array_equal(
+        mx.nd.minimum(mx.nd.array(x), mx.nd.array(x[::-1])).asnumpy(),
+        np.minimum(x, x[::-1]))
+
+
+def test_scalar_free_fn_dtype_and_pure_python():
+    """free-fn scalar forms keep integer dtypes (jax weak typing) and two
+    plain scalars return plain python results like the reference."""
+    a = mx.nd.array(np.array([2, 3], np.int32))
+    p = mx.nd.power(a, 2)
+    assert p.dtype == np.int32 and list(p.asnumpy()) == [4, 9]
+    assert mx.nd.power(2, 3) == 8
+    assert mx.nd.maximum(2, 3) == 3
+    assert mx.nd.minimum(2.5, 3) == 2.5
