@@ -37,6 +37,13 @@ PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
+# Committed ledger of TPU-measured phase results, written by
+# tools/tpu_grind.py whenever the flapping chip answers. When a LIVE phase
+# attempt fails (or only a CPU rescue ran), the banked TPU number is
+# reported instead — explicitly labeled with when/what-commit it was
+# measured, so the provenance of every figure stays inspectable. A live
+# TPU result always wins over the bank.
+BANK_PATH = os.path.join(_HERE, "bench_banked.jsonl")
 
 
 def _child_env(force_cpu):
@@ -69,6 +76,85 @@ def _run_child(phase, force_cpu, timeout_s):
                 continue
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
     return None, "rc=%d: %s" % (proc.returncode, " | ".join(tail))
+
+
+BANK_MAX_AGE_S = int(os.environ.get("BENCH_BANK_MAX_AGE_S", "86400"))
+
+
+def _load_bank(path=None, now=None):
+    """{phase: newest TPU-platform ledger entry} from bench_banked.jsonl.
+
+    Entries older than BANK_MAX_AGE_S (default 24h — roughly one build
+    round) are discarded: a ledger from a long-gone commit must not keep
+    masquerading as current perf after regressions could have landed."""
+    bank = {}
+    now = time.time() if now is None else now
+    try:
+        with open(path or BANK_PATH) as f:
+            for line in f:
+                # provenance must be explicit and well-formed — a line
+                # missing platform or ts (old ledger formats, hand edits,
+                # truncated writes) fails CLOSED, never "defaults to fresh
+                # TPU". Malformed lines must also never kill the bench:
+                # emitting the output line outranks reading every entry.
+                try:
+                    entry = json.loads(line)
+                    if (isinstance(entry, dict)
+                            and entry.get("phase")
+                            and isinstance(entry.get("result"), dict)
+                            and isinstance(entry.get("platform"), str)
+                            and entry["platform"] not in ("cpu", "")
+                            and isinstance(entry.get("ts"), (int, float))
+                            and now - entry["ts"] <= BANK_MAX_AGE_S):
+                        bank[entry["phase"]] = entry  # later lines overwrite
+                except (ValueError, TypeError, AttributeError):
+                    continue
+    except OSError:
+        pass
+    return bank
+
+
+def _apply_bank(results, extra, bank, allowed_phases=None):
+    """Overlay banked TPU phase results over missing/CPU-rescued phases.
+
+    Mutates `results` and `extra` in place; a live TPU result always wins,
+    and only phases this run actually attempted (`allowed_phases`) are
+    overlaid — an explicit skip (e.g. BENCH_SKIP_BF16) stays skipped.
+    Displaced live CPU numbers are preserved under live_cpu_* keys, and
+    every banked substitution is labeled per-phase with its measurement
+    time + commit. Banked entries carry `_banked` so downstream ratio
+    guards can refuse to mix banked and live operands."""
+    banked_used = {}
+    for phase, entry in bank.items():
+        if allowed_phases is not None and phase not in allowed_phases:
+            continue
+        live = results.get(phase)
+        if live is not None and live.get("_platform") != "cpu":
+            continue  # live TPU result wins
+        if live is not None:
+            for k, v in live.items():
+                if k != "_platform":
+                    extra.setdefault("live_cpu_%s" % k, v)
+        res = dict(entry["result"])
+        res["_platform"] = entry.get("platform", "tpu")
+        res["_banked"] = True
+        res["_commit"] = entry.get("commit", "?")
+        results[phase] = res
+        banked_used[phase] = "%s@%s" % (entry.get("iso", "?"),
+                                        entry.get("commit", "?"))
+    if banked_used:
+        extra["banked_phases"] = banked_used
+        extra["banked_note"] = (
+            "banked values were measured on this host's TPU by "
+            "tools/tpu_grind.py running the same bench.py phase code, at "
+            "the per-phase time+commit above; they substitute for phases "
+            "that produced no TPU result in this live run")
+        if "infer" in banked_used:
+            extra["platform"] = bank["infer"].get("platform", "tpu")
+            extra["device_kind"] = bank["infer"].get(
+                "device_kind", extra.get("device_kind", ""))
+            extra["value_source"] = "banked"
+    return banked_used
 
 
 def _emit(value, vs_baseline, extra):
@@ -109,8 +195,14 @@ def main():
     # 2) measurement phases, each in its own budgeted child
     phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
               "io_train", "infer_int8"]
-    if os.environ.get("BENCH_SKIP_BF16") or force_cpu:
-        phases.remove("train_bf16")
+    # single source of truth for operator-requested skips: also consulted
+    # by the bank overlay below, so an explicitly skipped phase can never
+    # come back via the ledger (outage removals like force_cpu CAN)
+    explicit_skips = {"train_bf16"} if os.environ.get("BENCH_SKIP_BF16") \
+        else set()
+    for p in explicit_skips | ({"train_bf16"} if force_cpu else set()):
+        if p in phases:
+            phases.remove(p)
     results = {}
     wedged = False
     for phase in phases:
@@ -181,13 +273,21 @@ def main():
     elif not force_cpu and "infer" not in results:
         _cpu_rescue(phases, "TPU died after probe; cpu rescue")
 
+    # 3b) banked-TPU fallback: phases with no live TPU result take the
+    #     committed grind ledger's number (same phase code, same chip,
+    #     earlier in the round). Live CPU rescues for those phases move
+    #     aside under live_cpu_* so nothing measured is hidden. Explicitly
+    #     skipped phases stay skipped (outage-removed ones don't).
+    allowed = [p for p in PHASE_BUDGET_S if p not in explicit_skips]
+    _apply_bank(results, extra, _load_bank(), allowed)
+
     # 4) merge
     infer = results.get("infer", {})
     value = infer.get("img_per_sec", 0.0)
     for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
                   "io_train", "infer_int8"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
-                      if k != "_platform"})
+                      if not k.startswith("_")})
     # mixed-platform runs (partial rescue): say which metric ran where
     plats = {ph: r.get("_platform") for ph, r in results.items()}
     if len(set(plats.values())) > 1:
@@ -205,7 +305,18 @@ def main():
                                         "float32", "train_fp32")
     ours_plat = results.get(ours_phase, {}).get("_platform")
     flax_plat = results.get("jax_baseline", {}).get("_platform")
-    if flax_ips and ours and ours_plat == flax_plat:
+    # numerator and denominator must share provenance: same platform AND
+    # both-live or both-banked — a banked number over a live one (or vice
+    # versa) spans commits/chip-states and the ratio would be noise
+    ours_banked = results.get(ours_phase, {}).get("_banked", False)
+    flax_banked = results.get("jax_baseline", {}).get("_banked", False)
+    # two banked operands must also come from the SAME commit: grind
+    # restarts can re-bank one side after in-repo code changed under it
+    same_bank_commit = (not (ours_banked and flax_banked)
+                        or (results[ours_phase].get("_commit")
+                            == results["jax_baseline"].get("_commit")))
+    if flax_ips and ours and ours_plat == flax_plat \
+            and ours_banked == flax_banked and same_bank_commit:
         # same chip for numerator and denominator, or the ratio is noise
         # (e.g. wedge rescue reran only the flax baseline on CPU)
         extra["vs_jax_flax"] = round(ours / flax_ips, 3)
@@ -214,8 +325,9 @@ def main():
             # numerator so the ratio can't masquerade as like-for-like
             extra["vs_jax_flax_ours_dtype"] = ours_dtype
     elif flax_ips and ours:
-        errors.append("vs_jax_flax skipped: ours on %s, flax on %s"
-                      % (ours_plat, flax_plat))
+        errors.append("vs_jax_flax skipped: ours on %s%s, flax on %s%s"
+                      % (ours_plat, " (banked)" if ours_banked else "",
+                         flax_plat, " (banked)" if flax_banked else ""))
     if errors:
         extra["errors"] = "; ".join(errors)[-800:]
     extra["bench_seconds"] = round(time.time() - t0, 1)

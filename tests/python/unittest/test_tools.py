@@ -125,7 +125,10 @@ def test_tpu_grind_resumes_from_results(tmp_path):
     sys.path.insert(0, os.path.join(_REPO, "tools"))
     from tpu_grind import PHASES  # single source of phase names
     results = tmp_path / "r.jsonl"
-    lines = [json.dumps({"phase": p, "result": {"x": 1}}) for p in PHASES]
+    import time as _time
+    lines = [json.dumps({"phase": p, "result": {"x": 1}, "platform": "tpu",
+                         "ts": _time.time(), "iso": "t", "commit": "c"})
+             for p in PHASES]
     results.write_text("\n".join(lines) + "\n")
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "tpu_grind.py"),
@@ -133,3 +136,108 @@ def test_tpu_grind_resumes_from_results(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stderr
     assert "all phases banked" in out.stdout
+
+
+# --- bench.py banked-TPU fallback (tools/tpu_grind.py ledger) ---------------
+
+def _bench_mod():
+    sys.path.insert(0, _REPO)
+    import bench
+    return bench
+
+
+def test_bench_load_bank_newest_tpu_entry_wins(tmp_path):
+    bench = _bench_mod()
+    ledger = tmp_path / "bank.jsonl"
+    ledger.write_text(
+        '{"phase": "infer", "result": {"img_per_sec": 100.0}, '
+        '"platform": "tpu", "iso": "old", "commit": "aaa", "ts": 50.0}\n'
+        'not json\n'
+        'null\n'
+        '42\n'
+        '{"phase": "infer", "result": {"img_per_sec": 150.0}, '
+        '"platform": "tpu", "ts": "yesterday"}\n'
+        '{"phase": "infer", "result": {"img_per_sec": 200.0}, '
+        '"platform": "tpu", "iso": "new", "commit": "bbb", "ts": 60.0}\n'
+        '{"phase": "flash", "result": {"flash_attn_tflops": 1.0}, '
+        '"platform": "cpu", "ts": 60.0}\n'
+        '{"phase": "io_train", "result": {"io_train_img_per_sec": 2.0}}\n')
+    bank = bench._load_bank(str(ledger), now=100.0)
+    # cpu-platform lines, provenance-less lines (no platform/ts — old
+    # ledger formats fail CLOSED), scalar JSON and bad-ts lines never bank
+    assert set(bank) == {"infer"}
+    assert bank["infer"]["result"]["img_per_sec"] == 200.0
+    assert bank["infer"]["iso"] == "new"
+
+
+def test_bench_apply_bank_overlay_semantics():
+    bench = _bench_mod()
+    bank = {
+        "infer": {"phase": "infer", "result": {"img_per_sec": 5000.0},
+                  "platform": "tpu", "device_kind": "TPU v5 lite",
+                  "iso": "2026-07-31T00:00:00Z", "commit": "abc1234"},
+        "train_fp32": {"phase": "train_fp32",
+                       "result": {"train_img_per_sec": 700.0},
+                       "platform": "tpu", "iso": "t", "commit": "c"},
+        "flash": {"phase": "flash", "result": {"flash_attn_tflops": 90.0},
+                  "platform": "tpu", "iso": "t", "commit": "c"},
+    }
+    # live run: infer CPU-rescued, train_fp32 ran on TPU, flash missing
+    results = {
+        "infer": {"img_per_sec": 4.6, "_platform": "cpu"},
+        "train_fp32": {"train_img_per_sec": 650.0, "_platform": "tpu"},
+    }
+    extra = {"platform": "cpu", "platform_fallback": "wedged"}
+    used = bench._apply_bank(results, extra, bank)
+    # CPU rescue displaced by the banked TPU number, preserved as live_cpu_*
+    assert results["infer"]["img_per_sec"] == 5000.0
+    assert results["infer"]["_platform"] == "tpu"
+    assert extra["live_cpu_img_per_sec"] == 4.6
+    # live TPU result is NOT displaced by an older banked one
+    assert results["train_fp32"]["train_img_per_sec"] == 650.0
+    assert "train_fp32" not in used
+    # missing phase filled from bank
+    assert results["flash"]["flash_attn_tflops"] == 90.0
+    # provenance labeling
+    assert extra["platform"] == "tpu"
+    assert extra["device_kind"] == "TPU v5 lite"
+    assert used["infer"].startswith("2026-07-31T00:00:00Z@abc1234")
+    assert "banked_note" in extra
+
+
+def test_bench_apply_bank_noop_without_ledger():
+    bench = _bench_mod()
+    results = {"infer": {"img_per_sec": 4.6, "_platform": "cpu"}}
+    extra = {"platform": "cpu"}
+    assert bench._apply_bank(results, extra, {}) == {}
+    assert extra == {"platform": "cpu"}
+    assert bench._load_bank("/nonexistent/path.jsonl") == {}
+
+
+def test_bench_load_bank_discards_stale_entries(tmp_path):
+    bench = _bench_mod()
+    ledger = tmp_path / "bank.jsonl"
+    ledger.write_text(
+        '{"phase": "infer", "result": {"img_per_sec": 1.0}, '
+        '"platform": "tpu", "ts": 1000.0}\n'
+        '{"phase": "flash", "result": {"flash_attn_tflops": 2.0}, '
+        '"platform": "tpu", "ts": 90000.0}\n')
+    bank = bench._load_bank(str(ledger), now=100000.0)
+    assert set(bank) == {"flash"}  # infer is > BANK_MAX_AGE_S old
+
+
+def test_bench_apply_bank_respects_allowed_phases():
+    bench = _bench_mod()
+    bank = {"train_bf16": {"phase": "train_bf16",
+                           "result": {"train_bf16_img_per_sec": 900.0},
+                           "platform": "tpu", "iso": "t", "commit": "c"}}
+    results, extra = {}, {}
+    # explicit skip (BENCH_SKIP_BF16): the phase is not in allowed -> no overlay
+    used = bench._apply_bank(results, extra, bank,
+                             allowed_phases=["infer", "train_fp32"])
+    assert used == {} and results == {} and extra == {}
+    # outage removal: phase allowed -> overlay happens and is marked banked
+    used = bench._apply_bank(results, extra, bank,
+                             allowed_phases=["train_bf16"])
+    assert results["train_bf16"]["_banked"] is True
+    assert "train_bf16" in used

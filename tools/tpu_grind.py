@@ -10,7 +10,10 @@ them: the driver's bench then skips multi-minute remote compiles) and
 appends its JSON result to --results.
 
 Usage (leave running in the background while the chip is flaky):
-    python tools/tpu_grind.py --results /tmp/grind_results.jsonl
+    python tools/tpu_grind.py
+The default --results is the repo's committed bench_banked.jsonl — the
+ledger bench.py's banked-TPU fallback reads; point it elsewhere only for
+experiments you do NOT want the driver's bench to pick up.
 """
 import argparse
 import json
@@ -21,7 +24,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-from bench import PHASES as _BENCH_PHASES, _child_env  # noqa: E402
+from bench import PHASES as _BENCH_PHASES, _child_env, _load_bank  # noqa: E402
 
 PHASES = [p for p in _BENCH_PHASES if p != "probe"]
 
@@ -46,28 +49,43 @@ def _run(phase, timeout_s):
     return None
 
 
+def _git_head():
+    try:
+        return subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                              cwd=REPO, capture_output=True,
+                              text=True).stdout.strip()
+    except OSError:
+        return ""
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--results", default="/tmp/grind_results.jsonl")
+    ap.add_argument("--results",
+                    default=os.path.join(REPO, "bench_banked.jsonl"))
     ap.add_argument("--probe-timeout", type=int, default=90)
     ap.add_argument("--phase-timeout", type=int, default=1500)
     ap.add_argument("--down-sleep", type=int, default=240)
     args = ap.parse_args()
 
-    done = set()
-    if os.path.exists(args.results):
-        for line in open(args.results):
-            try:
-                name = json.loads(line)["phase"]
-            except (ValueError, KeyError):
-                continue
-            if name in PHASES:  # stale/renamed phases must not count
-                done.add(name)
+    # resume through the same parse/filter bench.py's fallback will apply,
+    # so "banked" here can never drift from what the bench will actually use
+    done = {p for p in _load_bank(args.results) if p in PHASES}
 
     while len(done) < len(PHASES):
-        if _run("probe", args.probe_timeout) is None:
+        probe = _run("probe", args.probe_timeout)
+        if probe is None:
             print("[grind] backend down %s; sleeping %ds"
                   % (time.strftime("%H:%M:%S"), args.down_sleep), flush=True)
+            time.sleep(args.down_sleep)
+            continue
+        if probe.get("platform") == "cpu":
+            # jax can silently fall back to cpu while the TPU plugin fails
+            # to init — the same recoverable outage as a hung probe. Never
+            # bank cpu numbers (the bench fallback discards them); sleep
+            # and wait for the real backend to come back.
+            print("[grind] probe came up CPU (TPU init failing?) %s; "
+                  "sleeping %ds" % (time.strftime("%H:%M:%S"),
+                                    args.down_sleep), flush=True)
             time.sleep(args.down_sleep)
             continue
         for phase in PHASES:
@@ -81,7 +99,15 @@ def main():
                 break  # re-probe before spending another budget
             done.add(phase)
             with open(args.results, "a") as f:
-                f.write(json.dumps({"phase": phase, "result": res}) + "\n")
+                # provenance travels with every banked line so bench.py's
+                # banked-fallback can label exactly what ran where and when
+                f.write(json.dumps({
+                    "phase": phase, "result": res,
+                    "platform": probe.get("platform", "unknown"),
+                    "device_kind": probe.get("device_kind", ""),
+                    "ts": round(time.time(), 1),
+                    "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "commit": _git_head()}) + "\n")
             print("[grind] %s OK: %s" % (phase, json.dumps(res)), flush=True)
     print("[grind] all phases banked", flush=True)
 
