@@ -54,6 +54,15 @@ def default_use_pallas():
 _NEG_INF = -1e30
 
 
+def _fold_scale(q, sm_scale):
+    """q * sm_scale rounded back to q's dtype — ONE [block_q, d] multiply
+    per program instead of a [block_q, block_k] multiply per KV iteration.
+    All four kernels (fwd and bwd, plain and offset) must fold identically:
+    the bwd recomputes p = exp(s - lse) from the fwd-computed lse, and the
+    two stay bit-consistent only if s is produced from the same rounded q."""
+    return (q.astype(jnp.float32) * sm_scale).astype(q.dtype)
+
+
 def _mxu_qk(a, b):
     """[m, d] x [n, d] -> [m, n] contracting d WITHOUT materializing b.T —
     Mosaic feeds the MXU the transposed operand directly; an explicit
@@ -179,20 +188,28 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     Matmuls run in the input dtype (bf16 inputs -> full-rate MXU passes)
     with fp32 accumulation; softmax statistics are fp32 throughout.
+
+    VPU-load design (the softmax/elementwise work between MXU passes is
+    what bounds this kernel, not the matmuls): sm_scale is folded into q
+    once per program instead of a [block_q, block_k] multiply per KV
+    iteration, and the causal loop is SPLIT into an unmasked prefix (no
+    iotas/compare/select at all) plus the few boundary blocks that
+    actually straddle the diagonal.
     """
     q = q_ref[0]  # [block_q, d], input dtype
     block_q, d = q.shape
     qi = pl.program_id(1)
     q_off = qi * block_q
+    qs = _fold_scale(q, sm_scale)
 
     nblk = kv_len // block_k
 
-    def body(i, carry):
+    def body(i, carry, masked):
         acc, m_i, l_i = carry
         k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
-        s = _mxu_qk(q, k_blk) * sm_scale
-        if causal:
+        s = _mxu_qk(qs, k_blk)
+        if masked:
             q_pos = q_off + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = i * block_k + lax.broadcasted_iota(jnp.int32,
                                                        (block_q, block_k), 1)
@@ -209,12 +226,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     if causal:
-        # only blocks up to the causal frontier contribute
-        hi = lax.div(q_off + block_q + block_k - 1, block_k)
-        hi = jnp.minimum(hi, nblk)
+        # blocks < full_hi lie entirely below the diagonal (no masking);
+        # blocks in [full_hi, hi) straddle it; blocks >= hi are dead
+        full_hi = jnp.minimum(lax.div(q_off, block_k), nblk)
+        hi = jnp.minimum(lax.div(q_off + block_q + block_k - 1, block_k),
+                         nblk)
+        carry = lax.fori_loop(0, full_hi,
+                              functools.partial(body, masked=False),
+                              (acc0, m0, l0))
+        acc, m_i, l_i = lax.fori_loop(full_hi, hi,
+                                      functools.partial(body, masked=True),
+                                      carry)
     else:
-        hi = nblk
-    acc, m_i, l_i = lax.fori_loop(0, hi, body, (acc0, m0, l0))
+        acc, m_i, l_i = lax.fori_loop(0, nblk,
+                                      functools.partial(body, masked=False),
+                                      (acc0, m0, l0))
     l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     # lse ref carries a trailing lane dim of 1: TPU block shapes must be
@@ -244,24 +270,27 @@ def _flash_fwd_offs_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     q_off = offs_ref[0] + qi * block_q   # global query offset
     k_base = offs_ref[1]                 # global key offset
     nblk = kv_len // block_k
+    qs = _fold_scale(q, sm_scale)
 
-    def body(i, carry):
+    def body(i, carry, masked):
         acc, m_i, l_i = carry
         k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
-        s = _mxu_qk(q, k_blk) * sm_scale
-        if causal:
+        s = _mxu_qk(qs, k_blk)
+        if masked:
             q_pos = q_off + lax.broadcasted_iota(jnp.int32,
                                                  (block_q, block_k), 0)
             k_pos = k_base + i * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
-        # rows with every key masked keep m == -inf; exp(s - m) would be
-        # exp(0) = 1 there, so mask p explicitly
-        p = jnp.where(s > _NEG_INF / 2,
-                      jnp.exp(s - m_new[:, None]), 0.0)
-        alpha = jnp.where(m_i > _NEG_INF / 2, jnp.exp(m_i - m_new), 0.0)
+        # rows with every key masked keep m == -inf; substituting a per-row
+        # SAFE maximum makes exp underflow to exact 0 for them (and for
+        # masked entries), replacing two full-tile where()s with one
+        # per-row select
+        m_safe = jnp.where(m_new > _NEG_INF / 2, m_new, 0.0)
+        p = jnp.exp(s - m_safe[:, None])
+        alpha = jnp.exp(m_i - m_safe)
         l_new = l_i * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + jnp.dot(
             p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32)
@@ -270,7 +299,24 @@ def _flash_fwd_offs_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m_i, l_i = lax.fori_loop(0, nblk, body, (acc0, m0, l0))
+    if causal:
+        # ring chunks put this q shard at a dynamic global offset: blocks
+        # fully below the diagonal need no mask, blocks fully above it
+        # (ahead of the causal frontier) contribute nothing and are
+        # skipped outright
+        full_hi = jnp.clip(lax.div(q_off - k_base + 1, block_k), 0, nblk)
+        hi = jnp.clip(lax.div(q_off + block_q - k_base + block_k - 1,
+                              block_k), full_hi, nblk)
+        carry = lax.fori_loop(0, full_hi,
+                              functools.partial(body, masked=False),
+                              (acc0, m0, l0))
+        acc, m_i, l_i = lax.fori_loop(full_hi, hi,
+                                      functools.partial(body, masked=True),
+                                      carry)
+    else:
+        acc, m_i, l_i = lax.fori_loop(0, nblk,
+                                      functools.partial(body, masked=False),
+                                      (acc0, m0, l0))
     l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     lse_ref[0] = jnp.where(l_i > 0.0, m_i + jnp.log(l_safe),
@@ -337,7 +383,6 @@ def _flash_bwd_dq_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
                               lse_ref, deff_ref, dq_ref, *, sm_scale,
                               causal, block_k, kv_len):
     q = q_ref[0]
-    do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][:, 0]
     deff = deff_ref[0][:, 0]
     block_q, d = q.shape
@@ -345,32 +390,44 @@ def _flash_bwd_dq_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
     q_off = offs_ref[0] + qi * block_q
     k_base = offs_ref[1]
     nblk = kv_len // block_k
+    qs = _fold_scale(q, sm_scale)
+    do = do_ref[0].astype(v_ref.dtype)  # cast once, not per KV iteration
+    # fully-masked ring rows carry lse == -inf; a +BIG substitute makes
+    # exp(s - lse_safe) underflow to exact 0 for them, so no per-element
+    # guard is needed (masked entries have s == -inf and underflow too)
+    lse_safe = jnp.where(lse > _NEG_INF / 2, lse, -_NEG_INF)
 
-    def body(i, dq):
+    def body(i, dq, masked):
         k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
-        s = _mxu_qk(q, k_blk) * sm_scale
-        if causal:
+        s = _mxu_qk(qs, k_blk)
+        if masked:
             q_pos = q_off + lax.broadcasted_iota(jnp.int32,
                                                  (block_q, block_k), 0)
             k_pos = k_base + i * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.where((s > _NEG_INF / 2) & (lse[:, None] > _NEG_INF / 2),
-                      jnp.exp(s - lse[:, None]), 0.0)
-        dp = _mxu_qk(do.astype(v_blk.dtype), v_blk)
-        ds = p * (dp - deff[:, None]) * sm_scale
+        p = jnp.exp(s - lse_safe[:, None])
+        dp = _mxu_qk(do, v_blk)
+        ds = p * (dp - deff[:, None])   # sm_scale folded in after the loop
         return dq + jnp.dot(ds.astype(k_blk.dtype), k_blk,
                             preferred_element_type=jnp.float32)
 
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
     if causal:
-        # kv blocks entirely past the causal frontier contribute nothing
+        # blocks below the diagonal need no mask; blocks entirely past
+        # the causal frontier contribute nothing
+        full_hi = jnp.clip(lax.div(q_off - k_base + 1, block_k), 0, nblk)
         hi = jnp.clip(lax.div(q_off + block_q - k_base + block_k - 1,
-                              block_k), 0, nblk)
+                              block_k), full_hi, nblk)
+        dq = lax.fori_loop(0, full_hi,
+                           functools.partial(body, masked=False), dq0)
+        dq = lax.fori_loop(full_hi, hi,
+                           functools.partial(body, masked=True), dq)
     else:
-        hi = nblk
-    dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+        dq = lax.fori_loop(0, nblk,
+                           functools.partial(body, masked=False), dq0)
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
@@ -384,36 +441,48 @@ def _flash_bwd_dkv_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
     q_base = offs_ref[0]
     nblk = q_len // block_q
 
-    def body(i, carry):
+    def body(i, carry, masked):
         dk, dv = carry
+        # q pre-scaled by sm_scale: s comes out scaled, AND accumulating
+        # dk against the scaled q folds the ds * sm_scale multiply away
+        # (dk = sm_scale * sum ds'^T q  ==  sum ds'^T (q * sm_scale))
         q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        qs_blk = _fold_scale(q_blk, sm_scale)
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), 0]
         deff_blk = deff_ref[0, pl.ds(i * block_q, block_q), 0]
-        s = _mxu_qk(q_blk, k) * sm_scale
-        if causal:
+        s = _mxu_qk(qs_blk, k)
+        if masked:
             q_pos = q_base + i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_off + lax.broadcasted_iota(jnp.int32,
                                                  (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.where((s > _NEG_INF / 2)
-                      & (lse_blk[:, None] > _NEG_INF / 2),
-                      jnp.exp(s - lse_blk[:, None]), 0.0)
+        # per-row safe lse (see dq kernel): exp underflows to exact 0 for
+        # masked entries and for fully-masked ring rows — no tile-wide guard
+        lse_safe = jnp.where(lse_blk > _NEG_INF / 2, lse_blk, -_NEG_INF)
+        p = jnp.exp(s - lse_safe[:, None])
         dv = dv + _mxu_tn(p.astype(do_blk.dtype), do_blk)
         dp = _mxu_qk(do_blk.astype(v.dtype), v)
-        ds = p * (dp - deff_blk[:, None]) * sm_scale
-        dk = dk + _mxu_tn(ds.astype(q_blk.dtype), q_blk)
+        ds = p * (dp - deff_blk[:, None])
+        dk = dk + _mxu_tn(ds.astype(qs_blk.dtype), qs_blk)
         return dk, dv
 
+    zeros = (jnp.zeros((block_k, d), jnp.float32),
+             jnp.zeros((block_k, d), jnp.float32))
     if causal:
-        # q blocks entirely before this kv block never attend to it
+        # q blocks entirely before this kv block never attend to it;
+        # blocks entirely past the diagonal need no mask
         lo = jnp.clip(lax.div(k_off - q_base, block_q), 0, nblk)
+        mask_end = jnp.clip(lax.div(k_off + block_k - q_base + block_q - 1,
+                                    block_q), lo, nblk)
+        carry = lax.fori_loop(lo, mask_end,
+                              functools.partial(body, masked=True), zeros)
+        dk, dv = lax.fori_loop(mask_end, nblk,
+                               functools.partial(body, masked=False), carry)
     else:
-        lo = 0
-    dk, dv = lax.fori_loop(lo, nblk, body,
-                           (jnp.zeros((block_k, d), jnp.float32),
-                            jnp.zeros((block_k, d), jnp.float32)))
+        dk, dv = lax.fori_loop(0, nblk,
+                               functools.partial(body, masked=False), zeros)
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 

@@ -395,3 +395,44 @@ def test_pallas_offs_backward_with_lse_cotangent():
         if ko == 256:  # fully masked chunk: exact zeros
             assert float(jnp.abs(dq).max()) == 0.0
             assert float(jnp.abs(dk).max()) == 0.0
+
+
+def test_flash_causal_more_queries_than_keys():
+    """Cross-length causal attention (sq > sk and sq < sk): the unmasked-
+    prefix loop bound must clamp to the actual number of KV blocks.
+    Regression test for the unclamped full_hi that re-read the final KV
+    block for q blocks past the KV end (fwd lse wrong by log(k) per
+    duplicated block, bwd grads off by O(1))."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.kernels import flash_attention as _pub  # noqa: F401
+    import importlib
+    fa = importlib.import_module("mxnet_tpu.kernels.flash_attention")
+    rng = np.random.RandomState(3)
+    B, H, D = 1, 2, 16
+    for sq, sk in [(64, 16), (16, 64)]:
+        q = jnp.asarray(rng.normal(0, 1, (B, H, sq, D)).astype(np.float32))
+        k = jnp.asarray(rng.normal(0, 1, (B, H, sk, D)).astype(np.float32))
+        v = jnp.asarray(rng.normal(0, 1, (B, H, sk, D)).astype(np.float32))
+        sm = 0.25
+        out = fa._flash_attention_tpu(q, k, v, sm, True, 16, 16, True)
+        ref, _ = fa.attention_with_lse(q, k, v, causal=True, sm_scale=sm)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg="sq=%d sk=%d" % (sq, sk))
+
+        def loss_p(q, k, v):
+            return (fa._flash_attention_tpu(q, k, v, sm, True, 16, 16,
+                                            True) ** 2).sum()
+
+        def loss_r(q, k, v):
+            o, _ = fa.attention_with_lse(q, k, v, causal=True, sm_scale=sm)
+            return (o ** 2).sum()
+
+        gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4,
+                                       err_msg="d%s sq=%d sk=%d"
+                                       % (name, sq, sk))
