@@ -1,5 +1,8 @@
 #!/usr/bin/env python
-"""Benchmark harness — prints ONE JSON line for the driver.
+"""Benchmark harness — prints the driver's JSON result line (LAST line wins:
+when a banked ledger exists, a provisional banked-only line is emitted
+before the live phases so a mid-run kill still leaves TPU evidence; the
+final line supersedes it).
 
 Headline metric mirrors the reference's `benchmark_score.py` (docs/faq/perf.md):
 ResNet-50 inference images/sec at batch 32, vs the reference's best published
@@ -33,7 +36,7 @@ PROBE_TIMEOUT_S = 75
 PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy)
     "infer": 900, "train_fp32": 800, "train_bf16": 600,
     "jax_baseline": 700, "flash": 450, "io_train": 600,
-    "infer_int8": 600,
+    "infer_int8": 600, "train_big_batch": 900,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -192,15 +195,42 @@ def main():
     else:
         extra["platform"] = "cpu"
 
-    # 2) measurement phases, each in its own budgeted child
-    phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
-              "io_train", "infer_int8"]
-    # single source of truth for operator-requested skips: also consulted
-    # by the bank overlay below, so an explicitly skipped phase can never
-    # come back via the ledger (outage removals like force_cpu CAN)
+    # single source of truth for operator-requested skips: consulted by
+    # the phase list, the bank overlays, and the CPU-useless set below,
+    # so an explicitly skipped phase can never come back via the ledger
     explicit_skips = {"train_bf16"} if os.environ.get("BENCH_SKIP_BF16") \
         else set()
-    for p in explicit_skips | ({"train_bf16"} if force_cpu else set()):
+    allowed = [p for p in PHASE_BUDGET_S if p not in explicit_skips]
+
+    # 1b) provisional line from the banked ledger, emitted BEFORE the
+    #     long measurement phases: if the driver's own timeout kills this
+    #     process mid-run (round-2 failure mode), the last stdout JSON
+    #     line still carries banked TPU evidence instead of nothing. The
+    #     final line printed at the end supersedes it (last line wins).
+    prov_bank = _load_bank()
+    if prov_bank:
+        prov_results, prov_extra = {}, dict(extra)
+        _apply_bank(prov_results, prov_extra, prov_bank, allowed)
+        prov_val = prov_results.get("infer", {}).get("img_per_sec", 0.0)
+        for ph, r in prov_results.items():
+            if ph == "infer":
+                continue  # headline only — same extra shape as the final line
+            prov_extra.update({k: v for k, v in r.items()
+                               if not k.startswith("_")})
+        prov_extra["provisional"] = ("banked-only line emitted before "
+                                     "live phases; superseded by the "
+                                     "final line unless this run was "
+                                     "killed mid-measurement")
+        _emit(round(prov_val, 2), round(prov_val / BASELINE_INFER_P100, 3),
+              prov_extra)
+
+    # 2) measurement phases, each in its own budgeted child
+    phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
+              "io_train", "infer_int8", "train_big_batch"]
+    # phases that measure nothing useful on the CPU fallback (outage
+    # removals — unlike explicit_skips, the bank may still supply them)
+    cpu_useless = {"train_bf16", "train_big_batch"}
+    for p in explicit_skips | (cpu_useless if force_cpu else set()):
         if p in phases:
             phases.remove(p)
     results = {}
@@ -251,8 +281,8 @@ def main():
             extra["platform"] = "cpu"
         extra["platform_fallback"] = reason
         for phase in phase_list:
-            if phase in results or phase == "train_bf16":
-                continue  # bf16 on CPU measures nothing useful
+            if phase in results or phase in cpu_useless:
+                continue  # bf16 / big-batch on CPU measure nothing useful
             budget = min(PHASE_BUDGET_S[phase], max(0, int(remaining())))
             if budget < 90:
                 errors.append("%s: cpu rescue skipped (deadline)" % phase)
@@ -278,14 +308,13 @@ def main():
     #     earlier in the round). Live CPU rescues for those phases move
     #     aside under live_cpu_* so nothing measured is hidden. Explicitly
     #     skipped phases stay skipped (outage-removed ones don't).
-    allowed = [p for p in PHASE_BUDGET_S if p not in explicit_skips]
     _apply_bank(results, extra, _load_bank(), allowed)
 
     # 4) merge
     infer = results.get("infer", {})
     value = infer.get("img_per_sec", 0.0)
     for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
-                  "io_train", "infer_int8"):
+                  "io_train", "infer_int8", "train_big_batch"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
                       if not k.startswith("_")})
     # mixed-platform runs (partial rescue): say which metric ran where
@@ -389,7 +418,7 @@ def _phase_infer():
     return {"img_per_sec": _timed_score_loop(exe, batch, 224, n_iter)}
 
 
-def _fused_train_ips(compute_dtype=None):
+def _fused_train_ips(compute_dtype=None, batch=32, n_iter=None):
     """Fused train step (fwd+bwd+SGD in ONE jitted program, donated buffers)
     on a 1-device mesh — the `train_imagenet.py --kv-store tpu_sync` path.
     compute_dtype='bfloat16' additionally exercises the mixed-precision
@@ -400,7 +429,8 @@ def _fused_train_ips(compute_dtype=None):
     from mxnet_tpu.parallel.mesh import data_parallel_mesh
     from mxnet_tpu.parallel.tpu_step import DataParallelTrainStep
     platform = jax.devices()[0].platform
-    batch, n_iter = 32, (15 if platform != "cpu" else 2)
+    if n_iter is None:
+        n_iter = 15 if platform != "cpu" else 2
     mesh = data_parallel_mesh(jax.devices()[:1])
     sym = resnet.get_symbol(num_classes=1000, num_layers=50,
                             image_shape="3,224,224")
@@ -436,6 +466,27 @@ def _phase_train_fp32():
 
 def _phase_train_bf16():
     return {"train_bf16_img_per_sec": _fused_train_ips("bfloat16")}
+
+
+def _phase_train_big_batch():
+    """bf16 fused train at batch 256 — ours AND plain Flax in the same
+    child, same chip, for an honest large-batch ratio. The reference's
+    published numbers stop at batch 32 (2016-era GPU memory); a v5e's
+    MXU only saturates at larger batches, so this is where the TPU-first
+    design shows headroom rather than parity. TPU-only: measuring a
+    b256 ResNet-50 on the CPU fallback would burn minutes for noise."""
+    import jax
+    import jax.numpy as jnp
+    if jax.devices()[0].platform == "cpu":
+        return {}
+    ours = _fused_train_ips("bfloat16", batch=256, n_iter=8)
+    sys.path.insert(0, _HERE)
+    from tools import flax_baseline
+    flax_ips = flax_baseline.bench(batch=256, n_iter=8,
+                                   compute_dtype=jnp.bfloat16)
+    return {"train_bf16_b256_img_per_sec": ours,
+            "jax_train_b256_img_per_sec": round(flax_ips, 2),
+            "vs_jax_flax_b256": round(ours / flax_ips, 3)}
 
 
 def _phase_jax_baseline():
@@ -609,6 +660,7 @@ PHASES = {
     "flash": _phase_flash,
     "io_train": _phase_io_train,
     "infer_int8": _phase_infer_int8,
+    "train_big_batch": _phase_train_big_batch,
 }
 
 

@@ -80,6 +80,18 @@ def main():
     qs, k, v = attn_timing.make_inputs(B, H, S, D, n_iter, jnp.bfloat16)
     flops_fwd = attn_timing.causal_flops(B, H, S, D)
 
+    # anchor: the jnp blockwise path (pure XLA fusion, no Pallas) on the
+    # same shapes — tells us how much the hand-written kernel actually buys
+    try:
+        bw_tf, _ = attn_timing.timed_map_tflops(
+            lambda q, k_, v_: blockwise_attention(q, k_, v_, causal=True,
+                                                  block_k=512)[0],
+            qs, k, v, flops_fwd * n_iter)
+        print(json.dumps({"xla_blockwise_fwd_tflops": round(bw_tf, 2)}),
+              flush=True)
+    except Exception as e:
+        print(json.dumps({"xla_blockwise_error": str(e)[:120]}), flush=True)
+
     results = []
     for bq, bk in itertools.product((256, 512, 1024, 2048), repeat=2):
         if bq > S or bk > S:
