@@ -331,7 +331,7 @@ def _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal, block_q, block_k,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:
-        raise ValueError("seq lengths must divide block sizes")
+        raise ValueError("block sizes must divide the seq lengths")
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
@@ -593,7 +593,7 @@ def _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:
-        raise ValueError("seq lengths must divide block sizes")
+        raise ValueError("block sizes must divide the seq lengths")
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
@@ -622,6 +622,142 @@ def _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
 
 
 # ---------------------------------------------------------------------------
+# Grid-variant forward: KV as a third ("arbitrary") grid dimension with
+# VMEM scratch accumulators — the canonical TPU flash structure. Versus
+# the streaming kernel above (whole K/V resident in VMEM, fori_loop over
+# blocks) this keeps the FORWARD's VMEM at O(block_k) and hands the
+# KV-block pipeline to Mosaic's grid-level double buffering. (The shared
+# backward still stages full K/V per program, so the long-sequence VMEM
+# ceiling moves only for inference until a grid backward exists; ring
+# attention is the framework's answer for long-sequence training.)
+# Which forward is faster is an empirical, shape-dependent question —
+# tools/flash_tune.py sweeps both variants on-chip.
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_grid_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                           acc_ref, m_ref, l_ref, *, sm_scale, causal,
+                           block_q, block_k):
+    """One (batch*head, q-block, kv-block) program.
+
+    m/l scratch is [block_q, 128] with all lanes equal (lane-broadcast
+    state avoids sublane-strided column writes); acc is [block_q, d]
+    fp32. Output is flushed at the last KV step from scratch."""
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+    q_off = j * block_q
+    k_off = kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def tile(masked):
+        q = q_ref[0]
+        s = _mxu_qk(_fold_scale(q, sm_scale), k_ref[0])
+        if masked:
+            q_pos = q_off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[...]                         # [bq, 128], lanes equal
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)             # lanes equal
+        p = jnp.exp(s - m_new[:, :1])
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = (acc_ref[...] * alpha[:, :1]
+                        + jnp.dot(p.astype(v_ref.dtype), v_ref[0],
+                                  preferred_element_type=jnp.float32))
+
+    if causal:
+        # dead tile (entirely past the diagonal): skip all compute;
+        # boundary tile: masked; below-diagonal tile: mask-free
+        is_dead = k_off > q_off + block_q - 1
+        is_full = k_off + block_k - 1 <= q_off
+
+        @pl.when(jnp.logical_not(is_dead) & is_full)
+        def _full():
+            tile(masked=False)
+
+        @pl.when(jnp.logical_not(is_dead) & jnp.logical_not(is_full))
+        def _boundary():
+            tile(masked=True)
+    else:
+        tile(masked=False)
+
+    @pl.when(kb == n_kb - 1)
+    def _flush():
+        l_col = l_ref[:, :1]
+        l_safe = jnp.where(l_col == 0.0, 1.0, l_col)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(l_safe)
+
+
+def _flash_fwd_grid_pallas(q, k, v, sm_scale, causal, block_q, block_k,
+                           interpret=False):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError("block sizes must divide the seq lengths")
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    kernel = functools.partial(_flash_fwd_grid_kernel, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k)
+    if interpret:
+        params = None
+    else:
+        params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    if causal:
+        # dead tiles (kb past the causal frontier of q block j) skip
+        # compute via pl.when; clamping their KV index to the last LIVE
+        # block means the block index doesn't change across dead steps,
+        # so Mosaic skips their HBM->VMEM copies too (~2x KV traffic
+        # saved at sq == sk)
+        def kv_index(i, j, kb):
+            last_live = (j * block_q + block_q - 1) // block_k
+            return (i, jnp.minimum(kb, last_live), 0)
+    else:
+        def kv_index(i, j, kb):
+            return (i, kb, 0)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kb: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),     # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),   # m (lane-broadcast)
+            pltpu.VMEM((block_q, 128), jnp.float32),   # l (lane-broadcast)
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+# ---------------------------------------------------------------------------
 # Pallas backward kernels (FlashAttention-2): dq gridded over q blocks,
 # dk/dv gridded over kv blocks; both recompute P from the saved lse.
 # ---------------------------------------------------------------------------
@@ -638,24 +774,32 @@ def _flash_bwd_pallas(q, k, v, do, out, lse, sm_scale, causal, block_q,
                                   interpret)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fwd_dispatch(variant):
+    return {"stream": _flash_fwd_pallas,
+            "grid": _flash_fwd_grid_pallas}[variant]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_attention_tpu(q, k, v, sm_scale, causal, block_q, block_k,
-                         interpret):
-    out, _ = _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
-                               interpret)
+                         interpret, fwd_variant="stream"):
+    out, _ = _fwd_dispatch(fwd_variant)(q, k, v, sm_scale, causal,
+                                        block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd_pallas(q, k, v, sm_scale, causal, block_q, block_k,
-                                 interpret)
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                    fwd_variant="stream"):
+    out, lse = _fwd_dispatch(fwd_variant)(q, k, v, sm_scale, causal,
+                                          block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, do):
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret,
+                    fwd_variant, res, do):
     # Pallas FlashAttention-2 backward (dq kernel + dk/dv kernel), P
     # recomputed from the saved lse — no S materialization, no jnp
-    # fallback graph.
+    # fallback graph. Shared by both forward variants (they produce the
+    # same out/lse).
     q, k, v, out, lse = res
     return _flash_bwd_pallas(q, k, v, do, out, lse, sm_scale, causal,
                              block_q, block_k, interpret)
@@ -665,11 +809,15 @@ _flash_attention_tpu.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
-                    block_q=512, block_k=512, use_pallas=None):
+                    block_q=512, block_k=512, use_pallas=None,
+                    fwd_variant="stream"):
     """Fused attention over [B, H, S, D] tensors.
 
     `use_pallas=None` auto-selects: the Pallas kernel on TPU backends,
     blockwise jnp elsewhere (identical numerics up to fp tolerance).
+    `fwd_variant` picks the Pallas forward: "stream" (whole K/V in VMEM,
+    fori_loop over blocks) or "grid" (KV as an arbitrary grid dimension,
+    O(block_k) VMEM — required for very long sequences).
     """
     if sm_scale is None:
         sm_scale = 1.0 / _np.sqrt(q.shape[-1])
@@ -679,7 +827,7 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
                  and k.shape[2] % min(block_k, k.shape[2]) == 0)
     if use_pallas and ok_shapes:
         return _flash_attention_tpu(q, k, v, sm_scale, causal,
-                                    block_q, block_k, False)
+                                    block_q, block_k, False, fwd_variant)
     out, _ = blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                  block_k=block_k)
     return out

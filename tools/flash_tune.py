@@ -93,29 +93,32 @@ def main():
         print(json.dumps({"xla_blockwise_error": str(e)[:120]}), flush=True)
 
     results = []
-    for bq, bk in itertools.product((256, 512, 1024, 2048), repeat=2):
+    for variant, (bq, bk) in itertools.product(
+            ("stream", "grid"), itertools.product((256, 512, 1024, 2048),
+                                                  repeat=2)):
         if bq > S or bk > S:
             continue
         try:
             fwd_tf, _ = attn_timing.timed_map_tflops(
-                lambda q, k_, v_, bq=bq, bk=bk: flash_attention(
+                lambda q, k_, v_, bq=bq, bk=bk, fv=variant: flash_attention(
                     q, k_, v_, causal=True, block_q=bq, block_k=bk,
-                    use_pallas=True),
+                    use_pallas=True, fwd_variant=fv),
                 qs, k, v, flops_fwd * n_iter)
 
-            def loss(q_, k_, v_, bq=bq, bk=bk):
+            def loss(q_, k_, v_, bq=bq, bk=bk, fv=variant):
                 return (flash_attention(q_, k_, v_, causal=True, block_q=bq,
-                                        block_k=bk, use_pallas=True)
+                                        block_k=bk, use_pallas=True,
+                                        fwd_variant=fv)
                         ** 2).sum()
             bwd_tf, _ = attn_timing.timed_map_tflops(
                 lambda q, k_, v_, bq=bq, bk=bk: jax.grad(
                     loss, argnums=(0, 1, 2))(q, k_, v_),
                 qs, k, v, 3.5 * flops_fwd * n_iter)
-            row = {"block_q": bq, "block_k": bk,
+            row = {"variant": variant, "block_q": bq, "block_k": bk,
                    "fwd_tflops": round(fwd_tf, 2),
                    "fwd_bwd_tflops": round(bwd_tf, 2)}
         except Exception as e:
-            row = {"block_q": bq, "block_k": bk,
+            row = {"variant": variant, "block_q": bq, "block_k": bk,
                    "error": "%s: %s" % (type(e).__name__, str(e)[:120])}
         print(json.dumps(row), flush=True)
         results.append(row)
