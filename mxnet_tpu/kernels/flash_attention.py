@@ -487,21 +487,31 @@ def _flash_bwd_dkv_offs_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd_offs_pallas(q, k, v, offs, do, dlse, out, lse, sm_scale,
-                           causal, block_q, block_k, interpret=False):
+def _bwd_staging(q, k, v, do, dlse, out, lse):
+    """Flatten (b, h) and fold the lse cotangent into the per-row scalar
+    delta_eff = delta - dlse (see note above). ONE definition shared by
+    the streaming and grid backends: the deff contract is what keeps the
+    two variants' gradients interchangeable."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
     dof = do.reshape(b * h, sq, d)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
-    # fold the lse cotangent into the per-row scalar (see note above)
     deff = (delta - dlse.astype(jnp.float32)).reshape(b * h, sq, 1)
     lsef = lse.reshape(b * h, sq, 1)
+    return qf, kf, vf, dof, lsef, deff
+
+
+def _flash_bwd_offs_pallas(q, k, v, offs, do, dlse, out, lse, sm_scale,
+                           causal, block_q, block_k, interpret=False):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    qf, kf, vf, dof, lsef, deff = _bwd_staging(q, k, v, do, dlse, out, lse)
     offs = offs.astype(jnp.int32)
 
     dq = pl.pallas_call(
@@ -556,28 +566,371 @@ def _flash_bwd_offs_pallas(q, k, v, offs, do, dlse, out, lse, sm_scale,
             dv.reshape(b, h, sk, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+# --- grid-variant offset forward (ring inner step): the grid fwd kernel
+# with dynamic global offsets from scalar prefetch, plus the pinned-lse
+# convention for fully-masked rows that merge_attention depends on.
+
+
+def _flash_fwd_offs_grid_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref,
+                                lse_ref, acc_ref, m_ref, l_ref, *,
+                                sm_scale, causal, block_q, block_k):
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+    q_off = offs_ref[0] + j * block_q
+    k_off = offs_ref[1] + kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def tile(masked):
+        s = _mxu_qk(_fold_scale(q_ref[0], sm_scale), k_ref[0])
+        if masked:
+            q_pos = q_off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # per-row safe max: exp underflows to exact 0 for masked entries
+        # and fully-masked ring rows (see the streaming offs kernel)
+        m_safe = jnp.where(m_new > _NEG_INF / 2, m_new, 0.0)
+        alpha = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe[:, :1])
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = (acc_ref[...] * alpha[:, :1]
+                        + jnp.dot(p.astype(v_ref.dtype), v_ref[0],
+                                  preferred_element_type=jnp.float32))
+
+    if causal:
+        is_dead = k_off > q_off + block_q - 1
+        is_full = k_off + block_k - 1 <= q_off
+
+        @pl.when(jnp.logical_not(is_dead) & is_full)
+        def _full():
+            tile(masked=False)
+
+        @pl.when(jnp.logical_not(is_dead) & jnp.logical_not(is_full))
+        def _boundary():
+            tile(masked=True)
+    else:
+        tile(masked=False)
+
+    @pl.when(kb == n_kb - 1)
+    def _flush():
+        l_col = l_ref[:, :1]
+        l_safe = jnp.where(l_col == 0.0, 1.0, l_col)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l_col > 0.0,
+                               m_ref[:, :1] + jnp.log(l_safe), _NEG_INF)
+
+
+def _flash_fwd_offs_grid_pallas(q, k, v, offs, sm_scale, causal, block_q,
+                                block_k, interpret=False):
+    """(out, lse) with dynamic global offsets — grid variant."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError("block sizes must divide the seq lengths")
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    n_qb, n_kb = sq // block_q, sk // block_k
+    if causal:
+        def kv_ix(i, j, kb, o):
+            last_live = lax.div(o[0] + j * block_q + block_q - 1 - o[1],
+                                block_k)
+            return (i, jnp.minimum(kb, jnp.clip(last_live, 0, n_kb - 1)), 0)
+    else:
+        def kv_ix(i, j, kb, o):
+            return (i, kb, 0)
+    try:
+        vma = jax.typeof(q).vma
+        out_shapes = [
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32, vma=vma),
+        ]
+    except (AttributeError, TypeError):
+        out_shapes = [
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ]
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_fwd_offs_grid_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, n_qb, n_kb),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j, kb, o: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), kv_ix),
+                pl.BlockSpec((1, block_k, d), kv_ix),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j, kb, o: (i, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda i, j, kb, o: (i, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+            ],
+        ),
+        out_shape=out_shapes,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs.astype(jnp.int32), qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+# --- grid-variant backward: the arbitrary grid dimension replaces the
+# in-kernel fori_loop, with dq (resp. dk/dv) accumulating in VMEM scratch.
+# Same O(block) VMEM story as the grid forward — K/V (resp. Q/do) no
+# longer stage whole-sequence blocks per program, so single-chip training
+# scales to sequences the streaming backward cannot hold.
+
+
+def _flash_bwd_dq_grid_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
+                              lse_ref, deff_ref, dq_ref, dq_acc, *,
+                              sm_scale, causal, block_q, block_k):
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+    q_off = offs_ref[0] + j * block_q
+    k_off = offs_ref[1] + kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def tile(masked):
+        qs = _fold_scale(q_ref[0], sm_scale)
+        lse = lse_ref[0][:, 0]
+        deff = deff_ref[0][:, 0]
+        lse_safe = jnp.where(lse > _NEG_INF / 2, lse, -_NEG_INF)
+        s = _mxu_qk(qs, k_ref[0])
+        if masked:
+            q_pos = q_off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_safe[:, None])
+        dp = _mxu_qk(do_ref[0].astype(v_ref.dtype), v_ref[0])
+        ds = p * (dp - deff[:, None])
+        dq_acc[...] += jnp.dot(ds.astype(k_ref.dtype), k_ref[0],
+                               preferred_element_type=jnp.float32)
+
+    if causal:
+        is_dead = k_off > q_off + block_q - 1
+        is_full = k_off + block_k - 1 <= q_off
+
+        @pl.when(jnp.logical_not(is_dead) & is_full)
+        def _full():
+            tile(masked=False)
+
+        @pl.when(jnp.logical_not(is_dead) & jnp.logical_not(is_full))
+        def _boundary():
+            tile(masked=True)
+    else:
+        tile(masked=False)
+
+    @pl.when(kb == n_kb - 1)
+    def _flush():
+        dq_ref[0] = (dq_acc[...] * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_grid_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref,
+                               lse_ref, deff_ref, dk_ref, dv_ref,
+                               dk_acc, dv_acc, *, sm_scale, causal,
+                               block_q, block_k):
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+    n_qb = pl.num_programs(2)
+    k_off = offs_ref[1] + kb * block_k
+    q_off = offs_ref[0] + qb * block_q
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def tile(masked):
+        qs_blk = _fold_scale(q_ref[0], sm_scale)
+        lse = lse_ref[0][:, 0]
+        deff = deff_ref[0][:, 0]
+        lse_safe = jnp.where(lse > _NEG_INF / 2, lse, -_NEG_INF)
+        s = _mxu_qk(qs_blk, k_ref[0])
+        if masked:
+            q_pos = q_off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_off + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_safe[:, None])
+        do_blk = do_ref[0]
+        dv_acc[...] += _mxu_tn(p.astype(do_blk.dtype), do_blk)
+        dp = _mxu_qk(do_blk.astype(v_ref.dtype), v_ref[0])
+        ds = p * (dp - deff[:, None])
+        # dk against the pre-scaled q folds the sm_scale multiply away
+        dk_acc[...] += _mxu_tn(ds.astype(qs_blk.dtype), qs_blk)
+
+    if causal:
+        is_dead = q_off + block_q - 1 < k_off
+        is_full = q_off >= k_off + block_k - 1
+
+        @pl.when(jnp.logical_not(is_dead) & is_full)
+        def _full():
+            tile(masked=False)
+
+        @pl.when(jnp.logical_not(is_dead) & jnp.logical_not(is_full))
+        def _boundary():
+            tile(masked=True)
+    else:
+        tile(masked=False)
+
+    @pl.when(qb == n_qb - 1)
+    def _flush():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_offs_grid_pallas(q, k, v, offs, do, dlse, out, lse,
+                                sm_scale, causal, block_q, block_k,
+                                interpret=False):
+    """Grid-variant backward (see _flash_bwd_offs_pallas for the math)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError("block sizes must divide the seq lengths")
+    qf, kf, vf, dof, lsef, deff = _bwd_staging(q, k, v, do, dlse, out, lse)
+    offs = offs.astype(jnp.int32)
+    n_qb, n_kb = sq // block_q, sk // block_k
+
+    def sem3():
+        return (None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")))
+
+    if causal:
+        # clamp dead tiles' block index to the last/first LIVE one so the
+        # index doesn't change across dead steps and Mosaic skips their
+        # HBM copies (compute is skipped by pl.when in the kernel)
+        def kv_ix(i, j, kb, o):
+            last_live = lax.div(o[0] + j * block_q + block_q - 1 - o[1],
+                                block_k)
+            return (i, jnp.minimum(kb, jnp.clip(last_live, 0, n_kb - 1)), 0)
+
+        def q_ix(i, kb, qb, o):
+            first_live = lax.div(o[1] + kb * block_k - o[0], block_q)
+            return (i, jnp.maximum(qb, jnp.clip(first_live, 0, n_qb - 1)),
+                    0)
+    else:
+        def kv_ix(i, j, kb, o):
+            return (i, kb, 0)
+
+        def q_ix(i, kb, qb, o):
+            return (i, qb, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_grid_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, n_qb, n_kb),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j, kb, o: (i, j, 0)),
+                pl.BlockSpec((1, block_k, d), kv_ix),
+                pl.BlockSpec((1, block_k, d), kv_ix),
+                pl.BlockSpec((1, block_q, d), lambda i, j, kb, o: (i, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda i, j, kb, o: (i, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda i, j, kb, o: (i, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda i, j, kb, o: (i, j, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        compiler_params=sem3(),
+        interpret=interpret,
+    )(offs, qf, kf, vf, dof, lsef, deff)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_grid_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, n_kb, n_qb),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), q_ix),
+                pl.BlockSpec((1, block_k, d), lambda i, kb, qb, o: (i, kb, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, kb, qb, o: (i, kb, 0)),
+                pl.BlockSpec((1, block_q, d), q_ix),
+                pl.BlockSpec((1, block_q, 1), q_ix),
+                pl.BlockSpec((1, block_q, 1), q_ix),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda i, kb, qb, o: (i, kb, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i, kb, qb, o: (i, kb, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        compiler_params=sem3(),
+        interpret=interpret,
+    )(offs, qf, kf, vf, dof, lsef, deff)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+def _bwd_dispatch(variant):
+    return {"stream": _flash_bwd_offs_pallas,
+            "grid": _flash_bwd_offs_grid_pallas}[variant]
+
+
+def _fwd_offs_dispatch(variant):
+    return {"stream": _flash_fwd_offs_pallas,
+            "grid": _flash_fwd_offs_grid_pallas}[variant]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def flash_attention_with_lse(q, k, v, offs, sm_scale, causal, block_q,
-                             block_k, interpret):
+                             block_k, interpret, variant="stream"):
     """Pallas fused (out, lse) attention with dynamic global offsets —
     the ring-attention inner step. Backward runs the offset-aware
-    FlashAttention-2 Pallas kernels (lse cotangent included)."""
-    return _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal,
-                                  block_q, block_k, interpret)
+    FlashAttention-2 Pallas kernels (lse cotangent included). `variant`
+    selects both directions: "stream" (whole sequence in VMEM per
+    program) or "grid" (blocks as an arbitrary grid dim, O(block) VMEM)."""
+    return _fwd_offs_dispatch(variant)(q, k, v, offs, sm_scale, causal,
+                                       block_q, block_k, interpret)
 
 
 def _flash_lse_fwd_rule(q, k, v, offs, sm_scale, causal, block_q, block_k,
-                        interpret):
-    out, lse = _flash_fwd_offs_pallas(q, k, v, offs, sm_scale, causal,
-                                      block_q, block_k, interpret)
+                        interpret, variant="stream"):
+    out, lse = _fwd_offs_dispatch(variant)(q, k, v, offs, sm_scale, causal,
+                                           block_q, block_k, interpret)
     return (out, lse), (q, k, v, offs, out, lse)
 
 
 def _flash_lse_bwd_rule(sm_scale, causal, block_q, block_k, interpret,
-                        res, cts):
+                        variant, res, cts):
     q, k, v, offs, out, lse = res
     do, dlse = cts
-    dq, dk, dv = _flash_bwd_offs_pallas(q, k, v, offs, do, dlse, out, lse,
+    dq, dk, dv = _bwd_dispatch(variant)(q, k, v, offs, do, dlse, out, lse,
                                         sm_scale, causal, block_q, block_k,
                                         interpret)
     return dq, dk, dv, jnp.zeros_like(offs)
@@ -764,12 +1117,13 @@ def _flash_fwd_grid_pallas(q, k, v, sm_scale, causal, block_q, block_k,
 
 
 def _flash_bwd_pallas(q, k, v, do, out, lse, sm_scale, causal, block_q,
-                      block_k, interpret=False):
+                      block_k, interpret=False, variant="stream"):
     """Backward for the non-offset path: the offset-aware kernels with
-    offs = [0, 0] and no lse cotangent (one kernel pair to maintain)."""
+    offs = [0, 0] and no lse cotangent (one kernel pair per variant to
+    maintain)."""
     offs = jnp.zeros((2,), jnp.int32)
     dlse = jnp.zeros(lse.shape, jnp.float32)
-    return _flash_bwd_offs_pallas(q, k, v, offs, do, dlse, out, lse,
+    return _bwd_dispatch(variant)(q, k, v, offs, do, dlse, out, lse,
                                   sm_scale, causal, block_q, block_k,
                                   interpret)
 
@@ -781,28 +1135,27 @@ def _fwd_dispatch(variant):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_attention_tpu(q, k, v, sm_scale, causal, block_q, block_k,
-                         interpret, fwd_variant="stream"):
-    out, _ = _fwd_dispatch(fwd_variant)(q, k, v, sm_scale, causal,
-                                        block_q, block_k, interpret)
+                         interpret, variant="stream"):
+    out, _ = _fwd_dispatch(variant)(q, k, v, sm_scale, causal,
+                                    block_q, block_k, interpret)
     return out
 
 
 def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret,
-                    fwd_variant="stream"):
-    out, lse = _fwd_dispatch(fwd_variant)(q, k, v, sm_scale, causal,
-                                          block_q, block_k, interpret)
+                    variant="stream"):
+    out, lse = _fwd_dispatch(variant)(q, k, v, sm_scale, causal,
+                                      block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret,
-                    fwd_variant, res, do):
+                    variant, res, do):
     # Pallas FlashAttention-2 backward (dq kernel + dk/dv kernel), P
     # recomputed from the saved lse — no S materialization, no jnp
-    # fallback graph. Shared by both forward variants (they produce the
-    # same out/lse).
+    # fallback graph. Both variants share the out/lse contract.
     q, k, v, out, lse = res
     return _flash_bwd_pallas(q, k, v, do, out, lse, sm_scale, causal,
-                             block_q, block_k, interpret)
+                             block_q, block_k, interpret, variant)
 
 
 _flash_attention_tpu.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -810,14 +1163,15 @@ _flash_attention_tpu.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
                     block_q=512, block_k=512, use_pallas=None,
-                    fwd_variant="stream"):
+                    variant="stream"):
     """Fused attention over [B, H, S, D] tensors.
 
     `use_pallas=None` auto-selects: the Pallas kernel on TPU backends,
     blockwise jnp elsewhere (identical numerics up to fp tolerance).
-    `fwd_variant` picks the Pallas forward: "stream" (whole K/V in VMEM,
-    fori_loop over blocks) or "grid" (KV as an arbitrary grid dimension,
-    O(block_k) VMEM — required for very long sequences).
+    `variant` picks the Pallas kernels (fwd and bwd): "stream" (whole
+    sequence resident in VMEM, fori_loop over blocks) or "grid" (blocks
+    as an arbitrary grid dimension with scratch accumulators — O(block)
+    VMEM, required for very long sequences).
     """
     if sm_scale is None:
         sm_scale = 1.0 / _np.sqrt(q.shape[-1])
@@ -827,7 +1181,7 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
                  and k.shape[2] % min(block_k, k.shape[2]) == 0)
     if use_pallas and ok_shapes:
         return _flash_attention_tpu(q, k, v, sm_scale, causal,
-                                    block_q, block_k, False, fwd_variant)
+                                    block_q, block_k, False, variant)
     out, _ = blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                  block_k=block_k)
     return out

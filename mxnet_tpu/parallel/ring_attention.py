@@ -36,12 +36,15 @@ __all__ = ["ring_attention", "ulysses_attention", "sequence_parallel_attention"]
 
 
 def ring_attention(q, k, v, axis_name, *, causal=False, sm_scale=None,
-                   block_k=512, use_pallas=None, pallas_interpret=False):
+                   block_k=512, use_pallas=None, pallas_interpret=False,
+                   variant="stream"):
     """Ring attention over a sharded sequence axis.
 
     Must be called inside `shard_map`; `q`, `k`, `v` are the per-device
     [B, H, S_local, D] chunks of sequence sharded over `axis_name`. Returns
-    the per-device [B, H, S_local, D] output chunk.
+    the per-device [B, H, S_local, D] output chunk. `variant` selects the
+    inner Pallas kernels ("stream" or "grid" — the latter keeps VMEM at
+    O(block) for very long per-device chunks).
 
     Reference role: this is the SP analog of the reference's collective layer
     (src/kvstore/comm.h reduce trees) — but as in-graph XLA collectives.
@@ -78,7 +81,7 @@ def ring_attention(q, k, v, axis_name, *, causal=False, sm_scale=None,
             ob, lb = flash_attention_with_lse(
                 q, kc, vc, offs, sm_scale, causal,
                 min(block_k, q.shape[-2]), min(block_k, kc.shape[-2]),
-                pallas_interpret)
+                pallas_interpret, variant)
         else:
             ob, lb = blockwise_attention(
                 q, kc, vc, causal=causal, sm_scale=sm_scale,

@@ -485,3 +485,92 @@ def test_flash_grid_variant_parity():
                                        rtol=2e-3, atol=2e-4,
                                        err_msg="d%s sq=%d sk=%d causal=%s"
                                        % (name, sq, sk, causal))
+
+
+def test_flash_grid_bwd_offsets_parity():
+    """Offset-aware grid backward (ring inner step) matches the streaming
+    backward — including offsets that fully mask some tiles and the lse
+    cotangent path."""
+    import importlib
+    import jax
+    import jax.numpy as jnp
+    fa = importlib.import_module("mxnet_tpu.kernels.flash_attention")
+    rng = np.random.RandomState(7)
+    B, H, S, D = 1, 2, 64, 16
+    q = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32))
+    for (qo, ko) in [(0, 0), (64, 0), (0, 64), (64, 128)]:
+        offs = jnp.asarray([qo, ko], jnp.int32)
+
+        def loss(q, k, v, variant):
+            out, lse = fa.flash_attention_with_lse(
+                q, k, v, offs, 0.25, True, 16, 16, True, variant)
+            # involve BOTH cotangents (out and lse), like ring's merge
+            return (out ** 2).sum() + (jnp.where(
+                lse > -1e15, lse, 0.0) ** 2).sum() * 0.1
+
+        gs = jax.grad(lambda *a: loss(*a, "stream"),
+                      argnums=(0, 1, 2))(q, k, v)
+        gg = jax.grad(lambda *a: loss(*a, "grid"),
+                      argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gg, gs):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg="d%s offs=(%d,%d)"
+                                       % (name, qo, ko))
+        # grid fwd parity on the offs path (out AND pinned-lse contract)
+        og, lg = fa.flash_attention_with_lse(q, k, v, offs, 0.25, True,
+                                             16, 16, True, "grid")
+        os_, ls = fa.flash_attention_with_lse(q, k, v, offs, 0.25, True,
+                                              16, 16, True, "stream")
+        np.testing.assert_allclose(np.asarray(og), np.asarray(os_),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(ls),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_grid_unequal_blocks_parity():
+    """The clamped dead-tile index maps divide by block_k (kv_ix) and
+    block_q (q_ix); they are only delicate when the blocks differ. Pins
+    causal parity for asymmetric blocks on both the plain and offs
+    paths, fwd and bwd."""
+    import importlib
+    import jax
+    import jax.numpy as jnp
+    fa = importlib.import_module("mxnet_tpu.kernels.flash_attention")
+    rng = np.random.RandomState(11)
+    B, H, S, D = 1, 2, 64, 16
+    q = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32))
+    for bq, bk in [(8, 16), (16, 8), (8, 32), (32, 8)]:
+        def loss_v(q, k, v, variant, bq=bq, bk=bk):
+            return (fa._flash_attention_tpu(q, k, v, 0.25, True, bq, bk,
+                                            True, variant) ** 2).sum()
+        gs = jax.grad(lambda *a: loss_v(*a, "stream"),
+                      argnums=(0, 1, 2))(q, k, v)
+        gg = jax.grad(lambda *a: loss_v(*a, "grid"),
+                      argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gg, gs):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg="d%s bq=%d bk=%d"
+                                       % (name, bq, bk))
+        for qo, ko in [(64, 0), (0, 64)]:
+            offs = jnp.asarray([qo, ko], jnp.int32)
+
+            def loss_o(q, k, v, variant, bq=bq, bk=bk, offs=offs):
+                out, lse = fa.flash_attention_with_lse(
+                    q, k, v, offs, 0.25, True, bq, bk, True, variant)
+                return (out ** 2).sum() + (jnp.where(
+                    lse > -1e15, lse, 0.0) ** 2).sum() * 0.1
+            gs = jax.grad(lambda *a: loss_o(*a, "stream"),
+                          argnums=(0, 1, 2))(q, k, v)
+            gg = jax.grad(lambda *a: loss_o(*a, "grid"),
+                          argnums=(0, 1, 2))(q, k, v)
+            for name, a, b in zip("qkv", gg, gs):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                    err_msg="d%s bq=%d bk=%d offs=(%d,%d)"
+                    % (name, bq, bk, qo, ko))

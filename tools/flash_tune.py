@@ -102,13 +102,13 @@ def main():
             fwd_tf, _ = attn_timing.timed_map_tflops(
                 lambda q, k_, v_, bq=bq, bk=bk, fv=variant: flash_attention(
                     q, k_, v_, causal=True, block_q=bq, block_k=bk,
-                    use_pallas=True, fwd_variant=fv),
+                    use_pallas=True, variant=fv),
                 qs, k, v, flops_fwd * n_iter)
 
             def loss(q_, k_, v_, bq=bq, bk=bk, fv=variant):
                 return (flash_attention(q_, k_, v_, causal=True, block_q=bq,
                                         block_k=bk, use_pallas=True,
-                                        fwd_variant=fv)
+                                        variant=fv)
                         ** 2).sum()
             bwd_tf, _ = attn_timing.timed_map_tflops(
                 lambda q, k_, v_, bq=bq, bk=bk: jax.grad(
