@@ -16,13 +16,13 @@ import mxnet_tpu as mx
 from mxnet_tpu.models import resnet
 
 
-def get_symbol(network, num_layers, image_shape):
+def get_symbol(network, num_layers, image_shape, dev=None):
     if network == "resnet":
         return resnet.get_symbol(num_classes=1000, num_layers=num_layers,
                                  image_shape=image_shape)
     from mxnet_tpu.gluon.model_zoo.vision import get_model
     net = get_model(network)
-    net.initialize()
+    net.initialize(ctx=dev)  # params must live on the benchmarked device
     net.hybridize()
     return net
 
@@ -32,7 +32,7 @@ def score(network, num_layers, dev, batch_size, image_shape="3,224,224",
     shape = (batch_size,) + tuple(int(x) for x in image_shape.split(","))
     rng = np.random.RandomState(0)
     data = rng.uniform(-1, 1, shape).astype(np.float32)
-    sym = get_symbol(network, num_layers, image_shape)
+    sym = get_symbol(network, num_layers, image_shape, dev)
     if isinstance(sym, mx.Symbol):
         exe = sym.simple_bind(dev, grad_req="null", data=shape,
                               softmax_label=(batch_size,))
@@ -45,7 +45,7 @@ def score(network, num_layers, dev, batch_size, image_shape="3,224,224",
             exe.forward(is_train=False)
             return exe.outputs[0]
     else:
-        x = mx.nd.array(data)
+        x = mx.nd.array(data, ctx=dev)
 
         def run():
             return sym(x)
@@ -58,16 +58,50 @@ def score(network, num_layers, dev, batch_size, image_shape="3,224,224",
     return batch_size * num_batches / (time.time() - tic)
 
 
+# the reference's docs/faq/perf.md:115-144 score table — same networks,
+# same batch size, so the two tables compare line for line
+PERF_MD_TABLE = [
+    # (label, network, num_layers, P100 img/s from perf.md)
+    # Inception-BN is omitted: a 2015 legacy symbol the reference kept
+    # only as an example script, absent from its gluon model zoo too.
+    ("alexnet", "alexnet", 0, 4883.77),
+    ("vgg-16", "vgg16", 0, 854.40),
+    ("inception-v3", "inceptionv3", 0, 493.72),
+    ("resnet-50", "resnet", 50, 713.17),
+    ("resnet-152", "resnet", 152, 294.17),
+]
+
+
+def score_table(dev, batch_size=32):
+    """Reproduce the reference's headline inference table on `dev`."""
+    rows = []
+    for label, network, layers, p100 in PERF_MD_TABLE:
+        shape = "3,299,299" if network == "inceptionv3" else "3,224,224"
+        try:
+            ips = score(network, layers, dev, batch_size, shape)
+            rows.append((label, ips, p100, ips / p100))
+            print("%-14s batch %2d: %8.1f img/s  (P100 ref %7.1f, %5.2fx)"
+                  % (label, batch_size, ips, p100, ips / p100), flush=True)
+        except Exception as e:  # one failing net must not kill the table
+            print("%-14s ERROR: %s" % (label, str(e)[:120]), flush=True)
+    return rows
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--network", type=str, default="resnet")
     parser.add_argument("--num-layers", type=int, default=50)
     parser.add_argument("--batch-sizes", type=str, default="1,32")
     parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--all", action="store_true",
+                        help="run the full perf.md score table (batch 32)")
     args = parser.parse_args()
     dev = mx.tpu() if mx.num_tpus() else mx.cpu()
-    for b in (int(x) for x in args.batch_sizes.split(",")):
-        speed = score(args.network, args.num_layers, dev, b,
-                      args.image_shape)
-        print("network: %s-%d, batch %3d: %.1f img/sec"
-              % (args.network, args.num_layers, b, speed))
+    if args.all:
+        score_table(dev)
+    else:
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            speed = score(args.network, args.num_layers, dev, b,
+                          args.image_shape)
+            print("network: %s-%d, batch %3d: %.1f img/sec"
+                  % (args.network, args.num_layers, b, speed))

@@ -49,6 +49,10 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--attn", choices=("ring", "ulysses"), default="ring")
+    ap.add_argument("--attn-variant", choices=("stream", "grid"),
+                    default="stream",
+                    help="Pallas kernel family; 'grid' keeps VMEM at "
+                         "O(block) for very long per-device chunks")
     args = ap.parse_args()
     if not 0 < args.lag < args.seq_len:
         ap.error("--lag must be in (0, seq-len): the copy structure only "
@@ -75,7 +79,8 @@ def main():
                             num_layers=args.num_layers,
                             num_heads=args.num_heads, d_model=args.d_model,
                             max_len=args.seq_len, attn_impl=args.attn,
-                            block_k=max(16, args.seq_len // (4 * args.sp)))
+                            block_k=max(16, args.seq_len // (4 * args.sp)),
+                            attn_variant=args.attn_variant)
     params = init_transformer(cfg, jax.random.PRNGKey(0))
     rules = transformer_sharding_rules(cfg, mesh)
     step = ShardedTrainStep(

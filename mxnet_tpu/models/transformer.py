@@ -37,7 +37,8 @@ class TransformerConfig:
 
     def __init__(self, vocab_size, num_layers=2, num_heads=4, d_model=128,
                  d_ff=None, max_len=512, dtype=jnp.float32, remat=False,
-                 attn_impl="ring", block_k=512, dropout=0.0):
+                 attn_impl="ring", block_k=512, dropout=0.0,
+                 attn_variant="stream"):
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_heads = num_heads
@@ -49,6 +50,10 @@ class TransformerConfig:
         self.attn_impl = attn_impl  # 'ring' | 'ulysses' | 'full'
         self.block_k = block_k
         self.dropout = dropout
+        # Pallas kernel family for the attention core: 'stream' or 'grid'
+        # (O(block) VMEM — long per-device sequence chunks)
+        self.attn_variant = attn_variant
+        assert attn_variant in ("stream", "grid"), attn_variant
         assert d_model % num_heads == 0
 
 
@@ -124,7 +129,8 @@ def _layer_norm(x, scale, bias, eps=1e-5):
 def _attention(q, k, v, cfg, mesh):
     """[B, H, S, D] attention; shard_map island when a mesh is given."""
     if mesh is None:
-        return flash_attention(q, k, v, causal=True, block_k=cfg.block_k)
+        return flash_attention(q, k, v, causal=True, block_k=cfg.block_k,
+                               variant=cfg.attn_variant)
     names = mesh.axis_names
     bq = "dp" if "dp" in names else None
     hq = "tp" if "tp" in names else None
@@ -136,9 +142,11 @@ def _attention(q, k, v, cfg, mesh):
 
     def local(q, k, v):
         if sq is None or impl == "full":
-            return flash_attention(q, k, v, causal=True, block_k=cfg.block_k)
+            return flash_attention(q, k, v, causal=True, block_k=cfg.block_k,
+                                   variant=cfg.attn_variant)
         return sequence_parallel_attention(q, k, v, sq, impl=impl,
-                                           causal=True, block_k=cfg.block_k)
+                                           causal=True, block_k=cfg.block_k,
+                                           variant=cfg.attn_variant)
 
     # pad sequence to a multiple of the sp degree: causal masking keeps
     # end-padding invisible to real query positions

@@ -115,12 +115,21 @@ def ulysses_attention(q, k, v, axis_name, *, causal=False, sm_scale=None):
 
 
 def sequence_parallel_attention(q, k, v, axis_name, *, impl="ring",
-                                causal=False, sm_scale=None, block_k=512):
+                                causal=False, sm_scale=None, block_k=512,
+                                variant="stream"):
     """Dispatch between SP strategies by name ('ring' | 'ulysses')."""
     if impl == "ring":
         return ring_attention(q, k, v, axis_name, causal=causal,
-                              sm_scale=sm_scale, block_k=block_k)
+                              sm_scale=sm_scale, block_k=block_k,
+                              variant=variant)
     if impl == "ulysses":
+        if variant != "stream":
+            # ulysses re-shards to full sequence per head group and runs
+            # plain flash attention — no offset kernels, so the grid
+            # family does not apply; fail loudly rather than silently
+            # measure the wrong kernels
+            raise ValueError("variant=%r is not supported with "
+                             "impl='ulysses' (ring only)" % variant)
         return ulysses_attention(q, k, v, axis_name, causal=causal,
                                  sm_scale=sm_scale)
     raise ValueError("unknown sequence-parallel impl %r" % impl)
