@@ -112,6 +112,14 @@ def param_field(type=str, default=None, required=False, doc="", enum=None):
     return _Field(type=type, default=default, required=required, doc=doc, enum=enum)
 
 
+def _is_jax_tracer(x):
+    try:
+        import jax
+        return isinstance(x, jax.core.Tracer)
+    except Exception:  # pragma: no cover - jax always present in practice
+        return False
+
+
 def _coerce(value, typ):
     """Coerce a (possibly string-serialized) value to the declared field type."""
     if value is None:
@@ -121,7 +129,16 @@ def _coerce(value, typ):
             return value.lower() in ("1", "true", "yes")
         return bool(value)
     if typ in (int, float):
-        return typ(value)
+        try:
+            return typ(value)
+        except TypeError:
+            # jax tracers can't concretize to python scalars; inside a
+            # traced region (e.g. the fused Trainer update, where lr is a
+            # runtime argument) pass them through — all downstream use is
+            # jnp arithmetic
+            if _is_jax_tracer(value):
+                return value
+            raise
     if typ is tuple:  # shape-like "(1, 2)" / float-list "(1, 0.5)" strings
         def elem(x):
             f = float(x)

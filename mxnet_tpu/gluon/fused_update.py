@@ -1,0 +1,202 @@
+"""One-dispatch Gluon Trainer update.
+
+The reference Trainer (python/mxnet/gluon/trainer.py:157) updates each
+parameter with its own engine op — cheap when ops queue into a C++
+engine, but N host->device dispatches per step on this runtime. Here the
+whole update fuses into ONE jitted XLA program per parameter-set
+signature: every parameter's `optimizer.update()` is traced as-is (the
+SAME Python math the eager path runs — nothing is reimplemented per
+optimizer), with the step-varying scalars (lr, rescale_grad, per-index
+update counts for Adam-style bias correction) passed as runtime
+arguments so lr schedules never retrace.
+
+Tracing the real update() requires three surgical, trace-scoped
+substitutions on the optimizer object (restored in a finally):
+  * lr_scheduler=None + lr=<traced scalar>: _get_lr returns
+    traced_lr * lr_mult; the schedule itself is evaluated eagerly each
+    step OUTSIDE the program.
+  * rescale_grad=<traced scalar> (changes with batch_size).
+  * _index_update_count=<{index: traced count}> and _update_count=noop:
+    counts are advanced eagerly outside (reference bookkeeping,
+    including num_update), and the advanced values ride in as traced
+    ints so e.g. Adam's beta**t bias correction stays step-correct.
+
+Falls back to the reference per-parameter path for sparse grads,
+multi-context parameters, or MXNET_GLUON_FUSED=0.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray, _new_from_jax
+
+__all__ = ["FusedTrainerUpdate", "fused_enabled"]
+
+_tf = jax.tree_util.tree_flatten
+_tu = jax.tree_util.tree_unflatten
+
+_is_nd = lambda x: isinstance(x, NDArray)  # noqa: E731
+
+
+def fused_enabled():
+    return os.environ.get("MXNET_GLUON_FUSED", "1") not in ("0", "false")
+
+
+def _fused_safe_classes():
+    """Exact optimizer classes whose update() is pure w.r.t. host state.
+
+    Tracing bakes host-side Python into the compiled program, so three
+    built-ins can NEVER fuse: LBSGD (host cumgrads/warmup accounting),
+    Nadam (cross-step m_schedule product on the instance), SGLD (host
+    PRNG draw per step). User subclasses are excluded by the exact-type
+    check — an override with host state would be silently frozen."""
+    from .. import optimizer as opt_mod
+    return {opt_mod.SGD, opt_mod.NAG, opt_mod.Signum, opt_mod.Adam,
+            opt_mod.AdaGrad, opt_mod.RMSProp, opt_mod.AdaDelta,
+            opt_mod.Ftrl, opt_mod.Adamax, opt_mod.FTML, opt_mod.DCASGD}
+
+
+def _hyper_signature(opt, indices):
+    """Everything static the trace bakes in: scalar optimizer
+    hyperparameters and the per-parameter lr/wd multipliers."""
+    scalars = tuple(sorted(
+        (k, v) for k, v in vars(opt).items()
+        if isinstance(v, (int, float, bool, str, type(None)))
+        # lr/rescale ride in as runtime args; counts advance every step
+        # (they ride in via ts) — neither may key the program cache
+        and k not in ("lr", "rescale_grad", "num_update",
+                      "begin_num_update")))
+    mults = tuple((opt._mult(i, "lr_mult"), opt._mult(i, "wd_mult"))
+                  for i in indices)
+    return scalars, mults
+
+
+class FusedTrainerUpdate:
+    """Caches one jitted update program per parameter-set signature."""
+
+    def __init__(self, optimizer, updater):
+        self._opt = optimizer
+        self._updater = updater
+        self._cache = {}
+        self._unfusable = False  # set when the optimizer can't trace
+
+    def applicable(self, params):
+        if not fused_enabled() or self._unfusable:
+            return False
+        if type(self._opt) not in _fused_safe_classes():
+            return False  # host-stateful or user-defined: eager path
+        for p in params:
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if len(p.list_data()) != 1:
+                return False  # multi-context: reference aggregation path
+            if (p.list_data()[0].stype != "default"
+                    or p.list_grad()[0].stype != "default"):
+                return False  # sparse update semantics stay eager
+        return True
+
+    def __call__(self, params):
+        """Apply the fused update; returns False (restoring all count
+        bookkeeping) if the optimizer turns out to be untraceable — e.g.
+        host-side norm math (LBSGD) — so the caller can run the eager
+        path instead. The verdict is remembered in self._unfusable."""
+        opt, updater = self._opt, self._updater
+        live = [(i, p) for i, p in enumerate(params)
+                if p.grad_req != "null" and p._data is not None]
+        if not live:
+            return True
+        indices = tuple(i for i, _ in live)
+        weights = [p.list_data()[0] for _, p in live]
+        grads = [p.list_grad()[0] for _, p in live]
+        for i, _p in live:  # state creation, as Updater.__call__ would
+            if i not in updater.states:
+                updater.states[i] = opt.create_state_multi_precision(
+                    i, params[i].list_data()[0])
+                updater.states_synced[i] = True
+        states = {i: updater.states[i] for i in indices}
+        state_leaves, state_def = _tf(states, is_leaf=_is_nd)
+        nd_slots = tuple(n for n, leaf in enumerate(state_leaves)
+                         if _is_nd(leaf))
+        static_leaves = {n: leaf for n, leaf in enumerate(state_leaves)
+                         if not _is_nd(leaf)}
+
+        # reference count bookkeeping, advanced eagerly (trace-invariant);
+        # snapshotted so a failed trace can undo it before the eager path
+        counts_snapshot = (dict(opt._index_update_count), opt.num_update)
+        for i in indices:
+            opt._update_count(i)
+        ts = [opt._index_update_count[i] for i in indices]
+        base_lr = opt.lr if opt.lr_scheduler is None \
+            else opt.lr_scheduler(opt.num_update)
+
+        key = (indices,
+               tuple((w._data.shape, str(w._data.dtype)) for w in weights),
+               tuple((g._data.shape, str(g._data.dtype)) for g in grads),
+               tuple((state_leaves[n]._data.shape,
+                      str(state_leaves[n]._data.dtype)) for n in nd_slots),
+               state_def, tuple(sorted(static_leaves.items())),
+               _hyper_signature(opt, indices))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(indices, state_def, nd_slots, static_leaves)
+            self._cache[key] = fn
+
+        try:
+            new_w, new_s = fn(
+                [w._data for w in weights], [g._data for g in grads],
+                [state_leaves[n]._data for n in nd_slots],
+                jnp.float32(base_lr), jnp.float32(opt.rescale_grad),
+                jnp.asarray(ts, jnp.int32))
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerBoolConversionError):
+            self._unfusable = True
+            self._cache.pop(key, None)
+            opt._index_update_count, opt.num_update = counts_snapshot
+            return False
+        for w, nw in zip(weights, new_w):
+            w._data = nw
+        for n, ns in zip(nd_slots, new_s):
+            state_leaves[n]._data = ns
+        return True
+
+    def _build(self, indices, state_def, nd_slots, static_leaves):
+        opt = self._opt
+        nd_set = set(nd_slots)
+
+        def traced(w_datas, g_datas, s_datas, lr, rescale, ts):
+            weights = [_new_from_jax(d) for d in w_datas]
+            grads = [_new_from_jax(d) for d in g_datas]
+            it = iter(s_datas)
+            flat = [(_new_from_jax(next(it)) if n in nd_set
+                     else static_leaves[n])
+                    for n in range(state_def.num_leaves)]
+            states = _tu(state_def, flat)
+
+            saved = (opt.lr, opt.lr_scheduler, opt.rescale_grad,
+                     opt._index_update_count)
+            opt.lr = lr
+            opt.lr_scheduler = None
+            opt.rescale_grad = rescale
+            opt._index_update_count = {i: ts[slot]
+                                       for slot, i in enumerate(indices)}
+            opt._update_count = lambda index: None  # advanced outside
+            try:
+                for slot, i in enumerate(indices):
+                    opt.update_multi_precision(i, weights[slot],
+                                               grads[slot], states[i])
+            finally:
+                (opt.lr, opt.lr_scheduler, opt.rescale_grad,
+                 opt._index_update_count) = saved
+                del opt._update_count  # uncover the class method
+            new_flat, _ = _tf(states, is_leaf=_is_nd)
+            return ([w._data for w in weights],
+                    [new_flat[n]._data for n in nd_slots])
+
+        # donate ONLY the states: weight buffers can be vjp residuals on
+        # the autograd tape (retain_graph backward after step); states
+        # never appear in a forward graph
+        return jax.jit(traced, donate_argnums=(2,))

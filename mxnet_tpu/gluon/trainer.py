@@ -33,6 +33,7 @@ class Trainer:
         self._update_on_kvstore_arg = update_on_kvstore
         self._kvstore = None
         self._update_on_kvstore = None
+        self._fused_update = None
 
     def _check_contexts(self):
         contexts = None
@@ -131,6 +132,20 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore and self._kvstore is not None:
             return  # weights already updated by the store in _allreduce_grads
+        from .fused_update import fused_enabled
+        if self._fused_update is None and len(self._updaters) == 1 \
+                and fused_enabled():
+            from .fused_update import FusedTrainerUpdate
+            self._fused_update = FusedTrainerUpdate(self._optimizer,
+                                                    self._updaters[0])
+        if self._fused_update is not None \
+                and self._fused_update.applicable(self._params) \
+                and self._fused_update(self._params):
+            # ONE jitted program updated every parameter (the eager
+            # reference path costs a dispatch per parameter per step);
+            # a False return means the optimizer can't trace (falls
+            # through to the eager path, permanently)
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue

@@ -383,7 +383,7 @@ class SGLD(Optimizer):
         if self.clip_gradient is not None:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         g = g + kw["wd"] * weight._data
-        noise = jax.random.normal(_rnd.next_key(), weight.shape) * math.sqrt(kw["lr"])
+        noise = jax.random.normal(_rnd.next_key(), weight.shape) * kw["lr"] ** 0.5
         weight._data = weight._data - kw["lr"] / 2 * g + noise.astype(weight.dtype)
 
 
@@ -409,7 +409,7 @@ class Adam(Optimizer):
         kw = self._common_kwargs(index)
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        lr = kw.pop("lr") * math.sqrt(coef2) / coef1
+        lr = kw.pop("lr") * coef2 ** 0.5 / coef1  # ** works for traced lr/t too
         mean, var = state
         if isinstance(grad, _sparse.RowSparseNDArray):
             import jax.numpy as jnp

@@ -260,3 +260,137 @@ def test_model_zoo_all_families():
         n = 1 if side > 100 else 2  # inception needs 299^2 (AvgPool(8))
         out = net(mx.nd.random.uniform(shape=(n, 3, side, side)))
         assert out.shape == (n, 7), (name, out.shape)
+
+
+def test_trainer_fused_update_matches_eager():
+    """The one-dispatch fused Trainer update traces each parameter's own
+    optimizer.update(); weights, states, and schedules must match the
+    per-parameter eager path bit-for-bit-ish across optimizers."""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    def run(fused, optimizer, opt_params, steps=5):
+        os.environ["MXNET_GLUON_FUSED"] = "1" if fused else "0"
+        try:
+            mx.random.seed(0)  # identical init across the two runs
+            net = gluon.nn.HybridSequential()
+            # linear stack: a relu kink would chaotically amplify the
+            # benign ~1e-9 fused-vs-eager fusion differences over steps
+            net.add(gluon.nn.Dense(8), gluon.nn.Dense(3))
+            net.initialize(mx.init.Xavier(rnd_type="gaussian",
+                                          magnitude=2.0))
+            net.hybridize()
+            trainer = gluon.Trainer(net.collect_params(), optimizer,
+                                    dict(opt_params))
+            losses = []
+            for step in range(steps):
+                x = mx.nd.array(np.random.RandomState(step).normal(
+                    0, 1, (4, 6)).astype(np.float32))
+                y = mx.nd.array(np.random.RandomState(100 + step).normal(
+                    0, 1, (4, 3)).astype(np.float32))
+                with mx.autograd.record():
+                    loss = ((net(x) - y) ** 2).mean()
+                loss.backward()
+                trainer.step(batch_size=4)
+                losses.append(float(loss.asnumpy()))
+            if fused:
+                # non-vacuous: the fused program must actually have run
+                fu = trainer._fused_update
+                assert fu is not None and not fu._unfusable and fu._cache, \
+                    "fused path did not run; eager-vs-eager is vacuous"
+            # positional: gluon name prefixes differ per net instance
+            params = [v.data().asnumpy()
+                      for _, v in sorted(net.collect_params().items())]
+            return losses, params
+        finally:
+            os.environ.pop("MXNET_GLUON_FUSED", None)
+
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    # stable hyperparameters: divergent training would chaotically
+    # amplify benign ~1e-9 fused-vs-eager fusion differences. opt_params
+    # are FACTORIES: FactorScheduler is stateful, so each run needs its own
+    configs = [
+        ("sgd", lambda: {"learning_rate": 0.02, "momentum": 0.9,
+                         "wd": 1e-4}),
+        ("sgd", lambda: {"learning_rate": 0.02, "clip_gradient": 0.05}),
+        ("sgd", lambda: {"learning_rate": 0.02,
+                         "lr_scheduler": FactorScheduler(step=2,
+                                                         factor=0.5)}),
+        ("adam", lambda: {"learning_rate": 0.01}),
+        ("rmsprop", lambda: {"learning_rate": 0.01}),
+        ("signum", lambda: {"learning_rate": 0.01, "momentum": 0.9}),
+    ]
+    for opt_name, opt_params in configs:
+        le, pe = run(False, opt_name, opt_params())
+        lf, pf = run(True, opt_name, opt_params())
+        np.testing.assert_allclose(le, lf, rtol=1e-5, atol=1e-6,
+                                   err_msg=opt_name)
+        assert len(pe) == len(pf)
+        for n, (a, b) in enumerate(zip(pe, pf)):
+            np.testing.assert_allclose(
+                a, b, rtol=1e-5, atol=1e-6,
+                err_msg="%s/param%d" % (opt_name, n))
+
+
+def test_trainer_fused_update_single_dispatch():
+    """The fused path compiles once and reuses the program across steps
+    and lr-schedule changes (lr rides in as a runtime argument)."""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    os.environ["MXNET_GLUON_FUSED"] = "1"
+    try:
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(4), gluon.nn.Dense(2))
+        net.initialize()
+        trainer = gluon.Trainer(
+            net.collect_params(), "adam",
+            {"learning_rate": 0.01,
+             "lr_scheduler": FactorScheduler(step=1, factor=0.7)})
+        for step in range(4):
+            x = mx.nd.array(np.ones((2, 3), np.float32) * (step + 1))
+            with mx.autograd.record():
+                loss = (net(x) ** 2).mean()
+            loss.backward()
+            trainer.step(batch_size=2)
+        fused = trainer._fused_update
+        assert fused is not None and len(fused._cache) == 1, \
+            "schedule changes must not retrace (cache=%d)" % len(fused._cache)
+    finally:
+        os.environ.pop("MXNET_GLUON_FUSED", None)
+
+
+def test_trainer_fused_update_excludes_host_stateful_optimizers():
+    """LBSGD (host cumgrads), Nadam (host m_schedule product) and SGLD
+    (host PRNG per step) must never fuse — tracing would freeze their
+    host-side state into the compiled program silently."""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    os.environ["MXNET_GLUON_FUSED"] = "1"
+    try:
+        for opt in ("nadam", "sgld", "lbsgd"):
+            net = gluon.nn.Dense(2, in_units=3)
+            net.initialize()
+            trainer = gluon.Trainer(net.collect_params(), opt,
+                                    {"learning_rate": 0.01})
+            w0 = net.weight.data().asnumpy().copy()
+            for _ in range(2):
+                x = mx.nd.ones((2, 3))
+                with mx.autograd.record():
+                    loss = (net(x) ** 2).mean()
+                loss.backward()
+                trainer.step(batch_size=2)
+            fu = trainer._fused_update
+            assert fu is None or not fu._cache, \
+                "%s must not fuse (host-side per-step state)" % opt
+            assert not np.allclose(w0, net.weight.data().asnumpy()), opt
+    finally:
+        os.environ.pop("MXNET_GLUON_FUSED", None)
