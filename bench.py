@@ -35,7 +35,7 @@ BASELINE_TRAIN_P100 = 181.53   # ResNet-50 train b32, docs/faq/perf.md:178-185
 PROBE_TIMEOUT_S = 75
 PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy)
     "infer": 900, "train_fp32": 800, "train_bf16": 600,
-    "jax_baseline": 700, "flash": 450, "io_train": 600,
+    "jax_baseline": 700, "flash": 700, "io_train": 600,
     "infer_int8": 600, "train_big_batch": 900,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
@@ -528,12 +528,42 @@ def _phase_flash():
     dt_ = jnp.bfloat16 if on_tpu else jnp.float32
     qs, k, v = attn_timing.make_inputs(B, H, S, D, n_iter, dt_)
     bq, bk = (1024, 512) if on_tpu else (256, 256)
-    tflops, _ = attn_timing.timed_map_tflops(
-        lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=bq,
-                                        block_k=bk, use_pallas=use_pallas),
-        qs, k, v, attn_timing.causal_flops(B, H, S, D, n_iter))
-    return {"flash_attn_tflops": round(tflops, 2),
-            "flash_attn_pallas": bool(use_pallas)}
+    out = {"flash_attn_pallas": bool(use_pallas)}
+    if not use_pallas:
+        # jnp blockwise fallback: 'variant' has no effect there, so no
+        # per-family labels that could read as Pallas evidence
+        tflops, _ = attn_timing.timed_map_tflops(
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            block_q=bq, block_k=bk,
+                                            use_pallas=False),
+            qs, k, v, attn_timing.causal_flops(B, H, S, D, n_iter))
+        out["flash_attn_tflops"] = round(tflops, 2)
+        return out
+    best = None
+    # both Pallas kernel families (stream: whole-KV VMEM + fori_loop;
+    # grid: KV as an arbitrary grid dim) — report each and the winner.
+    # Block sizes are per-family starting points; tools/flash_tune.py is
+    # the full sweep. A failing family must not discard the other's
+    # already-measured number.
+    for variant, (vbq, vbk) in (("stream", (bq, bk)),
+                                ("grid", (512, 512))):
+        try:
+            tflops, _ = attn_timing.timed_map_tflops(
+                lambda q, k, v, fv=variant, a=vbq, b=vbk: flash_attention(
+                    q, k, v, causal=True, block_q=a, block_k=b,
+                    use_pallas=True, variant=fv),
+                qs, k, v, attn_timing.causal_flops(B, H, S, D, n_iter))
+        except Exception as e:
+            out["flash_attn_%s_error" % variant] = "%s: %s" % (
+                type(e).__name__, str(e)[:160])
+            continue
+        out["flash_attn_tflops_%s" % variant] = round(tflops, 2)
+        if best is None or tflops > best[1]:
+            best = (variant, tflops)
+    if best is not None:
+        out["flash_attn_tflops"] = round(best[1], 2)
+        out["flash_attn_variant"] = best[0]
+    return out
 
 
 def _phase_infer_int8():
