@@ -145,3 +145,62 @@ def test_gluon_save_load_binary(tmp_path):
     net2.load_parameters(f)
     np.testing.assert_array_equal(net.weight.data().asnumpy(),
                                   net2.weight.data().asnumpy())
+
+
+def test_background_checkpoint_point_in_time(tmp_path):
+    """save_checkpoint(background=True): the write overlaps the caller,
+    and mutation AFTER the call never leaks into the snapshot (NDArray
+    mutation is buffer swap over immutable jax arrays)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.model import save_checkpoint, load_checkpoint
+
+    prefix = str(tmp_path / "bgckpt")
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    w = mx.nd.array(np.arange(8, dtype=np.float32).reshape(4, 2))
+    args = {"fc_weight": w, "fc_bias": mx.nd.zeros((4,))}
+    handle = save_checkpoint(prefix, 7, sym, args, {}, background=True)
+    w[:] = -1.0  # post-call mutation must not appear in the checkpoint
+    handle.wait()
+    assert handle.done()
+    _, loaded, _ = load_checkpoint(prefix, 7)
+    np.testing.assert_array_equal(
+        loaded["fc_weight"].asnumpy(),
+        np.arange(8, dtype=np.float32).reshape(4, 2))
+
+    # IO errors surface at wait(), not silently
+    bad = save_checkpoint(str(tmp_path / "no" / "such" / "dir" / "x"),
+                          1, None, args, {}, background=True)
+    try:
+        bad.wait()
+        raised = False
+    except OSError:
+        raised = True
+    assert raised, "background IO error must re-raise at wait()"
+
+
+def test_do_checkpoint_background_in_fit(tmp_path):
+    """Module.fit with a background do_checkpoint callback writes every
+    epoch's checkpoint (the next epoch awaits the previous writer)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.model import load_checkpoint
+
+    prefix = str(tmp_path / "fitbg")
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (64, 5)).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+    mod.fit(it, num_epoch=3,
+            optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=mx.callback.do_checkpoint(
+                prefix, background=True))
+    expected = set(mod.get_params()[0])
+    for epoch in (1, 2, 3):
+        _, args, _ = load_checkpoint(prefix, epoch)
+        assert set(args) == expected, (epoch, set(args))
