@@ -152,15 +152,26 @@ class Block:
                 out[name + "." + k] = v
         return out
 
-    def save_parameters(self, filename):
+    def save_parameters(self, filename, background=False):
         """Reference binary NDArray-list format (gluon/block.py save_params
-        → ndarray.save), interchangeable with reference-produced files."""
+        → ndarray.save), interchangeable with reference-produced files.
+
+        `background=True` snapshots the current buffers (zero-copy —
+        immutable jax arrays; see model.save_checkpoint) and writes on a
+        daemon thread, returning a CheckpointHandle."""
         from ..ndarray.utils import save as _nd_save
+        from ..ndarray.ndarray import _new_from_jax
         arrays = {}
         for key, p in self._structured_params().items():
             if p._data is not None:
                 arrays[key] = p.data()
-        _nd_save(filename, arrays)
+        if not background:
+            _nd_save(filename, arrays)
+            return None
+        from ..model import background_write
+        snap = {k: _new_from_jax(v._data) for k, v in arrays.items()}
+        return background_write(lambda: _nd_save(filename, snap),
+                                name="mx-gluon-save")
 
     save_params = save_parameters
 

@@ -145,6 +145,25 @@ class CheckpointHandle:
         return not self._thread.is_alive()
 
 
+def background_write(write_fn, name="mx-checkpoint"):
+    """Run `write_fn` on a daemon thread; errors surface at
+    CheckpointHandle.wait(). The caller is responsible for snapshotting
+    buffers BEFORE calling (pin `._data` in fresh wrappers — immutable
+    jax arrays make that a zero-copy point-in-time view)."""
+    import threading
+    errbox = []
+
+    def _write():
+        try:
+            write_fn()
+        except BaseException as e:  # surfaced via handle.wait()
+            errbox.append(e)
+
+    thread = threading.Thread(target=_write, name=name, daemon=True)
+    thread.start()
+    return CheckpointHandle(thread, errbox)
+
+
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     background=False):
     """reference: model.py:365.
@@ -162,7 +181,6 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
         save_params("%s-%04d.params" % (prefix, epoch), arg_params,
                     aux_params)
         return None
-    import threading
     from .ndarray.ndarray import NDArray, _new_from_jax
     # pin each parameter's CURRENT buffer in a fresh wrapper: the jax
     # arrays are immutable, and later training-step mutation swaps the
@@ -171,21 +189,13 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                           else v) for k, v in (d or {}).items()}  # noqa: E731
     arg_snap = snap(arg_params)
     aux_snap = snap(aux_params)
-    errbox = []
 
     def _write():
-        try:
-            if symbol is not None:
-                symbol.save("%s-symbol.json" % prefix)
-            save_params("%s-%04d.params" % (prefix, epoch), arg_snap,
-                        aux_snap)
-        except BaseException as e:  # surfaced via handle.wait()
-            errbox.append(e)
+        if symbol is not None:
+            symbol.save("%s-symbol.json" % prefix)
+        save_params("%s-%04d.params" % (prefix, epoch), arg_snap, aux_snap)
 
-    thread = threading.Thread(target=_write, name="mx-checkpoint",
-                              daemon=True)
-    thread.start()
-    return CheckpointHandle(thread, errbox)
+    return background_write(_write)
 
 
 def load_checkpoint(prefix, epoch):

@@ -394,3 +394,19 @@ def test_trainer_fused_update_excludes_host_stateful_optimizers():
             assert not np.allclose(w0, net.weight.data().asnumpy()), opt
     finally:
         os.environ.pop("MXNET_GLUON_FUSED", None)
+
+
+def test_gluon_save_parameters_background(tmp_path):
+    """Block.save_parameters(background=True): point-in-time snapshot,
+    durable at wait(), loadable into a fresh net."""
+    path = str(tmp_path / "net.params")
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    w0 = net.weight.data().asnumpy().copy()
+    handle = net.save_parameters(path, background=True)
+    net.weight.data()[:] = -5.0  # must not leak into the snapshot
+    handle.wait()
+    net2 = nn.Dense(4, in_units=3)
+    net2.initialize()
+    net2.load_parameters(path)
+    np.testing.assert_array_equal(net2.weight.data().asnumpy(), w0)
