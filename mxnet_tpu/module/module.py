@@ -630,10 +630,13 @@ class Module(BaseModule):
             if isinstance(blob, dict) and "fused" in blob:
                 import jax
                 from jax.tree_util import tree_map
+                # restore with the step's own state layout: under weight-
+                # update sharding the jitted program pins dp-sharded
+                # in_shardings, and a replicated restore would fail the
+                # sharding match on the next step
                 self._fused_step.opt_state = tree_map(
-                    lambda ref, v: jax.device_put(
-                        v, self._fused_step._repl),
-                    self._fused_step.opt_state, blob["state"])
+                    lambda sh, v: jax.device_put(v, sh),
+                    self._fused_step._state_shardings(), blob["state"])
                 return
             raise MXNetError("optimizer states file %s is not a fused-step "
                              "checkpoint" % fname)

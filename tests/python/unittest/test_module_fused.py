@@ -298,3 +298,82 @@ def test_fused_single_dispatch_per_step(tmp_path):
     others = [e for e in events if e["name"] != "tpu_sync_fused_step"]
     assert not others, "extra dispatches rode along: %r" % (
         [(e["cat"], e["name"]) for e in others],)
+
+
+def test_weight_update_sharding_parity_and_layout():
+    """Cross-replica weight-update sharding (arxiv 2004.13336, ZeRO-1's
+    TPU form): with dp>1 the optimizer state lives dp-sharded (per-chip
+    optimizer memory / dp) and training is numerically identical to the
+    replicated-update step."""
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.mesh import data_parallel_mesh
+    from mxnet_tpu.parallel.tpu_step import DataParallelTrainStep
+
+    mesh = data_parallel_mesh(jax.devices()[:8])
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Activation(
+                mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                      num_hidden=16, name="fc1"),
+                act_type="relu"),
+            num_hidden=4, name="fc2"),
+        name="softmax")
+    shapes = {"data": (32, 8), "softmax_label": (32,)}
+    rng = np.random.RandomState(0)
+    batches = [{"data": rng.normal(0, 1, (32, 8)).astype(np.float32),
+                "softmax_label": rng.randint(0, 4, (32,)).astype(np.float32)}
+               for _ in range(4)]
+
+    def train(shard_update):
+        step = DataParallelTrainStep(sym, mesh, lr=0.1, momentum=0.9,
+                                     shard_update=shard_update)
+        step.init(shapes, seed=3)
+        for b in batches:
+            step(b)
+        return step
+
+    s_on = train(True)
+    s_off = train(False)
+    for n in s_on.params:
+        np.testing.assert_allclose(np.asarray(s_on.params[n]),
+                                   np.asarray(s_off.params[n]),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+    # layout: a (16, 8) momentum leaf must be dp-sharded, and per-shard
+    # memory must be 1/8 of the leaf
+    mom = s_on.opt_state["mom"]["fc1_weight"]
+    assert mom.shape[0] == 16
+    shard_shapes = {tuple(sh.data.shape) for sh in mom.addressable_shards}
+    assert shard_shapes == {(2, 8)}, shard_shapes
+    # replicated run keeps full copies everywhere
+    mom_off = s_off.opt_state["mom"]["fc1_weight"]
+    assert {tuple(sh.data.shape)
+            for sh in mom_off.addressable_shards} == {(16, 8)}
+
+
+def test_optimizer_state_roundtrip_then_continue_under_update_sharding(
+        tmp_path):
+    """save_optimizer_states -> load_optimizer_states -> CONTINUE fitting
+    on a dp>1 mesh: the restored state must come back in the step's own
+    (dp-sharded) layout or the pinned jit shardings reject it."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (64, 6)).astype(np.float32)
+    y = rng.randint(0, 3, (64,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3),
+        name="softmax")
+    mod = mx.mod.Module(sym, context=[mx.tpu(i) for i in range(8)])
+    mod.fit(it, num_epoch=1, kvstore="tpu_sync",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    assert mod._fused_step is not None and mod._fused_step.shard_update
+    path = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(path)
+    mod.load_optimizer_states(path)
+    it.reset()
+    mod.fit(it, num_epoch=1, kvstore="tpu_sync",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
