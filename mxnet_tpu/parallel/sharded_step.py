@@ -38,7 +38,8 @@ class ShardedTrainStep:
 
     def __init__(self, loss_fn, mesh, param_specs, batch_spec=None,
                  optimizer="adam", lr=1e-3, momentum=0.9, wd=0.0,
-                 beta1=0.9, beta2=0.999, eps=1e-8, grad_clip=None):
+                 beta1=0.9, beta2=0.999, eps=1e-8, grad_clip=None,
+                 shard_update=None):
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.param_specs = param_specs
@@ -49,8 +50,37 @@ class ShardedTrainStep:
         self.optimizer = optimizer
         self.hp = dict(lr=lr, momentum=momentum, wd=wd, beta1=beta1,
                        beta2=beta2, eps=eps, grad_clip=grad_clip)
+        # ZeRO-1 across the dp axis (see tpu_step): optimizer state for a
+        # param replicated over 'dp' additionally shards its first free
+        # divisible axis over 'dp' — composes with the tp shardings
+        dp_ok = "dp" in mesh.axis_names and mesh.shape["dp"] > 1
+        if shard_update and not dp_ok:
+            raise MXNetError(
+                "shard_update=True needs a 'dp' mesh axis of size > 1; "
+                "mesh axes are %r" % (dict(mesh.shape),))
+        self.shard_update = dp_ok if shard_update is None \
+            else bool(shard_update)
         self._step_fn = None
         self.step_count = 0
+
+    def _state_spec(self, param, spec):
+        """State spec for one param: its own spec, plus 'dp' on the first
+        unsharded, dp-divisible axis when weight-update sharding is on."""
+        if not self.shard_update:
+            return spec
+        entries = tuple(spec)
+        flat = [e for ent in entries if ent is not None
+                for e in (ent if isinstance(ent, tuple) else (ent,))]
+        if "dp" in flat:
+            return spec  # already dp-sharded; an axis can't be reused
+        dp = self.mesh.shape["dp"]
+        ndim = getattr(param, "ndim", 0)
+        entries = entries + (None,) * (ndim - len(entries))
+        for i in range(ndim):
+            if entries[i] is None and param.shape[i] % dp == 0 \
+                    and param.shape[i] >= dp:
+                return P(*entries[:i], "dp", *entries[i + 1:])
+        return spec
 
     # ------------------------------------------------------------------
     def _shard(self, tree, specs):
@@ -89,12 +119,16 @@ class ShardedTrainStep:
             params, opt_state = apply_update(opt, hp, params, opt_state, grads)
             return params, opt_state, loss
 
-        # optimizer state shards like its param
+        # optimizer state shards like its param, PLUS 'dp' on a free axis
+        # when weight-update sharding is on (state spec, not param spec)
+        # two-tree tree_map flattens only up to the FIRST tree's leaves,
+        # so each P arrives whole (same contract _shard relies on)
+        state_specs = jax.tree_util.tree_map(
+            self._state_spec, self.params, self.param_specs)
         if self.optimizer == "adam":
-            opt_specs = {"m": self.param_specs, "v": self.param_specs,
-                         "t": P()}
+            opt_specs = {"m": state_specs, "v": state_specs, "t": P()}
         else:
-            opt_specs = {"mom": self.param_specs
+            opt_specs = {"mom": state_specs
                          if self.opt_state["mom"] is not None else None}
         param_sh = jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s), self.param_specs,

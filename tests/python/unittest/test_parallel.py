@@ -574,3 +574,46 @@ def test_flash_grid_unequal_blocks_parity():
                     np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
                     err_msg="d%s bq=%d bk=%d offs=(%d,%d)"
                     % (name, bq, bk, qo, ko))
+
+
+def test_sharded_step_weight_update_sharding_parity():
+    """ZeRO-1 over the dp axis of ShardedTrainStep: tp-sharded params
+    keep their spec, optimizer state additionally shards a free axis
+    over 'dp'; numerics match the replicated-state step."""
+    mesh = get_mesh(dp=4, tp=2, pp=1, sp=1, devices=jax.devices()[:8])
+    rng = np.random.RandomState(0)
+    params = {"w1": rng.normal(0, 0.1, (8, 16)).astype(np.float32),
+              "b1": np.zeros((16,), np.float32),
+              "w2": rng.normal(0, 0.1, (16, 4)).astype(np.float32)}
+    specs = {"w1": P(None, "tp"), "b1": P(), "w2": P("tp", None)}
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - batch["y"]) ** 2)
+
+    batches = [{"x": rng.normal(0, 1, (16, 8)).astype(np.float32),
+                "y": rng.normal(0, 1, (16, 4)).astype(np.float32)}
+               for _ in range(4)]
+
+    def train(shard_update):
+        step = ShardedTrainStep(loss_fn, mesh, specs, optimizer="adam",
+                                lr=0.01, shard_update=shard_update)
+        step.init({k: v.copy() for k, v in params.items()})
+        for b in batches:
+            step(b)
+        return step
+
+    on, off = train(True), train(False)
+    assert on.shard_update and not off.shard_update
+    for k in params:
+        np.testing.assert_allclose(np.asarray(on.params[k]),
+                                   np.asarray(off.params[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    # w1 is P(None, 'tp'): its adam state must pick up 'dp' on axis 0
+    m = on.opt_state["m"]["w1"]
+    shard_shapes = {tuple(s.data.shape) for s in m.addressable_shards}
+    assert shard_shapes == {(2, 8)}, shard_shapes   # 8/dp=2, 16/tp=8
+    # b1 (16,) replicated spec -> state shards over dp alone
+    mb = on.opt_state["m"]["b1"]
+    assert {tuple(s.data.shape)
+            for s in mb.addressable_shards} == {(4,)}
