@@ -11,6 +11,11 @@ through the whole schedule (ppermute transposes to the reverse hop), so one
 
 Everything is expressed inside ONE `shard_map` + `lax.fori_loop` — a single
 XLA program per step, compiler-visible overlap of compute and ICI transfer.
+
+Scope note: cross-replica weight-update sharding (ZeRO-1; see tpu_step /
+sharded_step) is NOT applied here — this step is manual-SPMD (shard_map),
+where it would mean hand-written reduce_scatter/all_gather around the
+update, and pp already divides optimizer state by the pipeline depth.
 """
 from __future__ import annotations
 
