@@ -410,3 +410,51 @@ def test_gluon_save_parameters_background(tmp_path):
     net2.initialize()
     net2.load_parameters(path)
     np.testing.assert_array_equal(net2.weight.data().asnumpy(), w0)
+
+
+def test_trainer_fused_update_no_per_param_dispatches(tmp_path):
+    """Dispatch-count regression guard for the fused Trainer: the eager
+    path records one optimizer-op dispatch per parameter per step; the
+    fused path records none (ONE jitted program outside the imperative
+    dispatch layer)."""
+    import os
+    import mxnet_tpu as mx
+
+    def opt_op_events(fused):
+        os.environ["MXNET_GLUON_FUSED"] = "1" if fused else "0"
+        try:
+            net = nn.HybridSequential()
+            net.add(nn.Dense(8, in_units=6), nn.Dense(3, in_units=8))
+            net.initialize()
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.05,
+                                     "momentum": 0.9})
+            x = mx.nd.ones((4, 6))
+            # warmup (compiles outside the profiled window)
+            with autograd.record():
+                loss = (net(x) ** 2).mean()
+            loss.backward()
+            trainer.step(batch_size=4)
+
+            mx.profiler.set_config(filename=str(tmp_path / "p.json"))
+            mx.profiler.set_state("run")
+            try:
+                for _ in range(2):
+                    with autograd.record():
+                        loss = (net(x) ** 2).mean()
+                    loss.backward()
+                    trainer.step(batch_size=4)
+            finally:
+                mx.profiler.set_state("stop")
+            events = [e for e in mx.profiler._state["events"]
+                      if "update" in e.get("name", "")]
+            mx.profiler._state["events"] = []
+            return events
+        finally:
+            os.environ.pop("MXNET_GLUON_FUSED", None)
+
+    eager = opt_op_events(False)
+    fused = opt_op_events(True)
+    assert len(eager) >= 2 * 4, eager  # >= params x steps op dispatches
+    assert not fused, "fused update leaked per-param dispatches: %r" % (
+        [(e.get("cat"), e.get("name")) for e in fused],)
