@@ -90,6 +90,46 @@ def env_flag(name, default=False):
     return get_env(name, default, bool)
 
 
+def atomic_write(fname, data, mode="wb"):
+    """Write `data` to `fname` via a unique temp file + os.replace.
+
+    Checkpoint writers can run on background threads that die with the
+    process, and several writers may target the same path concurrently
+    (epoch-N background save still in flight when epoch N+1 starts) — a
+    per-call mkstemp temp plus an atomic rename means the file at `fname`
+    is always a complete, self-consistent write, never truncated or
+    interleaved.
+
+    Semantics differ from plain open(fname): the PARENT DIRECTORY must be
+    writable (the temp lives beside the target), and a symlink at `fname`
+    is replaced by a regular file rather than written through. The mode
+    of an existing target is preserved; new files get umask-default."""
+    import tempfile
+    d = os.path.dirname(os.path.abspath(fname))
+    fd, tmp = tempfile.mkstemp(dir=d,
+                               prefix=os.path.basename(fname) + ".tmp-")
+    try:
+        with os.fdopen(fd, mode) as f:
+            f.write(data)
+        # mkstemp creates 0600; restore what a plain open() would have
+        # produced (umask-masked 0666, or the target's existing mode) so
+        # the atomicity refactor doesn't regress file shareability
+        try:
+            mode_bits = os.stat(fname).st_mode & 0o7777
+        except OSError:
+            umask = os.umask(0)
+            os.umask(umask)
+            mode_bits = 0o666 & ~umask
+        os.chmod(tmp, mode_bits)
+        os.replace(tmp, fname)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 # ---------------------------------------------------------------------------
 # Parameter reflection (reference: dmlc::Parameter / DMLC_REGISTER_PARAMETER).
 # Gives every op/iterator auto-documented, string-coercible kwargs — powers the

@@ -157,6 +157,15 @@ class FusedTrainerUpdate:
             self._cache.pop(key, None)
             opt._index_update_count, opt.num_update = counts_snapshot
             return False
+        except BaseException:
+            # ANY other trace-time failure must also restore the counts:
+            # the caller (or the user) may retry eagerly, and a retry on
+            # top of already-advanced counts would double-advance t and
+            # skew Adam-style bias correction. Only tracer errors mark the
+            # optimizer permanently unfusable; everything else re-raises.
+            self._cache.pop(key, None)
+            opt._index_update_count, opt.num_update = counts_snapshot
+            raise
         for w, nw in zip(weights, new_w):
             w._data = nw
         for n, ns in zip(nd_slots, new_s):

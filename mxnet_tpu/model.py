@@ -7,6 +7,7 @@ binary; the key structure is preserved, the container is not byte-compatible).
 """
 from __future__ import annotations
 
+import threading
 from collections import namedtuple
 
 import numpy as _np
@@ -145,12 +146,33 @@ class CheckpointHandle:
         return not self._thread.is_alive()
 
 
+_INFLIGHT_WRITERS = []
+_INFLIGHT_LOCK = threading.Lock()
+_ATEXIT_REGISTERED = False
+
+
+def _drain_inflight_writers():
+    """atexit: let in-flight checkpoint writers finish on normal interpreter
+    exit (daemon threads are otherwise killed mid-write; file-level
+    atomicity in base.atomic_write covers abnormal exits)."""
+    while True:
+        with _INFLIGHT_LOCK:
+            if not _INFLIGHT_WRITERS:
+                return
+            t = _INFLIGHT_WRITERS.pop()
+        if t.is_alive():
+            t.join(timeout=60.0)
+
+
 def background_write(write_fn, name="mx-checkpoint"):
     """Run `write_fn` on a daemon thread; errors surface at
     CheckpointHandle.wait(). The caller is responsible for snapshotting
     buffers BEFORE calling (pin `._data` in fresh wrappers — immutable
-    jax arrays make that a zero-copy point-in-time view)."""
-    import threading
+    jax arrays make that a zero-copy point-in-time view). Writers are
+    joined at interpreter exit; the underlying file writes are
+    temp+os.replace atomic, so a hard kill leaves the previous good
+    checkpoint in place rather than a truncated file."""
+    import atexit
     errbox = []
 
     def _write():
@@ -160,7 +182,19 @@ def background_write(write_fn, name="mx-checkpoint"):
             errbox.append(e)
 
     thread = threading.Thread(target=_write, name=name, daemon=True)
+    global _ATEXIT_REGISTERED
+    with _INFLIGHT_LOCK:
+        if not _ATEXIT_REGISTERED:
+            atexit.register(_drain_inflight_writers)
+            _ATEXIT_REGISTERED = True
+    # start BEFORE appending: the prune below may only ever see started
+    # threads, or a concurrent caller could drop this one (is_alive() is
+    # False until start()) and the atexit drain would never join it
     thread.start()
+    with _INFLIGHT_LOCK:
+        _INFLIGHT_WRITERS[:] = [t for t in _INFLIGHT_WRITERS
+                                if t.is_alive()]
+        _INFLIGHT_WRITERS.append(thread)
     return CheckpointHandle(thread, errbox)
 
 

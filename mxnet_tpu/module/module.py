@@ -5,7 +5,7 @@ import logging
 
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, atomic_write
 from ..context import Context, cpu
 from ..initializer import Uniform, InitDesc
 from .. import optimizer as opt_mod
@@ -611,15 +611,13 @@ class Module(BaseModule):
             import pickle
             import numpy as _np2
             state_np = jax_tree_to_numpy(self._fused_step.opt_state)
-            with open(fname, "wb") as fout:
-                pickle.dump({"fused": self._fused_step.optimizer,
-                             "state": state_np}, fout)
+            atomic_write(fname, pickle.dumps(
+                {"fused": self._fused_step.optimizer, "state": state_np}))
             return
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            atomic_write(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

@@ -24,7 +24,7 @@ import struct
 
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, atomic_write
 
 __all__ = ["save", "load"]
 
@@ -203,8 +203,9 @@ def save(fname, data):
         nb = n.encode("utf-8")
         out.append(struct.pack("<Q", len(nb)))
         out.append(nb)
-    with open(fname, "wb") as f:
-        f.write(b"".join(out))
+    # atomic: the previous good file at `fname` must never be replaced by
+    # a truncated/interleaved one (background checkpoint threads)
+    atomic_write(fname, b"".join(out))
 
 
 def load(fname):

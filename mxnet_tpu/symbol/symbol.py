@@ -606,8 +606,10 @@ class Symbol:
                            "attrs": {"mxnet_version": ["int", 10201]}}, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        # atomic: may run on a background checkpoint thread that the
+        # interpreter can kill — never leave a truncated -symbol.json
+        from ..base import atomic_write
+        atomic_write(fname, self.tojson(), mode="w")
 
     # ------------------------------------------------------------------
     # evaluation / binding

@@ -204,3 +204,31 @@ def test_do_checkpoint_background_in_fit(tmp_path):
     for epoch in (1, 2, 3):
         _, args, _ = load_checkpoint(prefix, epoch)
         assert set(args) == expected, (epoch, set(args))
+
+
+def test_save_is_atomic_on_failure(tmp_path, monkeypatch):
+    """A failed write must leave the PREVIOUS file intact (checkpoint
+    writers can die mid-write on a background thread — ADVICE r3): save
+    goes through a temp file + os.replace, and cleans the temp up."""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import utils as nd_utils
+
+    path = str(tmp_path / "ck.params")
+    good = {"w": mx.nd.array(np.ones((3,), np.float32))}
+    nd_utils.save(path, good)
+    before = open(path, "rb").read()
+
+    def boom(src, dst):
+        raise OSError("disk gone")
+    monkeypatch.setattr(os, "replace", boom)
+    try:
+        nd_utils.save(path, {"w": mx.nd.array(np.zeros((3,), np.float32))})
+        raised = False
+    except OSError:
+        raised = True
+    assert raised
+    assert open(path, "rb").read() == before, "previous file clobbered"
+    leftovers = [f for f in os.listdir(str(tmp_path)) if ".tmp-" in f]
+    assert leftovers == [], leftovers

@@ -198,9 +198,13 @@ def test_bench_apply_bank_overlay_semantics():
     assert "train_fp32" not in used
     # missing phase filled from bank
     assert results["flash"]["flash_attn_tflops"] == 90.0
-    # provenance labeling
-    assert extra["platform"] == "tpu"
-    assert extra["device_kind"] == "TPU v5 lite"
+    # provenance labeling: the live run's platform is never rewritten —
+    # the banked origin rides separate keys + value_source (ADVICE r3)
+    assert extra["platform"] == "cpu"
+    assert extra["headline_platform"] == "tpu"
+    assert extra["banked_platform"] == "tpu"
+    assert extra["banked_device_kind"] == "TPU v5 lite"
+    assert extra["value_source"] == "banked"
     assert used["infer"].startswith("2026-07-31T00:00:00Z@abc1234")
     assert "banked_note" in extra
 
@@ -217,12 +221,13 @@ def test_bench_apply_bank_noop_without_ledger():
 def test_bench_load_bank_discards_stale_entries(tmp_path):
     bench = _bench_mod()
     ledger = tmp_path / "bank.jsonl"
+    fresh_ts = 1000.0 + bench.BANK_MAX_AGE_S
     ledger.write_text(
         '{"phase": "infer", "result": {"img_per_sec": 1.0}, '
         '"platform": "tpu", "ts": 1000.0}\n'
         '{"phase": "flash", "result": {"flash_attn_tflops": 2.0}, '
-        '"platform": "tpu", "ts": 90000.0}\n')
-    bank = bench._load_bank(str(ledger), now=100000.0)
+        '"platform": "tpu", "ts": %f}\n' % fresh_ts)
+    bank = bench._load_bank(str(ledger), now=fresh_ts + 1.0)
     assert set(bank) == {"flash"}  # infer is > BANK_MAX_AGE_S old
 
 
