@@ -616,11 +616,21 @@ def _phase_flash():
     best = None
     # both Pallas kernel families (stream: whole-KV VMEM + fori_loop;
     # grid: KV as an arbitrary grid dim) — report each and the winner.
-    # Block sizes are per-family starting points; tools/flash_tune.py is
-    # the full sweep. A failing family must not discard the other's
-    # already-measured number.
-    for variant, (vbq, vbk) in (("stream", (bq, bk)),
-                                ("grid", (512, 512))):
+    # Block sizes: tools/flash_tune.py pins per-family sweep winners into
+    # flash_tune_results.json; fall back to sane starting points when no
+    # pin exists. A failing family must not discard the other's number.
+    family_blocks = {"stream": (bq, bk), "grid": (512, 512)}
+    try:
+        with open(os.path.join(_HERE, "flash_tune_results.json")) as f:
+            for vname, row in (json.load(f).get("best_by_variant")
+                               or {}).items():
+                if vname in family_blocks:
+                    family_blocks[vname] = (row["block_q"], row["block_k"])
+                    out["flash_blocks_%s" % vname] = "pinned %dx%d" % (
+                        row["block_q"], row["block_k"])
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    for variant, (vbq, vbk) in family_blocks.items():
         try:
             tflops, _ = attn_timing.timed_map_tflops(
                 lambda q, k, v, fv=variant, a=vbq, b=vbk: flash_attention(

@@ -9,7 +9,8 @@ from ...nn.basic_layers import Sequential, HybridSequential
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
            "CenterCrop", "Resize", "RandomFlipLeftRight", "RandomFlipTopBottom",
-           "RandomBrightness", "RandomContrast", "RandomSaturation"]
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomColorJitter", "RandomLighting"]
 
 
 class Compose(Sequential):
@@ -167,3 +168,54 @@ class RandomSaturation(_RandomJitter):
         gray = npx.mean(axis=-1, keepdims=True)
         out = (npx - gray) * self._factor() + gray
         return array(out)
+
+
+class RandomHue(Block):
+    """Jitter hue by a factor drawn from U(-hue, hue), via the
+    `_image_random_hue` op (reference transforms.py RandomHue ->
+    F.image.random_hue)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = float(hue)
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        if not isinstance(x, NDArray):
+            x = array(_np.asarray(x))
+        return nd.image.random_hue(x, min_factor=-self._hue,
+                                   max_factor=self._hue)
+
+
+class RandomColorJitter(Block):
+    """Brightness/contrast/saturation/hue jitter applied in random order
+    (reference transforms.py RandomColorJitter ->
+    F.image.random_color_jitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._args = (float(brightness), float(contrast),
+                      float(saturation), float(hue))
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        if not isinstance(x, NDArray):
+            x = array(_np.asarray(x))
+        b, c, s, h = self._args
+        return nd.image.random_color_jitter(x, brightness=b, contrast=c,
+                                            saturation=s, hue=h)
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference transforms.py
+    RandomLighting -> F.image.random_lighting)."""
+
+    def __init__(self, alpha=0.05):
+        super().__init__()
+        self._alpha = float(alpha)
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        if not isinstance(x, NDArray):
+            x = array(_np.asarray(x))
+        return nd.image.random_lighting(x, alpha_std=self._alpha)

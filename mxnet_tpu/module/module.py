@@ -355,6 +355,17 @@ class Module(BaseModule):
             return
         if self.inputs_need_grad or self._state_names or self._monitor:
             return
+        if self._compression_params:
+            # an explicit compression request must actually compress: the
+            # fused step's in-graph psum rides ICI where 2-bit compression
+            # buys nothing, so honor the request on the kvstore push path
+            # (which applies error-feedback quantization) instead of
+            # silently ignoring it (docs/faq/distributed.md)
+            self.logger.info(
+                "kvstore=%s: gradient compression requested; using the "
+                "kvstore aggregation path (drop compression_params to get "
+                "the fused in-graph step)", kvstore_type)
+            return
         import jax as _jax
         if _jax.process_count() > 1:
             # multi-process goes through the kvstore allreduce path (the

@@ -215,5 +215,7 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
 
 from ..ops.registry import make_internal_namespace as _min  # noqa: E402
 from ..ops.registry import make_contrib_namespace as _mcn  # noqa: E402
+from ..ops.registry import make_prefix_namespace as _mpn  # noqa: E402
 _internal = _min(_GENERATED, _OP_ALIASES)
 contrib = _mcn(_GENERATED)
+image = _mpn(_GENERATED, "_image_", "image")

@@ -17,5 +17,6 @@ from . import linalg_extra   # noqa: F401
 from . import quantization   # noqa: F401
 from . import contrib_extra  # noqa: F401
 from . import compat_extra   # noqa: F401
+from . import image_ops      # noqa: F401
 
 __all__ = ["OpDef", "register_op", "get_op", "find_op", "list_ops", "OPS"]

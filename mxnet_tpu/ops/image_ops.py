@@ -1,0 +1,266 @@
+"""Image augmentation ops (`mx.nd.image.*` / gluon vision transforms).
+
+Reference: src/operator/image/image_random-inl.h — flip, brightness,
+contrast, saturation, hue, color-jitter, PCA lighting. The reference
+iterates pixels on the CPU with an engine-seeded std RNG; here every op
+is a vectorized jnp computation over the whole HWC tensor, stochastic
+ops draw from the op-level jax PRNG key (`need_rng`), and the hue
+round-trip (RGB->HLS->RGB) is branchless `where` algebra so the whole
+augmentation stack can live inside a jitted input pipeline.
+
+All ops take HWC (or ...HWC) tensors, channels last, RGB order, values
+in [0, 255] (float or uint8) — the reference's layout contract.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import Params, param_field
+from .registry import register_op
+
+# ITU-R BT.601 luma weights, as the reference's AdjustContrastImpl coef[]
+_LUMA = (0.299, 0.587, 0.114)
+
+
+def _saturate(val, dtype):
+    """reference saturate_cast<DType>: round+clamp for integer outputs,
+    plain cast for float."""
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return jnp.clip(jnp.round(val), info.min, info.max).astype(dtype)
+    return val.astype(dtype)
+
+
+def _luma(f):
+    """Per-pixel luminance of an ...HWC float tensor -> ...HW1."""
+    w = jnp.asarray(_LUMA, f.dtype)
+    return (f[..., :3] * w).sum(axis=-1, keepdims=True)
+
+
+# ---------------------------------------------------------------- flips --
+
+
+@register_op("_image_flip_left_right", input_names=("data",))
+def _flip_left_right(params, data):
+    return jnp.flip(data, axis=data.ndim - 2)  # W axis of ...HWC
+
+
+@register_op("_image_flip_top_bottom", input_names=("data",))
+def _flip_top_bottom(params, data):
+    return jnp.flip(data, axis=data.ndim - 3)  # H axis of ...HWC
+
+
+def _random_flip(data, axis, rng):
+    coin = jax.random.bernoulli(rng)
+    return jnp.where(coin, jnp.flip(data, axis=axis), data)
+
+
+@register_op("_image_random_flip_left_right", input_names=("data",),
+             need_rng=True)
+def _random_flip_left_right(params, data, rng=None):
+    return _random_flip(data, data.ndim - 2, rng)
+
+
+@register_op("_image_random_flip_top_bottom", input_names=("data",),
+             need_rng=True)
+def _random_flip_top_bottom(params, data, rng=None):
+    return _random_flip(data, data.ndim - 3, rng)
+
+
+# ------------------------------------------------------------- enhance --
+
+
+class RandomEnhanceParam(Params):
+    min_factor = param_field(float, required=True)
+    max_factor = param_field(float, required=True)
+
+
+def _enhance_alpha(params, rng):
+    return jax.random.uniform(rng, (), minval=params.min_factor,
+                              maxval=params.max_factor)
+
+
+def _adjust_brightness(data, alpha):
+    return _saturate(data.astype(jnp.float32) * alpha, data.dtype)
+
+
+def _adjust_contrast(data, alpha):
+    f = data.astype(jnp.float32)
+    gray = _luma(f) if data.shape[-1] > 1 else f
+    # PER-IMAGE mean over (H, W, C): a leading batch dim must not blend
+    # one image toward another's gray level
+    gray_mean = gray.mean(axis=(-3, -2, -1), keepdims=True)
+    return _saturate(f * alpha + (1.0 - alpha) * gray_mean, data.dtype)
+
+
+def _adjust_saturation(data, alpha):
+    if data.shape[-1] == 1:
+        return data
+    f = data.astype(jnp.float32)
+    # full luminance blend. Deliberate divergence from the reference:
+    # its AdjustSaturationImpl overwrites instead of accumulating the
+    # per-channel luma terms (image_random-inl.h:379 `gray = ...` in a
+    # loop), desaturating toward 0.114*B only — we blend toward the
+    # actual gray pixel, which is the documented intent of the op.
+    return _saturate(f * alpha + (1.0 - alpha) * _luma(f), data.dtype)
+
+
+def _rgb_to_hls(f):
+    """Vectorized reference RGB2HLSConvert: [0,255] RGB -> (h,l,s),
+    h in degrees, l/s in [0,1]."""
+    rgb = f / 255.0
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    vmax = jnp.maximum(jnp.maximum(r, g), b)
+    vmin = jnp.minimum(jnp.minimum(r, g), b)
+    diff = vmax - vmin
+    l = (vmax + vmin) * 0.5
+    nonzero = diff > jnp.finfo(jnp.float32).eps
+    safe_diff = jnp.where(nonzero, diff, 1.0)
+    s = jnp.where(l < 0.5, safe_diff / jnp.maximum(vmax + vmin, 1e-12),
+                  safe_diff / jnp.maximum(2.0 - vmax - vmin, 1e-12))
+    hd = 60.0 / safe_diff
+    h = jnp.where(vmax == r, (g - b) * hd,
+                  jnp.where(vmax == g, (b - r) * hd + 120.0,
+                            (r - g) * hd + 240.0))
+    h = jnp.where(h < 0.0, h + 360.0, h)
+    return (jnp.where(nonzero, h, 0.0), l, jnp.where(nonzero, s, 0.0))
+
+
+def _hls_to_rgb(h, l, s):
+    """Vectorized reference HLS2RGBConvert -> [0,255] RGB stack."""
+    p2 = jnp.where(l <= 0.5, l * (1.0 + s), l + s - l * s)
+    p1 = 2.0 * l - p2
+    hs = jnp.mod(h / 60.0, 6.0)
+    sector = jnp.floor(hs).astype(jnp.int32)
+    frac = hs - sector
+    tab = jnp.stack([p2, p1, p1 + (p2 - p1) * (1.0 - frac),
+                     p1 + (p2 - p1) * frac], axis=-1)
+    # c_HlsSectorData: per-sector tab indices for (b, g, r)
+    sector_data = jnp.asarray([[1, 3, 0], [1, 0, 2], [3, 0, 1],
+                               [0, 2, 1], [0, 1, 3], [2, 1, 0]], jnp.int32)
+    idx = sector_data[sector]  # ...x3 tab indices
+    b = jnp.take_along_axis(tab, idx[..., 0:1], axis=-1)[..., 0]
+    g = jnp.take_along_axis(tab, idx[..., 1:2], axis=-1)[..., 0]
+    r = jnp.take_along_axis(tab, idx[..., 2:3], axis=-1)[..., 0]
+    gray = s == 0.0
+    rgb = jnp.stack([jnp.where(gray, l, r), jnp.where(gray, l, g),
+                     jnp.where(gray, l, b)], axis=-1)
+    return rgb * 255.0
+
+
+def _adjust_hue(data, alpha):
+    if data.shape[-1] == 1:
+        return data
+    f = data.astype(jnp.float32)
+    h, l, s = _rgb_to_hls(f)
+    rgb = _hls_to_rgb(h + alpha * 360.0, l, s)
+    return _saturate(rgb, data.dtype)
+
+
+@register_op("_image_random_brightness", param_cls=RandomEnhanceParam,
+             input_names=("data",), need_rng=True)
+def _random_brightness(params, data, rng=None):
+    return _adjust_brightness(data, _enhance_alpha(params, rng))
+
+
+@register_op("_image_random_contrast", param_cls=RandomEnhanceParam,
+             input_names=("data",), need_rng=True)
+def _random_contrast(params, data, rng=None):
+    return _adjust_contrast(data, _enhance_alpha(params, rng))
+
+
+@register_op("_image_random_saturation", param_cls=RandomEnhanceParam,
+             input_names=("data",), need_rng=True)
+def _random_saturation(params, data, rng=None):
+    return _adjust_saturation(data, _enhance_alpha(params, rng))
+
+
+@register_op("_image_random_hue", param_cls=RandomEnhanceParam,
+             input_names=("data",), need_rng=True)
+def _random_hue(params, data, rng=None):
+    return _adjust_hue(data, _enhance_alpha(params, rng))
+
+
+class ColorJitterParam(Params):
+    brightness = param_field(float, required=True)
+    contrast = param_field(float, required=True)
+    saturation = param_field(float, required=True)
+    hue = param_field(float, required=True)
+
+
+@register_op("_image_random_color_jitter", param_cls=ColorJitterParam,
+             input_names=("data",), need_rng=True)
+def _random_color_jitter(params, data, rng=None):
+    """Brightness/contrast/saturation/hue, each jittered in
+    1 +- strength (hue: +- strength) and applied in a RANDOM ORDER —
+    the reference shuffles the four stages per call. Traced-friendly:
+    the drawn permutation selects stages through lax.switch instead of
+    Python control flow, so the jitted pipeline stays one program."""
+    k_perm, k_b, k_c, k_s, k_h = jax.random.split(rng, 5)
+
+    def draw(key, strength):
+        return 1.0 + jax.random.uniform(key, (), minval=-strength,
+                                        maxval=strength)
+
+    alpha_b = draw(k_b, params.brightness)
+    alpha_c = draw(k_c, params.contrast)
+    alpha_s = draw(k_s, params.saturation)
+    alpha_h = jax.random.uniform(k_h, (), minval=-params.hue,
+                                 maxval=params.hue)
+    # statically-inactive stages (strength == 0) become identity branches
+    stages = [
+        (lambda img: _adjust_brightness(img, alpha_b))
+        if params.brightness > 0 else (lambda img: img),
+        (lambda img: _adjust_contrast(img, alpha_c))
+        if params.contrast > 0 else (lambda img: img),
+        (lambda img: _adjust_saturation(img, alpha_s))
+        if params.saturation > 0 else (lambda img: img),
+        (lambda img: _adjust_hue(img, alpha_h))
+        if params.hue > 0 else (lambda img: img),
+    ]
+    order = jax.random.permutation(k_perm, 4)
+    out = data
+    for slot in range(4):
+        out = jax.lax.switch(order[slot], stages, out)
+    return out
+
+
+# ------------------------------------------------------------ lighting --
+
+# AlexNet-style PCA lighting: ImageNet RGB eigenvectors scaled by their
+# eigenvalues (reference AdjustLightingImpl eig[][])
+_LIGHT_EIG = (
+    (55.46 * -0.5675, 4.794 * 0.7192, 1.148 * 0.4009),
+    (55.46 * -0.5808, 4.794 * -0.0045, 1.148 * -0.8140),
+    (55.46 * -0.5836, 4.794 * -0.6948, 1.148 * 0.4203),
+)
+
+
+def _adjust_lighting(data, alpha):
+    if data.shape[-1] == 1:
+        return data
+    pca = jnp.asarray(_LIGHT_EIG, jnp.float32) @ jnp.asarray(
+        alpha, jnp.float32).reshape(3)
+    return _saturate(data.astype(jnp.float32) + pca, data.dtype)
+
+
+class AdjustLightingParam(Params):
+    alpha = param_field(tuple, required=True)
+
+
+@register_op("_image_adjust_lighting", param_cls=AdjustLightingParam,
+             input_names=("data",))
+def _image_adjust_lighting(params, data):
+    return _adjust_lighting(data, params.alpha)
+
+
+class RandomLightingParam(Params):
+    alpha_std = param_field(float, default=0.05)
+
+
+@register_op("_image_random_lighting", param_cls=RandomLightingParam,
+             input_names=("data",), need_rng=True)
+def _image_random_lighting(params, data, rng=None):
+    alpha = jax.random.normal(rng, (3,)) * params.alpha_std
+    return _adjust_lighting(data, alpha)

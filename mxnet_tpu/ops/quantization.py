@@ -117,6 +117,34 @@ def _range_for_multiplication(min_a, max_a, min_b, max_b):
 from .nn import ConvParam, FCParam, PoolParam  # noqa: E402
 
 
+def _int8_compute_dtypes(lhs, rhs, reduce_len):
+    """Backend-specialized operand dtypes for int8xint8->int32 contractions
+    (the analog of the reference dispatching quantized_conv to MKLDNN int8
+    kernels on CPU and cuDNN int8 on GPU — quantized_conv.cc:1):
+
+    * TPU/GPU: keep operands int8 — XLA lowers them onto the native
+      low-precision matmul path with int32 accumulation (an int32 upcast
+      BEFORE the contraction forces a slow wide-integer path instead).
+    * CPU: XLA:CPU has no vectorized integer conv (measured ~50x slower
+      than f32) — compute in f32 over exactly-representable integer
+      values and round the accumulator back to int32. Products |a*b| <=
+      127*127 are exact in f32; the simulation is only used while the
+      WORST-CASE accumulated magnitude (`reduce_len` terms of 127*127)
+      stays inside f32's 2^24 integer-exact window, so a huge reduction
+      (e.g. 512-channel 3x3 conv at saturation) falls back to the exact
+      wide-int path instead of silently rounding.
+    Mixed operand dtypes (e.g. uint8 data from a direct caller) always
+    take the wide path, which XLA requires to be same-dtype."""
+    f32_exact = reduce_len * 127 * 127 < 2 ** 24
+    if lhs.dtype == rhs.dtype and jax.default_backend() == "cpu" \
+            and f32_exact:
+        return (lhs.astype(jnp.float32), rhs.astype(jnp.float32),
+                jnp.float32, True)
+    if lhs.dtype != rhs.dtype or jax.default_backend() == "cpu":
+        return lhs.astype(jnp.int32), rhs.astype(jnp.int32), jnp.int32, False
+    return lhs, rhs, jnp.int32, False
+
+
 def _qconv_inputs(p):
     if p is not None and p.no_bias:
         return ("data", "weight", "min_data", "max_data",
@@ -144,12 +172,21 @@ def _quantized_conv(params, data, weight, *rest):
     pad = params.pad or (0,) * nd
     if nd != 2:
         raise ValueError("quantized_conv supports 2D kernels only")
+    reduce_len = (data.shape[1] // params.num_group) * int(
+        _np.prod(params.kernel))
+    lhs, rhs, acc_dt, simulated = _int8_compute_dtypes(data, weight,
+                                                       reduce_len)
     out = lax.conv_general_dilated(
-        data.astype(jnp.int32), weight.astype(jnp.int32),
+        lhs, rhs,
         window_strides=stride, padding=[(p, p) for p in pad],
         rhs_dilation=dilate, feature_group_count=params.num_group,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp.int32)
+        preferred_element_type=acc_dt,
+        # simulated path must not be demoted to bf16 by a global
+        # default_matmul_precision — integer exactness needs full f32
+        precision=lax.Precision.HIGHEST if simulated else None)
+    if simulated:
+        out = jnp.round(out).astype(jnp.int32)
     min_out, max_out = _range_for_multiplication(
         min_data.reshape(()), max_data.reshape(()),
         min_weight.reshape(()), max_weight.reshape(()))
@@ -181,8 +218,14 @@ def _quantized_fully_connected(params, data, weight, *rest):
     x = data
     if params.flatten and x.ndim > 2:
         x = x.reshape((x.shape[0], -1))
-    out = jax.lax.dot(x.astype(jnp.int32), weight.astype(jnp.int32).T,
-                      preferred_element_type=jnp.int32)
+    # int8 operands straight into dot on TPU; f32-simulated on CPU
+    # (see _int8_compute_dtypes)
+    x, w, acc_dt, simulated = _int8_compute_dtypes(x, weight, x.shape[-1])
+    out = jax.lax.dot(
+        x, w.T, preferred_element_type=acc_dt,
+        precision=jax.lax.Precision.HIGHEST if simulated else None)
+    if simulated:
+        out = jnp.round(out).astype(jnp.int32)
     min_out, max_out = _range_for_multiplication(
         min_data.reshape(()), max_data.reshape(()),
         min_weight.reshape(()), max_weight.reshape(()))

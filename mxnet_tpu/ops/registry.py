@@ -42,22 +42,28 @@ def make_internal_namespace(generated, aliases):
     return _InternalNamespace()
 
 
-def make_contrib_namespace(generated):
-    """`mx.nd.contrib` / `mx.sym.contrib`: exposes ops registered under a
-    `_contrib_` prefix by bare name (reference: python/mxnet/ndarray/contrib.py,
-    generated from the C-API's contrib op list)."""
+def make_prefix_namespace(generated, prefix, label):
+    """A sub-namespace exposing ops registered under `prefix` by bare name
+    — `mx.nd.contrib` ("_contrib_"), `mx.nd.image` ("_image_"), and their
+    `mx.sym` twins (reference: python/mxnet/ndarray/{contrib,image}.py,
+    generated from the C-API's prefixed op lists)."""
 
-    class _ContribNamespace(object):
+    class _PrefixNamespace(object):
         def __getattr__(self, name):
-            fn = generated.get("_contrib_" + name)
+            fn = generated.get(prefix + name)
             if fn is None:
-                raise AttributeError("no contrib op %r" % name)
+                raise AttributeError("no %s op %r" % (label, name))
             return fn
 
         def __dir__(self):
-            return [k[len("_contrib_"):] for k in generated if k.startswith("_contrib_")]
+            return [k[len(prefix):] for k in generated
+                    if k.startswith(prefix)]
 
-    return _ContribNamespace()
+    return _PrefixNamespace()
+
+
+def make_contrib_namespace(generated):
+    return make_prefix_namespace(generated, "_contrib_", "contrib")
 
 OPS = {}
 _ALIASES = {}

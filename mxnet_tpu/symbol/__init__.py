@@ -131,8 +131,10 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "fromjson"
 
 from ..ops.registry import make_internal_namespace as _min  # noqa: E402
 from ..ops.registry import make_contrib_namespace as _mcn  # noqa: E402
+from ..ops.registry import make_prefix_namespace as _mpn  # noqa: E402
 _internal = _min(_GENERATED, _OP_ALIASES)
 contrib = _mcn(_GENERATED)
+image = _mpn(_GENERATED, "_image_", "image")
 
 
 def full(shape, val, dtype="float32", **kwargs):

@@ -96,20 +96,50 @@ def test_sym_full_and_pow():
     assert float(ex3.forward()[0].asnumpy()[0]) == 8.0
 
 
+# Reference-registered names that are deliberately NOT ops here, each with
+# the reason. Anything in the snapshot but not in this dict MUST resolve.
+_REFERENCE_OP_EXCLUSIONS = {
+    # engine/executor internals registered as ops for the reference's NNVM
+    # graph machinery — never part of the Python op surface (the analogs
+    # here are the executor/imperative/autograd modules themselves)
+    "_CachedOp": "imperative cache machinery (our CachedOp/hybridize)",
+    "_CrossDeviceCopy": "engine-internal device copy (XLA moves buffers)",
+    "_CustomFunction": "autograd.Function internal node",
+    "_NDArray": "deprecated ndarray-op bridge internal",
+    "_Native": "deprecated native-op bridge internal",
+    "_NoGradient": "graph-internal no-grad marker",
+    "_copyto": "NDArray.copyto device transfer, an ndarray method here",
+    # backend-internal kernel registration, not a user-facing name
+    "CuDNNBatchNorm": "cuDNN-internal BatchNorm registration",
+    # host-side OpenCV IO; the public surface (mx.image / nd.imdecode)
+    # is implemented in mxnet_tpu/image + ndarray.imdecode
+    "_cvcopyMakeBorder": "mx.image.copyMakeBorder python impl",
+    "_cvimdecode": "nd.imdecode / mx.image.imdecode python impl",
+    "_cvimread": "mx.image.imread python impl",
+    "_cvimresize": "mx.image.imresize python impl",
+}
+
+
 def test_every_reference_forward_op_resolves():
-    """The full registered forward-op surface of the reference resolves in
-    the registry (guards against regressions in the alias table)."""
+    """EVERY forward op registered by the reference resolves here (the
+    snapshot is extracted from the reference's registration macros —
+    NNVM_REGISTER_OP / MXNET_REGISTER_OP_PROPERTY / wrapper macros /
+    add_alias). Exclusions are explicit and reasoned above; deleting any
+    alias from the registry fails this test."""
+    import json
+    import os
     from mxnet_tpu.ops.registry import find_op
-    # spot names from every family (the exhaustive 348/348 diff ran during
-    # development; this pins representatives from each group)
-    for name in ["Convolution", "BatchNorm_v1", "_PlusScalar", "_linalg_gemm",
-                 "_contrib_DeformableConvolution", "_contrib_ROIAlign_v2",
-                 "_sample_uniform", "_contrib_quantized_conv", "khatri_rao",
-                 "ProposalTarget", "_contrib_count_sketch", "ftml_update",
-                 "_sparse_adagrad_update", "IdentityAttachKLSparseReg",
-                 "_scatter_set_nd", "_image_to_tensor", "broadcast_axes",
-                 "_contrib_bipartite_matching", "cast_storage"]:
-        assert find_op(name) is not None, name
+    data = os.path.join(os.path.dirname(__file__), "data",
+                        "reference_forward_ops.json")
+    names = json.load(open(data))
+    assert len(names) > 350  # the snapshot itself must not rot
+    missing = [n for n in names
+               if n not in _REFERENCE_OP_EXCLUSIONS and find_op(n) is None]
+    assert not missing, "reference ops not resolving: %s" % missing
+    # exclusions must not mask ops that exist (stale exclusion check)
+    stale = [n for n in _REFERENCE_OP_EXCLUSIONS if find_op(n) is not None]
+    assert not stale, "exclusions now resolve, remove them: %s" % stale
+    assert set(_REFERENCE_OP_EXCLUSIONS) <= set(names)
 
 
 def test_sym_pow_symbol_symbol():

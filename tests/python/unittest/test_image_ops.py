@@ -1,0 +1,186 @@
+"""Numerics for the `_image_*` augmentation ops (image_ops.py).
+
+Reference: src/operator/image/image_random-inl.h. Deterministic ops are
+pinned against simple numpy formulations; stochastic ops are pinned via
+degenerate parameter ranges (min_factor == max_factor) where the drawn
+alpha is forced, plus distribution sanity for the genuinely random ones.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import get_op
+
+
+def _apply(name, data, rng_seed=None, **attrs):
+    import jax
+    op = get_op(name)
+    params = op.param_cls(**{k: str(v) for k, v in attrs.items()}) \
+        if op.param_cls else None
+    rng = jax.random.PRNGKey(rng_seed) if op.need_rng else None
+    out = op.apply(params, [data], rng=rng)
+    return np.asarray(out[0] if isinstance(out, (tuple, list)) else out)
+
+
+def _img(h=6, w=5, c=3, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(0, 255, (h, w, c)).astype(dtype)
+
+
+def test_flips_match_numpy():
+    x = _img()
+    np.testing.assert_array_equal(_apply("_image_flip_left_right", x),
+                                  x[:, ::-1, :])
+    np.testing.assert_array_equal(_apply("_image_flip_top_bottom", x),
+                                  x[::-1, :, :])
+
+
+def test_random_flip_is_identity_or_flip():
+    x = _img()
+    seen = set()
+    for seed in range(8):
+        out = _apply("_image_random_flip_left_right", x, rng_seed=seed)
+        if np.array_equal(out, x):
+            seen.add("id")
+        else:
+            np.testing.assert_array_equal(out, x[:, ::-1, :])
+            seen.add("flip")
+    assert seen == {"id", "flip"}  # both branches reachable
+
+
+def test_brightness_degenerate_range_is_exact_scale():
+    x = _img()
+    out = _apply("_image_random_brightness", x, rng_seed=0,
+                 min_factor=0.5, max_factor=0.5)
+    np.testing.assert_allclose(out, x * 0.5, rtol=1e-6)
+
+
+def test_brightness_uint8_saturates():
+    x = np.full((2, 2, 3), 200, np.uint8)
+    out = _apply("_image_random_brightness", x, rng_seed=0,
+                 min_factor=2.0, max_factor=2.0)
+    assert out.dtype == np.uint8
+    np.testing.assert_array_equal(out, np.full((2, 2, 3), 255, np.uint8))
+
+
+def test_contrast_blends_with_gray_mean():
+    x = _img()
+    alpha = 0.3
+    out = _apply("_image_random_contrast", x, rng_seed=0,
+                 min_factor=alpha, max_factor=alpha)
+    gray = (x * [0.299, 0.587, 0.114]).sum(axis=-1).mean()
+    np.testing.assert_allclose(out, x * alpha + (1 - alpha) * gray,
+                               rtol=1e-5)
+
+
+def test_saturation_blends_with_pixel_luma():
+    x = _img()
+    alpha = 0.25
+    out = _apply("_image_random_saturation", x, rng_seed=0,
+                 min_factor=alpha, max_factor=alpha)
+    luma = (x * [0.299, 0.587, 0.114]).sum(axis=-1, keepdims=True)
+    np.testing.assert_allclose(out, x * alpha + (1 - alpha) * luma,
+                               rtol=1e-5)
+
+
+def test_hue_zero_alpha_roundtrips():
+    x = _img()
+    out = _apply("_image_random_hue", x, rng_seed=0,
+                 min_factor=0.0, max_factor=0.0)
+    np.testing.assert_allclose(out, x, atol=0.25)  # HLS roundtrip error
+
+
+def test_hue_rotates_primaries():
+    # pure red rotated by 1/3 becomes green (HLS hue + 120 degrees)
+    x = np.zeros((1, 1, 3), np.float32)
+    x[..., 0] = 255.0
+    out = _apply("_image_random_hue", x, rng_seed=0,
+                 min_factor=1.0 / 3.0, max_factor=1.0 / 3.0)
+    np.testing.assert_allclose(out[0, 0], [0.0, 255.0, 0.0], atol=0.5)
+
+
+def test_color_jitter_zero_strengths_is_identity():
+    x = _img()
+    out = _apply("_image_random_color_jitter", x, rng_seed=3,
+                 brightness=0.0, contrast=0.0, saturation=0.0, hue=0.0)
+    np.testing.assert_array_equal(out, x)
+
+
+def test_color_jitter_brightness_only_matches_brightness():
+    # with one active stage the random order cannot matter
+    x = _img()
+    out = _apply("_image_random_color_jitter", x, rng_seed=5,
+                 brightness=0.4, contrast=0.0, saturation=0.0, hue=0.0)
+    ratio = out / x
+    assert np.allclose(ratio, ratio.flat[0], rtol=1e-5)  # pure scale
+    assert 0.6 - 1e-5 <= ratio.flat[0] <= 1.4 + 1e-5
+
+
+def test_adjust_lighting_adds_pca_shift():
+    x = _img()
+    out = _apply("_image_adjust_lighting", x, alpha=(0.1, -0.2, 0.3))
+    eig = np.array([[55.46 * -0.5675, 4.794 * 0.7192, 1.148 * 0.4009],
+                    [55.46 * -0.5808, 4.794 * -0.0045, 1.148 * -0.8140],
+                    [55.46 * -0.5836, 4.794 * -0.6948, 1.148 * 0.4203]])
+    pca = eig @ np.array([0.1, -0.2, 0.3])
+    np.testing.assert_allclose(out, x + pca, rtol=1e-5)
+
+
+def test_random_lighting_zero_std_is_identity():
+    x = _img()
+    out = _apply("_image_random_lighting", x, rng_seed=0, alpha_std=0.0)
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+def test_single_channel_passthrough():
+    x = _img(c=1)
+    for name in ("_image_random_saturation", "_image_random_hue"):
+        out = _apply(name, x, rng_seed=0, min_factor=0.3, max_factor=0.3)
+        np.testing.assert_allclose(out, x, rtol=1e-6)
+    out = _apply("_image_adjust_lighting", x, alpha=(0.1, 0.1, 0.1))
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+def test_image_namespaces_nd_and_sym():
+    """The reference exposes these as mx.nd.image.* / mx.sym.image.*
+    (python/mxnet/ndarray/image.py) — ours must too, and they must be
+    real graph citizens bindable like any other op."""
+    x = _img()
+    out = mx.nd.image.flip_left_right(mx.nd.array(x))
+    np.testing.assert_array_equal(out.asnumpy(), x[:, ::-1, :])
+    s = mx.sym.image.flip_top_bottom(mx.sym.Variable("data"))
+    ex = s.bind(mx.cpu(), {"data": mx.nd.array(x)})
+    np.testing.assert_array_equal(ex.forward()[0].asnumpy(), x[::-1, :, :])
+
+
+def test_gluon_transforms_hue_jitter_lighting():
+    """The three op-backed gluon transforms produce valid images and
+    degenerate parameters give identity (reference: gluon vision
+    transforms RandomHue/RandomColorJitter/RandomLighting)."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    x = mx.nd.array(_img())
+    out = T.RandomHue(0.0)(x).asnumpy()
+    np.testing.assert_allclose(out, x.asnumpy(), atol=0.25)
+    out = T.RandomColorJitter(0, 0, 0, 0)(x).asnumpy()
+    np.testing.assert_array_equal(out, x.asnumpy())
+    out = T.RandomColorJitter(0.4, 0.4, 0.4, 0.2)(x).asnumpy()
+    assert out.shape == x.shape and np.isfinite(out).all()
+    out = T.RandomLighting(0.0)(x).asnumpy()
+    np.testing.assert_allclose(out, x.asnumpy(), rtol=1e-6)
+    out = T.RandomLighting(0.5)(x).asnumpy()
+    assert out.shape == x.shape and not np.array_equal(out, x.asnumpy())
+
+
+def test_contrast_batched_is_per_image():
+    """A leading batch dim must not blend one image toward another's gray
+    level: batched output == stacked per-image outputs."""
+    dark = _img(seed=1) * 0.2
+    bright = _img(seed=2) * 0.8 + 50.0
+    batch = np.stack([dark, bright])
+    alpha = 0.3
+    out_b = _apply("_image_random_contrast", batch, rng_seed=0,
+                   min_factor=alpha, max_factor=alpha)
+    for i, single in enumerate((dark, bright)):
+        out_s = _apply("_image_random_contrast", single, rng_seed=0,
+                       min_factor=alpha, max_factor=alpha)
+        np.testing.assert_allclose(out_b[i], out_s, rtol=1e-5)

@@ -175,3 +175,52 @@ def test_compression_params_validation():
     gc_roundtrip = mx.kv.create("device")
     gc_roundtrip.set_gradient_compression({"type": "2bit", "threshold": 2.0})
     assert gc_roundtrip._gc.encode_params() == "2,2.0"
+
+
+def test_compression_on_tpu_sync_eager_push():
+    """Compression set on the tpu_sync kvstore applies on its EAGER
+    push/pull path exactly as on `device` (the fused in-graph step is a
+    separate, never-compressed path — docs/faq/distributed.md scope)."""
+    kv = mx.kv.create("tpu_sync")
+    shape = (4, 3)
+    kv.init("w", mx.nd.zeros(shape))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    rng = np.random.RandomState(7)
+    grads = [rng.normal(0, 1, shape).astype(np.float32) for _ in range(2)]
+    kv.push("w", [mx.nd.array(g) for g in grads])
+    out = mx.nd.empty(shape)
+    kv.pull("w", out=out)
+    expect = np.zeros(shape, np.float32)
+    for g in grads:
+        recv, _ = _np_quantize_roundtrip(g.ravel(),
+                                         np.zeros(g.size, np.float32), 0.5)
+        expect += recv.reshape(shape)
+    np.testing.assert_allclose(out.asnumpy(), expect, atol=1e-6)
+    # quantized values only: every entry is in {-0.5, 0, +0.5} * n_pushes
+    steps = np.unique(np.round(out.asnumpy() / 0.5, 6))
+    assert all(abs(s - round(s)) < 1e-5 for s in steps)
+
+
+def test_compression_routes_module_off_fused_step():
+    """Module.fit with compression_params + tpu_sync must actually
+    compress: the fused in-graph step (which never compresses) is
+    skipped and training goes through the kvstore push path."""
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (64, 8)).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.tpu(0),
+                        compression_params={"type": "2bit",
+                                            "threshold": 0.5})
+    mod.fit(it, num_epoch=2, kvstore="tpu_sync",
+            optimizer_params={"learning_rate": 0.1})
+    assert mod._fused_step is None  # compression honored -> kvstore path
+    assert mod._kvstore is not None and mod._kvstore._gc.active
+    # control: without compression the fused step builds as usual
+    mod2 = mx.mod.Module(net, context=mx.tpu(0))
+    mod2.fit(it, num_epoch=1, kvstore="tpu_sync",
+             optimizer_params={"learning_rate": 0.1})
+    assert mod2._fused_step is not None

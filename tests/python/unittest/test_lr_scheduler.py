@@ -1,0 +1,77 @@
+"""Schedule-value pins for lr_scheduler.py (reference semantics:
+python/mxnet/lr_scheduler.py — validated against its stateful loop)."""
+import math
+
+import pytest
+
+from mxnet_tpu.lr_scheduler import (CosineScheduler, FactorScheduler,
+                                    MultiFactorScheduler, PolyScheduler)
+
+
+def test_factor_decay_table():
+    s = FactorScheduler(step=2, factor=0.5)
+    s.base_lr = 0.4
+    assert [round(s(n), 6) for n in range(1, 8)] == [
+        0.4, 0.4, 0.2, 0.2, 0.1, 0.1, 0.05]
+
+
+def test_factor_skipped_updates_fold_all_crossings():
+    # the count can jump (one call per N weights): all passed boundaries
+    # apply at once, matching the reference's while-loop
+    s = FactorScheduler(step=10, factor=0.1, base_lr=1.0)
+    assert abs(s(35) - 1e-3) < 1e-12
+
+
+def test_factor_floors_at_stop_lr():
+    s = FactorScheduler(step=1, factor=0.1, stop_factor_lr=1e-3, base_lr=1.0)
+    for n in range(1, 10):
+        s(n)
+    assert s(20) == 1e-3
+    # raising base_lr mid-run resumes decay from the new value
+    # (two boundaries pass between update 20 and 22 at step=1)
+    s.base_lr = 1.0
+    assert abs(s(22) - 0.01) < 1e-12
+
+
+def test_factor_repeated_calls_idempotent():
+    s = FactorScheduler(step=2, factor=0.5, base_lr=0.4)
+    assert s(3) == s(3) == 0.2
+
+
+def test_factor_validates_step():
+    with pytest.raises(ValueError):
+        FactorScheduler(step=0)
+
+
+def test_multifactor_table():
+    s = MultiFactorScheduler(step=[3, 5], factor=0.1, base_lr=1.0)
+    got = [round(s(n), 6) for n in range(1, 8)]
+    assert got == [1.0, 1.0, 1.0, 0.1, 0.1, 0.01, 0.01]
+
+
+def test_multifactor_validates_monotonic():
+    with pytest.raises(ValueError):
+        MultiFactorScheduler(step=[5, 5])
+    with pytest.raises(ValueError):
+        MultiFactorScheduler(step=[])
+
+
+def test_poly_curve_and_hold():
+    s = PolyScheduler(max_update=10, base_lr=1.0, pwr=2)
+    assert abs(s(5) - 0.25) < 1e-12
+    assert s(10) == 0.0
+    assert s(15) == 0.0  # holds the final value past max_update
+
+
+def test_cosine_curve_and_hold():
+    s = CosineScheduler(max_update=10, base_lr=1.0, final_lr=0.1)
+    assert abs(s(0) - 1.0) < 1e-12
+    mid = 0.1 + 0.9 * (1 + math.cos(math.pi / 2)) / 2
+    assert abs(s(5) - mid) < 1e-12
+    assert abs(s(10) - 0.1) < 1e-12
+    assert abs(s(99) - 0.1) < 1e-12
+
+
+def test_poly_validates_max_update():
+    with pytest.raises(ValueError):
+        PolyScheduler(max_update=0)

@@ -88,6 +88,13 @@ entry("add_n", mx.sym.add_n(X, Y, Z),
 entry("Cast", mx.sym.Cast(X, dtype="float64"), {"x": _sym((2, 3))})
 entry("Crop", mx.sym.Crop(X, h_w=(2, 2), center_crop=True),
       {"x": _sym((1, 1, 4, 4))})
+entry("_image_flip_left_right", mx.sym.image.flip_left_right(X),
+      {"x": _sym((3, 4, 3))})
+entry("_image_flip_top_bottom", mx.sym.image.flip_top_bottom(X),
+      {"x": _sym((3, 4, 3))})
+entry("_image_adjust_lighting",
+      mx.sym.image.adjust_lighting(X, alpha=(0.02, -0.01, 0.03)),
+      {"x": _sym((3, 4, 3))})
 entry("identity", mx.sym.identity(X), {"x": _sym((2, 3))})
 entry("softrelu", mx.sym.softrelu(X), {"x": _sym((2, 3))})
 entry("softsign", mx.sym.softsign(X), {"x": _sym((2, 3))})
@@ -372,6 +379,16 @@ EXCLUDED = {
     "_full": "constant creator", "_eye": "constant creator",
     "_arange": "constant creator", "zeros_like": "constant creator",
     "ones_like": "constant creator",
+    # stochastic image augmentations (rng-dependent compute path; the
+    # deterministic family members are swept as entries)
+    "_image_random_flip_left_right": "stochastic augmentation",
+    "_image_random_flip_top_bottom": "stochastic augmentation",
+    "_image_random_brightness": "stochastic augmentation",
+    "_image_random_contrast": "stochastic augmentation",
+    "_image_random_saturation": "stochastic augmentation",
+    "_image_random_hue": "stochastic augmentation",
+    "_image_random_color_jitter": "stochastic augmentation",
+    "_image_random_lighting": "stochastic augmentation",
     # random samplers (stochastic forward; no gradient in the reference)
     "_random_uniform": "sampler", "_random_normal": "sampler",
     "_random_gamma": "sampler", "_random_exponential": "sampler",

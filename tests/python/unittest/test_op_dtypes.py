@@ -1,0 +1,173 @@
+"""Low-precision numerics for the core op families: each op runs
+fwd(train)+bwd in float16 and bfloat16 and must agree with its own
+float32 run within dtype-aware tolerances — the flagship bf16 fused path
+deserves op-level pinning, not just end-to-end convergence.
+
+Tolerance model mirrors the reference's dtype-keyed assert_almost_equal
+machinery (reference: python/mxnet/test_utils.py — rtol/atol chosen per
+dtype): bf16 keeps 8 mantissa bits (eps ~ 7.8e-3), fp16 keeps 10
+(eps ~ 9.8e-4); gradients accumulate a few ulps more than forwards.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+# (rtol, atol) per dtype — forward; backward doubles the budget
+_TOL = {"float16": (2e-2, 2e-2), "bfloat16": (8e-2, 8e-2)}
+
+_DTYPES = ["float16", "bfloat16"]
+
+
+def _run(sym, arrays, out_grad, dtype):
+    """simple_bind fwd(train)+bwd in `dtype`; returns (out, grads) as f32."""
+    from mxnet_tpu.base import np_dtype
+    exe = sym.simple_bind(mx.cpu(), grad_req="write",
+                          type_dict={k: np_dtype(dtype) for k in arrays},
+                          **{k: v.shape for k, v in arrays.items()})
+    for k, v in arrays.items():
+        exe.arg_dict[k][:] = mx.nd.array(v, dtype=dtype)
+    out = exe.forward(is_train=True)[0]
+    exe.backward(out_grads=mx.nd.array(out_grad, dtype=out.dtype))
+    to32 = lambda a: a.asnumpy().astype(np.float32)  # noqa: E731
+    return to32(out), {k: to32(g) for k, g in exe.grad_dict.items()}
+
+
+def _sweep(sym, arrays, out_shape=None, seed=0):
+    """Run f32 as the oracle, then each low dtype against it. The head
+    gradient's shape comes from shape inference (scalar reductions have
+    shape (), which a caller-guessed tuple gets wrong)."""
+    rng = np.random.RandomState(seed)
+    inferred = sym.infer_shape(**{k: v.shape for k, v in arrays.items()})[1]
+    og = rng.normal(size=inferred[0]).astype(np.float32)
+    ref_out, ref_gr = _run(sym, arrays, og, "float32")
+    for dtype in _DTYPES:
+        rtol, atol = _TOL[dtype]
+        out, gr = _run(sym, arrays, og, dtype)
+        scale = max(1.0, float(np.abs(ref_out).max()))
+        np.testing.assert_allclose(
+            out, ref_out, rtol=rtol, atol=atol * scale,
+            err_msg="%s fwd" % dtype)
+        for name, g in gr.items():
+            gscale = max(1.0, float(np.abs(ref_gr[name]).max()))
+            np.testing.assert_allclose(
+                g, ref_gr[name], rtol=2 * rtol, atol=2 * atol * gscale,
+                err_msg="%s grad(%s)" % (dtype, name))
+
+
+def test_convolution_dtypes():
+    rng = np.random.RandomState(1)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    w = rng.normal(scale=0.5, size=(4, 3, 3, 3)).astype(np.float32)
+    b = rng.normal(size=(4,)).astype(np.float32)
+    sym = mx.sym.Convolution(mx.sym.Variable("x"), kernel=(3, 3),
+                             num_filter=4, stride=(1, 1), pad=(1, 1),
+                             name="c")
+    _sweep(sym, {"x": x, "c_weight": w, "c_bias": b}, (2, 4, 8, 8))
+
+
+def test_fully_connected_dtypes():
+    rng = np.random.RandomState(2)
+    x = rng.normal(size=(4, 10)).astype(np.float32)
+    w = rng.normal(scale=0.3, size=(6, 10)).astype(np.float32)
+    b = rng.normal(size=(6,)).astype(np.float32)
+    sym = mx.sym.FullyConnected(mx.sym.Variable("x"), num_hidden=6,
+                                name="fc")
+    _sweep(sym, {"x": x, "fc_weight": w, "fc_bias": b}, (4, 6))
+
+
+def test_batchnorm_dtypes():
+    rng = np.random.RandomState(3)
+    x = rng.normal(size=(4, 3, 6, 6)).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, (3,)).astype(np.float32)
+    beta = rng.normal(size=(3,)).astype(np.float32)
+    sym = mx.sym.BatchNorm(mx.sym.Variable("x"), fix_gamma=False,
+                           name="bn")
+    _sweep(sym, {"x": x, "bn_gamma": gamma, "bn_beta": beta},
+           (4, 3, 6, 6))
+
+
+def test_softmax_dtypes():
+    rng = np.random.RandomState(4)
+    x = rng.normal(scale=2.0, size=(5, 9)).astype(np.float32)
+    _sweep(mx.sym.softmax(mx.sym.Variable("x")), {"x": x}, (5, 9))
+
+
+def test_log_softmax_dtypes():
+    rng = np.random.RandomState(5)
+    x = rng.normal(scale=2.0, size=(5, 9)).astype(np.float32)
+    _sweep(mx.sym.log_softmax(mx.sym.Variable("x")), {"x": x}, (5, 9))
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_pooling_dtypes(pool_type):
+    rng = np.random.RandomState(6)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    sym = mx.sym.Pooling(mx.sym.Variable("x"), kernel=(2, 2),
+                         stride=(2, 2), pool_type=pool_type)
+    _sweep(sym, {"x": x}, (2, 3, 4, 4))
+
+
+def test_global_pooling_dtypes():
+    rng = np.random.RandomState(7)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    sym = mx.sym.Pooling(mx.sym.Variable("x"), kernel=(1, 1),
+                         global_pool=True, pool_type="avg")
+    _sweep(sym, {"x": x}, (2, 3, 1, 1))
+
+
+@pytest.mark.parametrize("op,out_shape", [
+    ("sum", ()), ("mean", ()), ("max", ()), ("min", ())])
+def test_reduce_all_dtypes(op, out_shape):
+    rng = np.random.RandomState(8)
+    x = rng.normal(size=(3, 4, 5)).astype(np.float32)
+    sym = getattr(mx.sym, op)(mx.sym.Variable("x"))
+    _sweep(sym, {"x": x}, out_shape or (1,))
+
+
+@pytest.mark.parametrize("op", ["sum", "mean", "max"])
+def test_reduce_axis_dtypes(op):
+    rng = np.random.RandomState(9)
+    x = rng.normal(size=(3, 4, 5)).astype(np.float32)
+    sym = getattr(mx.sym, op)(mx.sym.Variable("x"), axis=1)
+    _sweep(sym, {"x": x}, (3, 5))
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu"])
+def test_activation_dtypes(act):
+    rng = np.random.RandomState(10)
+    x = rng.normal(scale=2.0, size=(4, 7)).astype(np.float32)
+    sym = mx.sym.Activation(mx.sym.Variable("x"), act_type=act)
+    _sweep(sym, {"x": x}, (4, 7))
+
+
+def test_layernorm_dtypes():
+    rng = np.random.RandomState(11)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    gamma = rng.uniform(0.5, 1.5, (8,)).astype(np.float32)
+    beta = rng.normal(size=(8,)).astype(np.float32)
+    sym = mx.sym.LayerNorm(mx.sym.Variable("x"), name="ln")
+    _sweep(sym, {"x": x, "ln_gamma": gamma, "ln_beta": beta}, (4, 8))
+
+
+def test_softmax_output_dtypes():
+    # the classifier head of the flagship path (grad = softmax - onehot)
+    rng = np.random.RandomState(12)
+    x = rng.normal(scale=2.0, size=(6, 5)).astype(np.float32)
+    lab = rng.randint(0, 5, (6,)).astype(np.float32)
+
+    def run(dtype):
+        sym = mx.sym.SoftmaxOutput(mx.sym.Variable("x"), name="softmax")
+        exe = sym.simple_bind(mx.cpu(), grad_req="write",
+                              x=x.shape, softmax_label=lab.shape)
+        exe.arg_dict["x"][:] = mx.nd.array(x, dtype=dtype)
+        exe.arg_dict["softmax_label"][:] = mx.nd.array(lab, dtype=dtype)
+        exe.forward(is_train=True)
+        exe.backward()
+        return exe.grad_dict["x"].asnumpy().astype(np.float32)
+
+    ref = run("float32")
+    for dtype in _DTYPES:
+        rtol, atol = _TOL[dtype]
+        np.testing.assert_allclose(run(dtype), ref, rtol=2 * rtol,
+                                   atol=2 * atol, err_msg=dtype)

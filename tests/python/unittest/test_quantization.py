@@ -148,3 +148,22 @@ def test_quantize_params_roundtrip_values():
     np.testing.assert_array_equal(q, [[-127, -64, 0, 32, 127]])
     assert qargs["f_weight_min"].asnumpy()[0] == -2.0
     assert qargs["f_weight_max"].asnumpy()[0] == 2.0
+
+
+def test_int8_cpu_simulation_guards_f32_exactness():
+    """The CPU f32-simulated int8 path is only taken while the worst-case
+    accumulation fits f32's 2^24 integer-exact window; bigger reductions
+    use the exact wide-int path (ADVICE r4 review)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.quantization import _int8_compute_dtypes
+    small = jnp.zeros((2, 8), jnp.int8)
+    # 8-term reduction: simulated on CPU
+    *_, simulated = _int8_compute_dtypes(small, small, 8)
+    assert simulated
+    # 4608-term reduction at saturation would exceed 2^24: exact path
+    *_, simulated = _int8_compute_dtypes(small, small, 4608)
+    assert not simulated
+    # mixed dtypes always take the wide path
+    u = jnp.zeros((2, 8), jnp.uint8)
+    *_, simulated = _int8_compute_dtypes(u, small, 8)
+    assert not simulated

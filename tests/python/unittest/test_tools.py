@@ -120,22 +120,51 @@ def test_parse_log_markdown(tmp_path):
 
 def test_tpu_grind_resumes_from_results(tmp_path):
     """tpu_grind skips phases already banked in --results (it must be
-    restartable without redoing work)."""
+    restartable without redoing work). With --once and a ledger banked at
+    the CURRENT commit it exits immediately; the default mode would
+    instead idle, watching for new commits to refresh against."""
     import json
     sys.path.insert(0, os.path.join(_REPO, "tools"))
-    from tpu_grind import PHASES  # single source of phase names
+    from tpu_grind import PHASES, _git_head  # single source of phase names
     results = tmp_path / "r.jsonl"
     import time as _time
+    head = _git_head()
     lines = [json.dumps({"phase": p, "result": {"x": 1}, "platform": "tpu",
-                         "ts": _time.time(), "iso": "t", "commit": "c"})
+                         "ts": _time.time(), "iso": "t", "commit": head})
              for p in PHASES]
     results.write_text("\n".join(lines) + "\n")
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "tpu_grind.py"),
-         "--results", str(results)],
+         "--results", str(results), "--once"],
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stderr
     assert "all phases banked" in out.stdout
+
+
+def test_tpu_grind_refresh_mode_reports_current_ledger(tmp_path):
+    """Default (refresh) mode with an at-HEAD ledger goes idle rather than
+    exiting — it keeps the ledger aligned with future commits. Pin via a
+    1-second idle-sleep and a kill after the first status line."""
+    import json
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    from tpu_grind import PHASES, _git_head
+    results = tmp_path / "r.jsonl"
+    import time as _time
+    head = _git_head()
+    lines = [json.dumps({"phase": p, "result": {"x": 1}, "platform": "tpu",
+                         "ts": _time.time(), "iso": "t", "commit": head})
+             for p in PHASES]
+    results.write_text("\n".join(lines) + "\n")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools", "tpu_grind.py"),
+         "--results", str(results), "--idle-sleep", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "ledger current at %s" % head in line, line
+    finally:
+        proc.kill()
+        proc.wait()
 
 
 # --- bench.py banked-TPU fallback (tools/tpu_grind.py ledger) ---------------

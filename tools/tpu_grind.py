@@ -65,13 +65,37 @@ def main():
     ap.add_argument("--probe-timeout", type=int, default=90)
     ap.add_argument("--phase-timeout", type=int, default=1500)
     ap.add_argument("--down-sleep", type=int, default=240)
+    ap.add_argument("--idle-sleep", type=int, default=600,
+                    help="sleep when every phase is banked at current HEAD")
+    ap.add_argument("--once", action="store_true",
+                    help="exit once all phases are banked (old behavior); "
+                         "default keeps refreshing stale-commit entries")
     args = ap.parse_args()
 
-    # resume through the same parse/filter bench.py's fallback will apply,
-    # so "banked" here can never drift from what the bench will actually use
-    done = {p for p in _load_bank(args.results) if p in PHASES}
+    # the honest-ratio pair must share a bank commit or bench.py's
+    # same_bank_commit guard refuses vs_jax_flax — re-bank them together
+    RATIO_PAIR = ("train_bf16", "jax_baseline")
 
-    while len(done) < len(PHASES):
+    while True:
+        # resume through the same parse/filter bench.py's fallback will
+        # apply, so "banked" can never drift from what the bench will use
+        bank = _load_bank(args.results)
+        head = _git_head()
+        missing = [p for p in PHASES if p not in bank]
+        stale = [p for p in PHASES
+                 if p in bank and bank[p].get("commit") != head]
+        work = set(missing) | set(stale)
+        if work & set(RATIO_PAIR):
+            work |= set(RATIO_PAIR)
+        if not work:
+            if args.once:
+                print("[grind] all phases banked", flush=True)
+                return
+            print("[grind] ledger current at %s %s; sleeping %ds"
+                  % (head, time.strftime("%H:%M:%S"), args.idle_sleep),
+                  flush=True)
+            time.sleep(args.idle_sleep)
+            continue
         probe = _run("probe", args.probe_timeout)
         if probe is None:
             print("[grind] backend down %s; sleeping %ds"
@@ -88,16 +112,13 @@ def main():
                                     args.down_sleep), flush=True)
             time.sleep(args.down_sleep)
             continue
-        for phase in PHASES:
-            if phase in done:
-                continue
+        for phase in [p for p in PHASES if p in work]:
             print("[grind] phase %s %s" % (phase, time.strftime("%H:%M:%S")),
                   flush=True)
             res = _run(phase, args.phase_timeout)
             if res is None:
                 print("[grind] %s failed; re-probing" % phase, flush=True)
                 break  # re-probe before spending another budget
-            done.add(phase)
             with open(args.results, "a") as f:
                 # provenance travels with every banked line so bench.py's
                 # banked-fallback can label exactly what ran where and when
@@ -109,7 +130,6 @@ def main():
                     "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                     "commit": _git_head()}) + "\n")
             print("[grind] %s OK: %s" % (phase, json.dumps(res)), flush=True)
-    print("[grind] all phases banked", flush=True)
 
 
 if __name__ == "__main__":
