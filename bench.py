@@ -36,7 +36,7 @@ PROBE_TIMEOUT_S = 75
 PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy)
     "infer": 900, "train_fp32": 800, "train_bf16": 600,
     "jax_baseline": 700, "flash": 700, "io_train": 600,
-    "infer_int8": 600, "train_big_batch": 900,
+    "infer_int8": 600, "train_big_batch": 900, "flash_parity": 500,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -292,10 +292,10 @@ def main():
 
     # 2) measurement phases, each in its own budgeted child
     phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
-              "io_train", "infer_int8", "train_big_batch"]
+              "io_train", "infer_int8", "train_big_batch", "flash_parity"]
     # phases that measure nothing useful on the CPU fallback (outage
     # removals — unlike explicit_skips, the bank may still supply them)
-    cpu_useless = {"train_bf16", "train_big_batch"}
+    cpu_useless = {"train_bf16", "train_big_batch", "flash_parity"}
     for p in explicit_skips | (cpu_useless if force_cpu else set()):
         if p in phases:
             phases.remove(p)
@@ -388,7 +388,8 @@ def main():
             or any(k.startswith("live_cpu_") for k in extra)):
         extra.update(_host_stamp())
     for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
-                  "io_train", "infer_int8", "train_big_batch"):
+                  "io_train", "infer_int8", "train_big_batch",
+                  "flash_parity"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
                       if not k.startswith("_")})
     # mixed-platform runs (partial rescue): say which metric ran where
@@ -650,6 +651,36 @@ def _phase_flash():
     return out
 
 
+def _phase_flash_parity():
+    """On-chip, NON-interpret fwd+bwd parity of both Pallas kernel
+    families vs the jnp blockwise path, at the PINNED production block
+    sizes (tools/flash_tune.run_parity — one shared dtype/tolerance
+    table). CI runs these kernels interpret-mode only (no TPU), so
+    kernel-side regressions (VMEM overflow, Mosaic layout errors) would
+    otherwise surface first at bench time — banking one parity record
+    per healthy chip window closes that gap.
+
+    RAISES when no TPU backend is live (e.g. the chip flapped after the
+    probe and jax fell back to CPU): an empty rc-0 result would be
+    banked by tpu_grind as permanent 'validation' and would shadow real
+    banked records in _apply_bank — a failed phase is the truthful
+    outcome."""
+    import jax
+    from mxnet_tpu.kernels.flash_attention import (flash_attention,
+                                                   blockwise_attention,
+                                                   default_use_pallas)
+    if not default_use_pallas():
+        raise RuntimeError("flash_parity: no TPU backend (pallas gate "
+                           "off) — nothing to validate")
+    import jax.numpy as jnp
+    sys.path.insert(0, _HERE)
+    from tools.flash_tune import run_parity, load_pinned_blocks
+    return run_parity(
+        jax, jnp, flash_attention, blockwise_attention,
+        pinned_blocks=load_pinned_blocks(
+            os.path.join(_HERE, "flash_tune_results.json")))
+
+
 def _phase_infer_int8():
     """Post-training int8 inference: quantize_model rewrites ResNet-50
     conv/FC into `_contrib_quantized_*` ops (int8 MXU compute, int32
@@ -775,6 +806,7 @@ PHASES = {
     "io_train": _phase_io_train,
     "infer_int8": _phase_infer_int8,
     "train_big_batch": _phase_train_big_batch,
+    "flash_parity": _phase_flash_parity,
 }
 
 

@@ -21,15 +21,20 @@ import time
 import numpy as np
 
 
-def _parity(jax, jnp, flash, blockwise, dtype, tol):
+def _parity(jax, jnp, flash, blockwise, dtype, tol, variant="stream",
+            block_q=None, block_k=None):
     """fwd+bwd agreement between the Pallas kernel and the jnp path."""
     rng = np.random.RandomState(0)
     B, H, S, D = 1, 2, 1024, 128
     q, k, v = (jnp.asarray(rng.normal(0, 1, (B, H, S, D)).astype(np.float32),
                            dtype=dtype) for _ in range(3))
+    blocks = {}
+    if block_q is not None:
+        blocks = {"block_q": block_q, "block_k": block_k}
 
     def loss_pallas(q, k, v):
-        return (flash(q, k, v, causal=True, use_pallas=True) ** 2).sum()
+        return (flash(q, k, v, causal=True, use_pallas=True,
+                      variant=variant, **blocks) ** 2).sum()
 
     def loss_ref(q, k, v):
         out, _ = blockwise(q, k, v, causal=True, block_k=256)
@@ -44,6 +49,46 @@ def _parity(jax, jnp, flash, blockwise, dtype, tol):
         assert err / scale < tol, ("d%s rel err %.3g (tol %.3g, %s)"
                                    % (name, err / scale, tol, dtype))
     return True
+
+
+# the one dtype/tolerance table for flash parity everywhere (bench.py's
+# flash_parity phase imports run_parity, so the banked record and the
+# pinned tune record can never disagree about what "parity" means)
+PARITY_DTYPES = (("fp32", 2e-3), ("bf16", 4e-2))
+DEFAULT_BLOCKS = {"stream": (1024, 512), "grid": (512, 512)}
+
+
+def load_pinned_blocks(path):
+    """{variant: (block_q, block_k)} winners from a flash_tune pin file."""
+    import json as _json
+    try:
+        with open(path) as f:
+            best = _json.load(f).get("best_by_variant") or {}
+        return {v: (r["block_q"], r["block_k"]) for v, r in best.items()}
+    except (OSError, ValueError, KeyError, TypeError):
+        return {}
+
+
+def run_parity(jax, jnp, flash, blockwise, pinned_blocks=None):
+    """Non-interpret fwd+bwd parity of BOTH Pallas families at each
+    PARITY_DTYPES entry, using the PINNED production block sizes when
+    available (VMEM/layout failures are block-size dependent — validating
+    only defaults would miss regressions in the config the bench runs).
+    Returns {key: True | 'Error: ...'} per (variant, dtype)."""
+    out = {}
+    for variant in ("stream", "grid"):
+        bq, bk = (pinned_blocks or {}).get(variant,
+                                           DEFAULT_BLOCKS[variant])
+        for name, tol in PARITY_DTYPES:
+            dtype = jnp.float32 if name == "fp32" else jnp.bfloat16
+            key = "flash_parity_%s_%s" % (variant, name)
+            try:
+                _parity(jax, jnp, flash, blockwise, dtype, tol,
+                        variant=variant, block_q=bq, block_k=bk)
+                out[key] = True
+            except Exception as e:  # noqa: BLE001 — recorded, not masked
+                out[key] = "%s: %s" % (type(e).__name__, str(e)[:140])
+    return out
 
 
 def main():
@@ -72,14 +117,13 @@ def main():
     print("default_use_pallas:", default_use_pallas())
     assert default_use_pallas(), "not on a TPU backend — nothing to tune"
 
-    # on-chip (non-interpret) fwd+bwd parity for BOTH kernel families —
-    # the record CI's interpret-mode runs cannot produce
-    parity = {}
-    for dtype, name, tol in ((jnp.float32, "fp32", 2e-3),
-                             (jnp.bfloat16, "bf16", 4e-2)):
-        parity[name] = _parity(jax, jnp, flash_attention,
-                               blockwise_attention, dtype, tol)
-        print("parity %s: %s" % (name, parity[name]))
+    # on-chip (non-interpret) fwd+bwd parity for BOTH kernel families at
+    # the pinned production block sizes — the record CI's interpret-mode
+    # runs cannot produce
+    parity = run_parity(jax, jnp, flash_attention, blockwise_attention,
+                        pinned_blocks=load_pinned_blocks(args.out))
+    print("parity:", json.dumps(parity))
+    parity_ok = all(v is True for v in parity.values())
 
     def _write_out(results, note=""):
         ok = [r for r in results if "fwd_tflops" in r]
@@ -120,6 +164,8 @@ def main():
 
     if args.quick:
         _write_out([], note="--quick: parity only, no sweep")
+        if not parity_ok:
+            raise SystemExit("parity failures: %s" % json.dumps(parity))
         return
 
     import sys as _sys
@@ -185,6 +231,8 @@ def main():
     payload = _write_out(results)
     if payload["best"] is not None:
         print("BEST:", json.dumps(payload["best"]))
+    if not parity_ok:
+        raise SystemExit("parity failures: %s" % json.dumps(parity))
 
 
 if __name__ == "__main__":
