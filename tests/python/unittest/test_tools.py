@@ -135,7 +135,7 @@ def test_tpu_grind_resumes_from_results(tmp_path):
     results.write_text("\n".join(lines) + "\n")
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "tpu_grind.py"),
-         "--results", str(results), "--once"],
+         "--results", str(results), "--once", "--tune-budget", "0"],
         capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stderr
     assert "all phases banked" in out.stdout
@@ -157,7 +157,8 @@ def test_tpu_grind_refresh_mode_reports_current_ledger(tmp_path):
     results.write_text("\n".join(lines) + "\n")
     proc = subprocess.Popen(
         [sys.executable, os.path.join(_REPO, "tools", "tpu_grind.py"),
-         "--results", str(results), "--idle-sleep", "1"],
+         "--results", str(results), "--idle-sleep", "1",
+         "--tune-budget", "0"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     try:
         line = proc.stdout.readline()
