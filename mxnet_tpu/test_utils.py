@@ -3,6 +3,8 @@ module user test-suites import as `mx.test_utils`). The implementation
 lives in util/test_utils; this module is the reference-named surface."""
 from __future__ import annotations
 
+import os
+
 import numpy as _np
 
 from .util.test_utils import (  # noqa: F401
@@ -51,3 +53,278 @@ def np_reduce(dat, axis, keepdims, numpy_reduce_func):
             keepdims_shape[i] = 1
         ret = ret.reshape(tuple(keepdims_shape))
     return ret
+
+
+def get_rtol(rtol=None, dtype=_np.float32):
+    """Dtype-keyed default relative tolerance (reference test_utils.py
+    get_rtol)."""
+    from .util.test_utils import _DEFAULT_RTOL
+    if rtol is not None:
+        return rtol
+    return _DEFAULT_RTOL.get(_np.dtype(dtype), 1e-5)
+
+
+def get_atol(atol=None, dtype=_np.float32):
+    """Dtype-keyed default absolute tolerance (reference test_utils.py
+    get_atol)."""
+    from .util.test_utils import _DEFAULT_ATOL
+    if atol is not None:
+        return atol
+    return _DEFAULT_ATOL.get(_np.dtype(dtype), 1e-20)
+
+
+def random_arrays(*shapes):
+    """One gaussian numpy array per shape; a single shape returns the bare
+    array (reference test_utils.py random_arrays)."""
+    made = [_np.random.randn(*s).astype(default_dtype()) for s in shapes]
+    return made[0] if len(made) == 1 else made
+
+
+def random_sample(population, k):
+    """k elements drawn without replacement (reference random_sample)."""
+    assert 0 <= k <= len(population)
+    picked = _np.random.permutation(len(population))[:k]
+    return [population[i] for i in picked]
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    """almost_equal over only the positions where NEITHER side is NaN."""
+    from .util.test_utils import _as_np
+    a, b = _as_np(a), _as_np(b)
+    keep = ~(_np.isnan(a) | _np.isnan(b))
+    return almost_equal(a[keep], b[keep], rtol, atol)
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None,
+                                   names=("a", "b")):
+    from .util.test_utils import _as_np
+    a, b = _as_np(a), _as_np(b)
+    keep = ~(_np.isnan(a) | _np.isnan(b))
+    assert_almost_equal(a[keep], b[keep], rtol, atol, names=names)
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """f(*args, **kwargs) must raise exception_type (reference
+    assert_exception)."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError("%r did not raise %s" % (f, exception_type))
+
+
+def retry(n):
+    """Decorator: rerun a stochastic test up to n times before failing
+    (reference test_utils.py retry)."""
+    assert n > 0
+    import functools
+
+    def decorate(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for attempt in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError:
+                    if attempt == n - 1:
+                        raise
+        return wrapper
+    return decorate
+
+
+def _bind_with_location(sym, location, aux_states, ctx, grad_req,
+                        dtype=_np.float32):
+    """simple_bind an executor and fill args from a list/dict of numpy
+    arrays (the location convention shared by the check_symbolic_*
+    helpers; reference _parse_location/_parse_aux_states)."""
+    ctx = ctx or default_context()
+    names = sym.list_arguments()
+    if isinstance(location, dict):
+        loc = {k: _np.asarray(v, dtype=dtype) for k, v in location.items()}
+    else:
+        loc = {n: _np.asarray(v, dtype=dtype)
+               for n, v in zip(names, location)}
+    exe = sym.simple_bind(ctx, grad_req=grad_req,
+                          **{k: v.shape for k, v in loc.items()})
+    for k, v in loc.items():
+        exe.arg_dict[k][:] = v
+    if aux_states:
+        aux = aux_states if isinstance(aux_states, dict) else dict(
+            zip(sym.list_auxiliary_states(), aux_states))
+        for k, v in aux.items():
+            exe.aux_dict[k][:] = _np.asarray(v, dtype=dtype)
+    return exe, loc
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=None,
+                           aux_states=None, ctx=None, equal_nan=False,
+                           dtype=_np.float32):
+    """Forward outputs must match `expected` (list or dict by output
+    name); returns the outputs (reference check_symbolic_forward)."""
+    exe, _ = _bind_with_location(sym, location, aux_states, ctx, "null",
+                                 dtype)
+    outputs = [o.asnumpy() for o in exe.forward(is_train=False)]
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym.list_outputs()]
+    for out, want, name in zip(outputs, expected, sym.list_outputs()):
+        assert_almost_equal(out, _np.asarray(want), rtol, atol,
+                            names=("forward(%s)" % name, "expected"),
+                            equal_nan=equal_nan)
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None, equal_nan=False, dtype=_np.float32):
+    """Input gradients under the given head gradients must match
+    `expected` (list or dict by argument name); returns the gradient
+    dict (reference check_symbolic_backward)."""
+    from .ndarray.ndarray import array as nd_array
+    exe, loc = _bind_with_location(sym, location, aux_states, ctx,
+                                   grad_req, dtype)
+    exe.forward(is_train=True)
+    exe.backward(out_grads=[nd_array(_np.asarray(g, dtype=dtype))
+                            for g in (out_grads or [])] or None)
+    grads = {k: v.asnumpy() for k, v in exe.grad_dict.items()
+             if v is not None}
+    if not isinstance(expected, dict):
+        expected = dict(zip(sym.list_arguments(), expected))
+    for name, want in expected.items():
+        assert_almost_equal(grads[name], _np.asarray(want), rtol, atol,
+                            names=("grad(%s)" % name, "expected"),
+                            equal_nan=equal_nan)
+    return grads
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req=None,
+                typ="whole", **kwargs):
+    """Seconds/iteration for fwd+bwd ("whole") or forward only
+    ("forward"); shapes come from `location` or **kwargs (reference
+    check_speed)."""
+    import time as _time
+    ctx = ctx or default_context()
+    if grad_req is None:
+        grad_req = "write" if typ == "whole" else "null"
+    if location is None:
+        exe = sym.simple_bind(ctx, grad_req=grad_req, **kwargs)
+        for arr in exe.arg_dict.values():
+            arr[:] = _np.random.uniform(-1, 1, arr.shape).astype(
+                _np.float32)
+    else:
+        exe, _ = _bind_with_location(sym, location, None, ctx, grad_req)
+
+    def one_iter():
+        exe.forward(is_train=(typ == "whole"))
+        if typ == "whole":
+            exe.backward()
+        exe.outputs[0].wait_to_read()
+    one_iter()  # warmup: compile
+    tic = _time.time()
+    for _ in range(N):
+        one_iter()
+    return (_time.time() - tic) / N
+
+
+def same_array(array1, array2):
+    """Whether the two NDArrays view the SAME device buffer. Divergence
+    note: the reference checks aliasing by writing through one array and
+    reading the other; buffers here are immutable jax arrays (mutation
+    swaps the wrapper's buffer), so aliasing === buffer identity at the
+    time of the call."""
+    return array1._data is array2._data
+
+
+class discard_stderr:
+    """`with discard_stderr():` — silence fd-level stderr for a block
+    (reference test_utils.py discard_stderr)."""
+
+    def __enter__(self):
+        import sys
+        self._devnull = open(os.devnull, "w")
+        self._saved = os.dup(sys.stderr.fileno())
+        os.dup2(self._devnull.fileno(), sys.stderr.fileno())
+        return self
+
+    def __exit__(self, *exc):
+        import sys
+        os.dup2(self._saved, sys.stderr.fileno())
+        os.close(self._saved)
+        self._devnull.close()
+        return False
+
+
+def set_env_var(key, val, default_val=""):
+    """Set an env var, returning its previous value (reference
+    set_env_var)."""
+    prev = os.environ.get(key, default_val)
+    os.environ[key] = val
+    return prev
+
+
+# ---- distribution checks for random generators (reference: the
+# goucher2009beautiful-based mean/var/chi-square machinery) -------------
+
+
+def gen_buckets_probs_with_ppf(ppf, nbuckets):
+    """Equal-probability buckets from a quantile function: returns
+    ([(lo, hi)], [prob]) with prob = 1/nbuckets each."""
+    edges = [ppf(i / nbuckets) for i in range(nbuckets + 1)]
+    buckets = list(zip(edges[:-1], edges[1:]))
+    return buckets, [1.0 / nbuckets] * nbuckets
+
+
+def mean_check(generator, mu, sigma, nsamples=1000000):
+    """Sample mean within mu +- 3*sigma/sqrt(n)."""
+    samples = _np.asarray(generator(nsamples), _np.float64)
+    bound = 3.0 * sigma / _np.sqrt(nsamples)
+    return bool(abs(samples.mean() - mu) < bound)
+
+
+def var_check(generator, sigma, nsamples=1000000):
+    """Sample variance within sigma^2 +- 3*sqrt(2*sigma^4/(n-1))."""
+    samples = _np.asarray(generator(nsamples), _np.float64)
+    bound = 3.0 * _np.sqrt(2.0 * sigma ** 4 / (nsamples - 1))
+    return bool(abs(samples.var() - sigma ** 2) < bound)
+
+
+def chi_square_check(generator, buckets, probs, nsamples=1000000):
+    """Pearson chi-square statistic + p-value of generator samples vs
+    the expected bucket probabilities. `buckets` are (lo, hi) ranges for
+    continuous draws, or scalar values for discrete ones."""
+    from scipy import stats as _stats
+    samples = _np.asarray(generator(nsamples))
+    expected = _np.asarray(probs, _np.float64) * nsamples
+    continuous = isinstance(buckets[0], (tuple, list))
+    if continuous:
+        counts = _np.asarray(
+            [((samples >= lo) & (samples < hi)).sum()
+             for lo, hi in buckets], _np.float64)
+    else:
+        counts = _np.asarray([(samples == v).sum() for v in buckets],
+                             _np.float64)
+    lost = nsamples - counts.sum()
+    if lost:
+        # out-of-bucket draws are evidence AGAINST the generator, not a
+        # reason to crash: fold them into a synthetic zero-expectation
+        # overflow bucket is ill-defined for chisquare, so renormalize
+        # the expectation to the counted mass and let the missing mass
+        # show up as a hard failure when it is material
+        if lost / float(nsamples) > 1e-3:
+            return float("inf"), 0.0  # fails any p-value gate
+        expected = expected * (counts.sum() / expected.sum())
+    stat, pval = _stats.chisquare(f_obs=counts, f_exp=expected)
+    return float(stat), float(pval)
+
+
+def verify_generator(generator, buckets, probs, nsamples=1000000,
+                     nrepeat=5, success_rate=0.15):
+    """Repeat the chi-square check; pass when at least success_rate of
+    the repeats reach p >= 0.05 (reference verify_generator)."""
+    passes = 0
+    for _ in range(nrepeat):
+        _, pval = chi_square_check(generator, buckets, probs, nsamples)
+        passes += pval >= 0.05
+    assert passes >= nrepeat * success_rate, \
+        "generator failed chi-square: %d/%d repeats passed" % (passes,
+                                                               nrepeat)
+    return passes
