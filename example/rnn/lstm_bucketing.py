@@ -1,0 +1,110 @@
+"""LSTM language model with bucketing (reference:
+example/rnn/lstm_bucketing.py — PTB there; a local-file-or-synthetic
+corpus here, this environment has no egress).
+
+The classic variable-length workflow: sentences quantize into a few
+buckets, `BucketingModule` compiles ONE XLA program per bucket (shared
+parameters), and every batch replays its bucket's program — see
+docs/faq/bucketing.md for why bucket count == compile count on TPU.
+
+    python example/rnn/lstm_bucketing.py --num-epochs 5
+
+With CORPUS=path/to/tokens.txt (one sentence of space-separated tokens
+per line) it trains on real text instead of the synthetic corpus.
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+BUCKETS = [10, 20, 30, 40]
+
+
+def tokenize(path):
+    """token text -> int sentences via mx.rnn.encode_sentences
+    (0 is reserved for padding, ids start at 1)."""
+    with open(path) as f:
+        lines = [line.split() for line in f if line.split()]
+    return mx.rnn.encode_sentences(lines, start_label=1, invalid_label=0)
+
+
+def synthetic_corpus(vocab_size=64, n=2000, seed=0):
+    """Markov-ish token chains: learnable structure, no downloads."""
+    rng = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n):
+        length = int(rng.choice(BUCKETS)) - rng.randint(0, 5)
+        start = rng.randint(1, vocab_size)
+        step = rng.choice([1, 2])
+        sents.append([(start + step * k) % (vocab_size - 1) + 1
+                      for k in range(max(2, length))])
+    return sents, vocab_size + 1
+
+
+def lm_sym_gen(vocab_size, num_hidden, num_embed, num_layers):
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=num_embed, name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        for i in range(num_layers):
+            stack.add(mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                      prefix="lstm_l%d_" % i))
+        outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax",
+                                    use_ignore=True, ignore_label=0)
+        return pred, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def main():
+    import logging
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-hidden", type=int, default=128)
+    ap.add_argument("--num-embed", type=int, default=64)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--kv-store", default="tpu_sync")
+    args = ap.parse_args()
+
+    corpus = os.environ.get("CORPUS")
+    if corpus:
+        sents, vocab = tokenize(corpus)
+        vocab_size = max(vocab.values()) + 1
+    else:
+        sents, vocab_size = synthetic_corpus()
+    split = int(0.9 * len(sents))
+    train_it = mx.rnn.BucketSentenceIter(sents[:split], args.batch_size,
+                                         buckets=BUCKETS, invalid_label=0,
+                                         shuffle_seed=1)
+    val_it = mx.rnn.BucketSentenceIter(sents[split:], args.batch_size,
+                                       buckets=BUCKETS, invalid_label=0)
+
+    model = mx.mod.BucketingModule(
+        lm_sym_gen(vocab_size, args.num_hidden, args.num_embed,
+                   args.num_layers),
+        default_bucket_key=train_it.default_bucket_key,
+        context=mx.tpu(0))
+    model.fit(train_it, eval_data=val_it,
+              eval_metric=mx.metric.Perplexity(ignore_label=0),
+              kvstore=args.kv_store, optimizer="adam",
+              optimizer_params={"learning_rate": args.lr},
+              initializer=mx.init.Xavier(),
+              num_epoch=args.num_epochs,
+              batch_end_callback=mx.callback.Speedometer(
+                  args.batch_size, frequent=20))
+
+
+if __name__ == "__main__":
+    main()

@@ -149,3 +149,15 @@ def test_long_context_ring_attention_example():
                 "--lag", "48", "--steps", "120", "--batch", "8"],
                timeout=1500)
     assert "long-context ring attention training OK" in out, out[-2000:]
+
+
+def test_lstm_bucketing_example():
+    """Classic bucketed LSTM LM workflow (reference
+    example/rnn/lstm_bucketing.py): BucketingModule compiles one program
+    per bucket and trains across them."""
+    out = _run([os.path.join(EX, "rnn", "lstm_bucketing.py"),
+                "--num-epochs", "2", "--batch-size", "16"],
+               timeout=1200)
+    ppls = [float(x) for x in
+            re.findall(r"Train-perplexity=([0-9.]+)", out)]
+    assert len(ppls) == 2 and ppls[-1] < ppls[0], out[-2000:]
