@@ -38,6 +38,16 @@ def _luma(f):
     return (f[..., :3] * w).sum(axis=-1, keepdims=True)
 
 
+def _require_rgb_or_gray(data, op_name):
+    """Color ops are defined for C==3 (RGB) and pass through C==1; any
+    other channel count raises up front (the reference kernels index
+    `pixel*3 + c` and would read garbage for e.g. RGBA)."""
+    c = data.shape[-1]
+    if c not in (1, 3):
+        raise ValueError("%s expects 1 or 3 channels (channels-last), "
+                         "got %d" % (op_name, c))
+
+
 # ---------------------------------------------------------------- flips --
 
 
@@ -86,6 +96,7 @@ def _adjust_brightness(data, alpha):
 
 
 def _adjust_contrast(data, alpha):
+    _require_rgb_or_gray(data, "adjust_contrast")
     f = data.astype(jnp.float32)
     gray = _luma(f) if data.shape[-1] > 1 else f
     # PER-IMAGE mean over (H, W, C): a leading batch dim must not blend
@@ -95,6 +106,7 @@ def _adjust_contrast(data, alpha):
 
 
 def _adjust_saturation(data, alpha):
+    _require_rgb_or_gray(data, "adjust_saturation")
     if data.shape[-1] == 1:
         return data
     f = data.astype(jnp.float32)
@@ -150,6 +162,7 @@ def _hls_to_rgb(h, l, s):
 
 
 def _adjust_hue(data, alpha):
+    _require_rgb_or_gray(data, "adjust_hue")
     if data.shape[-1] == 1:
         return data
     f = data.astype(jnp.float32)
@@ -197,6 +210,7 @@ def _random_color_jitter(params, data, rng=None):
     the reference shuffles the four stages per call. Traced-friendly:
     the drawn permutation selects stages through lax.switch instead of
     Python control flow, so the jitted pipeline stays one program."""
+    _require_rgb_or_gray(data, "random_color_jitter")
     k_perm, k_b, k_c, k_s, k_h = jax.random.split(rng, 5)
 
     def draw(key, strength):
@@ -238,6 +252,7 @@ _LIGHT_EIG = (
 
 
 def _adjust_lighting(data, alpha):
+    _require_rgb_or_gray(data, "adjust_lighting")
     if data.shape[-1] == 1:
         return data
     pca = jnp.asarray(_LIGHT_EIG, jnp.float32) @ jnp.asarray(

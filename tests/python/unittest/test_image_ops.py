@@ -184,3 +184,29 @@ def test_contrast_batched_is_per_image():
         out_s = _apply("_image_random_contrast", single, rng_seed=0,
                        min_factor=alpha, max_factor=alpha)
         np.testing.assert_allclose(out_b[i], out_s, rtol=1e-5)
+
+
+def test_color_ops_reject_unsupported_channel_counts():
+    """RGBA-like inputs raise a clear error instead of producing wrong
+    shapes or cryptic trace failures (the reference kernels hardcode
+    3-channel indexing and would read garbage)."""
+    x4 = _img(c=4)
+    for name, kw in (("_image_random_hue",
+                      dict(min_factor=0.1, max_factor=0.1)),
+                     ("_image_random_saturation",
+                      dict(min_factor=0.5, max_factor=0.5)),
+                     ("_image_random_contrast",
+                      dict(min_factor=0.5, max_factor=0.5))):
+        with pytest.raises(ValueError, match="channels"):
+            _apply(name, x4, rng_seed=0, **kw)
+    with pytest.raises(ValueError, match="channels"):
+        _apply("_image_random_color_jitter", x4, rng_seed=0, brightness=0.1,
+               contrast=0.1, saturation=0.1, hue=0.1)
+    with pytest.raises(ValueError, match="channels"):
+        _apply("_image_adjust_lighting", x4, alpha=(0.1, 0.1, 0.1))
+    # channel-agnostic ops still work on 4 channels
+    np.testing.assert_array_equal(
+        _apply("_image_flip_left_right", x4), x4[:, ::-1, :])
+    out = _apply("_image_random_brightness", x4, rng_seed=0,
+                 min_factor=0.5, max_factor=0.5)
+    np.testing.assert_allclose(out, x4 * 0.5, rtol=1e-6)
