@@ -32,7 +32,7 @@ import time
 BASELINE_INFER_P100 = 713.17   # ResNet-50 score b32, docs/faq/perf.md:137-144
 BASELINE_TRAIN_P100 = 181.53   # ResNet-50 train b32, docs/faq/perf.md:178-185
 
-PROBE_TIMEOUT_S = 75
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
 PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy)
     "infer": 900, "train_fp32": 800, "train_bf16": 600,
     "jax_baseline": 700, "flash": 700, "io_train": 600,

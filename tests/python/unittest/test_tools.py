@@ -305,7 +305,10 @@ def test_bench_end_to_end_banked_protocol(tmp_path):
             f.write(json.dumps(e) + "\n")
     env = dict(os.environ)
     env["BENCH_DEADLINE_S"] = "1"  # no live-phase budget: bank-only run
-    env["JAX_PLATFORMS"] = "cpu"   # don't burn probe timeouts on the chip
+    # the image's sitecustomize overrides JAX_PLATFORMS, so the probe
+    # children may still reach for the (possibly wedged) tunneled chip —
+    # a short probe budget keeps this ledger-protocol test chip-agnostic
+    env["BENCH_PROBE_TIMEOUT_S"] = "8"
     for knob in ("BENCH_NO_PROVISIONAL", "BENCH_SKIP_BF16",
                  "BENCH_BANK_MAX_AGE_S"):
         env.pop(knob, None)  # assert on default-mode protocol behavior
