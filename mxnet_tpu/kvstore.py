@@ -285,6 +285,13 @@ def create(name="local"):
     """reference: src/kvstore/kvstore.cc:40-77 substring dispatch."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
+    if "async" in name:
+        # the one mode XLA collectives cannot express: per-push server
+        # updates with no worker barrier (kvstore_async.py). Workers are
+        # INDEPENDENT processes talking to the parameter server over TCP
+        # — no jax.distributed process group is formed.
+        from .kvstore_async import KVStoreDistAsync
+        return KVStoreDistAsync()
     if "tpu" in name or "dist" in name:
         # join the process group if a launcher provided one (launch.py env);
         # must happen before first device use — workers launched via

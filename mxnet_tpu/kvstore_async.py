@@ -1,0 +1,341 @@
+"""`dist_async` — a real asynchronous parameter server.
+
+Reference: src/kvstore/kvstore_dist_server.h:282-294 — in async mode the
+server applies the optimizer to EVERY worker push immediately, with no
+cross-worker barrier; workers pull whatever weights the server has at
+that moment (bounded staleness). This is the one reference behavior
+class XLA collectives cannot express (collectives are synchronous by
+construction), so it gets an actual server:
+
+* `AsyncParamServer` — a host-side TCP server owning fp32 weights and
+  the optimizer (`update_on_kvstore=True` semantics). One request loop
+  serializes updates exactly like the reference engine serializes
+  per-key server ops.
+* `KVStoreDistAsync` — the worker client: `push` ships gradients and
+  returns (no barrier), `pull` fetches current weights.
+
+Topology and wire format are deliberately minimal: ONE server process
+(the reference shards big arrays across N ps-lite servers; a single
+host-side server is enough for the scale this path is for — anyone at
+multi-host scale wants `dist_sync`'s in-graph collectives), and
+length-prefixed pickle over TCP. Like the reference's ps-lite transport
+this is for TRUSTED cluster networks only: pickle deserialization is
+code execution, so never expose the port beyond the job's hosts
+(reference ps-lite vans are equally unauthenticated).
+
+Env protocol (reference kvstore.h:254 InitPSEnv):
+  DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT — server address
+  DMLC_ROLE                            — worker | server | scheduler
+  DMLC_NUM_WORKER / DMLC_WORKER_ID     — worker identity
+`tools/launch.py --num-servers 1` wires all of it.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as _np
+
+from .base import MXNetError
+from .kvstore import KVStore, _key_list, _val_list
+from .ndarray.ndarray import array
+
+__all__ = ["AsyncParamServer", "KVStoreDistAsync", "serve_forever"]
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    header = _recv_exact(sock, 8)
+    if header is None:
+        return None
+    (n,) = struct.unpack("<Q", header)
+    payload = _recv_exact(sock, n)
+    return None if payload is None else pickle.loads(payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class AsyncParamServer:
+    """Single-process parameter server applying per-push updates."""
+
+    def __init__(self, port, num_workers):
+        self.port = port
+        self.num_workers = num_workers
+        self._weights = {}      # key -> np.ndarray (fp32 master copy)
+        self._updater = None
+        self._push_count = 0
+        self._barrier_waiting = 0
+        self._barrier_generation = 0
+        self._barrier_cv = threading.Condition()
+        self._done = threading.Event()
+        self._ready = threading.Event()  # set once listening
+        self._lock = threading.Lock()  # serializes state mutation
+
+    # -- request handlers --------------------------------------------------
+
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "init":
+            _, key, value = msg
+            with self._lock:
+                # first writer wins (reference: server keeps the first
+                # initialization, others are no-ops)
+                self._weights.setdefault(key, _np.asarray(value,
+                                                          _np.float32))
+            return ("ok",)
+        if op == "push":
+            _, key, grad = msg
+            with self._lock:
+                if key not in self._weights:
+                    raise MXNetError("push before init for key %r" % key)
+                if self._updater is None:
+                    raise MXNetError("dist_async server has no optimizer; "
+                                     "call kv.set_optimizer first")
+                w = array(self._weights[key])
+                g = array(_np.asarray(grad, _np.float32))
+                self._updater(_updater_key(key), g, w)
+                self._weights[key] = w.asnumpy()
+                self._push_count += 1
+                return ("ok", self._push_count)
+        if op == "pull":
+            _, key = msg
+            with self._lock:
+                if key not in self._weights:
+                    raise MXNetError("pull before init for key %r" % key)
+                return ("ok", self._weights[key])
+        if op == "set_optimizer":
+            _, payload = msg
+            from . import optimizer as opt_mod
+            with self._lock:
+                if self._updater is None:
+                    optimizer = pickle.loads(payload)
+                    self._updater = opt_mod.get_updater(optimizer)
+            return ("ok",)
+        if op == "barrier":
+            with self._barrier_cv:
+                generation = self._barrier_generation
+                self._barrier_waiting += 1
+                if self._barrier_waiting == self.num_workers:
+                    self._barrier_waiting = 0
+                    self._barrier_generation += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    # shorter than the client's 300s socket timeout so a
+                    # TIMED-OUT barrier surfaces as a clear server error
+                    # on the worker, not a raw socket.timeout
+                    released = self._barrier_cv.wait_for(
+                        lambda: self._barrier_generation > generation,
+                        timeout=240.0)
+                    if not released:
+                        self._barrier_waiting = max(
+                            0, self._barrier_waiting - 1)
+                        raise MXNetError(
+                            "barrier timed out: %d/%d workers arrived "
+                            "(a worker crashed?)"
+                            % (self._barrier_waiting + 1,
+                               self.num_workers))
+            return ("ok",)
+        if op == "stats":
+            with self._lock:
+                return ("ok", {"push_count": self._push_count,
+                               "num_keys": len(self._weights)})
+        if op == "stop":
+            self._done.set()
+            return ("ok",)
+        raise MXNetError("unknown server op %r" % (op,))
+
+    # -- serving -----------------------------------------------------------
+
+    def serve(self):
+        """Accept loop; one thread per connection (updates still serialize
+        on the state lock — reference analog: per-key engine ordering)."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("", self.port))
+        srv.listen(self.num_workers * 2)
+        srv.settimeout(1.0)
+        self._ready.set()
+        threads = []
+        try:
+            while not self._done.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+        finally:
+            srv.close()
+        for t in threads:
+            t.join(timeout=5.0)
+
+    def _serve_conn(self, conn):
+        with conn:
+            while not self._done.is_set():
+                try:
+                    msg = _recv_msg(conn)
+                except OSError:
+                    return
+                if msg is None:
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # surfaces on the WORKER
+                    reply = ("error", "%s: %s" % (type(e).__name__, e))
+                try:
+                    _send_msg(conn, reply)
+                except OSError:
+                    return
+
+
+def _updater_key(key):
+    """int when possible — optimizer per-index state dicts key on ints."""
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        return key
+
+
+def serve_forever():
+    """Entry for a DMLC_ROLE=server process (kvstore_server.py hook).
+
+    The server is a host-side component: pin jax to CPU before the first
+    device use (the optimizer update math) so a wedged accelerator
+    tunnel can never hang the parameter server."""
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # jax already initialized by the host process: use as-is
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    AsyncParamServer(port, n).serve()
+
+
+class KVStoreDistAsync(KVStore):
+    """Worker client: per-push server updates, no worker barrier."""
+
+    def __init__(self):
+        super().__init__("dist_async")
+        self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._sock = None
+        self._sock_lock = threading.Lock()
+        role = os.environ.get("DMLC_ROLE", "worker")
+        if role in ("server", "scheduler"):
+            # reference server flow: `kv = mx.kv.create('dist_async');
+            # KVStoreServer(kv).run()` — the server process must NOT dial
+            # its own (not-yet-listening) port; this instance is just the
+            # handle run() reads the type from
+            return
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        if not uri:
+            raise MXNetError(
+                "kvstore dist_async needs a parameter server: launch via "
+                "`tools/launch.py -n <workers> --num-servers 1` (sets "
+                "DMLC_PS_ROOT_URI/PORT), or start "
+                "`python -m mxnet_tpu.kvstore_server` with DMLC_ROLE=server")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        self._sock = self._connect_with_retry(uri, port)
+
+    @staticmethod
+    def _connect_with_retry(uri, port, deadline_s=60.0):
+        """The server process may still be binding when workers start
+        (launch.py spawns both concurrently) — retry briefly."""
+        import time
+        end = time.time() + deadline_s
+        while True:
+            try:
+                return socket.create_connection((uri, port), timeout=300.0)
+            except OSError:
+                if time.time() > end:
+                    raise
+                time.sleep(0.2)
+
+    # identity from the DMLC env, NOT jax.process_*: async workers are
+    # independent processes, no jax.distributed mesh exists
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def _rpc(self, *msg):
+        if self._sock is None:
+            raise MXNetError(
+                "this dist_async kvstore is a server-role handle "
+                "(DMLC_ROLE=%s): pass it to KVStoreServer(kv).run() — "
+                "worker API calls belong on worker processes"
+                % os.environ.get("DMLC_ROLE"))
+        with self._sock_lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply is None:
+            raise MXNetError("dist_async server closed the connection")
+        if reply[0] == "error":
+            raise MXNetError("dist_async server: %s" % reply[1])
+        return reply
+
+    # -- KVStore API -------------------------------------------------------
+
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            self._rpc("init", str(k), vlist[0].asnumpy())
+
+    def push(self, key, value, priority=0):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if self._gc.active:
+                vlist = self._compress_vlist(str(k), vlist)
+            merged = self._merge(vlist)
+            self._rpc("push", str(k), merged.asnumpy())
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, _ = _key_list(key)
+        outs = _val_list(out, len(keys))
+        for k, olist in zip(keys, outs):
+            weights = self._rpc("pull", str(k))[1]
+            for o in olist:
+                o[:] = array(weights)
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._rpc("set_optimizer", pickle.dumps(optimizer))
+
+    def barrier(self):
+        self._rpc("barrier")
+
+    def server_stats(self):
+        """{push_count, num_keys} — observability + the async-semantics
+        test hook (push_count counts EVERY push, not rounds)."""
+        return self._rpc("stats")[1]
+
+    def stop_server(self):
+        self._rpc("stop")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise MXNetError("dist_async: optimizer state lives on the server "
+                         "(reference parity: dist kvstores cannot save "
+                         "states from a worker)")

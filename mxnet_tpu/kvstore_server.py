@@ -17,13 +17,21 @@ __all__ = ["KVStoreServer", "_init_kvstore_server_module"]
 
 
 class KVStoreServer(object):
-    """API-parity shim for the reference server controller."""
+    """Reference server controller. For the synchronous kvstore types the
+    server role is subsumed by XLA collectives and run() just logs; for
+    `dist_async` it runs the real parameter server (kvstore_async.py)."""
 
     def __init__(self, kvstore):
         self.kvstore = kvstore
         self.init_logging = False
 
     def run(self):
+        kv_type = getattr(self.kvstore, "type", "")
+        if "async" in (os.environ.get("MXNET_KVSTORE_TYPE", kv_type) or ""):
+            from .kvstore_async import serve_forever
+            logging.info("dist_async parameter server starting")
+            serve_forever()
+            return
         logging.info(
             "kvstore server role is subsumed by XLA collectives on TPU; "
             "nothing to serve — exiting (workers reduce over ICI/DCN)")
@@ -32,6 +40,12 @@ class KVStoreServer(object):
 def _init_kvstore_server_module():
     """reference: kvstore_server.py module hook reading DMLC_ROLE."""
     role = os.environ.get("DMLC_ROLE", "worker")
+    if role == "server" and "async" in os.environ.get(
+            "MXNET_KVSTORE_TYPE", ""):
+        from .kvstore_async import serve_forever
+        logging.info("dist_async parameter server starting (role=server)")
+        serve_forever()
+        raise SystemExit(0)
     if role in ("server", "scheduler"):
         logging.info("DMLC_ROLE=%s has no TPU analog (XLA collectives "
                      "replace the parameter server); exiting cleanly", role)
@@ -39,4 +53,11 @@ def _init_kvstore_server_module():
 
 
 if os.environ.get("MXNET_TPU_AUTO_SERVER_EXIT", "0") == "1":
+    _init_kvstore_server_module()
+
+
+if __name__ == "__main__":
+    # `python -m mxnet_tpu.kvstore_server` with DMLC_ROLE=server +
+    # MXNET_KVSTORE_TYPE=dist_async runs the parameter server directly
+    logging.basicConfig(level=logging.INFO)
     _init_kvstore_server_module()

@@ -1,0 +1,176 @@
+"""`dist_async` parameter server (kvstore_async.py; reference:
+src/kvstore/kvstore_dist_server.h:282-294 async branch — per-push
+optimizer updates, no worker barrier)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore_async import AsyncParamServer, KVStoreDistAsync
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def server_env(monkeypatch):
+    port = _free_port()
+    server = AsyncParamServer(port, num_workers=1)
+    t = threading.Thread(target=server.serve, daemon=True)
+    t.start()
+    assert server._ready.wait(timeout=30)
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    yield server
+    server._done.set()
+    t.join(timeout=10)
+
+
+def test_push_updates_immediately_without_other_workers(server_env):
+    """THE async semantic: a single worker's push is applied by the
+    server at once — no waiting for the other workers of the group
+    (reference ApplyUpdates async branch)."""
+    server_env.num_workers = 4  # pretend 3 more workers exist...
+    kv = mx.kv.create("dist_async")
+    assert kv.type == "dist_async"
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    w0 = np.ones((2, 3), np.float32)
+    kv.init("w", mx.nd.array(w0))
+    kv.push("w", mx.nd.ones((2, 3)))  # ...but push alone still updates
+    out = mx.nd.empty((2, 3))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), w0 - 0.5 * 1.0, rtol=1e-6)
+    assert kv.server_stats()["push_count"] == 1  # per push, not per round
+
+
+def test_every_push_counts_and_compounds(server_env):
+    kv = mx.kv.create("dist_async")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.init("w", mx.nd.zeros((4,)))
+    for _ in range(5):
+        kv.push("w", mx.nd.ones((4,)))
+    out = mx.nd.empty((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), -0.5 * np.ones(4), rtol=1e-5)
+    assert kv.server_stats()["push_count"] == 5
+
+
+def test_init_first_writer_wins(server_env):
+    kv = mx.kv.create("dist_async")
+    kv.init("w", mx.nd.ones((3,)))
+    kv.init("w", mx.nd.zeros((3,)))  # later init is a no-op (reference)
+    out = mx.nd.empty((3,))
+    kv.pull("w", out=out)
+    np.testing.assert_array_equal(out.asnumpy(), np.ones(3))
+
+
+def test_push_before_init_and_no_optimizer_error(server_env):
+    kv = mx.kv.create("dist_async")
+    with pytest.raises(mx.base.MXNetError, match="init"):
+        kv.push("nope", mx.nd.ones((2,)))
+    kv.init("w", mx.nd.ones((2,)))
+    with pytest.raises(mx.base.MXNetError, match="optimizer"):
+        kv.push("w", mx.nd.ones((2,)))
+
+
+WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, %(repo)r)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rank = int(os.environ["DMLC_WORKER_ID"])
+    rng = np.random.RandomState(rank)
+    X = rng.normal(0, 1, (96, 6)).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=2), name="softmax")
+    mod = mx.mod.Module(net, context=mx.tpu(0))
+    metric = mx.metric.Accuracy()
+    mod.fit(it, num_epoch=12, kvstore="dist_async", eval_metric=metric,
+            optimizer_params={"learning_rate": 0.2})
+    kv = mod._kvstore
+    assert kv.type == "dist_async", kv.type
+    stats = kv.server_stats()
+    with open(%(outdir)r + "/worker%%d.json" %% rank, "w") as f:
+        json.dump({"acc": metric.get()[1], "rank": rank,
+                   "push_count": stats["push_count"]}, f)
+    kv.barrier()
+""")
+
+
+@pytest.mark.skipif(os.environ.get("SKIP_DIST_TESTS") == "1",
+                    reason="dist tests disabled")
+def test_two_worker_async_training_via_launcher(tmp_path):
+    """launch.py --num-servers 1 spawns the PS + 2 independent workers;
+    both converge on the shared asynchronously-updated weights, and the
+    server applied every push individually."""
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER % {"repo": REPO, "outdir": str(tmp_path)})
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    port = _free_port()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--num-servers", "1", "--server-port", str(port),
+         "--launcher", "local", "--",
+         sys.executable, str(worker_py)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stderr[-3000:] or proc.stdout[-2000:])
+    results = [json.load(open(str(tmp_path / ("worker%d.json" % r))))
+               for r in (0, 1)]
+    for r in results:
+        assert r["acc"] > 0.8, results
+    # the server saw every individual push: 12 epochs x 6 batches x
+    # 2 workers x n_params pushes, far more than one worker alone makes
+    one_worker_pushes = 12 * 6 * 2  # epochs x batches x params
+    assert results[0]["push_count"] > one_worker_pushes, results
+
+
+def test_server_role_reference_flow(monkeypatch):
+    """The reference server pattern works: create('dist_async') on a
+    DMLC_ROLE=server process returns a non-dialing handle whose
+    KVStoreServer(kv).run() serves (pinned by driving one RPC)."""
+    from mxnet_tpu.kvstore_server import KVStoreServer
+    port = _free_port()
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    kv = mx.kv.create("dist_async")  # must not dial the unstarted port
+    with pytest.raises(mx.base.MXNetError, match="server-role"):
+        kv.push("w", mx.nd.ones((2,)))
+    controller = KVStoreServer(kv)
+    t = threading.Thread(target=controller.run, daemon=True)
+    t.start()
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    worker = mx.kv.create("dist_async")  # connects once serving
+    worker.init("w", mx.nd.ones((2,)))
+    out = mx.nd.empty((2,))
+    worker.pull("w", out=out)
+    np.testing.assert_array_equal(out.asnumpy(), np.ones(2))
+    worker.stop_server()
+    t.join(timeout=15)
+    assert not t.is_alive()

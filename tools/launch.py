@@ -17,10 +17,67 @@ import subprocess
 import sys
 
 
-def launch_local(n, command, coordinator="127.0.0.1:12345"):
+def launch_local(n, command, coordinator="127.0.0.1:12345", num_servers=0,
+                 server_port=9091):
+    server_procs = []
+    ps_env = {}
+    if num_servers:
+        # dist_async topology: ONE parameter server process
+        # (kvstore_async.py documents the single-server scope), workers
+        # get its address through the reference DMLC env protocol
+        ps_env = {"DMLC_PS_ROOT_URI": "127.0.0.1",
+                  "DMLC_PS_ROOT_PORT": str(server_port)}
+        env = dict(os.environ)
+        env.update(ps_env)
+        env.update({"DMLC_ROLE": "server", "DMLC_NUM_WORKER": str(n),
+                    "MXNET_KVSTORE_TYPE": "dist_async"})
+        # the parameter server is a HOST-side component: pin it to the
+        # CPU backend and keep accelerator plugins from registering so a
+        # wedged device tunnel can never take the server down with it
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        # the server module must import regardless of the caller's cwd
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        server = subprocess.Popen(
+            [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
+            env=env, shell=False)
+        server_procs.append(server)
+        # gate on server health BEFORE spawning workers: a dead server
+        # (EADDRINUSE from a stale run is the classic) must abort the
+        # launch loudly, not leave workers dialing a wrong/stale server
+        import socket as _socket
+        import time as _time
+        deadline = _time.time() + 30.0
+        while True:
+            if server.poll() is not None:
+                raise SystemExit(
+                    "dist_async parameter server exited rc=%d before "
+                    "accepting (stale server still on port %d?)"
+                    % (server.returncode, server_port))
+            try:
+                _socket.create_connection(("127.0.0.1", server_port),
+                                          timeout=1.0).close()
+                break
+            except OSError:
+                if _time.time() > deadline:
+                    server.terminate()
+                    raise SystemExit("dist_async parameter server did not "
+                                     "accept within 30s")
+                _time.sleep(0.2)
+        # the accepting socket could be a STALE server from a previous
+        # run while ours is still dying of EADDRINUSE — let the bind
+        # settle and re-check our process actually owns the port
+        _time.sleep(1.0)
+        if server.poll() is not None:
+            raise SystemExit(
+                "dist_async parameter server exited rc=%d right after "
+                "startup — another server is likely holding port %d"
+                % (server.returncode, server_port))
     procs = []
     for rank in range(n):
         env = dict(os.environ)
+        env.update(ps_env)
         env.update({
             "JAX_COORDINATOR_ADDRESS": coordinator,
             "JAX_NUM_PROCESSES": str(n),
@@ -34,6 +91,9 @@ def launch_local(n, command, coordinator="127.0.0.1:12345"):
     rc = 0
     for p in procs:
         rc |= p.wait()
+    for p in server_procs:  # workers done: the server has nothing to serve
+        p.terminate()
+        p.wait()
     return rc
 
 
@@ -65,6 +125,10 @@ def main():
                         default="local")
     parser.add_argument("-H", "--hostfile", type=str, default=None)
     parser.add_argument("--coordinator-port", type=int, default=12345)
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="parameter-server processes for dist_async "
+                             "(0 or 1; sync kvstores need none)")
+    parser.add_argument("--server-port", type=int, default=9091)
     parser.add_argument("--run-ssh", action="store_true",
                         help="actually exec over ssh instead of printing")
     parser.add_argument("command", nargs=argparse.REMAINDER)
@@ -74,7 +138,9 @@ def main():
         parser.error("no command given")
     if args.launcher == "local":
         sys.exit(launch_local(args.num_workers, command,
-                              "127.0.0.1:%d" % args.coordinator_port))
+                              "127.0.0.1:%d" % args.coordinator_port,
+                              num_servers=args.num_servers,
+                              server_port=args.server_port))
     if not args.hostfile:
         parser.error("ssh launcher needs --hostfile")
     sys.exit(launch_ssh(args.hostfile, command, args.coordinator_port,
