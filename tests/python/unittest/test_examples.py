@@ -161,3 +161,12 @@ def test_lstm_bucketing_example():
     ppls = [float(x) for x in
             re.findall(r"Train-perplexity=([0-9.]+)", out)]
     assert len(ppls) == 2 and ppls[-1] < ppls[0], out[-2000:]
+
+
+def test_quantization_example():
+    """Post-training int8 walkthrough: graph rewrite + calibration +
+    fp32-vs-int8 agreement (reference contrib/quantization.py driver)."""
+    out = _run([os.path.join(EX, "quantization", "quantize_model.py"),
+                "--num-layers", "18", "--side", "32", "--batch-size", "8",
+                "--n-iter", "2"], timeout=900)
+    assert "quantize_model example OK" in out, out[-2000:]
