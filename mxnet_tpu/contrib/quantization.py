@@ -211,21 +211,19 @@ def calib_threshold_kl(hist, hist_edges, num_quantized_bins=255):
         return float(hist_edges[-1])
     thresholds = []
     divergences = []
+    tail = _np.concatenate([hist[::-1].cumsum()[::-1][1:], [0.0]])
     for i in range(num_quantized_bins, num_bins + 1):
         p = hist[:i].copy()
-        p[i - 1] += hist[i:].sum()  # clip outliers into the edge bin
+        p[i - 1] += tail[i - 1]  # clip outliers into the edge bin
         p_norm = p / p.sum()
-        # quantize the first i bins into num_quantized_bins
+        # quantize the first i bins into num_quantized_bins, expand back
+        # (vectorized: the naive per-bin python loops make 8001-bin
+        # calibration of a deep net take hours)
         idx = (_np.arange(i) * num_quantized_bins // i)
-        q = _np.zeros(num_quantized_bins)
-        for j in range(i):
-            q[idx[j]] += hist[j]
-        # expand back
-        expanded = _np.zeros(i)
+        q = _np.bincount(idx, weights=hist[:i],
+                         minlength=num_quantized_bins)
         counts = _np.bincount(idx, minlength=num_quantized_bins)
-        for j in range(i):
-            if counts[idx[j]]:
-                expanded[j] = q[idx[j]] / counts[idx[j]]
+        expanded = (q / _np.maximum(counts, 1))[idx]
         nonzero = p > 0
         expanded_norm = expanded / max(expanded.sum(), 1e-12)
         kl = _np.sum(p_norm[nonzero] * _np.log(
