@@ -163,10 +163,12 @@ def test_lstm_bucketing_example():
     assert len(ppls) == 2 and ppls[-1] < ppls[0], out[-2000:]
 
 
-def test_quantization_example():
-    """Post-training int8 walkthrough: graph rewrite + calibration +
+@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+def test_quantization_example(calib_mode):
+    """Post-training int8 walkthrough: graph rewrite + calibration (both
+    modes — entropy exercises the vectorized KL threshold search) +
     fp32-vs-int8 agreement (reference contrib/quantization.py driver)."""
     out = _run([os.path.join(EX, "quantization", "quantize_model.py"),
                 "--num-layers", "18", "--side", "32", "--batch-size", "8",
-                "--n-iter", "2"], timeout=900)
+                "--n-iter", "2", "--calib-mode", calib_mode], timeout=900)
     assert "quantize_model example OK" in out, out[-2000:]
