@@ -174,3 +174,21 @@ def test_server_role_reference_flow(monkeypatch):
     worker.stop_server()
     t.join(timeout=15)
     assert not t.is_alive()
+
+
+def test_async_push_composes_with_compression(server_env):
+    """2-bit compression applies on the worker before the async push
+    (the reference's compressed dist push path — gradient values reach
+    the server quantized to +-threshold steps)."""
+    kv = mx.kv.create("dist_async")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.nd.zeros((64,)))
+    rng = np.random.RandomState(3)
+    kv.push("w", mx.nd.array(rng.normal(0, 1, (64,)).astype(np.float32)))
+    out = mx.nd.empty((64,))
+    kv.pull("w", out=out)
+    # w = 0 - 1.0 * quantized_grad: every weight is a multiple of 0.5
+    steps = out.asnumpy() / 0.5
+    assert np.allclose(steps, np.round(steps), atol=1e-5)
+    assert np.abs(out.asnumpy()).max() <= 0.5 + 1e-6
