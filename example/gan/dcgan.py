@@ -1,0 +1,119 @@
+"""DCGAN on synthetic images (reference: example/gan/dcgan.py — MNIST
+there; a smooth synthetic distribution here, this environment has no
+egress).
+
+Exercises the adversarial Gluon training loop end to end: transpose
+convolutions (generator), strided conv discriminator, BatchNorm in both,
+two Trainers stepping different parameter sets in one program, and the
+classic non-saturating GAN objective via SigmoidBCELoss on logits.
+
+    python example/gan/dcgan.py --epochs 3
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+SIDE = 16  # image side; G upsamples 4 -> 8 -> 16
+
+
+def build_generator(ngf=32, nz=32):
+    net = nn.HybridSequential(prefix="gen_")
+    with net.name_scope():
+        net.add(nn.Dense(ngf * 2 * 4 * 4, use_bias=False))
+        net.add(nn.HybridLambda(lambda F, x: x.reshape((-1, ngf * 2, 4, 4))))
+        net.add(nn.BatchNorm(), nn.Activation("relu"))
+        net.add(nn.Conv2DTranspose(ngf, 4, strides=2, padding=1,
+                                   use_bias=False))   # 4 -> 8
+        net.add(nn.BatchNorm(), nn.Activation("relu"))
+        net.add(nn.Conv2DTranspose(1, 4, strides=2, padding=1,
+                                   use_bias=False))   # 8 -> 16
+        net.add(nn.Activation("tanh"))
+    return net
+
+
+def build_discriminator(ndf=32):
+    net = nn.HybridSequential(prefix="disc_")
+    with net.name_scope():
+        net.add(nn.Conv2D(ndf, 4, strides=2, padding=1, use_bias=False))
+        net.add(nn.LeakyReLU(0.2))                    # 16 -> 8
+        net.add(nn.Conv2D(ndf * 2, 4, strides=2, padding=1,
+                          use_bias=False))            # 8 -> 4
+        net.add(nn.BatchNorm(), nn.LeakyReLU(0.2))
+        net.add(nn.Dense(1))                          # real/fake logit
+    return net
+
+
+def real_batch(rng, batch):
+    """Smooth 2-D waves — a learnable, low-entropy image distribution."""
+    yy, xx = np.mgrid[0:SIDE, 0:SIDE].astype(np.float32) / SIDE
+    phase = rng.uniform(0, 2 * np.pi, (batch, 1, 1)).astype(np.float32)
+    freq = rng.choice([1.0, 2.0], (batch, 1, 1)).astype(np.float32)
+    img = np.sin(2 * np.pi * freq * (xx + yy)[None] + phase)
+    return img[:, None].astype(np.float32)  # NCHW in [-1, 1]
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--batches-per-epoch", type=int, default=20)
+    ap.add_argument("--nz", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=2e-4)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+
+    gen, disc = build_generator(nz=args.nz), build_discriminator()
+    for net in (gen, disc):
+        net.initialize(mx.init.Normal(0.02))
+        net.hybridize()
+    trainer_g = gluon.Trainer(gen.collect_params(), "adam",
+                              {"learning_rate": args.lr, "beta1": 0.5})
+    trainer_d = gluon.Trainer(disc.collect_params(), "adam",
+                              {"learning_rate": args.lr, "beta1": 0.5})
+    bce = gluon.loss.SigmoidBCELoss(from_sigmoid=False)
+    ones = mx.nd.ones((args.batch_size,))
+    zeros = mx.nd.zeros((args.batch_size,))
+
+    for epoch in range(args.epochs):
+        d_losses, g_losses = [], []
+        for _ in range(args.batches_per_epoch):
+            real = mx.nd.array(real_batch(rng, args.batch_size))
+            noise = mx.nd.array(rng.normal(
+                0, 1, (args.batch_size, args.nz)).astype(np.float32))
+            # D step: real -> 1, G(z) -> 0
+            with autograd.record():
+                loss_d = (bce(disc(real), ones)
+                          + bce(disc(gen(noise).detach()), zeros))
+            loss_d.backward()
+            trainer_d.step(args.batch_size)
+            # G step: non-saturating, D(G(z)) -> 1
+            with autograd.record():
+                loss_g = bce(disc(gen(noise)), ones)
+            loss_g.backward()
+            trainer_g.step(args.batch_size)
+            d_losses.append(float(loss_d.mean().asscalar()))
+            g_losses.append(float(loss_g.mean().asscalar()))
+        logging.info("epoch %d: loss_d %.3f loss_g %.3f", epoch,
+                     np.mean(d_losses), np.mean(g_losses))
+
+    fake = gen(mx.nd.array(rng.normal(
+        0, 1, (8, args.nz)).astype(np.float32))).asnumpy()
+    assert fake.shape == (8, 1, SIDE, SIDE) and np.isfinite(fake).all()
+    assert np.abs(fake).max() <= 1.0 + 1e-5  # tanh range
+    # adversarial health: D hasn't trivially won (G gradients alive)
+    assert np.mean(g_losses) < 15.0, np.mean(g_losses)
+    # very short runs barely move off init; the bar only catches a true
+    # constant-output collapse at the default/test run lengths
+    assert fake.std() > 0.01, "generator collapsed to a constant"
+    print("dcgan example OK")
+
+
+if __name__ == "__main__":
+    main()

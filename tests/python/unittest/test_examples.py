@@ -172,3 +172,12 @@ def test_quantization_example(calib_mode):
                 "--num-layers", "18", "--side", "32", "--batch-size", "8",
                 "--n-iter", "2", "--calib-mode", calib_mode], timeout=900)
     assert "quantize_model example OK" in out, out[-2000:]
+
+
+def test_dcgan_example():
+    """Adversarial Gluon loop (reference example/gan): transpose-conv
+    generator + conv discriminator, two Trainers, BCE-on-logits."""
+    out = _run([os.path.join(EX, "gan", "dcgan.py"),
+                "--epochs", "2", "--batches-per-epoch", "12"],
+               timeout=900)
+    assert "dcgan example OK" in out, out[-2000:]
