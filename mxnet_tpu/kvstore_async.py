@@ -27,6 +27,10 @@ Env protocol (reference kvstore.h:254 InitPSEnv):
   DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT — server address
   DMLC_ROLE                            — worker | server | scheduler
   DMLC_NUM_WORKER / DMLC_WORKER_ID     — worker identity
+  DMLC_PS_BIND_ADDR                    — server listen interface
+                                         (default 127.0.0.1; set "" on the
+                                         server host for all-interfaces in
+                                         a real multi-host cluster)
 `tools/launch.py --num-servers 1` wires all of it.
 """
 from __future__ import annotations
@@ -81,6 +85,10 @@ class AsyncParamServer:
         self._push_count = 0
         self._barrier_waiting = 0
         self._barrier_generation = 0
+        # arrivals in the CURRENT generation; unlike _barrier_waiting it
+        # never decrements on timeout, so concurrent timed-out waiters
+        # all report the true arrived count
+        self._barrier_arrived = 0
         self._barrier_cv = threading.Condition()
         self._done = threading.Event()
         self._ready = threading.Event()  # set once listening
@@ -130,8 +138,10 @@ class AsyncParamServer:
             with self._barrier_cv:
                 generation = self._barrier_generation
                 self._barrier_waiting += 1
+                self._barrier_arrived += 1
                 if self._barrier_waiting == self.num_workers:
                     self._barrier_waiting = 0
+                    self._barrier_arrived = 0
                     self._barrier_generation += 1
                     self._barrier_cv.notify_all()
                 else:
@@ -142,13 +152,17 @@ class AsyncParamServer:
                         lambda: self._barrier_generation > generation,
                         timeout=240.0)
                     if not released:
-                        self._barrier_waiting = max(
-                            0, self._barrier_waiting - 1)
+                        # report the per-generation arrival count, which
+                        # earlier timed-out waiters have NOT decremented
+                        # (decrementing _barrier_waiting below is just
+                        # bookkeeping so a later generation can't be
+                        # released by phantom waiters)
+                        arrived = self._barrier_arrived
+                        self._barrier_waiting -= 1
                         raise MXNetError(
                             "barrier timed out: %d/%d workers arrived "
                             "(a worker crashed?)"
-                            % (self._barrier_waiting + 1,
-                               self.num_workers))
+                            % (arrived, self.num_workers))
             return ("ok",)
         if op == "stats":
             with self._lock:
@@ -166,7 +180,12 @@ class AsyncParamServer:
         on the state lock — reference analog: per-key engine ordering)."""
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind(("", self.port))
+        # The transport is unauthenticated pickle (code execution), so
+        # never listen on all interfaces by default: bind the loopback
+        # unless the launcher says otherwise (DMLC_PS_BIND_ADDR, or "" to
+        # opt back into all-interfaces for real multi-host clusters).
+        srv.bind((os.environ.get("DMLC_PS_BIND_ADDR", "127.0.0.1"),
+                  self.port))
         srv.listen(self.num_workers * 2)
         srv.settimeout(1.0)
         self._ready.set()
@@ -264,9 +283,15 @@ class KVStoreDistAsync(KVStore):
         while True:
             try:
                 return socket.create_connection((uri, port), timeout=300.0)
-            except OSError:
+            except OSError as e:
                 if time.time() > end:
-                    raise
+                    raise MXNetError(
+                        "could not reach dist_async server at %s:%d within "
+                        "%.0fs (%s). If the server runs on another host, "
+                        "it binds 127.0.0.1 by default — set "
+                        "DMLC_PS_BIND_ADDR on the server (empty string = "
+                        "all interfaces; trusted networks only)"
+                        % (uri, port, deadline_s, e)) from e
                 time.sleep(0.2)
 
     # identity from the DMLC env, NOT jax.process_*: async workers are

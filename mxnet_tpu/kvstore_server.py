@@ -26,8 +26,13 @@ class KVStoreServer(object):
         self.init_logging = False
 
     def run(self):
+        # the handle knows its own type; the env var is only a fallback
+        # (a stale MXNET_KVSTORE_TYPE=dist_sync left in the environment
+        # must not make a dist_async server silently log-and-exit while
+        # workers hang in their connect-retry loop)
         kv_type = getattr(self.kvstore, "type", "")
-        if "async" in (os.environ.get("MXNET_KVSTORE_TYPE", kv_type) or ""):
+        if "async" in (kv_type
+                       or os.environ.get("MXNET_KVSTORE_TYPE", "") or ""):
             from .kvstore_async import serve_forever
             logging.info("dist_async parameter server starting")
             serve_forever()

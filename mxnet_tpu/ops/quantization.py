@@ -128,14 +128,17 @@ def _int8_compute_dtypes(lhs, rhs, reduce_len):
     * CPU: XLA:CPU has no vectorized integer conv (measured ~50x slower
       than f32) — compute in f32 over exactly-representable integer
       values and round the accumulator back to int32. Products |a*b| <=
-      127*127 are exact in f32; the simulation is only used while the
-      WORST-CASE accumulated magnitude (`reduce_len` terms of 127*127)
-      stays inside f32's 2^24 integer-exact window, so a huge reduction
+      128*128 are exact in f32; the simulation is only used while the
+      WORST-CASE accumulated magnitude (`reduce_len` terms of 128*128,
+      the -128 corner included) stays inside f32's 2^24 integer-exact
+      window, so a huge reduction
       (e.g. 512-channel 3x3 conv at saturation) falls back to the exact
       wide-int path instead of silently rounding.
     Mixed operand dtypes (e.g. uint8 data from a direct caller) always
     take the wide path, which XLA requires to be same-dtype."""
-    f32_exact = reduce_len * 127 * 127 < 2 ** 24
+    # worst case per product is (-128)*(-128) = 16384, not 127*127:
+    # int8 is asymmetric, so size the exactness window for -128 operands
+    f32_exact = reduce_len * 128 * 128 < 2 ** 24
     if lhs.dtype == rhs.dtype and jax.default_backend() == "cpu" \
             and f32_exact:
         return (lhs.astype(jnp.float32), rhs.astype(jnp.float32),

@@ -82,6 +82,14 @@ def test_predict_and_score():
     np.testing.assert_allclose(preds.asnumpy().sum(1), 1.0, rtol=1e-4)
     res = dict(mod.score(it, mx.metric.Accuracy()))
     assert 0.0 <= res["accuracy"] <= 1.0
+    # BatchEndParam.locals carries the reference-era variable names:
+    # legacy callbacks index locals["eval_batch"] / ["actual_num_batch"]
+    seen_locals = []
+    mod.score(it, mx.metric.Accuracy(),
+              batch_end_callback=lambda p: seen_locals.append(p.locals),
+              score_end_callback=lambda p: seen_locals.append(p.locals))
+    assert all("eval_batch" in loc for loc in seen_locals[:-1])
+    assert "actual_num_batch" in seen_locals[-1]
 
 
 def test_forward_smaller_last_batch():

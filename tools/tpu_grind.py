@@ -26,7 +26,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 from bench import PHASES as _BENCH_PHASES, _child_env, _load_bank  # noqa: E402
 
-PHASES = [p for p in _BENCH_PHASES if p != "probe"]
+# Decisive phases first: chip windows are rare and short, so the first
+# minutes must bank the headline (infer), the honest-ratio pair
+# (train_bf16 + jax_baseline, which must share a window anyway), flash,
+# and int8 before anything else gets a budget.
+_PRIORITY = ["infer", "train_bf16", "jax_baseline", "flash", "infer_int8"]
+PHASES = _PRIORITY + [p for p in _BENCH_PHASES
+                      if p != "probe" and p not in _PRIORITY]
+assert set(PHASES) == {p for p in _BENCH_PHASES if p != "probe"}
 
 
 def _run(phase, timeout_s):
