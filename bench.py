@@ -37,6 +37,7 @@ PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy
     "infer": 900, "train_fp32": 800, "train_bf16": 600,
     "jax_baseline": 700, "flash": 700, "io_train": 600,
     "infer_int8": 600, "train_big_batch": 900, "flash_parity": 500,
+    "cost": 600,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -292,7 +293,8 @@ def main():
 
     # 2) measurement phases, each in its own budgeted child
     phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
-              "io_train", "infer_int8", "train_big_batch", "flash_parity"]
+              "io_train", "infer_int8", "train_big_batch", "flash_parity",
+              "cost"]
     # phases that measure nothing useful on the CPU fallback (outage
     # removals — unlike explicit_skips, the bank may still supply them)
     cpu_useless = {"train_bf16", "train_big_batch", "flash_parity"}
@@ -389,7 +391,7 @@ def main():
         extra.update(_host_stamp())
     for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
                   "io_train", "infer_int8", "train_big_batch",
-                  "flash_parity"):
+                  "flash_parity", "cost"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
                       if not k.startswith("_")})
     # mixed-platform runs (partial rescue): say which metric ran where
@@ -721,7 +723,93 @@ def _phase_infer_int8():
     exe = qsym.bind(mx.tpu(0), bind_args, grad_req="null",
                     aux_states=qaux)
     return {"int8_infer_img_per_sec": _timed_score_loop(
-        exe, batch, side, n_iter)}
+        exe, batch, side, n_iter),
+            # off-chip the quantized ops run the exactness-guarded f32
+            # SIMULATION (ops/quantization.py) — slower than fp32 by
+            # design; only "native-int8" figures speak to MXU int8 perf
+            "int8_mode": ("native-int8" if on_tpu else "simulated-f32")}
+
+
+def _phase_cost():
+    """Hardware-independent analytic cost invariants (VERDICT r4 #9).
+
+    Lowers the fused ResNet-50 train step (fp32 and bf16-compute) and the
+    inference graph to HLO and records XLA's analytic FLOPs / bytes
+    (`jit(...).lower(...).cost_analysis()`), plus the closed-form flash-
+    attention FLOP count at the production benchmark shape. These give
+    every round a chip-independent fingerprint: a graph-level regression
+    (extra transposes, a lost fusion, an accidental fp32 upcast) moves
+    `step_gflops`/`step_bytes` with no hardware needed, and each figure
+    converts to MFU the moment a wall-clock measurement lands."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.parallel.mesh import data_parallel_mesh
+    from mxnet_tpu.parallel.tpu_step import DataParallelTrainStep
+
+    batch = 32
+    out = {}
+
+    def _analyze(lowered):
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax: one dict per comp
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        nbytes = float(ca.get("bytes accessed", 0.0))
+        return round(flops / 1e9, 2), round(nbytes / 1e6, 2)
+
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape="3,224,224")
+    for tag, dt_ in (("", None), ("_bf16", "bfloat16")):
+        mesh = data_parallel_mesh(jax.devices()[:1])
+        step = DataParallelTrainStep(sym, mesh, lr=0.05, momentum=0.9,
+                                     data_names=("data",),
+                                     label_names=("softmax_label",),
+                                     compute_dtype=dt_)
+        step.init({"data": (batch, 3, 224, 224), "softmax_label": (batch,)})
+        abstract = {  # lower from shapes only: no batch materialization
+            "data": jax.ShapeDtypeStruct((batch, 3, 224, 224), jnp.float32),
+            "softmax_label": jax.ShapeDtypeStruct((batch,), jnp.float32)}
+        lowered = step._step.lower(step.params, step.opt_state, step.aux,
+                                   abstract, jax.random.PRNGKey(0),
+                                   np.float32(0.05))
+        gflops, mbytes = _analyze(lowered)
+        out["step%s_gflops" % tag] = gflops
+        out["step%s_bytes_mb" % tag] = mbytes
+
+    # inference graph (the headline phase's program, batch 32 fp32)
+    import mxnet_tpu as mx
+    from mxnet_tpu.executor import Executor
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(batch, 3, 224, 224), softmax_label=(batch,))
+    args = {n: mx.nd.zeros(s)
+            for n, s in zip(sym.list_arguments(), arg_shapes)}
+    aux = {n: mx.nd.zeros(s)
+           for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    exe = Executor(sym, mx.cpu(), args, {}, "null", aux)
+    arg_sds = {n: jax.ShapeDtypeStruct(s, jnp.float32)
+               for n, s in zip(sym.list_arguments(), arg_shapes)}
+    aux_sds = {n: jax.ShapeDtypeStruct(s, jnp.float32)
+               for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+
+    def fwd(a, x):
+        outs, _ = exe._run_graph(a, x, jax.random.PRNGKey(0), False)
+        return outs[0]
+
+    gflops, mbytes = _analyze(jax.jit(fwd).lower(arg_sds, aux_sds))
+    out["infer_gflops"] = gflops
+    out["infer_bytes_mb"] = mbytes
+
+    # flash attention, closed form at the production benchmark shape
+    # (B=4 H=8 S=4096 D=128 causal): FLOPs are kernel-family-independent;
+    # ideal HBM traffic is Q+K+V+O in bf16
+    sys.path.insert(0, _HERE)
+    from tools.attn_timing import causal_flops
+    B, H, S, D = 4, 8, 4096, 128
+    out["flash_fwd_gflops"] = round(causal_flops(B, H, S, D) / 1e9, 2)
+    out["flash_ideal_bytes_mb"] = round(4 * B * H * S * D * 2 / 1e6, 2)
+    return out
 
 
 def _phase_io_train():
@@ -807,6 +895,7 @@ PHASES = {
     "infer_int8": _phase_infer_int8,
     "train_big_batch": _phase_train_big_batch,
     "flash_parity": _phase_flash_parity,
+    "cost": _phase_cost,
 }
 
 

@@ -10,8 +10,12 @@ across processes by `psum` over the JAX process group (ICI/DCN) — and the
 optimizer runs inside the same compiled step ("update_on_kvstore" semantics,
 reference: kvstore_dist_server.h:282 ApplyUpdates).
 
-`dist_sync`/`dist_async` map onto tpu_sync (sync); async has no ICI analog and
-degrades to sync — documented divergence (SURVEY.md §5.8).
+`dist_sync` maps onto tpu_sync (XLA collectives are synchronous by
+construction). `dist_async` is the one reference mode collectives cannot
+express, so it gets a real asynchronous parameter server
+(`kvstore_async.KVStoreDistAsync`, dispatched by `create()` below):
+per-push server-side optimizer updates, no worker barrier — reference
+kvstore_dist_server.h:282-294 semantics.
 """
 from __future__ import annotations
 

@@ -85,10 +85,12 @@ class AsyncParamServer:
         self._push_count = 0
         self._barrier_waiting = 0
         self._barrier_generation = 0
-        # arrivals in the CURRENT generation; unlike _barrier_waiting it
-        # never decrements on timeout, so concurrent timed-out waiters
-        # all report the true arrived count
-        self._barrier_arrived = 0
+        # worker ranks seen in the CURRENT generation (reset lazily when
+        # a new generation's first waiter arrives): a set dedupes retries
+        # and lets the timeout error name the missing workers, and unlike
+        # _barrier_waiting it doesn't shrink when timed-out waiters leave
+        self._barrier_ranks = set()
+        self._barrier_ranks_gen = 0
         self._barrier_cv = threading.Condition()
         self._done = threading.Event()
         self._ready = threading.Event()  # set once listening
@@ -135,13 +137,17 @@ class AsyncParamServer:
                     self._updater = opt_mod.get_updater(optimizer)
             return ("ok",)
         if op == "barrier":
+            rank = msg[1] if len(msg) > 1 else None
             with self._barrier_cv:
                 generation = self._barrier_generation
+                if self._barrier_ranks_gen != generation:
+                    self._barrier_ranks_gen = generation
+                    self._barrier_ranks = set()
+                if rank is not None:
+                    self._barrier_ranks.add(rank)
                 self._barrier_waiting += 1
-                self._barrier_arrived += 1
                 if self._barrier_waiting == self.num_workers:
                     self._barrier_waiting = 0
-                    self._barrier_arrived = 0
                     self._barrier_generation += 1
                     self._barrier_cv.notify_all()
                 else:
@@ -152,17 +158,19 @@ class AsyncParamServer:
                         lambda: self._barrier_generation > generation,
                         timeout=240.0)
                     if not released:
-                        # report the per-generation arrival count, which
-                        # earlier timed-out waiters have NOT decremented
-                        # (decrementing _barrier_waiting below is just
-                        # bookkeeping so a later generation can't be
-                        # released by phantom waiters)
-                        arrived = self._barrier_arrived
+                        # decrementing _barrier_waiting is bookkeeping so
+                        # a later generation can't be released by phantom
+                        # waiters; the error reports the per-generation
+                        # RANK SET, which retries and concurrent timeouts
+                        # cannot inflate or shrink
                         self._barrier_waiting -= 1
+                        seen = sorted(self._barrier_ranks)
+                        missing = sorted(set(range(self.num_workers))
+                                         - self._barrier_ranks)
                         raise MXNetError(
-                            "barrier timed out: %d/%d workers arrived "
-                            "(a worker crashed?)"
-                            % (arrived, self.num_workers))
+                            "barrier timed out: workers seen %s, missing "
+                            "%s of %d (a worker crashed?)"
+                            % (seen, missing, self.num_workers))
             return ("ok",)
         if op == "stats":
             with self._lock:
@@ -350,7 +358,7 @@ class KVStoreDistAsync(KVStore):
         self._rpc("set_optimizer", pickle.dumps(optimizer))
 
     def barrier(self):
-        self._rpc("barrier")
+        self._rpc("barrier", self._rank)
 
     def server_stats(self):
         """{push_count, num_keys} — observability + the async-semantics

@@ -29,11 +29,14 @@ from bench import PHASES as _BENCH_PHASES, _child_env, _load_bank  # noqa: E402
 # Decisive phases first: chip windows are rare and short, so the first
 # minutes must bank the headline (infer), the honest-ratio pair
 # (train_bf16 + jax_baseline, which must share a window anyway), flash,
-# and int8 before anything else gets a budget.
+# and int8 before anything else gets a budget. "cost" is hardware-
+# independent (analytic HLO cost accounting) — never spend a window on
+# it; the bench always runs it live.
+_SKIP = {"probe", "cost"}
 _PRIORITY = ["infer", "train_bf16", "jax_baseline", "flash", "infer_int8"]
 PHASES = _PRIORITY + [p for p in _BENCH_PHASES
-                      if p != "probe" and p not in _PRIORITY]
-assert set(PHASES) == {p for p in _BENCH_PHASES if p != "probe"}
+                      if p not in _SKIP and p not in _PRIORITY]
+assert set(PHASES) == {p for p in _BENCH_PHASES if p not in _SKIP}
 
 
 def _run(phase, timeout_s):
