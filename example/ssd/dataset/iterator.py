@@ -58,24 +58,55 @@ class SyntheticDetIter(DataIter):
 
 class DetRecordIter(DataIter):
     """ImageDetRecordIter wrapper (reference dataset/iterator.py:23); falls back
-    to SyntheticDetIter when the .rec file does not exist."""
+    to SyntheticDetIter when the .rec file does not exist.
 
-    def __init__(self, path_imgrec, batch_size, data_shape, label_pad_width=350,
+    The native iterator emits fixed `[c, rows, cols, n, header_width,
+    object_width, extras..., objects..., pad]` rows; this wrapper slices
+    and reshapes them to the `(batch, max_objects, object_width)` tensor
+    the SSD training graph consumes (same massage as the reference
+    example's DetRecordIter wrapper around its C++ iterator)."""
+
+    def __init__(self, path_imgrec, batch_size, data_shape, label_pad_width=-1,
                  **kwargs):
         super().__init__(batch_size)
+        self._reshape = None
         if path_imgrec and os.path.exists(path_imgrec):
             self.rec = mx.io.ImageDetRecordIter(
                 path_imgrec=path_imgrec, batch_size=batch_size,
                 data_shape=data_shape, label_pad_width=label_pad_width, **kwargs)
+            # resolve the object layout from the first batch's header
+            first = self.rec.next().label[0].asnumpy()
+            header_width = int(first[0, 4])
+            object_width = int(first[0, 5])
+            assert object_width >= 5, "object width must be >= 5"
+            start = 4 + header_width
+            max_objects = (first.shape[1] - start) // object_width
+            end = start + max_objects * object_width
+            self._reshape = (start, end, max_objects, object_width)
+            self.rec.reset()
+            # resolved pad width (sans the [c,rows,cols,n] prefix): pass
+            # this to the val iterator so train and eval share ONE static
+            # label shape (the reference forces alignment the same way)
+            self.label_pad_width = self.rec.label_width - 4
+            self.provide_label = [DataDesc(
+                "label", (batch_size, max_objects, object_width))]
         else:
             synth_kw = {k: v for k, v in kwargs.items()
                         if k in ("num_classes", "max_objects", "num_batches", "seed")}
             self.rec = SyntheticDetIter(batch_size, data_shape=data_shape, **synth_kw)
+            self.provide_label = self.rec.provide_label
         self.provide_data = self.rec.provide_data
-        self.provide_label = self.rec.provide_label
 
     def reset(self):
         self.rec.reset()
 
     def next(self):
-        return self.rec.next()
+        batch = self.rec.next()
+        if self._reshape is None:
+            return batch
+        start, end, max_objects, object_width = self._reshape
+        lab = batch.label[0].asnumpy()[:, start:end]
+        lab = lab.reshape(self.batch_size, max_objects, object_width)
+        return DataBatch(data=batch.data, label=[mx.nd.array(lab)],
+                         pad=batch.pad, provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -1,0 +1,69 @@
+"""Generate a synthetic VOC-style detection RecordIO dataset.
+
+Paints class-colored rectangles on flat backgrounds (the same learnable
+task as SyntheticDetIter, but materialized as JPEGs + a detection .lst)
+and packs them with ``tools/im2rec.py --pack-label`` — producing a real
+`.rec`/`.idx` pair for the native `mx.io.ImageDetRecordIter` path, so the
+full record-file SSD pipeline runs in a zero-egress environment.
+
+List format (one row per image, the im2rec detection convention):
+    idx  header_width  object_width  [cls x0 y0 x1 y1]...  relpath
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_TOOLS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      os.pardir, os.pardir, os.pardir, "tools", "im2rec.py")
+
+
+def generate(prefix, n_images=64, num_classes=20, max_objects=4,
+             image_size=160, seed=0):
+    root = prefix + "_imgs"
+    os.makedirs(root, exist_ok=True)
+    import cv2
+    rng = np.random.RandomState(seed)
+    rows = []
+    for i in range(n_images):
+        h = image_size + int(rng.randint(-8, 9))  # non-uniform source sizes
+        w = image_size
+        img = np.full((h, w, 3), 30, np.uint8)
+        toks = [str(i), "2", "5"]
+        for _ in range(rng.randint(1, max_objects + 1)):
+            cls = int(rng.randint(0, num_classes))
+            bw, bh = rng.uniform(0.2, 0.5, 2)
+            x0 = rng.uniform(0, 1 - bw)
+            y0 = rng.uniform(0, 1 - bh)
+            x1, y1 = x0 + bw, y0 + bh
+            shade = int(40 + 210 * (cls + 1) / num_classes)
+            color = [0, 0, 0]
+            color[cls % 3] = shade
+            cv2.rectangle(img, (int(x0 * w), int(y0 * h)),
+                          (int(x1 * w), int(y1 * h)), color, -1)
+            toks += [str(cls)] + ["%.4f" % v for v in (x0, y0, x1, y1)]
+        rel = "%d.jpg" % i
+        cv2.imwrite(os.path.join(root, rel), img)
+        toks.append(rel)
+        rows.append("\t".join(toks))
+    with open(prefix + ".lst", "w") as f:
+        f.write("\n".join(rows) + "\n")
+    subprocess.run([sys.executable, _TOOLS, prefix, root, "--pack-label"],
+                   check=True)
+    return prefix + ".rec"
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (writes prefix.rec/.idx)")
+    ap.add_argument("--n-images", type=int, default=64)
+    ap.add_argument("--num-classes", type=int, default=20)
+    ap.add_argument("--max-objects", type=int, default=4)
+    ap.add_argument("--image-size", type=int, default=160)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    path = generate(args.prefix, args.n_images, args.num_classes,
+                    args.max_objects, args.image_size, args.seed)
+    print("wrote %s" % path)

@@ -13,6 +13,24 @@ from train.metric import MultiBoxMetric      # noqa: E402
 from evaluate.eval_metric import VOC07MApMetric  # noqa: E402
 
 
+def _scan_label_width(path):
+    """Max IRHeader.flag across `path`'s records (-1 when no record file:
+    the synthetic fallback has no packed labels to scan)."""
+    if not path or not os.path.exists(path):
+        return -1
+    from mxnet_tpu import recordio
+    rec = recordio.MXRecordIO(path, "r")
+    width = -1
+    while True:
+        raw = rec.read()
+        if raw is None:
+            break
+        header, _ = recordio.unpack(raw)
+        width = max(width, int(header.flag))
+    rec.close()
+    return width
+
+
 def train_net(train_path, val_path, num_classes, batch_size, data_shape,
               ctx=None, num_epochs=1, lr=0.004, momentum=0.9, wd=0.0005,
               lr_steps=(80, 160), lr_factor=0.1, frequent=20,
@@ -22,10 +40,17 @@ def train_net(train_path, val_path, num_classes, batch_size, data_shape,
     if isinstance(data_shape, int):
         data_shape = (3, data_shape, data_shape)
 
+    # train and val must share ONE static label shape (the Module binds to
+    # the train shape): scan both record files up front and pad to the max
+    # width (each native iterator header-scans its own file otherwise)
+    shared_pad = max((_scan_label_width(p) for p in (train_path, val_path)),
+                     default=-1)
     train_iter = DetRecordIter(train_path, batch_size, data_shape,
+                               label_pad_width=shared_pad,
                                num_classes=num_classes,
                                num_batches=num_batches)
     val_iter = DetRecordIter(val_path, batch_size, data_shape,
+                             label_pad_width=shared_pad,
                              num_classes=num_classes,
                              num_batches=max(2, num_batches // 4)) \
         if val_path is not None else None

@@ -63,6 +63,16 @@ def _load():
         lib.MXTIOCreateImageRecordIterEx2.restype = ctypes.c_void_p
         lib.MXTIOCreateImageRecordIterEx2.argtypes = (
             lib.MXTIOCreateImageRecordIterEx.argtypes + [ctypes.c_int])
+        lib.MXTIOCreateImageDetRecordIter.restype = ctypes.c_void_p
+        lib.MXTIOCreateImageDetRecordIter.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.c_float, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+        lib.MXTIODetLabelWidth.restype = ctypes.c_int
+        lib.MXTIODetLabelWidth.argtypes = [ctypes.c_void_p]
         lib.MXTIONext.restype = ctypes.c_int
         lib.MXTIONext.argtypes = [ctypes.c_void_p,
                                   ctypes.POINTER(ctypes.c_float),

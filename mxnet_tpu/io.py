@@ -19,7 +19,7 @@ from .ndarray import sparse as _sparse
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
-           "ImageRecordIter", "io_registry"]
+           "ImageRecordIter", "ImageDetRecordIter", "io_registry"]
 
 io_registry = Registry("data iterator")
 
@@ -511,4 +511,11 @@ class MNISTIter(DataIter):
 def ImageRecordIter(**kwargs):
     """RecordIO image pipeline — implemented in the native io package (phase 6)."""
     from .recordio_iter import ImageRecordIter as _Impl
+    return _Impl(**kwargs)
+
+
+def ImageDetRecordIter(**kwargs):
+    """Detection RecordIO pipeline (variable-width box labels, box-aware
+    augmentation) — native C++ (reference iter_image_det_recordio.cc:582)."""
+    from .recordio_iter import ImageDetRecordIter as _Impl
     return _Impl(**kwargs)

@@ -22,7 +22,7 @@ from .base import MXNetError
 from .io import DataIter, DataBatch, DataDesc
 from .ndarray.ndarray import array as nd_array
 
-__all__ = ["ImageRecordIter", "normalize_prelude"]
+__all__ = ["ImageRecordIter", "ImageDetRecordIter", "normalize_prelude"]
 
 
 def normalize_prelude(it, network):
@@ -153,6 +153,135 @@ class ImageRecordIter(DataIter):
     def normalize_prelude(self, network):
         """Compose `network` over a cast + per-channel-normalize prelude on
         the data input — THE consumer-side contract of dtype='uint8'."""
+        return normalize_prelude(self, network)
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.MXTIOFree(handle)
+            self._handle = None
+
+
+class ImageDetRecordIter(DataIter):
+    """Native detection RecordIO pipeline (reference ImageDetRecordIter,
+    src/io/iter_image_det_recordio.cc:582 + image_det_aug_default.cc).
+
+    Records carry variable-width labels (IRHeader.flag floats:
+    ``[header_width, object_width, extras..., per-object (id, xmin, ymin,
+    xmax, ymax, ...)...]`` with coords normalized to [0,1] — the
+    ``im2rec.py --pack-label`` convention). Every batch label row is the
+    fixed-width ``label_pad_width + 4`` layout ``[channels, rows, cols,
+    num_label, labels..., label_pad_value...]`` so XLA always compiles one
+    static shape; box-aware crop/expand/mirror run in the C++ workers."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 label_pad_width=-1, label_pad_value=-1.0,
+                 shuffle=False, preprocess_threads=None, seed=0,
+                 num_parts=1, part_index=0,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0,
+                 rand_crop_prob=0.0, min_crop_scale=0.3, max_crop_scale=1.0,
+                 min_crop_aspect_ratio=0.75, max_crop_aspect_ratio=1.333,
+                 min_crop_overlaps=0.1, max_crop_trials=25,
+                 rand_pad_prob=0.0, max_pad_scale=3.0, fill_value=127,
+                 rand_mirror_prob=0.0, round_batch=True, prefetch_buffer=4,
+                 data_name="data", label_name="label", dtype="float32",
+                 **kwargs):
+        super().__init__(batch_size)
+        if preprocess_threads is None:
+            from .base import get_env
+            preprocess_threads = get_env("MXNET_CPU_WORKER_NTHREADS", 4, int)
+        from . import _native
+        self._lib = _native.get_lib()
+        data_shape = tuple(int(x) for x in data_shape)
+        if len(data_shape) != 3:
+            raise MXNetError("data_shape must be (channels, height, width)")
+        self.data_shape = data_shape
+        self.data_name = data_name
+        self.label_name = label_name
+        if dtype not in ("float32", "uint8"):
+            raise MXNetError("ImageDetRecordIter: dtype must be float32 or "
+                             "uint8, got %r" % (dtype,))
+        self.dtype = dtype
+        self.normalize_mean = (mean_r, mean_g, mean_b)
+        self.normalize_std = (std_r, std_g, std_b)
+        c, h, w = data_shape
+        mean = (ctypes.c_float * 3)(mean_r, mean_g, mean_b)
+        std = (ctypes.c_float * 3)(std_r, std_g, std_b)
+        det_aug = (ctypes.c_float * 11)(
+            rand_crop_prob, min_crop_scale, max_crop_scale,
+            min_crop_aspect_ratio, max_crop_aspect_ratio,
+            min_crop_overlaps, max_crop_trials, rand_pad_prob,
+            max_pad_scale, fill_value, rand_mirror_prob)
+        self._handle = self._lib.MXTIOCreateImageDetRecordIter(
+            str(path_imgrec).encode(), int(batch_size), c, h, w,
+            int(preprocess_threads), int(bool(shuffle)), int(seed),
+            int(num_parts), int(part_index), mean, std,
+            int(label_pad_width), float(label_pad_value),
+            int(bool(round_batch)), int(prefetch_buffer), det_aug,
+            int(dtype == "uint8"))
+        if not self._handle:
+            raise MXNetError("ImageDetRecordIter: %s" % _native.last_error())
+        # the native side resolves label_pad_width from a header scan
+        self.label_width = int(self._lib.MXTIODetLabelWidth(self._handle))
+        from . import storage as _storage
+        self._data_buf = _storage.empty((batch_size, c, h, w),
+                                        _np.dtype(dtype))
+        self._label_buf = _storage.empty((batch_size, self.label_width),
+                                         _np.float32)
+        self._exhausted = False
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape,
+                         dtype=_np.dtype(self.dtype))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.label_width))]
+
+    @property
+    def num_samples(self):
+        return int(self._lib.MXTIONumSamples(self._handle))
+
+    def reset(self):
+        self._lib.MXTIOReset(self._handle)
+        self._exhausted = False
+
+    def next(self):
+        if self._exhausted:
+            raise StopIteration
+        if self.dtype == "uint8":
+            pad = self._lib.MXTIONextU8(
+                self._handle,
+                self._data_buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint8)),
+                self._label_buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)))
+        else:
+            pad = self._lib.MXTIONext(
+                self._handle,
+                self._data_buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)),
+                self._label_buf.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)))
+        if pad == -2:
+            from . import _native
+            raise MXNetError("ImageDetRecordIter: %s" % _native.last_error())
+        if pad < 0:
+            self._exhausted = True
+            raise StopIteration
+        return DataBatch(data=[nd_array(self._data_buf.copy())],
+                         label=[nd_array(self._label_buf.copy())],
+                         pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def normalize_prelude(self, network):
+        """cast + normalize prelude contract of dtype='uint8' (see
+        module-level normalize_prelude)."""
         return normalize_prelude(self, network)
 
     def __del__(self):

@@ -73,6 +73,26 @@ struct ImageRecParams {
   // host->device bytes, one less per-pixel pass on the (single-core) host;
   // mean/std are then folded into the accelerator graph by the consumer
   bool output_uint8 = false;
+
+  // ---- detection mode (reference: iter_image_det_recordio.cc:582 +
+  // image_det_aug_default.cc). Labels are variable-width per record
+  // (IRHeader.flag floats: [header_width, object_width, extras...,
+  // per-object (id, xmin, ymin, xmax, ymax, ...)...], coords normalized
+  // to [0,1]); the batch label row is fixed-width label_pad_width + 4 =
+  // [channels, rows, cols, num_label, labels..., pad_value...] so XLA
+  // always sees a static shape. Augmentation is box-aware.
+  bool detection = false;
+  int label_pad_width = 0;       // <=0: estimated from a full header scan
+  float label_pad_value = -1.f;
+  float rand_crop_prob = 0.f;    // box-constrained random crop
+  float min_crop_scale = 0.3f, max_crop_scale = 1.f;
+  float min_crop_aspect_ratio = 0.75f, max_crop_aspect_ratio = 1.333f;
+  float min_crop_overlap = 0.1f;  // min IoU with at least one gt box
+  int max_crop_trials = 25;
+  float rand_pad_prob = 0.f;     // expand canvas (zoom-out) before resize
+  float max_pad_scale = 3.f;
+  float fill_value = 127.f;      // expand-canvas fill (pre-normalization)
+  float rand_mirror_prob = 0.f;  // det uses a probability, not a coin flag
 };
 
 struct Batch {
@@ -97,6 +117,31 @@ class ImageRecordIter {
     }
     if (shard_.empty())
       throw std::runtime_error("empty shard for " + p_.path_imgrec);
+    if (p_.detection) {
+      // resolve the fixed batch label width: header-only scan of EVERY
+      // record in the file (all shards must agree on the padded width or
+      // multi-part training would see different label shapes). 24-byte
+      // reads, not payloads, so this is one cheap sequential pass.
+      int max_width = 0;
+      for (auto& off : all) {
+        IRHeader hdr;
+        if (!scan.ReadHeaderAt(off.first, &hdr))
+          throw std::runtime_error("truncated record in " + p_.path_imgrec);
+        max_width = std::max(max_width, static_cast<int>(hdr.flag));
+      }
+      if (max_width < 2)
+        throw std::runtime_error(
+            "detection records need IRHeader.flag >= 2 label floats "
+            "(header_width, object_width, ...); re-pack with "
+            "`im2rec.py --pack-label`");
+      if (p_.label_pad_width > 0 && p_.label_pad_width < max_width)
+        throw std::runtime_error(
+            "label_pad_width " + std::to_string(p_.label_pad_width) +
+            " is smaller than the widest record label " +
+            std::to_string(max_width));
+      if (p_.label_pad_width <= 0) p_.label_pad_width = max_width;
+      p_.label_width = p_.label_pad_width + 4;  // [c,rows,cols,n] prefix
+    }
     Start();
   }
 
@@ -105,6 +150,9 @@ class ImageRecordIter {
   int64_t num_samples() const { return static_cast<int64_t>(shard_.size()); }
 
   bool uint8_mode() const { return p_.output_uint8; }
+
+  // Detection mode: resolved fixed label row width (label_pad_width + 4).
+  int label_row_width() const { return p_.label_width; }
 
   // Copies the next batch into out pointers. Returns pad count, or -1 at
   // epoch end (call Reset for the next epoch). `data_out` must match the
@@ -307,6 +355,35 @@ class ImageRecordIter {
       const char* payload = rec.data() + sizeof(IRHeader);
       size_t payload_len = rec.size() - sizeof(IRHeader);
       float* lab = &b->label[i * p_.label_width];
+      if (p_.detection) {
+        if (hdr.flag < 2)
+          throw std::runtime_error(
+              "detection record has IRHeader.flag=" +
+              std::to_string(hdr.flag) + " < 2 label floats");
+        size_t lab_bytes = static_cast<size_t>(hdr.flag) * sizeof(float);
+        if (lab_bytes > payload_len)
+          throw std::runtime_error(
+              "corrupt record: IRHeader.flag labels exceed record size "
+              "(flag=" + std::to_string(hdr.flag) + ", payload=" +
+              std::to_string(payload_len) + " bytes)");
+        std::vector<float> lbuf(hdr.flag);
+        std::memcpy(lbuf.data(), payload, lab_bytes);
+        payload += lab_bytes;
+        payload_len -= lab_bytes;
+        DetDecodeAugment(
+            payload, payload_len, rng, &lbuf,
+            p_.output_uint8 ? nullptr : &b->data[i * c * h * w],
+            p_.output_uint8 ? &b->data_u8[i * c * h * w] : nullptr);
+        // fixed-width row: [channels, rows, cols, num_label, labels, pad]
+        // (reference iter_image_det_recordio.cc:456-463 layout)
+        std::fill(lab, lab + p_.label_width, p_.label_pad_value);
+        lab[0] = static_cast<float>(c);
+        lab[1] = static_cast<float>(h);
+        lab[2] = static_cast<float>(w);
+        lab[3] = static_cast<float>(lbuf.size());
+        std::memcpy(lab + 4, lbuf.data(), lbuf.size() * sizeof(float));
+        continue;
+      }
       if (hdr.flag > 0) {
         size_t lab_bytes = static_cast<size_t>(hdr.flag) * sizeof(float);
         if (lab_bytes > payload_len)
@@ -379,7 +456,16 @@ class ImageRecordIter {
     bool mirror = p_.rand_mirror &&
                   std::uniform_int_distribution<int>(0, 1)(rng);
     if (mirror) cv::flip(crop, crop, 1);
+    PackPixels(crop, rng, out, out_u8);
+  }
 
+  // Shared pixel-packing tail: color jitter + normalize + plane write.
+  // `crop` must already be (h, w); exactly one of out/out_u8 is non-null.
+  void PackPixels(const cv::Mat& crop_in, std::mt19937& rng, float* out,
+                  uint8_t* out_u8) {
+    const cv::Mat& crop = crop_in;
+    const int c = p_.channels, h = p_.height, w = p_.width;
+    std::uniform_real_distribution<float> uni01(0.f, 1.f);
     // color jitter in float, RGB order (reference applies brightness,
     // then contrast vs the mean gray, then saturation vs per-pixel gray,
     // then PCA lighting noise — image_aug_default.cc)
@@ -477,6 +563,143 @@ class ImageRecordIter {
         }
       }
     }
+  }
+
+  // ---- detection decode + box-aware augment -----------------------------
+  // Label layout: [header_width, object_width, extras..., objects...] with
+  // each object [id, xmin, ymin, xmax, ymax, ...] in normalized [0,1]
+  // coords (the im2rec --pack-label convention the reference SSD tooling
+  // writes). Geometric augmenters transform image and boxes together:
+  // expand (zoom-out onto a filled canvas), IoU-constrained random crop
+  // (dropping boxes whose center leaves the crop), force-resize to the
+  // static (h, w) XLA shape, and probabilistic horizontal mirror.
+  // Reference behavior class: image_det_aug_default.cc.
+  void DetDecodeAugment(const char* buf, size_t len, std::mt19937& rng,
+                        std::vector<float>* lbuf, float* out,
+                        uint8_t* out_u8) {
+    const int c = p_.channels, h = p_.height, w = p_.width;
+    cv::Mat raw(1, static_cast<int>(len), CV_8U, const_cast<char*>(buf));
+    cv::Mat img = cv::imdecode(raw, c == 1 ? cv::IMREAD_GRAYSCALE
+                                           : cv::IMREAD_COLOR);
+    if (img.empty()) throw std::runtime_error("image decode failed");
+    std::uniform_real_distribution<float> uni01(0.f, 1.f);
+
+    auto& L = *lbuf;
+    const int header_width = static_cast<int>(L[0]);
+    const int object_width = L.size() > 1 ? static_cast<int>(L[1]) : 0;
+    if (header_width < 2 || object_width < 5)
+      throw std::runtime_error(
+          "bad detection label: header_width=" + std::to_string(header_width)
+          + " object_width=" + std::to_string(object_width)
+          + " (need >=2 / >=5)");
+    if ((L.size() - header_width) % object_width != 0)
+      throw std::runtime_error(
+          "bad detection label: " + std::to_string(L.size() - header_width)
+          + " object floats not divisible by object_width "
+          + std::to_string(object_width));
+    const int n_obj = static_cast<int>(L.size() - header_width)
+                      / object_width;
+    // objects as a working copy (survivors are written back at the end)
+    std::vector<std::vector<float>> objs(n_obj);
+    for (int i = 0; i < n_obj; ++i)
+      objs[i].assign(L.begin() + header_width + i * object_width,
+                     L.begin() + header_width + (i + 1) * object_width);
+
+    // 1) expand: place the image on a fill-valued canvas `s` times larger
+    //    (teaches small-object scales); boxes shrink into the canvas
+    if (p_.rand_pad_prob > 0.f && uni01(rng) < p_.rand_pad_prob
+        && p_.max_pad_scale > 1.f) {
+      float s = 1.f + uni01(rng) * (p_.max_pad_scale - 1.f);
+      int nw = static_cast<int>(img.cols * s);
+      int nh = static_cast<int>(img.rows * s);
+      int dx = std::uniform_int_distribution<int>(0, nw - img.cols)(rng);
+      int dy = std::uniform_int_distribution<int>(0, nh - img.rows)(rng);
+      cv::Mat canvas(nh, nw, img.type(),
+                     cv::Scalar::all(p_.fill_value));
+      img.copyTo(canvas(cv::Rect(dx, dy, img.cols, img.rows)));
+      float fx = static_cast<float>(img.cols) / nw;
+      float fy = static_cast<float>(img.rows) / nh;
+      float ox = static_cast<float>(dx) / nw;
+      float oy = static_cast<float>(dy) / nh;
+      for (auto& o : objs) {
+        o[1] = o[1] * fx + ox;
+        o[3] = o[3] * fx + ox;
+        o[2] = o[2] * fy + oy;
+        o[4] = o[4] * fy + oy;
+      }
+      img = canvas;
+    }
+
+    // 2) IoU-constrained random crop (zoom-in); falls back to the full
+    //    image when no trial satisfies the overlap/coverage constraints
+    if (p_.rand_crop_prob > 0.f && uni01(rng) < p_.rand_crop_prob) {
+      for (int trial = 0; trial < p_.max_crop_trials; ++trial) {
+        float scale = p_.min_crop_scale
+                      + uni01(rng) * (p_.max_crop_scale - p_.min_crop_scale);
+        float ratio = p_.min_crop_aspect_ratio
+                      + uni01(rng) * (p_.max_crop_aspect_ratio
+                                      - p_.min_crop_aspect_ratio);
+        float cw = std::min(1.f, std::sqrt(scale * ratio));
+        float ch = std::min(1.f, std::sqrt(scale / ratio));
+        float cx = uni01(rng) * (1.f - cw);
+        float cy = uni01(rng) * (1.f - ch);
+        float cx1 = cx + cw, cy1 = cy + ch;
+        bool ok = objs.empty();
+        for (auto& o : objs) {
+          float ix = std::max(0.f, std::min(o[3], cx1) - std::max(o[1], cx));
+          float iy = std::max(0.f, std::min(o[4], cy1) - std::max(o[2], cy));
+          float inter = ix * iy;
+          float uni = (o[3] - o[1]) * (o[4] - o[2]) + cw * ch - inter;
+          if (uni > 0.f && inter / uni >= p_.min_crop_overlap) {
+            ok = true;
+            break;
+          }
+        }
+        if (!ok) continue;
+        // keep objects whose center stays inside the crop
+        std::vector<std::vector<float>> kept;
+        for (auto& o : objs) {
+          float mx = 0.5f * (o[1] + o[3]), my = 0.5f * (o[2] + o[4]);
+          if (mx < cx || mx > cx1 || my < cy || my > cy1) continue;
+          auto no = o;
+          no[1] = std::max(0.f, (o[1] - cx) / cw);
+          no[3] = std::min(1.f, (o[3] - cx) / cw);
+          no[2] = std::max(0.f, (o[2] - cy) / ch);
+          no[4] = std::min(1.f, (o[4] - cy) / ch);
+          kept.push_back(std::move(no));
+        }
+        if (kept.empty() && !objs.empty()) continue;
+        int px = static_cast<int>(cx * img.cols);
+        int py = static_cast<int>(cy * img.rows);
+        int pw = std::max(1, static_cast<int>(cw * img.cols));
+        int ph = std::max(1, static_cast<int>(ch * img.rows));
+        pw = std::min(pw, img.cols - px);
+        ph = std::min(ph, img.rows - py);
+        img = img(cv::Rect(px, py, pw, ph)).clone();
+        objs = std::move(kept);
+        break;
+      }
+    }
+
+    // 3) force-resize to the static shape (normalized boxes unchanged)
+    cv::resize(img, img, cv::Size(w, h), 0, 0, cv::INTER_LINEAR);
+
+    // 4) probabilistic horizontal mirror with box flip
+    if (p_.rand_mirror_prob > 0.f && uni01(rng) < p_.rand_mirror_prob) {
+      cv::flip(img, img, 1);
+      for (auto& o : objs) {
+        float x0 = o[1];
+        o[1] = 1.f - o[3];
+        o[3] = 1.f - x0;
+      }
+    }
+
+    // write back survivors (count may have shrunk under cropping)
+    L.resize(header_width + objs.size() * object_width);
+    for (size_t i = 0; i < objs.size(); ++i)
+      std::copy(objs[i].begin(), objs[i].end(),
+                L.begin() + header_width + i * object_width);
+    PackPixels(img, rng, out, out_u8);
   }
 
   // ---- stage 3: ordered bounded output ----------------------------------
@@ -618,6 +841,68 @@ void* MXTIOCreateImageRecordIter(
       shuffle, seed, num_parts, part_index, mean, stdv, rand_crop,
       rand_mirror, resize, label_width, round_batch, prefetch_depth,
       nullptr);
+}
+
+/* Detection iterator (reference ImageDetRecordIter,
+ * iter_image_det_recordio.cc:582): variable-width per-record labels packed
+ * into fixed [label_pad_width + 4] rows, box-aware augmentation.
+ * det_aug = {rand_crop_prob, min_crop_scale, max_crop_scale,
+ *            min_crop_aspect_ratio, max_crop_aspect_ratio,
+ *            min_crop_overlap, max_crop_trials, rand_pad_prob,
+ *            max_pad_scale, fill_value, rand_mirror_prob}.
+ * Returns NULL on error (MXTIOGetLastError); query the resolved row width
+ * with MXTIODetLabelWidth before sizing the label buffer. */
+void* MXTIOCreateImageDetRecordIter(
+    const char* path_imgrec, int batch_size, int channels, int height,
+    int width, int preprocess_threads, int shuffle, unsigned seed,
+    int num_parts, int part_index, const float* mean, const float* stdv,
+    int label_pad_width, float label_pad_value, int round_batch,
+    int prefetch_depth, const float* det_aug, int output_uint8) {
+  try {
+    mxtpu::ImageRecParams p;
+    p.detection = true;
+    p.path_imgrec = path_imgrec;
+    p.batch_size = batch_size;
+    p.channels = channels;
+    p.height = height;
+    p.width = width;
+    p.preprocess_threads = std::max(1, preprocess_threads);
+    p.shuffle = shuffle != 0;
+    p.seed = seed;
+    p.num_parts = std::max(1, num_parts);
+    p.part_index = part_index;
+    for (int i = 0; i < 3; ++i) {
+      p.mean[i] = mean ? mean[i] : 0.f;
+      p.std_[i] = stdv ? stdv[i] : 1.f;
+    }
+    p.label_pad_width = label_pad_width;
+    p.label_pad_value = label_pad_value;
+    p.round_batch = round_batch != 0;
+    p.prefetch_depth = std::max(1, prefetch_depth);
+    if (det_aug) {
+      p.rand_crop_prob = det_aug[0];
+      p.min_crop_scale = det_aug[1];
+      p.max_crop_scale = det_aug[2];
+      p.min_crop_aspect_ratio = det_aug[3];
+      p.max_crop_aspect_ratio = det_aug[4];
+      p.min_crop_overlap = det_aug[5];
+      p.max_crop_trials = std::max(1, static_cast<int>(det_aug[6]));
+      p.rand_pad_prob = det_aug[7];
+      p.max_pad_scale = det_aug[8];
+      p.fill_value = det_aug[9];
+      p.rand_mirror_prob = det_aug[10];
+    }
+    p.output_uint8 = output_uint8 != 0;
+    return new mxtpu::ImageRecordIter(p);
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return nullptr;
+  }
+}
+
+/* Resolved detection label row width (label_pad_width + 4). */
+int MXTIODetLabelWidth(void* handle) {
+  return static_cast<mxtpu::ImageRecordIter*>(handle)->label_row_width();
 }
 
 int MXTIONext(void* handle, float* data_out, float* label_out) {

@@ -108,6 +108,24 @@ bool RecordIOReader::ReadAt(uint64_t offset, uint32_t length,
   return out->size() == length;
 }
 
+bool RecordIOReader::ReadHeaderAt(uint64_t offset, IRHeader* hdr) {
+  std::fseek(fp_, static_cast<long>(offset), SEEK_SET);
+  uint32_t rec_hdr[2];
+  if (std::fread(rec_hdr, sizeof(uint32_t), 2, fp_) != 2) return false;
+  if (rec_hdr[0] != kRecordIOMagic)
+    throw std::runtime_error("invalid RecordIO magic");
+  uint32_t length = DecodeLength(rec_hdr[1]);
+  if (length >= sizeof(IRHeader))
+    return std::fread(hdr, sizeof(IRHeader), 1, fp_) == 1;
+  // first part shorter than the header (an aligned magic landed inside the
+  // first 24 bytes — possible, just vanishingly rare): stitch the record
+  std::string whole;
+  std::fseek(fp_, static_cast<long>(offset), SEEK_SET);
+  if (!ReadRecord(&whole) || whole.size() < sizeof(IRHeader)) return false;
+  std::memcpy(hdr, whole.data(), sizeof(IRHeader));
+  return true;
+}
+
 void RecordIOReader::Seek(uint64_t offset) {
   std::fseek(fp_, static_cast<long>(offset), SEEK_SET);
 }

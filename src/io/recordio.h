@@ -39,6 +39,9 @@ class RecordIOReader {
   // Read the logical record at a known offset (as produced by ScanOffsets);
   // `length` is validated against the stitched payload size.
   bool ReadAt(uint64_t offset, uint32_t length, std::string* out);
+  // Read only the IRHeader of the record at `offset` — a 24-byte read
+  // instead of the whole (JPEG-sized) payload, for label-width scans.
+  bool ReadHeaderAt(uint64_t offset, IRHeader* hdr);
   void Seek(uint64_t offset);
 
  private:

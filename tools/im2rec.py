@@ -74,7 +74,12 @@ def _load_recordio():
     return mod
 
 
-def pack(prefix, root, quality=95, resize=0, color=1):
+def pack(prefix, root, quality=95, resize=0, color=1, pack_label=False):
+    """pack_label=True writes EVERY float from the .lst row as the record
+    label (IRHeader.flag = count) — required for detection lists
+    (``idx  header_width  object_width  id x0 y0 x1 y1 ...  path``, the
+    format ImageDetRecordIter consumes); without it only the first float
+    is kept, matching the reference im2rec default."""
     import cv2
     recordio = _load_recordio()
     rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
@@ -91,7 +96,10 @@ def pack(prefix, root, quality=95, resize=0, color=1):
             scale = float(resize) / min(h, w)
             img = cv2.resize(img, (int(w * scale + 0.5),
                                    int(h * scale + 0.5)))
-        label = labels[0] if len(labels) == 1 else labels
+        if pack_label and len(labels) > 1:
+            label = labels
+        else:
+            label = labels[0]
         header = recordio.IRHeader(0, label, idx, 0)
         rec.write_idx(idx, recordio.pack_img(header, img, quality=quality))
         n += 1
@@ -110,13 +118,16 @@ def main():
     ap.add_argument("--resize", type=int, default=0)
     ap.add_argument("--no-recursive", action="store_true")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--pack-label", action="store_true",
+                    help="pack ALL label floats per row (detection lists)")
     args = ap.parse_args()
     if args.list:
         entries = list_images(args.root, recursive=not args.no_recursive)
         write_list(args.prefix, entries, shuffle=args.shuffle, seed=args.seed)
         print("wrote %d entries -> %s.lst" % (len(entries), args.prefix))
     else:
-        pack(args.prefix, args.root, quality=args.quality, resize=args.resize)
+        pack(args.prefix, args.root, quality=args.quality,
+             resize=args.resize, pack_label=args.pack_label)
 
 
 if __name__ == "__main__":
