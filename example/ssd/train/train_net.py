@@ -14,20 +14,17 @@ from evaluate.eval_metric import VOC07MApMetric  # noqa: E402
 
 
 def _scan_label_width(path):
-    """Max IRHeader.flag across `path`'s records (-1 when no record file:
-    the synthetic fallback has no packed labels to scan)."""
+    """Max IRHeader.flag across `path`'s records via the native
+    header-only scan (24-byte reads, no JPEG payloads — VOC-scale files
+    scan in milliseconds). -1 when no record file: the synthetic
+    fallback has no packed labels to scan."""
     if not path or not os.path.exists(path):
         return -1
-    from mxnet_tpu import recordio
-    rec = recordio.MXRecordIO(path, "r")
-    width = -1
-    while True:
-        raw = rec.read()
-        if raw is None:
-            break
-        header, _ = recordio.unpack(raw)
-        width = max(width, int(header.flag))
-    rec.close()
+    from mxnet_tpu import _native
+    width = _native.get_lib().MXTIOScanDetLabelWidth(str(path).encode())
+    if width < 0:
+        raise RuntimeError("label scan of %s failed: %s"
+                           % (path, _native.last_error()))
     return width
 
 

@@ -73,6 +73,8 @@ def _load():
             ctypes.POINTER(ctypes.c_float), ctypes.c_int]
         lib.MXTIODetLabelWidth.restype = ctypes.c_int
         lib.MXTIODetLabelWidth.argtypes = [ctypes.c_void_p]
+        lib.MXTIOScanDetLabelWidth.restype = ctypes.c_int
+        lib.MXTIOScanDetLabelWidth.argtypes = [ctypes.c_char_p]
         lib.MXTIONext.restype = ctypes.c_int
         lib.MXTIONext.argtypes = [ctypes.c_void_p,
                                   ctypes.POINTER(ctypes.c_float),

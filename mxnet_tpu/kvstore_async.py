@@ -14,24 +14,36 @@ construction), so it gets an actual server:
 * `KVStoreDistAsync` — the worker client: `push` ships gradients and
   returns (no barrier), `pull` fetches current weights.
 
-Topology and wire format are deliberately minimal: ONE server process
-(the reference shards big arrays across N ps-lite servers; a single
-host-side server is enough for the scale this path is for — anyone at
-multi-host scale wants `dist_sync`'s in-graph collectives), and
-length-prefixed pickle over TCP. Like the reference's ps-lite transport
-this is for TRUSTED cluster networks only: pickle deserialization is
-code execution, so never expose the port beyond the job's hosts
-(reference ps-lite vans are equally unauthenticated).
+Topology: N independent server processes with deterministic client-side
+key placement (reference `kvstore_dist.h:151` PSKV semantics):
+
+* arrays smaller than `MXNET_KVSTORE_BIGARRAY_BOUND` (default 1e6 bytes,
+  reference `docs/faq/env_var.md`) live whole on `hash(key) % N`;
+* bigger arrays split into N near-equal leading-axis slices, one per
+  server — every server then shares the update work of the hot weights,
+  which is exactly what made the reference's PS scale. Slices keep ROW
+  boundaries so row_sparse traffic routes to the owning server directly.
+
+The wire format is length-prefixed pickle over TCP. Like the reference's
+ps-lite transport this is for TRUSTED cluster networks only: pickle
+deserialization is code execution, so never expose the port beyond the
+job's hosts (reference ps-lite vans are equally unauthenticated).
 
 Env protocol (reference kvstore.h:254 InitPSEnv):
-  DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT — server address
+  DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT — server 0 address
+  DMLC_NUM_SERVER                      — server count (default 1);
+                                         server i defaults to the root
+                                         host at ROOT_PORT + i
+  DMLC_PS_SERVER_URIS                  — optional "host:port,host:port"
+                                         override for multi-host servers
+  DMLC_SERVER_ID                       — this server's index (server role)
   DMLC_ROLE                            — worker | server | scheduler
   DMLC_NUM_WORKER / DMLC_WORKER_ID     — worker identity
   DMLC_PS_BIND_ADDR                    — server listen interface
                                          (default 127.0.0.1; set "" on the
                                          server host for all-interfaces in
                                          a real multi-host cluster)
-`tools/launch.py --num-servers 1` wires all of it.
+`tools/launch.py --num-servers N` wires all of it.
 """
 from __future__ import annotations
 
@@ -45,9 +57,19 @@ import numpy as _np
 
 from .base import MXNetError
 from .kvstore import KVStore, _key_list, _val_list
+from .ndarray import sparse as _mx_sparse
 from .ndarray.ndarray import array
 
 __all__ = ["AsyncParamServer", "KVStoreDistAsync", "serve_forever"]
+
+
+def _stable_hash(key):
+    """Deterministic across processes (PYTHONHASHSEED randomizes str
+    hash) — every worker must compute the same key placement."""
+    h = 2166136261
+    for ch in str(key).encode():
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return h
 
 
 def _send_msg(sock, obj):
@@ -128,6 +150,34 @@ class AsyncParamServer:
                 if key not in self._weights:
                     raise MXNetError("pull before init for key %r" % key)
                 return ("ok", self._weights[key])
+        if op == "push_rows":
+            # sparse push: (local row indices, row values) against this
+            # server's slice; the updater sees a RowSparseNDArray grad so
+            # sparse-lazy optimizer variants touch only those rows
+            _, key, rows, vals = msg
+            from .ndarray import sparse as _sp
+            with self._lock:
+                if key not in self._weights:
+                    raise MXNetError("push before init for key %r" % key)
+                if self._updater is None:
+                    raise MXNetError("dist_async server has no optimizer; "
+                                     "call kv.set_optimizer first")
+                w = array(self._weights[key])
+                g = _sp.row_sparse_array(
+                    (_np.asarray(vals, _np.float32),
+                     _np.asarray(rows, _np.int64)),
+                    shape=self._weights[key].shape)
+                self._updater(_updater_key(key), g, w)
+                self._weights[key] = w.asnumpy()
+                self._push_count += 1
+                return ("ok", self._push_count)
+        if op == "pull_rows":
+            _, key, rows = msg
+            with self._lock:
+                if key not in self._weights:
+                    raise MXNetError("pull before init for key %r" % key)
+                idx = _np.asarray(rows, _np.int64)
+                return ("ok", self._weights[key][idx])
         if op == "set_optimizer":
             _, payload = msg
             from . import optimizer as opt_mod
@@ -240,6 +290,22 @@ def _updater_key(key):
         return key
 
 
+def _server_endpoints():
+    """(host, port) per server from the DMLC env: explicit
+    DMLC_PS_SERVER_URIS list, else root host at ROOT_PORT + i."""
+    uris = os.environ.get("DMLC_PS_SERVER_URIS", "")
+    if uris:
+        out = []
+        for ep in uris.split(","):
+            host, _, port = ep.strip().rpartition(":")
+            out.append((host, int(port)))
+        return out
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    n = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+    return [(host, port + i) for i in range(n)]
+
+
 def serve_forever():
     """Entry for a DMLC_ROLE=server process (kvstore_server.py hook).
 
@@ -251,20 +317,32 @@ def serve_forever():
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass  # jax already initialized by the host process: use as-is
-    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    sid = int(os.environ.get("DMLC_SERVER_ID", "0"))
+    endpoints = _server_endpoints()
+    if not 0 <= sid < len(endpoints):
+        raise MXNetError("DMLC_SERVER_ID=%d outside the %d-server topology"
+                         % (sid, len(endpoints)))
     n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-    AsyncParamServer(port, n).serve()
+    AsyncParamServer(endpoints[sid][1], n).serve()
 
 
 class KVStoreDistAsync(KVStore):
-    """Worker client: per-push server updates, no worker barrier."""
+    """Worker client: per-push server updates, no worker barrier.
+
+    Key placement mirrors the reference PSKV (`kvstore_dist.h:151`):
+    small arrays hash to one server; arrays over
+    MXNET_KVSTORE_BIGARRAY_BOUND bytes split into near-equal leading-axis
+    slices, one per server."""
 
     def __init__(self):
         super().__init__("dist_async")
         self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-        self._sock = None
-        self._sock_lock = threading.Lock()
+        self._socks = None
+        self._sock_locks = None
+        self._placements = {}   # key -> list of per-server row slices
+        self._bigarray_bound = int(float(os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000")))
         role = os.environ.get("DMLC_ROLE", "worker")
         if role in ("server", "scheduler"):
             # reference server flow: `kv = mx.kv.create('dist_async');
@@ -272,15 +350,19 @@ class KVStoreDistAsync(KVStore):
             # its own (not-yet-listening) port; this instance is just the
             # handle run() reads the type from
             return
-        uri = os.environ.get("DMLC_PS_ROOT_URI")
-        if not uri:
+        if not os.environ.get("DMLC_PS_ROOT_URI"):
             raise MXNetError(
                 "kvstore dist_async needs a parameter server: launch via "
-                "`tools/launch.py -n <workers> --num-servers 1` (sets "
+                "`tools/launch.py -n <workers> --num-servers N` (sets "
                 "DMLC_PS_ROOT_URI/PORT), or start "
                 "`python -m mxnet_tpu.kvstore_server` with DMLC_ROLE=server")
-        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
-        self._sock = self._connect_with_retry(uri, port)
+        self._socks = [self._connect_with_retry(host, port)
+                       for host, port in _server_endpoints()]
+        self._sock_locks = [threading.Lock() for _ in self._socks]
+
+    @property
+    def num_servers(self):
+        return len(self._socks) if self._socks else 0
 
     @staticmethod
     def _connect_with_retry(uri, port, deadline_s=60.0):
@@ -312,21 +394,70 @@ class KVStoreDistAsync(KVStore):
     def num_workers(self):
         return self._num_workers
 
-    def _rpc(self, *msg):
-        if self._sock is None:
+    def _require_worker(self):
+        if self._socks is None:
             raise MXNetError(
                 "this dist_async kvstore is a server-role handle "
                 "(DMLC_ROLE=%s): pass it to KVStoreServer(kv).run() — "
                 "worker API calls belong on worker processes"
                 % os.environ.get("DMLC_ROLE"))
-        with self._sock_lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
-        if reply is None:
-            raise MXNetError("dist_async server closed the connection")
-        if reply[0] == "error":
-            raise MXNetError("dist_async server: %s" % reply[1])
-        return reply
+
+    def _rpc(self, server, *msg):
+        return self._rpc_scatter([(server, msg)])[0]
+
+    def _rpc_scatter(self, calls):
+        """One round-trip to several servers, overlapped: send every
+        request first, then collect replies — per-key shard latency is
+        max(server round-trips), not their sum. `calls` is
+        [(server, msg tuple)] with at most one call per server."""
+        self._require_worker()
+        for s, _ in calls:
+            self._sock_locks[s].acquire()
+        try:
+            for s, msg in calls:
+                _send_msg(self._socks[s], msg)
+            replies = []
+            for s, _ in calls:
+                reply = _recv_msg(self._socks[s])
+                if reply is None:
+                    raise MXNetError(
+                        "dist_async server %d closed the connection" % s)
+                if reply[0] == "error":
+                    raise MXNetError("dist_async server %d: %s"
+                                     % (s, reply[1]))
+                replies.append(reply)
+            return replies
+        finally:
+            for s, _ in calls:
+                self._sock_locks[s].release()
+
+    # -- key placement (reference kvstore_dist.h:151 PSKV) -----------------
+
+    def _placement(self, key, arr):
+        """[(server, row_start, row_stop)] for `key` with shape/dtype of
+        `arr`; whole-array placements use (server, None, None). Computed
+        once per key at init and reused by every push/pull (the
+        reference caches PSKV the same way)."""
+        if key in self._placements:
+            return self._placements[key]
+        self._require_worker()
+        n = len(self._socks)
+        shape = arr.shape
+        nbytes = int(_np.prod(shape, dtype=_np.int64)) * 4 if shape else 4
+        if n == 1 or nbytes < self._bigarray_bound or not shape \
+                or shape[0] < n:
+            plan = [(_stable_hash(key) % n, None, None)]
+        else:
+            rows = shape[0]
+            bounds = [rows * i // n for i in range(n + 1)]
+            plan = [(s, bounds[s], bounds[s + 1]) for s in range(n)
+                    if bounds[s] < bounds[s + 1]]
+        self._placements[key] = plan
+        return plan
+
+    @staticmethod
+    def _subkey(key, server, whole):
+        return key if whole else "%s#shard%d" % (key, server)
 
     # -- KVStore API -------------------------------------------------------
 
@@ -334,7 +465,11 @@ class KVStoreDistAsync(KVStore):
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
-            self._rpc("init", str(k), vlist[0].asnumpy())
+            val = vlist[0].asnumpy()
+            self._rpc_scatter(
+                [(s, ("init", self._subkey(str(k), s, r0 is None),
+                      val if r0 is None else val[r0:r1]))
+                 for s, r0, r1 in self._placement(str(k), val)])
 
     def push(self, key, value, priority=0):
         keys, _ = _key_list(key)
@@ -343,30 +478,113 @@ class KVStoreDistAsync(KVStore):
             if self._gc.active:
                 vlist = self._compress_vlist(str(k), vlist)
             merged = self._merge(vlist)
-            self._rpc("push", str(k), merged.asnumpy())
+            if isinstance(merged, _mx_sparse.RowSparseNDArray):
+                self._push_row_sparse(str(k), merged)
+                continue
+            grad = merged.asnumpy()
+            self._rpc_scatter(
+                [(s, ("push", self._subkey(str(k), s, r0 is None),
+                      grad if r0 is None else grad[r0:r1]))
+                 for s, r0, r1 in self._placement(str(k), grad)])
+
+    def _push_row_sparse(self, key, merged):
+        """Route row_sparse gradient rows to their owning servers."""
+        rows = merged.indices.asnumpy().astype(_np.int64)
+        vals = merged.data.asnumpy()
+        plan = self._placement(key, merged)
+        calls = []
+        for s, r0, r1 in plan:
+            if r0 is None:
+                calls.append((s, ("push_rows", key, rows, vals)))
+                continue
+            mask = (rows >= r0) & (rows < r1)
+            if mask.any():
+                calls.append((s, ("push_rows", self._subkey(key, s, False),
+                                  rows[mask] - r0, vals[mask])))
+        self._rpc_scatter(calls)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, _ = _key_list(key)
         outs = _val_list(out, len(keys))
         for k, olist in zip(keys, outs):
-            weights = self._rpc("pull", str(k))[1]
+            # placement is derivable from the out buffer, so a fresh
+            # process (worker restart, eval-only attach) can pull keys it
+            # never init-ed as long as the servers hold them
+            plan = self._placement(str(k), olist[0])
+            if plan[0][1] is None:
+                weights = self._rpc(plan[0][0], "pull", str(k))[1]
+            else:
+                replies = self._rpc_scatter(
+                    [(s, ("pull", self._subkey(str(k), s, False)))
+                     for s, _, _ in plan])
+                weights = _np.concatenate([r[1] for r in replies], axis=0)
             for o in olist:
                 o[:] = array(weights)
 
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows, each from its owning server
+        (reference: row-sparse PSKV routing in kvstore_dist.h)."""
+        from .ndarray.ndarray import NDArray as _ND
+        keys, _ = _key_list(key)
+        outs = _val_list(out, len(keys))
+        if isinstance(row_ids, _ND):
+            rids = [row_ids] * len(keys)
+        else:
+            rids, _ = _key_list(row_ids)
+        for k, olist, rid in zip(keys, outs, rids):
+            plan = self._placement(str(k), olist[0])
+            rows = _np.unique(rid.asnumpy().astype(_np.int64))
+            if plan[0][1] is None:
+                vals = self._rpc(plan[0][0], "pull_rows", str(k), rows)[1]
+            else:
+                calls = []
+                for s, r0, r1 in plan:
+                    mask = (rows >= r0) & (rows < r1)
+                    if mask.any():
+                        calls.append((s, ("pull_rows",
+                                          self._subkey(str(k), s, False),
+                                          rows[mask] - r0)))
+                replies = self._rpc_scatter(calls)
+                vals = _np.concatenate([r[1] for r in replies], axis=0) \
+                    if replies else _np.zeros((0,), _np.float32)
+            for o in olist:
+                if isinstance(o, _mx_sparse.RowSparseNDArray):
+                    dst = _mx_sparse.row_sparse_array(
+                        (vals, rows), shape=o.shape)
+                    o._data, o._indices = dst._data, dst._indices
+                else:
+                    import jax
+                    import jax.numpy as jnp
+                    o._data = o._data.at[jnp.asarray(rows)].set(
+                        jax.device_put(jnp.asarray(vals),
+                                       o.context.jax_device))
+
     def set_optimizer(self, optimizer):
+        self._require_worker()
         self._optimizer = optimizer
-        self._rpc("set_optimizer", pickle.dumps(optimizer))
+        payload = pickle.dumps(optimizer)
+        self._rpc_scatter([(s, ("set_optimizer", payload))
+                           for s in range(len(self._socks))])
 
     def barrier(self):
-        self._rpc("barrier", self._rank)
+        # one rendezvous point: server 0 tracks the worker group
+        self._rpc(0, "barrier", self._rank)
 
     def server_stats(self):
-        """{push_count, num_keys} — observability + the async-semantics
-        test hook (push_count counts EVERY push, not rounds)."""
-        return self._rpc("stats")[1]
+        """Aggregated {push_count, num_keys} across servers, plus the
+        per-server breakdown under "per_server" — the multi-server test
+        hook (key accounting proves where shards landed)."""
+        self._require_worker()
+        per = [r[1] for r in self._rpc_scatter(
+            [(s, ("stats",)) for s in range(len(self._socks))])]
+        return {"push_count": sum(p["push_count"] for p in per),
+                "num_keys": sum(p["num_keys"] for p in per),
+                "per_server": per}
 
     def stop_server(self):
-        self._rpc("stop")
+        self._require_worker()
+        self._rpc_scatter([(s, ("stop",))
+                           for s in range(len(self._socks))])
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         raise MXNetError("dist_async: optimizer state lives on the server "

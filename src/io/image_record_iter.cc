@@ -905,6 +905,30 @@ int MXTIODetLabelWidth(void* handle) {
   return static_cast<mxtpu::ImageRecordIter*>(handle)->label_row_width();
 }
 
+/* Standalone header-only scan: max IRHeader.flag across a record file
+ * (24-byte reads, no payloads, no iterator threads). For callers that
+ * must align label_pad_width across SEVERAL files (train + val) before
+ * constructing any iterator. Returns -1 on error (MXTIOGetLastError). */
+int MXTIOScanDetLabelWidth(const char* path_imgrec) {
+  try {
+    mxtpu::RecordIOReader scan(path_imgrec);
+    if (!scan.is_open())
+      throw std::runtime_error(std::string("cannot open ") + path_imgrec);
+    int max_width = 0;
+    for (auto& off : scan.ScanOffsets()) {
+      mxtpu::IRHeader hdr;
+      if (!scan.ReadHeaderAt(off.first, &hdr))
+        throw std::runtime_error(std::string("truncated record in ")
+                                 + path_imgrec);
+      max_width = std::max(max_width, static_cast<int>(hdr.flag));
+    }
+    return max_width;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
 int MXTIONext(void* handle, float* data_out, float* label_out) {
   try {
     auto* it = static_cast<mxtpu::ImageRecordIter*>(handle);

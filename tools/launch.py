@@ -22,58 +22,66 @@ def launch_local(n, command, coordinator="127.0.0.1:12345", num_servers=0,
     server_procs = []
     ps_env = {}
     if num_servers:
-        # dist_async topology: ONE parameter server process
-        # (kvstore_async.py documents the single-server scope), workers
-        # get its address through the reference DMLC env protocol
+        # dist_async topology: N parameter-server processes on
+        # consecutive ports (server i at server_port + i); workers learn
+        # the topology through the reference DMLC env protocol and shard
+        # big arrays across all of them (kvstore_async.py PSKV placement)
         ps_env = {"DMLC_PS_ROOT_URI": "127.0.0.1",
-                  "DMLC_PS_ROOT_PORT": str(server_port)}
-        env = dict(os.environ)
-        env.update(ps_env)
-        env.update({"DMLC_ROLE": "server", "DMLC_NUM_WORKER": str(n),
-                    "MXNET_KVSTORE_TYPE": "dist_async"})
-        # the parameter server is a HOST-side component: pin it to the
-        # CPU backend and keep accelerator plugins from registering so a
-        # wedged device tunnel can never take the server down with it
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
+                  "DMLC_PS_ROOT_PORT": str(server_port),
+                  "DMLC_NUM_SERVER": str(num_servers)}
         # the server module must import regardless of the caller's cwd
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-        server = subprocess.Popen(
-            [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
-            env=env, shell=False)
-        server_procs.append(server)
+        for sid in range(num_servers):
+            env = dict(os.environ)
+            env.update(ps_env)
+            env.update({"DMLC_ROLE": "server", "DMLC_SERVER_ID": str(sid),
+                        "DMLC_NUM_WORKER": str(n),
+                        "MXNET_KVSTORE_TYPE": "dist_async"})
+            # the parameter server is a HOST-side component: pin it to the
+            # CPU backend and keep accelerator plugins from registering so
+            # a wedged device tunnel can never take the server down with it
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+            server_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "mxnet_tpu.kvstore_server"],
+                env=env, shell=False))
         # gate on server health BEFORE spawning workers: a dead server
         # (EADDRINUSE from a stale run is the classic) must abort the
         # launch loudly, not leave workers dialing a wrong/stale server
         import socket as _socket
         import time as _time
         deadline = _time.time() + 30.0
-        while True:
-            if server.poll() is not None:
-                raise SystemExit(
-                    "dist_async parameter server exited rc=%d before "
-                    "accepting (stale server still on port %d?)"
-                    % (server.returncode, server_port))
-            try:
-                _socket.create_connection(("127.0.0.1", server_port),
-                                          timeout=1.0).close()
-                break
-            except OSError:
-                if _time.time() > deadline:
-                    server.terminate()
-                    raise SystemExit("dist_async parameter server did not "
-                                     "accept within 30s")
-                _time.sleep(0.2)
+        for sid, server in enumerate(server_procs):
+            port = server_port + sid
+            while True:
+                if server.poll() is not None:
+                    raise SystemExit(
+                        "dist_async parameter server %d exited rc=%d before "
+                        "accepting (stale server still on port %d?)"
+                        % (sid, server.returncode, port))
+                try:
+                    _socket.create_connection(("127.0.0.1", port),
+                                              timeout=1.0).close()
+                    break
+                except OSError:
+                    if _time.time() > deadline:
+                        for p in server_procs:
+                            p.terminate()
+                        raise SystemExit(
+                            "dist_async parameter server %d did not "
+                            "accept within 30s" % sid)
+                    _time.sleep(0.2)
         # the accepting socket could be a STALE server from a previous
         # run while ours is still dying of EADDRINUSE — let the bind
-        # settle and re-check our process actually owns the port
+        # settle and re-check our processes actually own the ports
         _time.sleep(1.0)
-        if server.poll() is not None:
-            raise SystemExit(
-                "dist_async parameter server exited rc=%d right after "
-                "startup — another server is likely holding port %d"
-                % (server.returncode, server_port))
+        for sid, server in enumerate(server_procs):
+            if server.poll() is not None:
+                raise SystemExit(
+                    "dist_async parameter server %d exited rc=%d right "
+                    "after startup — another server is likely holding "
+                    "port %d" % (sid, server.returncode, server_port + sid))
     procs = []
     for rank in range(n):
         env = dict(os.environ)
@@ -127,7 +135,8 @@ def main():
     parser.add_argument("--coordinator-port", type=int, default=12345)
     parser.add_argument("-s", "--num-servers", type=int, default=0,
                         help="parameter-server processes for dist_async "
-                             "(0 or 1; sync kvstores need none)")
+                             "(keys shard across all of them; sync "
+                             "kvstores need none)")
     parser.add_argument("--server-port", type=int, default=9091)
     parser.add_argument("--run-ssh", action="store_true",
                         help="actually exec over ssh instead of printing")
