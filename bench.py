@@ -492,7 +492,8 @@ def _phase_infer():
     for name, arr in exe.arg_dict.items():
         if name not in ("data", "softmax_label"):
             arr[:] = rng.normal(0, 0.01, arr.shape).astype(np.float32)
-    return {"img_per_sec": _timed_score_loop(exe, batch, 224, n_iter)}
+    return {"img_per_sec": _median3_cpu(
+        lambda: _timed_score_loop(exe, batch, 224, n_iter))}
 
 
 def _fused_train_ips(compute_dtype=None, batch=32, n_iter=None):
@@ -537,8 +538,22 @@ def _fused_train_ips(compute_dtype=None, batch=32, n_iter=None):
     return round(batch * n_iter / (time.time() - tic), 2)
 
 
+def _median3_cpu(measure):
+    """On the 1-core CPU fallback a single background wakeup (grind
+    probe, cron) skews any single timing by ±20% (measured — see
+    BENCH_HISTORY.md r5 bisect note). Re-measure twice after the
+    compile-paying first run and report the median; on TPU one
+    measurement stands (device timing is not preempted)."""
+    import jax
+    first = measure()
+    if jax.devices()[0].platform != "cpu":
+        return first
+    vals = sorted([first, measure(), measure()])
+    return vals[1]
+
+
 def _phase_train_fp32():
-    return {"train_img_per_sec": _fused_train_ips()}
+    return {"train_img_per_sec": _median3_cpu(_fused_train_ips)}
 
 
 def _phase_train_bf16():
@@ -575,9 +590,9 @@ def _phase_jax_baseline():
     sys.path.insert(0, _HERE)
     from tools import flax_baseline
     on_tpu = jax.devices()[0].platform != "cpu"
-    ips = flax_baseline.bench(
+    ips = _median3_cpu(lambda: flax_baseline.bench(
         batch=32, n_iter=15 if on_tpu else 2,
-        compute_dtype=jnp.bfloat16 if on_tpu else None)
+        compute_dtype=jnp.bfloat16 if on_tpu else None))
     return {"jax_train_img_per_sec": round(ips, 2),
             "jax_baseline_dtype": "bfloat16" if on_tpu else "float32"}
 

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Sparse operator micro-benchmarks (reference:
+benchmark/python/sparse/{sparse_op,dot,cast_storage,updater}.py).
+
+Synthetic data replaces the reference's downloaded LIBSVM corpora
+(zero-egress environment); densities and shapes default to the same
+regimes those corpora exercise. Timings follow the bench.py discipline:
+jit-warm first, block_until_ready-bounded, distinct inputs.
+
+Usage:
+  python benchmark/python/sparse/sparse_bench.py [--json]
+      [--rows 100000] [--cols 1000] [--density 0.01] [--repeat 5]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                "..", "..", ".."))
+
+import numpy as np
+
+
+def _setup():
+    import jax
+    import mxnet_tpu as mx
+    return jax, mx
+
+
+def _rand_csr(mx, rng, rows, cols, density):
+    nnz_per_row = max(1, int(cols * density))
+    indptr = np.arange(0, (rows + 1) * nnz_per_row, nnz_per_row,
+                       dtype=np.int64)
+    indices = rng.randint(0, cols, rows * nnz_per_row).astype(np.int64)
+    data = rng.uniform(-1, 1, rows * nnz_per_row).astype(np.float32)
+    return mx.nd.sparse.csr_matrix((data, indices, indptr),
+                                   shape=(rows, cols))
+
+
+def _timeit(fn, repeat):
+    import jax
+    jax.block_until_ready(fn())           # warm (compile)
+    tic = time.time()
+    for _ in range(repeat):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.time() - tic) / repeat
+
+
+def bench_dot(mx, rng, rows, cols, density, repeat):
+    """csr @ dense forward + dense^T fallback (reference dot.py)."""
+    csr = _rand_csr(mx, rng, rows, cols, density)
+    dense = mx.nd.array(rng.uniform(-1, 1, (cols, 64)).astype(np.float32))
+    t = _timeit(lambda: mx.nd.dot(csr, dense), repeat)
+    gflops = 2.0 * csr.data.shape[0] * 64 / 1e9
+    return {"csr_dot_ms": round(t * 1e3, 3),
+            "csr_dot_gflops": round(gflops / t, 3)}
+
+
+def bench_cast_storage(mx, rng, rows, cols, density, repeat):
+    """dense <-> sparse conversions (reference cast_storage.py)."""
+    d = rng.uniform(0, 1, (rows // 10, cols)).astype(np.float32)
+    d[d > density * 10] = 0
+    nd = mx.nd.array(d)
+    t_csr = _timeit(lambda: nd.tostype("csr"), repeat)
+    t_rsp = _timeit(lambda: nd.tostype("row_sparse"), repeat)
+    csr = nd.tostype("csr")
+    t_back = _timeit(lambda: csr.todense(), repeat)
+    return {"cast_dense_to_csr_ms": round(t_csr * 1e3, 3),
+            "cast_dense_to_rsp_ms": round(t_rsp * 1e3, 3),
+            "cast_csr_to_dense_ms": round(t_back * 1e3, 3)}
+
+
+def bench_sparse_updater(mx, rng, rows, cols, repeat):
+    """row_sparse SGD/Adam lazy updates vs dense (reference updater.py)."""
+    out = {}
+    weight = mx.nd.array(rng.normal(0, 1, (rows, cols)).astype(np.float32))
+    n_rows = max(1, rows // 100)
+    rows_idx = np.unique(rng.randint(0, rows, n_rows)).astype(np.int64)
+    vals = rng.normal(0, 1, (len(rows_idx), cols)).astype(np.float32)
+    rsp = mx.nd.sparse.row_sparse_array((vals, rows_idx),
+                                        shape=(rows, cols))
+    dense_grad = mx.nd.array(np.zeros((rows, cols), np.float32))
+    for name in ("sgd", "adam"):
+        opt = mx.optimizer.create(name, learning_rate=0.01)
+        state = opt.create_state(0, weight)
+        t_sparse = _timeit(
+            lambda: opt.update(0, weight, rsp, state) or weight._data,
+            repeat)
+        state = opt.create_state(0, weight)
+        t_dense = _timeit(
+            lambda: opt.update(0, weight, dense_grad, state) or weight._data,
+            repeat)
+        out["%s_rsp_update_ms" % name] = round(t_sparse * 1e3, 3)
+        out["%s_dense_update_ms" % name] = round(t_dense * 1e3, 3)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=100000)
+    ap.add_argument("--cols", type=int, default=1000)
+    ap.add_argument("--density", type=float, default=0.01)
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    jax, mx = _setup()
+    rng = np.random.RandomState(0)
+    results = {"platform": jax.devices()[0].platform,
+               "rows": args.rows, "cols": args.cols,
+               "density": args.density}
+    results.update(bench_dot(mx, rng, args.rows, args.cols, args.density,
+                             args.repeat))
+    results.update(bench_cast_storage(mx, rng, args.rows, args.cols,
+                                      args.density, args.repeat))
+    results.update(bench_sparse_updater(mx, rng, args.rows // 10,
+                                        args.cols, args.repeat))
+    if args.json:
+        print(json.dumps(results))
+    else:
+        for k, v in results.items():
+            print("%-26s %s" % (k, v))
+
+
+if __name__ == "__main__":
+    main()

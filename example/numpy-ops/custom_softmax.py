@@ -1,0 +1,89 @@
+"""CustomOp in Python: a hand-written softmax loss layer (reference:
+example/numpy-ops/custom_softmax.py — mx.operator.CustomOp + CustomOpProp
+registered as 'softmax', trained inside a normal Module graph).
+
+The runtime mechanics being exercised: a Python-defined op participates
+in the SYMBOLIC graph (shape inference, forward, custom backward) via
+`jax.pure_callback` + `custom_vjp`, while the rest of the graph still
+compiles to XLA around it.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        label = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy()
+        y[np.arange(label.shape[0]), label] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@mx.operator.register("custom_softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+def get_net():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.Custom(fc2, label, op_type="custom_softmax",
+                         name="softmax")
+
+
+def train(epochs=20, batch_size=32, n=512):
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (n, 10)).astype(np.float32)
+    w = rng.normal(0, 1, (10, 4)).astype(np.float32)
+    y = X.dot(w).argmax(axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch_size, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(get_net(), context=mx.tpu(0),
+                        label_names=("softmax_label",))
+    metric = mx.metric.Accuracy()
+    mod.fit(it, num_epoch=epochs, eval_metric=metric, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(batch_size, 10))
+    return metric.get()[1]
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=20)
+    args = ap.parse_args()
+    acc = train(args.epochs)
+    print("final accuracy: %.3f" % acc)

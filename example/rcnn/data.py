@@ -1,0 +1,163 @@
+"""Synthetic detection data + RPN anchor targets for the RCNN example.
+
+The iterator plays the role of the reference's AnchorLoader
+(example/rcnn/rcnn/core/loader.py): it serves (data, im_info, gt_boxes)
+plus per-anchor RPN training targets (label / bbox_target / bbox_weight)
+computed in numpy against the SAME anchor enumeration the
+`_contrib_Proposal` op decodes — imported from the op module so the two
+can never drift apart.
+
+Scenes are learnable colored rectangles (class encoded in the painted
+channel/shade), the same task family the SSD and detection-iterator
+examples use.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+from mxnet_tpu.ops.contrib_extra import _generate_anchors
+
+from symbol import FEATURE_STRIDE, NUM_ANCHORS, RATIOS, RPN_BATCH, SCALES
+
+
+def _all_anchors(height, width):
+    """[A*H*W, 4] in the op's (y, x, a) -> reshaped (a,y,x) layouts; we
+    produce (A, H, W, 4) so callers pick the layout they need."""
+    base = _generate_anchors(SCALES, RATIOS, FEATURE_STRIDE)  # [A, 4]
+    sx = np.arange(width) * FEATURE_STRIDE
+    sy = np.arange(height) * FEATURE_STRIDE
+    shift = np.stack([sx[None, :].repeat(height, 0),
+                      sy[:, None].repeat(width, 1),
+                      sx[None, :].repeat(height, 0),
+                      sy[:, None].repeat(width, 1)], axis=-1)  # [H, W, 4]
+    return base[:, None, None, :] + shift[None]               # [A, H, W, 4]
+
+
+def _iou_matrix(a, b):
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    iw = np.maximum(ix2 - ix1 + 1, 0)
+    ih = np.maximum(iy2 - iy1 + 1, 0)
+    inter = iw * ih
+    aa = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    ab = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    return inter / (aa[:, None] + ab[None, :] - inter)
+
+
+def assign_rpn_targets(gt, fh, fw, im_size, rng,
+                       pos_iou=0.7, neg_iou=0.3):
+    """Reference anchor-target rule (rcnn/core AnchorLoader): positives =
+    per-gt argmax anchors + anchors with IoU >= pos_iou; negatives =
+    IoU < neg_iou; rest ignored (-1); sampled to RPN_BATCH."""
+    anchors = _all_anchors(fh, fw)                       # [A, H, W, 4]
+    flat = anchors.reshape(-1, 4)                        # (a, y, x) order
+    inside = ((flat[:, 0] >= 0) & (flat[:, 1] >= 0)
+              & (flat[:, 2] < im_size) & (flat[:, 3] < im_size))
+    label = np.full(len(flat), -1, np.float32)
+    iou = _iou_matrix(flat, gt[:, :4])
+    best = iou.max(axis=1)
+    label[inside & (best < neg_iou)] = 0
+    label[inside & (best >= pos_iou)] = 1
+    for g in range(gt.shape[0]):                         # per-gt argmax
+        cand = np.where(inside)[0]
+        if len(cand):
+            label[cand[iou[cand, g].argmax()]] = 1
+    # subsample to RPN_BATCH (half positive at most)
+    pos = np.where(label == 1)[0]
+    neg = np.where(label == 0)[0]
+    if len(pos) > RPN_BATCH // 2:
+        label[rng.choice(pos, len(pos) - RPN_BATCH // 2, replace=False)] = -1
+        pos = np.where(label == 1)[0]
+    keep_neg = RPN_BATCH - len(pos)
+    if len(neg) > keep_neg:
+        label[rng.choice(neg, len(neg) - keep_neg, replace=False)] = -1
+    # bbox targets for positives, laid out (A, 4, H, W) -> (4A, H, W) to
+    # match rpn_bbox_pred's channel order in the Proposal decode
+    tgt = np.zeros((len(flat), 4), np.float32)
+    pos = np.where(label == 1)[0]
+    if len(pos):
+        b = flat[pos]
+        g = gt[iou[pos].argmax(axis=1), :4]
+        bw = b[:, 2] - b[:, 0] + 1
+        bh = b[:, 3] - b[:, 1] + 1
+        bcx = b[:, 0] + 0.5 * (bw - 1)
+        bcy = b[:, 1] + 0.5 * (bh - 1)
+        gw = g[:, 2] - g[:, 0] + 1
+        gh = g[:, 3] - g[:, 1] + 1
+        gcx = g[:, 0] + 0.5 * (gw - 1)
+        gcy = g[:, 1] + 0.5 * (gh - 1)
+        tgt[pos] = np.stack([(gcx - bcx) / bw, (gcy - bcy) / bh,
+                             np.log(gw / bw), np.log(gh / bh)], axis=1)
+    wgt = np.zeros_like(tgt)
+    wgt[label == 1] = 1.0
+    tgt = tgt.reshape(NUM_ANCHORS, fh, fw, 4).transpose(0, 3, 1, 2)
+    wgt = wgt.reshape(NUM_ANCHORS, fh, fw, 4).transpose(0, 3, 1, 2)
+    # label laid out (1, A*H, W): matches rpn_cls_score reshaped
+    # (1, 2A, H, W) -> (1, 2, A*H, W) with softmax over axis 1
+    return (label.reshape(1, NUM_ANCHORS * fh, fw),
+            tgt.reshape(1, 4 * NUM_ANCHORS, fh, fw),
+            wgt.reshape(1, 4 * NUM_ANCHORS, fh, fw))
+
+
+class SyntheticRCNNIter(DataIter):
+    """One image per batch (the reference RCNN batch unit), fixed scene
+    count per epoch, deterministic by seed."""
+
+    def __init__(self, num_classes=4, im_size=128, num_batches=16,
+                 max_objects=2, seed=0):
+        super().__init__(1)
+        self.num_classes = num_classes  # incl. background class 0
+        self.im_size = im_size
+        self.num_batches = num_batches
+        self.fh = self.fw = im_size // FEATURE_STRIDE
+        self._scenes = []
+        rng = np.random.RandomState(seed)
+        for _ in range(num_batches):
+            self._scenes.append(self._make_scene(rng, max_objects))
+        self._cur = 0
+        self.provide_data = [
+            DataDesc("data", (1, 3, im_size, im_size)),
+            DataDesc("im_info", (1, 3)),
+            DataDesc("gt_boxes", (max_objects, 5))]
+        self.provide_label = [
+            DataDesc("rpn_label", (1, NUM_ANCHORS * self.fh, self.fw)),
+            DataDesc("rpn_bbox_target",
+                     (1, 4 * NUM_ANCHORS, self.fh, self.fw)),
+            DataDesc("rpn_bbox_weight",
+                     (1, 4 * NUM_ANCHORS, self.fh, self.fw))]
+
+    def _make_scene(self, rng, max_objects):
+        s = self.im_size
+        img = np.full((1, 3, s, s), 0.05, np.float32)
+        gt = np.zeros((max_objects, 5), np.float32)
+        gt[:, 2] = -1.0  # invalid marker: x2 < x1 (ProposalTarget skips)
+        n = rng.randint(1, max_objects + 1)
+        for j in range(n):
+            cls = rng.randint(1, self.num_classes)  # 0 is background
+            w = rng.randint(s // 4, s // 2)
+            h = rng.randint(s // 4, s // 2)
+            x1 = rng.randint(0, s - w)
+            y1 = rng.randint(0, s - h)
+            shade = 0.3 + 0.7 * cls / self.num_classes
+            img[0, (cls - 1) % 3, y1:y1 + h, x1:x1 + w] = shade
+            gt[j] = [x1, y1, x1 + w - 1, y1 + h - 1, cls]
+        lab, tgt, wgt = assign_rpn_targets(
+            gt[:n], self.fh, self.fw, s, rng)
+        im_info = np.array([[s, s, 1.0]], np.float32)
+        return img, im_info, gt, lab, tgt, wgt
+
+    def reset(self):
+        self._cur = 0
+
+    def next(self):
+        if self._cur >= self.num_batches:
+            raise StopIteration
+        img, im_info, gt, lab, tgt, wgt = self._scenes[self._cur]
+        self._cur += 1
+        return DataBatch(
+            data=[mx.nd.array(img), mx.nd.array(im_info), mx.nd.array(gt)],
+            label=[mx.nd.array(lab), mx.nd.array(tgt), mx.nd.array(wgt)],
+            pad=0, provide_data=self.provide_data,
+            provide_label=self.provide_label)

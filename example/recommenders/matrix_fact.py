@@ -1,0 +1,72 @@
+"""Matrix factorization recommender (reference:
+example/recommenders/demo1-MF.ipynb + example/sparse/matrix_factorization
+— user/item Embedding -> dot -> L2 on observed ratings).
+
+Synthetic low-rank ratings replace MovieLens (zero-egress). The graph is
+the canonical embedding workload: two Embedding tables gathered per
+batch, fused into one XLA program; gradients to the tables are
+row-sparse by construction, exercising the lazy-update optimizer path.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def get_symbol(num_users, num_items, factor=16):
+    user = mx.sym.Variable("user")
+    item = mx.sym.Variable("item")
+    score = mx.sym.Variable("score")
+    u = mx.sym.Embedding(user, input_dim=num_users, output_dim=factor,
+                         name="user_embed")
+    v = mx.sym.Embedding(item, input_dim=num_items, output_dim=factor,
+                         name="item_embed")
+    pred = mx.sym.sum(u * v, axis=1)
+    return mx.sym.LinearRegressionOutput(pred, label=score, name="lro")
+
+
+def make_ratings(num_users, num_items, n_obs, factor=4, seed=0):
+    rng = np.random.RandomState(seed)
+    U = rng.normal(0, 1, (num_users, factor))
+    V = rng.normal(0, 1, (num_items, factor))
+    users = rng.randint(0, num_users, n_obs)
+    items = rng.randint(0, num_items, n_obs)
+    scores = ((U[users] * V[items]).sum(1)
+              + rng.normal(0, 0.1, n_obs)).astype(np.float32)
+    return (users.astype(np.float32), items.astype(np.float32), scores)
+
+
+def train(num_users=200, num_items=150, n_obs=8192, factor=16,
+          epochs=10, batch_size=256, lr=0.05):
+    users, items, scores = make_ratings(num_users, num_items, n_obs)
+    it = mx.io.NDArrayIter({"user": users, "item": items},
+                           {"score": scores},
+                           batch_size=batch_size, shuffle=True)
+    mod = mx.mod.Module(get_symbol(num_users, num_items, factor),
+                        context=mx.tpu(0),
+                        data_names=("user", "item"),
+                        label_names=("score",))
+    metric = mx.metric.MSE()
+    mod.fit(it, num_epoch=epochs, eval_metric=metric, optimizer="adam",
+            optimizer_params={"learning_rate": lr},
+            initializer=mx.init.Normal(0.1),
+            batch_end_callback=mx.callback.Speedometer(batch_size, 16))
+    return metric.get()[1]
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--factor", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=256)
+    args = ap.parse_args()
+    mse = train(factor=args.factor, epochs=args.epochs,
+                batch_size=args.batch_size)
+    print("final mse: %.4f" % mse)

@@ -144,6 +144,19 @@ def invoke_op(opdef, inputs, attrs, rng=None):
     Returns (outputs, aux_updates); aux updates are written back by the caller.
     """
     params = opdef.make_params(dict(attrs)) if attrs or opdef.param_cls else opdef.make_params({})
+    # storage-type dispatch (reference: FComputeEx vs dense-fallback
+    # selection in the imperative invoke): sparse operands either route
+    # to an op-specific sparse kernel or densify before the generic path
+    # — the generic path only sees `_data` and would silently operate on
+    # a CSR's VALUES vector otherwise
+    from .ndarray import sparse as _sp
+    if any(isinstance(a, _sp.BaseSparseNDArray) for a in inputs):
+        if opdef.name == "dot":
+            return [_sp.dot(inputs[0], inputs[1],
+                            transpose_a=params.transpose_a,
+                            transpose_b=params.transpose_b)], []
+        inputs = [a.todense() if isinstance(a, _sp.BaseSparseNDArray)
+                  else a for a in inputs]
     is_train = _STATE.training
     if opdef.need_rng and rng is None:
         from . import random as _rnd

@@ -416,16 +416,20 @@ class KVStoreDistAsync(KVStore):
         try:
             for s, msg in calls:
                 _send_msg(self._socks[s], msg)
-            replies = []
+            # drain EVERY reply before raising: leaving an unread reply in
+            # a socket buffer desyncs that connection's request/reply
+            # protocol for good (the next RPC would read this stale one)
+            replies, errors = [], []
             for s, _ in calls:
                 reply = _recv_msg(self._socks[s])
                 if reply is None:
-                    raise MXNetError(
-                        "dist_async server %d closed the connection" % s)
-                if reply[0] == "error":
-                    raise MXNetError("dist_async server %d: %s"
-                                     % (s, reply[1]))
-                replies.append(reply)
+                    errors.append("server %d closed the connection" % s)
+                elif reply[0] == "error":
+                    errors.append("server %d: %s" % (s, reply[1]))
+                else:
+                    replies.append(reply)
+            if errors:
+                raise MXNetError("dist_async " + "; ".join(errors))
             return replies
         finally:
             for s, _ in calls:

@@ -104,6 +104,23 @@ class NDArray:
             raise TypeError("len() of unsized object")
         return self.shape[0]
 
+    def __array__(self, dtype=None, copy=None):
+        """One-shot numpy conversion (np.asarray(nd), np_buf[:] = nd).
+        Without this, numpy falls back to the sequence protocol and
+        builds the array ELEMENT-wise — each element a separate jax
+        gather dispatch+compile, turning a (32, 4) copy into ~100
+        compiles. One asnumpy() is one device sync."""
+        if copy is False:
+            # NumPy 2 contract: copy=False must be zero-copy or raise,
+            # and device->host is always a copy
+            raise ValueError(
+                "NDArray -> numpy always copies (device memory); "
+                "np.asarray(nd, copy=False) cannot be satisfied")
+        a = self.asnumpy()
+        if dtype is not None:
+            a = a.astype(dtype, copy=False)
+        return a
+
     def __bool__(self):
         if self.size == 1:
             return bool(self.asnumpy().reshape(()))

@@ -355,29 +355,44 @@ def retain(rsp, indices):
                             ctx=rsp.context)
 
 
+def csr_dense_dot_fn(lhs, transpose_a=False):
+    """Pure jax fn rhs_data -> out_data for `csr x dense` (the CSR is a
+    captured constant — gradients flow to the DENSE operand, the case
+    that matters: features are data, weights are dense). Shared by
+    `dot` below and the eager storage dispatch (imperative.invoke_op),
+    which runs it through apply_fn so the autograd tape sees it."""
+    nnz = lhs._indices.shape[0]
+    n, m = lhs.shape
+    rows = (jnp.searchsorted(lhs._indptr, jnp.arange(nnz), side="right") - 1
+            if nnz else None)
+    vals, cols = lhs._data, lhs._indices
+
+    def fn(rhs_data):
+        k = rhs_data.shape[1]
+        if nnz == 0:
+            return jnp.zeros((m if transpose_a else n, k),
+                             dtype=rhs_data.dtype)
+        if transpose_a:
+            # out[m, k] = sum over nnz at (r, c): val * rhs[r, :] -> row c
+            contrib = vals[:, None] * rhs_data[rows]
+            return jnp.zeros((m, k),
+                             dtype=rhs_data.dtype).at[cols].add(contrib)
+        contrib = vals[:, None] * rhs_data[cols]
+        return jnp.zeros((n, k), dtype=rhs_data.dtype).at[rows].add(contrib)
+
+    return fn
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse-aware dot (reference: src/operator/tensor/dot-inl.h).
 
     csr x dense  -> dense        (FM forward)
     csr.T x dense -> row_sparse  (FM gradient path)
     """
-    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray):
-        nnz = lhs._indices.shape[0]
-        n, m = lhs.shape
-        if nnz == 0:
-            shape = (m, rhs.shape[1]) if transpose_a else (n, rhs.shape[1])
-            return _dense_zeros(shape, ctx=lhs.context, dtype=lhs.dtype)
-        rows = jnp.searchsorted(lhs._indptr, jnp.arange(nnz), side="right") - 1
-        vals = lhs._data
-        cols = lhs._indices
-        if transpose_a:
-            # out[m, k] = sum over nnz at (r, c): val * rhs[r, :] scattered to row c
-            contrib = vals[:, None] * rhs._data[rows]
-            out = jnp.zeros((m, rhs.shape[1]), dtype=rhs.dtype).at[cols].add(contrib)
-            return NDArray(out, ctx=lhs.context)
-        contrib = vals[:, None] * rhs._data[cols]
-        out = jnp.zeros((n, rhs.shape[1]), dtype=rhs.dtype).at[rows].add(contrib)
-        return NDArray(out, ctx=lhs.context)
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray) \
+            and not transpose_b:
+        from ..imperative import apply_fn
+        return apply_fn(csr_dense_dot_fn(lhs, transpose_a), [rhs])[0]
     # dense fallback
     from . import dot as _dense_dot
     l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs

@@ -35,6 +35,10 @@ class CustomOp(object):
 
     def assign(self, dst, req, src):
         """reference semantics: honor the grad_req of the destination."""
+        if hasattr(src, "asnumpy") and isinstance(dst, _np.ndarray):
+            # user code passes NDArrays (reference style); land them in
+            # the host buffer with ONE device sync
+            src = src.asnumpy()
         if req == "null":
             return
         elif req in ("write", "inplace"):
@@ -94,7 +98,10 @@ class _SimpleArray(_np.ndarray):
     """numpy view that also answers .asnumpy() (user code may call either)."""
 
     def asnumpy(self):
-        return _np.asarray(self)
+        # a COPY, like the real NDArray.asnumpy (device->host always
+        # copies): reference-era op code freely mutates the result, and
+        # callback input buffers are read-only
+        return _np.array(self)
 
 
 def _wrap(arr):

@@ -364,3 +364,49 @@ def test_feedforward_fit_predict_save_load(tmp_path):
     model2 = mx.FeedForward.load(prefix, 1, ctx=mx.cpu())
     preds2 = model2.predict(X)
     np.testing.assert_allclose(preds, preds2, atol=1e-5)
+
+
+def test_ndarray_numpy_protocol():
+    """np.asarray(nd) converts in ONE device sync via __array__ — the
+    sequence-protocol fallback compiled one gather per ELEMENT (found
+    via a CustomOp assigning an NDArray into a numpy buffer)."""
+    nd = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    a = np.asarray(nd)
+    np.testing.assert_array_equal(a, nd.asnumpy())
+    a16 = np.asarray(nd, dtype=np.float16)
+    assert a16.dtype == np.float16
+    buf = np.zeros((3, 4), np.float32)
+    buf[:] = nd  # the CustomOp.assign shape of the same bug
+    np.testing.assert_array_equal(buf, nd.asnumpy())
+
+
+def test_custom_op_ndarray_assign_and_mutable_asnumpy():
+    """Reference-style CustomOp code: assigns NDArrays into out/grad
+    buffers and mutates asnumpy() results (which must be copies — the
+    callback input buffers are read-only)."""
+    class NdStyle(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        mx.nd.array(in_data[0].asnumpy() * 2.0))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            g = out_data[0].asnumpy()   # must be mutable (a copy)
+            g *= 0.0
+            g += 2.0 * out_grad[0].asnumpy()
+            self.assign(in_grad[0], req[0], mx.nd.array(g))
+
+    @mx.operator.register("test_nd_style")
+    class NdStyleProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return NdStyle()
+
+    x = mx.nd.array(np.ones((2, 3), np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.Custom(x, op_type="test_nd_style")
+        y.sum().backward()
+    np.testing.assert_allclose(y.asnumpy(), 2.0)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2.0)

@@ -28,6 +28,26 @@ def _free_port():
     return port
 
 
+def _free_consecutive_ports(n):
+    """Base port with ports base..base+n-1 all currently bindable (the
+    multi-server launcher assigns server i to server_port + i)."""
+    for _ in range(50):
+        base = _free_port()
+        try:
+            socks = []
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("", base + i))
+                socks.append(s)
+            for s in socks:
+                s.close()
+            return base
+        except OSError:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no %d consecutive free ports found" % n)
+
+
 @pytest.fixture()
 def server_env(monkeypatch):
     port = _free_port()
@@ -201,16 +221,7 @@ def test_async_push_composes_with_compression(server_env):
 def two_server_env(monkeypatch):
     """Two in-process servers on consecutive ports + the DMLC topology
     env (reference kvstore_dist.h:151 PSKV sharding scope)."""
-    base = _free_port()
-    # consecutive free ports: retry until base and base+1 both bind
-    for _ in range(20):
-        try:
-            s = socket.socket()
-            s.bind(("", base + 1))
-            s.close()
-            break
-        except OSError:
-            base = _free_port()
+    base = _free_consecutive_ports(2)
     servers = [AsyncParamServer(base + i, num_workers=1) for i in range(2)]
     threads = [threading.Thread(target=sv.serve, daemon=True)
                for sv in servers]
@@ -318,7 +329,7 @@ def test_two_worker_two_server_sharded_training(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     # force the (2, 6) FC weight over the big-array bound so it shards
     env["MXNET_KVSTORE_BIGARRAY_BOUND"] = "16"
-    port = _free_port()
+    port = _free_consecutive_ports(2)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", "2", "--num-servers", "2", "--server-port", str(port),

@@ -317,3 +317,28 @@ def test_sparse_negative_and_bad_indexing():
     rsp = mx.nd.sparse.row_sparse_array(d)
     np.testing.assert_array_equal(rsp[-10:3].asnumpy(), d[-10:3])
     assert rsp[4:2].shape[0] == 0  # empty, not negative
+
+
+def test_generic_nd_dot_sparse_dispatch_and_grad():
+    """mx.nd.dot on a CSR lhs routes to the sparse kernel (the generic
+    path would operate on the raw values vector), and gradients flow to
+    the DENSE operand through the autograd tape (a tape-bypass here once
+    produced silently-zero grads)."""
+    rng = np.random.RandomState(0)
+    dense_np = rng.uniform(-1, 1, (5, 4)).astype(np.float32)
+    dense_np[dense_np < 0] = 0
+    csr = sparse.csr_matrix(dense_np)
+    w = mx.nd.array(rng.uniform(-1, 1, (4, 3)).astype(np.float32))
+    out = mx.nd.dot(csr, w)
+    np.testing.assert_allclose(out.asnumpy(), dense_np.dot(w.asnumpy()),
+                               rtol=1e-5)
+    w.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.dot(csr, w)
+        y.sum().backward()
+    np.testing.assert_allclose(w.grad.asnumpy(),
+                               dense_np.sum(axis=0)[:, None]
+                               * np.ones((1, 3)), rtol=1e-5)
+    # non-dot ops with sparse operands densify (never the values vector)
+    s = mx.nd.sum(csr)
+    np.testing.assert_allclose(s.asnumpy(), dense_np.sum(), rtol=1e-5)

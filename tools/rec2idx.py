@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Regenerate the .idx file for an existing RecordIO file.
+
+Reference: tools/rec2idx.py (IndexCreator walking the .rec and emitting
+`key\\toffset` lines). Here the offsets come from one sequential scan of
+the container (multi-part records count once, at their first part — the
+same stitching `RecordIOReader::ScanOffsets` does natively); keys are the
+record ordinals unless the records carry IRHeader ids, which win.
+
+Usage: python tools/rec2idx.py data.rec [data.idx]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_recordio():
+    """mxnet_tpu.recordio without the package __init__ (no jax import —
+    a file tool must never touch an accelerator backend)."""
+    if "mxnet_tpu" in sys.modules:
+        from mxnet_tpu import recordio
+        return recordio
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "mxnet_tpu", "recordio.py")
+    spec = importlib.util.spec_from_file_location("_mxtpu_recordio", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def make_index(rec_path, idx_path=None, use_header_id=True):
+    recordio = _load_recordio()
+    idx_path = idx_path or os.path.splitext(rec_path)[0] + ".idx"
+    reader = recordio.MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as out:
+        while True:
+            pos = reader.tell()
+            raw = reader.read()
+            if raw is None:
+                break
+            key = n
+            if use_header_id and len(raw) >= struct.calcsize("<IfQQ"):
+                flag, _, rid, _ = struct.unpack_from("<IfQQ", raw)
+                # ids are only meaningful for image records (pack_img
+                # stamps them); raw payload records keep ordinals
+                if flag < 2 ** 20:
+                    key = int(rid)
+            out.write("%d\t%d\n" % (key, pos))
+            n += 1
+    reader.close()
+    return idx_path, n
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("record", help="path of the .rec file")
+    ap.add_argument("index", nargs="?", default=None,
+                    help="output .idx (default: alongside the .rec)")
+    ap.add_argument("--ordinal-keys", action="store_true",
+                    help="ignore IRHeader ids; key records 0..N-1")
+    args = ap.parse_args()
+    idx, n = make_index(args.record, args.index,
+                        use_header_id=not args.ordinal_keys)
+    print("wrote %d entries -> %s" % (n, idx))
+
+
+if __name__ == "__main__":
+    main()
