@@ -1,0 +1,138 @@
+"""REINFORCE policy gradient on CartPole (reference:
+example/reinforcement-learning/{a3c,dqn,parallel_actor_critic} — policy
+networks trained from environment rollouts; those use gym, unavailable
+here, so the classic cart-pole dynamics are implemented inline).
+
+Runtime surfaces exercised: stochastic policy sampling + log-prob loss
+through autograd, per-episode variable-length rollouts feeding
+fixed-shape batched updates (concatenate then one Trainer.step), reward
+normalization in numpy — the actor-critic family's training loop shape.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+
+class CartPole:
+    """Standard cart-pole dynamics (Barto/Sutton formulation)."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.RandomState(seed)
+        self.gravity = 9.8
+        self.mc, self.mp, self.length = 1.0, 0.1, 0.5
+        self.force_mag, self.dt = 10.0, 0.02
+        self.theta_max = 12 * np.pi / 180
+        self.x_max = 2.4
+        self.state = None
+
+    def reset(self):
+        self.state = self.rng.uniform(-0.05, 0.05, 4)
+        return self.state.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costh, sinth = np.cos(th), np.sin(th)
+        total_m = self.mc + self.mp
+        pm_l = self.mp * self.length
+        temp = (force + pm_l * thd ** 2 * sinth) / total_m
+        tha = (self.gravity * sinth - costh * temp) / (
+            self.length * (4.0 / 3.0 - self.mp * costh ** 2 / total_m))
+        xa = temp - pm_l * tha * costh / total_m
+        x, xd = x + self.dt * xd, xd + self.dt * xa
+        th, thd = th + self.dt * thd, thd + self.dt * tha
+        self.state = np.array([x, xd, th, thd])
+        done = (abs(x) > self.x_max) or (abs(th) > self.theta_max)
+        return self.state.copy(), 1.0, done
+
+
+def build_policy():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    return net
+
+
+def rollout(env, net, rng, max_steps=200):
+    states, actions, rewards = [], [], []
+    s = env.reset()
+    for _ in range(max_steps):
+        logits = net(mx.nd.array(s[None].astype(np.float32))).asnumpy()[0]
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        a = rng.choice(2, p=p)
+        states.append(s)
+        actions.append(a)
+        s, r, done = env.step(a)
+        rewards.append(r)
+        if done:
+            break
+    return np.array(states, np.float32), np.array(actions), rewards
+
+
+def returns(rewards, gamma=0.99):
+    out, g = np.zeros(len(rewards), np.float32), 0.0
+    for i in reversed(range(len(rewards))):
+        g = rewards[i] + gamma * g
+        out[i] = g
+    return out
+
+
+def train(episodes=300, lr=0.01, batch_episodes=8, seed=0):
+    env = CartPole(seed)
+    rng = np.random.RandomState(seed)
+    net = build_policy()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=True)
+    lengths = []
+    for ep0 in range(0, episodes, batch_episodes):
+        all_s, all_a, all_g = [], [], []
+        for _ in range(batch_episodes):
+            s, a, r = rollout(env, net, rng)
+            lengths.append(len(r))
+            all_s.append(s)
+            all_a.append(a)
+            all_g.append(returns(r))
+        S = np.concatenate(all_s)
+        A = np.concatenate(all_a).astype(np.float32)
+        G = np.concatenate(all_g)
+        G = (G - G.mean()) / (G.std() + 1e-6)   # variance reduction
+        # pad to ONE static shape so XLA compiles the update exactly once
+        # (variable rollout totals would otherwise recompile every batch);
+        # padded rows carry zero advantage = zero gradient
+        cap = 200 * batch_episodes
+        pad = cap - len(G)
+        S = np.pad(S, ((0, pad), (0, 0)))
+        A = np.pad(A, (0, pad))
+        G = np.pad(G, (0, pad))
+        with autograd.record():
+            logp = sce(net(mx.nd.array(S)), mx.nd.array(A))
+            loss = (logp * mx.nd.array(G)).mean()
+        loss.backward()
+        trainer.step(1)
+        if (ep0 // batch_episodes) % 5 == 0:
+            logging.info("episode %d mean-len %.1f", ep0 + batch_episodes,
+                         np.mean(lengths[-batch_episodes:]))
+    early = np.mean(lengths[:3 * batch_episodes])
+    late = np.mean(lengths[-3 * batch_episodes:])
+    print("mean episode length: %.1f -> %.1f" % (early, late))
+    return early, late
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--episodes", type=int, default=300)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+    train(args.episodes, args.lr)

@@ -35,13 +35,13 @@ class CustomOp(object):
 
     def assign(self, dst, req, src):
         """reference semantics: honor the grad_req of the destination."""
+        if req == "null":
+            return
         if hasattr(src, "asnumpy") and isinstance(dst, _np.ndarray):
             # user code passes NDArrays (reference style); land them in
             # the host buffer with ONE device sync
             src = src.asnumpy()
-        if req == "null":
-            return
-        elif req in ("write", "inplace"):
+        if req in ("write", "inplace"):
             dst[:] = src
         elif req == "add":
             dst[:] = dst[:] + src if hasattr(dst, "__getitem__") else dst + src

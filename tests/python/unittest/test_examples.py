@@ -336,3 +336,25 @@ def test_fcn_xs_segmentation():
     m = re.search(r"final pixel-acc: ([0-9.]+)", out)
     assert m, out[-2000:]
     assert float(m.group(1)) > 0.85, out[-1500:]
+
+
+def test_bi_lstm_sort():
+    """Bidirectional LSTM learns to sort token sequences (reference
+    example/bi-lstm-sort — needs context from both directions)."""
+    out = _run([os.path.join(EX, "bi-lstm-sort", "lstm_sort.py"),
+                "--epochs", "12"], timeout=1200)
+    m = re.search(r"final token-acc: ([0-9.]+)", out)
+    assert m, out[-2000:]
+    assert float(m.group(1)) > 0.8, out[-1500:]
+
+
+def test_reinforce_cartpole():
+    """REINFORCE policy gradient on inline cart-pole dynamics (reference
+    example/reinforcement-learning family): episode length grows."""
+    out = _run([os.path.join(EX, "reinforcement-learning",
+                             "reinforce_cartpole.py"),
+                "--episodes", "240"], timeout=1200)
+    m = re.search(r"mean episode length: ([0-9.]+) -> ([0-9.]+)", out)
+    assert m, out[-2000:]
+    early, late = float(m.group(1)), float(m.group(2))
+    assert late > early * 2, out[-1000:]

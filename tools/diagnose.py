@@ -108,8 +108,8 @@ def check_framework(timeout):
                                 timeout=10).stdout.strip()
         if commit:
             print("Commit Hash  :", commit)
-    except OSError:
-        pass
+    except (OSError, subprocess.SubprocessError):
+        pass  # a hung git must not kill the diagnostic report
 
 
 def check_network(timeout):

@@ -4,8 +4,9 @@
 Reference: tools/rec2idx.py (IndexCreator walking the .rec and emitting
 `key\\toffset` lines). Here the offsets come from one sequential scan of
 the container (multi-part records count once, at their first part — the
-same stitching `RecordIOReader::ScanOffsets` does natively); keys are the
-record ordinals unless the records carry IRHeader ids, which win.
+same stitching `RecordIOReader::ScanOffsets` does natively); keys are
+the record ordinals 0..N-1, or IRHeader ids with `--header-id-keys`
+(only valid for pack/pack_img records).
 
 Usage: python tools/rec2idx.py data.rec [data.idx]
 """
@@ -34,7 +35,11 @@ def _load_recordio():
     return mod
 
 
-def make_index(rec_path, idx_path=None, use_header_id=True):
+def make_index(rec_path, idx_path=None, use_header_id=False):
+    """use_header_id=True keys entries by IRHeader.id — ONLY correct for
+    records that actually carry an IRHeader (pack/pack_img); raw payload
+    records would have arbitrary bytes misread as ids, so ordinal keys
+    (0..N-1, always valid) are the default."""
     recordio = _load_recordio()
     idx_path = idx_path or os.path.splitext(rec_path)[0] + ".idx"
     reader = recordio.MXRecordIO(rec_path, "r")
@@ -46,12 +51,13 @@ def make_index(rec_path, idx_path=None, use_header_id=True):
             if raw is None:
                 break
             key = n
-            if use_header_id and len(raw) >= struct.calcsize("<IfQQ"):
-                flag, _, rid, _ = struct.unpack_from("<IfQQ", raw)
-                # ids are only meaningful for image records (pack_img
-                # stamps them); raw payload records keep ordinals
-                if flag < 2 ** 20:
-                    key = int(rid)
+            if use_header_id:
+                if len(raw) < struct.calcsize("<IfQQ"):
+                    raise ValueError(
+                        "record %d too short for an IRHeader; this .rec "
+                        "holds raw payloads — drop --header-id-keys" % n)
+                _, _, rid, _ = struct.unpack_from("<IfQQ", raw)
+                key = int(rid)
             out.write("%d\t%d\n" % (key, pos))
             n += 1
     reader.close()
@@ -63,11 +69,12 @@ def main():
     ap.add_argument("record", help="path of the .rec file")
     ap.add_argument("index", nargs="?", default=None,
                     help="output .idx (default: alongside the .rec)")
-    ap.add_argument("--ordinal-keys", action="store_true",
-                    help="ignore IRHeader ids; key records 0..N-1")
+    ap.add_argument("--header-id-keys", action="store_true",
+                    help="key by IRHeader.id (image records packed by "
+                         "pack_img) instead of ordinals 0..N-1")
     args = ap.parse_args()
     idx, n = make_index(args.record, args.index,
-                        use_header_id=not args.ordinal_keys)
+                        use_header_id=args.header_id_keys)
     print("wrote %d entries -> %s" % (n, idx))
 
 
