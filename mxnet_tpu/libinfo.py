@@ -3,7 +3,7 @@ from __future__ import annotations
 
 import os
 
-__version__ = "1.2.0.tpu"
+__version__ = "1.2.0+tpu"  # PEP 440 local version (pip metadata reads this)
 
 
 def find_lib_path():

@@ -333,3 +333,38 @@ def test_bench_end_to_end_banked_protocol(tmp_path):
     side = json.load(open(str(bench_dir / "BENCH_provisional.json")))
     assert side["value"] == 5000.0
     assert "provisional" not in side["extra"]
+
+
+def test_kill_job_lists_launch_processes():
+    """tools/kill_job.py finds processes carrying the launch.py env
+    markers (dry-run; nothing is killed)."""
+    import time
+    env = dict(os.environ, DMLC_ROLE="worker", JAX_PLATFORMS="cpu")
+    probe = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(30)"], env=env)
+    try:
+        # wait past fork->execve: /proc/<pid>/environ only shows the env
+        # once the child has exec'd (fixed sleeps flake under load)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                with open("/proc/%d/environ" % probe.pid, "rb") as f:
+                    if b"DMLC_ROLE" in f.read():
+                        break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "kill_job.py")],
+            capture_output=True, text=True, timeout=60).stdout
+        assert "would kill %d" % probe.pid in out, out
+        # --pattern path
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "kill_job.py"),
+             "--pattern", "time.sleep(30)"],
+            capture_output=True, text=True, timeout=60).stdout
+        assert str(probe.pid) in out, out
+        assert probe.poll() is None  # dry-run must not kill
+    finally:
+        probe.terminate()
+        probe.wait()

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Kill every process of a launch.py training job on this machine
+(reference: tools/kill-mxnet.py — pkill of stray workers/servers after a
+crashed distributed run).
+
+Matches processes whose environment carries the DMLC/JAX coordination
+variables `tools/launch.py` sets (workers, parameter servers), or whose
+command line matches --pattern. Dry-run by default; --force kills.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+_MARKERS = ("DMLC_ROLE", "JAX_COORDINATOR_ADDRESS")
+
+
+def job_processes(pattern=None):
+    """[(pid, cmdline)] of launch.py-spawned processes (not ourselves)."""
+    out = []
+    me = os.getpid()
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit() or int(pid_s) == me:
+            continue
+        pid = int(pid_s)
+        try:
+            with open("/proc/%d/environ" % pid, "rb") as f:
+                env_blob = f.read().decode("utf-8", "replace")
+            with open("/proc/%d/cmdline" % pid, "rb") as f:
+                cmd = f.read().decode("utf-8", "replace").replace("\0", " ")
+        except OSError:
+            continue  # raced exit or permission
+        if pattern is not None:
+            if pattern in cmd:
+                out.append((pid, cmd.strip()))
+            continue
+        # match variable NAMES, not a raw substring over the blob: a
+        # value that merely quotes "DMLC_ROLE=..." must not mark an
+        # unrelated process for killing
+        names = {entry.split("=", 1)[0]
+                 for entry in env_blob.split("\0") if "=" in entry}
+        if names & set(_MARKERS):
+            out.append((pid, cmd.strip()))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pattern", default=None,
+                    help="kill by command-line substring instead of the "
+                         "DMLC/JAX env markers")
+    ap.add_argument("--force", action="store_true",
+                    help="actually SIGTERM (default: list only)")
+    ap.add_argument("--signal", default="TERM",
+                    choices=["TERM", "KILL", "INT"])
+    args = ap.parse_args()
+    procs = job_processes(args.pattern)
+    if not procs:
+        print("no matching job processes")
+        return 0
+    sig = getattr(signal, "SIG" + args.signal)
+    failed = 0
+    for pid, cmd in procs:
+        print("%s %d  %.120s" % ("kill" if args.force else "would kill",
+                                 pid, cmd))
+        if args.force:
+            try:
+                os.kill(pid, sig)
+            except OSError as e:
+                print("  failed: %s" % e, file=sys.stderr)
+                failed += 1
+    return 1 if failed else 0  # surviving processes must fail the caller
+
+
+if __name__ == "__main__":
+    sys.exit(main())
