@@ -17,12 +17,30 @@ import sys
 _MARKERS = ("DMLC_ROLE", "JAX_COORDINATOR_ADDRESS")
 
 
+def _ancestors():
+    """This process's ancestor pids — killing the shell that invoked us
+    (its cmdline may quote the --pattern) must be impossible."""
+    out = set()
+    pid = os.getpid()
+    for _ in range(64):
+        out.add(pid)
+        try:
+            with open("/proc/%d/stat" % pid) as f:
+                pid = int(f.read().rsplit(") ", 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            break
+        if pid <= 1:
+            break
+    return out
+
+
 def job_processes(pattern=None):
-    """[(pid, cmdline)] of launch.py-spawned processes (not ourselves)."""
+    """[(pid, cmdline)] of launch.py-spawned processes (not ourselves
+    or our ancestors)."""
     out = []
-    me = os.getpid()
+    skip = _ancestors()
     for pid_s in os.listdir("/proc"):
-        if not pid_s.isdigit() or int(pid_s) == me:
+        if not pid_s.isdigit() or int(pid_s) in skip:
             continue
         pid = int(pid_s)
         try:
