@@ -358,3 +358,17 @@ def test_reinforce_cartpole():
     assert m, out[-2000:]
     early, late = float(m.group(1)), float(m.group(2))
     assert late > early * 2, out[-1000:]
+
+
+def test_ctc_speech_demo():
+    """Alignment-free CTC training (reference example/speech-demo +
+    warpctc): BiLSTM acoustic model learns latent alignments; greedy
+    decode recovers the token sequences."""
+    out = _run([os.path.join(EX, "speech-demo", "ctc_speech.py"),
+                "--epochs", "30"], timeout=1200)
+    m = re.search(r"ctc loss ([0-9.]+) -> ([0-9.]+), greedy seq-acc ([0-9.]+)",
+                  out)
+    assert m, out[-2000:]
+    first, last, acc = (float(m.group(i)) for i in (1, 2, 3))
+    assert last < first * 0.2, out[-1000:]
+    assert acc > 0.7, out[-1000:]
