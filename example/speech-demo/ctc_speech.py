@@ -87,6 +87,10 @@ def train(vocab=8, n_tokens=4, batch_size=32, epochs=30, lr=0.003,
     max_t = n_tokens * 7
     batches = [make_batch(rng, batch_size, n_tokens, vocab, max_t)
                for _ in range(num_batches)]
+    # stage the fixed dataset as NDArrays ONCE (the epoch loop reuses
+    # them; re-wrapping every step would re-copy identical host data)
+    nd_batches = [(mx.nd.array(X), mx.nd.array(Y), mx.nd.array(x_len))
+                  for X, Y, x_len in batches]
     net = AcousticModel(vocab)
     net.initialize(mx.init.Xavier())
     net.hybridize()
@@ -95,14 +99,13 @@ def train(vocab=8, n_tokens=4, batch_size=32, epochs=30, lr=0.003,
     first_loss = last_loss = None
     for epoch in range(epochs):
         tot = 0.0
-        for X, Y, x_len in batches:
-            x = mx.nd.array(X)
+        for x, y_nd, len_nd in nd_batches:
             with autograd.record():
                 act = net(x)                          # (B, T, vocab)
                 # ctc_loss wants (T, B, A) activations
                 loss = mx.nd.contrib.ctc_loss(
-                    mx.nd.transpose(act, (1, 0, 2)), mx.nd.array(Y),
-                    mx.nd.array(x_len), use_data_lengths=True,
+                    mx.nd.transpose(act, (1, 0, 2)), y_nd,
+                    len_nd, use_data_lengths=True,
                     blank_label="first").mean()
             loss.backward()
             trainer.step(1)
