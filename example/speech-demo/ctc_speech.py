@@ -115,11 +115,12 @@ def train(vocab=8, n_tokens=4, batch_size=32, epochs=30, lr=0.003,
         last_loss = tot
         if epoch % 5 == 0:
             logging.info("epoch %d ctc-loss %.3f", epoch, tot)
-    # sequence accuracy via greedy decode on the training set
+    # sequence accuracy via greedy decode on the training set (reuse the
+    # staged device arrays)
     correct = total = 0
-    for X, Y, x_len in batches[:2]:
-        act = net(mx.nd.array(X)).asnumpy()
-        for i in range(len(X)):
+    for (x, _, _), (_, Y, x_len) in list(zip(nd_batches, batches))[:2]:
+        act = net(x).asnumpy()
+        for i in range(len(Y)):
             dec = greedy_decode(act[i], x_len[i])
             correct += int(dec == list(Y[i].astype(int)))
             total += 1

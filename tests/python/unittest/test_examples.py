@@ -372,3 +372,14 @@ def test_ctc_speech_demo():
     first, last, acc = (float(m.group(i)) for i in (1, 2, 3))
     assert last < first * 0.2, out[-1000:]
     assert acc > 0.7, out[-1000:]
+
+
+def test_cnn_text_classification():
+    """Kim-style text CNN (parallel conv widths + max-over-time) learns a
+    planted-bigram sentiment task (reference
+    example/cnn_text_classification)."""
+    out = _run([os.path.join(EX, "cnn_text_classification", "text_cnn.py"),
+                "--epochs", "8"], timeout=1200)
+    m = re.search(r"final accuracy: ([0-9.]+)", out)
+    assert m, out[-2000:]
+    assert float(m.group(1)) > 0.9, out[-1500:]
