@@ -1,0 +1,123 @@
+"""Stochastic-depth residual training (reference:
+example/stochastic-depth/sd_cifar10.py — residual blocks randomly
+dropped during training with a linearly-decaying survival probability;
+at inference every block runs, scaled by its survival rate).
+
+TPU-idiomatic control flow: the reference mutates the graph per batch
+(death masks sampled in Python, separate executors); here each block's
+branch is multiplied by a Bernoulli gate drawn INSIDE the jitted
+program (`F.random.uniform(...) < p` on the graph's own RNG stream), so
+one compiled XLA program covers every depth configuration — no
+recompiles, no data-dependent Python control flow.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+
+class SDBlock(gluon.HybridBlock):
+    """Residual block whose branch survives with probability p during
+    training (drawn per forward pass) and is scaled by p at inference
+    (the reference's expectation-preserving rule)."""
+
+    def __init__(self, channels, survival_p, stride=1, **kw):
+        super().__init__(**kw)
+        self.p = float(survival_p)
+        self.body = gluon.nn.HybridSequential()
+        self.body.add(
+            gluon.nn.Conv2D(channels, 3, strides=stride, padding=1),
+            gluon.nn.BatchNorm(), gluon.nn.Activation("relu"),
+            gluon.nn.Conv2D(channels, 3, padding=1),
+            gluon.nn.BatchNorm())
+        self.down = None
+        if stride != 1:
+            self.down = gluon.nn.Conv2D(channels, 1, strides=stride)
+
+    def hybrid_forward(self, F, x):
+        shortcut = x if self.down is None else self.down(x)
+        branch = self.body(x)
+        if autograd.is_training():
+            # one Bernoulli draw per forward: the whole block's branch
+            # lives or dies together, inside the compiled program
+            gate = F.random.uniform(0, 1, shape=(1,)) < self.p
+            branch = F.broadcast_mul(branch, gate.astype("float32"))
+        else:
+            branch = branch * self.p
+        return F.Activation(shortcut + branch, act_type="relu")
+
+
+class SDNet(gluon.HybridBlock):
+    """Small residual net; survival probability decays linearly with
+    depth from 1.0 to `final_p` (the reference's schedule)."""
+
+    def __init__(self, num_classes=4, blocks=6, final_p=0.5, **kw):
+        super().__init__(**kw)
+        self.stem = gluon.nn.Conv2D(16, 3, padding=1)
+        self.features = gluon.nn.HybridSequential()
+        for i in range(blocks):
+            p = 1.0 - (1.0 - final_p) * (i + 1) / blocks
+            stride = 2 if i in (blocks // 3, 2 * blocks // 3) else 1
+            ch = 16 * (2 ** ((i >= blocks // 3) + (i >= 2 * blocks // 3)))
+            self.features.add(SDBlock(ch, p, stride=stride))
+        self.pool = gluon.nn.GlobalAvgPool2D()
+        self.head = gluon.nn.Dense(num_classes)
+
+    def hybrid_forward(self, F, x):
+        return self.head(self.pool(self.features(self.stem(x))))
+
+
+def make_data(n=512, size=24, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n)
+    X = rng.normal(0, 0.3, (n, 3, size, size)).astype(np.float32)
+    for i in range(n):  # class-coded blob
+        c = y[i]
+        X[i, c % 3, 4:4 + 4 * (c + 1) % size, 4:12] += 1.0
+    return X, y.astype(np.float32)
+
+
+def train(epochs=10, batch_size=64, blocks=6, final_p=0.5, lr=0.05):
+    X, y = make_data()
+    net = SDNet(blocks=blocks, final_p=final_p)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    n_batches = len(X) // batch_size
+    for epoch in range(epochs):
+        perm = np.random.RandomState(epoch).permutation(len(X))
+        tot = 0.0
+        for b in range(n_batches):
+            idx = perm[b * batch_size:(b + 1) * batch_size]
+            xb = mx.nd.array(X[idx])
+            yb = mx.nd.array(y[idx])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        logging.info("epoch %d loss %.3f", epoch, tot / n_batches)
+    # deterministic inference pass (blocks scaled by p, no sampling)
+    pred = net(mx.nd.array(X)).asnumpy().argmax(1)
+    acc = float((pred == y).mean())
+    print("train accuracy (deterministic inference): %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--blocks", type=int, default=6)
+    ap.add_argument("--final-p", type=float, default=0.5)
+    args = ap.parse_args()
+    train(epochs=args.epochs, blocks=args.blocks, final_p=args.final_p)

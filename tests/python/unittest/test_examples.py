@@ -383,3 +383,14 @@ def test_cnn_text_classification():
     m = re.search(r"final accuracy: ([0-9.]+)", out)
     assert m, out[-2000:]
     assert float(m.group(1)) > 0.9, out[-1500:]
+
+
+def test_stochastic_depth():
+    """Stochastic-depth residual training: per-batch Bernoulli block
+    gates INSIDE one jitted program, expectation-scaled inference
+    (reference example/stochastic-depth)."""
+    out = _run([os.path.join(EX, "stochastic-depth", "sd_resnet.py"),
+                "--epochs", "8"], timeout=1200)
+    m = re.search(r"deterministic inference\): ([0-9.]+)", out)
+    assert m, out[-2000:]
+    assert float(m.group(1)) > 0.9, out[-1500:]
