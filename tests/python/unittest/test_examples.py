@@ -394,3 +394,27 @@ def test_stochastic_depth():
     m = re.search(r"deterministic inference\): ([0-9.]+)", out)
     assert m, out[-2000:]
     assert float(m.group(1)) > 0.9, out[-1500:]
+
+
+def test_vae_reparameterization():
+    """VAE: in-graph reparameterized sampling (sample_normal), two-term
+    ELBO, prior generation (reference example/vae)."""
+    out = _run([os.path.join(EX, "vae", "vae.py"), "--epochs", "25"],
+               timeout=1200)
+    m = re.search(r"elbo ([0-9.]+) -> ([0-9.]+), sample-sharpness ([0-9.]+)",
+                  out)
+    assert m, out[-2000:]
+    first, last, sharp = (float(m.group(i)) for i in (1, 2, 3))
+    assert last < first * 0.6, out[-1000:]
+    assert sharp > 0.5, out[-1000:]
+
+
+def test_multi_task_two_heads():
+    """Shared trunk + two SoftmaxOutput heads trained jointly through one
+    fused program, per-task metrics (reference example/multi-task)."""
+    out = _run([os.path.join(EX, "multi-task", "multitask.py"),
+                "--epochs", "8"], timeout=900)
+    assert "fused train step active" in out, out[-2000:]  # tpu_sync path
+    m = re.search(r"final: acc-a=([0-9.]+) acc-b=([0-9.]+)", out)
+    assert m, out[-2000:]
+    assert float(m.group(1)) > 0.9 and float(m.group(2)) > 0.9, out[-800:]
