@@ -418,3 +418,31 @@ def test_multi_task_two_heads():
     m = re.search(r"final: acc-a=([0-9.]+) acc-b=([0-9.]+)", out)
     assert m, out[-2000:]
     assert float(m.group(1)) > 0.9 and float(m.group(2)) > 0.9, out[-800:]
+
+
+def test_profiler_demo():
+    """Profiler walkthrough: aggregate per-op table + chrome trace file
+    (reference example/profiler)."""
+    import json as _json
+    import tempfile
+    trace = os.path.join(tempfile.mkdtemp(), "trace.json")
+    out = _run([os.path.join(EX, "profiler", "profiler_demo.py"),
+                "--trace", trace], timeout=600)
+    assert "dot" in out and "Total Count" in out, out[-2000:]
+    events = _json.load(open(trace))["traceEvents"]
+    names = {e["name"] for e in events}
+    assert "matmul-phase" in names and "dot" in names, sorted(names)[:10]
+
+
+def test_bayesian_sgld():
+    """SGLD posterior sampling: ensemble accuracy high AND uncertainty
+    concentrated at the class overlap (reference
+    example/bayesian-methods)."""
+    out = _run([os.path.join(EX, "bayesian-methods", "sgld_logreg.py")],
+               timeout=900)
+    m = re.search(r"samples=(\d+) acc=([0-9.]+) unc\(near\)=([0-9.]+) "
+                  r"unc\(far\)=([0-9.]+)", out)
+    assert m, out[-2000:]
+    n, acc, near, far = (float(m.group(i)) for i in (1, 2, 3, 4))
+    assert n >= 10 and acc > 0.8, out[-800:]
+    assert near > 3 * far, out[-800:]  # uncertainty where classes overlap
