@@ -32,6 +32,10 @@ def make_data(n=600, seed=0):
 
 
 def train_sgld(X, y, epochs=120, lr=2e-3, burnin=60, thin=4):
+    if epochs <= burnin:
+        raise ValueError(
+            "epochs (%d) must exceed the burn-in (%d) or no posterior "
+            "samples are ever collected" % (epochs, burnin))
     net = gluon.nn.Dense(1)
     net.initialize(mx.init.Normal(0.5))
     trainer = gluon.Trainer(net.collect_params(), "sgld",
