@@ -1,0 +1,137 @@
+"""Deep Embedded Clustering (reference:
+example/deep-embedded-clustering/dec.py — pretrain an autoencoder,
+then fine-tune the encoder + learnable cluster centroids by sharpening
+the Student-t soft assignments against their own target distribution).
+
+Mechanics shown: a two-stage training workflow (reconstruction
+pretrain -> KL self-training), free centroids trained alongside the
+encoder via `attach_grad` + an explicit gradient step (the eager-tensor
+analog of the reference's centroid weight), and the periodic
+recomputation of the target distribution OUTSIDE the graph feeding a
+static-shape training step.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+
+def make_data(n=900, dim=32, clusters=3, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.normal(0, 2.2, (clusters, dim))
+    y = rng.randint(0, clusters, n)
+    X = (centers[y] + rng.normal(0, 0.5, (n, dim))).astype(np.float32)
+    return X, y
+
+
+class Encoder(gluon.HybridBlock):
+    def __init__(self, latent=4, **kw):
+        super().__init__(**kw)
+        self.net = gluon.nn.HybridSequential()
+        self.net.add(gluon.nn.Dense(64, activation="relu"),
+                     gluon.nn.Dense(latent))
+
+    def hybrid_forward(self, F, x):
+        return self.net(x)
+
+
+def soft_assign(z, mu, alpha=1.0):
+    """Student-t similarity q_ij (DEC eq. 1)."""
+    d2 = ((z.expand_dims(1) - mu.expand_dims(0)) ** 2).sum(axis=2)
+    q = (1 + d2 / alpha) ** (-(alpha + 1) / 2)
+    return q / q.sum(axis=1, keepdims=True)
+
+
+def target_distribution(q):
+    """p_ij = q^2/f normalized (DEC eq. 3) — sharpens confident
+    assignments; recomputed periodically in numpy."""
+    w = q ** 2 / q.sum(axis=0, keepdims=True)
+    return w / w.sum(axis=1, keepdims=True)
+
+
+def cluster_accuracy(pred, y):
+    """Best 1-1 label matching (greedy over the small confusion matrix)."""
+    k = max(pred.max(), y.max()) + 1
+    conf = np.zeros((k, k), np.int64)
+    for p, t in zip(pred, y):
+        conf[p, t] += 1
+    total = 0
+    used_p, used_t = set(), set()
+    for _ in range(k):
+        best = None
+        for i in range(k):
+            for j in range(k):
+                if i in used_p or j in used_t:
+                    continue
+                if best is None or conf[i, j] > conf[best[0], best[1]]:
+                    best = (i, j)
+        used_p.add(best[0])
+        used_t.add(best[1])
+        total += conf[best[0], best[1]]
+    return total / len(y)
+
+
+def train(clusters=3, latent=4, pretrain_epochs=30, dec_epochs=40,
+          update_interval=10, lr=0.003):
+    X, y = make_data(clusters=clusters)
+    Xn = mx.nd.array(X)
+    enc = Encoder(latent)
+    dec_head = gluon.nn.Dense(X.shape[1])
+    enc.initialize(mx.init.Xavier())
+    dec_head.initialize(mx.init.Xavier())
+
+    # stage 1: autoencoder pretraining (reconstruction)
+    tr = gluon.Trainer(dict(list(enc.collect_params().items())
+                            + list(dec_head.collect_params().items())),
+                       "adam", {"learning_rate": lr})
+    for epoch in range(pretrain_epochs):
+        with autograd.record():
+            recon = dec_head(enc(Xn))
+            loss = ((recon - Xn) ** 2).mean()
+        loss.backward()
+        tr.step(1)
+    logging.info("pretrain recon mse %.4f", float(loss.asnumpy()))
+
+    # k-means-style centroid init: means of the coarsest assignment
+    z = enc(Xn).asnumpy()
+    idx = np.argsort(z[:, 0])
+    mu0 = np.stack([z[chunk].mean(axis=0)
+                    for chunk in np.array_split(idx, clusters)])
+    mu = mx.nd.array(mu0)
+    mu.attach_grad()
+
+    # stage 2: KL(P || Q) self-training of encoder + centroids
+    dec_tr = gluon.Trainer(enc.collect_params(), "adam",
+                           {"learning_rate": lr})
+    for epoch in range(dec_epochs):
+        if epoch % update_interval == 0:
+            q_np = soft_assign(enc(Xn), mu).asnumpy()
+            p = mx.nd.array(target_distribution(q_np))
+        with autograd.record():
+            q = soft_assign(enc(Xn), mu)
+            kl = (p * (mx.nd.log(p + 1e-10)
+                       - mx.nd.log(q + 1e-10))).sum(axis=1).mean()
+        kl.backward()
+        dec_tr.step(1)
+        mu -= lr * 10 * mu.grad          # centroids: plain gradient step
+        mu.attach_grad()                 # re-arm after the in-place move
+    pred = soft_assign(enc(Xn), mu).asnumpy().argmax(axis=1)
+    acc = cluster_accuracy(pred, y)
+    print("cluster accuracy %.3f (kl %.4f)" % (acc, float(kl.asnumpy())))
+    return acc
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clusters", type=int, default=3)
+    ap.add_argument("--dec-epochs", type=int, default=40)
+    args = ap.parse_args()
+    train(clusters=args.clusters, dec_epochs=args.dec_epochs)

@@ -446,3 +446,14 @@ def test_bayesian_sgld():
     n, acc, near, far = (float(m.group(i)) for i in (1, 2, 3, 4))
     assert n >= 10 and acc > 0.8, out[-800:]
     assert near > 3 * far, out[-800:]  # uncertainty where classes overlap
+
+
+def test_deep_embedded_clustering():
+    """DEC two-stage workflow: AE pretrain -> KL self-training with
+    learnable centroids; recovers the planted clusters (reference
+    example/deep-embedded-clustering)."""
+    out = _run([os.path.join(EX, "deep-embedded-clustering", "dec.py")],
+               timeout=900)
+    m = re.search(r"cluster accuracy ([0-9.]+)", out)
+    assert m, out[-2000:]
+    assert float(m.group(1)) > 0.85, out[-800:]
