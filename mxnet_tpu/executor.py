@@ -230,7 +230,15 @@ class Executor:
                     outs, aux_upd = self._run_graph(all_args, aux_vals, rng, True)
                     return outs, aux_upd
                 if do_mirror:
-                    inner = jax.checkpoint(inner)
+                    # save matmul/conv outputs, rematerialize elementwise
+                    # chains in the backward — the reference's mirroring
+                    # recomputes exactly the activation-type ops. A bare
+                    # whole-graph checkpoint would re-run the matmuls too
+                    # (+1 full forward of FLOPs) without lowering the
+                    # peak any further.
+                    inner = jax.checkpoint(
+                        inner,
+                        policy=jax.checkpoint_policies.dots_saveable)
                 outs, vjp, aux_upd = jax.vjp(inner, grad_args, has_aux=True)
                 if out_grads is None:
                     seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
@@ -252,6 +260,35 @@ class Executor:
     # ------------------------------------------------------------------
     # public API (reference: executor.py forward/backward/outputs)
     # ------------------------------------------------------------------
+    def program_cost(self):
+        """Compile-time accounting for the fused forward+backward program:
+        {"flops", "peak_bytes", "temp_bytes"} from XLA's own cost/memory
+        analysis (peak_bytes is the headline — the peak live set incl.
+        activations) — chip-independent, no execution. Used by
+        example/memcost to measure the MXNET_BACKWARD_DO_MIRROR remat
+        trade exactly (the reference estimated it by watching
+        nvidia-smi)."""
+        arg_vals = {n: a._data for n, a in self.arg_dict.items()}
+        aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        # lowering consumes only shapes: never draw from the global RNG
+        # chain for it (that would shift later dropout masks)
+        rng = _rnd.fixed_key()
+        if self._grad_names:
+            grad_args = {n: arg_vals.pop(n) for n in self._grad_names}
+            lowered = self._fb_fn(False).lower(grad_args, arg_vals,
+                                               aux_vals, rng)
+        else:
+            lowered = self._fwd_fn(True).lower(arg_vals, aux_vals, rng)
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ma = lowered.compile().memory_analysis()
+        return {"flops": float(ca.get("flops", 0.0)),
+                # peak live set (activations included) — temp_size alone
+                # misses buffers XLA classifies as program outputs
+                "peak_bytes": float(getattr(ma, "peak_memory_in_bytes", 0)),
+                "temp_bytes": float(getattr(ma, "temp_size_in_bytes", 0))}
+
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k not in self.arg_dict:

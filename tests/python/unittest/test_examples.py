@@ -457,3 +457,19 @@ def test_deep_embedded_clustering():
     m = re.search(r"cluster accuracy ([0-9.]+)", out)
     assert m, out[-2000:]
     assert float(m.group(1)) > 0.85, out[-800:]
+
+
+def test_memcost_mirror_accounting():
+    """Executor.program_cost compiles the fused fwd+bwd under both
+    mirror settings and reports XLA's exact peak/FLOPs accounting
+    (reference example/memcost; remat = dots-saveable checkpoint)."""
+    out = _run([os.path.join(EX, "memcost", "mirror_memcost.py"),
+                "--depth", "8", "--width", "256", "--batch", "64"],
+               timeout=900)
+    m = re.search(r"mirroring: (-?\d+)% less peak memory for (-?\d+)% "
+                  r"more FLOPs", out)
+    assert m, out[-2000:]
+    assert "peak_bytes (MB)" in out and "flops (GFLOP)" in out
+    # remat may be a wash on a given model, but can never GROW the peak
+    # or SHRINK the FLOPs
+    assert int(m.group(1)) >= 0 and int(m.group(2)) >= 0, out[-800:]
