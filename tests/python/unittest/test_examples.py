@@ -473,3 +473,23 @@ def test_memcost_mirror_accounting():
     # remat may be a wash on a given model, but can never GROW the peak
     # or SHRINK the FLOPs
     assert int(m.group(1)) >= 0 and int(m.group(2)) >= 0, out[-800:]
+
+
+def test_svm_mnist_both_hinges():
+    """SVMOutput (squared + L1 hinge) trains a real Module classifier
+    (reference example/svm_mnist)."""
+    for extra in ([], ["--use-linear"]):
+        out = _run([os.path.join(EX, "svm_mnist", "svm_mnist.py"),
+                    "--epochs", "8"] + extra, timeout=900)
+        m = re.search(r"final accuracy: ([0-9.]+)", out)
+        assert m and float(m.group(1)) > 0.9, out[-800:]
+
+
+def test_rnn_time_major_layouts_agree():
+    """TNC and NTC fused-LSTM layouts learn the same task to the same
+    accuracy (reference example/rnn-time-major)."""
+    out = _run([os.path.join(EX, "rnn-time-major", "readme_tnc.py"),
+                "--epochs", "8"], timeout=1200)
+    m = re.search(r"token-acc TNC=([0-9.]+) NTC=([0-9.]+)", out)
+    assert m, out[-2000:]
+    assert float(m.group(1)) > 0.9 and float(m.group(2)) > 0.9, out[-800:]
