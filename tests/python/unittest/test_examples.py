@@ -493,3 +493,25 @@ def test_rnn_time_major_layouts_agree():
     m = re.search(r"token-acc TNC=([0-9.]+) NTC=([0-9.]+)", out)
     assert m, out[-2000:]
     assert float(m.group(1)) > 0.9 and float(m.group(2)) > 0.9, out[-800:]
+
+
+def test_captcha_multi_digit():
+    """Four digit heads over one conv trunk, sequence-level accuracy —
+    ALL positions must match (reference example/captcha)."""
+    out = _run([os.path.join(EX, "captcha", "cnn_ocr.py"),
+                "--epochs", "8"], timeout=1200)
+    m = re.search(r"final seq-acc: ([0-9.]+)", out)
+    assert m, out[-2000:]
+    assert float(m.group(1)) > 0.85, out[-800:]
+
+
+def test_lstnet_beats_naive_forecast():
+    """LSTNet-style conv+GRU+AR-highway forecaster beats the naive
+    last-value baseline at horizon 3 (reference
+    example/multivariate_time_series)."""
+    out = _run([os.path.join(EX, "multivariate_time_series", "lstnet.py"),
+                "--epochs", "12"], timeout=1200)
+    m = re.search(r"test rmse ([0-9.]+) vs naive last-value ([0-9.]+)", out)
+    assert m, out[-2000:]
+    rmse, naive = float(m.group(1)), float(m.group(2))
+    assert rmse < naive * 0.7, out[-800:]
