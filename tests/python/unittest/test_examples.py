@@ -515,3 +515,17 @@ def test_lstnet_beats_naive_forecast():
     assert m, out[-2000:]
     rmse, naive = float(m.group(1)), float(m.group(2))
     assert rmse < naive * 0.7, out[-800:]
+
+
+def test_dsd_schedule():
+    """Dense-Sparse-Dense: magnitude pruning holds exactly the target
+    sparsity through the S phase, and accuracy survives every phase
+    (reference example/dsd)."""
+    out = _run([os.path.join(EX, "dsd", "dsd_train.py"),
+                "--sparsity", "0.6"], timeout=900)
+    m = re.search(r"acc dense=([0-9.]+) sparse=([0-9.]+) "
+                  r"redense=([0-9.]+) \(zeros ([0-9.]+)\)", out)
+    assert m, out[-2000:]
+    d1, s, d2, z = (float(m.group(i)) for i in (1, 2, 3, 4))
+    assert min(d1, s, d2) > 0.9, out[-800:]
+    assert 0.55 <= z <= 0.65, out[-800:]  # mask really held
