@@ -37,7 +37,7 @@ PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy
     "infer": 900, "train_fp32": 800, "train_bf16": 600,
     "jax_baseline": 700, "flash": 700, "io_train": 600,
     "infer_int8": 600, "train_big_batch": 900, "flash_parity": 500,
-    "cost": 600,
+    "cost": 600, "serving": 600,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -239,11 +239,20 @@ def main():
     #    call itself? (device.platform name matters for the Pallas gate)
     force_cpu = False
     probe, err = _run_child("probe", False, PROBE_TIMEOUT_S)
-    if probe is None:  # one retry — init failures are often transient
+    if probe is None and "timeout" not in (err or ""):
+        # FAST failure (rc!=0 crash) is often transient — one retry. A
+        # TIMEOUT means the backend is hung (rounds 4-5 burned 150s on two
+        # identical 75s waits); a second wait buys nothing, so fail
+        # straight into the CPU/banked path instead.
         probe, err2 = _run_child("probe", False, PROBE_TIMEOUT_S)
         if probe is None:
-            errors.append("probe: %s; retry: %s" % (err, err2))
-            force_cpu = True
+            err = "%s; retry: %s" % (err, err2)
+    if probe is None:
+        # an unusable accelerator is an OUTCOME of this run (recorded as
+        # probe_status, with CPU/banked figures standing in), not an error
+        # in it — keep `errors` for phases that failed to produce evidence
+        extra["probe_status"] = "%s -> cpu/banked fallback" % err
+        force_cpu = True
     if probe is not None:
         extra["platform"] = probe.get("platform", "unknown")
         extra["device_kind"] = probe.get("device_kind", "")
@@ -294,7 +303,7 @@ def main():
     # 2) measurement phases, each in its own budgeted child
     phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
               "io_train", "infer_int8", "train_big_batch", "flash_parity",
-              "cost"]
+              "cost", "serving"]
     # phases that measure nothing useful on the CPU fallback (outage
     # removals — unlike explicit_skips, the bank may still supply them)
     cpu_useless = {"train_bf16", "train_big_batch", "flash_parity"}
@@ -399,7 +408,7 @@ def main():
         extra.update(_host_stamp())
     for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
                   "io_train", "infer_int8", "train_big_batch",
-                  "flash_parity", "cost"):
+                  "flash_parity", "cost", "serving"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
                       if not k.startswith("_")})
     # mixed-platform runs (partial rescue): say which metric ran where.
@@ -837,6 +846,138 @@ def _phase_cost():
     return out
 
 
+def _phase_serving():
+    """Mixed-trace serving throughput through the serving subsystem
+    (mxnet_tpu/serving/): individual requests with batch sizes 1..32 are
+    queued async, the dynamic micro-batcher coalesces them into full
+    buckets, and every dispatch hits a pre-compiled (warmup) XLA program
+    with donated input buffers on TPU. The honest yardstick is measured in
+    the SAME child: a plain pre-staged batch-32 executor loop over the
+    same number of images (`serving_plain_b32_img_per_sec`) — bucketing +
+    padding + coalescing must sustain >= it (`serving_vs_plain`)."""
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.serving import InferenceEngine
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+    side = 224 if on_tpu else 64
+    layers = 50 if on_tpu else 18
+    # CPU fallback: a single bucket keeps the phase deterministic (every
+    # coalesced group pads to 32 — no surprise mid-trace compiles on the
+    # 1-core host); TPU warms the full production bucket ladder
+    buckets = (1, 4, 8, 16, 32) if on_tpu else (32,)
+    sym = resnet.get_symbol(num_classes=1000, num_layers=layers,
+                            image_shape="3,%d,%d" % (side, side))
+    rng = np.random.RandomState(0)
+    arg_shapes, _, aux_shapes = sym.infer_shape(
+        data=(32, 3, side, side), softmax_label=(32,))
+    args = {n: mx.nd.array(rng.normal(0, 0.01, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    aux = {n: mx.nd.array(np.ones(s, np.float32) if "var" in n
+                          else np.zeros(s, np.float32))
+           for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    # CPU fallback: nproc=1, so the threaded worker only adds context-
+    # switch thrash against the single-threaded plain loop — drive the
+    # same coalesce/pad/dispatch path on the calling thread via flush()
+    eng = InferenceEngine(sym, args, aux, ctx=mx.tpu(0), buckets=buckets,
+                          max_batch=32, max_delay_ms=5.0,
+                          async_worker=on_tpu)
+    tic = time.time()
+    eng.warmup({"data": (32, 3, side, side)})
+    warmup_s = time.time() - tic
+
+    # mixed 1-32 request trace (deterministic shuffle of the size ladder)
+    trng = np.random.RandomState(7)
+    sizes = [1, 2, 4, 8, 16, 32]
+    trace = []
+    for _ in range(20 if on_tpu else 2):
+        trace.extend(int(s) for s in trng.permutation(sizes))
+    total_imgs = sum(trace)
+    pool = rng.uniform(-1, 1, (32, 3, side, side)).astype(np.float32)
+
+    def serve_once():
+        tic = time.time()
+        futs = [eng.predict_async({"data": pool[:n]}) for n in trace]
+        if not on_tpu:
+            eng.flush()  # single-threaded drain (async_worker=False above)
+        outs = [f.result_wait(PHASE_BUDGET_S["serving"]) for f in futs]
+        # futures resolve at dispatch (async device queue); the clock
+        # stops when every request's rows are actually computed — the
+        # same wait-at-end protocol as _timed_score_loop
+        jax.block_until_ready([o for out in outs for o in out])
+        return time.time() - tic
+
+    # same-child plain executor baseline, batch 32, same image count
+    exe = sym.simple_bind(mx.tpu(0), grad_req="null",
+                          data=(32, 3, side, side), softmax_label=(32,))
+    for name, arr in args.items():
+        arr.copyto(exe.arg_dict[name])
+    for name, arr in aux.items():
+        arr.copyto(exe.aux_dict[name])
+    n_iter = max(1, total_imgs // 32)
+
+    serve_once()  # warm the worker thread + any unwarmed remainder bucket
+    # this 1-core host's slow states last seconds-to-tens-of-seconds
+    # (BENCH_HISTORY r5), so the comparison interleaves MANY SHORT
+    # serve/plain pairs (alternating order so linear drift cancels) and
+    # takes the median of per-pair ratios
+    serve_rates, plain_rates, pair_ratios = [], [], []
+    for i in range(5 if not on_tpu else 1):
+        if i % 2 == 0:
+            s = total_imgs / serve_once()
+            p = _timed_score_loop(exe, 32, side, n_iter)
+        else:
+            p = _timed_score_loop(exe, 32, side, n_iter)
+            s = total_imgs / serve_once()
+        serve_rates.append(s)
+        plain_rates.append(p)
+        pair_ratios.append(s / p)
+    med = lambda v: sorted(v)[len(v) // 2]  # noqa: E731
+    st = eng.stats()
+    eng.stop()
+    out = {"serving_req_per_sec": round(
+               med(serve_rates) * len(trace) / total_imgs, 2),
+           "serving_img_per_sec": round(med(serve_rates), 2),
+           "serving_plain_b32_img_per_sec": round(med(plain_rates), 2),
+           # median of PER-PAIR ratios: each pair ran under the same host
+           # state, so drift cancels. Structurally this converges to ~1.0
+           # (the serving machinery costs <0.1% of a ResNet batch) —
+           # values off 1.0 beyond a few % are host noise, see
+           # _median3_cpu's provenance note
+           "serving_vs_plain": round(med(pair_ratios), 3),
+           "serving_warmup_s": round(warmup_s, 1),
+           "serving_compiles": st["compiles"],
+           "serving_batches": st["batches_run"],
+           "serving_padded_rows": st["padded_rows"]}
+
+    # the NAIVE mixed-trace baseline — what this traffic costs WITHOUT the
+    # serving engine: each request forwards individually through the bound
+    # executor, per-shape jit (the pre-serving predict path). Steady-state
+    # (first pass pays the per-size compiles and is excluded), so the
+    # ratio isolates coalescing + bucket reuse, not compile amortization.
+    def naive_once():
+        tic = time.time()
+        for n in trace:
+            exe.forward(is_train=False,
+                        data=mx.nd.array(pool[:n].copy()))
+        exe.outputs[0].wait_to_read()
+        return total_imgs / (time.time() - tic)
+
+    try:
+        naive_once()  # compile every distinct request size
+        naive = med([naive_once() for _ in range(3 if not on_tpu else 1)])
+        out["serving_naive_trace_img_per_sec"] = round(naive, 2)
+        out["serving_vs_naive"] = round(out["serving_img_per_sec"] / naive,
+                                        3)
+    except Exception as e:  # a failed baseline must not kill the phase
+        out["serving_naive_error"] = "%s: %s" % (type(e).__name__,
+                                                 str(e)[:120])
+    return out
+
+
 def _phase_io_train():
     """End-to-end input-pipeline + train throughput: synthetic JPEG .rec ->
     C++ ImageRecordIter (sharded read, threaded decode/augment, prefetch;
@@ -921,6 +1062,7 @@ PHASES = {
     "train_big_batch": _phase_train_big_batch,
     "flash_parity": _phase_flash_parity,
     "cost": _phase_cost,
+    "serving": _phase_serving,
 }
 
 

@@ -64,6 +64,8 @@ kv = kvstore  # reference alias: mx.kv.create(...)
 
 from . import module
 from . import module as mod
+from . import serving
+from .serving import InferenceEngine
 from . import model
 from .model import save_checkpoint, load_checkpoint, FeedForward
 from . import gluon

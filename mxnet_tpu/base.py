@@ -17,7 +17,8 @@ integer_types = (int, _np.integer)
 
 __all__ = [
     "MXNetError", "NotSupportedForSparseNDArray", "Params", "param_field",
-    "get_env", "env_flag", "string_types", "numeric_types", "integer_types",
+    "get_env", "env_flag", "configure_compile_cache", "string_types",
+    "numeric_types", "integer_types",
 ]
 
 
@@ -88,6 +89,53 @@ def get_env(name, default=None, typ=str):
 
 def env_flag(name, default=False):
     return get_env(name, default, bool)
+
+
+_compile_cache_state = {"configured": False, "dir": None}
+
+
+def configure_compile_cache():
+    """Wire `MXNET_TPU_COMPILE_CACHE` into JAX's persistent compilation
+    cache (docs/faq/env_var.md). When the variable names a directory, XLA
+    executables — including every serving bucket program — are persisted
+    there so cold-start compile cost survives process restarts: a warmed
+    serving engine's re-warmup after redeploy becomes a disk read.
+
+    Idempotent and safe to call from any number of entry points (serving
+    program cache, Executor.warmup); explicit JAX_COMPILATION_CACHE_DIR /
+    prior jax.config settings win, mirroring how the reference's env knobs
+    defer to more specific configuration. Returns the active cache dir or
+    None."""
+    if _compile_cache_state["configured"]:
+        return _compile_cache_state["dir"]
+    _compile_cache_state["configured"] = True
+    path = get_env("MXNET_TPU_COMPILE_CACHE")
+    if not path:
+        return None
+    import jax
+    try:
+        current = jax.config.jax_compilation_cache_dir
+    except AttributeError:  # pragma: no cover - very old jax
+        return None
+    if current:  # user already pointed jax at a cache; don't fight it
+        _compile_cache_state["dir"] = current
+        return current
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # cache genuinely off
+        return None
+    # serving bucket programs are small and fast-compiling relative to
+    # train steps; cache them all so warmup hits disk, not XLA. On a jax
+    # without these tuning knobs the cache is STILL ON (dir was set above)
+    # with that jax's default thresholds — the return value must say so.
+    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0),
+                     ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            pass
+    _compile_cache_state["dir"] = path
+    return path
 
 
 def atomic_write(fname, data, mode="wb"):

@@ -2,10 +2,13 @@
 set_bulk_size).
 
 The reference batches small async-engine ops into bulks to cut dispatch
-overhead. There is no engine here — whole graphs compile into single XLA
-programs, which IS the bulk — so these knobs keep their API contract
-(returning the previous size, scoping correctly) while the real batching
-decision lives with the compiler."""
+overhead. Whole graphs here compile into single XLA programs — which IS the
+bulk — so for training these knobs keep only their API contract. For
+SERVING the bulk size is live again: it caps how many queued inference
+requests the dynamic micro-batcher (serving/batcher.py) coalesces into one
+executable call, the direct analog of how many engine ops fused into one
+dispatch. 0 (the default) means "no user preference" and the batcher falls
+back to its largest bucket."""
 from __future__ import annotations
 
 import contextlib
@@ -15,11 +18,19 @@ _bulk_size = 0
 
 def set_bulk_size(size):
     """Set the bulk-execution cap; returns the previous value (reference
-    engine.py set_bulk_size). Advisory under XLA: fusion already bulks
-    every traced program."""
+    engine.py set_bulk_size). Consumed by the serving micro-batcher as its
+    default max coalesced batch; negative sizes are invalid."""
     global _bulk_size
-    prev, _bulk_size = _bulk_size, int(size)
+    size = int(size)
+    if size < 0:
+        raise ValueError("bulk size must be >= 0, got %d" % size)
+    prev, _bulk_size = _bulk_size, size
     return prev
+
+
+def current_bulk_size():
+    """The active bulk-execution cap (0 = no user preference)."""
+    return _bulk_size
 
 
 @contextlib.contextmanager

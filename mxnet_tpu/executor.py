@@ -64,6 +64,7 @@ class Executor:
                             if self._grad_req.get(n, "null") != "null"]
         self._outputs = None  # lazily materialized (see outputs property)
         self._cached = {}
+        self._aot = {}  # (is_train, shape-sig) -> AOT-compiled executable
         self._monitor_cb = None
         self._monitor_active = False
         self._pending_monitor = []
@@ -250,6 +251,69 @@ class Executor:
             self._cached[key] = jax.jit(f)
         return self._cached[key]
 
+    # ------------------------------------------------------------------
+    # AOT compilation (serving warmup path; reference analog: the bind-time
+    # memory planning that let reference executors serve with zero
+    # first-request overhead — here the cost being fronted is XLA compile)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _shape_sig(arg_vals, aux_vals, rng):
+        return (tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                             for n, v in arg_vals.items())),
+                tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                             for n, v in aux_vals.items())),
+                (tuple(rng.shape), str(rng.dtype)))
+
+    def warmup(self, is_train=False):
+        """Ahead-of-time compile the forward program for the BOUND shapes
+        via jit.lower(...).compile(), so the first forward() pays dispatch
+        only — no trace, no XLA compile. With MXNET_TPU_COMPILE_CACHE set
+        (base.configure_compile_cache) the compiled program also persists
+        across process restarts. Bucketed multi-shape warmup lives one
+        level up in serving/ (InferenceEngine.warmup); this entry point
+        covers the single bound shape. Returns self for chaining."""
+        from .base import configure_compile_cache
+        configure_compile_cache()
+        if self._group_shardings is not None:
+            return self  # sharded programs compile through the jit path
+        if self._ctx.jax_device != jax.devices()[0]:
+            # lowering from abstract shapes pins the DEFAULT device; an
+            # executor bound elsewhere would hit a committed-device
+            # mismatch on every forward — let jit specialize instead
+            return self
+        if is_train and self._grad_names:
+            # train-mode forward on a gradient-bound executor dispatches
+            # the fused fwd+bwd program (_fb_fn), which never consults
+            # the AOT table — compiling _fwd_fn(True) here would be a
+            # multi-second no-op
+            return self
+        arg_sds = {n: jax.ShapeDtypeStruct(a.shape, a._data.dtype)
+                   for n, a in self.arg_dict.items()}
+        aux_sds = {n: jax.ShapeDtypeStruct(a.shape, a._data.dtype)
+                   for n, a in self.aux_dict.items()}
+        rng = _rnd.fixed_key()
+        rng_sds = jax.ShapeDtypeStruct(rng.shape, rng.dtype)
+        key = (bool(is_train), self._shape_sig(arg_sds, aux_sds, rng_sds))
+        if key not in self._aot:
+            self._aot[key] = self._fwd_fn(bool(is_train)).lower(
+                arg_sds, aux_sds, rng_sds).compile()
+        return self
+
+    def has_compiled_forward(self, is_train=False):
+        """Whether a forward program for this mode has already been built
+        (jit wrapper exists => a forward ran and paid its compile). Part
+        of the executor's public surface so callers — Module's serving
+        router — need not poke the private jit-cache key format."""
+        return ("fwd", bool(is_train)) in self._cached
+
+    def _aot_lookup(self, is_train, arg_vals, aux_vals, rng):
+        if not self._aot or self._group_shardings is not None:
+            # mesh-sharded programs pin their own in_shardings; the AOT
+            # program was lowered for single-device placement
+            return None
+        return self._aot.get(
+            (bool(is_train), self._shape_sig(arg_vals, aux_vals, rng)))
+
     def _next_key(self):
         """Fresh PRNG key for stochastic graphs; the shared constant key
         for deterministic ones (jax.random.split costs ~150us of host
@@ -315,7 +379,11 @@ class Executor:
                                                       aux_vals, rng)
             self._pending_grads = grads
         else:
-            outs, aux_upd = self._fwd_fn(is_train)(arg_vals, aux_vals, rng)
+            # warmed executors dispatch straight into the AOT-compiled
+            # executable — no jit-cache lookup/trace on the serving path
+            aot = self._aot_lookup(is_train, arg_vals, aux_vals, rng)
+            fwd = aot if aot is not None else self._fwd_fn(is_train)
+            outs, aux_upd = fwd(arg_vals, aux_vals, rng)
             self._pending_grads = None
         if _profiling:
             jax.block_until_ready(outs)

@@ -276,7 +276,7 @@ class HybridBlock(Block):
         whole tree compiles into the parent's single XLA program.
         """
         inputs = (x,) + args
-        if _is_tracing():
+        if _is_tracing() or _symbol_tracing():
             return self._eager_forward_overridden(*inputs)
         try:
             if self._active:
@@ -394,6 +394,9 @@ class HybridBlock(Block):
         for attr, p in sorted(self._reg_params.items()):
             ov = _get_override(p.name)
             params[attr] = ov if ov is not None else p.data()
+        if _symbol_tracing():
+            from .. import symbol as sym_mod
+            return self.hybrid_forward(sym_mod, *args, **params)
         from .. import ndarray as nd_mod
         return self.hybrid_forward(nd_mod, *args, **params)
 
@@ -413,11 +416,23 @@ class HybridBlock(Block):
         save_params("%s-%04d.params" % (path, epoch), arg_params, {})
 
     def _as_symbol(self):
-        """Trace hybrid_forward with Symbol proxies to build a Symbol graph."""
+        """Trace hybrid_forward with Symbol proxies to build a Symbol graph.
+
+        Recursive: a symbol-tracing mode routes every CHILD block's forward
+        through the same proxy path with its params overridden by Symbol
+        variables, so nested trees (HybridSequential of Denses, a whole
+        model) trace into one graph — the serving engine's from_block and
+        export both ride this."""
         from .. import symbol as sym_mod
         data = sym_mod.Variable("data")
-        params = {attr: p.var() for attr, p in sorted(self._reg_params.items())}
-        out = self.hybrid_forward(sym_mod, data, **params)
+        override = {name: p.var()
+                    for name, p in self.collect_params().items()}
+        _SYM_TRACE.depth = getattr(_SYM_TRACE, "depth", 0) + 1
+        try:
+            with _param_override(override):
+                out = self._eager_forward_overridden(data)
+        finally:
+            _SYM_TRACE.depth -= 1
         if isinstance(out, (list, tuple)):
             out = sym_mod.Group(list(out))
         return out
@@ -429,10 +444,15 @@ class HybridBlock(Block):
 
 _OVERRIDE = threading.local()
 _TRACING = threading.local()
+_SYM_TRACE = threading.local()
 
 
 def _is_tracing():
     return getattr(_TRACING, "depth", 0) > 0
+
+
+def _symbol_tracing():
+    return getattr(_SYM_TRACE, "depth", 0) > 0
 
 
 class _param_override:

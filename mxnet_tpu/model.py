@@ -298,6 +298,11 @@ class FeedForward(object):
         return self
 
     def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """Feed-forward inference. Batches ride Module.predict's serving
+        path (serving/engine.py): the fixed `numpy_batch_size` makes the
+        shapes static, so every batch — including the padded final one —
+        dispatches into one pre-compiled bucket program
+        (MXNET_SERVING_PREDICT=0 restores the bare executor sweep)."""
         from .io import NDArrayIter
         if not hasattr(X, "provide_data"):
             X = NDArrayIter(X, batch_size=self.numpy_batch_size)

@@ -65,6 +65,9 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._group2ctxs = group2ctxs
+        # serving-engine predict path (serving/engine.py): bucketed AOT
+        # programs + padded dispatch replace per-shape jit recompiles
+        self._serving_engine = None
         # fused tpu_sync train path (parallel/tpu_step.py): one XLA program
         # per iteration instead of per-param push/pull (model.py:59-88)
         self._fused_step = None
@@ -261,6 +264,7 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._rsp_param_names = None
+        self._serving_engine = None
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
@@ -577,6 +581,113 @@ class Module(BaseModule):
                              else _sp.row_sparse_from_dense(g) for g in dev_grads]
             out.append(dev_grads)
         return out
+
+    # ------------------------------------------------------------------
+    # serving-engine predict path: static-shape inference routes through
+    # serving/engine.py — bucketed pre-compiled XLA programs with padded
+    # dispatch, so an odd-sized final batch (or a caller-varied batch
+    # size) reuses a warmed program instead of recompiling via reshape.
+    # MXNET_SERVING_PREDICT=0 restores the plain executor sweep.
+    # ------------------------------------------------------------------
+    def _predict_serving_engine(self):
+        """The module's InferenceEngine, built lazily and refreshed with
+        the current params; None when this module can't serve (then
+        predict falls back to the executor path)."""
+        from ..base import env_flag
+        if not env_flag("MXNET_SERVING_PREDICT", True):
+            return None
+        if not (self.binded and self.params_initialized):
+            return None
+        if (len(self._context) != 1 or self._state_names
+                or self._monitor is not None or self.inputs_need_grad):
+            return None
+        for desc in self._data_shapes:
+            layout = getattr(desc, "layout", None)
+            if layout and "N" in layout and layout.find("N") != 0:
+                return None  # engine pads/splits along axis 0 only
+        if (self._serving_engine is None and self._exec_group.execs
+                and self._exec_group.execs[0].has_compiled_forward()):
+            # score/eval already paid this module's inference compile on
+            # the executor path; building the engine now would compile the
+            # same program a second time for nothing. Modules that predict
+            # FIRST (the serving pattern) still get the engine — and keep
+            # it for every later predict.
+            return None
+        try:
+            # hand the engine the executors' own DEVICE param buffers:
+            # same device -> device_put is a no-op alias, so neither the
+            # build nor the per-predict refresh moves any bytes, and the
+            # engine always serves the training-current weights (exec
+            # arrays are the authoritative device copies on every update
+            # path; the fused step syncs into them here)
+            self._sync_fused_to_execs()
+            exe0 = self._exec_group.execs[0]
+            arg_params = {n: exe0.arg_dict[n] for n in self._param_names
+                          if n in exe0.arg_dict}
+            aux_params = dict(exe0.aux_dict)
+            if self._serving_engine is None:
+                from ..serving import InferenceEngine
+                self._serving_engine = InferenceEngine(
+                    self._symbol, arg_params, aux_params,
+                    ctx=self._context[0],
+                    buckets=(self._data_shapes[0].shape[0],))
+            else:
+                self._serving_engine.update_params(arg_params, aux_params)
+            return self._serving_engine
+        except Exception as e:
+            self.logger.debug("serving predict unavailable (%s); "
+                              "falling back to executors", e)
+            self._serving_engine = None
+            return None
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False, sparse_row_id_fn=None):
+        """reference: base_module.py predict, routed through the serving
+        engine when shapes are static (single device, batch-major layout,
+        no sparse pulls) — see _predict_serving_engine."""
+        eng = (self._predict_serving_engine()
+               if sparse_row_id_fn is None else None)
+        if eng is None:
+            return super().predict(
+                eval_data, num_batch=num_batch, merge_batches=merge_batches,
+                reset=reset, always_output_list=always_output_list,
+                sparse_row_id_fn=sparse_row_id_fn)
+        if reset:
+            eval_data.reset()
+        per_batch = []
+        try:
+            for i, batch in enumerate(eval_data):
+                if i == num_batch:
+                    break
+                n_pad = getattr(batch, "pad", 0) or 0
+                request = {}
+                for desc, arr in zip(self._data_shapes, batch.data):
+                    request[desc.name] = arr[:arr.shape[0] - n_pad] \
+                        if n_pad else arr
+                # feed labels when the batch carries them: graphs whose
+                # inference output consumes the label (MakeLoss heads) must
+                # see the same values the executor path would
+                for desc, arr in zip(self._label_shapes or [],
+                                     getattr(batch, "label", None) or []):
+                    request[desc.name] = arr[:arr.shape[0] - n_pad] \
+                        if n_pad else arr
+                per_batch.append(eng.predict(request))
+        except Exception as e:
+            # a serve-incompatible graph only reveals itself at dispatch —
+            # a bound input with no batch axis (MXNetError), or a bucket
+            # program that fails to compile/run (raw XLA errors): fall
+            # back to the executor sweep rather than regress predict()
+            self._serving_engine = None
+            if not reset:
+                raise  # a half-consumed non-resettable sweep can't replay
+            self.logger.debug("serving predict failed (%s); falling back "
+                              "to executors", e)
+            return super().predict(
+                eval_data, num_batch=num_batch,
+                merge_batches=merge_batches, reset=True,
+                always_output_list=always_output_list)
+        return self._merge_predict_outputs(per_batch, merge_batches,
+                                           always_output_list)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

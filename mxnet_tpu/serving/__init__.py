@@ -1,0 +1,19 @@
+"""Serving subsystem — dynamic batching + bucketed AOT program cache +
+donated async inference (docs/faq/serving.md).
+
+The TPU-native analog of the reference dependency engine's op bulking
+(MXNet paper §4) and of TF-Serving's compiled-graph serving layer
+(arXiv:1605.08695): request shapes round up into a small set of batch
+buckets, each bucket's XLA program compiles once (ahead of time at warmup,
+persisted across restarts via MXNET_TPU_COMPILE_CACHE), and a dynamic
+micro-batcher coalesces concurrent requests into full buckets.
+
+    from mxnet_tpu.serving import InferenceEngine
+"""
+from .program_cache import BucketedProgramCache, DEFAULT_BUCKETS, bucket_for
+from .batcher import DynamicBatcher, pad_to_bucket, default_max_batch
+from .engine import InferenceEngine
+
+__all__ = ["InferenceEngine", "BucketedProgramCache", "DynamicBatcher",
+           "DEFAULT_BUCKETS", "bucket_for", "pad_to_bucket",
+           "default_max_batch"]

@@ -1,0 +1,407 @@
+"""InferenceEngine — the serving facade over a Symbol (or hybridized Block).
+
+The production inference entry point the ROADMAP's "serve heavy traffic"
+north star asks for: one object owning (a) the bucketed AOT program cache
+(program_cache.py) so every request shape maps onto a pre-compiled XLA
+executable, (b) the dynamic micro-batcher (batcher.py) so concurrent small
+requests coalesce into full buckets, and (c) the padded dispatch/split
+plumbing with compile/hit/miss counters for observability.
+
+    engine = InferenceEngine(sym, arg_params, aux_params, ctx=mx.tpu(0))
+    engine.warmup({"data": (32, 3, 224, 224)})   # pre-pay every bucket
+    out = engine.predict({"data": batch})        # any batch size 1..32
+    fut = engine.predict_async({"data": row})    # coalesced micro-batching
+    engine.stats()                               # compiles/hits/misses/...
+
+Synchronous `predict` pads to the nearest bucket and runs inline (one
+caller, lowest latency); `predict_async` queues into the batcher (many
+callers, highest throughput). Both run the graph strictly in inference mode
+— see batcher.py for the padding-correctness argument.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, zeros as _nd_zeros, _new_from_jax
+from .program_cache import BucketedProgramCache, DEFAULT_BUCKETS
+from .batcher import DynamicBatcher
+
+__all__ = ["InferenceEngine"]
+
+
+class InferenceEngine:
+    """Serve a bound inference graph through bucketed, pre-compiled programs.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        The inference graph. Every argument present in ``arg_params`` is a
+        weight; the remaining arguments (data, labels) are request inputs.
+    arg_params, aux_params : dict of str -> NDArray/np.ndarray
+        Weights. Updating them later via :meth:`update_params` swaps the
+        execution-time buffers without recompiling (params are runtime
+        arguments of the cached programs, not compile-time constants).
+    ctx : Context
+        Device the programs run on (default: current context).
+    buckets : tuple of int
+        Batch-size buckets (default ``(1, 4, 8, 16, 32)``).
+    donate : bool or "auto"
+        Donate request-batch buffers to XLA on the inference call ("auto":
+        only on backends that honor donation — not CPU).
+    max_batch, max_delay_ms
+        Micro-batcher knobs (see batcher.py). ``max_batch=None`` defers to
+        ``mx.engine.set_bulk_size`` / the largest bucket.
+    async_worker : bool
+        True (default): a background worker drains ``predict_async``'s
+        queue. False: no thread is spawned — queued requests run on the
+        CALLING thread at :meth:`flush`, through the same coalesce/pad/
+        dispatch path (deterministic; what benchmarks on single-core
+        hosts and tests use).
+    """
+
+    def __init__(self, symbol, arg_params, aux_params=None, ctx=None,
+                 buckets=DEFAULT_BUCKETS, donate="auto", max_batch=None,
+                 max_delay_ms=2.0, async_worker=True):
+        import jax
+        self._symbol = symbol
+        self._ctx = (ctx if isinstance(ctx, Context)
+                     else Context(ctx) if ctx is not None
+                     else current_context())
+        self._device = self._ctx.jax_device
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_params = dict(arg_params or {})
+        aux_params = dict(aux_params or {})
+        self._param_names = [n for n in arg_names if n in arg_params]
+        self._input_names = [n for n in arg_names if n not in arg_params]
+        if not self._input_names:
+            raise MXNetError("InferenceEngine: symbol has no free inputs "
+                             "(every argument was supplied as a parameter)")
+        missing_aux = [n for n in aux_names if n not in aux_params]
+        if missing_aux:
+            raise MXNetError("InferenceEngine: missing aux states %s"
+                             % missing_aux)
+
+        self._params = {n: self._to_device(arg_params[n])
+                        for n in self._param_names}
+        self._aux = {n: self._to_device(aux_params[n]) for n in aux_names}
+
+        # graph interpreter: reuse Executor's traced-node walk. The dummy
+        # input arrays are never executed — _run_graph is shape-agnostic
+        # and only the jitted serving fn below ever calls it.
+        from ..executor import Executor
+        dummy_args = {n: _new_from_jax(self._params[n], ctx=self._ctx)
+                      for n in self._param_names}
+        for n in self._input_names:
+            dummy_args[n] = _nd_zeros((1,), ctx=self._ctx)
+        dummy_aux = {n: _new_from_jax(self._aux[n], ctx=self._ctx)
+                     for n in aux_names}
+        self._exe = Executor(symbol, self._ctx, dummy_args, None, "null",
+                             dummy_aux)
+        from .. import random as _rnd
+        self._needs_rng = symbol._needs_rng()
+        # commit the key to the engine device: the AOT programs' input
+        # placement is pinned there, and compiled executables are strict
+        # about committed input devices
+        self._fixed_rng = jax.device_put(_rnd.fixed_key(), self._device)
+
+        exe = self._exe
+
+        def _serve(batch_vals, param_vals, aux_vals, rng):
+            args = dict(param_vals)
+            args.update(batch_vals)
+            outs, _ = exe._run_graph(args, aux_vals, rng, False)
+            return outs
+
+        self._cache = BucketedProgramCache(_serve, buckets=buckets,
+                                           donate=donate,
+                                           device=self._device)
+        self._batcher = DynamicBatcher(self._run_padded, self._cache.buckets,
+                                       max_batch=max_batch,
+                                       max_delay_ms=max_delay_ms,
+                                       autostart=async_worker)
+        self._templates = {}        # input name -> (shape tuple, np dtype)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_block(cls, block, ctx=None, **kwargs):
+        """Build from a hybridized Gluon Block: trace it to a Symbol and
+        lift its initialized Parameters (reference: HybridBlock.export,
+        but straight into the serving engine with no disk round trip)."""
+        sym = block._as_symbol()
+        arg_params, aux_params = {}, {}
+        for name, param in block.collect_params().items():
+            if param._data is None:
+                raise MXNetError("from_block: parameter %s is uninitialized"
+                                 % name)
+            (aux_params if param.grad_req == "null" else arg_params)[name] \
+                = param.data()
+        # traced graphs carry aux (running stats) as plain variables; keep
+        # them wherever the symbol expects them
+        args = set(sym.list_arguments())
+        for name in list(aux_params):
+            if name in args:
+                arg_params[name] = aux_params.pop(name)
+        return cls(sym, arg_params, aux_params, ctx=ctx, **kwargs)
+
+    # ------------------------------------------------------------------
+    # parameters
+    # ------------------------------------------------------------------
+    def _to_device(self, v):
+        """Engine-device buffer for a param/input (a no-op alias when the
+        value already lives there — jax.device_put returns the same
+        buffer for same-device committed arrays)."""
+        import jax
+        data = v._data if isinstance(v, NDArray) else _np.asarray(v)
+        return jax.device_put(data, self._device)
+
+    def update_params(self, arg_params, aux_params=None):
+        """Swap the serving weights in place. No recompilation: the cached
+        programs take params as runtime arguments, so this is a device_put
+        per (changed) array — shape/dtype changes transparently key new
+        programs on next use."""
+        for n, v in (arg_params or {}).items():
+            if n in self._params:
+                self._params[n] = self._to_device(v)
+        for n, v in (aux_params or {}).items():
+            if n in self._aux:
+                self._aux[n] = self._to_device(v)
+
+    # ------------------------------------------------------------------
+    # shape templates
+    # ------------------------------------------------------------------
+    def _learn_templates(self, supplied):
+        """Pin every input's non-batch shape + dtype, inferring the never-
+        supplied ones (labels) from the symbol's shape inference."""
+        shapes = {}
+        for name, (shape, _) in self._templates.items():
+            shapes[name] = shape
+        for name, arr in supplied.items():
+            shapes[name] = tuple(_np.shape(arr))
+        try:
+            arg_shapes, _, _ = self._symbol.infer_shape(**shapes)
+        except MXNetError as e:
+            raise MXNetError(
+                "InferenceEngine: cannot infer shapes for inputs %s from "
+                "%s — pass them to warmup(shapes) explicitly (%s)"
+                % ([n for n in self._input_names if n not in shapes],
+                   sorted(shapes), e))
+        arg_names = self._symbol.list_arguments()
+        for name, shape in zip(arg_names, arg_shapes):
+            if name not in self._input_names:
+                continue
+            dtype = _np.float32
+            if name in supplied:
+                a = supplied[name]
+                dtype = _np.dtype(a.dtype) if hasattr(a, "dtype") \
+                    else _np.float32
+            elif name in self._templates:
+                dtype = self._templates[name][1]
+            self._templates[name] = (tuple(shape), _np.dtype(dtype))
+
+    def _rng(self):
+        if not self._needs_rng:
+            return self._fixed_rng
+        import jax
+        from .. import random as _rnd
+        return jax.device_put(_rnd.next_key(), self._device)
+
+    # ------------------------------------------------------------------
+    # warmup (AOT)
+    # ------------------------------------------------------------------
+    def warmup(self, shapes=None, buckets=None):
+        """Ahead-of-time compile the serving program for each bucket.
+
+        ``shapes``: dict input name -> full shape (the batch axis value is
+        a placeholder; each bucket substitutes its own). May be omitted
+        when a previous warmup/predict already taught the engine its input
+        shapes. Returns the number of programs compiled."""
+        import jax
+        # lock only the template snapshot: the compiles below can take
+        # seconds per bucket, and in-flight requests on already-cached
+        # buckets must keep flowing (program_cache implements the same
+        # compile-outside-lock rule one level down)
+        with self._lock:
+            if shapes:
+                supplied = {k: _np.zeros(tuple(v), _np.float32)
+                            for k, v in shapes.items()}
+                self._learn_templates(supplied)
+            if not self._templates:
+                raise MXNetError("warmup needs shapes on first use, e.g. "
+                                 "engine.warmup({'data': (32, 3, 224, 224)})")
+            template = {
+                name: jax.ShapeDtypeStruct(shape, dtype)
+                for name, (shape, dtype) in self._templates.items()}
+        # lowering consumes only the key's shape/dtype — never draw from
+        # the global RNG chain for it (that would shift later user-visible
+        # draws; same rule as Executor.program_cost)
+        return self._cache.warmup(template, self._params, self._aux,
+                                  self._fixed_rng, buckets=buckets)
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+    def _normalize_request(self, data, keep_device=False):
+        """Accept a dict of arrays, a single array (mapped to the first
+        free input), or a list matching input order; return arrays keyed
+        by input name plus the common row count. ``keep_device=True``
+        (the sync predict path) passes device-resident jax buffers
+        through untouched — no device->host->device round trip; the
+        batcher path materializes to host np (it stacks across
+        requests)."""
+        import jax
+        if isinstance(data, (NDArray, _np.ndarray)) or hasattr(data, "shape"):
+            data = {self._input_names[0]: data}
+        elif isinstance(data, (list, tuple)):
+            data = dict(zip(self._input_names, data))
+        unknown = set(data) - set(self._input_names)
+        if unknown:
+            raise MXNetError("unknown inference inputs %s (free inputs: %s)"
+                             % (sorted(unknown), self._input_names))
+        host = {}
+        for name, arr in data.items():
+            if isinstance(arr, NDArray):
+                arr = arr._data if keep_device else arr.asnumpy()
+            if not (keep_device and isinstance(arr, jax.Array)):
+                arr = _np.asarray(arr)
+            host[name] = arr
+        ns = {a.shape[0] for a in host.values()}
+        if len(ns) != 1:
+            raise MXNetError("inference inputs disagree on batch size: %s"
+                             % {k: v.shape for k, v in host.items()})
+        n = ns.pop()
+        if n <= 0:
+            raise MXNetError("empty inference batch")
+        with self._lock:
+            if set(self._templates) != set(self._input_names):
+                self._learn_templates(host)
+        # fill never-supplied inputs (labels) with zeros of their inferred
+        # row shape; cast supplied ones to the pinned dtype so a stray
+        # float64 batch cannot key a distinct program
+        for name in self._input_names:
+            shape, dtype = self._templates[name]
+            if name in host:
+                if host[name].dtype != dtype:
+                    host[name] = host[name].astype(dtype)
+            else:
+                host[name] = _np.zeros((n,) + shape[1:], dtype)
+        return host, n
+
+    def _stage(self, padded):
+        """Host -> device staging of one bucket-padded batch. Fresh buffers
+        every call, so donation can never invalidate caller memory."""
+        import jax
+        return {name: jax.device_put(arr, self._device)
+                for name, arr in padded.items()}
+
+    @staticmethod
+    def _pad_rows(arr, n, bucket):
+        """Row-0-replicating pad for one array, device-side for jax
+        buffers (see batcher.pad_to_bucket for the host-dict variant and
+        the padding-correctness argument)."""
+        if n == bucket:
+            return arr
+        import jax
+        import jax.numpy as jnp
+        if isinstance(arr, jax.Array):
+            pad = jnp.broadcast_to(arr[:1],
+                                   (bucket - n,) + tuple(arr.shape[1:]))
+            return jnp.concatenate([arr, pad], axis=0)
+        pad = _np.broadcast_to(arr[:1], (bucket - n,) + arr.shape[1:])
+        return _np.concatenate([arr, pad], axis=0)
+
+    def _stage_one(self, arr, fresh):
+        """Stage one input: device_put host arrays (fresh buffers); alias
+        same-device jax buffers. Under donation a caller-owned device
+        buffer that we did NOT freshly build must be copied — donating it
+        would invalidate the caller's array."""
+        import jax
+        import jax.numpy as jnp
+        if isinstance(arr, jax.Array):
+            arr = jax.device_put(arr, self._device)  # same-device: alias
+            if self._cache.donate and not fresh:
+                arr = jnp.copy(arr)
+            return arr
+        return jax.device_put(arr, self._device)
+
+    def _run_padded(self, padded, n):
+        """Batcher callback: run one bucket-padded host batch, return the
+        padded outputs for the batcher to slice per request.
+
+        On accelerators the outputs stay DEVICE arrays and no sync happens
+        here: JAX async dispatch keeps the device queue full across
+        consecutive coalesced batches, and per-request slices materialize
+        when a caller reads them. On the CPU backend (compute shares the
+        caller's core, nothing to overlap) each output materializes to
+        host ONCE per batch instead — numpy slicing then hands every
+        request a free view, where device-array slicing would dispatch a
+        separate XLA slice op per request per output."""
+        outs = self._cache.run(self._stage(padded), self._params,
+                               self._aux, self._rng())
+        if self._device.platform == "cpu":
+            return [_np.asarray(o) for o in outs]
+        return list(outs)
+
+    def predict(self, data):
+        """Synchronous inference for a batch of any size: pad to the
+        nearest bucket, run the cached program, return unpadded NDArray
+        outputs (row-for-row equal to an unbatched run — batcher.py has
+        the padding-correctness argument). Device-resident inputs stay on
+        device end to end (padding runs device-side)."""
+        arrays, n = self._normalize_request(data, keep_device=True)
+        bucket = self._cache.bucket_for(n)
+        staged = {}
+        for name, arr in arrays.items():
+            padded = self._pad_rows(arr, n, bucket)
+            staged[name] = self._stage_one(padded, fresh=padded is not arr)
+        outs = self._cache.run(staged, self._params, self._aux, self._rng())
+        return [_new_from_jax(o[:n], ctx=self._ctx) for o in outs]
+
+    def predict_async(self, data):
+        """Queue a request into the dynamic micro-batcher; returns a
+        future-like handle (``.result_wait(timeout)`` / ``.done()``).
+        Concurrent requests coalesce into shared bucket-padded executable
+        calls. Results are per-request-unpadded DEVICE arrays riding JAX
+        async dispatch — ``np.asarray`` (or ``jax.block_until_ready``)
+        them to materialize on host."""
+        host, _ = self._normalize_request(data)
+        return self._batcher.submit(host)
+
+    def flush(self):
+        """Drain any queued async requests on the calling thread."""
+        self._batcher.flush()
+
+    def stop(self):
+        self._batcher.stop()
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    @property
+    def compiles(self):
+        return self._cache.compiles
+
+    @property
+    def hits(self):
+        return self._cache.hits
+
+    @property
+    def misses(self):
+        return self._cache.misses
+
+    def stats(self):
+        """Compile/hit/miss counters plus batcher coalescing stats — the
+        serving observability surface (bench.py's serving phase and
+        tools/serve_bench.py report exactly this dict)."""
+        out = self._cache.stats()
+        out.update(self._batcher.stats())
+        out["buckets"] = list(self._cache.buckets)
+        return out

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Local serving-benchmark entry point — prints the serving metrics JSON.
+
+Runs exactly the mixed-trace serving phase the driver-facing bench harness
+reports (bench.py `_phase_serving`: dynamic batching + bucketed AOT cache +
+donated dispatch vs a plain batch-32 executor loop in the same process), so
+a local run and the round's committed number can never measure different
+code paths.
+
+Usage:
+    python tools/serve_bench.py           # default backend (TPU if up)
+    python tools/serve_bench.py --cpu     # forced single-device CPU shapes
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (small shapes)")
+    parser.add_argument("--pretty", action="store_true",
+                        help="indent the JSON output")
+    args = parser.parse_args(argv)
+
+    if args.cpu and os.environ.get("_SERVE_BENCH_CHILD") != "1":
+        # backend selection must happen before jax is imported anywhere —
+        # re-exec into a sanitized single-device CPU environment
+        sys.path.insert(0, _ROOT)
+        from ci.envutil import cpu_mesh_env
+        env = cpu_mesh_env(1)
+        env["_SERVE_BENCH_CHILD"] = "1"
+        return subprocess.call([sys.executable, os.path.abspath(__file__)]
+                               + [a for a in (argv or sys.argv[1:])
+                                  if a != "--cpu"], env=env, cwd=_ROOT)
+
+    sys.path.insert(0, _ROOT)
+    import bench
+    metrics = bench._phase_serving()
+    print(json.dumps(metrics, indent=2 if args.pretty else None))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
