@@ -68,6 +68,8 @@ from . import serving
 from .serving import InferenceEngine
 from . import model
 from .model import save_checkpoint, load_checkpoint, FeedForward
+from . import checkpoint
+from .checkpoint import CheckpointManager
 from . import gluon
 from . import rnn
 from . import recordio

@@ -1,0 +1,41 @@
+"""Asynchronous, preemption-safe checkpointing & restore.
+
+The fault-tolerance half of the training story (serving's zero-recompile
+`InferenceEngine.update_params` hot-swap is the other): durable, async,
+shard-aware training state that a preempted job resumes bit-exactly and
+a live serving engine reloads without restart. See docs/faq/checkpoint.md.
+
+Quick tour::
+
+    import mxnet_tpu as mx
+    mgr = mx.checkpoint.CheckpointManager("/ckpt", keep_last_n=3)
+    mod.fit(train, num_epoch=90, checkpoint_manager=mgr)  # auto-resumes
+    mx.checkpoint.latest_checkpoint("/ckpt")              # discovery
+    engine.reload_from("/ckpt", poll_interval=30)         # serving hot-swap
+
+Layers:
+
+* `layout`  — step dirs, atomic tmp→rename commit, discovery, retention
+* `state`   — params/optimizer/RNG capture + restore (zero-copy pinning)
+* `manager` — CheckpointManager: async writer, retention, resume, SIGTERM
+* `kvshard` — dist_async server-shard snapshot merge/reshard
+"""
+from . import layout
+from . import state
+from . import kvshard
+from .layout import (latest_checkpoint, latest_step, list_checkpoints,
+                     read_meta)
+from .manager import CheckpointManager, SaveHandle, RestoredCheckpoint
+from .state import TrainingState
+
+
+def load_params(path):
+    """(arg_params, aux_params) of a committed checkpoint directory —
+    the serving hot-swap read path (`InferenceEngine.reload_from`)."""
+    return state.load_params_files(path)
+
+
+__all__ = ["CheckpointManager", "SaveHandle", "RestoredCheckpoint",
+           "TrainingState", "latest_checkpoint", "latest_step",
+           "list_checkpoints", "read_meta", "load_params",
+           "layout", "state", "kvshard"]

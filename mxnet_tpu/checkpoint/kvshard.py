@@ -1,0 +1,231 @@
+"""dist_async server-shard checkpointing: merge + reshard helpers.
+
+`kvstore_async` places big arrays as `key#shardN` row-slices, one per
+server (reference PSKV, `kvstore_dist.h:151`). Each server snapshots its
+OWN slice of the weights and its optimizer state slots to a
+`kvserver-<i>-of-<n>.pkl` file (the server is the only process that can
+address them — the shard-aware analog of "each host saves only
+addressable shards"). Restore comes in two flavors:
+
+* same server count — each server wholesale-reloads its own file;
+* different server count — the worker merges every saved shard back
+  into full arrays host-side (shards concatenate in shard-index order;
+  the reference's bounds formula keeps row ranges contiguous and
+  ordered), recomputes placement for the NEW topology, row-slices both
+  weights and per-key optimizer slots (momentum/master-weight arrays
+  share the weight's leading axis), and installs the pieces on the new
+  servers.
+
+File format per server (pickle, trusted-cluster only like the wire
+protocol): ``{"format": 1, "server": i, "num_servers": n,
+"entries": {subkey: {"weight": np, "state": numpy-tree|None}},
+"optimizer": pickle-bytes|None, "push_count": int}``.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as _np
+
+from ..base import MXNetError
+from . import layout
+
+__all__ = ["save_kv_checkpoint", "restore_kv_checkpoint",
+           "merge_server_blobs", "slice_state", "concat_states"]
+
+
+def split_subkey(subkey):
+    """('base key', shard index or None) — parsed with kvstore_async's
+    own SHARD_KEY_RE, so the checkpoint merge can never drift from the
+    wire format the servers key on."""
+    from ..kvstore_async import SHARD_KEY_RE
+    m = SHARD_KEY_RE.match(str(subkey))
+    if m:
+        return m.group("base"), int(m.group("idx"))
+    return str(subkey), None
+
+
+# ---------------------------------------------------------------------------
+# state-tree row surgery
+# ---------------------------------------------------------------------------
+
+def slice_state(state, r0, r1, total_rows):
+    """Row-slice an optimizer state tree for one shard: array leaves that
+    share the weight's leading axis (`total_rows`) are cut to [r0:r1);
+    anything else (scalars, None, differently-shaped slots) replicates."""
+    if isinstance(state, (list, tuple)):
+        return type(state)(slice_state(s, r0, r1, total_rows)
+                           for s in state)
+    if isinstance(state, _np.ndarray) and state.ndim >= 1 \
+            and state.shape[0] == total_rows:
+        return state[r0:r1]
+    return state
+
+
+def concat_states(parts, rows_per_shard=None):
+    """Inverse of slice_state: rebuild a full state tree from per-shard
+    trees ordered by shard index. Row-sliced leaves concatenate along
+    axis 0; replicated leaves are taken from the first non-None shard.
+
+    `rows_per_shard` (the weight shards' row counts) resolves the
+    lazily-initialized case: a shard whose server never received a push
+    for the key has NO state — its rows come back as ZEROS (exactly the
+    uninitialized-slot semantics), rather than another shard's partial
+    array masquerading as the full state."""
+    live = [i for i, p in enumerate(parts) if p is not None]
+    if not live:
+        return None
+    first = parts[live[0]]
+    if isinstance(first, (list, tuple)):
+        return type(first)(
+            concat_states([(p[i] if p is not None else None)
+                           for p in parts], rows_per_shard)
+            for i in range(len(first)))
+    if isinstance(first, _np.ndarray) and first.ndim >= 1 \
+            and rows_per_shard is not None \
+            and first.shape[0] == rows_per_shard[live[0]]:
+        row_aligned = all(
+            parts[i] is None
+            or (isinstance(parts[i], _np.ndarray)
+                and parts[i].shape == (rows_per_shard[i],) + first.shape[1:])
+            for i in range(len(parts)))
+        if row_aligned:
+            filled = [parts[i] if parts[i] is not None
+                      else _np.zeros((rows_per_shard[i],) + first.shape[1:],
+                                     first.dtype)
+                      for i in range(len(parts))]
+            return _np.concatenate(filled, axis=0)
+    return first
+
+
+def _merge_optimizers(payloads):
+    """One optimizer pickle for the whole merged checkpoint. Each server
+    advanced its OWN per-key update counters; taking just the first blob
+    would reset the lr-schedule position of every key the other servers
+    owned — merge counters (max per key, max num_update) instead."""
+    opts = []
+    for p in payloads:
+        if p is None:
+            continue
+        try:
+            opts.append(pickle.loads(p))
+        except Exception:
+            continue
+    if not opts:
+        return None
+    merged = opts[0]
+    for other in opts[1:]:
+        counts = getattr(other, "_index_update_count", None)
+        if counts is not None and hasattr(merged, "_index_update_count"):
+            for k, v in counts.items():
+                merged._index_update_count[k] = max(
+                    v, merged._index_update_count.get(k, 0))
+        if hasattr(other, "num_update") and hasattr(merged, "num_update"):
+            merged.num_update = max(merged.num_update, other.num_update)
+    return pickle.dumps(merged, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+# ---------------------------------------------------------------------------
+# merge across server files
+# ---------------------------------------------------------------------------
+
+def merge_server_blobs(blobs):
+    """{base key: {"weight": full np, "state": full tree|None}} plus the
+    first available optimizer pickle, from every server's snapshot blob.
+    Shards concatenate in #shardN order; whole-array keys pass through."""
+    per_key = {}
+    for blob in blobs:
+        for subkey, rec in blob.get("entries", {}).items():
+            base, shard = split_subkey(subkey)
+            per_key.setdefault(base, {})[shard] = rec
+    optimizer = _merge_optimizers([b.get("optimizer") for b in blobs])
+    merged = {}
+    for base, shards in per_key.items():
+        if list(shards) == [None]:
+            rec = shards[None]
+            merged[base] = {"weight": _np.asarray(rec["weight"]),
+                            "state": rec.get("state")}
+            continue
+        if None in shards:
+            raise MXNetError("key %r is saved both whole and sharded — "
+                             "corrupt kv checkpoint" % base)
+        order = sorted(shards)
+        if order != list(range(len(order))):
+            raise MXNetError("key %r is missing shards (%s present)"
+                             % (base, order))
+        weights = [_np.asarray(shards[i]["weight"]) for i in order]
+        merged_entry = {"weight": _np.concatenate(weights, axis=0)}
+        states = [shards[i].get("state") for i in order]
+        merged_entry["state"] = None if all(s is None for s in states) \
+            else concat_states(states,
+                               rows_per_shard=[w.shape[0] for w in weights])
+        merged[base] = merged_entry
+    return merged, optimizer
+
+
+# ---------------------------------------------------------------------------
+# worker entry points
+# ---------------------------------------------------------------------------
+
+def save_kv_checkpoint(kv, directory):
+    """Ask every dist_async server to snapshot its shard of weights +
+    optimizer state into `directory` (one atomic file per server; the
+    path must be on a filesystem the server hosts can write — same
+    shared-fs assumption the reference's server-side dumps made).
+    Returns the per-server file list."""
+    os.makedirs(directory, exist_ok=True)
+    n = kv.num_servers
+    # sweep snapshots from a PREVIOUS save under a different server
+    # count: a mixed file set would (correctly) fail restore's
+    # completeness check, turning a valid re-save into dead weight.
+    # Same-count files are simply overwritten atomically below.
+    for _, n_old, path in layout.list_kv_server_files(directory):
+        if n_old != n:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    files = [layout.kv_server_file(directory, s, n) for s in range(n)]
+    kv._rpc_scatter([(s, ("snapshot", files[s], s, n)) for s in range(n)])
+    return files
+
+
+def restore_kv_checkpoint(kv, directory):
+    """Restore server-side weights + optimizer state from a checkpoint
+    dir. Same server count: each server reloads its own file. Different
+    count: merge host-side, recompute placement for the new topology,
+    and install resharded pieces (weights AND per-key optimizer slots)."""
+    files = layout.list_kv_server_files(directory)
+    if not files:
+        raise MXNetError("no kvserver-*.pkl snapshots under %s" % directory)
+    n_saved = files[0][1]
+    if len(files) != n_saved or [f[0] for f in files] != list(range(n_saved)):
+        raise MXNetError("incomplete kv checkpoint under %s: have servers "
+                         "%s of %d" % (directory, [f[0] for f in files],
+                                       n_saved))
+    n_now = kv.num_servers
+    if n_now == n_saved:
+        kv._rpc_scatter([(s, ("restore", path))
+                         for s, _, path in files])
+        return
+    blobs = []
+    for _, _, path in files:
+        with open(path, "rb") as f:
+            blobs.append(pickle.load(f))
+    merged, optimizer = merge_server_blobs(blobs)
+    calls = {}
+    for base, rec in merged.items():
+        weight = rec["weight"]
+        plan = kv._placement(base, weight)
+        rows = weight.shape[0] if weight.ndim else 0
+        for s, r0, r1 in plan:
+            whole = r0 is None
+            subkey = kv._subkey(base, s, whole)
+            w = weight if whole else weight[r0:r1]
+            st = rec["state"]
+            if st is not None and not whole:
+                st = slice_state(st, r0, r1, rows)
+            calls.setdefault(s, []).append((subkey, w, st))
+    kv._rpc_scatter([(s, ("install", entries, optimizer))
+                     for s, entries in calls.items()])

@@ -1,0 +1,576 @@
+"""CheckpointManager: async snapshot writes, retention, resume, preemption.
+
+`save(step, ...)` captures the training state on the calling thread by
+PINNING device buffers (immutable jax arrays — a zero-copy point-in-time
+view, see checkpoint/state.py) and enqueues the job on one background
+writer thread. The training step resumes immediately; serialization,
+file IO, the atomic tmp→rename commit, and retention pruning all happen
+on the writer. A kill at any moment leaves the previous committed
+checkpoint intact (layout.py's commit protocol).
+
+Environment defaults (docs/faq/env_var.md):
+
+* ``MXNET_CHECKPOINT_DIR``       — default `directory`
+* ``MXNET_CHECKPOINT_PERIOD``    — default `save_period` (epochs between
+  auto-saves in `Module.fit(checkpoint_manager=...)`)
+* ``MXNET_CHECKPOINT_KEEP_LAST`` — default `keep_last_n`
+"""
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import queue
+import threading
+import time
+
+from ..base import MXNetError, atomic_write, get_env
+from . import layout, state as state_mod
+
+__all__ = ["CheckpointManager", "SaveHandle"]
+
+
+class SaveHandle:
+    """Returned by `CheckpointManager.save`; `wait()` blocks until the
+    checkpoint is committed (or re-raises the writer's error)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._err = []
+        self._observed = False  # error already surfaced to a caller
+        self.path = None
+
+    def wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise MXNetError("checkpoint write still in flight after %ss"
+                             % timeout)
+        if self._err:
+            self._observed = True
+            raise self._err[0]
+        return self.path
+
+    def done(self):
+        return self._event.is_set()
+
+    def _finish(self, path=None, error=None):
+        self.path = path
+        if error is not None:
+            self._err.append(error)
+        self._event.set()
+
+
+class RestoredCheckpoint:
+    """Loaded checkpoint contents (`CheckpointManager.restore`)."""
+
+    __slots__ = ("path", "meta", "symbol", "arg_params", "aux_params",
+                 "optimizer", "rng_key")
+
+    def __init__(self, path, meta, symbol, arg_params, aux_params,
+                 optimizer, rng_key):
+        self.path = path
+        self.meta = meta
+        self.symbol = symbol
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.optimizer = optimizer
+        self.rng_key = rng_key
+
+    @property
+    def step(self):
+        return self.meta.get("step")
+
+    @property
+    def epoch(self):
+        return self.meta.get("epoch")
+
+
+class CheckpointManager:
+    """Asynchronous, preemption-safe checkpoint save/restore.
+
+    ``keep_last_n`` — retain the N highest committed steps (None: all).
+    ``keep_every_k_steps`` — additionally retain every step divisible by
+    k forever (the reference's `keep_every` milestone pattern).
+    ``save_period`` — epochs between auto-saves when driven by
+    `Module.fit(checkpoint_manager=...)`.
+    ``preemption_signal`` — a signal number (e.g. ``signal.SIGTERM``) or
+    True (=SIGTERM); `Module.fit` installs the flush-one-final-checkpoint
+    hook for it (install_preemption_hook can also be called directly).
+    """
+
+    FORMAT = 1
+
+    def __init__(self, directory=None, keep_last_n=None,
+                 keep_every_k_steps=None, save_period=None,
+                 preemption_signal=None, logger=None):
+        directory = directory or get_env("MXNET_CHECKPOINT_DIR")
+        if not directory:
+            raise MXNetError("CheckpointManager needs a directory (argument "
+                             "or MXNET_CHECKPOINT_DIR)")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep_last_n = keep_last_n if keep_last_n is not None else \
+            get_env("MXNET_CHECKPOINT_KEEP_LAST", None, int)
+        self.keep_every_k_steps = keep_every_k_steps
+        self.save_period = max(1, save_period if save_period is not None
+                               else get_env("MXNET_CHECKPOINT_PERIOD", 1, int))
+        self.preemption_signal = preemption_signal
+        self.logger = logger or logging.getLogger(__name__)
+        self._queue = queue.Queue()
+        self._writer = None
+        # REENTRANT: the preemption signal handler runs on whatever thread
+        # holds the GIL — usually the training thread, possibly inside one
+        # of our own lock sections — and calls save()/wait() itself. A
+        # plain Lock would deadlock the handler against its own thread.
+        self._lock = threading.RLock()
+        self._handles = []       # outstanding SaveHandles
+        self._active_tmp = set()  # staging dirs being written right now
+        self._live_capture = None
+        self._prev_handlers = {}
+        self._atexit_registered = False
+
+    # ------------------------------------------------------------------
+    # save
+    # ------------------------------------------------------------------
+    def save(self, step, module=None, trainer=None, state=None, symbol=None,
+             arg_params=None, aux_params=None, epoch=None, blocking=False,
+             **meta_extra):
+        """Capture + enqueue one checkpoint; returns a SaveHandle.
+
+        Exactly one source: a `module`, a gluon `trainer`, a pre-built
+        TrainingState, or explicit symbol/params. Capture cost on this
+        thread is one host param sync (module source) or zero-copy
+        buffer pinning; everything else runs on the writer thread.
+        `blocking=True` writes on the calling thread (preemption hook,
+        import paths)."""
+        if state is None:
+            if module is not None:
+                state = state_mod.capture_module(
+                    module, epoch=epoch, step=step, arg_params=arg_params,
+                    aux_params=aux_params, **meta_extra)
+            elif trainer is not None:
+                state = state_mod.capture_trainer(trainer, step=step,
+                                                  epoch=epoch, **meta_extra)
+            else:
+                state = state_mod.capture_params(
+                    symbol=symbol, arg_params=arg_params,
+                    aux_params=aux_params, epoch=epoch, step=step,
+                    **meta_extra)
+        state.step = step
+        if epoch is not None:
+            state.epoch = epoch
+        handle = SaveHandle()
+        if blocking:
+            self._write_one(step, state, handle)
+            if handle._err:
+                raise handle._err[0]
+            return handle
+        tmp = None
+        if state.extra_writers:
+            # extra writers snapshot EXTERNAL state (dist_async servers)
+            # — they must run NOW, on the capture thread, or the async
+            # writer would snapshot the servers mid-way into the next
+            # epoch and pair epoch-e params with epoch-e+1 slots. Stage
+            # the dir early so their files land inside the checkpoint.
+            tmp = layout.begin_write(
+                self.directory, step,
+                shared=state_mod._jax_process_info()[1] > 1)
+            with self._lock:
+                self._active_tmp.add(tmp)
+            try:
+                for writer in state.extra_writers:
+                    writer(tmp)
+            except BaseException:
+                with self._lock:
+                    self._active_tmp.discard(tmp)
+                layout.discard(tmp)
+                raise
+            state.extra_writers = []
+        with self._lock:
+            self._handles.append(handle)
+            self._ensure_writer()
+        self._queue.put((step, state, handle, tmp))
+        return handle
+
+    def save_module(self, module, step, epoch=None, **kw):
+        return self.save(step, module=module, epoch=epoch, **kw)
+
+    def save_trainer(self, trainer, step, epoch=None, **kw):
+        return self.save(step, trainer=trainer, epoch=epoch, **kw)
+
+    def import_legacy(self, prefix, epoch, step=None):
+        """Convert a reference-format `prefix-symbol.json` +
+        `prefix-%04d.params` checkpoint into a managed step (blocking)."""
+        state = state_mod.from_legacy(prefix, epoch)
+        return self.save(epoch if step is None else step, state=state,
+                         epoch=epoch, blocking=True)
+
+    # ------------------------------------------------------------------
+    # writer thread
+    # ------------------------------------------------------------------
+    def _ensure_writer(self):
+        if self._writer is not None and self._writer.is_alive():
+            return
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        name="mx-checkpoint-writer",
+                                        daemon=True)
+        self._writer.start()
+        if not self._atexit_registered:
+            # drain in-flight writes on normal interpreter exit (the
+            # daemon writer would otherwise die mid-write; file-level
+            # atomicity covers abnormal exits)
+            atexit.register(self._atexit_flush)
+            self._atexit_registered = True
+
+    def _writer_loop(self):
+        # one long-lived daemon per manager: a retire-on-idle thread could
+        # race a concurrent save() past its liveness check and strand the
+        # job in the queue forever
+        while True:
+            step, state, handle, tmp = self._queue.get()
+            self._write_one(step, state, handle, tmp=tmp)
+            self._queue.task_done()
+
+    def _write_one(self, step, state, handle, tmp=None):
+        host, num_hosts = state_mod._jax_process_info()
+        shared = num_hosts > 1
+        try:
+            if tmp is None:
+                tmp = layout.begin_write(self.directory, step, shared=shared)
+            with self._lock:
+                self._active_tmp.add(tmp)
+            meta = self._write_files(tmp, step, state,
+                                     shard_only=shared and host != 0)
+            if shared and host != 0:
+                # non-coordinator hosts only stage their shard files; the
+                # coordinator awaits them, writes the manifest, commits
+                handle._finish(path=tmp)
+                return
+            if shared:
+                self._await_host_files(tmp, num_hosts)
+            layout.write_meta(tmp, meta)  # commit marker, written last
+            path = layout.commit(tmp, self.directory, step)
+            handle._finish(path=path)
+        except BaseException as e:  # surfaced at handle.wait()
+            # the coordinator also discards a failed SHARED staging dir:
+            # begin_write reuses the deterministic name, and a later save
+            # of the same step must not inherit this attempt's stale
+            # shard files. Peers never discard — their error must not
+            # destroy files other hosts are still writing.
+            if tmp is not None and (not shared or host == 0):
+                layout.discard(tmp)
+            handle._finish(error=e)
+        finally:
+            with self._lock:
+                self._active_tmp.discard(tmp)
+                self._handles[:] = [h for h in self._handles
+                                    if not h.done() or h._err]
+        if shared and host != 0:
+            return  # retention/sweeping is the coordinator's job: another
+            # host's listing must never rmtree a peer's in-flight staging
+        try:
+            self._prune()
+            with self._lock:
+                active = set(self._active_tmp)
+            layout.clean_stale(self.directory, active=active)
+        except Exception as e:
+            self.logger.warning("checkpoint retention sweep failed: %s", e)
+
+    def _await_host_files(self, tmp, num_hosts, timeout=600.0):
+        """Coordinator-side barrier substitute: wait until every host's
+        param shard file has landed in the shared staging dir."""
+        deadline = time.time() + timeout
+        while True:
+            have = {h for h, n, _ in layout.list_host_params_files(tmp)
+                    if n == num_hosts}
+            if len(have) >= num_hosts:
+                return
+            if time.time() > deadline:
+                raise MXNetError(
+                    "checkpoint %s: only hosts %s of %d wrote their shards "
+                    "within %.0fs" % (tmp, sorted(have), num_hosts, timeout))
+            time.sleep(0.25)
+
+    def _write_files(self, tmp, step, state, shard_only=False):
+        """`shard_only` (non-coordinator hosts of a multi-host save):
+        write ONLY this host's param shard files. The host's .nd file is
+        its completion marker — _await_host_files must imply 'this host
+        is fully done', so peers write nothing after it. Symbol/optimizer
+        /manifest come from the coordinator (optimizer state is
+        replicated across data-parallel hosts)."""
+        if shard_only:
+            state_mod.save_params_files(tmp, state.arg_params,
+                                        state.aux_params)
+            return None
+        meta = {"format": self.FORMAT, "step": step, "epoch": state.epoch,
+                "time": time.time()}
+        meta.update(state.meta_extra)
+        if state.symbol_json is not None:
+            with open(os.path.join(tmp, layout.SYMBOL_FILE), "w") as f:
+                f.write(state.symbol_json)
+        sharded = state_mod.save_params_files(tmp, state.arg_params,
+                                              state.aux_params)
+        if sharded:
+            meta["sharded_params"] = sharded
+        if state.optimizer is not None:
+            atomic_write(os.path.join(tmp, layout.OPTIMIZER_FILE),
+                         state_mod._serialize_opt_payload(state.optimizer))
+        for writer in state.extra_writers:
+            writer(tmp)
+        if state.rng_key is not None:
+            meta["rng_key"] = [int(v) for v in state.rng_key.ravel()]
+            meta["rng_key_shape"] = list(state.rng_key.shape)
+        return meta
+
+    # ------------------------------------------------------------------
+    # flush / error surfacing
+    # ------------------------------------------------------------------
+    def wait(self, timeout=None):
+        """Block until every enqueued checkpoint is committed; re-raises
+        the first writer error (fit's end-of-training flush). `timeout`
+        is one SHARED deadline across all outstanding writes. Completed
+        handles are consumed — their errors surface exactly once; a
+        still-in-flight handle at timeout goes back on the tracked list
+        so a later wait()/atexit flush still covers it."""
+        with self._lock:
+            handles = list(self._handles)
+            self._handles.clear()
+        deadline = None if timeout is None else time.time() + timeout
+        err = None
+        unfinished = []
+        for h in handles:
+            if h._observed:
+                continue  # its error was already raised at handle.wait()
+            try:
+                remaining = None if deadline is None \
+                    else max(0.0, deadline - time.time())
+                h.wait(remaining)
+            except BaseException as e:
+                if not h.done():
+                    unfinished.append(h)
+                err = err or e
+        if unfinished:
+            with self._lock:
+                self._handles.extend(unfinished)
+        if err is not None:
+            raise err
+
+    flush = wait
+
+    def _atexit_flush(self):
+        try:
+            self.wait(timeout=60.0)
+        except Exception as e:
+            self.logger.error("checkpoint flush at exit: %s", e)
+
+    # ------------------------------------------------------------------
+    # discovery / retention
+    # ------------------------------------------------------------------
+    def all_steps(self):
+        return [s for s, _ in layout.list_checkpoints(self.directory)]
+
+    def latest_step(self):
+        return layout.latest_step(self.directory)
+
+    def latest_path(self):
+        return layout.latest_checkpoint(self.directory)
+
+    def _prune(self):
+        ckpts = layout.list_checkpoints(self.directory)
+        if not ckpts:
+            return
+        if self.keep_last_n is None:
+            # unbounded retention: keep_every_k_steps only ADDS milestone
+            # pins when a bound exists — alone it must not prune anything
+            return
+        steps = [s for s, _ in ckpts]
+        boundary = []
+        for s, path in ckpts:
+            try:
+                if not layout.read_meta(path).get("mid_epoch"):
+                    boundary.append(s)
+            except Exception:
+                boundary.append(s)  # unreadable meta: keep conservative
+        keep = {steps[-1]}  # the latest is always retained...
+        if boundary:
+            # ...and so is the newest EPOCH-BOUNDARY checkpoint: resume()
+            # skips mid_epoch snapshots, so with keep_last_n=1 a SIGTERM
+            # flush must not evict the only checkpoint resume can use
+            keep.add(boundary[-1])
+        if self.keep_last_n:
+            keep.update(steps[-self.keep_last_n:])
+        if self.keep_every_k_steps:
+            keep.update(s for s in steps
+                        if s % self.keep_every_k_steps == 0)
+        layout.prune(self.directory, keep)
+
+    # ------------------------------------------------------------------
+    # restore
+    # ------------------------------------------------------------------
+    def restore(self, step=None):
+        """Load a committed checkpoint (`step=None`: the latest). Returns
+        a RestoredCheckpoint, or None when the directory has none."""
+        if step is None:
+            path = layout.latest_checkpoint(self.directory)
+        else:
+            path = layout.step_path(self.directory, step)
+            if not layout.is_committed(path):
+                raise MXNetError("no committed checkpoint for step %d under "
+                                 "%s" % (step, self.directory))
+        if path is None:
+            return None
+        return self._load(path)
+
+    def _load(self, path):
+        import numpy as _np
+        meta = layout.read_meta(path)
+        symbol = None
+        sym_file = os.path.join(path, layout.SYMBOL_FILE)
+        if os.path.isfile(sym_file):
+            from .. import symbol as sym_mod
+            symbol = sym_mod.load(sym_file)
+        arg_params, aux_params = state_mod.load_params_files(path, meta)
+        optimizer = None
+        opt_file = os.path.join(path, layout.OPTIMIZER_FILE)
+        if os.path.isfile(opt_file):
+            with open(opt_file, "rb") as f:
+                optimizer = state_mod._parse_opt_payload(f.read())
+        rng_key = None
+        if meta.get("rng_key") is not None:
+            rng_key = _np.asarray(meta["rng_key"], _np.uint32).reshape(
+                meta.get("rng_key_shape", [-1]))
+        return RestoredCheckpoint(path, meta, symbol, arg_params, aux_params,
+                                  optimizer, rng_key)
+
+    def restore_module(self, module, step=None, restore_rng=True):
+        """Restore params + optimizer slots + RNG chain onto a bound,
+        initialized Module. Returns the checkpoint's meta dict, or None
+        when nothing is committed yet."""
+        data = self.restore(step)
+        if data is None:
+            return None
+        module.set_params(data.arg_params, data.aux_params)
+        kv = getattr(module, "_kvstore", None)
+        if kv is not None and getattr(module, "_update_on_kvstore", False) \
+                and hasattr(kv, "_store"):
+            # local-store update_on_kvstore: the STORE owns the weights the
+            # next push/pull round-trips through — refresh its copies or
+            # the restored params are clobbered by the first update
+            for name, val in data.arg_params.items():
+                if name in kv._store:
+                    kv.init(name, val)
+        if data.optimizer is not None and \
+                getattr(module, "optimizer_initialized", False):
+            if data.optimizer.get("kind") == "kvstore":
+                kv = getattr(module, "_kvstore", None)
+                if kv is not None and hasattr(kv, "restore_checkpoint"):
+                    kv.restore_checkpoint(data.path)
+                state_mod.restore_optimizer_attrs(
+                    getattr(module, "_optimizer", None),
+                    data.optimizer.get("optimizer"))
+            else:
+                state_mod.apply_optimizer_payload(module, data.optimizer)
+        if restore_rng and data.rng_key is not None:
+            from .. import random as _rnd
+            _rnd.set_key(data.rng_key)
+        return data.meta
+
+    def restore_trainer(self, trainer, step=None, restore_rng=True):
+        """Restore gluon Trainer parameter data + updater slots."""
+        data = self.restore(step)
+        if data is None:
+            return None
+        blob = data.optimizer
+        state_mod.apply_to_trainer(trainer, data.arg_params, blob,
+                                   ckpt_path=data.path)
+        if restore_rng and data.rng_key is not None:
+            from .. import random as _rnd
+            _rnd.set_key(data.rng_key)
+        return data.meta
+
+    def resume(self, module, default_begin_epoch=0):
+        """fit() auto-resume: restore the newest EPOCH-BOUNDARY checkpoint
+        and return the epoch to continue from. Mid-epoch preemption
+        snapshots (meta mid_epoch=true) are skipped — re-running the
+        interrupted epoch from its boundary state is what keeps resumed
+        training bit-identical to an uninterrupted run."""
+        for step, path in reversed(layout.list_checkpoints(self.directory)):
+            meta = layout.read_meta(path)
+            if meta.get("mid_epoch"):
+                continue
+            self.restore_module(module, step=step)
+            epoch = meta.get("epoch")
+            self.logger.info("checkpoint resume: step %d from %s", step, path)
+            if epoch is None:
+                return default_begin_epoch
+            return max(default_begin_epoch, int(epoch) + 1)
+        return default_begin_epoch
+
+    # ------------------------------------------------------------------
+    # preemption
+    # ------------------------------------------------------------------
+    def set_live_capture(self, capture):
+        """`capture() -> save(**kwargs)` provider the preemption hook uses
+        for its final flush (fit points this at the live module/epoch)."""
+        self._live_capture = capture
+
+    def install_preemption_hook(self, signals=None, capture=None):
+        """Install signal handlers that flush one final checkpoint (the
+        live capture, marked `mid_epoch`), drain the writer queue, then
+        chain to the previous handler (or exit). SIGTERM is what cloud
+        preemption sends; the handler must run on the main thread."""
+        import signal as _signal
+        if signals is None:
+            sig = self.preemption_signal
+            if sig in (None, False, True):
+                sig = _signal.SIGTERM
+            signals = (sig,)
+
+        def _handler(signum, frame):
+            self.logger.warning("signal %d: flushing final checkpoint",
+                                signum)
+            try:
+                # drain queued boundary saves FIRST: the mid-epoch flush
+                # below may reuse the current epoch's step number, and a
+                # concurrent in-queue write of that step would race the
+                # blocking save for the commit
+                try:
+                    self.wait(timeout=300.0)
+                except Exception as e:
+                    self.logger.error("preemption flush: %s", e)
+                cap = capture or self._live_capture
+                if cap is not None:
+                    kwargs = dict(cap())
+                    kwargs.setdefault("blocking", True)
+                    kwargs.setdefault("mid_epoch", True)
+                    kwargs.setdefault("preempted", True)
+                    step = kwargs.get("step")
+                    committed = layout.step_path(self.directory, step) \
+                        if step is not None else None
+                    if committed is not None \
+                            and layout.is_committed(committed) \
+                            and not layout.read_meta(committed).get(
+                                "mid_epoch"):
+                        # this step's epoch-BOUNDARY checkpoint already
+                        # landed — never replace it with a mid-epoch
+                        # snapshot (resume() depends on boundary state)
+                        self.logger.info("step %s already committed; "
+                                         "skipping preemption snapshot",
+                                         step)
+                    else:
+                        self.save(**kwargs)
+            finally:
+                prev = self._prev_handlers.get(signum)
+                if callable(prev):
+                    prev(signum, frame)
+                elif prev != _signal.SIG_IGN:
+                    raise SystemExit(128 + signum)
+
+        for sig in signals:
+            self._prev_handlers[sig] = _signal.signal(sig, _handler)
+        return signals
+
+    def uninstall_preemption_hook(self):
+        import signal as _signal
+        for sig, prev in self._prev_handlers.items():
+            _signal.signal(sig, prev if prev is not None else _signal.SIG_DFL)
+        self._prev_handlers.clear()

@@ -1,0 +1,568 @@
+"""Training-state capture/restore: params, optimizer slots, RNG, schedules.
+
+The capture functions run on the TRAINING thread and must be near-free:
+they pin the current jax buffers in fresh NDArray wrappers (NDArray
+mutation is buffer *swap* over immutable jax arrays, so a pinned buffer
+is a point-in-time view no later training step can touch — no copy is
+made). All serialization (device→host materialization, pickling, file
+IO) happens later on the manager's writer thread.
+
+Optimizer state is saved as a tagged payload covering every update path
+the framework has:
+
+* ``kind="updater"`` — `optimizer.Updater` per-index state slots
+  (including `create_state_multi_precision` master-weight tuples) plus
+  the optimizer object itself (num_update / per-index update counts /
+  lr_scheduler, so schedules resume bit-exactly).
+* ``kind="fused"``  — the fused tpu_step opt_state tree + optimizer.
+* ``kind="kvstore"`` — state lives server-side (dist_async); the
+  checkpoint carries per-server snapshot files instead (kvshard.py).
+
+Legacy payloads (raw pickles written by older `save_optimizer_states`:
+a bare states dict, a ``(states, optimizer)`` tuple, or the fused
+``{"fused": ..., "state": ...}`` blob) stay loadable.
+"""
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array, _new_from_jax
+from . import layout
+
+__all__ = ["TrainingState", "snapshot_params", "snapshot_tree",
+           "tree_to_numpy", "tree_from_numpy", "capture_module",
+           "capture_params", "capture_trainer", "apply_to_trainer",
+           "optimizer_payload_bytes", "apply_optimizer_payload",
+           "updater_payload_bytes", "apply_updater_payload",
+           "save_params_files", "load_params_files", "from_legacy"]
+
+_OPT_FORMAT_KEY = "mx_ckpt_opt"
+
+
+# ---------------------------------------------------------------------------
+# snapshot / conversion trees
+# ---------------------------------------------------------------------------
+
+def snapshot_tree(x):
+    """Point-in-time snapshot of a state tree: NDArray leaves get fresh
+    wrappers pinning the CURRENT immutable jax buffer (zero-copy);
+    containers are shallow-copied; numpy leaves are copied (mutable);
+    scalars/None pass through."""
+    if isinstance(x, NDArray):
+        from ..ndarray import sparse as _sp
+        if isinstance(x, _sp.RowSparseNDArray):
+            # sparse state is rare; a host densification keeps the
+            # snapshot self-contained
+            return array(x.asnumpy())
+        return _new_from_jax(x._data)
+    if isinstance(x, dict):
+        return {k: snapshot_tree(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return type(x)(snapshot_tree(v) for v in x)
+    if isinstance(x, _np.ndarray):
+        return x.copy()
+    return x
+
+
+def snapshot_params(params):
+    """Pin every NDArray in a name->NDArray dict (see snapshot_tree)."""
+    return {k: snapshot_tree(v) for k, v in (params or {}).items()}
+
+
+def tree_to_numpy(x):
+    """Materialize a (possibly pinned) state tree on the host: NDArray
+    and jax leaves become numpy. Runs on the writer thread."""
+    if isinstance(x, NDArray):
+        return _np.asarray(x.asnumpy())
+    if isinstance(x, dict):
+        return {k: tree_to_numpy(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return type(x)(tree_to_numpy(v) for v in x)
+    import jax
+    if isinstance(x, jax.Array):
+        return _np.asarray(x)
+    return x
+
+
+def tree_from_numpy(x):
+    """Inverse of tree_to_numpy for optimizer state slots: numpy array
+    leaves come back as NDArray (update math expects them)."""
+    if isinstance(x, _np.ndarray):
+        return array(x)
+    if isinstance(x, dict):
+        return {k: tree_from_numpy(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return type(x)(tree_from_numpy(v) for v in x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# optimizer payloads
+# ---------------------------------------------------------------------------
+
+def _clean_optimizer(opt):
+    """Pickle-safe POINT-IN-TIME copy: gluon Parameters in param_dict
+    hold live device state and symbol handles — drop them (the loader
+    reattaches the current param_dict) — then DEEP-copy the rest. The
+    deep copy is what makes an async save correct: the live optimizer
+    keeps mutating `_index_update_count` and the lr_scheduler's internal
+    counters while the writer thread serializes, and a shallow copy
+    would leak those later updates into this checkpoint. Everything left
+    after dropping param_dict is small host data (dicts, scalars, the
+    scheduler object). Non-Optimizer values (the fused tpu_step names
+    its optimizer by string) pass through."""
+    if opt is None or not hasattr(opt, "param_dict"):
+        return opt
+    out = copy.copy(opt)
+    out.param_dict = {}
+    return copy.deepcopy(out)
+
+
+def restore_optimizer_attrs(dst, src):
+    """Carry the resume-critical schedule state from a restored optimizer
+    object onto the live one: update counters (lr schedules key on
+    num_update), hyperparameters, per-name multipliers, and the
+    lr_scheduler object itself (FactorScheduler et al. carry internal
+    counters)."""
+    if dst is None or src is None or dst is src:
+        return
+    if not hasattr(dst, "__dict__") or not hasattr(src, "__dict__"):
+        return  # string-named optimizers (fused step) carry no schedule
+    for attr in ("num_update", "begin_num_update", "lr", "wd"):
+        if hasattr(src, attr):
+            setattr(dst, attr, getattr(src, attr))
+    for attr in ("_index_update_count", "lr_mult", "wd_mult", "idx2name"):
+        if hasattr(src, attr):
+            setattr(dst, attr, dict(getattr(src, attr)))
+    if getattr(src, "lr_scheduler", None) is not None:
+        dst.lr_scheduler = src.lr_scheduler
+
+
+def capture_optimizer(mod):
+    """(payload dict with pinned trees, extra_writers) for a Module's
+    optimizer state; payload is None when no optimizer is initialized."""
+    if not getattr(mod, "optimizer_initialized", False):
+        return None, []
+    if getattr(mod, "_fused_step", None) is not None:
+        step = mod._fused_step
+        # opt_state is replaced functionally every iteration — holding
+        # the current tree IS the point-in-time snapshot
+        return {_OPT_FORMAT_KEY: 1, "kind": "fused",
+                "optimizer": _clean_optimizer(step.optimizer),
+                "state": step.opt_state}, []
+    if getattr(mod, "_update_on_kvstore", False) and mod._kvstore is not None:
+        kv = mod._kvstore
+        if hasattr(kv, "save_checkpoint"):
+            # dist_async: slots live on remote servers; they snapshot
+            # themselves into the checkpoint dir (kvshard.py)
+            return {_OPT_FORMAT_KEY: 1, "kind": "kvstore",
+                    "optimizer": _clean_optimizer(
+                        getattr(mod, "_optimizer", None))}, \
+                [kv.save_checkpoint]
+        kv_updater = getattr(kv, "_updater", None)
+        if kv_updater is not None:
+            # local kvstore: the updater (and its slots) lives in-process
+            # on the store — capture it like the worker-side path, or a
+            # resumed run would silently restart with zeroed slots
+            return {_OPT_FORMAT_KEY: 1, "kind": "updater",
+                    "optimizer": _clean_optimizer(kv_updater.optimizer),
+                    "states": snapshot_tree(kv_updater.states)}, []
+        return {_OPT_FORMAT_KEY: 1, "kind": "kvstore",
+                "optimizer": _clean_optimizer(getattr(mod, "_optimizer",
+                                                      None))}, []
+    updater = getattr(mod, "_updater", None)
+    if updater is None:
+        return None, []
+    return {_OPT_FORMAT_KEY: 1, "kind": "updater",
+            "optimizer": _clean_optimizer(updater.optimizer),
+            "states": snapshot_tree(updater.states)}, []
+
+
+def optimizer_payload_bytes(mod):
+    """Serialized optimizer payload for a Module (host-side pickle).
+    Used by Module.save_optimizer_states for the fused/updater paths."""
+    payload, _ = capture_optimizer(mod)
+    if payload is None:
+        raise MXNetError("module has no optimizer state to save")
+    return _serialize_opt_payload(payload)
+
+
+def _serialize_opt_payload(payload):
+    out = dict(payload)
+    for key in ("states", "state"):
+        if key in out:
+            out[key] = tree_to_numpy(out[key])
+    return pickle.dumps(out, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _parse_opt_payload(blob):
+    """Normalize new-format and every legacy optimizer-states pickle into
+    the tagged payload dict."""
+    payload = pickle.loads(blob) if isinstance(blob, (bytes, bytearray)) \
+        else blob
+    if isinstance(payload, dict) and payload.get(_OPT_FORMAT_KEY):
+        return payload
+    if isinstance(payload, dict) and "fused" in payload and "state" in payload:
+        return {_OPT_FORMAT_KEY: 1, "kind": "fused",
+                "optimizer": payload["fused"], "state": payload["state"]}
+    if isinstance(payload, tuple) and len(payload) == 2:
+        # reference get_states(dump_optimizer=True): (states, optimizer)
+        return {_OPT_FORMAT_KEY: 1, "kind": "updater",
+                "optimizer": payload[1], "states": payload[0]}
+    if isinstance(payload, dict):
+        # reference get_states(): bare per-index states dict
+        return {_OPT_FORMAT_KEY: 1, "kind": "updater", "optimizer": None,
+                "states": payload}
+    raise MXNetError("unrecognized optimizer states payload (%s)"
+                     % type(payload).__name__)
+
+
+def apply_optimizer_payload(mod, blob):
+    """Restore a Module's optimizer state from payload bytes/dict
+    (fused and updater kinds; the kvstore kind restores through
+    KVStoreDistAsync.restore_checkpoint — see manager.restore_module)."""
+    payload = _parse_opt_payload(blob)
+    kind = payload["kind"]
+    if kind == "fused":
+        if getattr(mod, "_fused_step", None) is None:
+            raise MXNetError("checkpoint holds fused-step optimizer state "
+                             "but this module has no fused step")
+        import jax
+        from jax.tree_util import tree_map
+        state_np = tree_to_numpy(payload["state"])
+        # restore with the step's own sharding layout: the jitted program
+        # pins dp-sharded in_shardings, a replicated restore would fail
+        # the sharding match on the next step
+        mod._fused_step.opt_state = tree_map(
+            lambda sh, v: jax.device_put(v, sh),
+            mod._fused_step._state_shardings(), state_np)
+        restore_optimizer_attrs(mod._fused_step.optimizer,
+                                payload.get("optimizer"))
+        if getattr(mod, "_optimizer", None) is not None:
+            restore_optimizer_attrs(mod._optimizer, payload.get("optimizer"))
+        return
+    if kind == "updater":
+        if getattr(mod, "_fused_step", None) is not None:
+            # a fused module also carries an (unused) _updater — loading
+            # worker-updater slots into it would report success while the
+            # fused step keeps its zeroed opt_state
+            raise MXNetError("optimizer states hold worker-updater slots "
+                             "but this module trains with the fused step")
+        updater = getattr(mod, "_updater", None)
+        if updater is None:
+            # update_on_kvstore with a LOCAL store: the updater lives on
+            # the kvstore in this process
+            updater = getattr(getattr(mod, "_kvstore", None), "_updater",
+                              None)
+        if updater is None:
+            raise MXNetError("checkpoint holds worker-side optimizer state "
+                             "but this module updates on the kvstore")
+        updater.states = tree_from_numpy(payload["states"])
+        updater.states_synced = {k: False for k in updater.states}
+        restore_optimizer_attrs(updater.optimizer, payload.get("optimizer"))
+        if getattr(mod, "_optimizer", None) is not None and \
+                mod._optimizer is not updater.optimizer:
+            restore_optimizer_attrs(mod._optimizer, payload.get("optimizer"))
+        return
+    if kind == "kvstore":
+        raise MXNetError("optimizer state of this checkpoint lives on "
+                         "dist_async servers; restore through "
+                         "CheckpointManager.restore_module (it routes to "
+                         "kvstore.restore_checkpoint)")
+    raise MXNetError("unknown optimizer payload kind %r" % (kind,))
+
+
+# -- gluon Trainer (Updater-based) payloads ---------------------------------
+
+def updater_payload_bytes(updater, dump_optimizer=False):
+    """Serialized state payload for an `optimizer.Updater` (gluon Trainer
+    save_states). Captures multi-precision slots and, with
+    `dump_optimizer`, the optimizer's schedule counters."""
+    payload = {_OPT_FORMAT_KEY: 1, "kind": "updater",
+               "optimizer": _clean_optimizer(updater.optimizer)
+               if dump_optimizer else None,
+               "states": snapshot_tree(updater.states)}
+    return _serialize_opt_payload(payload)
+
+
+def apply_updater_payload(updater, blob):
+    """Restore an Updater from payload bytes (new or legacy format).
+    Returns the restored optimizer object when the payload carried one
+    (caller decides whether to adopt it), else None."""
+    payload = _parse_opt_payload(blob)
+    if payload["kind"] != "updater":
+        raise MXNetError("payload kind %r cannot restore an Updater"
+                         % (payload["kind"],))
+    updater.states = tree_from_numpy(payload["states"])
+    updater.states_synced = {k: False for k in updater.states}
+    opt = payload.get("optimizer")
+    if opt is not None:
+        restore_optimizer_attrs(updater.optimizer, opt)
+    return opt
+
+
+# ---------------------------------------------------------------------------
+# params files (shard-aware)
+# ---------------------------------------------------------------------------
+
+def _jax_process_info():
+    try:
+        import jax
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def _addressable_entries(name, nd):
+    """[(entry_key, index_meta, host_array)] for one parameter. On a
+    single host this is one full-array entry. Under a multi-host mesh
+    each process stores only the shards its devices hold, tagged with
+    their global slice so restore can reassemble (and reshard under a
+    different device count)."""
+    data = getattr(nd, "_data", None)
+    sharding = getattr(data, "sharding", None)
+    if data is None or sharding is None or data.is_fully_addressable:
+        return [(name, None, None)]  # full array, materialized lazily
+    shape = tuple(int(s) for s in data.shape)
+    entries, seen = [], set()
+    for i, shard in enumerate(data.addressable_shards):
+        index = tuple(
+            (0 if sl.start is None else int(sl.start),
+             dim if sl.stop is None else int(sl.stop))
+            for sl, dim in zip(shard.index, shape))
+        if index in seen:  # replicated shard — store once
+            continue
+        seen.add(index)
+        entries.append(("%s@%d" % (name, i),
+                        {"global_shape": list(shape),
+                         "index": [list(p) for p in index]},
+                        _np.asarray(shard.data)))
+    return entries
+
+
+def save_params_files(path, arg_params, aux_params):
+    """Write the checkpoint's param section under `path`. Returns the
+    shard-index metadata to embed in the manifest ({} when the plain
+    single-file layout was used).
+
+    Single-host (including fully-addressable mesh shardings): one
+    `params.nd` in the legacy arg:/aux: container — directly importable
+    by `model.load_params`. Multi-host: `params.hostK-of-N.nd` per
+    process holding only addressable shards plus slice metadata."""
+    from ..model import save_params
+    host, num_hosts = _jax_process_info()
+    flat, sharded_meta = {}, {}
+    for prefix, params in (("arg:", arg_params), ("aux:", aux_params)):
+        for name, nd in (params or {}).items():
+            for entry_key, index_meta, data in _addressable_entries(name, nd):
+                if index_meta is None:
+                    flat[prefix + entry_key] = nd
+                else:
+                    flat[prefix + entry_key] = array(data)
+                    sharded_meta.setdefault(prefix + name, {
+                        "global_shape": index_meta["global_shape"],
+                        "entries": []})["entries"].append(
+                            {"key": prefix + entry_key,
+                             "index": index_meta["index"]})
+    if num_hosts == 1 and not sharded_meta:
+        fname = os.path.join(path, layout.PARAMS_FILE)
+    else:
+        fname = os.path.join(path, layout.host_params_file(host, num_hosts))
+        if sharded_meta:
+            # per-host shard index SIDECAR: the manifest is written by
+            # the coordinator, which cannot know the other hosts' slice
+            # layouts — restore merges every sidecar instead
+            import json
+            with open(fname[:-3] + ".json", "w") as f:
+                json.dump(sharded_meta, f)
+    arg_out = {k[4:]: v for k, v in flat.items() if k.startswith("arg:")}
+    aux_out = {k[4:]: v for k, v in flat.items() if k.startswith("aux:")}
+    save_params(fname, arg_out, aux_out)
+    return sharded_meta
+
+
+def load_params_files(path, meta=None):
+    """(arg_params, aux_params) reassembled from a checkpoint dir. Reads
+    the single-file layout directly; for the multi-host shard layout it
+    stitches every host file's slices back into full host arrays — the
+    caller re-device_puts under whatever mesh/device count is live now,
+    which is how restore-under-a-different-topology works."""
+    from ..model import load_params
+    single = os.path.join(path, layout.PARAMS_FILE)
+    if os.path.isfile(single):
+        return load_params(single)
+    host_files = layout.list_host_params_files(path)
+    if not host_files:
+        raise MXNetError("checkpoint %s has no params file" % path)
+    sharded_meta = dict((meta or {}).get("sharded_params")
+                        or layout.read_meta(path).get("sharded_params", {}))
+    pieces = {}
+    import json
+    for _, _, fname in host_files:
+        # merge each host's shard-index sidecar: the manifest only knows
+        # the coordinator's slices
+        sidecar = fname[:-3] + ".json"
+        if os.path.isfile(sidecar):
+            with open(sidecar) as f:
+                for full_key, spec in json.load(f).items():
+                    if full_key in sharded_meta:
+                        # dedupe by entry key: the coordinator's slices
+                        # appear in both the manifest and its sidecar
+                        merged = {e["key"]: e for e in
+                                  sharded_meta[full_key]["entries"]}
+                        merged.update({e["key"]: e
+                                       for e in spec["entries"]})
+                        sharded_meta[full_key] = {
+                            "global_shape": spec["global_shape"],
+                            "entries": list(merged.values())}
+                    else:
+                        sharded_meta[full_key] = spec
+        arg_p, aux_p = load_params(fname)
+        for prefix, part in (("arg:", arg_p), ("aux:", aux_p)):
+            for key, nd in part.items():
+                pieces[prefix + key] = nd.asnumpy()
+    arg_params, aux_params = {}, {}
+    for full_key, spec in sharded_meta.items():
+        out = None
+        for entry in spec["entries"]:
+            if entry["key"] not in pieces:
+                raise MXNetError("checkpoint %s is missing shard %s (host "
+                                 "file not written?)" % (path, entry["key"]))
+            if out is None:
+                data = pieces[entry["key"]]
+                out = _np.empty(tuple(spec["global_shape"]), data.dtype)
+            sl = tuple(slice(s, e) for s, e in entry["index"])
+            out[sl] = pieces.pop(entry["key"])
+        dst = arg_params if full_key.startswith("arg:") else aux_params
+        dst[full_key[4:]] = array(out)
+    for key, nd in pieces.items():  # unsharded entries in host files
+        if "@" in key.rsplit(":", 1)[-1]:
+            continue
+        dst = arg_params if key.startswith("arg:") else aux_params
+        dst[key[4:]] = nd if isinstance(nd, NDArray) else array(nd)
+    return arg_params, aux_params
+
+
+# ---------------------------------------------------------------------------
+# TrainingState + capture entry points
+# ---------------------------------------------------------------------------
+
+class TrainingState:
+    """Everything one resumable checkpoint carries, pre-pinned and ready
+    for the writer thread."""
+
+    __slots__ = ("arg_params", "aux_params", "symbol_json", "optimizer",
+                 "extra_writers", "rng_key", "epoch", "step", "meta_extra")
+
+    def __init__(self, arg_params=None, aux_params=None, symbol_json=None,
+                 optimizer=None, extra_writers=(), rng_key=None, epoch=None,
+                 step=None, meta_extra=None):
+        self.arg_params = arg_params or {}
+        self.aux_params = aux_params or {}
+        self.symbol_json = symbol_json
+        self.optimizer = optimizer
+        self.extra_writers = list(extra_writers)
+        self.rng_key = rng_key
+        self.epoch = epoch
+        self.step = step
+        self.meta_extra = dict(meta_extra or {})
+
+
+def _current_rng_key():
+    from .. import random as _rnd
+    return _np.asarray(_rnd.current_key())
+
+
+def capture_params(symbol=None, arg_params=None, aux_params=None, epoch=None,
+                   step=None, **meta_extra):
+    """TrainingState from explicit parts (callback.do_checkpoint path)."""
+    return TrainingState(
+        arg_params=snapshot_params(arg_params),
+        aux_params=snapshot_params(aux_params),
+        symbol_json=symbol.tojson() if symbol is not None else None,
+        rng_key=_current_rng_key(), epoch=epoch, step=step,
+        meta_extra=meta_extra)
+
+
+def capture_module(mod, epoch=None, step=None, arg_params=None,
+                   aux_params=None, **meta_extra):
+    """Full TrainingState from a Module: params (+aux), optimizer slots,
+    RNG key. `arg_params`/`aux_params` may pass pre-pulled host dicts
+    (fit's epoch-end snapshot) to skip a second device sync."""
+    if arg_params is None or aux_params is None:
+        arg_params, aux_params = mod.get_params()
+    payload, writers = capture_optimizer(mod)
+    symbol = getattr(mod, "symbol", None)
+    return TrainingState(
+        arg_params=snapshot_params(arg_params),
+        aux_params=snapshot_params(aux_params),
+        symbol_json=symbol.tojson() if symbol is not None else None,
+        optimizer=payload, extra_writers=writers,
+        rng_key=_current_rng_key(), epoch=epoch, step=step,
+        meta_extra=meta_extra)
+
+
+def capture_trainer(trainer, step=None, epoch=None, **meta_extra):
+    """TrainingState from a gluon Trainer: parameter data + updater
+    slots. Parameters save under their gluon names as arg params."""
+    arg_params = {}
+    for param in trainer._params:
+        if param._data is not None:
+            arg_params[param.name] = param.data(param.list_ctx()[0])
+    if trainer._update_on_kvstore and trainer._kvstore is not None:
+        payload = {_OPT_FORMAT_KEY: 1, "kind": "kvstore",
+                   "optimizer": _clean_optimizer(trainer._optimizer)}
+        writers = [trainer._kvstore.save_checkpoint] \
+            if hasattr(trainer._kvstore, "save_checkpoint") else []
+    else:
+        payload = {_OPT_FORMAT_KEY: 1, "kind": "updater",
+                   "optimizer": _clean_optimizer(trainer._optimizer),
+                   "states": snapshot_tree(trainer._updaters[0].states)}
+        writers = []
+    return TrainingState(
+        arg_params=snapshot_params(arg_params), optimizer=payload,
+        extra_writers=writers, rng_key=_current_rng_key(), epoch=epoch,
+        step=step, meta_extra=meta_extra)
+
+
+def apply_to_trainer(trainer, arg_params, optimizer_blob, ckpt_path=None):
+    """Restore a gluon Trainer: parameter data by name, then updater
+    slots/optimizer schedule (or server-side state via the kvstore)."""
+    for param in trainer._params:
+        if param.name in arg_params and param._data is not None:
+            param.set_data(arg_params[param.name])
+    if optimizer_blob is None:
+        return
+    payload = _parse_opt_payload(optimizer_blob)
+    if payload["kind"] == "kvstore":
+        if not trainer._kv_initialized:
+            trainer._init_kvstore()
+        kv = trainer._kvstore
+        if kv is None or not hasattr(kv, "restore_checkpoint"):
+            raise MXNetError("checkpoint holds kvstore-side optimizer state "
+                             "but this trainer has no dist_async kvstore")
+        kv.restore_checkpoint(ckpt_path)
+        restore_optimizer_attrs(trainer._optimizer, payload.get("optimizer"))
+        return
+    for updater in trainer._updaters:
+        apply_updater_payload(updater, payload)
+    opt = payload.get("optimizer")
+    if opt is not None:
+        restore_optimizer_attrs(trainer._optimizer, opt)
+
+
+def from_legacy(prefix, epoch):
+    """TrainingState imported from a reference-format two-file checkpoint
+    (`prefix-symbol.json` + `prefix-%04d.params`)."""
+    from ..model import load_checkpoint
+    symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+    return TrainingState(
+        arg_params=arg_params, aux_params=aux_params,
+        symbol_json=symbol.tojson() if symbol is not None else None,
+        epoch=epoch, step=epoch,
+        meta_extra={"legacy_source": os.path.abspath(prefix)})

@@ -154,23 +154,45 @@ class Trainer:
                 upd(i, grad, arr)
 
     def save_states(self, fname):
+        """Atomic full-state save through checkpoint/state.py: per-index
+        slots (incl. multi-precision master weights) plus the optimizer's
+        num_update / per-index counters and lr scheduler, so a reloaded
+        trainer's schedule continues bit-exactly. With
+        `update_on_kvstore` the state lives server-side and dist_async
+        snapshots it there (kvstore_async.save_optimizer_states)."""
         assert self._optimizer is not None
         if self._update_on_kvstore and self._kvstore:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
             from ..base import atomic_write
-            atomic_write(fname,
-                         self._updaters[0].get_states(dump_optimizer=True))
+            from ..checkpoint.state import updater_payload_bytes
+            atomic_write(fname, updater_payload_bytes(self._updaters[0],
+                                                      dump_optimizer=True))
 
     def load_states(self, fname):
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore and self._kvstore:
             self._kvstore.load_optimizer_states(fname)
-            self._optimizer = self._kvstore._updater.optimizer
-        else:
-            with open(fname, "rb") as f:
-                states = f.read()
+            return
+        from ..checkpoint.state import (apply_updater_payload,
+                                        _parse_opt_payload)
+        with open(fname, "rb") as f:
+            payload = _parse_opt_payload(f.read())  # parse ONCE, not per
+        restored = None                             # device updater
+        for updater in self._updaters:
+            restored = apply_updater_payload(updater, payload)
+        if restored is not None:
+            # adopt the checkpointed optimizer (schedule counters and
+            # all), reattached to the LIVE parameters
+            restored.param_dict = {i: p for i, p in enumerate(self._params)}
+            self._optimizer = restored
             for updater in self._updaters:
-                updater.set_states(states)
+                updater.optimizer = restored
+            # the fused update captured the OLD optimizer object at build
+            # time — drop it so the next step rebuilds against the
+            # restored one (otherwise hyperparams/counters diverge)
+            self._fused_update = None
+        else:
+            for updater in self._updaters:
                 updater.optimizer = self._optimizer

@@ -17,8 +17,9 @@ construction), so it gets an actual server:
 Topology: N independent server processes with deterministic client-side
 key placement (reference `kvstore_dist.h:151` PSKV semantics):
 
-* arrays smaller than `MXNET_KVSTORE_BIGARRAY_BOUND` (default 1e6 bytes,
-  reference `docs/faq/env_var.md`) live whole on `hash(key) % N`;
+* arrays smaller than `MXNET_KVSTORE_BIGARRAY_BOUND` (default 1e6
+  ELEMENTS — the reference compares `size()`, not bytes; see
+  `docs/faq/env_var.md`) live whole on `hash(key) % N`;
 * bigger arrays split into N near-equal leading-axis slices, one per
   server — every server then shares the update work of the hot weights,
   which is exactly what made the reference's PS scale. Slices keep ROW
@@ -49,6 +50,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import re
 import socket
 import struct
 import threading
@@ -222,6 +224,26 @@ class AsyncParamServer:
                             "%s of %d (a worker crashed?)"
                             % (seen, missing, self.num_workers))
             return ("ok",)
+        if op == "snapshot":
+            # write this server's addressable shard of the training state
+            # (weights + optimizer slots) to an atomic file — the
+            # server-side half of checkpoint/kvshard.py
+            _, path, sid, n = msg
+            with self._lock:
+                self._snapshot_to(path, sid, n)
+            return ("ok", path)
+        if op == "restore":
+            _, path = msg
+            with self._lock:
+                self._restore_from(path)
+            return ("ok",)
+        if op == "install":
+            # resharded restore: entries computed by the worker for THIS
+            # server under a new topology
+            _, entries, opt_payload = msg
+            with self._lock:
+                self._install_entries(entries, opt_payload)
+            return ("ok",)
         if op == "stats":
             with self._lock:
                 return ("ok", {"push_count": self._push_count,
@@ -230,6 +252,64 @@ class AsyncParamServer:
             self._done.set()
             return ("ok",)
         raise MXNetError("unknown server op %r" % (op,))
+
+    # -- checkpoint (server side; see checkpoint/kvshard.py) ---------------
+
+    def _state_blob(self, sid, n):
+        """Snapshot blob of this server's weights + optimizer slots.
+        Caller holds the state lock. State slots key on the STRIPPED
+        updater key (one shard of a key per server, so the pairing
+        subkey -> state is unique)."""
+        from .checkpoint.state import tree_to_numpy
+        entries = {}
+        states = self._updater.states if self._updater is not None else {}
+        for subkey, weight in self._weights.items():
+            entries[subkey] = {
+                "weight": _np.asarray(weight),
+                "state": tree_to_numpy(states.get(_updater_key(subkey)))}
+        optimizer = None
+        if self._updater is not None:
+            opt = self._updater.optimizer
+            try:
+                optimizer = pickle.dumps(opt)
+            except Exception:  # unpicklable custom optimizer: weights-only
+                optimizer = None
+        return {"format": 1, "server": sid, "num_servers": n,
+                "entries": entries, "optimizer": optimizer,
+                "push_count": self._push_count}
+
+    def _snapshot_to(self, path, sid, n):
+        from .base import atomic_write
+        atomic_write(path, pickle.dumps(self._state_blob(sid, n),
+                                        protocol=pickle.HIGHEST_PROTOCOL))
+
+    def _install_entries(self, entries, opt_payload):
+        from .checkpoint.state import tree_from_numpy
+        if opt_payload is not None:
+            # the checkpoint's optimizer carries num_update / per-key
+            # counters — adopt it (reference load_optimizer_states
+            # semantics), replacing any freshly set_optimizer'd one
+            from . import optimizer as opt_mod
+            self._updater = opt_mod.get_updater(pickle.loads(opt_payload))
+        for subkey, weight, state in entries:
+            self._weights[subkey] = _np.asarray(weight, _np.float32)
+            if state is not None and self._updater is not None:
+                self._updater.states[_updater_key(subkey)] = \
+                    tree_from_numpy(state)
+                self._updater.states_synced[_updater_key(subkey)] = False
+
+    def _restore_from(self, path):
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self._weights = {}
+        if self._updater is not None:
+            self._updater.states = {}
+            self._updater.states_synced = {}
+        self._install_entries(
+            [(k, rec["weight"], rec.get("state"))
+             for k, rec in blob.get("entries", {}).items()],
+            blob.get("optimizer"))
+        self._push_count = int(blob.get("push_count", 0))
 
     # -- serving -----------------------------------------------------------
 
@@ -282,8 +362,21 @@ class AsyncParamServer:
                     return
 
 
+# THE shard-subkey wire format, shared with checkpoint/kvshard.py's
+# split_subkey — one definition so checkpoint merge and optimizer-key
+# stripping can never drift apart
+SHARD_KEY_RE = re.compile(r"^(?P<base>.*)#shard(?P<idx>\d+)$")
+
+
 def _updater_key(key):
-    """int when possible — optimizer per-index state dicts key on ints."""
+    """Optimizer-facing key for a server subkey: the `#shardN` suffix is
+    stripped (per-key `lr_mult`/`wd_mult`/`idx2name` settings must apply
+    to every shard of a parameter, and sharded checkpoints must key state
+    by the real parameter), then int when possible — optimizer per-index
+    state dicts key on ints. Each server holds at most one shard of a
+    key, so stripped keys stay unique server-side."""
+    m = SHARD_KEY_RE.match(str(key))
+    key = m.group("base") if m else str(key)
     try:
         return int(key)
     except (TypeError, ValueError):
@@ -330,9 +423,9 @@ class KVStoreDistAsync(KVStore):
     """Worker client: per-push server updates, no worker barrier.
 
     Key placement mirrors the reference PSKV (`kvstore_dist.h:151`):
-    small arrays hash to one server; arrays over
-    MXNET_KVSTORE_BIGARRAY_BOUND bytes split into near-equal leading-axis
-    slices, one per server."""
+    small arrays hash to one server; arrays of
+    MXNET_KVSTORE_BIGARRAY_BOUND or more elements split into near-equal
+    leading-axis slices, one per server."""
 
     def __init__(self):
         super().__init__("dist_async")
@@ -447,8 +540,11 @@ class KVStoreDistAsync(KVStore):
         self._require_worker()
         n = len(self._socks)
         shape = arr.shape
-        nbytes = int(_np.prod(shape, dtype=_np.int64)) * 4 if shape else 4
-        if n == 1 or nbytes < self._bigarray_bound or not shape \
+        # the bound counts ELEMENTS (reference kvstore_dist.h compares
+        # size(), and model.py's big-array split uses prod(shape)), not
+        # bytes-assuming-float32
+        size = int(_np.prod(shape, dtype=_np.int64)) if shape else 1
+        if n == 1 or size < self._bigarray_bound or not shape \
                 or shape[0] < n:
             plan = [(_stable_hash(key) % n, None, None)]
         else:
@@ -538,19 +634,30 @@ class KVStoreDistAsync(KVStore):
         for k, olist, rid in zip(keys, outs, rids):
             plan = self._placement(str(k), olist[0])
             rows = _np.unique(rid.asnumpy().astype(_np.int64))
-            if plan[0][1] is None:
+            # empty / no-match row_ids no-op with (0,) + row_shape (the
+            # dense scatter and row_sparse_array below would otherwise
+            # broadcast-error on a bare (0,) value array)
+            row_shape = tuple(olist[0].shape[1:])
+            if rows.size == 0:
+                vals = _np.zeros((0,) + row_shape, _np.float32)
+            elif plan[0][1] is None:
                 vals = self._rpc(plan[0][0], "pull_rows", str(k), rows)[1]
             else:
-                calls = []
+                calls, kept = [], []
                 for s, r0, r1 in plan:
                     mask = (rows >= r0) & (rows < r1)
                     if mask.any():
                         calls.append((s, ("pull_rows",
                                           self._subkey(str(k), s, False),
                                           rows[mask] - r0)))
-                replies = self._rpc_scatter(calls)
-                vals = _np.concatenate([r[1] for r in replies], axis=0) \
-                    if replies else _np.zeros((0,), _np.float32)
+                        kept.append(rows[mask])
+                if calls:
+                    replies = self._rpc_scatter(calls)
+                    vals = _np.concatenate([r[1] for r in replies], axis=0)
+                    rows = _np.concatenate(kept)
+                else:
+                    vals = _np.zeros((0,) + row_shape, _np.float32)
+                    rows = rows[:0]
             for o in olist:
                 if isinstance(o, _mx_sparse.RowSparseNDArray):
                     dst = _mx_sparse.row_sparse_array(
@@ -590,7 +697,44 @@ class KVStoreDistAsync(KVStore):
         self._rpc_scatter([(s, ("stop",))
                            for s in range(len(self._socks))])
 
+    # -- checkpoint (worker side) ------------------------------------------
+
+    def save_checkpoint(self, directory):
+        """Every server snapshots its addressable shard of weights +
+        optimizer state into `directory` (one atomic file per server).
+        Used standalone or as a CheckpointManager extra writer — the
+        shard files land inside the managed step dir."""
+        from .checkpoint.kvshard import save_kv_checkpoint
+        self._require_worker()
+        return save_kv_checkpoint(self, directory)
+
+    def restore_checkpoint(self, directory):
+        """Restore server-side state from `save_checkpoint` files. With
+        the same server count each server reloads its own file; under a
+        DIFFERENT count the shards are merged host-side and resharded
+        for the new topology (checkpoint/kvshard.py)."""
+        from .checkpoint.kvshard import restore_kv_checkpoint
+        self._require_worker()
+        restore_kv_checkpoint(self, directory)
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
-        raise MXNetError("dist_async: optimizer state lives on the server "
-                         "(reference parity: dist kvstores cannot save "
-                         "states from a worker)")
+        """Server-side state save (the reference raised here — dist
+        kvstores could not save from a worker; the checkpoint subsystem
+        lifts that). `fname` becomes a small manifest; the per-server
+        shard files live in a `fname + ".kvshards"` sidecar dir on the
+        servers' shared filesystem."""
+        from .base import atomic_write
+        d = fname + ".kvshards"
+        files = self.save_checkpoint(d)
+        atomic_write(fname, pickle.dumps(
+            {"mx_kv_ckpt": 1, "num_servers": self.num_servers,
+             "files": [os.path.basename(f) for f in files]},
+            protocol=pickle.HIGHEST_PROTOCOL))
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            manifest = pickle.load(f)
+        if not (isinstance(manifest, dict) and manifest.get("mx_kv_ckpt")):
+            raise MXNetError("%s is not a dist_async optimizer-states "
+                             "manifest" % fname)
+        self.restore_checkpoint(fname + ".kvshards")

@@ -270,7 +270,8 @@ class FeedForward(object):
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
-            eval_end_callback=None, eval_batch_end_callback=None):
+            eval_end_callback=None, eval_batch_end_callback=None,
+            checkpoint_manager=None):
         from .io import NDArrayIter
         if not hasattr(X, "provide_data"):
             X = NDArrayIter(X, y, batch_size=self.numpy_batch_size,
@@ -293,7 +294,7 @@ class FeedForward(object):
                 initializer=self.initializer, arg_params=self.arg_params,
                 aux_params=self.aux_params, allow_missing=True,
                 begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
-                monitor=monitor)
+                monitor=monitor, checkpoint_manager=checkpoint_manager)
         self.arg_params, self.aux_params = mod.get_params()
         return self
 

@@ -3,8 +3,6 @@ from __future__ import annotations
 
 import logging
 
-import numpy as _np
-
 from ..base import MXNetError, atomic_write
 from ..context import Context, cpu
 from ..initializer import Uniform, InitDesc
@@ -728,43 +726,27 @@ class Module(BaseModule):
         self._params_dirty = False
 
     def save_optimizer_states(self, fname):
+        """Write FULL optimizer state: per-index slots (incl.
+        multi-precision master weights), num_update / per-index counters
+        and the lr scheduler — checkpoint/state.py's tagged payload, so
+        a restored run's schedule continues bit-exactly. Legacy files
+        (bare states pickle, fused {"fused","state"} blob) stay loadable
+        below."""
         assert self.optimizer_initialized
-        if self._fused_step is not None:
-            import pickle
-            import numpy as _np2
-            state_np = jax_tree_to_numpy(self._fused_step.opt_state)
-            atomic_write(fname, pickle.dumps(
-                {"fused": self._fused_step.optimizer, "state": state_np}))
-            return
-        if self._update_on_kvstore:
+        if self._update_on_kvstore and self._fused_step is None:
             self._kvstore.save_optimizer_states(fname)
-        else:
-            atomic_write(fname, self._updater.get_states())
+            return
+        from ..checkpoint import state as ckpt_state
+        atomic_write(fname, ckpt_state.optimizer_payload_bytes(self))
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._fused_step is not None:
-            import pickle
-            with open(fname, "rb") as f:
-                blob = pickle.load(f)
-            if isinstance(blob, dict) and "fused" in blob:
-                import jax
-                from jax.tree_util import tree_map
-                # restore with the step's own state layout: under weight-
-                # update sharding the jitted program pins dp-sharded
-                # in_shardings, and a replicated restore would fail the
-                # sharding match on the next step
-                self._fused_step.opt_state = tree_map(
-                    lambda sh, v: jax.device_put(v, sh),
-                    self._fused_step._state_shardings(), blob["state"])
-                return
-            raise MXNetError("optimizer states file %s is not a fused-step "
-                             "checkpoint" % fname)
-        if self._update_on_kvstore:
+        if self._update_on_kvstore and self._fused_step is None:
             self._kvstore.load_optimizer_states(fname)
-        else:
-            with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
+            return
+        from ..checkpoint import state as ckpt_state
+        with open(fname, "rb") as f:
+            ckpt_state.apply_optimizer_payload(self, f.read())
 
     def install_monitor(self, mon):
         assert self.binded
@@ -786,11 +768,6 @@ class Module(BaseModule):
                     self._kvstore.row_sparse_pull(
                         name, out=self._exec_group.param_arrays[idx],
                         row_ids=rid)
-
-
-def jax_tree_to_numpy(tree):
-    import jax
-    return jax.tree_util.tree_map(lambda v: _np.asarray(v), tree)
 
 
 def _parse_data_desc(data_names, label_names, data_shapes, label_shapes):

@@ -659,12 +659,19 @@ class Updater:
         self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
 
     def set_states(self, states):
-        import pickle
-        self.states = pickle.loads(states)
-        self.states_synced = {k: False for k in self.states}
+        """Accepts both the legacy bare-states pickle and the tagged
+        payload get_states(dump_optimizer=True) now emits (which also
+        restores num_update / lr-scheduler position)."""
+        from .checkpoint.state import apply_updater_payload
+        apply_updater_payload(self, states)
 
     def get_states(self, dump_optimizer=False):
         import pickle
+        if dump_optimizer:
+            # full payload: slots + the optimizer's schedule counters, so
+            # a reloaded updater continues the lr schedule bit-exactly
+            from .checkpoint.state import updater_payload_bytes
+            return updater_payload_bytes(self, dump_optimizer=True)
         return pickle.dumps(self.states)
 
 

@@ -10,7 +10,7 @@ import threading
 
 import jax
 
-__all__ = ["seed", "next_key", "current_key"]
+__all__ = ["seed", "next_key", "current_key", "set_key"]
 
 
 class _RngState(threading.local):
@@ -51,6 +51,15 @@ def trace_consumed():
 
 def current_key():
     return _STATE.key
+
+
+def set_key(key):
+    """Restore the global key chain from raw key data (checkpoint resume:
+    `CheckpointManager` saves `np.asarray(current_key())` in the manifest
+    and reinstalls it here, so stochastic ops continue the exact sequence
+    an uninterrupted run would have drawn)."""
+    import jax.numpy as jnp
+    _STATE.key = jnp.asarray(key, dtype=jnp.uint32)
 
 
 _FIXED_KEY = None

@@ -20,6 +20,7 @@ callers, highest throughput). Both run the graph strictly in inference mode
 """
 from __future__ import annotations
 
+import logging
 import threading
 
 import numpy as _np
@@ -127,6 +128,11 @@ class InferenceEngine:
                                        autostart=async_worker)
         self._templates = {}        # input name -> (shape tuple, np dtype)
         self._lock = threading.Lock()
+        # checkpoint hot-swap state (reload_from)
+        self._reload_step = None
+        self._reload_dir = None
+        self._reload_stop = threading.Event()
+        self._reload_thread = None
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -174,6 +180,85 @@ class InferenceEngine:
         for n, v in (aux_params or {}).items():
             if n in self._aux:
                 self._aux[n] = self._to_device(v)
+
+    # ------------------------------------------------------------------
+    # checkpoint hot-swap
+    # ------------------------------------------------------------------
+    def reload_from(self, directory, poll_interval=None):
+        """Live weight hot-swap from a checkpoint directory: load the
+        latest COMMITTED checkpoint's params (checkpoint.latest_checkpoint
+        — half-written checkpoints are invisible by construction) if it is
+        newer than what the engine already serves, and swap via
+        :meth:`update_params` (no recompilation, in-flight requests keep
+        their buffers).
+
+        ``poll_interval`` (seconds) starts a daemon poller repeating the
+        check until :meth:`stop` — training saves through a
+        CheckpointManager and serving follows along. Returns the step
+        just loaded, or None when nothing newer was committed."""
+        if directory != self._reload_dir:
+            # re-pointing at a different run: retire any poller following
+            # the old directory BEFORE forgetting the step watermark (an
+            # un-joined poller mid-_reload_once could finish after the
+            # switch and poison the watermark with the old run's step),
+            # which runs number independently
+            if self._reload_thread is not None:
+                self._reload_stop.set()
+                self._reload_thread.join(timeout=30.0)
+                self._reload_thread = None
+            self._reload_dir = directory
+            self._reload_step = None
+        loaded = self._reload_once(directory)
+        if poll_interval and self._reload_thread is None:
+            # each poller owns its OWN stop event: a stop() whose 5s join
+            # timed out (poller stuck loading big params) leaves the old
+            # thread alive holding the old, already-set event — it exits
+            # on its next check instead of being revived by a new start
+            stop_evt = threading.Event()
+            self._reload_stop = stop_evt
+
+            def _poll():
+                while not stop_evt.wait(poll_interval):
+                    try:
+                        self._reload_once(directory)
+                    except Exception as e:  # keep serving the old weights
+                        logging.warning("reload_from(%s): %s", directory, e)
+            self._reload_thread = threading.Thread(
+                target=_poll, name="mx-serving-reload", daemon=True)
+            self._reload_thread.start()
+        return loaded
+
+    def _reload_once(self, directory, _retries=3):
+        from .. import checkpoint as ckpt
+        for attempt in range(_retries):
+            path = ckpt.latest_checkpoint(directory)
+            if path is None:
+                return None
+            step = None
+            try:
+                meta = ckpt.read_meta(path)
+                step = meta.get("step")
+                if step is not None and self._reload_step is not None \
+                        and step <= self._reload_step:
+                    # NEWER-only: a re-commit of the current step briefly
+                    # makes an older step the "latest" (commit unlinks
+                    # before replacing); swapping back would serve stale
+                    # weights for a poll interval
+                    return None
+                arg_params, aux_params = ckpt.load_params(path)
+            except Exception:
+                # transient by construction: retention pruning or a
+                # same-step re-commit removed the dir between discovery
+                # and read — re-resolve "latest" and try again
+                if attempt == _retries - 1:
+                    raise
+                import time as _time
+                _time.sleep(0.1)
+                continue
+            self.update_params(arg_params, aux_params)
+            self._reload_step = step
+            return step
+        return None
 
     # ------------------------------------------------------------------
     # shape templates
@@ -380,6 +465,10 @@ class InferenceEngine:
         self._batcher.flush()
 
     def stop(self):
+        self._reload_stop.set()
+        if self._reload_thread is not None:
+            self._reload_thread.join(timeout=5.0)
+            self._reload_thread = None
         self._batcher.stop()
 
     # ------------------------------------------------------------------

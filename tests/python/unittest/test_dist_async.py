@@ -250,7 +250,7 @@ def test_big_array_splits_across_servers(two_server_env):
     kv = mx.kv.create("dist_async")
     assert kv.num_servers == 2
     kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
-    big = np.arange(2000 * 3, dtype=np.float32).reshape(2000, 3)  # 24 KB
+    big = np.arange(2000 * 3, dtype=np.float32).reshape(2000, 3)  # 6000 elems
     small = np.ones((4, 4), np.float32)                           # 64 B
     kv.init("big", mx.nd.array(big))
     kv.init("small", mx.nd.array(small))
@@ -277,13 +277,15 @@ def test_row_sparse_routes_rows_to_owning_server(two_server_env):
     s0, s1 = two_server_env
     kv = mx.kv.create("dist_async")
     kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
-    w = np.zeros((1600, 2), np.float32)  # 12.8 KB > bound -> split 800/800
+    # 1600*3 = 4800 elements >= bound (the bound counts ELEMENTS,
+    # reference size() semantics) -> split 800/800
+    w = np.zeros((1600, 3), np.float32)
     kv.init("emb", mx.nd.array(w))
-    assert s0._weights["emb#shard0"].shape == (800, 2)
+    assert s0._weights["emb#shard0"].shape == (800, 3)
     # rows 5, 799 belong to server 0; rows 800, 1599 to server 1
     rows = np.array([5, 799, 800, 1599], np.int64)
-    vals = np.ones((4, 2), np.float32)
-    grad = mxsp.row_sparse_array((vals, rows), shape=(1600, 2))
+    vals = np.ones((4, 3), np.float32)
+    grad = mxsp.row_sparse_array((vals, rows), shape=(1600, 3))
     kv.push("emb", grad)
     # each server applied exactly one sparse push to its own shard
     assert s0._push_count == 1 and s1._push_count == 1
@@ -291,13 +293,13 @@ def test_row_sparse_routes_rows_to_owning_server(two_server_env):
     np.testing.assert_allclose(s1._weights["emb#shard1"][799], -1.0)  # 1599
     assert np.all(s0._weights["emb#shard0"][6] == 0)  # untouched rows
     # row_sparse_pull routes each requested row to its owner
-    out = mxsp.zeros("row_sparse", (1600, 2))
+    out = mxsp.zeros("row_sparse", (1600, 3))
     kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([799, 800]))
-    np.testing.assert_allclose(out.data.asnumpy(), -np.ones((2, 2)),
+    np.testing.assert_allclose(out.data.asnumpy(), -np.ones((2, 3)),
                                rtol=1e-6)
     np.testing.assert_array_equal(out.indices.asnumpy(), [799, 800])
     # dense destination scatter path
-    dense = mx.nd.zeros((1600, 2))
+    dense = mx.nd.zeros((1600, 3))
     kv.row_sparse_pull("emb", out=dense, row_ids=mx.nd.array([5, 1599]))
     got = dense.asnumpy()
     np.testing.assert_allclose(got[5], -1.0)
@@ -327,8 +329,9 @@ def test_two_worker_two_server_sharded_training(tmp_path):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
-    # force the (2, 6) FC weight over the big-array bound so it shards
-    env["MXNET_KVSTORE_BIGARRAY_BOUND"] = "16"
+    # force the (2, 6) FC weight (12 ELEMENTS — the bound counts
+    # elements, not bytes) over the big-array bound so it shards
+    env["MXNET_KVSTORE_BIGARRAY_BOUND"] = "8"
     port = _free_consecutive_ports(2)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),

@@ -368,3 +368,47 @@ def test_kill_job_lists_launch_processes():
     finally:
         probe.terminate()
         probe.wait()
+
+
+def test_kill_job_requires_launcher_marker():
+    """A process carrying only generic JAX coordination env (an unrelated
+    jax.distributed job) is never matched by the env scan, and even a
+    --pattern --force hit refuses to kill it without the DMLC_ROLE
+    launcher marker."""
+    import time
+    env = dict(os.environ, JAX_COORDINATOR_ADDRESS="127.0.0.1:1234",
+               JAX_PLATFORMS="cpu")
+    env.pop("DMLC_ROLE", None)
+    marker = "kill_job_probe_%d" % os.getpid()
+    probe = subprocess.Popen(
+        [sys.executable, "-c",
+         "import time; %s = 1; time.sleep(30)" % marker], env=env)
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                with open("/proc/%d/environ" % probe.pid, "rb") as f:
+                    if b"JAX_COORDINATOR_ADDRESS" in f.read():
+                        break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        # env scan: not a launch.py job -> invisible (match the exact
+        # pid token — a raw substring check flakes when the probe pid
+        # prefixes another listed pid)
+        import re as _re
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "kill_job.py")],
+            capture_output=True, text=True, timeout=60).stdout
+        assert not _re.search(r"\bkill %d\b" % probe.pid, out), out
+        # pattern + --force: matched by cmdline but REFUSED for kill
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "kill_job.py"),
+             "--pattern", marker, "--force"],
+            capture_output=True, text=True, timeout=60).stdout
+        assert "skip %d" % probe.pid in out, out
+        time.sleep(0.3)
+        assert probe.poll() is None  # still alive
+    finally:
+        probe.terminate()
+        probe.wait()

@@ -3,9 +3,12 @@
 (reference: tools/kill-mxnet.py — pkill of stray workers/servers after a
 crashed distributed run).
 
-Matches processes whose environment carries the DMLC/JAX coordination
-variables `tools/launch.py` sets (workers, parameter servers), or whose
-command line matches --pattern. Dry-run by default; --force kills.
+Matches processes whose environment carries the launcher-specific
+DMLC_ROLE variable `tools/launch.py` sets (workers, parameter servers),
+or whose command line matches --pattern. Either way, --force only kills
+processes that carry DMLC_ROLE — generic JAX coordination env
+(JAX_COORDINATOR_ADDRESS) is NOT enough, so unrelated jax.distributed
+jobs on the machine are never touched. Dry-run by default.
 """
 from __future__ import annotations
 
@@ -14,7 +17,11 @@ import os
 import signal
 import sys
 
-_MARKERS = ("DMLC_ROLE", "JAX_COORDINATOR_ADDRESS")
+# A process is a launch.py job member only if it carries the
+# LAUNCHER-SPECIFIC marker. JAX_COORDINATOR_ADDRESS alone is NOT enough:
+# any unrelated jax.distributed job on the machine sets it, and matching
+# on it would let --force kill someone else's training run.
+_REQUIRED_MARKER = "DMLC_ROLE"
 
 
 def _ancestors():
@@ -35,8 +42,10 @@ def _ancestors():
 
 
 def job_processes(pattern=None):
-    """[(pid, cmdline)] of launch.py-spawned processes (not ourselves
-    or our ancestors)."""
+    """[(pid, cmdline, has_marker)] of candidate processes (not ourselves
+    or our ancestors). Without a pattern only marker-carrying processes
+    match; with a pattern, cmdline matches are listed but `has_marker`
+    records whether --force may actually kill them."""
     out = []
     skip = _ancestors()
     for pid_s in os.listdir("/proc"):
@@ -50,17 +59,18 @@ def job_processes(pattern=None):
                 cmd = f.read().decode("utf-8", "replace").replace("\0", " ")
         except OSError:
             continue  # raced exit or permission
-        if pattern is not None:
-            if pattern in cmd:
-                out.append((pid, cmd.strip()))
-            continue
         # match variable NAMES, not a raw substring over the blob: a
         # value that merely quotes "DMLC_ROLE=..." must not mark an
         # unrelated process for killing
         names = {entry.split("=", 1)[0]
                  for entry in env_blob.split("\0") if "=" in entry}
-        if names & set(_MARKERS):
-            out.append((pid, cmd.strip()))
+        has_marker = _REQUIRED_MARKER in names
+        if pattern is not None:
+            if pattern in cmd:
+                out.append((pid, cmd.strip(), has_marker))
+            continue
+        if has_marker:
+            out.append((pid, cmd.strip(), True))
     return out
 
 
@@ -80,7 +90,14 @@ def main():
         return 0
     sig = getattr(signal, "SIG" + args.signal)
     failed = 0
-    for pid, cmd in procs:
+    for pid, cmd, has_marker in procs:
+        if not has_marker:
+            # pattern matched, but the process does not carry the
+            # launcher env marker — never kill it (it could be anything,
+            # including an unrelated JAX distributed job)
+            print("skip %d (no %s in environ)  %.120s"
+                  % (pid, _REQUIRED_MARKER, cmd))
+            continue
         print("%s %d  %.120s" % ("kill" if args.force else "would kill",
                                  pid, cmd))
         if args.force:
