@@ -802,12 +802,15 @@ def _phase_cost():
                                      label_names=("softmax_label",),
                                      compute_dtype=dt_)
         step.init({"data": (batch, 3, 224, 224), "softmax_label": (batch,)})
-        abstract = {  # lower from shapes only: no batch materialization
-            "data": jax.ShapeDtypeStruct((batch, 3, 224, 224), jnp.float32),
+        # lower from shapes only: no batch materialization (data and label
+        # ride as separate args in the fused step signature)
+        abstract_data = {
+            "data": jax.ShapeDtypeStruct((batch, 3, 224, 224), jnp.float32)}
+        abstract_label = {
             "softmax_label": jax.ShapeDtypeStruct((batch,), jnp.float32)}
         lowered = step._step.lower(step.params, step.opt_state, step.aux,
-                                   abstract, jax.random.PRNGKey(0),
-                                   np.float32(0.05))
+                                   abstract_data, abstract_label,
+                                   jax.random.PRNGKey(0), np.float32(0.05))
         gflops, mbytes = _analyze(lowered)
         out["step%s_gflops" % tag] = gflops
         out["step%s_bytes_mb" % tag] = mbytes
@@ -1037,17 +1040,32 @@ def _phase_io_train():
     sym = it.normalize_prelude(body)
     mod = mx.mod.Module(sym, context=mx.tpu(0))
     step_times = []
+    from mxnet_tpu import profiler as _prof
+    _prof.pipeline_counters(reset=True)  # fresh overlap counters for fit
     mod.fit(it, num_epoch=3 if on_tpu else 2, kvstore="tpu_sync",
             optimizer="sgd",
             optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
             initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2.0),
             batch_end_callback=lambda p: step_times.append(time.time()))
     assert mod._fused_step is not None  # must measure the fused path
+    pc = _prof.pipeline_counters(reset=True)
     half = len(step_times) // 2  # steady state: drop compile + warmup half
     ips = batch * (len(step_times) - half) \
         / max(step_times[-1] - step_times[half - 1], 1e-9)
     return {"io_train_img_per_sec": round(ips, 2),
-            "io_pipeline_img_per_sec": round(pipeline_ips, 2)}
+            "io_pipeline_img_per_sec": round(pipeline_ips, 2),
+            # overlap efficiency of the pipeline (profiler pipeline
+            # counters): hit = next batch was already device-staged when
+            # the loop asked; stall = the loop waited on the stager;
+            # readback_stall = bounded-dispatch blocking on step i-depth
+            "io_overlap_extra": {
+                "prefetch_hit": int(pc.get("prefetch_hit", 0)),
+                "prefetch_stall": int(pc.get("prefetch_stall", 0)),
+                "prefetch_stall_ms": round(pc.get("prefetch_stall_ms", 0.0), 2),
+                "prefetch_stage_ms": round(pc.get("prefetch_stage_ms", 0.0), 2),
+                "dispatch_ms": round(pc.get("dispatch_ms", 0.0), 2),
+                "readback_stall_ms": round(pc.get("readback_stall_ms", 0.0), 2),
+                "steps": int(pc.get("steps", 0))}}
 
 
 PHASES = {

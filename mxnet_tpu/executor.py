@@ -359,6 +359,11 @@ class Executor:
                 raise MXNetError("unknown forward argument %r" % k)
             if isinstance(v, NDArray):
                 self.arg_dict[k]._data = v._data
+            elif isinstance(v, jax.Array):
+                # already device-resident (e.g. a prefetch-staged batch):
+                # adopt the buffer as-is — np.asarray() would round-trip
+                # it device->host->device
+                self.arg_dict[k]._data = v
             else:
                 self.arg_dict[k]._data = jnp.asarray(_np.asarray(v))
 

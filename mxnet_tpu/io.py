@@ -18,8 +18,8 @@ from .ndarray.ndarray import NDArray, array
 from .ndarray import sparse as _sparse
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
-           "ImageRecordIter", "ImageDetRecordIter", "io_registry"]
+           "PrefetchingIter", "DevicePrefetchIter", "CSVIter", "LibSVMIter",
+           "MNISTIter", "ImageRecordIter", "ImageDetRecordIter", "io_registry"]
 
 io_registry = Registry("data iterator")
 
@@ -178,13 +178,22 @@ class NDArrayIter(DataIter):
             return self.cursor + self.batch_size <= self.num_data
         return self.cursor < self.num_data
 
-    def _take(self, arrays):
+    def _batch_indices(self):
+        """Index selection for the CURRENT batch. When the batch runs past
+        the data end, the selection wraps to the epoch's first indices (the
+        `_take` roll-over padding), so its length always equals the emitted
+        batch's row count."""
         start = max(self.cursor, 0)
         end = min(start + self.batch_size, self.num_data)
         sel = self.idx[start:end]
-        pad = self.batch_size - len(sel)
-        if pad:
-            sel = _np.concatenate([sel, self.idx[:pad]])
+        short = self.batch_size - len(sel)
+        if short:
+            sel = _np.concatenate([sel, self.idx[:short]])
+        return sel
+
+    def _take(self, arrays, sel=None):
+        if sel is None:
+            sel = self._batch_indices()
         out = []
         for _, v in arrays:
             if isinstance(v, _sparse.BaseSparseNDArray):
@@ -193,13 +202,24 @@ class NDArrayIter(DataIter):
                            else array(dense))
             else:
                 out.append(array(v[sel]))
-        return out, pad
+        return out
+
+    def next(self):
+        """Single-pass batch assembly: ONE index selection shared by data
+        and label (the base-class getdata()+getlabel() pairing would
+        recompute the slice + pack twice per batch)."""
+        if not self.iter_next():
+            raise StopIteration
+        sel = self._batch_indices()
+        return DataBatch(data=self._take(self.data, sel),
+                         label=self._take(self.label, sel) if self.label else [],
+                         pad=self.getpad(), index=sel.copy())
 
     def getdata(self):
-        return self._take(self.data)[0]
+        return self._take(self.data)
 
     def getlabel(self):
-        return self._take(self.label)[0] if self.label else []
+        return self._take(self.label) if self.label else []
 
     def getpad(self):
         if self.last_batch_handle == "pad" and \
@@ -208,9 +228,7 @@ class NDArrayIter(DataIter):
         return 0
 
     def getindex(self):
-        start = max(self.cursor, 0)
-        end = min(start + self.batch_size, self.num_data)
-        return self.idx[start:end]
+        return self._batch_indices()
 
 
 class ResizeIter(DataIter):
@@ -277,6 +295,10 @@ class PrefetchingIter(DataIter):
         self._queue = queue.Queue(maxsize=4)
         self._stop = threading.Event()
         self._thread = None
+        # sticky terminal state: once the worker ends the stream (error or
+        # StopIteration) every later next() re-raises instead of blocking
+        # forever on a queue the dead worker will never refill
+        self._terminal = None
         self._start()
 
     @property
@@ -333,14 +355,19 @@ class PrefetchingIter(DataIter):
             pass
         for i in self.iters:
             i.reset()
+        self._terminal = None
         self._stop.clear()
         self._start()
 
     def next(self):
+        if self._terminal is not None:
+            raise self._terminal
         item = self._queue.get()
         if item is None:
-            raise StopIteration
+            self._terminal = StopIteration()
+            raise self._terminal
         if isinstance(item, Exception):
+            self._terminal = item
             raise item
         batch = item[0]
         if len(item) > 1:
@@ -519,3 +546,8 @@ def ImageDetRecordIter(**kwargs):
     augmentation) — native C++ (reference iter_image_det_recordio.cc:582)."""
     from .recordio_iter import ImageDetRecordIter as _Impl
     return _Impl(**kwargs)
+
+
+# device-resident prefetch wrapper (overlapped training pipeline) — lives in
+# io_device.py but belongs to the mx.io namespace like PrefetchingIter
+from .io_device import DevicePrefetchIter  # noqa: E402

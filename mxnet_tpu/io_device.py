@@ -1,0 +1,225 @@
+"""Device-resident batch prefetch — the input half of the overlapped
+training pipeline.
+
+The reference framework's dependency engine overlaps IO, H2D copy and
+compute by scheduling them as independent engine ops (MXNet paper §engine;
+iter_prefetcher.h). The TPU-native equivalent: a background thread pulls
+host batches from the wrapped iterator and *stages* them onto the device
+(`jax.device_put` against the fused step's dp-sharded batch layout —
+sharding-aware, uint8 rides the link untouched) while the current fused
+step is still executing.  `next()` then hands the training loop a batch
+whose arrays are already device-resident, so the fused step dispatches
+with zero host→device transfer on the critical path.
+
+The buffer is bounded (`depth` staged batches, default 2 = classic double
+buffering) so the stager can never run unboundedly ahead of compute.
+`Module.fit` wraps the user iterator in this automatically when the fused
+tpu_sync step is active; `MXNET_DEVICE_PREFETCH=0` opts out and
+`MXNET_DEVICE_PREFETCH_DEPTH` resizes the buffer (docs/faq/perf.md).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as _np
+
+from .base import MXNetError
+from .io import DataIter, DataBatch
+
+__all__ = ["DevicePrefetchIter", "default_stage_fn"]
+
+
+def default_stage_fn(device=None, sharding=None):
+    """Build a stage function placing each batch's data/label arrays on
+    `sharding` (a jax.sharding.Sharding — e.g. the fused step's dp batch
+    shard) or `device` (default: the first jax device).
+
+    The staged batch is marked `_device_staged`: its arrays already sit on
+    the fused step's batch sharding, so the step consumes them zero-copy
+    (no re-transfer, no reshard) and they stay readable afterwards for
+    metrics/callbacks."""
+    import jax
+    from .ndarray.ndarray import NDArray, _new_from_jax
+    target = sharding if sharding is not None else \
+        (device if device is not None else jax.devices()[0])
+
+    def _put(arr):
+        raw = arr._data if isinstance(arr, NDArray) else _np.asarray(arr)
+        return _new_from_jax(jax.device_put(raw, target))
+
+    def stage(batch):
+        staged = DataBatch(
+            data=[_put(a) for a in (batch.data or [])],
+            label=[_put(a) for a in (batch.label or [])],
+            pad=getattr(batch, "pad", None),
+            index=getattr(batch, "index", None),
+            bucket_key=getattr(batch, "bucket_key", None),
+            provide_data=getattr(batch, "provide_data", None),
+            provide_label=getattr(batch, "provide_label", None))
+        staged._device_staged = True
+        return staged
+
+    return stage
+
+
+class DevicePrefetchIter(DataIter):
+    """Background-thread iterator wrapper staging the NEXT batch onto
+    device while the current step runs.
+
+    Differences from `PrefetchingIter`: batches come out device-resident
+    (via `stage_fn`), the buffer depth is configurable, the worker starts
+    lazily on the first `next()` (a reset wrapper leaves the base iterator
+    untouched until data is actually demanded), and the end-of-stream /
+    error sentinel is sticky — once the worker terminates, every later
+    `next()` re-raises instead of deadlocking on an empty queue.
+
+    Exposes `counters` (hits/stalls/stall_ms/staged) and mirrors them into
+    `profiler.record_pipeline_event` for the bench's overlap report.
+    """
+
+    _STOP = object()
+
+    def __init__(self, base_iter, stage_fn=None, depth=2):
+        super().__init__(getattr(base_iter, "batch_size", 0))
+        self.base = base_iter
+        self.depth = max(1, int(depth))
+        self.stage_fn = stage_fn if stage_fn is not None else default_stage_fn()
+        self._queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._terminal = None
+        self.counters = {"hits": 0, "stalls": 0, "stall_ms": 0.0, "staged": 0}
+
+    # ------------------------------------------------------------------
+    @property
+    def provide_data(self):
+        return self.base.provide_data
+
+    @property
+    def provide_label(self):
+        return self.base.provide_label
+
+    @property
+    def default_bucket_key(self):
+        return self.base.default_bucket_key
+
+    # ------------------------------------------------------------------
+    def _worker(self):
+        from . import profiler as _prof
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = self.base.next()
+                except StopIteration:
+                    self._put(self._STOP)
+                    return
+                t0 = time.perf_counter()
+                staged = self.stage_fn(batch)
+                _prof.record_pipeline_event(
+                    prefetch_stage_ms=(time.perf_counter() - t0) * 1e3)
+                self.counters["staged"] += 1
+                self._put(staged)
+        except BaseException as e:  # transported to next(), then sticky
+            self._put(e)
+
+    def _put(self, item):
+        # bounded put that a concurrent reset() can always interrupt
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                pass
+
+    def _start(self):
+        self._thread = threading.Thread(target=self._worker,
+                                        name="mx-device-prefetch", daemon=True)
+        self._thread.start()
+
+    def _shutdown(self):
+        if self._thread is None:
+            return
+        self._stop.set()
+        # drain until the worker exits — a put() blocked on a full queue
+        # could otherwise land a stale batch after a one-shot drain
+        while self._thread.is_alive():
+            try:
+                self._queue.get(timeout=0.05)
+            except queue.Empty:
+                pass
+        self._thread.join(timeout=5)
+        self._thread = None
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        self._stop.clear()
+
+    # ------------------------------------------------------------------
+    def reset(self):
+        self._shutdown()
+        self.base.reset()
+        self._terminal = None
+        # worker restarts lazily on the next next(): after the final epoch
+        # the base iterator is left freshly reset, not advanced by an
+        # eagerly-refilling stager
+
+    def next(self):
+        from . import profiler as _prof
+        if self._terminal is not None:
+            raise self._terminal
+        if self._thread is None:
+            self._start()
+        stall_ms = None
+        try:
+            item = self._queue.get_nowait()
+        except queue.Empty:
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    item = self._queue.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    if self._thread is None or not self._thread.is_alive():
+                        # the worker enqueues its terminal sentinel BEFORE
+                        # exiting, so a dead thread + empty queue here can
+                        # still race one in-flight put — drain once more
+                        # before declaring the sentinel lost
+                        try:
+                            item = self._queue.get_nowait()
+                            break
+                        except queue.Empty:
+                            self._terminal = MXNetError(
+                                "device prefetch worker died "
+                                "without a sentinel")
+                            raise self._terminal
+            stall_ms = (time.perf_counter() - t0) * 1e3
+        if item is self._STOP:
+            self._terminal = StopIteration()
+            raise self._terminal
+        if isinstance(item, BaseException):
+            self._terminal = item
+            raise item
+        # hit/stall accounting covers REAL batches only (the terminal
+        # sentinel above is pipeline bookkeeping, not overlap efficiency)
+        if stall_ms is None:
+            self.counters["hits"] += 1
+            _prof.record_pipeline_event(prefetch_hit=1)
+        else:
+            self.counters["stalls"] += 1
+            self.counters["stall_ms"] += stall_ms
+            _prof.record_pipeline_event(prefetch_stall=1,
+                                        prefetch_stall_ms=stall_ms)
+        return item
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def __del__(self):
+        try:
+            self._stop.set()
+        except Exception:
+            pass

@@ -29,6 +29,67 @@ def _as_np(x):
     return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
 
 
+def _as_device(x):
+    """Underlying device array WITHOUT a host transfer (in-graph metric
+    path: NDArray wraps an immutable jax buffer, hand that over as-is)."""
+    if isinstance(x, NDArray):
+        return x._data
+    import jax
+    if isinstance(x, jax.Array):
+        return x
+    import jax.numpy as jnp
+    return jnp.asarray(_np.asarray(x))
+
+
+# jitted per-batch accumulator kernels for the device metric path, built
+# lazily (and cached by jit per shape/static-arg combo). Each returns ONE
+# device scalar — the per-batch metric increment — which EvalMetric keeps
+# unrealized until get() (zero per-batch host syncs; the cross-batch sum
+# happens on host in the same float64 accumulation the eager path uses,
+# so values stay bit-equal given equal per-batch increments).
+_DEVICE_FNS = {}
+
+
+def _device_fn(kind):
+    fn = _DEVICE_FNS.get(kind)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    if kind == "acc":
+        @partial(jax.jit, static_argnames=("axis", "do_argmax"))
+        def fn(pred, label, axis, do_argmax):
+            if do_argmax:
+                pred = jnp.argmax(pred, axis=axis)
+            pred = pred.astype(jnp.int32).reshape(-1)
+            label = label.astype(jnp.int32).reshape(-1)
+            return (pred == label).sum()
+    elif kind == "ce":
+        @jax.jit
+        def fn(pred, label, eps):
+            label = label.reshape(-1).astype(jnp.int32)
+            prob = pred[jnp.arange(label.shape[0]), label]
+            # out-of-range labels: the eager path's numpy gather raises
+            # IndexError, but XLA gather CLAMPS — poison the sum with NaN
+            # instead so corrupt labels can't silently read as the last
+            # class (valid labels select identical values, keeping the
+            # bit-parity with eager)
+            prob = jnp.where((label >= 0) & (label < pred.shape[1]),
+                             prob, jnp.nan)
+            # (-log(p+eps)).sum(): negation is exact, so this equals the
+            # eager numpy expression bit-for-bit given equal log results
+            return -(jnp.log(prob + eps)).sum()
+    elif kind == "sum":
+        @jax.jit
+        def fn(pred):
+            return pred.sum()
+    else:
+        raise KeyError(kind)
+    _DEVICE_FNS[kind] = fn
+    return fn
+
+
 class EvalMetric:
     """reference: metric.py:68."""
 
@@ -63,11 +124,33 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError
 
+    def update_device(self, labels, preds):
+        """In-graph accumulation: append per-batch device-scalar increments
+        to `_dev_pending` WITHOUT any host sync, returning True when
+        handled. Default False — the caller must then run the eager numpy
+        `update()` (the preserved fallback for custom metrics)."""
+        return False
+
+    def _drain_device_pending(self):
+        """Fold realized device increments into the host accumulators (the
+        get()-time sync point of the in-graph metric path). Host-side
+        accumulation is the same python-float/numpy-scalar arithmetic the
+        eager path uses, so draining preserves bit-equality."""
+        pending = self.__dict__.get("_dev_pending")
+        if not pending:
+            return
+        self._dev_pending = []
+        for inc, n in pending:
+            self.sum_metric += _np.asarray(inc)[()]
+            self.num_inst += n
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._dev_pending = []
 
     def get(self):
+        self._drain_device_pending()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
@@ -132,6 +215,14 @@ class CompositeEvalMetric(EvalMetric):
         for metric in self.metrics:
             metric.update(labels, preds)
 
+    def update_device(self, labels, preds):
+        # per-child routing: device-capable children accumulate in-graph,
+        # the rest fall back to their eager update — mixed composites work
+        for metric in self.metrics:
+            if not metric.update_device(labels, preds):
+                metric.update(labels, preds)
+        return True
+
     def reset(self):
         # base __init__ calls reset() before self.metrics is assigned
         for metric in getattr(self, "metrics", ()):
@@ -166,6 +257,27 @@ class Accuracy(EvalMetric):
             check_label_shapes(label, pred, shape=True)
             self.sum_metric += (pred == label).sum()
             self.num_inst += len(pred)
+
+    def update_device(self, labels, preds):
+        if len(labels) != len(preds):
+            return False  # eager path raises the proper shape error
+        try:
+            staged = []
+            for label, pred in zip(labels, preds):
+                p, l = _as_device(pred), _as_device(label)
+                do_argmax = p.ndim > 1 and tuple(p.shape) != tuple(l.shape)
+                n = (int(p.size // p.shape[self.axis]) if do_argmax
+                     else int(p.size))
+                if n != int(l.size):
+                    return False
+                staged.append((p, l, do_argmax, n))
+        except Exception:
+            return False  # shape/axis problems surface via the eager path
+        fn = _device_fn("acc")
+        for p, l, do_argmax, n in staged:
+            self._dev_pending.append(
+                (fn(p, l, axis=self.axis, do_argmax=do_argmax), n))
+        return True
 
 
 acc = Accuracy
@@ -385,6 +497,29 @@ class CrossEntropy(EvalMetric):
             self.sum_metric += (-_np.log(prob + self.eps)).sum()
             self.num_inst += label.shape[0]
 
+    def update_device(self, labels, preds):
+        return _ce_update_device(self, labels, preds)
+
+
+def _ce_update_device(metric, labels, preds):
+    """Shared in-graph accumulator for CrossEntropy/NegativeLogLikelihood
+    (identical loss-sum math)."""
+    if len(labels) != len(preds):
+        return False
+    try:
+        staged = []
+        for label, pred in zip(labels, preds):
+            p, l = _as_device(pred), _as_device(label)
+            if p.ndim != 2 or int(l.size) != int(p.shape[0]):
+                return False
+            staged.append((p, l))
+    except Exception:
+        return False
+    fn = _device_fn("ce")
+    for p, l in staged:
+        metric._dev_pending.append((fn(p, l, metric.eps), int(l.size)))
+    return True
+
 
 @register
 class NegativeLogLikelihood(EvalMetric):
@@ -404,6 +539,9 @@ class NegativeLogLikelihood(EvalMetric):
             prob = pred[_np.arange(num_examples, dtype=_np.int64), _np.int64(label)]
             self.sum_metric += (-_np.log(prob + self.eps)).sum()
             self.num_inst += num_examples
+
+    def update_device(self, labels, preds):
+        return _ce_update_device(self, labels, preds)
 
 
 metric_registry.alias(NegativeLogLikelihood, "nll_loss")
@@ -437,6 +575,18 @@ class Loss(EvalMetric):
         for pred in preds:
             self.sum_metric += _as_np(pred).sum()
             self.num_inst += pred.size
+
+    def update_device(self, _, preds):
+        if isinstance(preds, NDArray):
+            preds = [preds]
+        try:
+            staged = [(_as_device(p), int(p.size)) for p in preds]
+        except Exception:
+            return False
+        fn = _device_fn("sum")
+        for p, n in staged:
+            self._dev_pending.append((fn(p), n))
+        return True
 
 
 metric_registry.alias(Loss, "ce_loss")

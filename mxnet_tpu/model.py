@@ -272,6 +272,12 @@ class FeedForward(object):
             logger=None, work_load_list=None, monitor=None,
             eval_end_callback=None, eval_batch_end_callback=None,
             checkpoint_manager=None):
+        """Train. Routes through Module.fit, so `kvstore='tpu_sync'` gets
+        the full overlapped pipeline automatically: device-resident batch
+        prefetch (io_device.DevicePrefetchIter, opt out with
+        MXNET_DEVICE_PREFETCH=0), in-graph metric accumulation, and
+        bounded async dispatch (MXNET_ASYNC_DISPATCH_DEPTH) — see
+        docs/faq/perf.md."""
         from .io import NDArrayIter
         if not hasattr(X, "provide_data"):
             X = NDArrayIter(X, y, batch_size=self.numpy_batch_size,

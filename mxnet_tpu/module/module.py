@@ -73,6 +73,14 @@ class Module(BaseModule):
         self._fused_active = False
         self._fused_dirty = False   # fused params newer than exec_group's
         self._monitor = None
+        # bounded async dispatch (docs/faq/perf.md): up to
+        # MXNET_ASYNC_DISPATCH_DEPTH fused steps stay in flight; the host
+        # blocks on step i-depth so it never runs unboundedly ahead of the
+        # device queue (in-graph metrics removed the per-batch sync that
+        # used to bound it implicitly)
+        from collections import deque
+        self._inflight = deque()
+        self._dispatch_depth = 2
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -263,6 +271,7 @@ class Module(BaseModule):
         self._label_shapes = None
         self._rsp_param_names = None
         self._serving_engine = None
+        self._inflight.clear()
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
@@ -432,6 +441,10 @@ class Module(BaseModule):
         step.init_from(self._arg_params, self._aux_params, batch_shapes)
         self._fused_step = step
         self._fused_dirty = False
+        from ..base import get_env
+        self._dispatch_depth = max(0, get_env("MXNET_ASYNC_DISPATCH_DEPTH",
+                                              2, int))
+        self._inflight.clear()
         self.logger.info("kvstore=%s: fused train step active "
                          "(fwd+bwd+allreduce+%s in one XLA program over %d "
                          "device(s))", kvstore_type, fused_name, len(devices))
@@ -466,23 +479,38 @@ class Module(BaseModule):
         for desc, arr in zip(self._label_shapes or [], data_batch.label or []):
             batch[desc.name] = _raw(arr)
         batch = {k: v for k, v in batch.items() if k in fused.arg_names}
+        # device-prefetched batches (io_device.DevicePrefetchIter) arrive
+        # already on the fused step's batch sharding and pass through
+        # zero-copy; anything else is staged by the step itself
         from .. import profiler as _prof
+        import time as _time
+        _t0 = _time.perf_counter()
+        outs = fused(batch, lr=self._fused_lr())
+        # dispatch_ms is host enqueue time only — captured BEFORE any
+        # profiler block_until_ready, or it would absorb the whole step
+        _prof.record_pipeline_event(
+            steps=1, dispatch_ms=(_time.perf_counter() - _t0) * 1e3)
         if _prof.is_running():
-            import time as _time
-            _t0 = _time.perf_counter()
-            outs = fused(batch, lr=self._fused_lr())
             import jax as _jax
             _jax.block_until_ready(outs)
             _prof.record_op_event("tpu_sync_fused_step",
                                   _time.perf_counter() - _t0,
                                   category="xla_graph_exec")
-        else:
-            outs = fused(batch, lr=self._fused_lr())
         from ..ndarray.ndarray import _new_from_jax
         self._fused_outputs = [_new_from_jax(o) for o in outs]
         self._fused_active = True
         self._fused_dirty = True
         self._params_dirty = True
+        # bounded async dispatch: retain outputs of the last `depth` steps
+        # and block on step i-depth before dispatching further
+        self._inflight.append(outs)
+        while len(self._inflight) > self._dispatch_depth:
+            oldest = self._inflight.popleft()
+            _t1 = _time.perf_counter()
+            import jax as _jax
+            _jax.block_until_ready(oldest)
+            _prof.record_pipeline_event(
+                readback_stall_ms=(_time.perf_counter() - _t1) * 1e3)
 
     def _sync_fused_to_execs(self):
         """Push fused-step params into exec_group (before eval/predict)."""
@@ -699,9 +727,39 @@ class Module(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         if self._fused_active:
-            eval_metric.update(labels, self._fused_outputs)
+            # in-graph metric path: per-batch increments stay device
+            # scalars (realized only at metric.get()), so no asnumpy()
+            # blocks the pipeline. Custom/unsupported metrics fall back
+            # to the eager numpy update (MXNET_INGRAPH_METRICS=0 forces
+            # the fallback everywhere).
+            from ..base import env_flag
+            if not (env_flag("MXNET_INGRAPH_METRICS", True)
+                    and eval_metric.update_device(labels,
+                                                  self._fused_outputs)):
+                eval_metric.update(labels, self._fused_outputs)
             return
         self._exec_group.update_metric(eval_metric, labels)
+
+    def _wrap_train_iter(self, train_data):
+        """Wrap the user iterator in a DevicePrefetchIter (io_device.py)
+        staging the NEXT batch onto the fused step's dp-sharded device
+        layout while the current step executes. Fused path only —
+        MXNET_DEVICE_PREFETCH=0 opts out, MXNET_DEVICE_PREFETCH_DEPTH
+        resizes the staging buffer (default 2 = double buffering)."""
+        from ..base import env_flag, get_env
+        if self._fused_step is None or \
+                not env_flag("MXNET_DEVICE_PREFETCH", True):
+            return train_data
+        from ..io_device import DevicePrefetchIter, default_stage_fn
+        if isinstance(train_data, DevicePrefetchIter):
+            return train_data
+        if not (hasattr(train_data, "next") and hasattr(train_data, "reset")):
+            return train_data
+        return DevicePrefetchIter(
+            train_data,
+            stage_fn=default_stage_fn(
+                sharding=self._fused_step._batch_shard),
+            depth=max(1, get_env("MXNET_DEVICE_PREFETCH_DEPTH", 2, int)))
 
     def _sync_params_from_devices(self):
         if self._fused_step is not None and self._fused_dirty:

@@ -202,7 +202,11 @@ class DataParallelTrainStep:
         cast_names = frozenset(self.data_names)  # NEVER labels: class
         # indices >= 257 are unrepresentable in bf16's 8-bit significand
 
-        def step(params, opt_state, aux, batch, rng, lr):
+        # batch rides in as TWO pytree args: data (dp-sharded, bf16-castable)
+        # and labels (kept separate so the host-side metric fallback and
+        # callbacks can keep distinct sharding/dtype treatment)
+        def step(params, opt_state, aux, data_part, label_part, rng, lr):
+            batch = {**data_part, **label_part}
             if cdt is not None:
                 batch = {n: (v.astype(cdt)
                              if n in cast_names
@@ -246,9 +250,9 @@ class DataParallelTrainStep:
             {n: self._repl for n in self.param_names},
             st_sharding,
             {n: self._repl for n in self.aux_names},
-            {n: self._batch_shard for n in
-             self.data_names + [l for l in self.label_names
-                                if l in self.arg_names]},
+            {n: self._batch_shard for n in self.data_names},
+            {n: self._batch_shard for n in self.label_names
+             if n in self.arg_names},
             self._repl,
             None,
         )
@@ -257,24 +261,32 @@ class DataParallelTrainStep:
         # shards and all-gathers the updated weights
         out_shardings = ({n: self._repl for n in self.param_names},
                          st_sharding, None, None)
+        # batch args (3, 4) are NOT donated: no step output matches the
+        # batch shapes, so XLA could never alias them — donation would only
+        # warn per compile and force callers that reuse device-resident
+        # batches (bench _phase_step) into per-step defensive copies
         self._step = jax.jit(step, in_shardings=in_shardings,
                              out_shardings=out_shardings,
                              donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
     def __call__(self, batch_np, rng=None, lr=None):
-        """Run one step on a global batch (dict name->numpy or jax.Array)."""
+        """Run one step on a global batch (dict name->numpy or jax.Array).
+
+        Device-resident inputs already on the right sharding (e.g.
+        prefetch-staged batches) pass through zero-copy; anything else is
+        resharded/staged device-side without a host hop."""
         if self._step is None:
             raise MXNetError("call init() first")
-        batch = {}
+        data_part, label_part = {}, {}
+        data_names = frozenset(self.data_names)
         for name, arr in batch_np.items():
-            if isinstance(arr, jax.Array):  # already on device: reshard
-                if arr.sharding != self._batch_shard:  # device-side, no
+            if isinstance(arr, jax.Array):  # already on device
+                if arr.sharding != self._batch_shard:  # reshard, no
                     arr = jax.device_put(arr, self._batch_shard)  # host hop
-                batch[name] = arr
             else:
-                batch[name] = jax.device_put(jnp.asarray(arr),
-                                             self._batch_shard)
+                arr = jax.device_put(jnp.asarray(arr), self._batch_shard)
+            (data_part if name in data_names else label_part)[name] = arr
         if rng is None:
             if self._needs_rng:
                 rng = jax.device_put(
@@ -294,7 +306,7 @@ class DataParallelTrainStep:
         if lr is None:
             lr = self.lr
         self.params, self.opt_state, aux_upd, outs = self._step(
-            self.params, self.opt_state, self.aux, batch,
+            self.params, self.opt_state, self.aux, data_part, label_part,
             rng, _np.float32(lr))
         self.moms = self.opt_state.get("mom") or {}
         self.aux.update(aux_upd)

@@ -13,7 +13,8 @@ import threading
 
 import jax
 
-__all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume"]
+__all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
+           "record_pipeline_event", "pipeline_counters"]
 
 _state = {"running": False, "filename": "profile.json", "events": [],
           "jax_trace_dir": None, "lock": threading.Lock()}
@@ -77,6 +78,39 @@ class record_event:
 
 def is_running():
     return _state["running"]
+
+
+# ----------------------------------------------------------------------
+# training-pipeline overlap counters (module fused path + io_device
+# prefetcher). Unlike trace events these are always on — plain counter
+# adds — so the bench io_train phase can report overlap efficiency
+# without paying for a full profiler session.
+# ----------------------------------------------------------------------
+_PIPELINE_ZERO = {"steps": 0, "prefetch_hit": 0, "prefetch_stall": 0,
+                  "prefetch_stall_ms": 0.0, "prefetch_stage_ms": 0.0,
+                  "dispatch_ms": 0.0, "readback_stall_ms": 0.0}
+_pipeline = dict(_PIPELINE_ZERO)
+
+
+def record_pipeline_event(**deltas):
+    """Accumulate step-time breakdown counters: `prefetch_hit`/
+    `prefetch_stall`[`_ms`] (was the next batch already staged?),
+    `prefetch_stage_ms` (worker H2D staging), `dispatch_ms` (host time to
+    enqueue the fused step) and `readback_stall_ms` (blocking on step
+    i-depth under bounded async dispatch)."""
+    with _state["lock"]:
+        for k, v in deltas.items():
+            _pipeline[k] = _pipeline.get(k, 0) + v
+
+
+def pipeline_counters(reset=False):
+    """Snapshot (optionally reset) the pipeline overlap counters."""
+    with _state["lock"]:
+        out = dict(_pipeline)
+        if reset:
+            _pipeline.clear()
+            _pipeline.update(_PIPELINE_ZERO)
+    return out
 
 
 def record_op_event(name, dur_s, category="operator"):

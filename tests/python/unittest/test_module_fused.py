@@ -237,8 +237,8 @@ def test_fused_bf16_labels_not_cast():
         for _ in range(80):
             st({"data": X, "softmax_label": y})
         out = np.asarray(st._step(st.params, st.opt_state, st.aux,
-                                  {"data": jnp.asarray(X),
-                                   "softmax_label": jnp.asarray(y)},
+                                  {"data": jnp.asarray(X)},
+                                  {"softmax_label": jnp.asarray(y)},
                                   jax.random.PRNGKey(0),
                                   jnp.float32(0.0))[3][0], np.float32)
         losses[name] = out
