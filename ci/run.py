@@ -31,6 +31,15 @@ def stage_build(_):
     return subprocess.call(["make", "-C", os.path.join(ROOT, "src")])
 
 
+def stage_lint(_):
+    """tpulint static analysis over mxnet_tpu/ and tools/ (gating:
+    any unsuppressed error-severity finding fails the stage —
+    docs/faq/analysis.md)."""
+    return subprocess.call(
+        [sys.executable, "-m", "mxnet_tpu.analysis.lint",
+         "mxnet_tpu", "tools"], cwd=ROOT)
+
+
 def stage_unit(args):
     """Python unit suite on the virtual 8-device CPU mesh."""
     cmd = [sys.executable, "-m", "pytest",
@@ -75,6 +84,7 @@ def stage_bench_smoke(_):
 
 STAGES = [
     ("build", stage_build),
+    ("lint", stage_lint),
     ("unit", stage_unit),
     ("train", stage_train),
     ("cpp", stage_cpp),

@@ -1,0 +1,54 @@
+"""mxnet_tpu.analysis — tpulint, the two-level static analysis suite.
+
+Level 1 (`graph_passes`): passes over Symbol graphs and the jaxprs of
+fused/AOT programs — f64 leaks, dead subgraphs/params, donation
+contracts, serving-bucket recompilation hazards, infer_shape drift.
+Hooked (behind ``MXNET_TPU_LINT=1``, see `runtime`) at
+`Executor.warmup`, the serving program cache's compile, and the fused
+train step build; findings surface through `profiler` counters.
+
+Level 2 (`rules` + `lint` CLI): source AST lint for hot-path host syncs
+and async-subsystem discipline. Run it as
+``python -m mxnet_tpu.analysis.lint mxnet_tpu tools`` or via
+``tools/tpulint.py``; the `ci/run.py` ``lint`` stage gates on it.
+
+Catalog, severities and suppression syntax: docs/faq/analysis.md.
+
+Everything re-exported here resolves lazily (PEP 562): the hot modules'
+``from .analysis.runtime import lint_enabled`` guard must not drag the
+AST rule engine and graph passes into every process that builds an
+Executor.
+"""
+
+_EXPORTS = {
+    "Finding": "findings", "Severity": "findings",
+    "apply_pragmas": "findings", "format_finding": "findings",
+    "GRAPH_RULES": "graph_passes", "check_bucket_escape": "graph_passes",
+    "check_donation": "graph_passes",
+    "check_donation_aliasing": "graph_passes",
+    "check_infer_shape_consistency": "graph_passes",
+    "check_jaxpr_dead": "graph_passes", "check_jaxpr_f64": "graph_passes",
+    "check_symbol_f64": "graph_passes",
+    "check_symbol_unused_args": "graph_passes",
+    "run_jaxpr_checks": "graph_passes",
+    "RULES": "rules", "is_hot_path": "rules", "lint_source": "rules",
+    "check_traced": "runtime", "lint_enabled": "runtime",
+    "report_findings": "runtime",
+    "lint_paths": "lint", "find_registry": "lint", "main": "lint",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+        mod = importlib.import_module("." + _EXPORTS[name], __name__)
+        value = getattr(mod, name)
+        globals()[name] = value  # cache: resolve each name once
+        return value
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,6 @@
+"""`python -m mxnet_tpu.analysis` — same CLI as mxnet_tpu.analysis.lint."""
+import sys
+
+from .lint import main
+
+sys.exit(main())

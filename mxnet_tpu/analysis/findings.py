@@ -1,0 +1,119 @@
+"""Finding model shared by both analyzer levels (tpulint).
+
+Reference analog: nnvm graph passes report through `ApplyPass` attribute
+errors and the lint-ish checks in the reference CI (pylint stage of
+ci/jenkins). Here every check — AST rule or program/graph pass — emits
+`Finding` records carrying file:line, rule id, severity and message, so
+one reporter/CI gate serves both levels (docs/faq/analysis.md).
+
+Suppression: a source line (or the comment line directly above it) may
+carry ``# tpulint: allow-<slug> <reason>``. The reason is REQUIRED — a
+bare pragma does not suppress and additionally raises TPL000, so every
+silenced violation documents why it is safe.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["Severity", "Finding", "parse_pragmas", "apply_pragmas",
+           "format_finding", "PRAGMA_RE"]
+
+
+class Severity:
+    """Ordered severities; CI fails on unsuppressed ERROR findings."""
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+    _ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+    @classmethod
+    def rank(cls, sev):
+        return cls._ORDER.get(sev, 3)
+
+
+class Finding:
+    """One analyzer result: where, which rule, how bad, and why."""
+
+    __slots__ = ("rule_id", "slug", "severity", "message", "path", "line",
+                 "col", "suppressed", "suppress_reason")
+
+    def __init__(self, rule_id, slug, severity, message, path="<graph>",
+                 line=0, col=0):
+        self.rule_id = rule_id
+        self.slug = slug
+        self.severity = severity
+        self.message = message
+        self.path = path
+        self.line = line
+        self.col = col
+        self.suppressed = False
+        self.suppress_reason = None
+
+    def as_dict(self):
+        return {"rule": self.rule_id, "slug": self.slug,
+                "severity": self.severity, "message": self.message,
+                "path": self.path, "line": self.line, "col": self.col,
+                "suppressed": self.suppressed,
+                "suppress_reason": self.suppress_reason}
+
+    def __repr__(self):
+        return "Finding(%s)" % format_finding(self)
+
+
+def format_finding(f):
+    tag = " [suppressed: %s]" % f.suppress_reason if f.suppressed else ""
+    return "%s:%d:%d: %s %s: %s%s" % (f.path, f.line, f.col, f.rule_id,
+                                      f.severity, f.message, tag)
+
+
+# ``# tpulint: allow-host-sync params adopted once at init`` — slug then
+# free-text reason (an optional ':' after the slug is tolerated)
+PRAGMA_RE = re.compile(
+    r"#\s*tpulint:\s*allow-([a-z0-9][a-z0-9-]*)\s*:?\s*(.*?)\s*$")
+
+
+def parse_pragmas(source):
+    """Map line number (1-based) -> list of (slug, reason, line) pragmas.
+
+    Returns (pragmas, bad) where `bad` lists TPL000 findings for pragmas
+    whose reason is empty (they suppress nothing)."""
+    pragmas, bad = {}, []
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = PRAGMA_RE.search(text)
+        if not m:
+            continue
+        slug, reason = m.group(1), m.group(2)
+        if not reason:
+            bad.append((lineno, slug))
+            continue
+        pragmas.setdefault(lineno, []).append((slug, reason))
+    return pragmas, bad
+
+
+def apply_pragmas(findings, source, path):
+    """Mark findings suppressed by a same-line or directly-preceding-line
+    pragma whose slug matches. Returns extra findings for malformed
+    pragmas (missing reason — TPL000, error)."""
+    pragmas, bad = parse_pragmas(source)
+    lines = source.splitlines()
+    for f in findings:
+        for lineno in (f.line, f.line - 1):
+            if lineno == f.line - 1 and lineno >= 1:
+                # only a comment-only line may carry a pragma for the
+                # NEXT line (a pragma on code suppresses that code line)
+                stripped = lines[lineno - 1].lstrip() \
+                    if lineno - 1 < len(lines) else ""
+                if not stripped.startswith("#"):
+                    continue
+            for slug, reason in pragmas.get(lineno, ()):
+                if slug == f.slug:
+                    f.suppressed = True
+                    f.suppress_reason = reason
+                    break
+            if f.suppressed:
+                break
+    extra = [Finding("TPL000", "pragma", Severity.ERROR,
+                     "tpulint pragma 'allow-%s' has no reason; a bare "
+                     "pragma suppresses nothing" % slug, path, lineno)
+             for lineno, slug in bad]
+    return extra

@@ -1,0 +1,364 @@
+"""Level-1 tpulint passes — program/graph analysis over Symbol graphs and
+the jaxprs of fused/AOT programs.
+
+Reference analog: the nnvm bind-time passes (``ApplyPass(g, "PlanMemory")``,
+InferShape/InferType) that caught whole bug classes before execution.
+TPU-native, the checkable artifacts are the Symbol DAG (before bind) and
+the traced jaxpr of each compiled program (at `Executor.warmup`, serving
+program-cache compile, and the fused train step build — all hooked behind
+``MXNET_TPU_LINT=1``, see analysis.runtime).
+
+Rules:
+- TPL201 ``f64-leak``        float64 dtype destined for TPU
+- TPL202 ``dead-code``       dead subgraphs / params unused by any output
+- TPL203 ``donation``        donated-buffer contract violations
+- TPL204 ``recompile-hazard`` shapes escaping the serving bucket set
+- TPL205 ``infer-shape``     infer_shape vs infer_shape_partial drift
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .findings import Finding, Severity
+
+__all__ = ["GRAPH_RULES", "check_symbol_f64", "check_jaxpr_f64",
+           "check_jaxpr_dead", "check_symbol_unused_args",
+           "check_donation", "check_donation_aliasing",
+           "check_bucket_escape", "check_infer_shape_consistency",
+           "run_jaxpr_checks"]
+
+GRAPH_RULES = {
+    "TPL201": ("f64-leak", Severity.ERROR,
+               "float64 value destined for TPU (no f64 ALU path; silently "
+               "downcast or unsupported)"),
+    "TPL202": ("dead-code", Severity.WARNING,
+               "dead subgraph or parameter unused by any output"),
+    "TPL203": ("donation", Severity.ERROR,
+               "buffer-donation contract violation"),
+    "TPL204": ("recompile-hazard", Severity.WARNING,
+               "shape-polymorphic input escaping the serving bucket set"),
+    "TPL205": ("infer-shape", Severity.ERROR,
+               "infer_shape / infer_shape_partial inconsistency"),
+}
+
+
+def _finding(rule_id, message, where, severity=None):
+    slug, sev, _ = GRAPH_RULES[rule_id]
+    return Finding(rule_id, slug, severity or sev, message, where)
+
+
+# ----------------------------------------------------------------------
+# TPL201 — float64 leaks
+# ----------------------------------------------------------------------
+def check_symbol_f64(symbol, where="<symbol>", type_hints=None):
+    """Flag float64 args/outputs/aux a Symbol would bind with. Runs the
+    bidirectional infer_type pass, so one f64 Variable or Cast poisons —
+    and reports — every dtype it unifies with."""
+    findings = []
+    arg_types, out_types, aux_types = symbol.infer_type(**(type_hints or {}))
+    f64 = _np.dtype(_np.float64)
+    for name, dt in zip(symbol.list_arguments(), arg_types):
+        if dt == f64:
+            findings.append(_finding(
+                "TPL201", "argument %r infers float64" % name, where))
+    for name, dt in zip(symbol.list_auxiliary_states(), aux_types):
+        if dt == f64:
+            findings.append(_finding(
+                "TPL201", "aux state %r infers float64" % name, where))
+    for name, dt in zip(symbol.list_outputs(), out_types):
+        if dt == f64:
+            findings.append(_finding(
+                "TPL201", "output %r infers float64" % name, where))
+    return findings
+
+
+def _iter_sub_jaxprs(eqn):
+    for val in eqn.params.values():
+        if hasattr(val, "jaxpr") and hasattr(val, "consts"):
+            yield val.jaxpr            # ClosedJaxpr (pjit, custom_vjp, ...)
+        elif hasattr(val, "eqns") and hasattr(val, "invars"):
+            yield val                  # raw Jaxpr (call_jaxpr)
+
+
+def check_jaxpr_f64(closed_jaxpr, where="<jaxpr>"):
+    """Walk a (Closed)Jaxpr — recursing into pjit/scan/... sub-jaxprs —
+    and flag every float64 abstract value. Only observable when x64 is
+    enabled; with it off JAX already downcast the leak at trace time."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    findings = []
+    f64 = _np.dtype(_np.float64)
+
+    def scan(jx, depth):
+        # `dt is not None` first: np.dtype(None) defaults to float64, so
+        # `None == f64` is True and a dtype-less aval (token-typed
+        # effects) would read as a leak. Invars are only judged at the
+        # program boundary — a pjit sub-jaxpr repeats the same vars and
+        # would double-count each leak per nesting level
+        if depth == 0:
+            for i, v in enumerate(jx.invars):
+                dt = getattr(v.aval, "dtype", None)
+                if dt is not None and dt == f64:
+                    findings.append(_finding(
+                        "TPL201", "program input %d (%s) is float64"
+                        % (i, v.aval.str_short()), where))
+        for eqn in jx.eqns:
+            subs = list(_iter_sub_jaxprs(eqn))
+            if not subs:
+                # wrapper eqns (pjit, custom_vjp) just re-export their
+                # sub-jaxpr's results — the inner scan reports the
+                # producing op, counting the wrapper too would tally one
+                # leak once per nesting level
+                for v in eqn.outvars:
+                    dt = getattr(getattr(v, "aval", None), "dtype", None)
+                    if dt is not None and dt == f64:
+                        findings.append(_finding(
+                            "TPL201", "op %r produces float64 (%s)"
+                            % (eqn.primitive.name, v.aval.str_short()),
+                            where))
+            if depth < 8:
+                for sub in subs:
+                    scan(sub, depth + 1)
+
+    scan(jaxpr, 0)
+    return findings
+
+
+# ----------------------------------------------------------------------
+# TPL202 — dead subgraphs / unused params
+# ----------------------------------------------------------------------
+def _is_rng_key(aval, label=None):
+    """Every program here threads a PRNG key by contract, even when the
+    graph is deterministic (Executor reuses one fixed key rather than
+    specializing signatures) — an unused key input is by design, never a
+    dead param worth flagging."""
+    if label == "rng":
+        return True
+    dt = getattr(aval, "dtype", None)
+    if dt is None:
+        return False
+    try:
+        import jax
+        if jax.dtypes.issubdtype(dt, jax.dtypes.prng_key):
+            return True
+    except Exception:  # pragma: no cover - jax-version dependent
+        pass
+    return (_np.dtype(dt) == _np.dtype(_np.uint32)
+            and tuple(getattr(aval, "shape", ())) == (2,))
+
+
+def check_jaxpr_dead(closed_jaxpr, where="<jaxpr>", input_names=None):
+    """Backward liveness over a jaxpr: equations contributing to no output
+    are dead subgraphs; inputs feeding no live equation (and no output)
+    are params unused by any output. Effectful equations (callbacks, io)
+    are kept live. Recurses into sub-jaxprs for dead code hidden under a
+    pjit wrapper."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    findings = []
+
+    def scan(jx, depth, names):
+        # forward pass: vars derived purely from constants. Every
+        # jax.vjp-built program carries scalar-constant broadcasts the
+        # trace emits and XLA trivially DCEs — nothing a user wrote is
+        # dead there, so constant-only chains never count as findings
+        const_vars = set()
+        for eqn in jx.eqns:
+            if all(hasattr(v, "val") or id(v) in const_vars
+                   for v in eqn.invars):
+                const_vars.update(id(v) for v in eqn.outvars)
+        live = {id(v) for v in jx.outvars if hasattr(v, "aval")}
+        dead_eqns = []
+        for eqn in reversed(jx.eqns):
+            out_live = any(id(v) in live for v in eqn.outvars)
+            effectful = bool(getattr(eqn, "effects", ()))
+            if out_live or effectful:
+                for v in eqn.invars:
+                    live.add(id(v))  # Literals get unique ids — harmless
+            elif not all(id(v) in const_vars for v in eqn.outvars):
+                dead_eqns.append(eqn)
+        for eqn in reversed(dead_eqns):
+            findings.append(_finding(
+                "TPL202", "dead subgraph: %r output is unused by any "
+                "program output" % eqn.primitive.name, where))
+        if depth == 0:
+            # sub-jaxpr invars belong to their OUTER equation (a
+            # custom_vjp forward may ignore an operand its backward rule
+            # consumes) — unused-input analysis is only meaningful at the
+            # program boundary
+            for i, v in enumerate(jx.invars):
+                if id(v) not in live:
+                    label = names[i] if names and i < len(names) else None
+                    if _is_rng_key(v.aval, label):
+                        continue
+                    findings.append(_finding(
+                        "TPL202", "%s (%s) is unused by any output"
+                        % (label or "input %d" % i, v.aval.str_short()),
+                        where))
+        if depth < 8:
+            for eqn in jx.eqns:
+                for sub in _iter_sub_jaxprs(eqn):
+                    scan(sub, depth + 1, None)
+
+    scan(jaxpr, 0, input_names)
+    return findings
+
+
+def check_symbol_unused_args(symbol, provided, where="<symbol>"):
+    """Params handed to bind that the graph never consumes (Executor's
+    _normalize accepts dict extras silently — the reference raised at
+    bind; this pass restores the diagnostic)."""
+    used = set(symbol.list_arguments()) | set(symbol.list_auxiliary_states())
+    return [_finding("TPL202",
+                     "provided param %r is unused by any output" % name,
+                     where)
+            for name in provided if name not in used]
+
+
+# ----------------------------------------------------------------------
+# TPL203 — donation contracts
+# ----------------------------------------------------------------------
+_TRAIN_DONATABLE = frozenset({"params", "opt_state"})
+_SERVING_DONATABLE = frozenset({"batch"})
+
+
+def check_donation(donate_argnums, roles, mode="train", where="<program>"):
+    """Validate a jit donation spec against the argument roles.
+
+    Train-step contract (PR 3): only ``params``/``opt_state`` may be
+    donated — batch args are never donated (no step output can alias
+    them; donation would warn per compile and force device-batch callers
+    into per-step defensive copies). Serving contract (PR 1): only the
+    per-request ``batch`` is donated — params/aux are reused every call,
+    a donated weight buffer is freed under the next request.
+    """
+    allowed = _TRAIN_DONATABLE if mode == "train" else _SERVING_DONATABLE
+    findings = []
+    for argnum in donate_argnums:
+        if argnum >= len(roles) or argnum < 0:
+            findings.append(_finding(
+                "TPL203", "donate_argnums names position %d but the "
+                "program has %d args" % (argnum, len(roles)), where))
+            continue
+        role = roles[argnum]
+        if role not in allowed:
+            findings.append(_finding(
+                "TPL203", "%s-mode program donates arg %d (role %r); only "
+                "%s may be donated" % (mode, argnum, role,
+                                       "/".join(sorted(allowed))), where))
+    return findings
+
+
+def check_donation_aliasing(in_avals_by_arg, out_avals, donate_argnums,
+                            where="<program>"):
+    """A donated buffer XLA can never alias to an output (no output with
+    the same shape+dtype) is a wasted donation: it still invalidates the
+    caller's buffer and forces defensive copies, but saves nothing.
+
+    ``in_avals_by_arg``: per-positional-arg list of (shape, dtype) leaf
+    signatures; ``out_avals``: flat list of (shape, dtype) output leaves.
+    """
+    out_sigs = {(tuple(s), _np.dtype(d)) for s, d in out_avals}
+    findings = []
+    for argnum in donate_argnums:
+        if argnum >= len(in_avals_by_arg):
+            continue
+        leaves = [(tuple(s), _np.dtype(d))
+                  for s, d in in_avals_by_arg[argnum]]
+        if leaves and not any(sig in out_sigs for sig in leaves):
+            findings.append(_finding(
+                "TPL203", "donated arg %d matches no output shape/dtype — "
+                "the donation can never alias and only forces defensive "
+                "copies" % argnum, where, severity=Severity.WARNING))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# TPL204 — recompilation hazards
+# ----------------------------------------------------------------------
+def check_bucket_escape(batch_size, buckets, where="<serving>"):
+    """A request batch size above the largest configured bucket compiles
+    (and caches) its own exact-shape program — a steady mix of oversized
+    sizes is an unbounded recompile/cache-growth hazard."""
+    if not buckets or batch_size <= max(buckets):
+        return []
+    return [_finding(
+        "TPL204", "batch size %d escapes the bucket set %s: each distinct "
+        "oversized shape compiles its own XLA program"
+        % (batch_size, tuple(buckets)), where)]
+
+
+# ----------------------------------------------------------------------
+# TPL205 — infer_shape vs infer_shape_partial drift
+# ----------------------------------------------------------------------
+def check_infer_shape_consistency(symbol, known_shapes, where="<symbol>"):
+    """Surface, before bind, disagreements between the strict and partial
+    shape-inference passes: partial resolving shapes the strict pass
+    rejects, or the two passes inferring different concrete shapes for
+    the same variable."""
+    from ..base import MXNetError
+    findings = []
+    full = full_err = None
+    try:
+        full = symbol.infer_shape(**known_shapes)
+    except MXNetError as e:
+        full_err = e
+    try:
+        partial = symbol.infer_shape_partial(**known_shapes)
+    except MXNetError as e:
+        if full_err is None:
+            # drift only when the strict pass succeeded: if BOTH raise,
+            # the inputs have a genuine op-level shape bug (both passes
+            # wrap it identically) and there is nothing partial-specific
+            # to report
+            findings.append(_finding(
+                "TPL205", "infer_shape_partial raised (%s) but infer_shape "
+                "succeeded — the partial pass must degrade to None, never "
+                "fail" % e, where))
+        return findings
+    if full is None:
+        if partial is not None and all(
+                s is not None for s in partial[1] or [None]):
+            findings.append(_finding(
+                "TPL205", "infer_shape rejects these inputs (%s) but "
+                "infer_shape_partial resolves every output — the two "
+                "passes disagree" % full_err, where))
+        return findings
+    names = (symbol.list_arguments(), symbol.list_outputs(),
+             symbol.list_auxiliary_states())
+    kinds = ("argument", "output", "aux state")
+    for kind, nm, fl, pl in zip(kinds, names, full, partial):
+        for name, fs, ps in zip(nm, fl, pl):
+            if fs is not None and ps is not None and tuple(fs) != tuple(ps):
+                findings.append(_finding(
+                    "TPL205", "%s %r: infer_shape says %s but "
+                    "infer_shape_partial says %s"
+                    % (kind, name, tuple(fs), tuple(ps)), where))
+            elif fs is not None and ps is None:
+                findings.append(_finding(
+                    "TPL205", "%s %r: strict pass infers %s but the "
+                    "partial pass loses it" % (kind, name, tuple(fs)),
+                    where, severity=Severity.WARNING))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# aggregate entry for the runtime hooks
+# ----------------------------------------------------------------------
+def run_jaxpr_checks(closed_jaxpr, where="<jaxpr>", input_names=None):
+    findings = (check_jaxpr_f64(closed_jaxpr, where)
+                + check_jaxpr_dead(closed_jaxpr, where, input_names))
+    # collapse repeats (a fused step can hold N identical dead zeros
+    # broadcasts — one finding with a count reads, N findings spam)
+    merged, counts = {}, {}
+    for f in findings:
+        key = (f.rule_id, f.message)
+        if key in merged:
+            counts[key] += 1
+        else:
+            merged[key] = f
+            counts[key] = 1
+    out = []
+    for key, f in merged.items():
+        if counts[key] > 1:
+            f.message += " (x%d)" % counts[key]
+        out.append(f)
+    return out

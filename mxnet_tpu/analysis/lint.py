@@ -1,0 +1,135 @@
+"""tpulint CLI — run the Level-2 AST rules over source trees.
+
+Usage::
+
+    python -m mxnet_tpu.analysis.lint mxnet_tpu tools
+    python tools/tpulint.py mxnet_tpu tools          # same thing
+
+Exit status: 0 when no unsuppressed error-severity findings remain, 1
+otherwise, 2 on usage errors. CI gates on this (`ci/run.py` `lint`
+stage). Rule catalog + suppression syntax: docs/faq/analysis.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .findings import Severity, format_finding
+from .rules import RULES, is_hot_path, lint_source
+
+__all__ = ["lint_paths", "find_registry", "main"]
+
+_REGISTRY_REL = os.path.join("docs", "faq", "env_var.md")
+
+
+def find_registry(start):
+    """Walk upward from `start` looking for docs/faq/env_var.md (the env
+    var registry the TPL105 rule checks against)."""
+    path = os.path.abspath(start)
+    if os.path.isfile(path):
+        path = os.path.dirname(path)
+    while True:
+        cand = os.path.join(path, _REGISTRY_REL)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(path)
+        if parent == path:
+            return None
+        path = parent
+
+
+def _iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        yield os.path.join(root, fname)
+
+
+def lint_paths(paths, registry_text=None, registry_path=None):
+    """Lint every .py file under `paths`; returns the flat finding list."""
+    if registry_text is None and registry_path:
+        with open(registry_path) as f:
+            registry_text = f.read()
+    findings = []
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            print("tpulint: cannot read %s: %s" % (path, e), file=sys.stderr)
+            continue
+        findings.extend(lint_source(source, path, hot=is_hot_path(path),
+                                    registry_text=registry_text))
+    return findings
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tpulint",
+        description="Static analysis for TPU hot paths and async "
+                    "discipline (docs/faq/analysis.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories to lint (default: mxnet_tpu "
+                         "tools, resolved from the repo root)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--registry", default=None,
+                    help="env-var registry markdown (default: nearest "
+                         "docs/faq/env_var.md above the linted paths)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print findings silenced by pragmas")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from .graph_passes import GRAPH_RULES
+        for rid, (slug, sev, desc) in sorted({**RULES, **GRAPH_RULES}.items()):
+            print("%-8s %-18s %-8s %s" % (rid, slug, sev, desc))
+        return 0
+
+    if args.paths:
+        paths = args.paths
+    else:
+        # default paths resolve against the repo this package lives in,
+        # not the cwd — tools/tpulint.py promises to work from anywhere
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        paths = [os.path.join(root, "mxnet_tpu"), os.path.join(root, "tools")]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        ap.error("no such path: %s" % ", ".join(missing))
+
+    registry_path = args.registry or find_registry(paths[0])
+    findings = lint_paths(paths, registry_path=registry_path)
+    if registry_path is None:
+        print("tpulint: warning: docs/faq/env_var.md not found — "
+              "env-registry rule (TPL105) skipped", file=sys.stderr)
+
+    visible = [f for f in findings
+               if args.show_suppressed or not f.suppressed]
+    visible.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    if args.format == "json":
+        print(json.dumps([f.as_dict() for f in visible], indent=2))
+    else:
+        for f in visible:
+            print(format_finding(f))
+
+    active = [f for f in findings if not f.suppressed]
+    n_err = sum(1 for f in active if f.severity == Severity.ERROR)
+    n_warn = sum(1 for f in active if f.severity == Severity.WARNING)
+    n_sup = sum(1 for f in findings if f.suppressed)
+    if args.format == "text":
+        print("tpulint: %d finding(s): %d error(s), %d warning(s), "
+              "%d suppressed" % (len(active), n_err, n_warn, n_sup))
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

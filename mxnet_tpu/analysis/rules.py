@@ -1,0 +1,381 @@
+"""Level-2 tpulint rules — source AST lint for TPU hot-path and
+async-subsystem discipline.
+
+These encode the exact bug shapes PR 1-3 review rounds kept finding by
+hand (docs/faq/analysis.md has the catalog with examples):
+
+- TPL101 ``host-sync``      host sync on the fused/serving hot path
+- TPL102 ``thread-sentinel`` worker thread without stop-event/sentinel
+- TPL103 ``blocking-get``   untimed queue.get() inside a worker loop
+- TPL104 ``lock-device-call`` lock held across a jax device/compile call
+- TPL105 ``env-registry``   MXNET_* env read missing from docs/faq/env_var.md
+
+All rules are static heuristics over the AST — they cannot prove an
+expression is a device array, so genuinely-host uses are silenced with a
+reasoned pragma (``# tpulint: allow-host-sync <reason>``), which doubles
+as reviewer documentation at the call site.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .findings import Finding, Severity, apply_pragmas
+
+__all__ = ["lint_source", "is_hot_path", "RULES"]
+
+RULES = {
+    "TPL000": ("pragma", Severity.ERROR,
+               "tpulint pragma missing its required reason"),
+    "TPL001": ("parse", Severity.ERROR, "file does not parse"),
+    "TPL101": ("host-sync", Severity.ERROR,
+               "host sync (.asnumpy()/.item()/np.asarray/float(...)/"
+               "jax.device_get) on a TPU hot path"),
+    "TPL102": ("thread-sentinel", Severity.ERROR,
+               "looping worker thread without a stop-event or sticky "
+               "terminal sentinel"),
+    "TPL103": ("blocking-get", Severity.ERROR,
+               "queue.get() without timeout inside a worker loop"),
+    "TPL104": ("lock-device-call", Severity.ERROR,
+               "lock/condition held across a jax device or compile call"),
+    "TPL105": ("env-registry", Severity.ERROR,
+               "MXNET_* env var read in source but undocumented in "
+               "docs/faq/env_var.md"),
+}
+
+# directories whose files are fused/serving hot paths (ISSUE 5): host
+# syncs there stall the XLA dispatch pipeline
+_HOT_PARTS = {"module", "parallel", "serving"}
+_HOT_FILES = {"io_device.py"}
+
+_STOPPISH = re.compile(
+    r"stop|done|sentinel|terminal|shutdown|cancel|exit|quit|kill")
+# queue.task_done() is in every worker loop and says nothing about a stop
+# path — never let its "done" satisfy _STOPPISH
+_STOP_NOISE = frozenset({"task_done"})
+_LOCKISH = re.compile(r"lock|mutex|cond|(^|_)cv$")
+_SYNC_ATTRS = frozenset({"asnumpy", "item", "tolist"})
+_NP_PULL_FNS = frozenset({"asarray", "array", "asanyarray"})
+_DEVICE_CALL_ATTRS = frozenset({"device_put", "device_get",
+                                "block_until_ready", "lower", "compile"})
+_DEVICE_CALL_SAFE_ROOTS = frozenset({"re", "json", "pickle", "os",
+                                     "struct", "zlib", "sre_compile"})
+# float(X) is exempt when X is one of these callees — env/dict reads and
+# obvious host-scalar producers, not device arrays
+_FLOAT_EXEMPT_CALLEES = frozenset({"get", "getenv", "pop", "len",
+                                   "env_flag", "get_env"})
+_ENV_READ_FNS = frozenset({"env_flag", "get_env"})
+
+
+def is_hot_path(path):
+    parts = str(path).replace("\\", "/").split("/")
+    if parts and parts[-1] in _HOT_FILES:
+        return True
+    return any(p in _HOT_PARTS for p in parts[:-1])
+
+
+def _root_name(node):
+    """Leftmost Name of an attribute/call chain, or None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _idents(node):
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id.lower())
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr.lower())
+        elif isinstance(n, ast.arg):
+            out.add(n.arg.lower())
+    return out
+
+
+def _str_arg(call, index=0):
+    if len(call.args) > index and isinstance(call.args[index], ast.Constant) \
+            and isinstance(call.args[index].value, str):
+        return call.args[index].value
+    return None
+
+
+class _Analyzer(ast.NodeVisitor):
+    def __init__(self, path, hot, registry_text):
+        self.path = path
+        self.hot = hot
+        self.registry = registry_text
+        self.findings = []
+        self.np_aliases = set()
+        self.jax_aliases = set()
+        self.jnp_aliases = set()
+        self.class_stack = []
+        self.func_stack = []
+        self.loop_depth = 0
+        self.lock_depth = 0
+        self.module_funcs = {}
+        self._thread_calls = []  # deferred: (call, class_node, func_chain)
+
+    # -------------------------------------------------- reporting
+    def _emit(self, rule_id, node, message):
+        slug, sev, _ = RULES[rule_id]
+        self.findings.append(Finding(rule_id, slug, sev, message, self.path,
+                                     getattr(node, "lineno", 0),
+                                     getattr(node, "col_offset", 0)))
+
+    # -------------------------------------------------- imports
+    def visit_Import(self, node):
+        for alias in node.names:
+            name, asname = alias.name, alias.asname or alias.name
+            if name == "numpy":
+                self.np_aliases.add(asname)
+            elif name == "jax.numpy":
+                self.jnp_aliases.add(asname)
+            elif name == "jax":
+                self.jax_aliases.add(asname)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "jax" and any(a.name == "numpy"
+                                        for a in node.names):
+            for a in node.names:
+                if a.name == "numpy":
+                    self.jnp_aliases.add(a.asname or "numpy")
+        self.generic_visit(node)
+
+    # -------------------------------------------------- scope tracking
+    def visit_ClassDef(self, node):
+        self.class_stack.append(node)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node):
+        if not self.func_stack and not self.class_stack:
+            self.module_funcs[node.name] = node
+        self.func_stack.append(node)
+        # a nested def merely DEFINED under a with-lock/loop executes
+        # later, outside both — reset the depths for its body
+        loops, self.loop_depth = self.loop_depth, 0
+        locks, self.lock_depth = self.lock_depth, 0
+        self.generic_visit(node)
+        self.loop_depth = loops
+        self.lock_depth = locks
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _visit_loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_While = _visit_loop
+    visit_For = _visit_loop
+
+    def visit_With(self, node):
+        held = 0
+        for item in node.items:
+            ctx = item.context_expr
+            ident = None
+            if isinstance(ctx, ast.Name):
+                ident = ctx.id
+            elif isinstance(ctx, ast.Attribute):
+                ident = ctx.attr
+            if ident is not None and _LOCKISH.search(ident.lower()):
+                held += 1
+        self.lock_depth += held
+        self.generic_visit(node)
+        self.lock_depth -= held
+
+    visit_AsyncWith = visit_With
+
+    # -------------------------------------------------- call rules
+    def visit_Call(self, node):
+        func = node.func
+        # ---- TPL101 host syncs (hot paths only)
+        if self.hot:
+            if isinstance(func, ast.Attribute):
+                if func.attr in _SYNC_ATTRS and not node.args:
+                    self._emit("TPL101", node,
+                               ".%s() pulls a device array to host on a "
+                               "hot path" % func.attr)
+                elif func.attr in _NP_PULL_FNS \
+                        and _root_name(func.value) in self.np_aliases:
+                    self._emit("TPL101", node,
+                               "numpy %s() on a hot path forces a device->"
+                               "host transfer when fed a device array"
+                               % func.attr)
+                elif func.attr == "device_get" \
+                        and _root_name(func.value) in self.jax_aliases:
+                    self._emit("TPL101", node,
+                               "jax.device_get() on a hot path")
+            elif isinstance(func, ast.Name) and func.id == "float" \
+                    and node.args:
+                arg = node.args[0]
+                flag = isinstance(arg, ast.Subscript)
+                if isinstance(arg, ast.Call):
+                    callee = arg.func
+                    name = (callee.attr if isinstance(callee, ast.Attribute)
+                            else callee.id if isinstance(callee, ast.Name)
+                            else None)
+                    flag = name not in _FLOAT_EXEMPT_CALLEES
+                if flag:
+                    self._emit("TPL101", node,
+                               "float(...) of a computed value on a hot "
+                               "path realizes a device scalar on host")
+
+        # ---- TPL102 worker threads (resolved after full walk)
+        if (isinstance(func, ast.Attribute) and func.attr == "Thread") or \
+                (isinstance(func, ast.Name) and func.id == "Thread"):
+            self._thread_calls.append(
+                (node, self.class_stack[-1] if self.class_stack else None,
+                 list(self.func_stack)))
+
+        # ---- TPL103 untimed queue.get in a loop
+        if isinstance(func, ast.Attribute) and func.attr == "get" \
+                and len(node.args) <= 1 and self.loop_depth > 0:
+            recv = func.value
+            ident = (recv.attr if isinstance(recv, ast.Attribute)
+                     else recv.id if isinstance(recv, ast.Name) else "")
+            kw = {k.arg: k.value for k in node.keywords}
+            # Queue.get(block=True, timeout=None): two positionals means a
+            # timeout was passed; otherwise only block=False (non-blocking,
+            # cannot hang) exempts — block=True / block=<expr>, keyword or
+            # positional, still blocks forever sans timeout
+            block = node.args[0] if node.args else kw.get("block")
+            nonblocking = isinstance(block, ast.Constant) \
+                and block.value is False
+            # timeout=None is the documented forever-block default, not a
+            # timeout — only a real value exempts
+            timed = "timeout" in kw and not (
+                isinstance(kw["timeout"], ast.Constant)
+                and kw["timeout"].value is None)
+            if ("queue" in ident.lower() or ident.lower() in ("q", "_q")) \
+                    and not timed and not nonblocking:
+                self._emit("TPL103", node,
+                           "%s.get() without timeout in a worker loop "
+                           "hangs forever if the producer dies" % ident)
+
+        # ---- TPL104 device call under a held lock
+        if self.lock_depth > 0:
+            root = _root_name(func)
+            hit = False
+            if root in self.jnp_aliases:
+                hit = True  # every jnp.* call dispatches device compute
+            elif isinstance(func, ast.Attribute) \
+                    and func.attr in _DEVICE_CALL_ATTRS \
+                    and root not in _DEVICE_CALL_SAFE_ROOTS:
+                # bare jax.* is NOT flagged wholesale: metadata constructors
+                # (ShapeDtypeStruct, sharding specs) are lock-safe — only
+                # the dispatch/compile entry points above are the hazard
+                hit = True
+            if hit:
+                what = func.attr if isinstance(func, ast.Attribute) \
+                    else getattr(func, "id", "?")
+                self._emit("TPL104", node,
+                           "%s(...) under a held lock serializes device "
+                           "dispatch/compile behind the lock" % what)
+
+        # ---- TPL105 env registry
+        var = self._env_read_var(node)
+        if var is not None and var.startswith("MXNET"):
+            if not self._documented(var):
+                self._emit("TPL105", node,
+                           "env var %s is read here but not documented in "
+                           "docs/faq/env_var.md" % var)
+        self.generic_visit(node)
+
+    def _documented(self, var):
+        """Whole-word registry match: MXNET_CHECKPOINT must not count as
+        documented just because MXNET_CHECKPOINT_DIR is."""
+        if self.registry is None:
+            return True
+        return re.search(r"\b%s\b" % re.escape(var),
+                         self.registry) is not None
+
+    def visit_Subscript(self, node):
+        # os.environ["MXNET_X"]
+        if isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "environ":
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str) \
+                    and sl.value.startswith("MXNET"):
+                if not self._documented(sl.value):
+                    self._emit("TPL105", node,
+                               "env var %s is read here but not documented "
+                               "in docs/faq/env_var.md" % sl.value)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _env_read_var(node):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "get" and isinstance(func.value, ast.Attribute) \
+                    and func.value.attr == "environ":
+                return _str_arg(node)
+            if func.attr == "getenv" or func.attr in _ENV_READ_FNS:
+                return _str_arg(node)
+        elif isinstance(func, ast.Name) and func.id in _ENV_READ_FNS:
+            return _str_arg(node)
+        return None
+
+    # -------------------------------------------------- thread resolution
+    def _resolve_target(self, call, cls, func_chain):
+        target = next((k.value for k in call.keywords if k.arg == "target"),
+                      None)
+        if target is None:
+            return None
+        if isinstance(target, ast.Name):
+            for frame in reversed(func_chain):
+                for stmt in ast.walk(frame):
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and stmt.name == target.id:
+                        return stmt
+            return self.module_funcs.get(target.id)
+        if isinstance(target, ast.Attribute) and cls is not None \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            for stmt in cls.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and stmt.name == target.attr:
+                    return stmt
+        return None
+
+    def finish(self):
+        for call, cls, chain in self._thread_calls:
+            fn = self._resolve_target(call, cls, chain)
+            if fn is None:
+                continue  # unresolvable target: cannot judge statically
+            if not any(isinstance(n, ast.While) for n in ast.walk(fn)):
+                continue  # one-shot thread, no loop to wedge
+            scope = _idents(fn)
+            if cls is not None:
+                scope |= _idents(cls)
+            elif chain:
+                scope |= _idents(chain[-1])
+            scope -= _STOP_NOISE
+            if not any(_STOPPISH.search(i) for i in scope):
+                self._emit("TPL102", call,
+                           "thread target %r loops forever with no "
+                           "stop-event, sticky sentinel, or shutdown path "
+                           "in scope" % fn.name)
+        return self.findings
+
+
+def lint_source(source, path="<string>", hot=None, registry_text=None):
+    """Lint one file's source; returns findings with pragmas applied."""
+    if hot is None:
+        hot = is_hot_path(path)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("TPL001", "parse", Severity.ERROR,
+                        "syntax error: %s" % e, path, e.lineno or 0)]
+    analyzer = _Analyzer(path, hot, registry_text)
+    analyzer.visit(tree)
+    findings = analyzer.finish()
+    findings += apply_pragmas(findings, source, path)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule_id))
+    return findings

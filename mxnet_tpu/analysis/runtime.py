@@ -1,0 +1,65 @@
+"""Runtime guard for the compile-time graph passes.
+
+With ``MXNET_TPU_LINT=1`` the Level-1 passes (graph_passes) run at every
+program-build site — `Executor.warmup`, the serving program cache's
+compile, and the fused train step's build — and report through
+`profiler.record_analysis_finding` counters plus a logged warning per
+finding. Off (the default) the hooks cost one env check.
+
+Kept import-light: hot modules call these two functions lazily so the
+analyzer package never loads on the training hot path unless asked.
+"""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["lint_enabled", "report_findings", "check_traced"]
+
+_log = logging.getLogger("mxnet_tpu.analysis")
+
+
+def lint_enabled():
+    from ..base import env_flag
+    return env_flag("MXNET_TPU_LINT")
+
+
+def report_findings(findings):
+    """Route findings into profiler counters + the analysis logger.
+    Returns the findings for callers that also want them (each Finding
+    carries its own where)."""
+    from .. import profiler
+    from .findings import format_finding
+    for f in findings:
+        profiler.record_analysis_finding(f.rule_id, f.severity)
+        _log.warning("tpulint: %s", format_finding(f))
+    return findings
+
+
+def check_traced(fn, args, where, input_names=None, want_jaxpr=False):
+    """Trace `fn` abstractly (no execution) and run the jaxpr passes.
+    Trace failures are swallowed — the analyzer must never break a
+    build it is only observing. With ``want_jaxpr`` returns
+    ``(findings, closed_jaxpr_or_None)`` so callers needing output avals
+    (the donation-aliasing check) reuse the trace instead of paying a
+    second one."""
+    import jax
+    from .. import profiler
+    from .graph_passes import run_jaxpr_checks
+
+    def _ret(findings, jaxpr=None):
+        return (findings, jaxpr) if want_jaxpr else findings
+
+    try:
+        jaxpr = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # pragma: no cover - depends on jax internals
+        _log.debug("tpulint: trace for %s failed: %s", where, e)
+        return _ret([])
+    profiler.record_analysis_check()
+    try:
+        findings = run_jaxpr_checks(jaxpr, where, input_names)
+    except Exception as e:  # pragma: no cover - jax-version dependent
+        # a crash inside a pass (jaxpr structure drift across jax
+        # versions) must log, not abort the build being observed
+        _log.warning("tpulint: jaxpr passes for %s crashed: %s", where, e)
+        return _ret([], jaxpr)
+    return _ret(report_findings(findings), jaxpr)

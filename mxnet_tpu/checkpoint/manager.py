@@ -226,6 +226,7 @@ class CheckpointManager:
         # race a concurrent save() past its liveness check and strand the
         # job in the queue forever
         while True:
+            # tpulint: allow-blocking-get long-lived daemon by design (see comment above); atexit flush drains in-flight writes
             step, state, handle, tmp = self._queue.get()
             self._write_one(step, state, handle, tmp=tmp)
             self._queue.task_done()

@@ -81,6 +81,34 @@ class Executor:
         if group2ctx:
             self._group_shardings = self._build_group_shardings(group2ctx)
 
+        from .analysis.runtime import lint_enabled
+        if lint_enabled():
+            self._lint_bind()
+
+    def _lint_bind(self):
+        """MXNET_TPU_LINT bind-time passes (docs/faq/analysis.md): params
+        the graph never consumes (the reference raised at bind; _normalize
+        accepts dict extras silently) and infer_shape vs
+        infer_shape_partial drift — both surfaced before any compile."""
+        from .analysis.graph_passes import (check_infer_shape_consistency,
+                                            check_symbol_unused_args)
+        from .analysis.runtime import report_findings
+        try:
+            findings = check_symbol_unused_args(
+                self._symbol, list(self.arg_dict) + list(self.aux_dict),
+                where="Executor.bind")
+            findings += check_infer_shape_consistency(
+                self._symbol,
+                {n: a.shape for n, a in self.arg_dict.items()},
+                where="Executor.bind")
+        except Exception as e:
+            # the observer never fails a bind that succeeds with lint off
+            import logging
+            logging.getLogger("mxnet_tpu.analysis").warning(
+                "tpulint: bind-time passes crashed: %s", e)
+            return
+        report_findings(findings)
+
     # ------------------------------------------------------------------
     # group2ctx -> mesh sharding (TPU-native model parallelism)
     # ------------------------------------------------------------------
@@ -295,6 +323,20 @@ class Executor:
         rng_sds = jax.ShapeDtypeStruct(rng.shape, rng.dtype)
         key = (bool(is_train), self._shape_sig(arg_sds, aux_sds, rng_sds))
         if key not in self._aot:
+            from .analysis.runtime import lint_enabled
+            if lint_enabled():
+                # MXNET_TPU_LINT compile-time passes (docs/faq/analysis.md):
+                # sweep the forward jaxpr for f64 leaks and dead subgraphs /
+                # params unused by any output before paying the XLA compile.
+                # Inside the miss branch: one sweep per distinct program,
+                # repeat warmups neither re-trace nor re-count
+                from .analysis.runtime import check_traced
+                check_traced(
+                    lambda a, x, r: self._run_graph(a, x, r, bool(is_train)),
+                    (arg_sds, aux_sds, rng_sds),
+                    "Executor.warmup(%s)" % self._symbol.list_outputs()[:1],
+                    # pytree flattening order: sorted dict keys, then rng
+                    input_names=(sorted(arg_sds) + sorted(aux_sds) + ["rng"]))
             self._aot[key] = self._fwd_fn(bool(is_train)).lower(
                 arg_sds, aux_sds, rng_sds).compile()
         return self

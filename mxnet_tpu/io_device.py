@@ -46,6 +46,7 @@ def default_stage_fn(device=None, sharding=None):
         (device if device is not None else jax.devices()[0])
 
     def _put(arr):
+        # tpulint: allow-host-sync host batch normalized before H2D staging; NDArrays pass their buffer
         raw = arr._data if isinstance(arr, NDArray) else _np.asarray(arr)
         return _new_from_jax(jax.device_put(raw, target))
 

@@ -183,6 +183,7 @@ class DataParallelExecutorGroup:
     # ------------------------------------------------------------------
     def _load_data(self, batch):
         for d_arr, d_src in zip(self.data_arrays, batch.data):
+            # tpulint: allow-host-sync non-fused multi-device path slices host batches per device
             src = d_src.asnumpy() if not isinstance(d_src, _np.ndarray) else d_src
             for sl, dst in d_arr:
                 dst[:] = src[sl]
@@ -191,6 +192,7 @@ class DataParallelExecutorGroup:
         if self.label_arrays is None or batch.label is None:
             return
         for l_arr, l_src in zip(self.label_arrays, batch.label):
+            # tpulint: allow-host-sync non-fused multi-device path slices host batches per device
             src = l_src.asnumpy() if not isinstance(l_src, _np.ndarray) else l_src
             for sl, dst in l_arr:
                 dst[:] = src[sl]

@@ -471,6 +471,7 @@ class Module(BaseModule):
             # so the captured array can't change under the step.
             if isinstance(arr, _ND):
                 return arr._data
+            # tpulint: allow-host-sync host-numpy fallback; device arrays take the _data branch
             return _np2.asarray(arr)
 
         batch = {}

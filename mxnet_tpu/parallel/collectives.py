@@ -46,7 +46,7 @@ def _reduce_fn():
     call would retrace/recompile on every gradient push)."""
     if "fn" not in _REDUCE_CACHE:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        mesh = Mesh(_np.asarray(jax.devices()), ("w",))
+        mesh = Mesh(_np.asarray(jax.devices()), ("w",))  # tpulint: allow-host-sync device handle list, not a device array
         L = len(jax.local_devices())
         _REDUCE_CACHE["mesh"] = mesh
         _REDUCE_CACHE["in_sharding"] = NamedSharding(mesh, P("w"))

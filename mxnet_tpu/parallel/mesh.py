@@ -18,7 +18,7 @@ __all__ = ["get_mesh", "data_parallel_mesh", "ShardingConfig", "PartitionSpec",
 def data_parallel_mesh(devices=None):
     """1-D dp mesh over all (or given) devices."""
     devices = devices if devices is not None else jax.devices()
-    return Mesh(_np.asarray(devices), ("dp",))
+    return Mesh(_np.asarray(devices), ("dp",))  # tpulint: allow-host-sync device handle list, not a device array
 
 
 def get_mesh(dp=1, tp=1, pp=1, sp=1, devices=None):
@@ -27,7 +27,7 @@ def get_mesh(dp=1, tp=1, pp=1, sp=1, devices=None):
     n = dp * tp * pp * sp
     if n != len(devices):
         raise ValueError("mesh size %d != device count %d" % (n, len(devices)))
-    arr = _np.asarray(devices).reshape(dp, tp, pp, sp)
+    arr = _np.asarray(devices).reshape(dp, tp, pp, sp)  # tpulint: allow-host-sync device handle list, not a device array
     return Mesh(arr, ("dp", "tp", "pp", "sp"))
 
 

@@ -43,6 +43,9 @@ class DataParallelTrainStep:
         # one cached replicated key (see __call__)
         self._needs_rng = symbol._needs_rng()
         self._fixed_rng = None  # device-put copy of random.fixed_key()
+        # MXNET_TPU_LINT jaxpr sweep armed by _lint_step, run on the first
+        # __call__ (batch dtypes are only known then)
+        self._lint_sweep_pending = False
         self.mesh = mesh
         self.lr = lr
         self.momentum = momentum
@@ -134,12 +137,12 @@ class DataParallelTrainStep:
         the Module path: init_params already ran, this step becomes the
         device-side authority for them during fit."""
         self.params = {n: jax.device_put(jnp.asarray(
-                           arg_params[n].asnumpy()
+                           arg_params[n].asnumpy()  # tpulint: allow-host-sync one-time param adoption at build, not per-step
                            if hasattr(arg_params[n], "asnumpy")
                            else arg_params[n]), self._repl)
                        for n in self.param_names}
         self.aux = {n: jax.device_put(jnp.asarray(
-                        aux_params[n].asnumpy()
+                        aux_params[n].asnumpy()  # tpulint: allow-host-sync one-time param adoption at build, not per-step
                         if hasattr(aux_params[n], "asnumpy")
                         else aux_params[n]), self._repl)
                     for n in self.aux_names}
@@ -151,12 +154,12 @@ class DataParallelTrainStep:
         """Overwrite device param/aux values in place, PRESERVING optimizer
         state and the compiled program (no re-jit, no momentum reset)."""
         self.params = {n: jax.device_put(jnp.asarray(
-                           arg_params[n].asnumpy()
+                           arg_params[n].asnumpy()  # tpulint: allow-host-sync checkpoint-restore reload, off the step path
                            if hasattr(arg_params[n], "asnumpy")
                            else arg_params[n]), self._repl)
                        for n in self.param_names}
         self.aux = {n: jax.device_put(jnp.asarray(
-                        aux_params[n].asnumpy()
+                        aux_params[n].asnumpy()  # tpulint: allow-host-sync checkpoint-restore reload, off the step path
                         if hasattr(aux_params[n], "asnumpy")
                         else aux_params[n]), self._repl)
                     for n in self.aux_names}
@@ -176,8 +179,8 @@ class DataParallelTrainStep:
 
     def export_params(self):
         """Current (params, aux) as numpy dicts (host sync point)."""
-        return ({n: _np.asarray(v) for n, v in self.params.items()},
-                {n: _np.asarray(v) for n, v in self.aux.items()})
+        return ({n: _np.asarray(v) for n, v in self.params.items()},  # tpulint: allow-host-sync export_params IS the documented host sync point
+                {n: _np.asarray(v) for n, v in self.aux.items()})  # tpulint: allow-host-sync export_params IS the documented host sync point
 
     def _build_step(self, batch_shapes):
         from ..executor import Executor
@@ -265,9 +268,34 @@ class DataParallelTrainStep:
         # batch shapes, so XLA could never alias them — donation would only
         # warn per compile and force callers that reuse device-resident
         # batches (bench _phase_step) into per-step defensive copies
+        donate_argnums = (0, 1)
+        from ..analysis.runtime import lint_enabled
+        if lint_enabled():
+            self._lint_step(step, donate_argnums)
         self._step = jax.jit(step, in_shardings=in_shardings,
                              out_shardings=out_shardings,
-                             donate_argnums=(0, 1))
+                             donate_argnums=donate_argnums)
+
+    def _lint_step(self, step, donate_argnums):
+        """MXNET_TPU_LINT compile-time passes over the fused step
+        (docs/faq/analysis.md): the PR-3 donation contract (params/
+        opt_state only — never batch buffers), donation aliasability,
+        f64 leaks, and dead subgraphs/params."""
+        from ..analysis.graph_passes import check_donation
+        from ..analysis.runtime import report_findings
+        roles = ("params", "opt_state", "aux", "batch", "batch",
+                 "rng", "lr")
+        report_findings(check_donation(donate_argnums, roles, mode="train",
+                                       where="tpu_step"))
+        # the jaxpr sweep AND the donation-aliasing check wait for the
+        # first __call__: batch dtypes are only known then (uint8 image
+        # batches skip the bf16 cast an f32-guessed trace would take),
+        # and the aliasing check needs the REAL program outputs — deriving
+        # them from the input dicts would compare them to themselves and
+        # never fire
+        self._step_fn = step
+        self._lint_donate_argnums = donate_argnums
+        self._lint_sweep_pending = True
 
     # ------------------------------------------------------------------
     def __call__(self, batch_np, rng=None, lr=None):
@@ -305,6 +333,25 @@ class DataParallelTrainStep:
             rng = jax.device_put(rng, self._repl)
         if lr is None:
             lr = self.lr
+        if self._lint_sweep_pending:
+            # deferred MXNET_TPU_LINT jaxpr sweep (see _lint_step): one
+            # abstract trace of the REAL argument signature, first step only
+            self._lint_sweep_pending = False
+            from ..analysis.graph_passes import check_donation_aliasing
+            from ..analysis.runtime import check_traced, report_findings
+            step_args = (self.params, self.opt_state, self.aux, data_part,
+                         label_part, rng, _np.float32(lr))
+            _, jaxpr = check_traced(self._step_fn, step_args,
+                                    "tpu_step.fused_step", want_jaxpr=True)
+            if jaxpr is not None:
+                leaves = jax.tree_util.tree_leaves
+                in_avals = [[(v.shape, v.dtype) for v in leaves(part)]
+                            for part in step_args[:3]]
+                out_avals = [(v.shape, v.dtype) for v in jaxpr.out_avals
+                             if hasattr(v, "dtype")]
+                report_findings(check_donation_aliasing(
+                    in_avals, out_avals, self._lint_donate_argnums,
+                    where="tpu_step"))
         self.params, self.opt_state, aux_upd, outs = self._step(
             self.params, self.opt_state, self.aux, data_part, label_part,
             rng, _np.float32(lr))

@@ -14,7 +14,9 @@ import threading
 import jax
 
 __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
-           "record_pipeline_event", "pipeline_counters"]
+           "record_pipeline_event", "pipeline_counters",
+           "record_analysis_check", "record_analysis_finding",
+           "analysis_counters"]
 
 _state = {"running": False, "filename": "profile.json", "events": [],
           "jax_trace_dir": None, "lock": threading.Lock()}
@@ -110,6 +112,45 @@ def pipeline_counters(reset=False):
         if reset:
             _pipeline.clear()
             _pipeline.update(_PIPELINE_ZERO)
+    return out
+
+
+# ----------------------------------------------------------------------
+# static-analysis counters (MXNET_TPU_LINT=1 compile-time graph passes,
+# mxnet_tpu/analysis/runtime.py). Always-on plain adds, like the pipeline
+# counters: the bench/CI can assert "N programs checked, 0 findings"
+# without a profiler session.
+# ----------------------------------------------------------------------
+_ANALYSIS_ZERO = {"programs_checked": 0, "findings": 0, "errors": 0,
+                  "warnings": 0}
+_analysis = dict(_ANALYSIS_ZERO)
+
+
+def record_analysis_check(n=1):
+    """Count one program (jaxpr) swept by the compile-time passes."""
+    with _state["lock"]:
+        _analysis["programs_checked"] += n
+
+
+def record_analysis_finding(rule_id, severity):
+    """Count one finding, total + per-severity + per-rule."""
+    with _state["lock"]:
+        _analysis["findings"] += 1
+        if severity == "error":
+            _analysis["errors"] += 1
+        elif severity == "warning":
+            _analysis["warnings"] += 1
+        key = "rule:%s" % rule_id
+        _analysis[key] = _analysis.get(key, 0) + 1
+
+
+def analysis_counters(reset=False):
+    """Snapshot (optionally reset) the static-analysis counters."""
+    with _state["lock"]:
+        out = dict(_analysis)
+        if reset:
+            _analysis.clear()
+            _analysis.update(_ANALYSIS_ZERO)
     return out
 
 

@@ -166,6 +166,7 @@ class InferenceEngine:
         value already lives there — jax.device_put returns the same
         buffer for same-device committed arrays)."""
         import jax
+        # tpulint: allow-host-sync host input normalized before H2D; NDArrays pass their device buffer
         data = v._data if isinstance(v, NDArray) else _np.asarray(v)
         return jax.device_put(data, self._device)
 
@@ -354,9 +355,11 @@ class InferenceEngine:
         host = {}
         for name, arr in data.items():
             if isinstance(arr, NDArray):
+                # tpulint: allow-host-sync sync-predict host ingestion; keep_device branch stays on device
                 arr = arr._data if keep_device else arr.asnumpy()
             if not (keep_device and isinstance(arr, jax.Array)):
-                arr = _np.asarray(arr)
+                arr = _np.asarray(arr)  # tpulint: allow-host-sync host request arrays normalized for padding
+
             host[name] = arr
         ns = {a.shape[0] for a in host.values()}
         if len(ns) != 1:
@@ -432,6 +435,7 @@ class InferenceEngine:
         outs = self._cache.run(self._stage(padded), self._params,
                                self._aux, self._rng())
         if self._device.platform == "cpu":
+            # tpulint: allow-host-sync CPU backend: one deliberate batch materialization, slices become free views
             return [_np.asarray(o) for o in outs]
         return list(outs)
 

@@ -103,11 +103,18 @@ class BucketedProgramCache:
         if donate == "auto":
             donate = _donate_supported()
         self._donate = bool(donate)
+        self._fn = fn  # unjitted original: the MXNET_TPU_LINT trace target
+        from ..analysis.runtime import lint_enabled
+        # snapshot at construction: run() is the serving dispatch hot path
+        # and must not pay a per-request os.environ read for the guard
+        self._lint = lint_enabled()
+        self._lint_escapes_seen = set()  # TPL204 reported once per size
+        self._lint_donation_checked = False  # TPL203 once per cache
         import jax
         # donate_argnums=0: only the per-request batch dict is donated;
         # the params/aux dicts are long-lived and survive every call
-        self._jit = (jax.jit(fn, donate_argnums=(0,)) if self._donate
-                     else jax.jit(fn))
+        self._donate_argnums = (0,) if self._donate else ()
+        self._jit = jax.jit(fn, donate_argnums=self._donate_argnums)
         self._sharding = None
         if device is not None and device != jax.devices()[0]:
             # abstract lowering otherwise pins jit's default device; a
@@ -159,6 +166,26 @@ class BucketedProgramCache:
         Pure-shape AOT: nothing executes, no real buffers are consumed, so
         warmup can run before any traffic (and before params are final —
         only their shapes/dtypes matter)."""
+        if self._lint:
+            # MXNET_TPU_LINT compile-time passes (docs/faq/analysis.md):
+            # the serving donation contract (only the per-request batch
+            # may be donated — a donated weight buffer is freed under the
+            # next request), then a jaxpr sweep for f64 leaks and dead
+            # subgraphs, all before the (much costlier) XLA compile
+            from ..analysis.graph_passes import check_donation
+            from ..analysis.runtime import check_traced, report_findings
+            if not self._lint_donation_checked:
+                # the donate spec is cache-wide — one report, not one per
+                # bucket compile
+                self._lint_donation_checked = True
+                report_findings(check_donation(
+                    self._donate_argnums, ("batch", "params", "aux", "rng"),
+                    mode="serving", where="program_cache.compile"))
+            check_traced(self._fn,
+                         (batch_sds, param_sds, aux_sds, rng_sd),
+                         "serving program (batch=%s)"
+                         % sorted((k, tuple(v.shape))
+                                  for k, v in batch_sds.items()))
         lowered = self._jit.lower(batch_sds, param_sds, aux_sds, rng_sd)
         return lowered.compile()
 
@@ -230,6 +257,21 @@ class BucketedProgramCache:
         ``batch_vals`` must already be padded to a bucket (the batcher's
         job); its buffers are donated when donation is enabled — the caller
         must not reuse them after this call."""
+        if self._lint and batch_vals:
+            # recompilation-hazard pass: a batch size above the top bucket
+            # compiles its own exact-shape program per distinct size — so
+            # the hazard is per distinct size, reported once, not per
+            # request (a steady oversized client must not spam the log
+            # and skew the TPL204 counter on every dispatch)
+            n = int(_np.shape(next(iter(batch_vals.values())))[0] or 0)
+            if n not in self._lint_escapes_seen:
+                self._lint_escapes_seen.add(n)
+                from ..analysis.graph_passes import check_bucket_escape
+                from ..analysis.runtime import report_findings
+                findings = check_bucket_escape(n, self._buckets,
+                                               "program_cache.run")
+                if findings:
+                    report_findings(findings)
         batch_sds = self._sds(batch_vals)
         param_sds = self._sds(param_vals)
         aux_sds = self._sds(aux_vals)

@@ -550,7 +550,12 @@ class Symbol:
                 if node.op.name == "Cast":
                     out_dt = _np.dtype(getattr(params, "dtype", "float32"))
                     for k in out_keys:
-                        if dtype_of.get(k) != out_dt:
+                        # NOT `dtype_of.get(k) != out_dt`: numpy's
+                        # dtype(None) defaults to float64, so
+                        # `None != dtype('float64')` is False and a Cast
+                        # to exactly f64 would never register (the
+                        # tpulint f64-leak pass caught this)
+                        if k not in dtype_of or dtype_of[k] != out_dt:
                             dtype_of[k] = out_dt
                             changed = True
                     keys = in_keys  # input side unifies independently

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""tpulint — static analysis for TPU hot paths, program graphs, and
+async-subsystem discipline.
+
+Thin launcher for ``python -m mxnet_tpu.analysis.lint`` that works from
+any cwd (adds the repo root to sys.path first). Rule catalog and
+suppression syntax: docs/faq/analysis.md.
+
+Usage:
+    python tools/tpulint.py mxnet_tpu tools
+    python tools/tpulint.py --list-rules
+"""
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from mxnet_tpu.analysis.lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
