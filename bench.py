@@ -616,6 +616,23 @@ def _phase_jax_baseline():
             "jax_baseline_dtype": "bfloat16" if on_tpu else "float32"}
 
 
+def _tpu_roofline_tflops(device_kind, flops, ideal_bytes):
+    """Roofline ceiling (TFLOP/s) for a kernel of this arithmetic
+    intensity on a recognized chip; None when the chip is unknown (the
+    CPU fallback host has no published peak worth pretending about)."""
+    peaks = {  # bf16 peak TFLOP/s, HBM GB/s (public chip specs)
+        "v5 lite": (197.0, 819.0), "v5e": (197.0, 819.0),
+        "v5p": (459.0, 2765.0), "v4": (275.0, 1228.0),
+        "v3": (123.0, 900.0), "v2": (45.0, 700.0),
+    }
+    kind = (device_kind or "").lower()
+    for key, (peak, bw) in peaks.items():
+        if key in kind:
+            intensity = flops / max(ideal_bytes, 1.0)     # FLOP per byte
+            return min(peak, bw * intensity / 1e3)        # GB/s -> TFLOP/s
+    return None
+
+
 def _phase_flash():
     """Fused Pallas flash-attention kernel (non-interpret on TPU): bf16
     causal attention [B=4, H=8, S=4096, D=128] TFLOP/s. New TPU-native
@@ -625,10 +642,10 @@ def _phase_flash():
     import jax
     import jax.numpy as jnp
     from mxnet_tpu.kernels.flash_attention import (flash_attention,
-                                                   default_use_pallas)
+                                                   pallas_status)
     platform = jax.devices()[0].platform
     on_tpu = platform != "cpu"
-    use_pallas = default_use_pallas()  # the framework's own kernel gate
+    use_pallas, pallas_reason = pallas_status()  # the framework's kernel gate
     B, H, S, D = (4, 8, 4096, 128) if on_tpu else (2, 2, 512, 64)
     # methodology (dedup-proof, single-dispatch lax.map) is shared with
     # tools/flash_tune.py via tools/attn_timing so the tuner's block-size
@@ -639,7 +656,10 @@ def _phase_flash():
     dt_ = jnp.bfloat16 if on_tpu else jnp.float32
     qs, k, v = attn_timing.make_inputs(B, H, S, D, n_iter, dt_)
     bq, bk = (1024, 512) if on_tpu else (256, 256)
-    out = {"flash_attn_pallas": bool(use_pallas)}
+    # why the gate is open/closed is part of the record: "false" alone
+    # can't distinguish a missing chip from a broken Pallas toolchain
+    out = {"flash_attn_pallas": bool(use_pallas),
+           "flash_attn_pallas_reason": pallas_reason}
     if not use_pallas:
         # jnp blockwise fallback: 'variant' has no effect there, so no
         # per-family labels that could read as Pallas evidence
@@ -649,6 +669,7 @@ def _phase_flash():
                                             use_pallas=False),
             qs, k, v, attn_timing.causal_flops(B, H, S, D, n_iter))
         out["flash_attn_tflops"] = round(tflops, 2)
+        out["flash_measured_vs_ideal"] = None  # no roofline off-chip
         return out
     best = None
     # both Pallas kernel families (stream: whole-KV VMEM + fori_loop;
@@ -684,6 +705,22 @@ def _phase_flash():
     if best is not None:
         out["flash_attn_tflops"] = round(best[1], 2)
         out["flash_attn_variant"] = best[0]
+        # roofline gate: achieved TFLOP/s vs this chip's ceiling at the
+        # kernel's arithmetic intensity (same flops/ideal-bytes figures
+        # the cost phase emits as flash_fwd_gflops/flash_ideal_bytes_mb)
+        flops1 = attn_timing.causal_flops(B, H, S, D)
+        ideal_bytes = attn_timing.ideal_hbm_bytes(B, H, S, D)
+        ideal = _tpu_roofline_tflops(
+            getattr(jax.devices()[0], "device_kind", ""), flops1,
+            ideal_bytes)
+        if ideal:
+            out["flash_measured_vs_ideal"] = round(best[1] / ideal, 3)
+            from mxnet_tpu import profiler as _prof
+            _prof.record_kernel_roofline("flash_attention_fwd", best[1],
+                                         ideal, unit="tflops")
+            out["kernel_roofline"] = _prof.kernel_counters()
+        else:
+            out["flash_measured_vs_ideal"] = None
     return out
 
 
@@ -719,11 +756,18 @@ def _phase_flash_parity():
 
 def _phase_infer_int8():
     """Post-training int8 inference: quantize_model rewrites ResNet-50
-    conv/FC into `_contrib_quantized_*` ops (int8 MXU compute, int32
-    accumulation — the reference quantize_graph_pass.cc flow) and the
-    quantized graph is scored like _phase_infer. The reference published
-    no GPU int8 numbers for this model (its int8 path was MKLDNN/CPU-era),
-    so this is reported as an absolute img/s differentiator."""
+    conv/FC into `_contrib_quantized_*` ops executing on genuine int8
+    operands (ops/quantization.py strategy table: int32 MXU accumulation
+    on TPU, exact chunked-f32 accumulation for XLA:CPU convs, int32-
+    accumulating int8 dot for FC everywhere).
+
+    `int8_mode` is read off the TRACED JAXPR of the program this phase
+    actually times (contrib.quantization.inspect_int8_program), never
+    inferred from the backend name. The fp32 twin of the SAME model/shape
+    is measured in the SAME child, so `int8_speedup_vs_f32` is a clean
+    like-for-like ratio; `int8_measured_vs_ideal` gates it against the
+    roofline expectation (2x on the MXU's s8 path, 1x for the f32-rate
+    CPU accumulator — docs/faq/perf.md)."""
     import numpy as np
     import jax
     import mxnet_tpu as mx
@@ -731,7 +775,7 @@ def _phase_infer_int8():
     from mxnet_tpu.models import resnet
     platform = jax.devices()[0].platform
     on_tpu = platform != "cpu"
-    batch, n_iter = 32, (30 if on_tpu else 2)
+    batch, n_iter = 32, (30 if on_tpu else 3)
     side = 224 if on_tpu else 64
     sym = resnet.get_symbol(num_classes=1000, num_layers=50 if on_tpu else 18,
                             image_shape="3,%d,%d" % (side, side))
@@ -751,17 +795,51 @@ def _phase_infer_int8():
     qsym, qargs, qaux, _ = Q.quantize_model(
         sym, args, aux, calib_mode="naive", calib_data=it,
         ctx=mx.tpu(0))  # calibrate on the device being benchmarked
-    bind_args = dict(qargs)
-    bind_args["data"] = mx.nd.zeros((batch, 3, side, side))
-    bind_args["softmax_label"] = mx.nd.zeros((batch,))
-    exe = qsym.bind(mx.tpu(0), bind_args, grad_req="null",
-                    aux_states=qaux)
-    return {"int8_infer_img_per_sec": _timed_score_loop(
-        exe, batch, side, n_iter),
-            # off-chip the quantized ops run the exactness-guarded f32
-            # SIMULATION (ops/quantization.py) — slower than fp32 by
-            # design; only "native-int8" figures speak to MXU int8 perf
-            "int8_mode": ("native-int8" if on_tpu else "simulated-f32")}
+
+    def bind(s, a, x):
+        ba = dict(a)
+        ba["data"] = mx.nd.zeros((batch, 3, side, side))
+        ba["softmax_label"] = mx.nd.zeros((batch,))
+        return s.bind(mx.tpu(0), ba, grad_req="null", aux_states=x)
+
+    qexe = bind(qsym, qargs, qaux)
+    fexe = bind(sym, args, aux)
+    int8_ips = _median3_cpu(
+        lambda: _timed_score_loop(qexe, batch, side, n_iter))
+    f32_ips = _median3_cpu(
+        lambda: _timed_score_loop(fexe, batch, side, n_iter))
+
+    # ground truth: what do the timed program's contractions execute?
+    arg_sds = {n: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+               for n, v in qexe.arg_dict.items()}
+    aux_sds = {n: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+               for n, v in qexe.aux_dict.items()}
+    jaxpr = jax.make_jaxpr(
+        lambda a, x: qexe._run_graph(a, x, jax.random.PRNGKey(0), False))(
+        arg_sds, aux_sds)
+    stats = Q.inspect_int8_program(jaxpr)
+
+    speedup = round(int8_ips / f32_ips, 3) if f32_ips else None
+    # roofline expectation for the int8 program vs its fp32 twin: the MXU
+    # s8xs8->s32 path doubles the fp peak; the exact CPU accumulator runs
+    # at f32 rate (ideal = parity). docs/faq/perf.md "Roofline counters".
+    ideal_speedup = 2.0 if on_tpu else 1.0
+    from mxnet_tpu import profiler as _prof
+    out = {"int8_infer_img_per_sec": int8_ips,
+           "int8_fp32_img_per_sec": f32_ips,
+           "int8_speedup_vs_f32": speedup,
+           "int8_measured_vs_ideal": (round(speedup / ideal_speedup, 3)
+                                      if speedup is not None else None),
+           "int8_mode": stats["mode"],
+           "int8_contractions": {k: v for k, v in stats.items()
+                                 if k != "mode"}}
+    if speedup is not None:
+        _prof.record_kernel_roofline("int8_infer", speedup, ideal_speedup,
+                                     unit="speedup_vs_f32")
+        # phases run in a child: the JSON line is the only surviving
+        # channel, so the profiler snapshot rides the phase result
+        out["kernel_roofline"] = _prof.kernel_counters()
+    return out
 
 
 def _phase_cost():
@@ -842,10 +920,74 @@ def _phase_cost():
     # (B=4 H=8 S=4096 D=128 causal): FLOPs are kernel-family-independent;
     # ideal HBM traffic is Q+K+V+O in bf16
     sys.path.insert(0, _HERE)
-    from tools.attn_timing import causal_flops
+    from tools.attn_timing import causal_flops, ideal_hbm_bytes
     B, H, S, D = 4, 8, 4096, 128
     out["flash_fwd_gflops"] = round(causal_flops(B, H, S, D) / 1e9, 2)
-    out["flash_ideal_bytes_mb"] = round(4 * B * H * S * D * 2 / 1e6, 2)
+    out["flash_ideal_bytes_mb"] = round(ideal_hbm_bytes(B, H, S, D) / 1e6, 2)
+
+    # fused optimizer-update roofline (kernels/opt_update.py): bytes of
+    # the UPDATE-ONLY program vs the must-move floor. The update is pure
+    # memory traffic, so bytes ARE the gate. Three figures:
+    #   optupdate_bytes_mb        tree-map route, POST-FUSION (compiled)
+    #                             cost analysis — what XLA actually moves
+    #   optupdate_fused_bytes_mb  fused route as it runs on THIS backend
+    #                             (kernel tier on TPU, lax tier off it)
+    #   optupdate_kernel_bytes_mb the Pallas tier's DMA schedule (grid x
+    #                             BlockSpec — exact on any host)
+    from mxnet_tpu.kernels.opt_update import (fused_update_step,
+                                              fused_update_available,
+                                              optupdate_ideal_bytes,
+                                              optupdate_kernel_bytes)
+    from mxnet_tpu.parallel.optim_update import apply_update, init_opt_state
+    params = {n: jnp.zeros(v.shape, jnp.float32)
+              for n, v in step.params.items()}
+    opt_state = init_opt_state("sgd", params, momentum=0.9)
+    hp = {"lr": 0.05, "momentum": 0.9}
+    rescale = 1.0 / batch
+
+    def treemap_route(p, st, g, lr):
+        g = {n: v * rescale for n, v in g.items()}
+        g = {n: v + 1e-4 * p[n] for n, v in g.items()}
+        return apply_update("sgd", dict(hp, lr=lr), p, st, g)
+
+    def fused_route(p, st, g, lr):
+        return fused_update_step("sgd", dict(hp, lr=lr), p, st, g,
+                                 rescale=rescale, wd=1e-4)
+
+    def _analyze_compiled(lowered):
+        """Post-optimization bytes: the elementwise update chain fuses, so
+        pre-fusion analysis would overcount every intermediate."""
+        try:
+            ca = lowered.compile().cost_analysis()
+        except Exception:
+            ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return round(float(ca.get("bytes accessed", 0.0)) / 1e6, 2)
+
+    sds = jax.tree_util.tree_map(
+        lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
+        (params, opt_state, params, np.float32(0.05)))
+    for tag, route in (("optupdate", treemap_route),
+                       ("optupdate_fused", fused_route)):
+        out["%s_bytes_mb" % tag] = _analyze_compiled(
+            jax.jit(route).lower(*sds))
+    kernel_mb = round(
+        optupdate_kernel_bytes("sgd", params, opt_state) / 1e6, 2)
+    out["optupdate_kernel_bytes_mb"] = kernel_mb
+    ideal_mb = round(optupdate_ideal_bytes("sgd", params, opt_state) / 1e6, 2)
+    out["optupdate_ideal_bytes_mb"] = ideal_mb
+    if ideal_mb:
+        from mxnet_tpu import profiler as _prof
+        for tag in ("optupdate", "optupdate_fused", "optupdate_kernel"):
+            out["%s_measured_vs_ideal" % tag] = round(
+                out["%s_bytes_mb" % tag] / ideal_mb, 3)
+        # gate on the tier the flag actually engages on this backend
+        gated = (kernel_mb if fused_update_available()
+                 else out["optupdate_fused_bytes_mb"])
+        _prof.record_kernel_roofline("opt_update", gated, ideal_mb,
+                                     unit="bytes_mb")
+        out["kernel_roofline"] = _prof.kernel_counters()
     return out
 
 

@@ -2,10 +2,16 @@
 python/mxnet/contrib/quantization.py, 520 LoC — calibration via min/max or
 KL divergence, then graph rewrite to quantized ops).
 
-TPU formulation: calibration is identical host-side math; the "quantized
-graph" applies symmetric int8 fake-quantization to conv/FC weights (and
-optionally activations via calibrated thresholds). XLA lowers int8 matmuls
-natively when real int8 execution is requested via dtype.
+TPU formulation: calibration is identical host-side math; the rewritten
+graph executes conv/FC on **genuine int8 operands** (ops/quantization.py
+picks int32 accumulation on the MXU or the exact chunked-f32 accumulator on
+XLA:CPU). Weights are AQT-style per-output-channel symmetric int8, folded
+OFFLINE into `<name>_quantize`/`<name>_min`/`<name>_max` arguments — they
+quantize exactly once at `quantize_params` time and live on device as
+resident int8 buffers thereafter (the serving engine stages them once per
+engine, never per request). Calibrated activation thresholds become static
+scales baked into the `_contrib_quantize` nodes, so a calibrated inference
+program contains **zero dynamic range reductions**.
 """
 from __future__ import annotations
 
@@ -16,13 +22,8 @@ import numpy as _np
 from ..base import MXNetError
 
 __all__ = ["quantize_graph", "quantize_params", "calib_thresholds_minmax",
-           "calib_threshold_kl", "quantize_model", "CalibrationCollector"]
-
-
-def _quantize_array(arr, threshold):
-    scale = 127.0 / max(float(threshold), 1e-12)
-    q = _np.clip(_np.round(arr * scale), -127, 127).astype(_np.int8)
-    return q, 1.0 / scale
+           "calib_threshold_kl", "quantize_model", "CalibrationCollector",
+           "inspect_int8_program"]
 
 
 # -------------------------------------------------------------------------
@@ -47,16 +48,25 @@ def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
     """Rewrite a fp32 Symbol into an int8 inference graph.
 
     Every non-excluded Convolution/FullyConnected becomes its
-    `_contrib_quantized_*` form fed by int8 tensors; int32 accumulators pass
-    through `_contrib_requantize` (with calibrated ranges from `th_dict`,
-    keyed by fp32 node name) back to int8, and `_contrib_dequantize` bridges
-    to any fp32 consumer. Pooling/Flatten between quantized layers stay in
-    int8 (range passthrough). A quantize of a variable named in
+    `_contrib_quantized_*` form fed by int8 tensors. The int32 accumulator
+    passes through `_contrib_requantize` back to int8 **only when an int8
+    consumer actually exists** (a following quantized conv/pool/flatten);
+    an accumulator whose only consumers are fp32 ops is dequantized
+    DIRECTLY from int32 — one rescale instead of requantize+dequantize,
+    and no second rounding. Pooling/Flatten between quantized layers stay
+    in int8 (range passthrough).
+
+    Activation quantize nodes use the calibrated threshold from `th_dict`
+    (keyed by the producing fp32 node's name, or the input variable's name
+    for graph inputs) as a STATIC scale whenever one exists — no `amin`/
+    `amax` reductions remain in a calibrated graph; uncalibrated producers
+    fall back to dynamic min/max. A quantize of a variable named in
     `offline_params` (pass the param-dict keys; runtime inputs like `data`
     must NOT be in it) collapses into three new arguments —
     `<name>_quantize` (int8), `<name>_min`, `<name>_max` — which
     `quantize_params` fills from the fp32 params, so no weight quantization
-    runs at inference time.
+    runs at inference time (or per serving request — the folded weights are
+    ordinary resident device buffers).
 
     TPU formulation of reference quantize_graph_pass.cc:1: same insertion
     algorithm, but the result is still a plain Symbol — XLA fuses the
@@ -73,13 +83,29 @@ def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
     op_dequantize = find_op("_contrib_dequantize")
     op_min, op_max = find_op("min"), find_op("max")
 
+    def calib_th(name):
+        th = th_dict.get(name, th_dict.get(name + "_output"))
+        return None if th is None else float(th)
+
     fp32 = {}    # id(old node) -> fp32-producing new node
-    qform = {}   # id(old node) -> [(qnode, oidx), (min src), (max src)]
+    # id(old node) -> {"int8": triple|None, "acc": int32 triple|None,
+    #                  "rq_attrs": attrs, "name": str} — conv/FC park their
+    # int32 accumulator here and materialize the requantize lazily
+    qform = {}
     quantize_cache = {}  # (id(old node), oidx) -> inserted quantize triple
 
     def fp32_in(old_pair):
         node, oidx = old_pair
         return (fp32[id(node)], oidx)
+
+    def int8_of(rec):
+        """The int8 triple of a quantized producer, materializing the
+        requantize of an int32 accumulator on first demand."""
+        if rec["int8"] is None:
+            rq = Node(op_requantize, rec["rq_attrs"], list(rec["acc"]),
+                      rec["name"] + "_requantize")
+            rec["int8"] = [(rq, 0), (rq, 1), (rq, 2)]
+        return rec["int8"]
 
     def as_int8(old_pair):
         """Quantized (data, min, max) sources for an old node's output —
@@ -87,7 +113,7 @@ def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
         (or folding offline) a _contrib_quantize."""
         node, oidx = old_pair
         if id(node) in qform and oidx == 0:
-            return qform[id(node)]
+            return int8_of(qform[id(node)])
         if (id(node), oidx) in quantize_cache:
             return quantize_cache[(id(node), oidx)]
         if node.is_variable and node.name in offline:
@@ -97,11 +123,20 @@ def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
             vmax = Node(None, {}, [], node.name + "_max")
             triple = [(qvar, 0), (vmin, 0), (vmax, 0)]
         else:
+            th = calib_th(node.name)
             src = fp32_in(old_pair)
-            mn = Node(op_min, {}, [src], node.name + "_amin")
-            mx = Node(op_max, {}, [src], node.name + "_amax")
-            q = Node(op_quantize, {"out_type": "int8"},
-                     [src, (mn, 0), (mx, 0)], node.name + "_quantize")
+            if th is not None:
+                # calibrated: static scale, zero dynamic reductions
+                q = Node(op_quantize,
+                         {"out_type": "int8",
+                          "min_calib_range": str(-th),
+                          "max_calib_range": str(th)},
+                         [src], node.name + "_quantize")
+            else:
+                mn = Node(op_min, {}, [src], node.name + "_amin")
+                mx = Node(op_max, {}, [src], node.name + "_amax")
+                q = Node(op_quantize, {"out_type": "int8"},
+                         [src, (mn, 0), (mx, 0)], node.name + "_quantize")
             triple = [(q, 0), (q, 1), (q, 2)]
         quantize_cache[(id(node), oidx)] = triple
         return triple
@@ -120,7 +155,13 @@ def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
         opname = old.op.name
         quantizable = (opname in _QUANTIZED_OP and old.name not in excluded
                        and not (opname == "Convolution"
-                                and len(old.make_params().kernel) != 2))
+                                and len(old.make_params().kernel) != 2)
+                       # flatten=False FC can carry rank>2 activations,
+                       # whose output channel sits on the LAST axis — the
+                       # per-channel range plumbing broadcasts on axis 1
+                       # (reference quantized FC was 2-D-only); keep fp32
+                       and not (opname == "FullyConnected"
+                                and not old.make_params().flatten))
         if quantizable and opname in ("Pooling", "Flatten"):
             # only worth keeping in int8 when the producer already is —
             # quantizing solely for a pooling layer adds round-trips
@@ -139,6 +180,9 @@ def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
             qnode = Node(op_q[opname], dict(old.attrs), [d, mn, mx],
                          "quantized_" + old.name)
             triple = [(qnode, 0), (qnode, 1), (qnode, 2)]
+            qform[id(old)] = {"int8": triple, "acc": None,
+                              "rq_attrs": {}, "name": old.name}
+            attach_dequantize(old, triple)
         else:  # Convolution / FullyConnected
             data_t = as_int8(old.inputs[0])
             weight_t = as_int8(old.inputs[1])
@@ -153,27 +197,33 @@ def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
             qnode = Node(op_q[opname], dict(old.attrs), inputs,
                          "quantized_" + old.name)
             rq_attrs = {}
-            th = th_dict.get(old.name, th_dict.get(old.name + "_output"))
+            th = calib_th(old.name)
             if th is not None:
-                rq_attrs = {"min_calib_range": str(-float(th)),
-                            "max_calib_range": str(float(th))}
-            rq = Node(op_requantize, rq_attrs,
-                      [(qnode, 0), (qnode, 1), (qnode, 2)],
-                      old.name + "_requantize")
-            triple = [(rq, 0), (rq, 1), (rq, 2)]
-        qform[id(old)] = triple
-        attach_dequantize(old, triple)
+                rq_attrs = {"min_calib_range": str(-th),
+                            "max_calib_range": str(th)}
+            acc = [(qnode, 0), (qnode, 1), (qnode, 2)]
+            qform[id(old)] = {"int8": None, "acc": acc,
+                              "rq_attrs": rq_attrs, "name": old.name}
+            # fp32 consumers read the accumulator directly (lazy
+            # requantize: int8 materializes only if an int8 consumer asks)
+            attach_dequantize(old, acc)
 
     return Symbol([fp32_in(p) for p in sym._outputs])
 
 
-def quantize_params(qsym, arg_params):
+def quantize_params(qsym, arg_params, per_channel=True):
     """Fill the offline-quantized arguments of a `quantize_graph` output.
 
     For every `<name>_quantize` argument the fp32 param `<name>` is
     symmetric-int8 quantized, with its range in `<name>_min`/`<name>_max`
-    (reference: quantization.py _quantize_params). Other arguments pass
-    through. Returns the new arg dict."""
+    (reference: quantization.py _quantize_params). ``per_channel=True``
+    (the AQT-style default) scales conv/FC weights per OUTPUT CHANNEL
+    (axis 0) — the range arrays are then shape ``(num_filter,)`` and the
+    quantized ops broadcast them along the channel axis; 1-D params (bias)
+    and ``per_channel=False`` use one per-tensor scale. Other arguments
+    pass through. This is the ONE place weights quantize: the folded int8
+    arrays are ordinary arguments afterwards (staged to device once, reused
+    by every request/batch). Returns the new arg dict."""
     from ..ndarray.ndarray import array as nd_array
     out = {}
     for name in qsym.list_arguments():
@@ -181,11 +231,18 @@ def quantize_params(qsym, arg_params):
             base = name[:-len("_quantize")]
             v = arg_params[base]
             v = v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
-            absmax = float(_np.abs(v).max())
-            q, _scale = _quantize_array(v, absmax)
+            if per_channel and v.ndim >= 2:
+                absmax = _np.abs(v).max(axis=tuple(range(1, v.ndim)))
+            else:
+                absmax = _np.abs(v).max().reshape((1,))
+            absmax = _np.maximum(absmax.astype(_np.float64), 1e-12)
+            bshape = absmax.shape + (1,) * (v.ndim - 1)
+            q = _np.clip(_np.round(v * (127.0 / absmax.reshape(bshape))),
+                         -127, 127).astype(_np.int8)
+            absmax = absmax.astype(_np.float32)
             out[name] = nd_array(q)
-            out[base + "_min"] = nd_array(_np.array([-absmax], _np.float32))
-            out[base + "_max"] = nd_array(_np.array([absmax], _np.float32))
+            out[base + "_min"] = nd_array(-absmax)
+            out[base + "_max"] = nd_array(absmax)
         elif name.endswith("_min") or name.endswith("_max"):
             continue  # filled alongside their _quantize partner
         elif name in arg_params:
@@ -280,12 +337,17 @@ class CalibrationCollector(object):
 
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    excluded_sym_names=(), calib_mode="none", calib_data=None,
-                   num_calib_examples=None, ctx=None, logger=logging):
+                   num_calib_examples=None, ctx=None, per_channel=True,
+                   logger=logging):
     """Post-training quantization (reference: quantization.py quantize_model).
 
     Runs calibration (when requested), rewrites the graph via
     `quantize_graph` so conv/FC execute as int8 `_contrib_quantized_*` ops,
-    and offline-quantizes their weights/biases via `quantize_params`.
+    and offline-quantizes their weights/biases via `quantize_params`
+    (per-output-channel scales by default — AQT-style scale capture at
+    calibration time). Calibration also records the ranges of the graph
+    INPUTS (`data_names`), so every activation quantize in the result is a
+    static scale and the program performs no dynamic range reductions.
     Returns (qsym, qarg_params, aux_params, th_dict)."""
     th = {}
     if calib_mode != "none":
@@ -303,6 +365,10 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             exe.set_monitor_callback(collector.collect)
         seen = 0
         for batch in calib_data:
+            # graph inputs never pass the monitor hook — collect them here
+            # so the data quantize gets a static calibrated scale too
+            for dname, darr in zip(data_names, batch.data):
+                collector.collect(dname, darr)
             mod.forward(batch, is_train=False)
             for exe in mod._exec_group.execs:
                 exe.monitor_flush()
@@ -314,5 +380,71 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
 
     qsym = quantize_graph(sym, excluded_sym_names=excluded_sym_names,
                           th_dict=th, offline_params=set(arg_params))
-    new_args = quantize_params(qsym, arg_params)
+    new_args = quantize_params(qsym, arg_params, per_channel=per_channel)
     return qsym, new_args, aux_params, th
+
+
+# -------------------------------------------------------------------------
+# program inspection: what does the traced program ACTUALLY execute?
+# -------------------------------------------------------------------------
+
+_CONTRACTIONS = ("dot_general", "conv_general_dilated", "conv")
+
+
+def inspect_int8_program(closed_jaxpr):
+    """Classify the contractions of a traced program by operand/accumulator
+    dtype — the ground truth behind bench's ``int8_mode`` (the mode is read
+    off the jaxpr that runs, never inferred from the backend name).
+
+    Returns a dict with per-category counts and a ``mode``:
+
+    * ``int8_int32_acc`` — int8 operands, ``preferred_element_type=int32``
+      (the native MXU/GPU path; FC takes it on every backend)
+    * ``int8_f32_acc``   — int8 operands, exact f32 accumulation (the
+      chunked XLA:CPU conv path; bit-identical to int32 accumulation)
+    * ``wide_int``       — integer operands upcast before contraction
+    * ``float``          — floating-point contraction (unquantized layer,
+      or the old f32 *simulation* that pre-cast int8 to f32)
+
+    ``mode`` is ``"native-int8"`` when int8-operand contractions exist and
+    nothing falls back to wide/float, ``"mixed"`` when both kinds appear,
+    ``"simulated-f32"``/``"no-contractions"`` otherwise.
+    """
+    from ..analysis.graph_passes import _iter_sub_jaxprs
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    stats = {"int8_int32_acc": 0, "int8_f32_acc": 0, "wide_int": 0,
+             "float": 0}
+
+    def scan(jx, depth):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in _CONTRACTIONS:
+                dts = [_np.dtype(getattr(v.aval, "dtype", _np.float32))
+                       for v in eqn.invars[:2]]
+                pref = eqn.params.get("preferred_element_type")
+                pref = _np.dtype(pref) if pref is not None else None
+                if all(dt == _np.dtype(_np.int8) for dt in dts):
+                    if pref == _np.dtype(_np.int32):
+                        stats["int8_int32_acc"] += 1
+                    else:
+                        stats["int8_f32_acc"] += 1
+                elif all(_np.issubdtype(dt, _np.integer) for dt in dts):
+                    stats["wide_int"] += 1
+                else:
+                    stats["float"] += 1
+            if depth < 8:
+                for sub in _iter_sub_jaxprs(eqn):
+                    scan(sub, depth + 1)
+
+    scan(jaxpr, 0)
+    n_int8 = stats["int8_int32_acc"] + stats["int8_f32_acc"]
+    n_other = stats["wide_int"] + stats["float"]
+    if n_int8 and not n_other:
+        mode = "native-int8"
+    elif n_int8:
+        mode = "mixed"
+    elif n_other:
+        mode = "simulated-f32"
+    else:
+        mode = "no-contractions"
+    stats["mode"] = mode
+    return stats

@@ -187,6 +187,16 @@ class Executor:
     # ------------------------------------------------------------------
     def _run_graph(self, arg_vals, aux_vals, key, is_train,
                    collect_interior=False):
+        # int8 strategy picks per-platform lowerings at TRACE time; scope
+        # the choice to THIS executor's bound device (the process-default
+        # backend diverges exactly when an executor is bound off it)
+        from .ops.quantization import int8_platform_hint
+        with int8_platform_hint(self._ctx.jax_device.platform):
+            return self._run_graph_impl(arg_vals, aux_vals, key, is_train,
+                                        collect_interior)
+
+    def _run_graph_impl(self, arg_vals, aux_vals, key, is_train,
+                        collect_interior=False):
         vals = {}
         for node in self._var_nodes:
             src = aux_vals if id(node) in self._aux_var_ids else arg_vals

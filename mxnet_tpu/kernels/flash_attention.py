@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["flash_attention", "blockwise_attention", "attention_with_lse",
-           "default_use_pallas"]
+           "default_use_pallas", "pallas_status"]
 
 
 def default_use_pallas():
@@ -50,6 +50,26 @@ def default_use_pallas():
         return "tpu" in kind or "tpu" in dev.platform.lower()
     except Exception:
         return False
+
+def pallas_status():
+    """(use_pallas, reason) — WHY the kernel gate is open or closed, for
+    bench/observability (`flash_attn_pallas_reason`). Reasons: "tpu"
+    (compiled Mosaic kernels run), "pallas-import-failed" (the Pallas
+    import itself raised — toolchain problem), "no-backend" (jax device
+    enumeration failed), or "no-tpu" (CPU/GPU backend: the jnp blockwise
+    fallback serves; the kernels themselves only run interpret-mode, as
+    in CI)."""
+    if not _HAS_PALLAS:
+        return False, "pallas-import-failed"
+    try:
+        dev = jax.devices()[0]
+    except Exception as e:
+        return False, "no-backend: %s" % type(e).__name__
+    if default_use_pallas():
+        return True, "tpu"
+    return False, ("no-tpu (platform=%s; Pallas kernels run "
+                   "interpret-mode only off-TPU)" % dev.platform)
+
 
 _NEG_INF = -1e30
 

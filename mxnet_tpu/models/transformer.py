@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..kernels.flash_attention import flash_attention
+from ..parallel.collectives import shard_map
 from ..parallel.ring_attention import sequence_parallel_attention
 
 __all__ = ["TransformerConfig", "init_transformer", "transformer_forward",
@@ -156,8 +157,8 @@ def _attention(q, k, v, cfg, mesh):
     if pad:
         padw = ((0, 0), (0, 0), (0, pad), (0, 0))
         q, k, v = (jnp.pad(t, padw) for t in (q, k, v))
-    out = jax.shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
-                        out_specs=spec)(q, k, v)
+    out = shard_map(local, mesh=mesh, in_specs=(spec,) * 3,
+                    out_specs=spec)(q, k, v)
     return out[:, :, :S] if pad else out
 
 

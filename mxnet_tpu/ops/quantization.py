@@ -2,21 +2,75 @@
 
 Reference: src/operator/quantization/{quantize,dequantize,requantize}-inl.h —
 the INT8 post-training flow driven by python/mxnet/contrib/quantization.py.
-TPU analog: int8 storage with float scale/zero bookkeeping; int8 matmuls ride
-XLA's native int8 MXU path when used inside jitted models.
+TPU analog: int8 storage with float scale/zero bookkeeping; the quantized
+conv/FC contractions consume int8 operands DIRECTLY (no f32 pre-cast in the
+graph), so XLA's native low-precision paths apply.
+
+Execution strategy (``_int8_strategy``, override via MXNET_TPU_INT8_NATIVE):
+
+* **native** — int8 operands, ``preferred_element_type=int32``: the MXU's
+  s8 x s8 -> s32 path on TPU (2x fp peak), cuDNN-equivalent on GPU. Default
+  on non-CPU backends; force anywhere with ``MXNET_TPU_INT8_NATIVE=1``
+  (what the CI parity/jaxpr suite does).
+* **f32acc** — int8 operands, ``preferred_element_type=float32`` with the
+  accumulator rounded back to int32: XLA:CPU lowers integer convolutions
+  through a scalar loop (~28x slower than f32 — measured on the bench
+  host), but an int8-operand conv with an f32 accumulator rides the same
+  Eigen path as fp32. Products of int8 values are exact in f32 and the
+  contraction is CHUNKED along input channels so no partial sum can leave
+  f32's 2^24 integer-exact window — the result is bit-identical to int32
+  accumulation at any reduction depth. CPU conv default. FC stays
+  ``native`` even on CPU (a [batch, C] x [C, classes] integer dot is
+  microseconds; keeping it s8xs8->s32 means the headline inference program
+  always carries a jaxpr-verifiable int32-accumulating int8 dot_general).
+* **wide** — operands upcast to int32: mixed-dtype operands (uint8 data x
+  int8 weights from direct callers) and the ``MXNET_TPU_INT8_NATIVE=0``
+  escape hatch.
+
+Scale bookkeeping supports BOTH per-tensor ranges (shape ``(1,)``) and
+AQT-style per-output-channel ranges (shape ``(num_filter,)``) — the range
+arrays broadcast along the channel axis of conv/FC outputs wherever they
+are consumed (requantize / dequantize / bias folding).
 """
 from __future__ import annotations
+
+import contextlib
+from contextvars import ContextVar
 
 import numpy as _np
 import jax
 import jax.numpy as jnp
 
-from ..base import Params, param_field
+from ..base import Params, get_env, param_field
 from .registry import register_op
+
+#: platform of the device the enclosing program is BOUND to, set by
+#: Executor._run_graph around every graph trace. jax.default_backend() is
+#: the process default, which diverges from the bound device exactly when
+#: it matters (a cpu-bound executor on a TPU host would pick `native` and
+#: hit XLA:CPU's scalar-loop integer conv; a tpu-bound one on a cpu-default
+#: host would pick `f32acc` and waste the MXU's s8 path).
+_PLATFORM_HINT = ContextVar("mxnet_tpu_int8_platform", default=None)
+
+
+@contextlib.contextmanager
+def int8_platform_hint(platform):
+    """Scope the int8 strategy choice to the platform of the device the
+    traced program will run on."""
+    token = _PLATFORM_HINT.set(platform)
+    try:
+        yield
+    finally:
+        _PLATFORM_HINT.reset(token)
 
 
 class QuantizeParam(Params):
     out_type = param_field(str, default="uint8")
+    # calibrated static range (contrib.quantization sets these from the
+    # collector's thresholds): the op then takes ONE input and emits no
+    # dynamic min/max reductions — the range is a compile-time constant
+    min_calib_range = param_field(float, default=None)
+    max_calib_range = param_field(float, default=None)
 
 
 def _qrange(out_type):
@@ -27,16 +81,30 @@ def _qrange(out_type):
     raise ValueError("unsupported quantized type %r" % out_type)
 
 
+def _quantize_inputs(p):
+    if p is not None and p.min_calib_range is not None \
+            and p.max_calib_range is not None:
+        return ("data",)
+    return ("data", "min_range", "max_range")
+
+
 @register_op("_contrib_quantize", param_cls=QuantizeParam,
-             input_names=("data", "min_range", "max_range"), num_outputs=3)
-def _quantize(params, data, min_range, max_range):
+             input_names=_quantize_inputs, num_outputs=3)
+def _quantize(params, data, *minmax):
     """Quantize float -> uint8 (affine) / int8 (symmetric, reference
     quantize-inl.h: scale = 127 / MaxAbs(min, max), no zero point).
 
-    Returns (quantized, min_range, max_range)."""
+    With calibrated ranges the scale is a static constant (no per-request
+    min/max reductions); otherwise the range rides in as the two extra
+    inputs. Returns (quantized, min_range, max_range)."""
     qmin, qmax, qdt = _qrange(params.out_type)
-    real_min = jnp.minimum(min_range.reshape(()), 0.0)
-    real_max = jnp.maximum(max_range.reshape(()), 0.0)
+    if minmax:
+        min_range, max_range = minmax
+        real_min = jnp.minimum(min_range.reshape(()), 0.0)
+        real_max = jnp.maximum(max_range.reshape(()), 0.0)
+    else:
+        real_min = jnp.float32(min(params.min_calib_range, 0.0))
+        real_max = jnp.float32(max(params.max_calib_range, 0.0))
     if params.out_type == "int8":
         absmax = jnp.maximum(jnp.abs(real_min), jnp.abs(real_max))
         scale = 127.0 / jnp.maximum(absmax, 1e-12)
@@ -48,6 +116,14 @@ def _quantize(params, data, min_range, max_range):
     return q, real_min.reshape((1,)), real_max.reshape((1,))
 
 
+def _channel_bcast(vec, ndim):
+    """Reshape a per-channel range/scale vector for broadcasting along the
+    channel axis (axis 1) of an [N, C, ...] activation; scalars pass."""
+    if vec.size == 1:
+        return vec.reshape(())
+    return vec.reshape((1, -1) + (1,) * (ndim - 2))
+
+
 class DequantizeParam(Params):
     out_type = param_field(str, default="float32")
 
@@ -55,14 +131,21 @@ class DequantizeParam(Params):
 @register_op("_contrib_dequantize", param_cls=DequantizeParam,
              input_names=("data", "min_range", "max_range"))
 def _dequantize(params, data, min_range, max_range):
-    real_min = min_range.reshape(())
-    real_max = max_range.reshape(())
     if data.dtype == jnp.uint8:
+        real_min = min_range.reshape(())
+        real_max = max_range.reshape(())
         scale = (real_max - real_min) / 255.0
         return (data.astype(jnp.float32) * scale + real_min).astype(
             jnp.float32)
+    absmax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    absmax = _channel_bcast(absmax.reshape((-1,)), data.ndim)
+    if data.dtype == jnp.int32:
+        # int32 conv/FC accumulator dequantized DIRECTLY (no intermediate
+        # requantize when nothing downstream consumes int8): the range
+        # maps +/-2^31 onto +/-absmax, same convention as _requantize
+        return (data.astype(jnp.float32)
+                * (absmax / (2.0 ** 31))).astype(jnp.float32)
     # int8: symmetric (matches the quantize path above)
-    absmax = jnp.maximum(jnp.abs(real_min), jnp.abs(real_max))
     return (data.astype(jnp.float32) * (absmax / 127.0)).astype(jnp.float32)
 
 
@@ -72,22 +155,25 @@ class RequantizeParam(Params):
 
 
 @register_op("_contrib_requantize", param_cls=RequantizeParam,
-             input_names=("data", "min_range", "max_range"), num_outputs=3)
+             input_names=("data", "min_range", "max_range"), num_outputs=3,
+             output_names=("output", "min_output", "max_output"))
 def _requantize(params, data, min_range, max_range):
-    """int32 (conv/fc accumulators) -> int8 with calibrated or dynamic range."""
-    real_min = min_range.reshape(())
-    real_max = max_range.reshape(())
-    # float value of one int32 step
-    scale32 = jnp.maximum(jnp.abs(real_min), jnp.abs(real_max)) / (2.0 ** 31)
+    """int32 (conv/fc accumulators) -> int8 with calibrated or dynamic range.
+
+    The incoming accumulator range may be per-channel (per-channel weight
+    scales); the emitted int8 range is always per-tensor."""
+    in_absmax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    # float value of one int32 step, broadcast along the channel axis
+    scale32 = _channel_bcast(in_absmax.reshape((-1,)), data.ndim) / (2.0 ** 31)
+    fdata = data.astype(jnp.float32) * scale32
     if params.min_calib_range is not None and \
             params.max_calib_range is not None:
         out_min = jnp.float32(params.min_calib_range)
         out_max = jnp.float32(params.max_calib_range)
     else:
-        fdata_absmax = jnp.max(jnp.abs(data.astype(jnp.float32))) * scale32
+        fdata_absmax = jnp.max(jnp.abs(fdata))
         out_min = -fdata_absmax
         out_max = fdata_absmax
-    fdata = data.astype(jnp.float32) * scale32
     scale8 = 127.0 / jnp.maximum(jnp.maximum(jnp.abs(out_min),
                                              jnp.abs(out_max)), 1e-12)
     q = jnp.clip(jnp.round(fdata * scale8), -127, 127).astype(jnp.int8)
@@ -95,57 +181,123 @@ def _requantize(params, data, min_range, max_range):
 
 
 # ---------------------------------------------------------------------------
-# quantized compute ops (reference: quantized_conv.cc, 
+# quantized compute ops (reference: quantized_conv.cc,
 # quantized_fully_connected.cc, quantized_pooling.cc, quantized_flatten.cc)
 # ---------------------------------------------------------------------------
 
 
 def _float_per_level(vmin, vmax, bits_lo, bits_hi):
-    """quantization_utils.h:127 FloatForOneQuantizedLevel."""
+    """quantization_utils.h:127 FloatForOneQuantizedLevel (elementwise —
+    per-channel ranges give per-channel levels)."""
     return (vmax - vmin) / (bits_hi - bits_lo)
 
 
 def _range_for_multiplication(min_a, max_a, min_b, max_b):
-    """int8 x int8 -> int32 output range (quantization_utils.h:138)."""
+    """int8 x int8 -> int32 output range (quantization_utils.h:138).
+
+    Any operand range may be per-channel; the result broadcasts to the
+    widest shape (per-channel weight ranges -> per-channel output range)."""
     qa = _float_per_level(min_a, max_a, -128.0, 127.0)
     qb = _float_per_level(min_b, max_b, -128.0, 127.0)
     qc = qa * qb
     c_lo, c_hi = -(2.0 ** 31), 2.0 ** 31 - 1
-    return (qc * c_lo).reshape((1,)), (qc * c_hi).reshape((1,))
+    return (qc * c_lo).reshape((-1,)), (qc * c_hi).reshape((-1,))
 
 
 from .nn import ConvParam, FCParam, PoolParam  # noqa: E402
 
+# worst case per int8 product is (-128)*(-128) = 16384 (int8 is asymmetric
+# — size the window for -128 operands even though the quantize op clips to
+# +/-127): this many terms always accumulate exactly in f32's 2^24 window
+_F32_EXACT_TERMS = (2 ** 24) // (128 * 128)  # = 1024
 
-def _int8_compute_dtypes(lhs, rhs, reduce_len):
-    """Backend-specialized operand dtypes for int8xint8->int32 contractions
-    (the analog of the reference dispatching quantized_conv to MKLDNN int8
-    kernels on CPU and cuDNN int8 on GPU — quantized_conv.cc:1):
 
-    * TPU/GPU: keep operands int8 — XLA lowers them onto the native
-      low-precision matmul path with int32 accumulation (an int32 upcast
-      BEFORE the contraction forces a slow wide-integer path instead).
-    * CPU: XLA:CPU has no vectorized integer conv (measured ~50x slower
-      than f32) — compute in f32 over exactly-representable integer
-      values and round the accumulator back to int32. Products |a*b| <=
-      128*128 are exact in f32; the simulation is only used while the
-      WORST-CASE accumulated magnitude (`reduce_len` terms of 128*128,
-      the -128 corner included) stays inside f32's 2^24 integer-exact
-      window, so a huge reduction
-      (e.g. 512-channel 3x3 conv at saturation) falls back to the exact
-      wide-int path instead of silently rounding.
-    Mixed operand dtypes (e.g. uint8 data from a direct caller) always
-    take the wide path, which XLA requires to be same-dtype."""
-    # worst case per product is (-128)*(-128) = 16384, not 127*127:
-    # int8 is asymmetric, so size the exactness window for -128 operands
-    f32_exact = reduce_len * 128 * 128 < 2 ** 24
-    if lhs.dtype == rhs.dtype and jax.default_backend() == "cpu" \
-            and f32_exact:
-        return (lhs.astype(jnp.float32), rhs.astype(jnp.float32),
-                jnp.float32, True)
-    if lhs.dtype != rhs.dtype or jax.default_backend() == "cpu":
-        return lhs.astype(jnp.int32), rhs.astype(jnp.int32), jnp.int32, False
-    return lhs, rhs, jnp.int32, False
+def _int8_strategy(lhs, rhs):
+    """Pick the execution strategy for one int8 contraction (module
+    docstring has the policy table). Returns 'native' | 'f32acc' | 'wide'
+    | 'float' ('float': non-integer avals — shape inference traces every
+    op with f32 stand-ins, and a direct fp32 caller just gets fp32)."""
+    if not (jnp.issubdtype(lhs.dtype, jnp.integer)
+            and jnp.issubdtype(rhs.dtype, jnp.integer)):
+        return "float"
+    if lhs.dtype != rhs.dtype:
+        return "wide"  # XLA integer contractions want same-dtype operands
+    mode = str(get_env("MXNET_TPU_INT8_NATIVE", "auto")).lower()
+    if mode in ("1", "native", "true"):
+        return "native"
+    if mode in ("0", "wide", "false"):
+        return "wide"
+    platform = _PLATFORM_HINT.get() or jax.default_backend()
+    return "native" if platform != "cpu" else "f32acc"
+
+
+def _exact_f32_conv(lhs, rhs, conv_kwargs):
+    """int8-operand conv with an f32 accumulator rounded back to int32 —
+    exact by construction (see module docstring), fast on XLA:CPU."""
+    from jax import lax
+    out = lax.conv_general_dilated(
+        lhs, rhs, preferred_element_type=jnp.float32,
+        # integer exactness needs full f32 — a global
+        # default_matmul_precision must not demote to bf16
+        precision=lax.Precision.HIGHEST, **conv_kwargs)
+    return jnp.round(out).astype(jnp.int32)
+
+
+def _int8_conv(data, weight, num_group, conv_kwargs):
+    """Strategy-dispatched int8 conv with exact int32 results."""
+    from jax import lax
+    strategy = _int8_strategy(data, weight)
+    if strategy == "float":
+        return lax.conv_general_dilated(data, weight, **conv_kwargs)
+    if strategy == "native":
+        return lax.conv_general_dilated(
+            data, weight, preferred_element_type=jnp.int32, **conv_kwargs)
+    if strategy == "wide":
+        return lax.conv_general_dilated(
+            data.astype(jnp.int32), weight.astype(jnp.int32),
+            preferred_element_type=jnp.int32, **conv_kwargs)
+    # f32acc: exact while the PER-GROUP reduction depth (a group only
+    # reduces over its own weight.shape[1] input channels — grouped/
+    # depthwise convs are shallow by construction) fits the 2^24 window;
+    # deeper ungrouped convs chunk input channels (each chunk exact,
+    # chunks add in int32); deeper grouped convs can't be chunked without
+    # breaking group alignment, so exactness outranks speed: wide path
+    kernel_terms = int(_np.prod(weight.shape[2:]))
+    group_c = weight.shape[1]  # input channels per group (OIHW layout)
+    if group_c * kernel_terms <= _F32_EXACT_TERMS:
+        return _exact_f32_conv(data, weight, conv_kwargs)
+    chunk_c = _F32_EXACT_TERMS // max(kernel_terms, 1)
+    if num_group != 1 or chunk_c < 1:
+        return lax.conv_general_dilated(
+            data.astype(jnp.int32), weight.astype(jnp.int32),
+            preferred_element_type=jnp.int32, **conv_kwargs)
+    out = None
+    c_in = data.shape[1]
+    for lo in range(0, c_in, chunk_c):
+        hi = min(lo + chunk_c, c_in)
+        part = _exact_f32_conv(data[:, lo:hi], weight[:, lo:hi],
+                               conv_kwargs)
+        out = part if out is None else out + part
+    return out
+
+
+def _int8_dot(x, w):
+    """Strategy-dispatched int8 FC contraction ([..., C] x [O, C] ->
+    [..., O], int32 accumulation). FC rides the native s8xs8->s32
+    dot_general on every backend (see module docstring). Contracts x's
+    LAST axis — the feature axis whatever the rank (axis 1 would silently
+    contract the wrong axis of a rank-3 flatten=False activation)."""
+    from jax import lax
+    strategy = _int8_strategy(x, w)
+    # x @ w.T without materializing .T
+    contract = (((x.ndim - 1,), (1,)), ((), ()))
+    if strategy == "float":
+        return lax.dot_general(x, w, contract)
+    if strategy == "wide":
+        return lax.dot_general(x.astype(jnp.int32), w.astype(jnp.int32),
+                               contract, preferred_element_type=jnp.int32)
+    return lax.dot_general(x, w, contract,
+                           preferred_element_type=jnp.int32)
 
 
 def _qconv_inputs(p):
@@ -156,13 +308,26 @@ def _qconv_inputs(p):
             "min_weight", "max_weight", "min_bias", "max_bias")
 
 
+def _fold_bias(out, bias, min_bias, max_bias, min_out, max_out, nd):
+    """Rescale an int8 bias into the int32 accumulator scale (reference
+    quantized_conv.cu bias_scale handling). Per-channel output ranges give
+    per-channel bias scales — shapes already line up elementwise."""
+    bias_q = _float_per_level(min_bias.reshape((-1,)),
+                              max_bias.reshape((-1,)), -128.0, 127.0)
+    out_q = _float_per_level(min_out.reshape((-1,)), max_out.reshape((-1,)),
+                             -(2.0 ** 31), 2.0 ** 31 - 1)
+    scaled = jnp.round(bias.astype(jnp.float32)
+                       * (bias_q / out_q)).astype(jnp.int32)
+    return out + scaled.reshape((1, -1) + (1,) * nd)
+
+
 @register_op("_contrib_quantized_conv", param_cls=ConvParam,
              input_names=_qconv_inputs, num_outputs=3,
              output_names=("output", "min_output", "max_output"))
 def _quantized_conv(params, data, weight, *rest):
     """int8 conv with int32 accumulation (reference quantized_conv.cc:1).
-    Output range derives from the input/weight quantization ranges."""
-    from jax import lax
+    Output range derives from the input/weight quantization ranges (per-
+    channel when the weight range is per-channel)."""
     if params.no_bias:
         bias = None
         min_data, max_data, min_weight, max_weight = rest
@@ -175,35 +340,15 @@ def _quantized_conv(params, data, weight, *rest):
     pad = params.pad or (0,) * nd
     if nd != 2:
         raise ValueError("quantized_conv supports 2D kernels only")
-    reduce_len = (data.shape[1] // params.num_group) * int(
-        _np.prod(params.kernel))
-    lhs, rhs, acc_dt, simulated = _int8_compute_dtypes(data, weight,
-                                                       reduce_len)
-    out = lax.conv_general_dilated(
-        lhs, rhs,
+    out = _int8_conv(data, weight, params.num_group, dict(
         window_strides=stride, padding=[(p, p) for p in pad],
         rhs_dilation=dilate, feature_group_count=params.num_group,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=acc_dt,
-        # simulated path must not be demoted to bf16 by a global
-        # default_matmul_precision — integer exactness needs full f32
-        precision=lax.Precision.HIGHEST if simulated else None)
-    if simulated:
-        out = jnp.round(out).astype(jnp.int32)
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
     min_out, max_out = _range_for_multiplication(
-        min_data.reshape(()), max_data.reshape(()),
-        min_weight.reshape(()), max_weight.reshape(()))
+        min_data.reshape((-1,)), max_data.reshape((-1,)),
+        min_weight.reshape((-1,)), max_weight.reshape((-1,)))
     if bias is not None:
-        # rescale int8 bias into the int32 output scale (reference
-        # quantized_conv.cu bias_scale handling)
-        bias_q = _float_per_level(min_bias.reshape(()), max_bias.reshape(()),
-                                  -128.0, 127.0)
-        out_q = _float_per_level(min_out.reshape(()), max_out.reshape(()),
-                                 -(2.0 ** 31), 2.0 ** 31 - 1)
-        scale = bias_q / out_q
-        out = out + jnp.round(
-            bias.astype(jnp.float32) * scale).astype(jnp.int32).reshape(
-            (1, -1) + (1,) * nd)
+        out = _fold_bias(out, bias, min_bias, max_bias, min_out, max_out, nd)
     return out, min_out, max_out
 
 
@@ -221,24 +366,13 @@ def _quantized_fully_connected(params, data, weight, *rest):
     x = data
     if params.flatten and x.ndim > 2:
         x = x.reshape((x.shape[0], -1))
-    # int8 operands straight into dot on TPU; f32-simulated on CPU
-    # (see _int8_compute_dtypes)
-    x, w, acc_dt, simulated = _int8_compute_dtypes(x, weight, x.shape[-1])
-    out = jax.lax.dot(
-        x, w.T, preferred_element_type=acc_dt,
-        precision=jax.lax.Precision.HIGHEST if simulated else None)
-    if simulated:
-        out = jnp.round(out).astype(jnp.int32)
+    out = _int8_dot(x, weight)
     min_out, max_out = _range_for_multiplication(
-        min_data.reshape(()), max_data.reshape(()),
-        min_weight.reshape(()), max_weight.reshape(()))
+        min_data.reshape((-1,)), max_data.reshape((-1,)),
+        min_weight.reshape((-1,)), max_weight.reshape((-1,)))
     if bias is not None:
-        bias_q = _float_per_level(min_bias.reshape(()), max_bias.reshape(()),
-                                  -128.0, 127.0)
-        out_q = _float_per_level(min_out.reshape(()), max_out.reshape(()),
-                                 -(2.0 ** 31), 2.0 ** 31 - 1)
-        out = out + jnp.round(bias.astype(jnp.float32)
-                              * (bias_q / out_q)).astype(jnp.int32)[None, :]
+        # nd=0: the fold's (1, -1) broadcast is exactly the FC [N, O] form
+        out = _fold_bias(out, bias, min_bias, max_bias, min_out, max_out, 0)
     return out, min_out, max_out
 
 
@@ -253,7 +387,7 @@ def _quantized_pooling(params, data, min_data, max_data):
         out = jnp.round(out).astype(data.dtype)
     else:
         out = jnp.clip(jnp.round(out), -128, 127).astype(data.dtype)
-    return out, min_data.reshape((1,)), max_data.reshape((1,))
+    return out, min_data.reshape((-1,)), max_data.reshape((-1,))
 
 
 @register_op("_contrib_quantized_flatten",
@@ -261,5 +395,5 @@ def _quantized_pooling(params, data, min_data, max_data):
              output_names=("output", "min_output", "max_output"))
 def _quantized_flatten(params, data, min_data, max_data):
     """Flatten preserving the quantization range (quantized_flatten.cc)."""
-    return (data.reshape((data.shape[0], -1)), min_data.reshape((1,)),
-            max_data.reshape((1,)))
+    return (data.reshape((data.shape[0], -1)), min_data.reshape((-1,)),
+            max_data.reshape((-1,)))

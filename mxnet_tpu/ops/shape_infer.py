@@ -141,6 +141,40 @@ def _rnn(params, shapes):
     return out
 
 
+# --- quantized conv/FC (reference: quantized_conv.cc FInferShape): weight
+# shapes derive exactly like their fp32 twins; range inputs default to the
+# per-channel layout quantize_params emits (provided shapes always win, so
+# per-tensor (1,) ranges from an explicit bind are untouched) ---
+
+@hook("_contrib_quantized_conv")
+def _qconv_shapes(params, shapes):
+    data = shapes.get("data")
+    if data is None:
+        return {}
+    o = params.num_filter
+    out = {"weight": (o, data[1] // params.num_group) + tuple(params.kernel),
+           "min_data": (1,), "max_data": (1,),
+           "min_weight": (o,), "max_weight": (o,)}
+    if not params.no_bias:
+        out.update(bias=(o,), min_bias=(1,), max_bias=(1,))
+    return out
+
+
+@hook("_contrib_quantized_fully_connected")
+def _qfc_shapes(params, shapes):
+    data = shapes.get("data")
+    if data is None:
+        return {}
+    in_dim = int(_np.prod(data[1:])) if params.flatten else data[-1]
+    out = {"weight": (params.num_hidden, in_dim),
+           "min_data": (1,), "max_data": (1,),
+           "min_weight": (params.num_hidden,),
+           "max_weight": (params.num_hidden,)}
+    if not params.no_bias:
+        out.update(bias=(params.num_hidden,), min_bias=(1,), max_bias=(1,))
+    return out
+
+
 # --- loss-layer label shapes (reference: each op's FInferShape also infers the
 # label input from data, which is what lets inference-mode bind omit labels) ---
 

@@ -4,7 +4,7 @@ TPU-native replacement for the reference's comm stack (src/kvstore/comm.h NCCL /
 ps-lite): XLA collectives over ICI/DCN driven by jax.sharding.Mesh + shard_map.
 """
 from .mesh import get_mesh, data_parallel_mesh, ShardingConfig
-from .collectives import allreduce_hosts, host_barrier
+from .collectives import allreduce_hosts, host_barrier, shard_map
 from .ring_attention import (ring_attention, ulysses_attention,
                              sequence_parallel_attention)
 from .sharded_step import ShardedTrainStep
@@ -13,7 +13,7 @@ from .moe import init_moe_ffn, moe_ffn
 from .optim_update import init_opt_state, apply_update
 
 __all__ = ["get_mesh", "data_parallel_mesh", "ShardingConfig",
-           "allreduce_hosts", "host_barrier", "ring_attention",
+           "allreduce_hosts", "host_barrier", "shard_map", "ring_attention",
            "ulysses_attention", "sequence_parallel_attention",
            "ShardedTrainStep", "pipeline_apply", "PipelinedTrainStep",
            "init_moe_ffn", "moe_ffn", "init_opt_state", "apply_update"]

@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
+from .collectives import shard_map
 
 __all__ = ["pipeline_apply", "PipelinedTrainStep"]
 
@@ -155,7 +156,7 @@ class PipelinedTrainStep:
             return (new_io, new_layer, io_state["mom"], layer_state["mom"],
                     loss)
 
-        shmapped = jax.shard_map(
+        shmapped = shard_map(
             device_step, mesh=mesh,
             in_specs=(self._io_spec, self._layer_spec,
                       self._io_spec, self._layer_spec, batch_spec),

@@ -37,7 +37,8 @@ class DataParallelTrainStep:
                  data_names=("data",), label_names=("softmax_label",),
                  sharding_config=None, rescale_grad=None, optimizer="sgd",
                  opt_hp=None, fixed_param_names=(), clip_gradient=None,
-                 compute_dtype=None, shard_update=None):
+                 compute_dtype=None, shard_update=None,
+                 fused_optupdate=None):
         self.symbol = symbol
         # stochastic-op scan decides whether steps draw fresh keys or reuse
         # one cached replicated key (see __call__)
@@ -88,6 +89,14 @@ class DataParallelTrainStep:
         dp_size = mesh.shape[self._dp_axis]
         self.shard_update = (dp_size > 1 if shard_update is None
                              else bool(shard_update))
+        # fused optimizer-update kernel (kernels/opt_update.py): one
+        # memory-bound Pallas sweep per param block instead of the
+        # apply_update tree-map chain — bit-parity either way. Opt-in via
+        # MXNET_TPU_FUSED_OPTUPDATE=1 (or the ctor arg).
+        if fused_optupdate is None:
+            from ..base import env_flag
+            fused_optupdate = env_flag("MXNET_TPU_FUSED_OPTUPDATE")
+        self.fused_optupdate = bool(fused_optupdate)
         self._step = None
 
     def _state_sharding_leaf(self, x):
@@ -198,6 +207,8 @@ class DataParallelTrainStep:
         optimizer, opt_hp = self.optimizer, dict(self.opt_hp)
         fixed = self.fixed_param_names
         clip = self.clip_gradient
+        fused_opt = self.fused_optupdate
+        single_dev = int(_np.prod(list(self.mesh.shape.values()))) == 1
         batch_size = list(batch_shapes.values())[0][0]
         rescale = self._rescale if self._rescale is not None else 1.0 / batch_size
 
@@ -232,17 +243,29 @@ class DataParallelTrainStep:
             grads = vjp(seeds)[0]
             if cdt is not None:  # fp32 master update (mp_sgd semantics)
                 grads = {n: g.astype(jnp.float32) for n, g in grads.items()}
-            from .optim_update import apply_update
-            # reference optimizer order: rescale -> clip -> + wd*weight
-            grads = {name: grads[name] * rescale for name in params}
-            if clip is not None:
-                grads = {name: jnp.clip(g, -clip, clip)
-                         for name, g in grads.items()}
-            grads = {name: g + wd * params[name]
-                     for name, g in grads.items()}
             hp = dict(opt_hp, lr=lr)
-            new_params, new_state = apply_update(
-                optimizer, hp, params, opt_state, grads)
+            if fused_opt:
+                # one fused sweep per param block (prologue + update in
+                # the kernel) — bit-parity with the tree-map path below.
+                # Kernel tier only on a single-device mesh: pallas_call is
+                # not auto-partitionable, so sharded (dp>1 weight-update
+                # sharding) steps take the fused-lax tier instead
+                from ..kernels.opt_update import fused_update_step
+                new_params, new_state = fused_update_step(
+                    optimizer, hp, params, opt_state, grads,
+                    rescale=rescale, clip=clip, wd=wd,
+                    use_pallas=None if single_dev else False)
+            else:
+                from .optim_update import apply_update
+                # reference optimizer order: rescale -> clip -> + wd*weight
+                grads = {name: grads[name] * rescale for name in params}
+                if clip is not None:
+                    grads = {name: jnp.clip(g, -clip, clip)
+                             for name, g in grads.items()}
+                grads = {name: g + wd * params[name]
+                         for name, g in grads.items()}
+                new_params, new_state = apply_update(
+                    optimizer, hp, params, opt_state, grads)
             if fixed:
                 new_params = {n: (params[n] if n in fixed else v)
                               for n, v in new_params.items()}

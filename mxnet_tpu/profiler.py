@@ -16,7 +16,7 @@ import jax
 __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
            "record_pipeline_event", "pipeline_counters",
            "record_analysis_check", "record_analysis_finding",
-           "analysis_counters"]
+           "analysis_counters", "record_kernel_roofline", "kernel_counters"]
 
 _state = {"running": False, "filename": "profile.json", "events": [],
           "jax_trace_dir": None, "lock": threading.Lock()}
@@ -151,6 +151,37 @@ def analysis_counters(reset=False):
         if reset:
             _analysis.clear()
             _analysis.update(_ANALYSIS_ZERO)
+    return out
+
+
+# ----------------------------------------------------------------------
+# per-kernel roofline counters (ISSUE 6): each hand-written kernel's win
+# is a GATED NUMBER — measured vs ideal, recorded by whoever measured
+# (bench phases, tools/flash_tune, tests) and snapshotted like the
+# pipeline counters. Always-on plain dict writes, no profiler session.
+# ----------------------------------------------------------------------
+_kernels = {}
+
+
+def record_kernel_roofline(kernel, measured, ideal, unit=""):
+    """Record one kernel's measured-vs-ideal pair (e.g. achieved TFLOP/s
+    vs roofline TFLOP/s, or HLO bytes vs must-move bytes). The ratio is
+    derived, not stored, so a re-record with a better measurement is
+    self-consistent."""
+    with _state["lock"]:
+        _kernels[kernel] = {
+            "measured": float(measured), "ideal": float(ideal),
+            "unit": unit,
+            "measured_vs_ideal": (round(float(measured) / float(ideal), 4)
+                                  if ideal else None)}
+
+
+def kernel_counters(reset=False):
+    """Snapshot (optionally reset) the per-kernel roofline records."""
+    with _state["lock"]:
+        out = {k: dict(v) for k, v in _kernels.items()}
+        if reset:
+            _kernels.clear()
     return out
 
 

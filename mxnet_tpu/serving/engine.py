@@ -268,6 +268,11 @@ class InferenceEngine:
         """Pin every input's non-batch shape + dtype, inferring the never-
         supplied ones (labels) from the symbol's shape inference."""
         shapes = {}
+        # the engine's own staged params seed the inference: quantized
+        # graphs carry weight/range arguments whose layout (per-channel vs
+        # per-tensor ranges) only the actual arrays know
+        for name, arr in self._params.items():
+            shapes[name] = tuple(arr.shape)
         for name, (shape, _) in self._templates.items():
             shapes[name] = shape
         for name, arr in supplied.items():

@@ -589,6 +589,102 @@ class TestDonation:
 
 
 # ----------------------------------------------------------------------
+# int8 program shapes (ISSUE 6): the quantized inference programs the
+# serving engine compiles must pass every jaxpr sweep with ZERO findings
+# — int32 accumulators are not f64 leaks, per-channel range args are not
+# dead params — and resident quantized-weight buffers stay undonatable.
+# ----------------------------------------------------------------------
+class TestInt8ProgramShapes:
+    def _quantized_jaxpr(self):
+        from mxnet_tpu.contrib import quantization as Q
+        rng = np.random.RandomState(0)
+        data = mx.sym.Variable("data")
+        net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                                 pad=(1, 1), name="c0")
+        net = mx.sym.Flatten(net)
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc0")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        args = {"c0_weight": mx.nd.array(rng.normal(0, .3, (8, 3, 3, 3))),
+                "c0_bias": mx.nd.array(rng.normal(0, .1, (8,))),
+                "fc0_weight": mx.nd.array(rng.normal(0, .1, (4, 8 * 64))),
+                "fc0_bias": mx.nd.array(np.zeros(4, np.float32))}
+        qsym = Q.quantize_graph(net, th_dict={"data": 1.0, "c0": 8.0,
+                                              "fc0": 16.0},
+                                offline_params=list(args))
+        qargs = Q.quantize_params(qsym, args)
+        ba = dict(qargs, data=mx.nd.zeros((2, 3, 8, 8)),
+                  softmax_label=mx.nd.zeros((2,)))
+        exe = qsym.bind(mx.cpu(), ba, grad_req="null")
+        names = list(exe.arg_dict) + list(exe.aux_dict)
+        arg_sds = {n: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                   for n, v in exe.arg_dict.items()}
+        aux_sds = {n: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                   for n, v in exe.aux_dict.items()}
+        jx = jax.make_jaxpr(
+            lambda a, x: exe._run_graph(a, x, jax.random.PRNGKey(0),
+                                        False))(arg_sds, aux_sds)
+        return jx, names
+
+    def test_int32_accumulators_are_not_f64_leaks(self):
+        # even under x64 (where a stray Python-float promotion WOULD
+        # surface): the int8 program's int32 accumulators and range
+        # arithmetic stay out of f64
+        from jax.experimental import enable_x64
+        jx, _ = self._quantized_jaxpr()
+        assert not check_jaxpr_f64(jx)
+        with enable_x64():
+            jx64, _ = self._quantized_jaxpr()
+        assert not check_jaxpr_f64(jx64)
+
+    def test_quantized_range_args_not_dead(self):
+        # per-channel min/max range args all feed the requantize/
+        # dequantize/bias-fold arithmetic — none may read as dead params
+        jx, names = self._quantized_jaxpr()
+        assert not check_jaxpr_dead(jx)
+
+    def test_quantized_weight_buffers_never_donated(self):
+        # serving contract with a quantized model: the staged int8
+        # weights are role 'params' — donating them is the same TPL203
+        # error as fp32 weights, AND the aliasing pass flags that an int8
+        # buffer can never alias the f32 outputs
+        roles = ("batch", "params", "aux", "rng")
+        fs = check_donation((1,), roles, mode="serving")
+        assert len(fs) == 1 and "'params'" in fs[0].message
+        in_avals = [[((4, 3, 8, 8), np.float32)],
+                    [((8, 3, 3, 3), np.int8), ((8,), np.float32)]]
+        out_avals = [((4, 10), np.float32)]
+        fs = check_donation_aliasing(in_avals, out_avals, (1,))
+        assert len(fs) == 1 and fs[0].severity == "warning"
+
+    def test_serving_cache_compiles_int8_program_lint_clean(self, caplog):
+        # end to end: the engine's bucket compile runs the MXNET_TPU_LINT
+        # sweep over the real int8 program with zero findings
+        from mxnet_tpu.contrib import quantization as Q
+        from mxnet_tpu.serving.engine import InferenceEngine
+        rng = np.random.RandomState(1)
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                  name="fc"), name="softmax")
+        args = {"fc_weight": mx.nd.array(rng.normal(0, .1, (4, 16))),
+                "fc_bias": mx.nd.array(np.zeros(4, np.float32))}
+        qsym = Q.quantize_graph(net, th_dict={"data": 1.0, "fc": 8.0},
+                                offline_params=list(args))
+        qargs = Q.quantize_params(qsym, args)
+        before = profiler.analysis_counters()
+        os.environ["MXNET_TPU_LINT"] = "1"
+        try:
+            eng = InferenceEngine(qsym, qargs, {}, ctx=mx.cpu(),
+                                  buckets=(4,), async_worker=False)
+            eng.predict({"data": rng.normal(0, 1, (4, 16))
+                         .astype(np.float32)})
+        finally:
+            del os.environ["MXNET_TPU_LINT"]
+        after = profiler.analysis_counters()
+        assert after["programs_checked"] > before.get("programs_checked", 0)
+        assert after.get("findings", 0) == before.get("findings", 0)
+
+
+# ----------------------------------------------------------------------
 # TPL204 recompilation hazards
 # ----------------------------------------------------------------------
 class TestBucketEscape:

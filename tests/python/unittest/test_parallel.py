@@ -15,6 +15,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from mxnet_tpu.kernels.flash_attention import (
     attention_with_lse, blockwise_attention, _flash_fwd_pallas)
 from mxnet_tpu.parallel.ring_attention import ring_attention, ulysses_attention
+from mxnet_tpu.parallel.collectives import shard_map
 from mxnet_tpu.parallel.mesh import get_mesh
 from mxnet_tpu.parallel.sharded_step import ShardedTrainStep
 from mxnet_tpu.parallel.pipeline import PipelinedTrainStep
@@ -65,7 +66,7 @@ def test_sequence_parallel_matches_full(impl, causal):
                                          block_k=16)) if impl == "ring" else \
          (lambda q, k, v: ulysses_attention(q, k, v, "sp", causal=causal))
     spec = P(None, None, "sp", None)
-    out = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+    out = jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
                                 out_specs=spec))(q, k, v)
     np.testing.assert_allclose(ref, out, atol=1e-5)
 
@@ -74,7 +75,7 @@ def test_ring_attention_grad():
     q, k, v = _qkv()
     mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
     spec = P(None, None, "sp", None)
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda q, k, v: ring_attention(q, k, v, "sp", causal=True, block_k=16),
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))
     g_ref = jax.grad(lambda q: attention_with_lse(q, k, v, causal=True)[0].sum())(q)
@@ -164,7 +165,7 @@ def test_moe_expert_parallel_matches_dense(n_dev):
         ally, e_star[:, None, None].repeat(d, 2), 1)[:, 0]
 
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("ep",))
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda p, x: moe_ffn(p, x, "ep", capacity_factor=8.0),
         mesh=mesh,
         in_specs=({"wg": P(), "w1": P("ep"), "w2": P("ep")}, P("ep")),
@@ -172,7 +173,13 @@ def test_moe_expert_parallel_matches_dense(n_dev):
     y, aux = fn(params, x)
     np.testing.assert_allclose(ref, y, atol=1e-5)
     assert 0.5 < float(aux) < float(E)
-    grads = jax.grad(lambda p: fn(p, x)[0].sum())(params)
+    def loss(p):
+        # + 0.0*aux: give the unused aux output a CONCRETE zero cotangent
+        # — current shard_map transpose rejects the symbolic Zero a
+        # fully-unused output would get (jax ad_util.Zero TypeError)
+        y, aux = fn(p, x)
+        return y.sum() + 0.0 * aux
+    grads = jax.grad(loss)(params)
     assert all(np.isfinite(np.asarray(g)).all()
                for g in jax.tree_util.tree_leaves(grads))
 
@@ -185,7 +192,7 @@ def test_moe_capacity_drops_tokens():
     x = jnp.asarray(np.random.RandomState(0).normal(
         0, 1, (64, d)).astype(np.float32))
     mesh = Mesh(np.array(jax.devices()[:1]), ("ep",))
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda p, x: moe_ffn(p, x, "ep", capacity_factor=0.25),
         mesh=mesh,
         in_specs=({"wg": P(), "w1": P("ep"), "w2": P("ep")}, P("ep")),
@@ -312,7 +319,7 @@ def test_ring_attention_pallas_interpret_parity():
         # check_vma=False: the interpret-mode pallas HLO interpreter can't
         # type varying-manual-axes yet (jax suggests this workaround); the
         # real TPU path compiles via Mosaic and never hits it
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             lambda q, k, v: ring_attention(q, k, v, "sp", causal=True,
                                            block_k=16,
                                            use_pallas=use_pallas,

@@ -58,3 +58,24 @@ def test_profiler_off_records_nothing():
     (a + a).wait_to_read()
     table = profiler.dumps()
     assert "_plus" not in table and "elemwise_add" not in table
+
+
+def test_kernel_roofline_counters():
+    """record_kernel_roofline/kernel_counters (ISSUE 6): always-on (no
+    profiler session), ratio derived not stored (re-record with a better
+    measurement stays self-consistent), reset clears."""
+    profiler.kernel_counters(reset=True)
+    profiler.record_kernel_roofline("opt_update", 715.4, 511.0,
+                                    unit="bytes_mb")
+    snap = profiler.kernel_counters()
+    assert snap["opt_update"]["measured_vs_ideal"] == round(715.4 / 511.0, 4)
+    assert snap["opt_update"]["unit"] == "bytes_mb"
+    # re-record wins wholesale
+    profiler.record_kernel_roofline("opt_update", 516.0, 511.0,
+                                    unit="bytes_mb")
+    assert profiler.kernel_counters()["opt_update"]["measured"] == 516.0
+    # zero ideal never divides
+    profiler.record_kernel_roofline("degenerate", 1.0, 0.0)
+    assert profiler.kernel_counters()["degenerate"]["measured_vs_ideal"] is None
+    assert profiler.kernel_counters(reset=True)
+    assert not profiler.kernel_counters()

@@ -45,7 +45,10 @@ def _convnet_params(rng):
 
 def test_quantize_graph_structure():
     """Conv/FC nodes become _contrib_quantized_* with quantize/requantize/
-    dequantize plumbing; weights fold into offline int8 args."""
+    dequantize plumbing; weights fold into offline int8 args. Requantize is
+    LAZY: an int32 accumulator requantizes to int8 only when an int8
+    consumer exists (here just conv1 -> flatten); accumulators read by
+    fp32 ops dequantize directly (one rescale, no second rounding)."""
     net = _convnet()
     params = ["conv0_weight", "conv0_bias", "conv1_weight",
               "fc0_weight", "fc0_bias"]
@@ -53,7 +56,7 @@ def test_quantize_graph_structure():
     ops = _ops(qsym)
     assert ops.count("_contrib_quantized_conv") == 2
     assert ops.count("_contrib_quantized_fully_connected") == 1
-    assert ops.count("_contrib_requantize") == 3
+    assert ops.count("_contrib_requantize") == 1
     assert "Convolution" not in ops and "FullyConnected" not in ops
     # runtime activation quantization stays in-graph; params don't
     assert "_contrib_quantize" in ops
@@ -150,20 +153,364 @@ def test_quantize_params_roundtrip_values():
     assert qargs["f_weight_max"].asnumpy()[0] == 2.0
 
 
-def test_int8_cpu_simulation_guards_f32_exactness():
-    """The CPU f32-simulated int8 path is only taken while the worst-case
-    accumulation fits f32's 2^24 integer-exact window; bigger reductions
-    use the exact wide-int path (ADVICE r4 review)."""
+def test_quantize_params_per_channel_scales():
+    """AQT-style per-output-channel weight scales: each channel saturates
+    its own +/-127 range, and the range args carry shape (num_filter,)."""
+    w = np.zeros((3, 2, 1, 1), np.float32)
+    w[0] = 0.01   # tiny channel would lose everything to a global scale
+    w[1] = 1.0
+    w[2] = -100.0
+    fc = mx.sym.Convolution(mx.sym.Variable("data"), num_filter=3,
+                            kernel=(1, 1), no_bias=True, name="c")
+    qsym = Q.quantize_graph(fc, offline_params=["c_weight"])
+    qargs = Q.quantize_params(qsym, {"c_weight": mx.nd.array(w)},
+                              per_channel=True)
+    q = qargs["c_weight_quantize"].asnumpy()
+    assert q.shape == w.shape and q.dtype == np.int8
+    # every channel reaches full scale under its own range
+    np.testing.assert_array_equal(np.abs(q).max(axis=(1, 2, 3)),
+                                  [127, 127, 127])
+    assert qargs["c_weight_max"].asnumpy().shape == (3,)
+    np.testing.assert_allclose(qargs["c_weight_max"].asnumpy(),
+                               [0.01, 1.0, 100.0], rtol=1e-6)
+    # per-tensor opt-out: one global scale, tiny channel collapses to 0
+    qargs_pt = Q.quantize_params(qsym, {"c_weight": mx.nd.array(w)},
+                                 per_channel=False)
+    assert qargs_pt["c_weight_max"].asnumpy().shape == (1,)
+    assert np.abs(qargs_pt["c_weight_quantize"].asnumpy()[0]).max() == 0
+
+
+def _traced_jaxpr(qsym, qargs, batch_shape):
+    """Trace the bound inference program exactly as the serving/bench path
+    runs it and return its jaxpr."""
+    import jax
+    bind_args = dict(qargs)
+    bind_args["data"] = mx.nd.zeros(batch_shape)
+    bind_args["softmax_label"] = mx.nd.zeros((batch_shape[0],))
+    exe = qsym.bind(mx.cpu(), bind_args, grad_req="null")
+    arg_sds = {n: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+               for n, v in exe.arg_dict.items()}
+    aux_sds = {n: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+               for n, v in exe.aux_dict.items()}
+    return jax.make_jaxpr(
+        lambda a, x: exe._run_graph(a, x, jax.random.PRNGKey(0), False))(
+        arg_sds, aux_sds)
+
+
+@with_seed()
+def test_int8_jaxpr_native_operands(monkeypatch):
+    """Ground truth on the TRACED program (not the backend name): with the
+    native strategy forced, every conv/FC contraction consumes int8
+    operands and accumulates in int32 — and inspect_int8_program reports
+    exactly that as mode 'native-int8'."""
+    monkeypatch.setenv("MXNET_TPU_INT8_NATIVE", "1")
+    rng = np.random.RandomState(5)
+    net = _convnet()
+    args = _convnet_params(rng)
+    qsym = Q.quantize_graph(net, offline_params=list(args))
+    qargs = Q.quantize_params(qsym, args)
+    jaxpr = _traced_jaxpr(qsym, qargs, (2, 3, 32, 32))
+    stats = Q.inspect_int8_program(jaxpr)
+    assert stats["mode"] == "native-int8"
+    assert stats["int8_int32_acc"] == 3      # conv0, conv1, fc0
+    assert stats["float"] == 0 and stats["wide_int"] == 0
+
+
+@with_seed()
+def test_int8_jaxpr_cpu_auto_strategy(monkeypatch):
+    """auto on XLA:CPU: convs ride the exact f32 accumulator, the FC stays
+    an int32-accumulating int8 dot — mode is still native-int8 (genuine
+    int8 operands everywhere, zero float/wide fallbacks)."""
+    monkeypatch.delenv("MXNET_TPU_INT8_NATIVE", raising=False)
+    rng = np.random.RandomState(6)
+    net = _convnet()
+    args = _convnet_params(rng)
+    qsym = Q.quantize_graph(net, offline_params=list(args))
+    qargs = Q.quantize_params(qsym, args)
+    jaxpr = _traced_jaxpr(qsym, qargs, (2, 3, 32, 32))
+    stats = Q.inspect_int8_program(jaxpr)
+    assert stats["mode"] == "native-int8"
+    assert stats["int8_int32_acc"] >= 1      # the FC dot
+    assert stats["float"] == 0 and stats["wide_int"] == 0
+
+
+@with_seed()
+def test_int8_native_matches_f32acc_bitwise(monkeypatch):
+    """The forced-native path and the chunked-f32acc CPU path produce the
+    SAME int32 accumulators, so the quantized network's outputs are
+    bit-identical between strategies."""
+    rng = np.random.RandomState(9)
+    net = _convnet()
+    args = _convnet_params(rng)
+    qsym = Q.quantize_graph(net, offline_params=list(args))
+    qargs = Q.quantize_params(qsym, args)
+    x = rng.uniform(-1, 1, (2, 3, 32, 32)).astype(np.float32)
+
+    def run():
+        ba = dict(qargs, data=mx.nd.array(x),
+                  softmax_label=mx.nd.zeros((2,)))
+        return qsym.bind(mx.cpu(), ba, grad_req="null") \
+            .forward(is_train=False)[0].asnumpy()
+
+    monkeypatch.setenv("MXNET_TPU_INT8_NATIVE", "1")
+    out_native = run()
+    monkeypatch.delenv("MXNET_TPU_INT8_NATIVE", raising=False)
+    out_auto = run()
+    np.testing.assert_array_equal(out_native, out_auto)
+
+
+@with_seed()
+def test_quantized_model_asymmetric_activations():
+    """Asymmetric (post-relu, all-positive) activation ranges: calibration
+    + symmetric int8 still track fp32 within the calibrated tolerance."""
+    rng = np.random.RandomState(21)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="c0")
+    net = mx.sym.Activation(net, act_type="relu", name="r0")
+    net = mx.sym.Convolution(net, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="c1")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=5, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    args = {"c0_weight": mx.nd.array(rng.normal(0, 0.3, (8, 3, 3, 3))),
+            "c0_bias": mx.nd.array(rng.normal(0, 0.1, (8,))),
+            "c1_weight": mx.nd.array(rng.normal(0, 0.2, (8, 8, 3, 3))),
+            "c1_bias": mx.nd.array(rng.normal(0, 0.1, (8,))),
+            "fc_weight": mx.nd.array(rng.normal(0, 0.1, (5, 8 * 8 * 8))),
+            "fc_bias": mx.nd.array(np.zeros(5, np.float32))}
+    # asymmetric input too: shifted-positive data
+    calib = rng.uniform(0, 2, (8, 3, 8, 8)).astype(np.float32)
+    it = mx.io.NDArrayIter(calib, None, batch_size=4)
+    qsym, qargs, _, th = Q.quantize_model(net, args, {}, calib_mode="naive",
+                                          calib_data=it)
+    x = rng.uniform(0, 2, (4, 3, 8, 8)).astype(np.float32)
+    lbl = mx.nd.zeros((4,))
+    out_q = qsym.bind(mx.cpu(), dict(qargs, data=mx.nd.array(x),
+                                     softmax_label=lbl),
+                      grad_req="null").forward(is_train=False)[0].asnumpy()
+    out_f = net.bind(mx.cpu(), dict(args, data=mx.nd.array(x),
+                                    softmax_label=lbl),
+                     grad_req="null").forward(is_train=False)[0].asnumpy()
+    assert (out_f.argmax(axis=1) == out_q.argmax(axis=1)).mean() >= 0.75
+    assert np.abs(out_f - out_q).max() < 0.1
+
+
+def test_calibrated_graph_has_no_dynamic_reductions():
+    """A fully calibrated graph quantizes every activation with a STATIC
+    scale: no min/max reduction ops remain (th covers data + every conv/FC
+    output); uncalibrated graphs keep the dynamic pair per quantize."""
+    net = _convnet()
+    params = ["conv0_weight", "conv0_bias", "conv1_weight",
+              "fc0_weight", "fc0_bias"]
+    th = {"data": 1.0, "conv0": 2.0, "conv1": 3.0, "fc0": 4.0,
+          "pool0": 2.0, "flat0": 3.0}
+    ops_cal = _ops(Q.quantize_graph(net, th_dict=th, offline_params=params))
+    assert "min" not in ops_cal and "max" not in ops_cal
+    ops_dyn = _ops(Q.quantize_graph(net, offline_params=params))
+    assert "min" in ops_dyn and "max" in ops_dyn
+
+
+def test_quantize_graph_keeps_flatten_false_fc_fp32():
+    """flatten=False FC stays fp32 in the rewrite (rank-N activations put
+    the channel on the last axis; the per-channel range plumbing
+    broadcasts on axis 1 — reference quantized FC was 2-D-only), and the
+    quantized graph still runs correctly end to end on a 3-D input."""
+    rng = np.random.RandomState(17)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=6, flatten=False,
+                                name="fc_seq")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc_out")
+    args = {"fc_seq_weight": mx.nd.array(rng.normal(0, .3, (6, 5))),
+            "fc_seq_bias": mx.nd.array(np.zeros(6, np.float32)),
+            "fc_out_weight": mx.nd.array(rng.normal(0, .3, (3, 4 * 6))),
+            "fc_out_bias": mx.nd.array(np.zeros(3, np.float32))}
+    qsym = Q.quantize_graph(net, offline_params=list(args))
+    ops = _ops(qsym)
+    assert "FullyConnected" in ops                       # fc_seq kept fp32
+    assert ops.count("_contrib_quantized_fully_connected") == 1  # fc_out
+    qargs = Q.quantize_params(qsym, args)
+    x = rng.uniform(-1, 1, (2, 4, 5)).astype(np.float32)
+    out_q = qsym.bind(mx.cpu(), dict(qargs, data=mx.nd.array(x)),
+                      grad_req="null").forward(is_train=False)[0].asnumpy()
+    ref = net.bind(mx.cpu(), dict(args, data=mx.nd.array(x)),
+                   grad_req="null").forward(is_train=False)[0].asnumpy()
+    assert np.abs(out_q - ref).max() < 0.05 * np.abs(ref).max() + 0.05
+
+
+def test_int8_dot_contracts_last_axis():
+    """_int8_dot contracts the feature (last) axis whatever the rank — a
+    rank-3 [N, T, C] activation against [O, C] weights must equal the
+    per-timestep 2-D contraction, not an axis-1 (T) contraction."""
+    from mxnet_tpu.ops.quantization import _int8_dot
     import jax.numpy as jnp
-    from mxnet_tpu.ops.quantization import _int8_compute_dtypes
-    small = jnp.zeros((2, 8), jnp.int8)
-    # 8-term reduction: simulated on CPU
-    *_, simulated = _int8_compute_dtypes(small, small, 8)
-    assert simulated
-    # 4608-term reduction at saturation would exceed 2^24: exact path
-    *_, simulated = _int8_compute_dtypes(small, small, 4608)
-    assert not simulated
-    # mixed dtypes always take the wide path
-    u = jnp.zeros((2, 8), jnp.uint8)
-    *_, simulated = _int8_compute_dtypes(u, small, 8)
-    assert not simulated
+    rng = np.random.RandomState(19)
+    # T == C on purpose: an axis-1 contraction would still run (silently
+    # wrong) instead of crashing
+    x = jnp.asarray(rng.randint(-127, 128, (2, 5, 5)).astype(np.int8))
+    w = jnp.asarray(rng.randint(-127, 128, (3, 5)).astype(np.int8))
+    out = np.asarray(_int8_dot(x, w))
+    ref = np.einsum("ntc,oc->nto", x.astype(np.int32), w.astype(np.int32))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_qconv_qfc_range_shape_inference():
+    """ops/shape_infer hooks: bind can infer the quantized weight AND the
+    per-channel (num_filter,) range-arg shapes from the data shape alone."""
+    net = _convnet()
+    params = ["conv0_weight", "conv0_bias", "conv1_weight",
+              "fc0_weight", "fc0_bias"]
+    qsym = Q.quantize_graph(net, offline_params=params)
+    arg_shapes, _, _ = qsym.infer_shape(data=(2, 3, 32, 32),
+                                        softmax_label=(2,))
+    shapes = dict(zip(qsym.list_arguments(), arg_shapes))
+    assert shapes["conv0_weight_quantize"] == (8, 3, 3, 3)
+    assert shapes["conv0_weight_min"] == (8,)
+    assert shapes["conv1_weight_max"] == (16,)
+    assert shapes["fc0_weight_quantize"] == (10, 16 * 16 * 16)
+    assert shapes["fc0_weight_min"] == (10,)
+    assert shapes["conv0_bias_min"] == (1,)
+
+
+@with_seed()
+def test_serving_weights_quantized_once():
+    """The serving engine stages quantized weights ONCE as device-resident
+    int8 buffers: repeated predicts reuse the same staged buffer (no
+    per-request re-quantization or re-staging), programs compile once per
+    bucket, and weight buffers are never donated."""
+    from mxnet_tpu.serving.engine import InferenceEngine
+    rng = np.random.RandomState(13)
+    net = _convnet()
+    args = _convnet_params(rng)
+    calib = rng.uniform(-1, 1, (8, 3, 32, 32)).astype(np.float32)
+    it = mx.io.NDArrayIter(calib, None, batch_size=4)
+    qsym, qargs, qaux, _ = Q.quantize_model(net, args, {},
+                                            calib_mode="naive",
+                                            calib_data=it)
+    n_quantize_calls = [0]
+    real = Q.quantize_params
+
+    def counting(*a, **k):
+        n_quantize_calls[0] += 1
+        return real(*a, **k)
+
+    Q.quantize_params = counting
+    try:
+        eng = InferenceEngine(qsym, qargs, qaux, ctx=mx.cpu(),
+                              buckets=(4,), async_worker=False)
+        staged = eng._params["conv0_weight_quantize"]
+        assert staged.dtype == np.int8
+        x = rng.uniform(-1, 1, (4, 3, 32, 32)).astype(np.float32)
+        outs = [np.asarray(eng.predict({"data": x})[0]) for _ in range(3)]
+    finally:
+        Q.quantize_params = real
+    # same staged buffer object across all requests; zero re-quantizations
+    assert eng._params["conv0_weight_quantize"] is staged
+    assert n_quantize_calls[0] == 0
+    np.testing.assert_array_equal(outs[0], outs[1])
+    np.testing.assert_array_equal(outs[1], outs[2])
+    st = eng.stats()
+    assert st["compiles"] == 1 and st["programs"] == 1
+
+
+def test_int8_strategy_table():
+    """ops/quantization._int8_strategy policy: native s8xs8->s32 whenever
+    forced (or off-CPU), exact chunked-f32 accumulation for XLA:CPU convs,
+    wide int32 upcast for mixed dtypes and the escape hatch, plain float
+    for non-integer avals (shape-inference stand-ins)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.quantization import _int8_strategy
+    s8 = jnp.zeros((2, 8), jnp.int8)
+    u8 = jnp.zeros((2, 8), jnp.uint8)
+    f32 = jnp.zeros((2, 8), jnp.float32)
+    assert _int8_strategy(f32, f32) == "float"
+    assert _int8_strategy(u8, s8) == "wide"  # mixed integer dtypes
+    import os
+    old = os.environ.get("MXNET_TPU_INT8_NATIVE")
+    try:
+        os.environ["MXNET_TPU_INT8_NATIVE"] = "1"
+        assert _int8_strategy(s8, s8) == "native"
+        os.environ["MXNET_TPU_INT8_NATIVE"] = "0"
+        assert _int8_strategy(s8, s8) == "wide"
+        os.environ["MXNET_TPU_INT8_NATIVE"] = "auto"
+        expect = "f32acc" if jax.default_backend() == "cpu" else "native"
+        assert _int8_strategy(s8, s8) == expect
+        # auto keys off the BOUND device's platform when the executor
+        # scopes one (Executor._run_graph), not the process default
+        from mxnet_tpu.ops.quantization import int8_platform_hint
+        with int8_platform_hint("tpu"):
+            assert _int8_strategy(s8, s8) == "native"
+        with int8_platform_hint("cpu"):
+            assert _int8_strategy(s8, s8) == "f32acc"
+    finally:
+        if old is None:
+            os.environ.pop("MXNET_TPU_INT8_NATIVE", None)
+        else:
+            os.environ["MXNET_TPU_INT8_NATIVE"] = old
+
+
+def test_int8_chunked_f32acc_exact():
+    """The chunked-f32 CPU conv accumulator is bit-identical to genuine
+    int32 accumulation at reduction depths far beyond f32's 2^24 window
+    (576 terms/chunk x 160 channels here; saturated +/-127 operands)."""
+    import jax.numpy as jnp
+    from jax import lax
+    from mxnet_tpu.ops.quantization import _int8_conv
+    rng = np.random.RandomState(11)
+    # worst case: saturated operands so partial sums grow fastest
+    x = jnp.asarray(rng.choice([-127, 127], (1, 160, 6, 6)).astype(np.int8))
+    w = jnp.asarray(rng.choice([-127, 127], (4, 160, 3, 3)).astype(np.int8))
+    kw = dict(window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+              rhs_dilation=(1, 1), feature_group_count=1,
+              dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ref = lax.conv_general_dilated(x.astype(jnp.int32), w.astype(jnp.int32),
+                                   preferred_element_type=jnp.int32, **kw)
+    import os
+    old = os.environ.get("MXNET_TPU_INT8_NATIVE")
+    os.environ.pop("MXNET_TPU_INT8_NATIVE", None)  # auto -> f32acc on CPU
+    try:
+        out = _int8_conv(x, w, 1, kw)
+    finally:
+        if old is not None:
+            os.environ["MXNET_TPU_INT8_NATIVE"] = old
+    assert out.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_int8_grouped_conv_exact_and_fast_path():
+    """Grouped/depthwise convs judge the exactness window by PER-GROUP
+    reduction depth (weight.shape[1] x kernel terms), not total c_in — a
+    depthwise 128-channel 3x3 (9 terms/group) rides the fast exact-f32
+    accumulator, not the slow wide path, and is bit-identical to int32."""
+    import jax.numpy as jnp
+    from jax import lax
+    from mxnet_tpu.ops import quantization as qops
+    rng = np.random.RandomState(4)
+    C = 128
+    x = jnp.asarray(rng.choice([-127, 127], (1, C, 5, 5)).astype(np.int8))
+    w = jnp.asarray(rng.choice([-127, 127], (C, 1, 3, 3)).astype(np.int8))
+    kw = dict(window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+              rhs_dilation=(1, 1), feature_group_count=C,
+              dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ref = lax.conv_general_dilated(x.astype(jnp.int32), w.astype(jnp.int32),
+                                   preferred_element_type=jnp.int32, **kw)
+    calls = []
+    real = qops._exact_f32_conv
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    import os
+    old = os.environ.pop("MXNET_TPU_INT8_NATIVE", None)
+    qops._exact_f32_conv = spy
+    try:
+        with qops.int8_platform_hint("cpu"):
+            out = qops._int8_conv(x, w, C, kw)
+    finally:
+        qops._exact_f32_conv = real
+        if old is not None:
+            os.environ["MXNET_TPU_INT8_NATIVE"] = old
+    assert calls, "depthwise conv fell off the fast exact-f32 path"
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

@@ -19,6 +19,13 @@ def causal_flops(B, H, S, D, n_iter=1):
     return 2 * 2 * B * H * S * S * D * 0.5 * n_iter
 
 
+def ideal_hbm_bytes(B, H, S, D, itemsize=2):
+    """Roofline HBM floor of one attention forward: Q+K+V read + O write
+    (bf16 by default). Shared by bench's flash and cost phases so the
+    roofline gate and the reported ideal-bytes figure can't drift."""
+    return 4 * B * H * S * D * itemsize
+
+
 def make_inputs(B, H, S, D, n_iter, dtype, seed=0):
     """(qs [n_iter,B,H,S,D], k, v) staged on device in `dtype`.
 

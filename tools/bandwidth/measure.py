@@ -20,6 +20,7 @@ def measure(total_mb=256.0, num_arrays=50, iters=10, devices=None):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
+    from mxnet_tpu.parallel.collectives import shard_map
 
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
@@ -35,8 +36,8 @@ def measure(total_mb=256.0, num_arrays=50, iters=10, devices=None):
     def allreduce(arrs):
         return [jax.lax.psum(a, "dp") for a in arrs]
 
-    fn = jax.jit(jax.shard_map(allreduce, mesh=mesh,
-                               in_specs=P("dp", None), out_specs=P("dp", None)))
+    fn = jax.jit(shard_map(allreduce, mesh=mesh,
+                           in_specs=P("dp", None), out_specs=P("dp", None)))
     out = fn(shards)
     jax.block_until_ready(out)
 
