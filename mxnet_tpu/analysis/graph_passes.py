@@ -216,7 +216,7 @@ def check_symbol_unused_args(symbol, provided, where="<symbol>"):
 # ----------------------------------------------------------------------
 # TPL203 — donation contracts
 # ----------------------------------------------------------------------
-_TRAIN_DONATABLE = frozenset({"params", "opt_state"})
+_TRAIN_DONATABLE = frozenset({"params", "opt_state", "opt_state_shard"})
 _SERVING_DONATABLE = frozenset({"batch"})
 
 
@@ -226,9 +226,16 @@ def check_donation(donate_argnums, roles, mode="train", where="<program>"):
     Train-step contract (PR 3): only ``params``/``opt_state`` may be
     donated — batch args are never donated (no step output can alias
     them; donation would warn per compile and force device-batch callers
-    into per-step defensive copies). Serving contract (PR 1): only the
-    per-request ``batch`` is donated — params/aux are reused every call,
-    a donated weight buffer is freed under the next request.
+    into per-step defensive copies). ``opt_state_shard`` — ZERO-partitioned
+    (dp, chunk) slot blocks (parallel/zero.py) — is donatable in train
+    mode too: a partitioned slot is still step-private state whose output
+    always matches its input layout. (The shipped tpu_step chooses NOT to
+    donate it — XLA:CPU fp contraction in donated in-place loops is
+    layout-dependent and would cost the sharded update its bitwise parity
+    with the replicated one — but donating it is contract-legal, e.g. for
+    sharded_step's annotation-based form.) Serving contract (PR 1): only
+    the per-request ``batch`` is donated — params/aux are reused every
+    call, a donated weight buffer is freed under the next request.
     """
     allowed = _TRAIN_DONATABLE if mode == "train" else _SERVING_DONATABLE
     findings = []

@@ -151,10 +151,18 @@ def capture_optimizer(mod):
     if getattr(mod, "_fused_step", None) is not None:
         step = mod._fused_step
         # opt_state is replaced functionally every iteration — holding
-        # the current tree IS the point-in-time snapshot
-        return {_OPT_FORMAT_KEY: 1, "kind": "fused",
-                "optimizer": _clean_optimizer(step.optimizer),
-                "state": step.opt_state}, []
+        # the current tree IS the point-in-time snapshot. Under
+        # MXNET_TPU_ZERO the per-param slots are (dp, chunk) shard
+        # blocks; the layout manifest rides along so restore can
+        # reassemble canonical per-param slots — including under a
+        # DIFFERENT replica count, or into a non-sharded step.
+        payload = {_OPT_FORMAT_KEY: 1, "kind": "fused",
+                   "optimizer": _clean_optimizer(step.optimizer),
+                   "state": step.opt_state}
+        zero_meta = getattr(step, "opt_state_layout_meta", lambda: None)()
+        if zero_meta is not None:
+            payload["zero"] = zero_meta
+        return payload, []
     if getattr(mod, "_update_on_kvstore", False) and mod._kvstore is not None:
         kv = mod._kvstore
         if hasattr(kv, "save_checkpoint"):
@@ -234,13 +242,27 @@ def apply_optimizer_payload(mod, blob):
                              "but this module has no fused step")
         import jax
         from jax.tree_util import tree_map
+        step = mod._fused_step
         state_np = tree_to_numpy(payload["state"])
+        # ZERO-aware reassembly: a checkpoint written by a sharded step
+        # carries (dp, chunk) slot blocks + the layout manifest — fold
+        # them back to canonical per-param slots with the SAVED layout
+        # (its dp may differ from the live mesh), then re-partition with
+        # the LIVE step's layout when that step is sharded too. Pack and
+        # unpack are pure reshapes, so the round-trip is bit-exact across
+        # replica counts and across zero<->replicated restores.
+        if payload.get("zero"):
+            from ..parallel.zero import ZeroShardLayout
+            state_np = ZeroShardLayout.from_meta(
+                payload["zero"]).canonicalize_state(state_np)
+        if getattr(step, "zero", False):
+            state_np = step._zero_layout.shard_state(state_np)
         # restore with the step's own sharding layout: the jitted program
         # pins dp-sharded in_shardings, a replicated restore would fail
         # the sharding match on the next step
-        mod._fused_step.opt_state = tree_map(
+        step.opt_state = tree_map(
             lambda sh, v: jax.device_put(v, sh),
-            mod._fused_step._state_shardings(), state_np)
+            step._state_shardings(), state_np)
         restore_optimizer_attrs(mod._fused_step.optimizer,
                                 payload.get("optimizer"))
         if getattr(mod, "_optimizer", None) is not None:

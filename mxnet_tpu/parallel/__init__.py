@@ -10,10 +10,13 @@ from .ring_attention import (ring_attention, ulysses_attention,
 from .sharded_step import ShardedTrainStep
 from .pipeline import pipeline_apply, PipelinedTrainStep
 from .moe import init_moe_ffn, moe_ffn
-from .optim_update import init_opt_state, apply_update
+from .optim_update import (init_opt_state, apply_update,
+                           apply_update_sharded)
+from .zero import ZeroShardLayout
 
 __all__ = ["get_mesh", "data_parallel_mesh", "ShardingConfig",
            "allreduce_hosts", "host_barrier", "shard_map", "ring_attention",
            "ulysses_attention", "sequence_parallel_attention",
            "ShardedTrainStep", "pipeline_apply", "PipelinedTrainStep",
-           "init_moe_ffn", "moe_ffn", "init_opt_state", "apply_update"]
+           "init_moe_ffn", "moe_ffn", "init_opt_state", "apply_update",
+           "apply_update_sharded", "ZeroShardLayout"]

@@ -39,7 +39,7 @@ class ShardedTrainStep:
     def __init__(self, loss_fn, mesh, param_specs, batch_spec=None,
                  optimizer="adam", lr=1e-3, momentum=0.9, wd=0.0,
                  beta1=0.9, beta2=0.999, eps=1e-8, grad_clip=None,
-                 shard_update=None):
+                 shard_update=None, zero=None):
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.param_specs = param_specs
@@ -52,12 +52,37 @@ class ShardedTrainStep:
                        beta2=beta2, eps=eps, grad_clip=grad_clip)
         # ZeRO-1 across the dp axis (see tpu_step): optimizer state for a
         # param replicated over 'dp' additionally shards its first free
-        # divisible axis over 'dp' — composes with the tp shardings
+        # divisible axis over 'dp' — composes with the tp shardings.
+        # `zero` (or MXNET_TPU_ZERO=1) is the cross-step-consistent alias
+        # for the same transform in the composed dp x tp case: here the
+        # state keeps the param's own tp sharding per axis, so the
+        # flatten/pad block layout tpu_step uses cannot apply — 'dp'
+        # rides a free divisible axis instead, and the grads are
+        # explicitly reduce-scattered onto that layout (see _build).
         dp_ok = "dp" in mesh.axis_names and mesh.shape["dp"] > 1
+        if zero is None and shard_update is None:
+            from ..base import env_flag
+            if env_flag("MXNET_TPU_ZERO"):
+                # env opt-in is opportunistic: without a real dp axis
+                # there is nothing to shard over, keep the default
+                zero = dp_ok or None
+        flag_name = "shard_update"
+        if zero is not None and shard_update is not None and \
+                bool(zero) != bool(shard_update):
+            raise MXNetError(
+                "contradictory flags: zero=%r but shard_update=%r — in "
+                "ShardedTrainStep zero IS the shard_update transform; "
+                "pass only one" % (zero, shard_update))
+        if shard_update is None and zero:
+            # only a TRUTHY zero maps onto shard_update: zero=False means
+            # "no ZeRO opinion" and keeps the auto-on default, matching
+            # DataParallelTrainStep's semantics for the same flag
+            shard_update = True
+            flag_name = "zero"  # blame the flag the caller actually set
         if shard_update and not dp_ok:
             raise MXNetError(
-                "shard_update=True needs a 'dp' mesh axis of size > 1; "
-                "mesh axes are %r" % (dict(mesh.shape),))
+                "%s=True needs a 'dp' mesh axis of size > 1; "
+                "mesh axes are %r" % (flag_name, dict(mesh.shape)))
         self.shard_update = dp_ok if shard_update is None \
             else bool(shard_update)
         self._step_fn = None
@@ -104,6 +129,14 @@ class ShardedTrainStep:
         hp = self.hp
         opt = self.optimizer
         loss_fn = self.loss_fn
+        mesh = self.mesh
+        shard_update = self.shard_update
+        # optimizer state shards like its param, PLUS 'dp' on a free axis
+        # when weight-update sharding is on (state spec, not param spec)
+        # two-tree tree_map flattens only up to the FIRST tree's leaves,
+        # so each P arrives whole (same contract _shard relies on)
+        state_specs = jax.tree_util.tree_map(
+            self._state_spec, self.params, self.param_specs)
 
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -115,16 +148,22 @@ class ShardedTrainStep:
             if hp["wd"]:
                 grads = jax.tree_util.tree_map(
                     lambda g, p: g + hp["wd"] * p, grads, params)
+            if shard_update:
+                # explicit ZeRO scatter (arxiv 2004.13336): pin the grads
+                # to the STATE layout (param spec + 'dp' on a free axis)
+                # so the partitioner folds the pending cross-replica sum
+                # into a reduce-scatter and the update below runs on 1/dp
+                # of every slot-carrying tensor per replica; the param
+                # out_shardings all-gather the fresh weights. Composes
+                # with tp: the grad keeps its tensor-parallel axes.
+                grads = jax.tree_util.tree_map(
+                    lambda g, s: jax.lax.with_sharding_constraint(
+                        g, NamedSharding(mesh, s)),
+                    grads, state_specs)
             from .optim_update import apply_update
             params, opt_state = apply_update(opt, hp, params, opt_state, grads)
             return params, opt_state, loss
 
-        # optimizer state shards like its param, PLUS 'dp' on a free axis
-        # when weight-update sharding is on (state spec, not param spec)
-        # two-tree tree_map flattens only up to the FIRST tree's leaves,
-        # so each P arrives whole (same contract _shard relies on)
-        state_specs = jax.tree_util.tree_map(
-            self._state_spec, self.params, self.param_specs)
         if self.optimizer == "adam":
             opt_specs = {"m": state_specs, "v": state_specs, "t": P()}
         else:

@@ -38,7 +38,7 @@ class DataParallelTrainStep:
                  sharding_config=None, rescale_grad=None, optimizer="sgd",
                  opt_hp=None, fixed_param_names=(), clip_gradient=None,
                  compute_dtype=None, shard_update=None,
-                 fused_optupdate=None):
+                 fused_optupdate=None, zero=None):
         self.symbol = symbol
         # stochastic-op scan decides whether steps draw fresh keys or reuse
         # one cached replicated key (see __call__)
@@ -83,12 +83,32 @@ class DataParallelTrainStep:
         # Cross-replica weight-update sharding (Xu et al.,
         # arxiv 2004.13336 — the GSPMD weight-update-sharding transform,
         # ZeRO-1's TPU form): optimizer state shards over the dp axis, so
-        # per-chip optimizer memory and update FLOPs drop by dp; XLA
-        # turns the gradient all-reduce into reduce-scatter + all-gather
-        # (same bytes over ICI). Auto-on when the dp axis is real (>1).
+        # per-chip optimizer memory and update FLOPs drop by dp; the
+        # annotation leaves any all-reduce/all-gather placement to XLA.
+        # Auto-on when the dp axis is real (>1).
         dp_size = mesh.shape[self._dp_axis]
         self.shard_update = (dp_size > 1 if shard_update is None
                              else bool(shard_update))
+        # ZeRO-style EXPLICIT update sharding (MXNET_TPU_ZERO=1 or ctor
+        # arg): every param flattens/pads into a (dp, chunk) block
+        # (parallel/zero.py), each replica slices and updates its 1/dp
+        # shard of the all-reduced grads, params + slots (fp32 masters
+        # included in the bf16 multi-precision path), and the fresh
+        # params all-gather in-graph — a shard_map island; see
+        # optim_update.apply_update_sharded for the comm/bitwise trade.
+        # Strictly stronger than `shard_update`'s
+        # annotation form: bias vectors and dp-indivisible shapes shard
+        # too, so per-replica slot memory is exactly O(params/dp).
+        # Supersedes shard_update when on.
+        if zero is None:
+            from ..base import env_flag
+            # env opt-in is opportunistic (same policy as ShardedTrainStep):
+            # with a 1-way dp axis there is nothing to shard — the layout
+            # would only cost the single-device Pallas fused-optupdate tier
+            # and the slot donation for zero benefit
+            zero = env_flag("MXNET_TPU_ZERO") and dp_size > 1
+        self.zero = bool(zero)
+        self._zero_layout = None  # built with the params in _init_opt_state
         # fused optimizer-update kernel (kernels/opt_update.py): one
         # memory-bound Pallas sweep per param block instead of the
         # apply_update tree-map chain — bit-parity either way. Opt-in via
@@ -110,6 +130,13 @@ class DataParallelTrainStep:
         return self._repl
 
     def _state_shardings(self):
+        if self.zero:
+            zsh = self._zero_layout.sharding(self.mesh)
+            # per-param slots are (dp, chunk) blocks sharded over dp;
+            # scalar state (adam's t) stays replicated
+            return jax.tree_util.tree_map(
+                lambda x: zsh if getattr(x, "ndim", 0) >= 1 else self._repl,
+                self.opt_state)
         return jax.tree_util.tree_map(self._state_sharding_leaf,
                                       self.opt_state)
 
@@ -175,9 +202,19 @@ class DataParallelTrainStep:
 
     def _init_opt_state(self):
         from .optim_update import init_opt_state
-        self.opt_state = init_opt_state(
-            self.optimizer, self.params,
-            momentum=self.opt_hp.get("momentum", self.momentum))
+        momentum = self.opt_hp.get("momentum", self.momentum)
+        if self.zero:
+            from .zero import ZeroShardLayout
+            self._zero_layout = ZeroShardLayout.from_params(
+                self.params, self.mesh.shape[self._dp_axis],
+                axis_name=self._dp_axis)
+            self.opt_state = init_opt_state(
+                self.optimizer, self.params, momentum=momentum,
+                layout=self._zero_layout)
+            self._record_zero_counters()
+        else:
+            self.opt_state = init_opt_state(
+                self.optimizer, self.params, momentum=momentum)
         # place state with its (possibly dp-sharded) layout up front so
         # the first step doesn't reshard
         self.opt_state = jax.tree_util.tree_map(
@@ -185,6 +222,31 @@ class DataParallelTrainStep:
             self.opt_state, self._state_shardings())
         # keep legacy attribute for existing callers/tests
         self.moms = self.opt_state.get("mom") or {}
+
+    def _record_zero_counters(self):
+        """Always-on profiler accounting for the sharded update: what the
+        MULTICHIP bench banks (per-replica slot bytes, scatter/gather
+        volumes) comes straight from the layout arithmetic."""
+        from .. import profiler
+        lay = self._zero_layout
+        momentum = self.opt_hp.get("momentum", self.momentum)
+        comm = lay.comm_bytes()
+        profiler.record_zero_sharding(
+            dp=lay.dp,
+            opt_state_bytes_per_replica=lay.per_replica_slot_bytes(
+                self.optimizer, momentum),
+            opt_state_bytes_replicated=lay.replicated_slot_bytes(
+                self.optimizer, momentum),
+            grad_allreduce_bytes=comm["grad_allreduce_bytes"],
+            update_gather_bytes=comm["gather_bytes"],
+            param_bytes=lay.param_bytes())
+
+    def opt_state_layout_meta(self):
+        """Checkpoint manifest entry describing the sharded slot layout
+        (None when the update is replicated) — restore uses it to
+        reassemble canonical slots, including under a different replica
+        count (checkpoint/state.py)."""
+        return self._zero_layout.meta() if self.zero else None
 
     def export_params(self):
         """Current (params, aux) as numpy dicts (host sync point)."""
@@ -208,6 +270,8 @@ class DataParallelTrainStep:
         fixed = self.fixed_param_names
         clip = self.clip_gradient
         fused_opt = self.fused_optupdate
+        zero_layout = self._zero_layout if self.zero else None
+        mesh = self.mesh
         single_dev = int(_np.prod(list(self.mesh.shape.values()))) == 1
         batch_size = list(batch_shapes.values())[0][0]
         rescale = self._rescale if self._rescale is not None else 1.0 / batch_size
@@ -241,10 +305,30 @@ class DataParallelTrainStep:
             outs, vjp, aux_upd = jax.vjp(loss_fn, params, has_aux=True)
             seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads = vjp(seeds)[0]
-            if cdt is not None:  # fp32 master update (mp_sgd semantics)
-                grads = {n: g.astype(jnp.float32) for n, g in grads.items()}
+            if cdt is not None and zero_layout is None:
+                # fp32 master update (mp_sgd semantics); the ZERO path
+                # casts inside its shard_map island instead
+                # (apply_update_sharded cast_grads=) so the cast sits in
+                # the update loop in both variants
+                grads = {n: g.astype(jnp.float32)
+                         for n, g in grads.items()}
             hp = dict(opt_hp, lr=lr)
-            if fused_opt:
+            if zero_layout is not None:
+                # ZeRO cross-replica sharded update (arxiv 2004.13336):
+                # a shard_map island where each replica slices its 1/dp
+                # (dp, chunk) block of the all-reduced grads and updates
+                # its shard of params + slots (fp32 masters included:
+                # the mp_sgd-style bf16->fp32 grad cast runs on the
+                # shards, inside the island's update loop), then the
+                # fresh params all-gather. Bit-parity with both paths
+                # below.
+                from .optim_update import apply_update_sharded
+                new_params, new_state = apply_update_sharded(
+                    optimizer, hp, params, opt_state, grads, zero_layout,
+                    mesh, rescale=rescale, clip=clip, wd=wd,
+                    fused=fused_opt,
+                    cast_grads=jnp.float32 if cdt is not None else None)
+            elif fused_opt:
                 # one fused sweep per param block (prologue + update in
                 # the kernel) — bit-parity with the tree-map path below.
                 # Kernel tier only on a single-device mesh: pallas_call is
@@ -256,14 +340,9 @@ class DataParallelTrainStep:
                     rescale=rescale, clip=clip, wd=wd,
                     use_pallas=None if single_dev else False)
             else:
-                from .optim_update import apply_update
-                # reference optimizer order: rescale -> clip -> + wd*weight
-                grads = {name: grads[name] * rescale for name in params}
-                if clip is not None:
-                    grads = {name: jnp.clip(g, -clip, clip)
-                             for name, g in grads.items()}
-                grads = {name: g + wd * params[name]
-                         for name, g in grads.items()}
+                from .optim_update import apply_update, grad_prologue
+                grads = grad_prologue(params, grads, rescale=rescale,
+                                      clip=clip, wd=wd)
                 new_params, new_state = apply_update(
                     optimizer, hp, params, opt_state, grads)
             if fixed:
@@ -290,8 +369,16 @@ class DataParallelTrainStep:
         # batch args (3, 4) are NOT donated: no step output matches the
         # batch shapes, so XLA could never alias them — donation would only
         # warn per compile and force callers that reuse device-resident
-        # batches (bench _phase_step) into per-step defensive copies
-        donate_argnums = (0, 1)
+        # batches (bench _phase_step) into per-step defensive copies.
+        # ZERO donation contract: the O(params) param buffers stay donated,
+        # but the PARTITIONED optimizer slots are not — XLA:CPU's fp
+        # contraction inside in-place (donated) loops is layout-dependent,
+        # and donating the (dp, chunk) slots costs the sharded-vs-replicated
+        # update its bitwise parity (1-ulp drift in the momentum term).
+        # Rebuffering the slots each step costs O(params/dp) transient
+        # memory — the exact class ZeRO just freed, dp-fold smaller than
+        # what the param donation saves.
+        donate_argnums = (0,) if self.zero else (0, 1)
         from ..analysis.runtime import lint_enabled
         if lint_enabled():
             self._lint_step(step, donate_argnums)
@@ -306,8 +393,11 @@ class DataParallelTrainStep:
         f64 leaks, and dead subgraphs/params."""
         from ..analysis.graph_passes import check_donation
         from ..analysis.runtime import report_findings
-        roles = ("params", "opt_state", "aux", "batch", "batch",
-                 "rng", "lr")
+        # under ZERO the state arg carries partitioned (dp, chunk) slot
+        # blocks — its own donatable role (TPL203 accepts it in train
+        # mode; this step donates params only, see _build_step)
+        roles = ("params", "opt_state_shard" if self.zero else "opt_state",
+                 "aux", "batch", "batch", "rng", "lr")
         report_findings(check_donation(donate_argnums, roles, mode="train",
                                        where="tpu_step"))
         # the jaxpr sweep AND the donation-aliasing check wait for the

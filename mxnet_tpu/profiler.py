@@ -16,7 +16,8 @@ import jax
 __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
            "record_pipeline_event", "pipeline_counters",
            "record_analysis_check", "record_analysis_finding",
-           "analysis_counters", "record_kernel_roofline", "kernel_counters"]
+           "analysis_counters", "record_kernel_roofline", "kernel_counters",
+           "record_zero_sharding", "zero_counters"]
 
 _state = {"running": False, "filename": "profile.json", "events": [],
           "jax_trace_dir": None, "lock": threading.Lock()}
@@ -182,6 +183,37 @@ def kernel_counters(reset=False):
         out = {k: dict(v) for k, v in _kernels.items()}
         if reset:
             _kernels.clear()
+    return out
+
+
+# ----------------------------------------------------------------------
+# ZeRO weight-update-sharding counters (ISSUE 7): the memory/traffic
+# contract of MXNET_TPU_ZERO as plain numbers — per-replica optimizer-slot
+# bytes vs the replicated baseline, and the per-step scatter/gather
+# volumes — recorded by the fused step at build and banked by the
+# MULTICHIP bench. Always-on plain dict writes, like the kernel counters.
+# ----------------------------------------------------------------------
+_zero = {}
+
+
+def record_zero_sharding(**kv):
+    """Record the sharded-update layout accounting (dp, per-replica vs
+    replicated optimizer-state bytes, scatter/gather volumes). One record
+    per built step; a rebuild overwrites with its own layout."""
+    with _state["lock"]:
+        _zero.clear()
+        _zero.update({k: (float(v) if isinstance(v, float) else int(v))
+                      for k, v in kv.items()})
+        _zero["enabled"] = 1
+
+
+def zero_counters(reset=False):
+    """Snapshot (optionally reset) the ZeRO update-sharding record.
+    Empty dict when no sharded step was built."""
+    with _state["lock"]:
+        out = dict(_zero)
+        if reset:
+            _zero.clear()
     return out
 
 

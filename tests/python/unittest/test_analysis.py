@@ -587,6 +587,41 @@ class TestDonation:
         assert fs[0].severity == "warning"
         assert not check_donation_aliasing(in_avals, out_avals, (0,))
 
+    # -- ISSUE 7: the ZERO donation shape ------------------------------
+    ZERO_ROLES = ("params", "opt_state_shard", "aux", "batch", "batch",
+                  "rng", "lr")
+
+    def test_train_partitioned_slot_donation_accepted(self):
+        """A ZERO step that chooses to donate its partitioned (dp, chunk)
+        slot blocks is contract-legal in train mode."""
+        assert not check_donation((0, 1), self.ZERO_ROLES, mode="train")
+        # the shipped tpu_step donates params only — also clean
+        assert not check_donation((0,), self.ZERO_ROLES, mode="train")
+
+    def test_train_batch_still_rejected_beside_partitioned_slots(self):
+        fs = check_donation((0, 1, 3), self.ZERO_ROLES, mode="train")
+        assert len(fs) == 1 and "batch" in fs[0].message
+        assert fs[0].severity == "error"
+
+    def test_serving_never_donates_partitioned_slots(self):
+        roles = ("batch", "opt_state_shard")
+        fs = check_donation((0, 1), roles, mode="serving")
+        assert len(fs) == 1 and "opt_state_shard" in fs[0].message
+
+    def test_aliasing_accepts_sharded_block_outputs(self):
+        """Donated partitioned slots alias their (dp, chunk) block
+        outputs; a donated arg whose blocks vanished from the outputs
+        still warns."""
+        blocks = [((8, 24), np.float32), ((8, 8), np.float32)]
+        in_avals = [[((17, 9), np.float32), ((5,), np.float32)],  # params
+                    list(blocks)]                                 # slots
+        out_avals = [((17, 9), np.float32), ((5,), np.float32)] + blocks
+        assert not check_donation_aliasing(in_avals, out_avals, (0, 1))
+        # slots donated but the program only returns full-shape params
+        fs = check_donation_aliasing(
+            in_avals, [((17, 9), np.float32), ((5,), np.float32)], (0, 1))
+        assert len(fs) == 1 and "arg 1" in fs[0].message
+
 
 # ----------------------------------------------------------------------
 # int8 program shapes (ISSUE 6): the quantized inference programs the

@@ -1,0 +1,357 @@
+"""ZeRO-style cross-replica sharded weight update (MXNET_TPU_ZERO,
+parallel/zero.py + optim_update.apply_update_sharded — arxiv 2004.13336).
+
+The headline contract is BITWISE: training under the sharded update must
+reproduce the replicated update bit for bit — for sgd / momentum / adam,
+in fp32 and in the bf16-compute/fp32-master multi-precision path, and
+through the MXNET_TPU_FUSED_OPTUPDATE lax tier — while every per-param
+optimizer slot lives as a (dp, chunk) block holding 1/dp of the padded
+leaf per replica. Checkpoints carry the layout and restore bit-exactly
+under a DIFFERENT replica count and across zero<->replicated runs.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.parallel.mesh import data_parallel_mesh
+from mxnet_tpu.parallel.tpu_step import DataParallelTrainStep
+from mxnet_tpu.parallel.zero import ZeroShardLayout, opt_slots_per_param
+
+DP = 8
+
+
+def _mlp():
+    # odd sizes everywhere: every leaf needs padding, several need more
+    # than one ALIGN block, fc2_bias (5) is smaller than dp
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Activation(
+                mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                      num_hidden=17, name="fc1"),
+                act_type="relu"),
+            num_hidden=5, name="fc2"),
+        name="softmax")
+
+
+def _batches(n, batch=32, feat=9, classes=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"data": rng.normal(0, 1, (batch, feat)).astype(np.float32),
+             "softmax_label": rng.randint(0, classes, (batch,)).astype(
+                 np.float32)}
+            for _ in range(n)]
+
+
+def _train(zero, optimizer="sgd", opt_hp=None, nsteps=4, compute_dtype=None,
+           fused=False, sym=None, shapes=None, batches=None, seed=3):
+    sym = sym if sym is not None else _mlp()
+    shapes = shapes or {"data": (32, 9), "softmax_label": (32,)}
+    batches = batches or _batches(nsteps)
+    mesh = data_parallel_mesh(jax.devices()[:DP])
+    step = DataParallelTrainStep(
+        sym, mesh, lr=0.1, wd=1e-4, clip_gradient=1.0,
+        optimizer=optimizer, opt_hp=dict(opt_hp or {"momentum": 0.9}),
+        compute_dtype=compute_dtype, fused_optupdate=fused, zero=zero,
+        # the baseline is the TRUE replicated update: the legacy
+        # annotation-based shard_update repositions the grad collectives
+        # itself and never promised bitwise equality
+        shard_update=False if not zero else None)
+    step.init(shapes, seed=seed)
+    for b in batches[:nsteps]:
+        step(b)
+    return step
+
+
+def _assert_params_bitwise(a, b, msg=""):
+    for n in a.params:
+        x, y = np.asarray(a.params[n]), np.asarray(b.params[n])
+        assert x.dtype == y.dtype and x.shape == y.shape, n
+        np.testing.assert_array_equal(
+            x.view(np.uint8), y.view(np.uint8),
+            err_msg="%s param %s not bit-identical" % (msg, n))
+
+
+OPTIMIZERS = [
+    ("sgd", {"momentum": 0.0}),
+    ("sgd", {"momentum": 0.9}),
+    ("adam", {"beta1": 0.9, "beta2": 0.999, "eps": 1e-8}),
+]
+
+
+@pytest.mark.parametrize("optimizer,hp", OPTIMIZERS,
+                         ids=["sgd", "sgd_momentum", "adam"])
+def test_bit_parity_fp32(optimizer, hp):
+    z = _train(True, optimizer, hp)
+    r = _train(False, optimizer, hp)
+    _assert_params_bitwise(z, r, "%s fp32" % optimizer)
+
+
+@pytest.mark.parametrize("optimizer,hp", OPTIMIZERS[1:],
+                         ids=["sgd_momentum", "adam"])
+def test_bit_parity_bf16_compute_fp32_master(optimizer, hp):
+    z = _train(True, optimizer, hp, compute_dtype="bfloat16")
+    r = _train(False, optimizer, hp, compute_dtype="bfloat16")
+    # masters stay fp32 on both sides — and bit-identical
+    assert all(v.dtype == jnp.float32 for v in z.params.values())
+    _assert_params_bitwise(z, r, "%s bf16-master" % optimizer)
+
+
+@pytest.mark.parametrize("optimizer,hp", OPTIMIZERS,
+                         ids=["sgd", "sgd_momentum", "adam"])
+def test_bit_parity_fused_optupdate_lax_tier(optimizer, hp):
+    """MXNET_TPU_FUSED_OPTUPDATE routing: the sharded step takes the
+    fused-lax tier (pallas_call is not auto-partitionable) and stays
+    bitwise with BOTH the fused replicated step and the non-fused
+    sharded step."""
+    zf = _train(True, optimizer, hp, fused=True)
+    rf = _train(False, optimizer, hp, fused=True)
+    zn = _train(True, optimizer, hp, fused=False)
+    _assert_params_bitwise(zf, rf, "%s fused" % optimizer)
+    _assert_params_bitwise(zf, zn, "%s fused-vs-treemap" % optimizer)
+
+
+# ---------------------------------------------------------------------------
+# layout mechanics
+# ---------------------------------------------------------------------------
+
+def test_layout_shapes_padding_and_bytes():
+    params = {"w": jnp.zeros((17, 9), jnp.float32),    # 153 -> chunk 24
+              "b": jnp.zeros((5,), jnp.float32),       # 5   -> chunk 8
+              "big": jnp.zeros((256, 64), jnp.float32)}  # 16384 -> 2048
+    lay = ZeroShardLayout.from_params(params, DP)
+    m = lay.meta_by_name
+    assert m["w"]["chunk"] == 24 and m["w"]["padded"] == 192
+    assert m["b"]["chunk"] == 8 and m["b"]["padded"] == 64
+    assert m["big"]["chunk"] == 2048 and m["big"]["padded"] == 16384
+    for meta in m.values():  # every chunk SIMD-aligned
+        assert meta["chunk"] % ZeroShardLayout.ALIGN == 0
+    padded = (192 + 64 + 16384) * 4
+    assert lay.padded_bytes() == padded
+    assert lay.param_bytes() == (153 + 5 + 16384) * 4
+    assert lay.per_replica_slot_bytes("sgd", momentum=0.9) == padded // DP
+    assert lay.per_replica_slot_bytes("adam") == 2 * padded // DP
+    assert lay.per_replica_slot_bytes("sgd", momentum=0.0) == 0
+    assert lay.replicated_slot_bytes("adam") == 2 * lay.param_bytes()
+    assert lay.comm_bytes() == {
+        "grad_allreduce_bytes": lay.param_bytes(),
+        "gather_bytes": padded}
+    assert opt_slots_per_param("sgd", opt_state={"mom": None}) == 0
+    assert opt_slots_per_param("sgd", opt_state={"mom": {}}) == 1
+
+
+def test_layout_host_pack_unpack_and_meta_roundtrip():
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.zeros((17, 9), jnp.float32)}
+    lay = ZeroShardLayout.from_params(params, DP)
+    arr = rng.normal(0, 1, (17, 9)).astype(np.float32)
+    blocks = lay.pack_host(arr, "w")
+    assert blocks.shape == (DP, 24)
+    assert np.all(blocks.reshape(-1)[153:] == 0)  # pad lanes zero
+    np.testing.assert_array_equal(lay.unpack_host(blocks, "w"), arr)
+    # meta survives serialization and reconstructs the same layout
+    lay2 = ZeroShardLayout.from_meta(lay.meta())
+    assert lay2.dp == DP and lay2.meta_by_name["w"] == lay.meta_by_name["w"]
+    # state-tree transforms: adam tree with scalar t passes through
+    state = {"m": {"w": blocks}, "v": {"w": blocks.copy()},
+             "t": np.int32(7)}
+    canon = lay.canonicalize_state(state)
+    np.testing.assert_array_equal(canon["m"]["w"], arr)
+    assert canon["t"] == 7
+    back = lay.shard_state(canon)
+    np.testing.assert_array_equal(back["v"]["w"], blocks)
+
+
+def test_state_is_sharded_on_device_and_counters_recorded():
+    profiler.zero_counters(reset=True)
+    step = _train(True, "adam",
+                  {"beta1": 0.9, "beta2": 0.999, "eps": 1e-8}, nsteps=1)
+    lay = step._zero_layout
+    for slot in ("m", "v"):
+        for name, leaf in step.opt_state[slot].items():
+            chunk = lay.meta_by_name[name]["chunk"]
+            assert leaf.shape == (DP, chunk), (slot, name)
+            shard_shapes = {tuple(s.data.shape)
+                            for s in leaf.addressable_shards}
+            assert shard_shapes == {(1, chunk)}, (slot, name, shard_shapes)
+    # adam's t stays a replicated scalar
+    assert step.opt_state["t"].shape == ()
+    c = profiler.zero_counters()
+    assert c["enabled"] == 1 and c["dp"] == DP
+    assert c["opt_state_bytes_per_replica"] == \
+        lay.per_replica_slot_bytes("adam")
+    assert c["opt_state_bytes_per_replica"] * DP == \
+        2 * lay.padded_bytes()
+    assert c["update_gather_bytes"] == lay.padded_bytes()
+
+
+def test_env_flag_enables_and_supersedes_shard_update(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_ZERO", "1")
+    step = _train(None, nsteps=1)  # zero=None -> env pickup
+    assert step.zero and step._zero_layout is not None
+    mom = step.opt_state["mom"]["fc1_weight"]
+    assert mom.ndim == 2 and mom.shape[0] == DP  # block form, not (16, 8)
+    monkeypatch.delenv("MXNET_TPU_ZERO")
+    off = _train(None, nsteps=1)
+    assert not off.zero and off._zero_layout is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: save under dp=8, restore under dp=4 (and zero<->replicated)
+# ---------------------------------------------------------------------------
+
+def _fit_module(n_devices, nepoch=1, zero=True, monkeypatch=None):
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (64, 6)).astype(np.float32)
+    y = rng.randint(0, 3, (64,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(sym, context=[mx.tpu(i) for i in range(n_devices)])
+    if monkeypatch is not None:
+        monkeypatch.setenv("MXNET_TPU_ZERO", "1" if zero else "0")
+    mod.fit(it, num_epoch=nepoch, kvstore="tpu_sync",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    return mod, it
+
+
+def _canonical_mom(step):
+    if getattr(step, "zero", False):
+        lay = step._zero_layout
+        return {n: lay.unpack_host(np.asarray(v), n)
+                for n, v in step.opt_state["mom"].items()}
+    return {n: np.asarray(v) for n, v in step.opt_state["mom"].items()}
+
+
+def test_checkpoint_roundtrip_under_changed_replica_count(tmp_path,
+                                                          monkeypatch):
+    mod8, _ = _fit_module(8, monkeypatch=monkeypatch)
+    step8 = mod8._fused_step
+    assert step8.zero and step8._zero_layout.dp == 8
+    path = str(tmp_path / "opt.states")
+    mod8.save_optimizer_states(path)
+    want = _canonical_mom(step8)
+
+    # restore into a dp=4 sharded run: blocks reassemble with the SAVED
+    # layout (dp=8) and re-partition with the live one (dp=4), bit-exact
+    mod4, it4 = _fit_module(4, monkeypatch=monkeypatch)
+    step4 = mod4._fused_step
+    assert step4.zero and step4._zero_layout.dp == 4
+    mod4.load_optimizer_states(path)
+    got = _canonical_mom(mod4._fused_step)
+    assert set(got) == set(want)
+    for n in want:
+        np.testing.assert_array_equal(
+            got[n].view(np.uint8), want[n].view(np.uint8),
+            err_msg="slot %s not bit-exact across replica counts" % n)
+    # and the restored run still steps (the pinned shardings accept it)
+    it4.reset()
+    mod4.fit(it4, num_epoch=1, kvstore="tpu_sync",
+             optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+
+def test_checkpoint_cross_restore_zero_and_replicated(tmp_path,
+                                                      monkeypatch):
+    # sharded save -> replicated (zero off) restore
+    mod8, _ = _fit_module(8, monkeypatch=monkeypatch)
+    want = _canonical_mom(mod8._fused_step)
+    path = str(tmp_path / "opt.states")
+    mod8.save_optimizer_states(path)
+    modr, _ = _fit_module(8, zero=False, monkeypatch=monkeypatch)
+    assert not modr._fused_step.zero
+    modr.load_optimizer_states(path)
+    got = _canonical_mom(modr._fused_step)
+    for n in want:
+        np.testing.assert_array_equal(got[n].view(np.uint8),
+                                      want[n].view(np.uint8), err_msg=n)
+    # replicated save -> sharded restore
+    path2 = str(tmp_path / "opt2.states")
+    modr.save_optimizer_states(path2)
+    modz, _ = _fit_module(8, monkeypatch=monkeypatch)
+    modz.load_optimizer_states(path2)
+    got2 = _canonical_mom(modz._fused_step)
+    for n in want:
+        np.testing.assert_array_equal(got2[n].view(np.uint8),
+                                      want[n].view(np.uint8), err_msg=n)
+
+
+# ---------------------------------------------------------------------------
+# lint: the sharded step sweeps clean under MXNET_TPU_LINT=1
+# ---------------------------------------------------------------------------
+
+def test_zero_step_lint_sweep_reports_zero_findings(monkeypatch):
+    """Acceptance gate: TPL201-TPL205 over the ZERO step — donation
+    contract (params-only donation with the opt_state_shard role), the
+    deferred jaxpr sweep, and donation aliasing — all clean."""
+    monkeypatch.setenv("MXNET_TPU_LINT", "1")
+    profiler.analysis_counters(reset=True)
+    step = _train(True, nsteps=1)
+    assert step.zero
+    c = profiler.analysis_counters()
+    assert c["programs_checked"] == 1
+    assert c["findings"] == 0, c
+
+
+# ---------------------------------------------------------------------------
+# ShardedTrainStep composition (dp x tp): zero alias
+# ---------------------------------------------------------------------------
+
+def test_sharded_step_zero_alias_and_env(monkeypatch):
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.sharded_step import ShardedTrainStep
+    from mxnet_tpu.parallel.mesh import get_mesh
+    from mxnet_tpu.base import MXNetError
+
+    mesh = get_mesh(dp=4, tp=2, pp=1, sp=1, devices=jax.devices()[:8])
+    rng = np.random.RandomState(0)
+    params = {"w1": rng.normal(0, 0.1, (8, 16)).astype(np.float32),
+              "w2": rng.normal(0, 0.1, (16, 4)).astype(np.float32)}
+    specs = {"w1": P(None, "tp"), "w2": P("tp", None)}
+
+    def loss_fn(p, batch):
+        return jnp.mean((jnp.tanh(batch["x"] @ p["w1"]) @ p["w2"]
+                         - batch["y"]) ** 2)
+
+    batches = [{"x": rng.normal(0, 1, (16, 8)).astype(np.float32),
+                "y": rng.normal(0, 1, (16, 4)).astype(np.float32)}
+               for _ in range(3)]
+
+    def train(**kw):
+        s = ShardedTrainStep(loss_fn, mesh, specs, optimizer="adam",
+                             lr=0.01, **kw)
+        s.init({k: v.copy() for k, v in params.items()})
+        for b in batches:
+            s(b)
+        return s
+
+    z = train(zero=True)
+    assert z.shard_update  # zero IS the shard_update transform here
+    # env alias: MXNET_TPU_ZERO turns it on when dp is real
+    monkeypatch.setenv("MXNET_TPU_ZERO", "1")
+    e = train()
+    assert e.shard_update
+    monkeypatch.delenv("MXNET_TPU_ZERO")
+    # the adam state of a tp-sharded param picks up 'dp' on a free axis
+    m = z.opt_state["m"]["w1"]
+    assert {tuple(s.data.shape) for s in m.addressable_shards} == {(2, 8)}
+    # composition still trains to the same weights as the replicated state
+    off = train(shard_update=False)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(z.params[k]),
+                                   np.asarray(off.params[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    # zero=False is "no ZeRO opinion": the auto-on default survives
+    zoff = ShardedTrainStep(loss_fn, mesh, specs, zero=False)
+    assert zoff.shard_update
+    # contradictory explicit flags are diagnosed, not silently dropped
+    with pytest.raises(MXNetError, match="contradictory"):
+        ShardedTrainStep(loss_fn, mesh, specs, zero=True,
+                         shard_update=False)
+    # a mesh without a real dp axis rejects explicit zero
+    mesh1 = get_mesh(dp=1, tp=8, pp=1, sp=1, devices=jax.devices()[:8])
+    with pytest.raises(MXNetError, match="zero=True"):
+        ShardedTrainStep(loss_fn, mesh1, specs, zero=True)
