@@ -37,7 +37,7 @@ PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy
     "infer": 900, "train_fp32": 800, "train_bf16": 600,
     "jax_baseline": 700, "flash": 700, "io_train": 600,
     "infer_int8": 600, "train_big_batch": 900, "flash_parity": 500,
-    "cost": 600, "serving": 600,
+    "cost": 600, "serving": 600, "serving_sla": 300,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -1123,6 +1123,192 @@ def _phase_serving():
     return out
 
 
+def _phase_serving_sla():
+    """SLA goodput under overload (ISSUE 8): a bursty OPEN-LOOP trace —
+    arrivals on a fixed schedule at 2x the engine's measured capacity,
+    regardless of completions — against a deadline a few step times wide.
+    The metric that matters at this layer is goodput-under-deadline, not
+    raw req/s: without load shedding an overloaded queue grows without
+    bound and EVERY request's latency collapses together; with the
+    deadline-driven batcher, hopeless requests fast-fail (`shed_rate`)
+    and the SERVED distribution's p99 stays inside the SLA. Reports
+    `goodput_under_sla` (served-within-deadline / submitted), `shed_rate`,
+    and client-side p50/p95/p99 of served requests, plus the per-model
+    latency histograms from profiler.latency_counters()."""
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import ModelServer, DeadlineExceeded
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+    # model sized so one bucket step lands in the tens-of-ms band on the
+    # host: the phase measures the SERVING tier's scheduling, and a
+    # millisecond-scale step makes the host's own scheduling noise (GIL
+    # handoffs, container stalls — tens of ms on the CPU fallback) LARGER
+    # than the step, so every latency percentile measures the host, not
+    # the batcher. A step that dwarfs the noise also keeps the worker
+    # inside XLA (GIL released) while the open-loop submitter sleeps
+    # between bursts.
+    hidden = 1024
+    indim = 128
+    bucket = 8
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="sla_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=hidden, name="sla_fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="sla_fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=(bucket, indim))
+    args = {n: mx.nd.array(rng.normal(0, 0.05, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    profiler.latency_counters(reset=True, prefix="serving.sla_model")
+    srv = ModelServer()
+    # shed_margin 2.5 on the decaying-MAX step estimate: a request
+    # dispatched right at the feasibility edge must survive a service-
+    # time SPIKE (GIL handoff, GC, scheduler), not the mean — budgeting
+    # the tail is what keeps served p99 INSIDE the SLA on a noisy host
+    # instead of pecking at the deadline from above
+    srv.register("sla_model", sym, args, ctx=mx.tpu(0), buckets=(bucket,),
+                 max_delay_ms=1.0, slack_factor=3.0, shed_margin=2.5,
+                 warmup_shapes={"data": (bucket, indim)})
+    eng = srv.engine("sla_model")
+
+    # measured capacity from the REAL async serving path AT SATURATION
+    # (worker thread, staging, coalescing — not the bare sync loop): time
+    # the drain of a deadline-less burst. The drain also primes the
+    # program cache's per-bucket EWMA under load — the shedder's signal.
+    xb = rng.uniform(-1, 1, (bucket, indim)).astype(np.float32)
+    x1 = xb[:1]
+    for _ in range(bucket * 2):  # warm: worker thread + program path
+        srv.predict_async("sla_model", {"data": x1}).result_wait(60.0)
+    n_cal = bucket * 20
+    tic = time.monotonic()
+    cal = [srv.predict_async("sla_model", {"data": x1})
+           for _ in range(n_cal)]
+    for f in cal:
+        f.result_wait(60.0)
+    capacity_rps = n_cal / (time.monotonic() - tic)
+    batch_s = bucket / capacity_rps  # saturated per-batch service time
+    gap_s = max(batch_s / 2.0, 1.5e-3)  # floor: the submitter must sleep
+
+    def open_loop(n_bursts, deadline_ms):
+        fs = []
+        start = time.monotonic()
+        for b in range(n_bursts):
+            target = start + b * gap_s
+            now = time.monotonic()
+            if target > now:
+                time.sleep(target - now)
+            for _ in range(bucket):
+                fs.append(srv.predict_async("sla_model", {"data": x1},
+                                            deadline_ms=deadline_ms))
+        return fs, start
+
+    # PILOT overload (deadline-less, ~0.4 s at the 2x schedule): sustained
+    # submit/serve thread interleaving is what produces this host's
+    # service-time SPIKES (GIL handoffs on the 1-core fallback), and the
+    # decaying-max tail estimate must learn that contended profile BEFORE
+    # an SLA is set against it — an SLA below the host's own scheduling
+    # tail is unservable by any batcher
+    pilot, _ = open_loop(max(12, int(0.4 / gap_s)), None)
+    for f in pilot:
+        f.result_wait(60.0)
+    step_s = eng.step_time(bucket) or batch_s
+    tail_s = eng._cache.step_time_tail(bucket) or step_s
+    # SLA floor: ~3x the host's worst scheduling stall, or a request
+    # selected with honest slack still resolves late when a stall lands
+    # on its batch and p99 pecks over the deadline from above. The 1-core
+    # CPU fallback's measured stall tail is 30-70 ms (GIL handoffs +
+    # container scheduler), hence 200 ms there; a real accelerator host
+    # serves the tight 25 ms floor.
+    sla_floor_ms = 25.0 if on_tpu else 200.0
+    sla_ms = max(8.0 * batch_s * 1e3, 2.5 * 1.5 * tail_s * 1e3,
+                 sla_floor_ms)
+    base = eng.stats()                    # pilot counters, subtracted below
+    profiler.latency_counters(reset=True, prefix="serving.sla_model")
+
+    # measured trace: open-loop bursty arrivals at 2x capacity — bursts of
+    # `bucket` back-to-back requests, burst starts spaced
+    # bucket/(2*capacity) — long enough (>= 10 SLA windows, capped at
+    # 2000 requests) that the backlog a 2x overload necessarily builds
+    # crosses the deadline and shedding MUST engage (an open loop never
+    # slows down to match completions)
+    # requests carry an INTERNAL deadline 15% tighter than the external
+    # SLA (SRE-style error budget): under saturation EDF serves everything
+    # just-in-time, pinning the served distribution AT the shed edge — an
+    # edge at 0.85x SLA puts p99 ~0.85x SLA with the remaining 15% as the
+    # guard band for scheduling stalls the tail estimate hasn't seen
+    duration_s = max(0.4, 10.0 * sla_ms / 1e3)
+    n_bursts = max(12, min(2000 // bucket, int(duration_s / gap_s)))
+    futs, t0 = open_loop(n_bursts, 0.85 * sla_ms)
+    submit_wall_s = time.monotonic() - t0   # the offered-rate window ends
+    submitted = len(futs)                   # here, not after the drain
+    # steady-state window: the decaying-max tail estimate (the shedder's
+    # spike budget) needs the first batches of the trace to LEARN this
+    # host's spike profile, so SLO percentiles follow standard practice
+    # and exclude the ramp; full-trace accounting and p99 are reported
+    # alongside so nothing hides
+    ramp = submitted // 4
+    served, shed, errors, lat_all, lat_steady = 0, 0, 0, [], []
+    for i, f in enumerate(futs):
+        try:
+            f.result_wait(PHASE_BUDGET_S["serving_sla"])
+            served += 1
+            ms = (f.t_done - f.t_submit) * 1e3
+            lat_all.append(ms)
+            if i >= ramp:
+                lat_steady.append(ms)
+        except DeadlineExceeded:
+            shed += 1
+        except Exception:
+            errors += 1
+    wall_s = time.monotonic() - t0
+    lat_all.sort()
+    lat_steady.sort()
+
+    def pct(vals, q):
+        return round(vals[min(int(q * len(vals)), len(vals) - 1)], 2) \
+            if vals else None
+
+    within = sum(1 for v in lat_all if v <= sla_ms)
+    if not lat_steady:      # everything served landed in the ramp: judge
+        lat_steady = lat_all  # on the full trace rather than report None
+    st = eng.stats()
+    out = {
+        "sla_ms": round(sla_ms, 2),
+        "sla_step_ms": round(step_s * 1e3, 3),
+        "sla_capacity_rps": round(capacity_rps, 1),
+        "sla_offered_rps": round(submitted / max(submit_wall_s, 1e-9), 1),
+        "sla_submitted": submitted,
+        "sla_served": served,
+        "sla_shed": shed,
+        "sla_errors": errors,
+        "goodput_under_sla": round(within / float(submitted), 3),
+        "shed_rate": round(shed / float(submitted), 3),
+        "sla_p50_ms": pct(lat_steady, 0.50),
+        "sla_p95_ms": pct(lat_steady, 0.95),
+        "sla_p99_ms": pct(lat_steady, 0.99),
+        "sla_p99_within_sla": bool(lat_steady)
+        and pct(lat_steady, 0.99) <= sla_ms,
+        "sla_p99_full_trace_ms": pct(lat_all, 0.99),
+        "sla_overload_factor": round(
+            (submitted / max(submit_wall_s, 1e-9)) / capacity_rps, 2),
+        "sla_accounting_exact": served + shed + errors == submitted,
+        "sla_early_dispatches": st["early_dispatches"]
+        - base["early_dispatches"],
+        "sla_batches": st["batches_run"] - base["batches_run"],
+        "sla_step_tail_ms": st["step_tail_ms"],
+        "sla_latency_counters": profiler.latency_counters(
+            prefix="serving.sla_model"),
+    }
+    srv.stop()
+    return out
+
+
 def _phase_io_train():
     """End-to-end input-pipeline + train throughput: synthetic JPEG .rec ->
     C++ ImageRecordIter (sharded read, threaded decode/augment, prefetch;
@@ -1223,6 +1409,7 @@ PHASES = {
     "flash_parity": _phase_flash_parity,
     "cost": _phase_cost,
     "serving": _phase_serving,
+    "serving_sla": _phase_serving_sla,
 }
 
 

@@ -89,6 +89,22 @@ def stage_multichip(_):
         env=env, cwd=ROOT)
 
 
+def stage_serving_smoke(_):
+    """Non-slow serving-tier gate (ISSUE 8): two models on one
+    ModelServer — solo-engine isolation, zero-compile rollover, and a
+    forced-overload deadline trace whose served + shed accounting must
+    sum to submitted — then tpulint (TPL101-TPL105) over the serving
+    modules."""
+    rc = subprocess.call(
+        [sys.executable, os.path.join(ROOT, "tools", "serving_smoke.py")],
+        env=_env_cpu_mesh(1), cwd=ROOT)
+    if rc != 0:
+        return rc
+    return subprocess.call(
+        [sys.executable, "-m", "mxnet_tpu.analysis.lint",
+         os.path.join("mxnet_tpu", "serving")], cwd=ROOT)
+
+
 def stage_bench_smoke(_):
     """bench.py CPU fallback path must emit its JSON line."""
     env = _env_cpu_mesh(1)
@@ -106,6 +122,7 @@ STAGES = [
     ("cpp", stage_cpp),
     ("zero_smoke", stage_zero_smoke),
     ("multichip", stage_multichip),
+    ("serving_smoke", stage_serving_smoke),
     ("bench_smoke", stage_bench_smoke),
 ]
 

@@ -211,7 +211,7 @@ def quantize_graph(sym, excluded_sym_names=(), th_dict=None,
     return Symbol([fp32_in(p) for p in sym._outputs])
 
 
-def quantize_params(qsym, arg_params, per_channel=True):
+def quantize_params(qsym, arg_params, per_channel=True, partial=False):
     """Fill the offline-quantized arguments of a `quantize_graph` output.
 
     For every `<name>_quantize` argument the fp32 param `<name>` is
@@ -223,12 +223,23 @@ def quantize_params(qsym, arg_params, per_channel=True):
     and ``per_channel=False`` use one per-tensor scale. Other arguments
     pass through. This is the ONE place weights quantize: the folded int8
     arrays are ordinary arguments afterwards (staged to device once, reused
-    by every request/batch). Returns the new arg dict."""
+    by every request/batch). Returns the new arg dict.
+
+    ``partial=True`` is the hot-swap form (serving engine rollover): a
+    ``_quantize`` arg whose fp32 base is absent from ``arg_params`` is
+    skipped instead of raising — already-folded int8 triples present in
+    ``arg_params`` pass through — so a checkpoint carrying only a subset
+    of the weights re-folds exactly the weights it carries."""
     from ..ndarray.ndarray import array as nd_array
     out = {}
+    folded = set()
     for name in qsym.list_arguments():
         if name.endswith("_quantize"):
             base = name[:-len("_quantize")]
+            if partial and base not in arg_params:
+                if name in arg_params:  # pre-folded upstream: pass through
+                    out[name] = arg_params[name]
+                continue
             v = arg_params[base]
             v = v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
             if per_channel and v.ndim >= 2:
@@ -243,8 +254,14 @@ def quantize_params(qsym, arg_params, per_channel=True):
             out[name] = nd_array(q)
             out[base + "_min"] = nd_array(-absmax)
             out[base + "_max"] = nd_array(absmax)
+            folded.add(base)
         elif name.endswith("_min") or name.endswith("_max"):
-            continue  # filled alongside their _quantize partner
+            # filled alongside their _quantize partner; under partial a
+            # caller-supplied range whose partner we did NOT fold here
+            # passes through (pre-folded triple)
+            if partial and name[:-4] not in folded and name in arg_params:
+                out[name] = arg_params[name]
+            continue
         elif name in arg_params:
             out[name] = arg_params[name]
     return out

@@ -654,10 +654,14 @@ class Module(BaseModule):
             aux_params = dict(exe0.aux_dict)
             if self._serving_engine is None:
                 from ..serving import InferenceEngine
+                # named engine: Module predicts record per-model latency
+                # histograms (profiler.latency_counters "serving.<name>")
+                # alongside ModelServer-registered models
                 self._serving_engine = InferenceEngine(
                     self._symbol, arg_params, aux_params,
                     ctx=self._context[0],
-                    buckets=(self._data_shapes[0].shape[0],))
+                    buckets=(self._data_shapes[0].shape[0],),
+                    name=getattr(self._symbol, "name", None) or "module")
             else:
                 self._serving_engine.update_params(arg_params, aux_params)
             return self._serving_engine

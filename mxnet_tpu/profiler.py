@@ -17,7 +17,8 @@ __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
            "record_pipeline_event", "pipeline_counters",
            "record_analysis_check", "record_analysis_finding",
            "analysis_counters", "record_kernel_roofline", "kernel_counters",
-           "record_zero_sharding", "zero_counters"]
+           "record_zero_sharding", "zero_counters",
+           "record_latency", "latency_counters"]
 
 _state = {"running": False, "filename": "profile.json", "events": [],
           "jax_trace_dir": None, "lock": threading.Lock()}
@@ -214,6 +215,100 @@ def zero_counters(reset=False):
         out = dict(_zero)
         if reset:
             _zero.clear()
+    return out
+
+
+# ----------------------------------------------------------------------
+# serving latency histograms (ISSUE 8): always-on fixed log-spaced
+# buckets, same style as the pipeline/kernel/zero counter families —
+# plain adds under the state lock, no profiler session, snapshotted by
+# the bench SLA phase, ModelServer.stats(), and the CI serving smoke.
+# Keys are free-form; the serving tier records three per model —
+# `serving.<model>.queue` (submit -> dispatch), `serving.<model>.device`
+# (dispatch -> outputs ready) and `serving.<model>.total` — so tail
+# latency decomposes into queue wait vs device time per model.
+# ----------------------------------------------------------------------
+# Buckets: 10 per decade from 1 µs (1e3 ns) to ~17 min (1e12 ns), fixed
+# at import so every snapshot is mergeable. Percentiles come from the
+# histogram (upper bucket edge: a conservative <= 26% overestimate at 10
+# buckets/decade); mean/max are exact (sum/max tracked per key).
+_LAT_MIN_EXP = 3
+_LAT_MAX_EXP = 12
+_LAT_PER_DECADE = 10
+_LAT_EDGES_NS = tuple(
+    10.0 ** (_LAT_MIN_EXP + i / float(_LAT_PER_DECADE))
+    for i in range((_LAT_MAX_EXP - _LAT_MIN_EXP) * _LAT_PER_DECADE + 1))
+_latency = {}
+
+
+def _lat_bucket_index(ns):
+    import math
+    if ns <= _LAT_EDGES_NS[0]:
+        return 0
+    if ns >= _LAT_EDGES_NS[-1]:
+        return len(_LAT_EDGES_NS) - 1
+    return min(int(math.ceil((math.log10(ns) - _LAT_MIN_EXP)
+                             * _LAT_PER_DECADE)),
+               len(_LAT_EDGES_NS) - 1)
+
+
+def record_latency(key, ns):
+    """Record one latency observation (nanoseconds) under `key` into the
+    fixed log-spaced histogram. Always on; one dict update + one list
+    increment under the state lock."""
+    ns = float(ns)
+    if ns < 0:
+        return
+    idx = _lat_bucket_index(ns)
+    with _state["lock"]:
+        h = _latency.get(key)
+        if h is None:
+            h = _latency[key] = {
+                "counts": [0] * len(_LAT_EDGES_NS),
+                "count": 0, "sum_ns": 0.0, "max_ns": 0.0}
+        h["counts"][idx] += 1
+        h["count"] += 1
+        h["sum_ns"] += ns
+        h["max_ns"] = max(h["max_ns"], ns)
+
+
+def _lat_percentile_ns(h, q):
+    """q in [0,1] -> upper edge (ns) of the bucket where the cumulative
+    count crosses q — a conservative (never-underestimating) percentile."""
+    target = q * h["count"]
+    cum = 0
+    for i, c in enumerate(h["counts"]):
+        cum += c
+        if cum >= target and c:
+            return _LAT_EDGES_NS[i]
+    return h["max_ns"]
+
+
+def latency_counters(reset=False, prefix=None):
+    """Snapshot (optionally reset) the latency histograms as
+    key -> {count, p50_ms, p95_ms, p99_ms, mean_ms, max_ms}. `prefix`
+    filters keys (e.g. `serving.resnet`) without resetting others; reset
+    with a prefix clears only the matching keys."""
+    out = {}
+    with _state["lock"]:
+        for key, h in _latency.items():
+            if prefix is not None and not key.startswith(prefix):
+                continue
+            if not h["count"]:
+                continue
+            out[key] = {
+                "count": h["count"],
+                "p50_ms": round(_lat_percentile_ns(h, 0.50) / 1e6, 3),
+                "p95_ms": round(_lat_percentile_ns(h, 0.95) / 1e6, 3),
+                "p99_ms": round(_lat_percentile_ns(h, 0.99) / 1e6, 3),
+                "mean_ms": round(h["sum_ns"] / h["count"] / 1e6, 3),
+                "max_ms": round(h["max_ns"] / 1e6, 3)}
+        if reset:
+            if prefix is None:
+                _latency.clear()
+            else:
+                for key in [k for k in _latency if k.startswith(prefix)]:
+                    del _latency[key]
     return out
 
 

@@ -1,19 +1,26 @@
-"""Serving subsystem — dynamic batching + bucketed AOT program cache +
-donated async inference (docs/faq/serving.md).
+"""Serving subsystem — multi-model registry, SLA-aware dynamic batching,
+bucketed AOT program caches, and zero-downtime rollover
+(docs/faq/serving.md).
 
 The TPU-native analog of the reference dependency engine's op bulking
 (MXNet paper §4) and of TF-Serving's compiled-graph serving layer
 (arXiv:1605.08695): request shapes round up into a small set of batch
 buckets, each bucket's XLA program compiles once (ahead of time at warmup,
-persisted across restarts via MXNET_TPU_COMPILE_CACHE), and a dynamic
-micro-batcher coalesces concurrent requests into full buckets.
+persisted across restarts via MXNET_TPU_COMPILE_CACHE), a dynamic
+micro-batcher coalesces concurrent requests earliest-deadline-first —
+shedding requests whose deadline budget queue wait already consumed
+(`DeadlineExceeded`) so served-request p99 stays bounded under overload —
+and a `ModelServer` hosts many named model/version entries with
+least-loaded replica fan-out and live weight rollover.
 
-    from mxnet_tpu.serving import InferenceEngine
+    from mxnet_tpu.serving import InferenceEngine, ModelServer
 """
 from .program_cache import BucketedProgramCache, DEFAULT_BUCKETS, bucket_for
-from .batcher import DynamicBatcher, pad_to_bucket, default_max_batch
+from .batcher import (DynamicBatcher, DeadlineExceeded, pad_to_bucket,
+                      default_max_batch)
 from .engine import InferenceEngine
+from .server import ModelServer
 
-__all__ = ["InferenceEngine", "BucketedProgramCache", "DynamicBatcher",
-           "DEFAULT_BUCKETS", "bucket_for", "pad_to_bucket",
-           "default_max_batch"]
+__all__ = ["InferenceEngine", "ModelServer", "BucketedProgramCache",
+           "DynamicBatcher", "DeadlineExceeded", "DEFAULT_BUCKETS",
+           "bucket_for", "pad_to_bucket", "default_max_batch"]

@@ -22,16 +22,19 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray, zeros as _nd_zeros, _new_from_jax
 from .program_cache import BucketedProgramCache, DEFAULT_BUCKETS
 from .batcher import DynamicBatcher
 
 __all__ = ["InferenceEngine"]
+
+_QSUF = "_quantize"
 
 
 class InferenceEngine:
@@ -62,22 +65,59 @@ class InferenceEngine:
         CALLING thread at :meth:`flush`, through the same coalesce/pad/
         dispatch path (deterministic; what benchmarks on single-core
         hosts and tests use).
+    name : str, optional
+        Model name for observability: served requests record
+        ``serving.<name>.{queue,device,total}`` latency histograms
+        (``profiler.latency_counters()``); anonymous engines record under
+        plain ``serving``. The ModelServer registry names every engine.
+    default_deadline_ms : float, optional
+        Deadline budget applied to ``predict_async`` requests that carry
+        none (default: the ``MXNET_SERVING_DEADLINE_MS`` env var; unset
+        means no deadline — requests never shed).
+    slack_factor : float, optional
+        Early-dispatch safety multiplier on the measured bucket step time
+        (see batcher.py; default ``MXNET_SERVING_SLACK_FACTOR`` = 1.5).
+    shed_margin : float, optional
+        Shed-feasibility multiplier on the measured step time (batcher.py;
+        default 1.0 — raise toward ``slack_factor`` when service-time
+        spikes must not leak served requests past their deadline).
     """
 
     def __init__(self, symbol, arg_params, aux_params=None, ctx=None,
                  buckets=DEFAULT_BUCKETS, donate="auto", max_batch=None,
-                 max_delay_ms=2.0, async_worker=True):
+                 max_delay_ms=2.0, async_worker=True, name=None,
+                 default_deadline_ms=None, slack_factor=None,
+                 shed_margin=1.0):
         import jax
         self._symbol = symbol
         self._ctx = (ctx if isinstance(ctx, Context)
                      else Context(ctx) if ctx is not None
                      else current_context())
         self._device = self._ctx.jax_device
+        self.name = name
+        self._lat_key = "serving.%s" % name if name else "serving"
+        if default_deadline_ms is None:
+            default_deadline_ms = get_env("MXNET_SERVING_DEADLINE_MS",
+                                          None, float)
+        self._default_deadline_ms = default_deadline_ms
 
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         arg_params = dict(arg_params or {})
         aux_params = dict(aux_params or {})
+        # quantized graphs (contrib.quantization.quantize_graph) carry
+        # their weights as offline-folded `<w>_quantize`/`_min`/`_max`
+        # int8 triples. Accept raw fp32 weights here too by folding them
+        # through quantize_params ONCE — the same path update_params uses
+        # for hot-swap, so an engine built straight from a training
+        # checkpoint serves correctly quantized weights.
+        self._qnames = [n for n in arg_names if n.endswith(_QSUF)]
+        if self._qnames and any(n[:-len(_QSUF)] in arg_params
+                                and n not in arg_params
+                                for n in self._qnames):
+            from ..contrib.quantization import quantize_params
+            arg_params = quantize_params(symbol, arg_params,
+                                         per_channel=True, partial=True)
         self._param_names = [n for n in arg_names if n in arg_params]
         self._input_names = [n for n in arg_names if n not in arg_params]
         if not self._input_names:
@@ -125,7 +165,17 @@ class InferenceEngine:
         self._batcher = DynamicBatcher(self._run_padded, self._cache.buckets,
                                        max_batch=max_batch,
                                        max_delay_ms=max_delay_ms,
-                                       autostart=async_worker)
+                                       autostart=async_worker,
+                                       step_time=self._cache.step_time,
+                                       step_time_tail=(
+                                           self._cache.step_time_tail),
+                                       slack_factor=slack_factor,
+                                       shed_margin=shed_margin,
+                                       lat_key=self._lat_key,
+                                       observe_step=self._observe_batch)
+        self._step_probe = 0    # accelerator step-time re-sampling cadence
+        self._compiles_seen = 0  # compile-bearing batches excluded from
+        #                          the warm step-time estimate
         self._templates = {}        # input name -> (shape tuple, np dtype)
         self._lock = threading.Lock()
         # checkpoint hot-swap state (reload_from)
@@ -174,13 +224,72 @@ class InferenceEngine:
         """Swap the serving weights in place. No recompilation: the cached
         programs take params as runtime arguments, so this is a device_put
         per (changed) array — shape/dtype changes transparently key new
-        programs on next use."""
-        for n, v in (arg_params or {}).items():
-            if n in self._params:
-                self._params[n] = self._to_device(v)
-        for n, v in (aux_params or {}).items():
-            if n in self._aux:
-                self._aux[n] = self._to_device(v)
+        programs on next use.
+
+        Quantized engines: raw fp32 weights (base-named, what a training
+        checkpoint carries) are re-folded through ``quantize_params``
+        before staging — the staged per-channel int8 buffers and their
+        range arrays swap TOGETHER, so a `reload_from` rollover keeps
+        serving correctly quantized weights. A wrong-dtype buffer supplied
+        directly under a ``<w>_quantize`` name is rejected instead of
+        silently keying a new wrong-scale program."""
+        arg_params = dict(arg_params or {})
+        if self._qnames and arg_params:
+            arg_params = self._fold_for_swap(arg_params)
+        # stage everything FIRST, then publish as one reference swap: a
+        # concurrently dispatching batch reads self._params once per call
+        # and must see either the old weight set or the new one, never a
+        # mix (for a quantized graph a new int8 weight read against the
+        # old scale serves wrong-magnitude outputs during every rollover
+        # under load)
+        staged = {n: self._to_device(v) for n, v in arg_params.items()
+                  if n in self._params}
+        if staged:
+            new_params = dict(self._params)
+            new_params.update(staged)
+            self._params = new_params
+        staged_aux = {n: self._to_device(v)
+                      for n, v in (aux_params or {}).items()
+                      if n in self._aux}
+        if staged_aux:
+            new_aux = dict(self._aux)
+            new_aux.update(staged_aux)
+            self._aux = new_aux
+
+    def _fold_for_swap(self, arg_params):
+        """Hot-swap normalization for a quantized graph (the ISSUE-8
+        rollover bugfix): re-fold raw fp32 weights, validate pre-folded
+        int8 ones. Returns the dict safe to stage over self._params."""
+        for qn in self._qnames:
+            if qn in arg_params and qn[:-len(_QSUF)] not in arg_params:
+                dt = getattr(arg_params[qn], "dtype", None)
+                if dt is None or _np.dtype(dt) != _np.int8:
+                    raise MXNetError(
+                        "update_params: %s must be int8 (got %s) — pass "
+                        "the raw fp32 weight %r instead and the engine "
+                        "re-folds it through quantize_params"
+                        % (qn, dt, qn[:-len(_QSUF)]))
+        if not any(qn[:-len(_QSUF)] in arg_params for qn in self._qnames):
+            return arg_params  # already folded (or untouched weights)
+        # per-channel layout is a property of the STAGED ranges, not the
+        # incoming dict: re-fold with whatever layout this engine compiled
+        per_channel = any(
+            tuple(self._params[qn[:-len(_QSUF)] + "_min"].shape) != (1,)
+            for qn in self._qnames
+            if qn[:-len(_QSUF)] + "_min" in self._params)
+        from ..contrib.quantization import quantize_params
+        folded = quantize_params(self._symbol, arg_params,
+                                 per_channel=per_channel, partial=True)
+        for n, v in folded.items():
+            if n in self._params and tuple(_np.shape(v)) != \
+                    tuple(self._params[n].shape):
+                raise MXNetError(
+                    "update_params: re-folded %s has shape %s but the "
+                    "engine staged %s — a layout change needs a new "
+                    "engine, not a hot-swap"
+                    % (n, tuple(_np.shape(v)),
+                       tuple(self._params[n].shape)))
+        return folded
 
     # ------------------------------------------------------------------
     # checkpoint hot-swap
@@ -436,20 +545,55 @@ class InferenceEngine:
         caller's core, nothing to overlap) each output materializes to
         host ONCE per batch instead — numpy slicing then hands every
         request a free view, where device-array slicing would dispatch a
-        separate XLA slice op per request per output."""
+        separate XLA slice op per request per output.
+
+        On the CPU backend the batcher's `observe_step` hook feeds the
+        step-time EWMA/tail with each batch's FULL dispatch wall time
+        (see :meth:`_observe_batch`); on accelerators — where the hook
+        would only see async enqueue time — the first few (and every
+        64th) executions per bucket block here for a real device-time
+        sample instead. Steady state stays fully async."""
+        import jax
+        bucket = int(next(iter(padded.values())).shape[0]) if padded else n
+        compiles_before = self._cache.compiles
+        tic = time.monotonic()
         outs = self._cache.run(self._stage(padded), self._params,
                                self._aux, self._rng())
         if self._device.platform == "cpu":
             # tpulint: allow-host-sync CPU backend: one deliberate batch materialization, slices become free views
             return [_np.asarray(o) for o in outs]
+        if self._cache.compiles == compiles_before:
+            self._step_probe += 1
+            if (self._cache.step_samples(bucket) < 3
+                    or self._step_probe % 64 == 0):
+                jax.block_until_ready(outs)
+                self._cache.observe_step_time(bucket,
+                                              time.monotonic() - tic)
         return list(outs)
+
+    def _observe_batch(self, bucket, seconds):
+        """Batcher `observe_step` hook: fold one batch's dispatch->
+        delivery wall time into the per-bucket step estimate (CPU
+        backend only — on accelerators delivery is an async enqueue and
+        `_run_padded` samples real device time instead). Compile-bearing
+        batches are excluded: the estimate is the WARM step."""
+        if self._device.platform != "cpu":
+            return
+        compiles = self._cache.compiles
+        if compiles != self._compiles_seen:
+            self._compiles_seen = compiles
+            return
+        self._cache.observe_step_time(bucket, seconds)
 
     def predict(self, data):
         """Synchronous inference for a batch of any size: pad to the
         nearest bucket, run the cached program, return unpadded NDArray
         outputs (row-for-row equal to an unbatched run — batcher.py has
         the padding-correctness argument). Device-resident inputs stay on
-        device end to end (padding runs device-side)."""
+        device end to end (padding runs device-side). Dispatch wall time
+        records under ``<lat_key>.sync`` (async-dispatch enqueue time on
+        accelerators, full service time on CPU)."""
+        tic = time.monotonic()
         arrays, n = self._normalize_request(data, keep_device=True)
         bucket = self._cache.bucket_for(n)
         staged = {}
@@ -457,17 +601,31 @@ class InferenceEngine:
             padded = self._pad_rows(arr, n, bucket)
             staged[name] = self._stage_one(padded, fresh=padded is not arr)
         outs = self._cache.run(staged, self._params, self._aux, self._rng())
+        from .. import profiler as _prof
+        _prof.record_latency(self._lat_key + ".sync",
+                             (time.monotonic() - tic) * 1e9)
         return [_new_from_jax(o[:n], ctx=self._ctx) for o in outs]
 
-    def predict_async(self, data):
+    def predict_async(self, data, deadline_ms=None, priority=0):
         """Queue a request into the dynamic micro-batcher; returns a
-        future-like handle (``.result_wait(timeout)`` / ``.done()``).
-        Concurrent requests coalesce into shared bucket-padded executable
-        calls. Results are per-request-unpadded DEVICE arrays riding JAX
-        async dispatch — ``np.asarray`` (or ``jax.block_until_ready``)
-        them to materialize on host."""
+        future-like handle (``.result_wait(timeout)`` / ``.done()`` /
+        ``.add_done_callback(fn)``). Concurrent requests coalesce into
+        shared bucket-padded executable calls. Results are per-request-
+        unpadded DEVICE arrays riding JAX async dispatch — ``np.asarray``
+        (or ``jax.block_until_ready``) them to materialize on host.
+
+        ``deadline_ms`` (default: the engine's ``default_deadline_ms``)
+        is the end-to-end latency budget: batch formation is earliest-
+        deadline-first, a tight budget dispatches a partial batch early,
+        and a request whose budget queue wait already consumed fast-fails
+        with :class:`~.batcher.DeadlineExceeded` instead of being served
+        late (load shedding — see docs/faq/serving.md). ``priority``
+        (higher = more urgent) orders above the deadline."""
         host, _ = self._normalize_request(data)
-        return self._batcher.submit(host)
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms
+        return self._batcher.submit(host, deadline_ms=deadline_ms,
+                                    priority=priority)
 
     def flush(self):
         """Drain any queued async requests on the calling thread."""
@@ -495,11 +653,18 @@ class InferenceEngine:
     def misses(self):
         return self._cache.misses
 
+    def step_time(self, bucket):
+        """Measured compile-warm step time (seconds) for `bucket`, or None
+        while unmeasured — the SLA batcher's shed/early-dispatch signal."""
+        return self._cache.step_time(bucket)
+
     def stats(self):
-        """Compile/hit/miss counters plus batcher coalescing stats — the
-        serving observability surface (bench.py's serving phase and
-        tools/serve_bench.py report exactly this dict)."""
+        """Compile/hit/miss counters plus batcher coalescing/SLA stats —
+        the serving observability surface (bench.py's serving phases,
+        ModelServer.stats() and tools/serve_bench.py report this dict)."""
         out = self._cache.stats()
         out.update(self._batcher.stats())
         out["buckets"] = list(self._cache.buckets)
+        if self.name is not None:
+            out["name"] = self.name
         return out

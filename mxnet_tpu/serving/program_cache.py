@@ -126,6 +126,15 @@ class BucketedProgramCache:
         self.compiles = 0            # programs built (AOT or on demand)
         self.hits = 0                # executions served by a cached program
         self.misses = 0              # executions that had to compile first
+        # per-bucket measured compile-warm step time: EWMA mean + sample
+        # count + a decaying-max TAIL. The engine feeds this from real
+        # timed executions; the SLA batcher reads the mean for early
+        # dispatch and the tail for the shed-feasibility test — on a
+        # contended host the mean says what a step usually costs while
+        # the tail says what the request at the deadline edge must
+        # survive (GC pause, GIL handoff, scheduler hiccup). Compile-
+        # bearing samples are the caller's job to exclude.
+        self._step_time = {}         # bucket -> [ewma_s, n_samples, tail_s]
         configure_compile_cache()    # MXNET_TPU_COMPILE_CACHE, idempotent
 
     # ------------------------------------------------------------------
@@ -139,6 +148,47 @@ class BucketedProgramCache:
 
     def bucket_for(self, n):
         return bucket_for(n, self._buckets)
+
+    # ------------------------------------------------------------------
+    # measured step time (the SLA batcher's shed/early-dispatch signal)
+    # ------------------------------------------------------------------
+    def observe_step_time(self, bucket, seconds):
+        """Fold one measured compile-warm execution time for `bucket`:
+        EWMA mean (alpha 0.3 — tracks host drift within a few samples
+        while damping single-run noise) and decaying max tail (a spike
+        registers immediately and fades at 0.85/sample once conditions
+        improve)."""
+        seconds = float(seconds)
+        if seconds <= 0:
+            return
+        with self._lock:
+            rec = self._step_time.get(bucket)
+            if rec is None:
+                self._step_time[bucket] = [seconds, 1, seconds]
+            else:
+                rec[0] += 0.3 * (seconds - rec[0])
+                rec[1] += 1
+                rec[2] = max(seconds, rec[2] * 0.85)
+
+    def step_time(self, bucket):
+        """EWMA mean compile-warm step time for `bucket` in seconds, or
+        None while unmeasured."""
+        with self._lock:
+            rec = self._step_time.get(bucket)
+            return rec[0] if rec is not None else None
+
+    def step_time_tail(self, bucket):
+        """Decaying-max step time for `bucket` (seconds), or None while
+        unmeasured — what the shed-feasibility test budgets for."""
+        with self._lock:
+            rec = self._step_time.get(bucket)
+            return rec[2] if rec is not None else None
+
+    def step_samples(self, bucket):
+        """How many timed executions have been folded for `bucket`."""
+        with self._lock:
+            rec = self._step_time.get(bucket)
+            return rec[1] if rec is not None else 0
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -280,6 +330,12 @@ class BucketedProgramCache:
         return prog(batch_vals, param_vals, aux_vals, rng)
 
     def stats(self):
+        with self._lock:
+            step_ms = {str(b): round(rec[0] * 1e3, 3)
+                       for b, rec in sorted(self._step_time.items())}
+            tail_ms = {str(b): round(rec[2] * 1e3, 3)
+                       for b, rec in sorted(self._step_time.items())}
         return {"compiles": self.compiles, "hits": self.hits,
                 "misses": self.misses, "programs": len(self._programs),
-                "donate": self._donate}
+                "donate": self._donate, "step_time_ms": step_ms,
+                "step_tail_ms": tail_ms}

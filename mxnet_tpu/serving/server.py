@@ -1,0 +1,443 @@
+"""ModelServer — the multi-model, multi-version serving registry.
+
+The piece that turns the single-model `InferenceEngine` facade into a
+serving *system* (ROADMAP item 3; the serving half of the TensorFlow
+system paper, arXiv:1605.08695, and TF-Serving's model-manager layer):
+
+* **registry** — any number of named models, each with any number of
+  versions, routed by ``(model, version)`` with a default-version alias
+  per model (``predict("resnet", x)`` serves the default; an explicit
+  ``version=`` pins one).
+* **replica fan-out** — a version may stage its params on N devices; each
+  replica is a full `InferenceEngine` (own bucketed program cache, own
+  micro-batcher) and dispatch picks the LEAST-LOADED replica by live
+  in-flight count.
+* **zero-downtime rollover** — :meth:`rollover` swaps every replica's
+  device weight buffers under the program cache (params are runtime
+  arguments: zero recompiles, in-flight requests keep their buffers) and
+  atomically re-points the version label/default alias in the registry.
+  :meth:`reload_from` builds the same on the checkpoint poller: training
+  commits checkpoints, serving follows with one load per step fanned out
+  to every replica.
+* **observability** — per-model latency histograms
+  (``profiler.latency_counters(prefix="serving.<model>")``: queue wait vs
+  device time, p50/p95/p99) plus per-replica engine stats.
+
+    server = ModelServer()
+    server.register("resnet", sym, args, aux, replicas=2,
+                    warmup_shapes={"data": (32, 3, 224, 224)})
+    out = server.predict("resnet", {"data": batch})
+    fut = server.predict_async("resnet", {"data": rows}, deadline_ms=15)
+    server.rollover("resnet", new_args, version=2)   # zero recompiles
+    server.reload_from("resnet", ckpt_dir, poll_interval=30)
+    server.stats()
+
+Lock discipline: the registry lock guards the model/version tables and the
+in-flight counters ONLY — engine construction, warmup, predict dispatch
+and weight staging all run outside it (device/compile work under a held
+lock would serialize every model behind one registration; tpulint TPL104).
+Request done-callbacks (the in-flight decrement) may fire under a
+batcher's condition variable, so no ModelServer method may touch a batcher
+while holding the registry lock.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..base import MXNetError, get_env
+from ..context import Context, current_context
+from .engine import InferenceEngine
+
+__all__ = ["ModelServer"]
+
+
+class _Replica:
+    __slots__ = ("engine", "inflight")
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.inflight = 0
+
+
+class _ModelEntry:
+    __slots__ = ("versions", "default_version", "reload_step")
+
+    def __init__(self):
+        self.versions = {}        # label -> list of _Replica
+        self.default_version = None
+        self.reload_step = None   # checkpoint-poller watermark
+
+
+def _replica_ctxs(base, replicas):
+    """One Context per replica, device-striped from the base context's
+    device type. Hosts with fewer devices than replicas colocate the
+    overflow on device 0 (how the 1-core CI host still exercises the
+    least-loaded dispatch path; a real mesh stripes for real)."""
+    if replicas == 1:
+        return [base]
+    ctxs = []
+    for i in range(replicas):
+        ctx = Context(base.device_type, i)
+        try:
+            ctx.jax_device
+        except MXNetError:
+            ctx = Context(base.device_type, 0)
+        ctxs.append(ctx)
+    return ctxs
+
+
+class ModelServer:
+    """Host many named model/version entries, each a set of per-device
+    `InferenceEngine` replicas; route by ``(model, version)`` with a
+    default-version alias; swap weights live with zero recompiles."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}
+        self._pollers = {}    # model name -> (thread, stop_event)
+        self._stopped = False
+
+    # ------------------------------------------------------------------
+    # registration
+    # ------------------------------------------------------------------
+    def register(self, name, symbol, arg_params, aux_params=None,
+                 version=1, ctx=None, replicas=None, default=None,
+                 warmup_shapes=None, **engine_kwargs):
+        """Build and register one model version.
+
+        ``replicas`` (default: ``MXNET_SERVING_REPLICAS``, 1) fans the
+        version out across that many devices of the base context's type —
+        every replica stages its own param copy and owns its own program
+        cache/batcher; dispatch is least-loaded. ``default`` controls the
+        default-version alias: the FIRST version registered for a model
+        becomes the default unless a later ``register``/
+        :meth:`set_default_version` says otherwise. ``warmup_shapes``
+        AOT-compiles every bucket on every replica before traffic.
+        Remaining kwargs reach the `InferenceEngine` (buckets,
+        max_delay_ms, default_deadline_ms, ...). Returns the version
+        label."""
+        if replicas is None:
+            replicas = int(get_env("MXNET_SERVING_REPLICAS", 1, int))
+        if replicas < 1:
+            raise MXNetError("replicas must be >= 1, got %d" % replicas)
+        if ctx is None or isinstance(ctx, (Context, str)):
+            base = (ctx if isinstance(ctx, Context)
+                    else Context(ctx) if ctx is not None
+                    else current_context())
+            ctxs = _replica_ctxs(base, replicas)
+        else:
+            ctxs = [c if isinstance(c, Context) else Context(c)
+                    for c in ctx]
+        engines = [InferenceEngine(symbol, arg_params, aux_params,
+                                   ctx=c, name=name, **engine_kwargs)
+                   for c in ctxs]
+        if warmup_shapes:
+            for eng in engines:
+                eng.warmup(warmup_shapes)
+        return self.register_engines(name, engines, version=version,
+                                     default=default)
+
+    def register_engines(self, name, engines, version=1, default=None):
+        """Register pre-built engine(s) as one model version (accepts a
+        single `InferenceEngine` or a list — the replica set)."""
+        if isinstance(engines, InferenceEngine):
+            engines = [engines]
+        if not engines:
+            raise MXNetError("register: need at least one engine")
+        reps = [_Replica(e) for e in engines]
+        with self._lock:
+            if self._stopped:
+                raise MXNetError("ModelServer is stopped")
+            entry = self._models.get(name)
+            if entry is None:
+                entry = self._models[name] = _ModelEntry()
+            if version in entry.versions:
+                raise MXNetError(
+                    "model %r version %r is already registered — rollover "
+                    "or unregister it first" % (name, version))
+            entry.versions[version] = reps
+            if default or entry.default_version is None:
+                entry.default_version = version
+        return version
+
+    def unregister(self, name, version=None):
+        """Remove one version (or, with ``version=None``, the whole
+        model). Removed engines are stopped; a removed default re-points
+        to the newest remaining version."""
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise MXNetError("unknown model %r" % name)
+            if version is None:
+                removed = [r for reps in entry.versions.values()
+                           for r in reps]
+                del self._models[name]
+            else:
+                if version not in entry.versions:
+                    raise MXNetError("model %r has no version %r"
+                                     % (name, version))
+                removed = entry.versions.pop(version)
+                if not entry.versions:
+                    del self._models[name]
+                elif entry.default_version == version:
+                    # newest remaining = most recently registered (dict
+                    # insertion order) — label types are caller-chosen
+                    # (ints, strings, checkpoint steps), so no value
+                    # ordering is assumed
+                    entry.default_version = next(reversed(entry.versions))
+            poller = self._pollers.pop(name, None) \
+                if name not in self._models else None
+        if poller is not None:
+            poller[1].set()
+        for rep in removed:
+            rep.engine.stop()
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+    def models(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def versions(self, name):
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise MXNetError("unknown model %r" % name)
+            return sorted(entry.versions, key=str)
+
+    def default_version(self, name):
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise MXNetError("unknown model %r" % name)
+            return entry.default_version
+
+    def set_default_version(self, name, version):
+        """Atomically re-point the model's default-version alias."""
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise MXNetError("unknown model %r" % name)
+            if version not in entry.versions:
+                raise MXNetError("model %r has no version %r"
+                                 % (name, version))
+            entry.default_version = version
+
+    def engine(self, name, version=None, replica=0):
+        """One replica's engine (introspection/tests — dispatch goes
+        through :meth:`predict`/:meth:`predict_async`)."""
+        with self._lock:
+            reps = self._resolve_locked(name, version)[1]
+            return reps[replica].engine
+
+    def _resolve_locked(self, name, version):
+        entry = self._models.get(name)
+        if entry is None:
+            raise MXNetError("unknown model %r (registered: %s)"
+                             % (name, sorted(self._models)))
+        label = version if version is not None else entry.default_version
+        reps = entry.versions.get(label)
+        if reps is None:
+            raise MXNetError("model %r has no version %r (has: %s)"
+                             % (name, label, sorted(entry.versions,
+                                                    key=str)))
+        return label, reps
+
+    def _acquire(self, name, version):
+        """Pick the least-loaded replica and count the request in-flight
+        (the counter is what 'least-loaded' means — live queue depth, not
+        a stale round-robin)."""
+        with self._lock:
+            _, reps = self._resolve_locked(name, version)
+            rep = min(reps, key=lambda r: r.inflight)
+            rep.inflight += 1
+            return rep
+
+    def _release(self, rep):
+        with self._lock:
+            rep.inflight -= 1
+
+    def predict(self, name, data, version=None):
+        """Synchronous inference on the (model, version)'s least-loaded
+        replica (default version when ``version`` is None)."""
+        rep = self._acquire(name, version)
+        try:
+            return rep.engine.predict(data)
+        finally:
+            self._release(rep)
+
+    def predict_async(self, name, data, version=None, deadline_ms=None,
+                      priority=0):
+        """Queue onto the least-loaded replica's micro-batcher; returns
+        the future-like request handle (see
+        `InferenceEngine.predict_async` for the deadline/priority SLA
+        semantics). The replica stays counted in-flight until the request
+        resolves — served, failed, or shed."""
+        rep = self._acquire(name, version)
+        try:
+            fut = rep.engine.predict_async(data, deadline_ms=deadline_ms,
+                                           priority=priority)
+        except BaseException:
+            self._release(rep)
+            raise
+        fut.add_done_callback(lambda _req: self._release(rep))
+        return fut
+
+    # ------------------------------------------------------------------
+    # zero-downtime rollover
+    # ------------------------------------------------------------------
+    def rollover(self, name, arg_params, aux_params=None, version=None):
+        """Swap the DEFAULT version's weights on every replica and
+        (optionally) relabel it ``version`` — atomically re-pointing the
+        default alias.
+
+        Zero recompiles by construction: params are runtime arguments of
+        the cached bucket programs, so the swap is a device_put per
+        changed array (quantized engines re-fold fp32 checkpoints through
+        `quantize_params` — see `InferenceEngine.update_params`).
+        In-flight requests finish on the buffers they already hold; new
+        dispatches see the new weights. Returns the serving version
+        label."""
+        with self._lock:
+            label, reps = self._resolve_locked(name, None)
+        for rep in reps:
+            rep.engine.update_params(arg_params, aux_params)
+        if version is None or version == label:
+            return label
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None or entry.versions.get(label) is not reps:
+                raise MXNetError(
+                    "model %r changed during rollover — relabel aborted "
+                    "(weights on the live replicas DID swap)" % name)
+            if version in entry.versions:
+                raise MXNetError("model %r already has a version %r"
+                                 % (name, version))
+            entry.versions[version] = entry.versions.pop(label)
+            if entry.default_version == label:
+                entry.default_version = version
+        return version
+
+    def reload_from(self, name, directory, poll_interval=None):
+        """Checkpoint-driven rollover: load the latest COMMITTED
+        checkpoint in ``directory`` (half-written ones are invisible by
+        construction) ONCE and fan it out to every replica of the
+        model's default version, relabeling the version to the
+        checkpoint step. ``poll_interval`` (seconds) starts a daemon
+        poller repeating the check until :meth:`stop` — training saves
+        through a CheckpointManager, every serving replica follows.
+        Returns the step just loaded, or None when nothing newer was
+        committed."""
+        loaded = self._reload_once(name, directory)
+        with self._lock:
+            start = (poll_interval and name not in self._pollers
+                     and not self._stopped)
+        if start:
+            stop_evt = threading.Event()
+
+            def _poll():
+                while not stop_evt.wait(poll_interval):
+                    try:
+                        self._reload_once(name, directory)
+                    except Exception as e:  # keep serving the old weights
+                        logging.warning("ModelServer.reload_from(%s, %s): "
+                                        "%s", name, directory, e)
+            thread = threading.Thread(
+                target=_poll, name="mx-serving-server-reload", daemon=True)
+            with self._lock:
+                if name not in self._pollers and not self._stopped:
+                    self._pollers[name] = (thread, stop_evt)
+                    thread.start()
+        return loaded
+
+    def _reload_once(self, name, directory, _retries=3):
+        from .. import checkpoint as ckpt
+        for attempt in range(_retries):
+            path = ckpt.latest_checkpoint(directory)
+            if path is None:
+                return None
+            try:
+                meta = ckpt.read_meta(path)
+                step = meta.get("step")
+                with self._lock:
+                    entry = self._models.get(name)
+                    if entry is None:
+                        raise MXNetError("unknown model %r" % name)
+                    if step is not None and entry.reload_step is not None \
+                            and step <= entry.reload_step:
+                        # NEWER-only: a re-commit of the current step
+                        # briefly makes an older step the "latest"
+                        return None
+                arg_params, aux_params = ckpt.load_params(path)
+            except MXNetError:
+                raise
+            except Exception:
+                # transient by construction: retention pruning removed
+                # the dir between discovery and read — re-resolve
+                if attempt == _retries - 1:
+                    raise
+                import time as _time
+                _time.sleep(0.1)
+                continue
+            try:
+                self.rollover(name, arg_params, aux_params, version=step)
+            except MXNetError:
+                # label collision (e.g. a pre-registered step label):
+                # weights are what matter — swap under the existing label
+                self.rollover(name, arg_params, aux_params)
+            with self._lock:
+                entry = self._models.get(name)
+                if entry is not None:
+                    entry.reload_step = step
+            return step
+        return None
+
+    # ------------------------------------------------------------------
+    # lifecycle / observability
+    # ------------------------------------------------------------------
+    def stop(self):
+        """Stop every poller and every registered engine (queued async
+        requests drain first — the batcher's stop contract)."""
+        with self._lock:
+            self._stopped = True
+            pollers = list(self._pollers.values())
+            self._pollers.clear()
+            engines = [rep.engine for entry in self._models.values()
+                       for reps in entry.versions.values()
+                       for rep in reps]
+        for _thread, stop_evt in pollers:
+            stop_evt.set()
+        for thread, _evt in pollers:
+            thread.join(timeout=5.0)
+        for eng in engines:
+            eng.stop()
+
+    def stats(self):
+        """Per-model serving surface: default version, per-version
+        per-replica engine stats (+ live in-flight), and the model's
+        latency histograms (queue/device/total p50/p95/p99)."""
+        from .. import profiler as _prof
+        with self._lock:
+            snapshot = {
+                name: (entry.default_version,
+                       {label: list(reps)
+                        for label, reps in entry.versions.items()})
+                for name, entry in self._models.items()}
+        out = {}
+        for name, (default, versions) in snapshot.items():
+            vstats = {}
+            for label, reps in versions.items():
+                vstats[str(label)] = [
+                    dict(rep.engine.stats(), inflight=rep.inflight,
+                         ctx=str(rep.engine._ctx))
+                    for rep in reps]
+            out[name] = {
+                "default_version": default,
+                "versions": vstats,
+                # trailing dot: "serving.res" must not absorb
+                # "serving.resnet.*"
+                "latency": _prof.latency_counters(
+                    prefix="serving.%s." % name)}
+        return out

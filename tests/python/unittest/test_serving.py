@@ -19,8 +19,8 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.serving import (InferenceEngine, DynamicBatcher,
-                               BucketedProgramCache, bucket_for,
-                               pad_to_bucket, default_max_batch)
+                               BucketedProgramCache, DeadlineExceeded,
+                               bucket_for, pad_to_bucket, default_max_batch)
 
 
 def _net(with_bn=True):
@@ -529,3 +529,295 @@ def test_mixed_trace_serving_throughput():
     eng.stop()
     total = sum(trace)
     assert total / max(dt, 1e-9) > 0         # throughput is reportable
+
+
+# ---------------------------------------------------------------------------
+# SLA-aware batching: deadlines, EDF formation, load shedding (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+def test_batcher_sheds_expired_deadline():
+    """A request whose queue wait consumed its deadline budget fast-fails
+    with the typed DeadlineExceeded; deadline-less traffic is untouched,
+    and served + shed sums to submitted."""
+    calls = []
+
+    def run_batch(padded, n_real):
+        calls.append(padded["x"].shape[0])
+        return [padded["x"]]
+
+    b = DynamicBatcher(run_batch, buckets=(4,), autostart=False)
+    doomed = b.submit({"x": np.zeros((1, 1), np.float32)}, deadline_ms=1.0)
+    safe = b.submit({"x": np.ones((1, 1), np.float32)})
+    time.sleep(0.02)                       # the 1 ms budget is now spent
+    b.flush()
+    with pytest.raises(DeadlineExceeded):
+        doomed.result_wait(1.0)
+    np.testing.assert_allclose(np.asarray(safe.result_wait(1.0)[0]), 1.0)
+    st = b.stats()
+    assert st["shed"] == 1 and st["served"] == 1
+    assert st["served"] + st["shed"] == st["requests"] == 2
+    assert calls == [4]                    # the shed request never ran
+
+
+def test_batcher_submit_sheds_impossible_budget():
+    """A deadline below the bucket's measured step time can never be met
+    even on an idle engine — shed at submit, before queueing."""
+    b = DynamicBatcher(lambda p, n: [p["x"]], buckets=(4,),
+                       autostart=False, step_time=lambda bucket: 0.2)
+    req = b.submit({"x": np.zeros((1, 1), np.float32)}, deadline_ms=50.0)
+    assert req.done()                      # resolved without any dispatch
+    with pytest.raises(DeadlineExceeded, match="below the bucket"):
+        req.result_wait(0.0)
+    assert b.stats()["shed"] == 1 and b.stats()["requests"] == 1
+    assert not b._queue
+    with pytest.raises(MXNetError):
+        b.submit({"x": np.zeros((1, 1), np.float32)}, deadline_ms=0)
+
+
+def test_batcher_edf_order_priority_above_deadline():
+    """Batch formation is earliest-deadline-first; priority orders above
+    EDF; deadline-less requests go last at equal priority (FIFO there)."""
+    order = []
+
+    def run_batch(padded, n_real):
+        order.append(int(padded["x"][0, 0]))
+        return [padded["x"]]
+
+    b = DynamicBatcher(run_batch, buckets=(4,), max_batch=4,
+                       autostart=False)
+    # marker 0: late deadline; 1: early; 2: mid; 3: none; 4: low deadline
+    # but HIGH priority -> dispatches first
+    b.submit({"x": np.full((4, 1), 0, np.float32)}, deadline_ms=5000.0)
+    b.submit({"x": np.full((4, 1), 1, np.float32)}, deadline_ms=1000.0)
+    b.submit({"x": np.full((4, 1), 2, np.float32)}, deadline_ms=3000.0)
+    b.submit({"x": np.full((4, 1), 3, np.float32)})
+    b.submit({"x": np.full((4, 1), 4, np.float32)}, deadline_ms=8000.0,
+             priority=1)
+    b.flush()
+    assert order == [4, 1, 2, 0, 3]
+
+
+def test_batcher_early_dispatch_on_tight_slack():
+    """The worker must NOT hold a partial batch for the full max_delay
+    window when the most urgent queued deadline cannot afford it: the
+    batch goes out as soon as slack shrinks to slack_factor x measured
+    step time."""
+    b = DynamicBatcher(lambda p, n: [p["x"]], buckets=(8,),
+                       max_delay_ms=10000.0, step_time=lambda bucket: 0.01,
+                       slack_factor=5.0)
+    tic = time.monotonic()
+    req = b.submit({"x": np.zeros((1, 1), np.float32)}, deadline_ms=500.0)
+    out = req.result_wait(8.0)             # << the 10 s window
+    elapsed = time.monotonic() - tic
+    assert out is not None and elapsed < 8.0
+    assert b.stats()["early_dispatches"] >= 1
+    assert b.stats()["shed"] == 0
+    b.stop()
+
+
+def test_batcher_idle_wait_is_event_driven():
+    """Satellite: the idle wait is woken ONLY by submit/stop — no timer
+    churn. The pre-ISSUE-8 batcher woke every 100 ms forever while idle
+    (a 10-wakeups/second floor); the counter proves that's gone."""
+    b = DynamicBatcher(lambda p, n: [p["x"]], buckets=(4,),
+                       max_delay_ms=0.0)
+    b.start()
+    time.sleep(0.5)                         # idle: zero wakeups allowed
+    assert b.stats()["idle_wakeups"] == 0
+    req = b.submit({"x": np.zeros((1, 1), np.float32)})
+    req.result_wait(5.0)
+    time.sleep(0.3)                         # idle again after serving
+    wakes = b.stats()["idle_wakeups"]
+    assert 1 <= wakes <= 3                  # the submit (+ maybe a spurious
+    time.sleep(0.3)                         # notify) — but NOT a timer:
+    assert b.stats()["idle_wakeups"] == wakes
+    b.stop()
+
+
+def test_batcher_concurrent_producers_stop_race():
+    """Satellite stress: N producer threads submitting mixed sizes while
+    stop() races. Every ACCEPTED request must resolve exactly once with
+    its own rows (result, solo-dispatch, or shed); submissions after stop
+    raise; nothing is silently dropped."""
+    import threading
+
+    def run_batch(padded, n_real):
+        return [padded["x"] * 2.0]
+
+    b = DynamicBatcher(run_batch, buckets=(8,), max_delay_ms=1.0)
+    accepted, rejected = [], [0]
+    lock = threading.Lock()
+    rng = np.random.RandomState(21)
+    sizes = [[int(s) for s in rng.randint(1, 6, size=25)] for _ in range(6)]
+
+    def producer(my_sizes, seed):
+        prng = np.random.RandomState(seed)
+        for n in my_sizes:
+            x = prng.uniform(1, 2, (n, 2)).astype(np.float32)
+            try:
+                fut = b.submit({"x": x})
+            except MXNetError:
+                with lock:
+                    rejected[0] += 1
+                continue
+            with lock:
+                accepted.append((x, fut))
+            time.sleep(prng.uniform(0, 0.002))
+
+    threads = [threading.Thread(target=producer, args=(s, i))
+               for i, s in enumerate(sizes)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)
+    b.stop()                                # races the producers
+    for t in threads:
+        t.join()
+    for x, fut in accepted:
+        assert fut.event.wait(10.0), "request silently dropped"
+        # exactly one terminal state
+        assert (fut.result is None) != (fut.error is None)
+        assert fut.error is None            # no deadlines -> no sheds
+        np.testing.assert_allclose(np.asarray(fut.result[0]), x * 2.0)
+    st = b.stats()
+    assert st["requests"] == len(accepted)
+    assert st["served"] == len(accepted)
+    assert st["served"] + st["shed"] == st["requests"]
+    assert st["rows"] == sum(x.shape[0] for x, _ in accepted)
+    assert not b._queue                     # drained, not dropped
+    assert len(accepted) + rejected[0] == 6 * 25
+
+
+def test_step_time_ewma_feeds_batcher():
+    """The engine's measured compile-warm step times reach the batcher's
+    shed/early-dispatch signal through the program cache."""
+    rng = np.random.RandomState(22)
+    sym = _net(with_bn=False)
+    args, _ = _params_for(sym, 4, rng)
+    eng = InferenceEngine(sym, args, {}, ctx=mx.cpu(), buckets=(4,),
+                          async_worker=False)
+    x = rng.normal(0, 1, (4, 6)).astype(np.float32)
+    eng.predict_async({"data": x})
+    eng.flush()                             # first run compiles: excluded
+    assert eng.step_time(4) is None
+    eng.predict_async({"data": x})
+    eng.flush()                             # warm run: sampled
+    assert eng.step_time(4) is not None and eng.step_time(4) > 0
+    assert eng.stats()["step_time_ms"]["4"] > 0
+    eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# quantized-engine hot-swap (ISSUE 8 satellite bugfix): update_params /
+# reload_from must re-fold raw fp32 weights through quantize_params
+# ---------------------------------------------------------------------------
+
+def _qnet():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="qfc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="qfc1")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _qnet_params(rng):
+    return {
+        "qfc0_weight": mx.nd.array(rng.normal(0, 0.4, (8, 6)).astype(np.float32)),
+        "qfc0_bias": mx.nd.array(rng.normal(0, 0.1, (8,)).astype(np.float32)),
+        "qfc1_weight": mx.nd.array(rng.normal(0, 0.3, (3, 8)).astype(np.float32)),
+        "qfc1_bias": mx.nd.array(np.zeros(3, np.float32)),
+    }
+
+
+def test_quantized_engine_hot_swap_refolds_fp32():
+    """Regression (ISSUE 8): update_params on a quantized engine used to
+    stage raw fp32 arrays over the per-channel int8 weight buffers —
+    wrong dtype, wrong scale after the first rollover. It must re-fold
+    through quantize_params: same weights -> bitwise-stable outputs and
+    zero new compiles; new weights -> bitwise-equal to a fresh engine
+    built from quantize_params(new)."""
+    from mxnet_tpu.contrib import quantization as Q
+    rng = np.random.RandomState(23)
+    sym = _qnet()
+    params = _qnet_params(rng)
+    weights = ["qfc0_weight", "qfc1_weight"]
+    qsym = Q.quantize_graph(sym, offline_params=weights)
+    qargs = Q.quantize_params(qsym, params)
+    eng = InferenceEngine(qsym, qargs, {}, ctx=mx.cpu(), buckets=(4,),
+                          async_worker=False)
+    x = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+    out1 = np.asarray(eng.predict({"data": x})[0])
+    assert eng._params["qfc0_weight_quantize"].dtype == np.int8
+    assert eng.compiles == 1
+
+    # hot-swap with the SAME raw fp32 params: bitwise-stable, no compiles
+    eng.update_params(params)
+    assert eng._params["qfc0_weight_quantize"].dtype == np.int8
+    out2 = np.asarray(eng.predict({"data": x})[0])
+    np.testing.assert_array_equal(out1, out2)
+    assert eng.compiles == 1
+
+    # hot-swap with NEW fp32 params == fresh engine folded from them
+    new_params = _qnet_params(np.random.RandomState(24))
+    eng.update_params(new_params)
+    assert eng.compiles == 1                # still zero recompiles
+    out3 = np.asarray(eng.predict({"data": x})[0])
+    ref_eng = InferenceEngine(qsym, Q.quantize_params(qsym, new_params),
+                              {}, ctx=mx.cpu(), buckets=(4,),
+                              async_worker=False)
+    np.testing.assert_array_equal(
+        out3, np.asarray(ref_eng.predict({"data": x})[0]))
+    assert not np.array_equal(out1, out3)   # the swap actually happened
+
+    # wrong-dtype buffer under the int8 name is rejected, not staged
+    with pytest.raises(MXNetError, match="must be int8"):
+        eng.update_params({"qfc0_weight_quantize":
+                           np.zeros((8, 6), np.float32)})
+
+
+def test_quantized_engine_accepts_raw_fp32_at_build():
+    """An engine built straight from a training checkpoint (raw fp32,
+    base-named) folds once at construction and matches the pre-folded
+    build bitwise."""
+    from mxnet_tpu.contrib import quantization as Q
+    rng = np.random.RandomState(25)
+    params = _qnet_params(rng)
+    qsym = Q.quantize_graph(_qnet(), offline_params=["qfc0_weight",
+                                                     "qfc1_weight"])
+    eng_raw = InferenceEngine(qsym, params, {}, ctx=mx.cpu(), buckets=(4,),
+                              async_worker=False)
+    eng_folded = InferenceEngine(qsym, Q.quantize_params(qsym, params), {},
+                                 ctx=mx.cpu(), buckets=(4,),
+                                 async_worker=False)
+    assert eng_raw._params["qfc0_weight_quantize"].dtype == np.int8
+    x = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(eng_raw.predict({"data": x})[0]),
+        np.asarray(eng_folded.predict({"data": x})[0]))
+
+
+def test_quantized_reload_from_hot_swap(tmp_path):
+    """The checkpoint poller path: reload_from loads raw fp32 params and
+    the quantized engine re-folds them — int8 staging preserved, compile
+    count unchanged, outputs bitwise-equal to a fresh fold."""
+    from mxnet_tpu.contrib import quantization as Q
+    rng = np.random.RandomState(26)
+    params = _qnet_params(rng)
+    qsym = Q.quantize_graph(_qnet(), offline_params=["qfc0_weight",
+                                                     "qfc1_weight"])
+    eng = InferenceEngine(qsym, Q.quantize_params(qsym, params), {},
+                          ctx=mx.cpu(), buckets=(4,), async_worker=False)
+    x = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+    np.asarray(eng.predict({"data": x})[0])
+    assert eng.compiles == 1
+    new_params = _qnet_params(np.random.RandomState(27))
+    mgr = mx.checkpoint.CheckpointManager(str(tmp_path))
+    mgr.save(5, arg_params=new_params, blocking=True)
+    assert eng.reload_from(str(tmp_path)) == 5
+    assert eng._params["qfc0_weight_quantize"].dtype == np.int8
+    out = np.asarray(eng.predict({"data": x})[0])
+    assert eng.compiles == 1                # rollover compiled nothing
+    ref = InferenceEngine(qsym, Q.quantize_params(qsym, new_params), {},
+                          ctx=mx.cpu(), buckets=(4,), async_worker=False)
+    np.testing.assert_array_equal(out, np.asarray(
+        ref.predict({"data": x})[0]))
+    eng.stop()

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""CI serving smoke (`ci/run.py serving_smoke` stage, ISSUE 8).
+
+Fast, non-slow gate over the multi-model serving tier:
+  * two models registered on one ModelServer, each bit-identical to its
+    solo engine (isolation);
+  * zero-compile weight rollover with atomic default re-point;
+  * a short deadline trace under FORCED overload (queued work many times
+    the deadline budget) — served + shed must sum EXACTLY to submitted,
+    with both classes non-empty, and per-model latency histograms
+    reported separately.
+
+Prints one JSON summary line; non-zero exit on any violated contract.
+The companion lint half of the stage (TPL101-TPL105 over
+mxnet_tpu/serving) runs as a second command in ci/run.py.
+"""
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+from mxnet_tpu.serving import ModelServer, DeadlineExceeded  # noqa: E402
+from mxnet_tpu.serving import InferenceEngine  # noqa: E402
+
+
+def _net(hidden, prefix):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden,
+                                name=prefix + "_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name=prefix + "_fc1")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params(sym, rng):
+    shapes, _, _ = sym.infer_shape(data=(4, 6))
+    return {n: mx.nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+def main():
+    rng = np.random.RandomState(0)
+    sym_a, sym_b = _net(8, "smoke_a"), _net(6, "smoke_b")
+    p_a, p_b = _params(sym_a, rng), _params(sym_b, rng)
+    x = rng.normal(0, 1, (1, 6)).astype(np.float32)
+    x4 = rng.normal(0, 1, (4, 6)).astype(np.float32)
+
+    srv = ModelServer()
+    srv.register("smoke_a", sym_a, p_a, ctx=mx.cpu(), buckets=(4,),
+                 async_worker=False, warmup_shapes={"data": (4, 6)})
+    srv.register("smoke_b", sym_b, p_b, ctx=mx.cpu(), buckets=(4,),
+                 async_worker=False, warmup_shapes={"data": (4, 6)})
+
+    # --- isolation: bit-identical to solo engines -----------------------
+    solo_a = InferenceEngine(sym_a, p_a, {}, ctx=mx.cpu(), buckets=(4,),
+                             async_worker=False)
+    solo_b = InferenceEngine(sym_b, p_b, {}, ctx=mx.cpu(), buckets=(4,),
+                             async_worker=False)
+    for model, solo in (("smoke_a", solo_a), ("smoke_b", solo_b)):
+        got = np.asarray(srv.predict(model, {"data": x4})[0])
+        want = np.asarray(solo.predict({"data": x4})[0])
+        assert np.array_equal(got, want), "%s diverged from solo" % model
+
+    # --- zero-compile rollover ------------------------------------------
+    eng_a = srv.engine("smoke_a")
+    compiles_before = eng_a.compiles
+    out_v1 = np.asarray(srv.predict("smoke_a", {"data": x4})[0])
+    new_a = {n: mx.nd.array(rng.normal(0, 0.5, a.shape).astype(np.float32))
+             for n, a in p_a.items()}
+    assert srv.rollover("smoke_a", new_a, version=2) == 2
+    out_v2 = np.asarray(srv.predict("smoke_a", {"data": x4})[0])
+    assert eng_a.compiles == compiles_before, "rollover recompiled"
+    assert srv.default_version("smoke_a") == 2
+    assert not np.array_equal(out_v1, out_v2), "rollover did not swap"
+
+    # --- forced overload: deadline trace, exact accounting --------------
+    eng_b = srv.engine("smoke_b")
+    for _ in range(2):  # prime the warm step-time estimate
+        srv.predict_async("smoke_b", {"data": x})
+        eng_b.flush()
+    step_s = eng_b.step_time(4) or 1e-3
+    deadline_ms = max(6.0 * step_s * 1e3, 60.0)
+    # queue FAR more work than the budget covers, then drain: batch k
+    # finishes ~k*step after drain start, so everything past
+    # ~deadline/step batches MUST shed and the first batches MUST serve
+    n_req = 4 * int(5.0 * (deadline_ms / 1e3) / step_s + 1)
+    n_req = min(max(n_req, 64), 4000)
+    futs = [srv.predict_async("smoke_b", {"data": x},
+                              deadline_ms=deadline_ms)
+            for _ in range(n_req)]
+    tic = time.time()
+    eng_b.flush()
+    drain_s = time.time() - tic
+    served = shed = other = 0
+    for f in futs:
+        assert f.done(), "request left unresolved"
+        if f.error is None:
+            served += 1
+        elif isinstance(f.error, DeadlineExceeded):
+            shed += 1
+        else:
+            other += 1
+    st = eng_b.stats()
+    summary = {
+        "submitted": n_req, "served": served, "shed": shed,
+        "errors": other, "deadline_ms": round(deadline_ms, 1),
+        "step_ms": round(step_s * 1e3, 3),
+        "drain_s": round(drain_s, 3),
+        "batcher_served": st["served"], "batcher_shed": st["shed"],
+        "latency_a": profiler.latency_counters(prefix="serving.smoke_a"),
+        "latency_b": profiler.latency_counters(prefix="serving.smoke_b"),
+    }
+    print(json.dumps(summary), flush=True)
+    assert served + shed + other == n_req, "accounting does not sum"
+    assert other == 0, "non-shed errors in the trace"
+    assert shed > 0, "forced overload shed nothing"
+    assert served > 0, "overload shed everything"
+    # batcher's own counters agree with the client-side tally
+    assert st["served"] + st["shed"] == st["requests"]
+    # per-model latency histograms reported separately
+    assert summary["latency_a"] and summary["latency_b"]
+    assert not set(summary["latency_a"]) & set(summary["latency_b"])
+    srv.stop()
+    solo_a.stop()
+    solo_b.stop()
+    print("serving_smoke OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
