@@ -38,6 +38,7 @@ PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy
     "jax_baseline": 700, "flash": 700, "io_train": 600,
     "infer_int8": 600, "train_big_batch": 900, "flash_parity": 500,
     "cost": 600, "serving": 600, "serving_sla": 300,
+    "fault_recovery": 300,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -303,7 +304,7 @@ def main():
     # 2) measurement phases, each in its own budgeted child
     phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
               "io_train", "infer_int8", "train_big_batch", "flash_parity",
-              "cost", "serving"]
+              "cost", "serving", "fault_recovery"]
     # phases that measure nothing useful on the CPU fallback (outage
     # removals — unlike explicit_skips, the bank may still supply them)
     cpu_useless = {"train_bf16", "train_big_batch", "flash_parity"}
@@ -408,7 +409,7 @@ def main():
         extra.update(_host_stamp())
     for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
                   "io_train", "infer_int8", "train_big_batch",
-                  "flash_parity", "cost", "serving"):
+                  "flash_parity", "cost", "serving", "fault_recovery"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
                       if not k.startswith("_")})
     # mixed-platform runs (partial rescue): say which metric ran where.
@@ -1396,6 +1397,116 @@ def _phase_io_train():
                 "steps": int(pc.get("steps", 0))}}
 
 
+def _phase_fault_recovery():
+    """Resilience under injected faults (ISSUE 9): the numbers that make
+    the recovery claims measurable. (a) Replica kill mid-trace: one of
+    two serving replicas starts failing every dispatch; the breaker must
+    open, traffic must reroute, and the trace must account exactly —
+    `fault_lost` (submitted - served - shed) MUST be 0, with
+    `fault_reroute_ms` = wall time from the kill to the first
+    failed-then-rerouted request resolving served. (b) Checkpoint I/O
+    fault: a save hit by an injected write failure retries to a commit;
+    the restored params must be BIT-exact (`ckpt_fault_bit_exact`), and
+    `ckpt_recovery_ms` prices the retry against a clean save."""
+    import shutil
+    import tempfile
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serving import ModelServer, DeadlineExceeded
+
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fr_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fr_fc1")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    shapes, _, _ = sym.infer_shape(data=(8, 16))
+    args = {n: mx.nd.array(rng.normal(0, 0.1, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    out = {}
+
+    # -- (a) replica kill under load -----------------------------------
+    faults.reset()
+    profiler.fault_counters(reset=True)
+    srv = ModelServer(breaker_threshold=3, breaker_cooldown_ms=5000.0)
+    srv.register("fr", sym, args, ctx=mx.tpu(0), replicas=2, buckets=(8,),
+                 max_delay_ms=1.0, warmup_shapes={"data": (8, 16)})
+    x = rng.normal(0, 1, (1, 16)).astype(np.float32)
+    n_req, kill_at = 120, 40
+    futs, t_kill = [], None
+    for i in range(n_req):
+        if i == kill_at:
+            t_kill = time.monotonic()
+            faults.configure("serving.dispatch:replica=0:mode=async:"
+                             "raise=OSError,replica killed")
+        futs.append(srv.predict_async("fr", {"data": x},
+                                      deadline_ms=2000.0))
+        time.sleep(0.002)   # steady open-loop-ish trace
+    served = shed = lost = retried = 0
+    first_reroute = None
+    for f in futs:
+        try:
+            f.result_wait(30.0)
+            served += 1
+            if f.attempts > 1:
+                retried += 1
+                if first_reroute is None or f.t_done < first_reroute:
+                    first_reroute = f.t_done
+        except DeadlineExceeded:
+            shed += 1
+        except Exception:
+            lost += 1
+    st = srv.stats()["fr"]
+    faults.reset()
+    srv.stop()
+    out["fault_submitted"] = n_req
+    out["fault_served"] = served
+    out["fault_shed"] = shed
+    out["fault_lost"] = lost
+    out["fault_retried"] = retried
+    out["fault_breaker_open"] = \
+        st["versions"]["1"][0]["breaker"]["state"] == "open"
+    out["fault_injected"] = profiler.fault_counters().get(
+        "serving.dispatch", 0)
+    if first_reroute is not None and t_kill is not None:
+        out["fault_reroute_ms"] = round((first_reroute - t_kill) * 1e3, 2)
+
+    # -- (b) checkpoint write fault ------------------------------------
+    from mxnet_tpu import checkpoint as ckpt_mod
+    from mxnet_tpu.checkpoint import CheckpointManager
+    tmpdir = tempfile.mkdtemp(prefix="bench_fault_ckpt_")
+    try:
+        mgr = CheckpointManager(tmpdir)
+        mgr._write_retry.base_delay_s = 0.001
+        w = rng.normal(0, 1, (256, 256)).astype(np.float32)
+
+        def timed_save(step, fault):
+            faults.reset()
+            if fault:
+                faults.configure(
+                    "checkpoint.write:count=1:raise=OSError,disk blip")
+            t0 = time.monotonic()
+            mgr.save(step, symbol=sym,
+                     arg_params={"fr_w": mx.nd.array(w)}, blocking=True)
+            faults.reset()
+            return (time.monotonic() - t0) * 1e3
+        clean_ms = timed_save(1, fault=False)
+        faulted_ms = timed_save(2, fault=True)
+        arg, _ = ckpt_mod.load_params(ckpt_mod.latest_checkpoint(tmpdir))
+        out["ckpt_fault_bit_exact"] = bool(
+            np.array_equal(arg["fr_w"].asnumpy(), w))
+        out["ckpt_save_clean_ms"] = round(clean_ms, 2)
+        out["ckpt_recovery_ms"] = round(faulted_ms, 2)
+        out["ckpt_fault_retried"] = profiler.retry_counters().get(
+            "checkpoint.write.recovery", 0)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return out
+
+
 PHASES = {
     "probe": _phase_probe,
     "infer": _phase_infer,
@@ -1410,6 +1521,7 @@ PHASES = {
     "cost": _phase_cost,
     "serving": _phase_serving,
     "serving_sla": _phase_serving_sla,
+    "fault_recovery": _phase_fault_recovery,
 }
 
 

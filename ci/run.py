@@ -105,6 +105,25 @@ def stage_serving_smoke(_):
          os.path.join("mxnet_tpu", "serving")], cwd=ROOT)
 
 
+def stage_chaos_smoke(_):
+    """Non-slow resilience gate (ISSUE 9): replica-kill-under-load
+    (served + shed == submitted, breaker opens, traffic reroutes) and
+    checkpoint-write-fault (transient retried to commit; persistent
+    surfaces with the previous committed checkpoint intact) scenarios,
+    plus the zero-overhead fault-hook contract — then tpulint (incl.
+    TPL106 swallowed-exception) over the resilience modules."""
+    rc = subprocess.call(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_smoke.py")],
+        env=_env_cpu_mesh(1), cwd=ROOT)
+    if rc != 0:
+        return rc
+    return subprocess.call(
+        [sys.executable, "-m", "mxnet_tpu.analysis.lint",
+         os.path.join("mxnet_tpu", "resilience"),
+         os.path.join("mxnet_tpu", "checkpoint"),
+         os.path.join("mxnet_tpu", "io_device.py")], cwd=ROOT)
+
+
 def stage_bench_smoke(_):
     """bench.py CPU fallback path must emit its JSON line."""
     env = _env_cpu_mesh(1)
@@ -123,6 +142,7 @@ STAGES = [
     ("zero_smoke", stage_zero_smoke),
     ("multichip", stage_multichip),
     ("serving_smoke", stage_serving_smoke),
+    ("chaos_smoke", stage_chaos_smoke),
     ("bench_smoke", stage_bench_smoke),
 ]
 

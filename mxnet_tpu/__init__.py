@@ -70,6 +70,7 @@ from . import model
 from .model import save_checkpoint, load_checkpoint, FeedForward
 from . import checkpoint
 from .checkpoint import CheckpointManager
+from . import resilience
 from . import gluon
 from . import rnn
 from . import recordio

@@ -9,6 +9,8 @@ hand (docs/faq/analysis.md has the catalog with examples):
 - TPL103 ``blocking-get``   untimed queue.get() inside a worker loop
 - TPL104 ``lock-device-call`` lock held across a jax device/compile call
 - TPL105 ``env-registry``   MXNET_* env read missing from docs/faq/env_var.md
+- TPL106 ``swallowed-exception`` except handler that only passes/logs in
+  the resilience-critical set (serving|checkpoint|parallel|io_device.py)
 
 All rules are static heuristics over the AST — they cannot prove an
 expression is a device array, so genuinely-host uses are silenced with a
@@ -22,7 +24,7 @@ import re
 
 from .findings import Finding, Severity, apply_pragmas
 
-__all__ = ["lint_source", "is_hot_path", "RULES"]
+__all__ = ["lint_source", "is_hot_path", "is_swallow_scope", "RULES"]
 
 RULES = {
     "TPL000": ("pragma", Severity.ERROR,
@@ -41,12 +43,55 @@ RULES = {
     "TPL105": ("env-registry", Severity.ERROR,
                "MXNET_* env var read in source but undocumented in "
                "docs/faq/env_var.md"),
+    "TPL106": ("swallowed-exception", Severity.ERROR,
+               "exception swallowed (pass / log-and-continue with no "
+               "re-raise or counter) in a resilience-critical module"),
 }
 
 # directories whose files are fused/serving hot paths (ISSUE 5): host
 # syncs there stall the XLA dispatch pipeline
 _HOT_PARTS = {"module", "parallel", "serving"}
 _HOT_FILES = {"io_device.py"}
+
+# the resilience-critical set (ISSUE 9): modules whose failure handling
+# IS the product — a silently-swallowed exception here is a lost
+# checkpoint, a stale serving weight, or a wedged pipeline nobody can
+# diagnose. TPL106 demands every handler either re-raise, do real
+# handling work, or leave a counter/log-with-counter trail.
+_SWALLOW_PARTS = {"serving", "checkpoint", "parallel"}
+_SWALLOW_FILES = {"io_device.py"}
+
+_LOGGING_METHODS = frozenset({"debug", "info", "warning", "warn", "error",
+                              "exception", "critical", "log", "print"})
+
+
+def is_swallow_scope(path):
+    parts = str(path).replace("\\", "/").split("/")
+    if parts and parts[-1] in _SWALLOW_FILES:
+        return True
+    return any(p in _SWALLOW_PARTS for p in parts[:-1])
+
+
+def _is_inert_stmt(stmt):
+    """True for statements that neither handle nor surface an exception:
+    pass/continue/break, a bare return, a constant expression, or a
+    logging/print call. A handler made ONLY of these swallows its
+    exception — any assignment, counter increment, raise, or non-logging
+    call counts as real handling."""
+    if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+        return True
+    if isinstance(stmt, ast.Return) and stmt.value is None:
+        return True
+    if isinstance(stmt, ast.Expr):
+        v = stmt.value
+        if isinstance(v, ast.Constant):
+            return True  # stray docstring / ellipsis
+        if isinstance(v, ast.Call):
+            f = v.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None)
+            return name in _LOGGING_METHODS
+    return False
 
 _STOPPISH = re.compile(
     r"stop|done|sentinel|terminal|shutdown|cancel|exit|quit|kill")
@@ -101,9 +146,10 @@ def _str_arg(call, index=0):
 
 
 class _Analyzer(ast.NodeVisitor):
-    def __init__(self, path, hot, registry_text):
+    def __init__(self, path, hot, registry_text, swallow=False):
         self.path = path
         self.hot = hot
+        self.swallow = swallow
         self.registry = registry_text
         self.findings = []
         self.np_aliases = set()
@@ -172,6 +218,22 @@ class _Analyzer(ast.NodeVisitor):
 
     visit_While = _visit_loop
     visit_For = _visit_loop
+
+    # -------------------------------------------------- TPL106
+    def visit_ExceptHandler(self, node):
+        if self.swallow and node.body \
+                and all(_is_inert_stmt(s) for s in node.body):
+            what = ast.unparse(node.type) if node.type is not None \
+                else "BaseException"
+            # anchor on the handler's first statement: the pragma reads
+            # inline next to the pass/log it justifies
+            self._emit("TPL106", node.body[0],
+                       "except %s: handler only %s — the exception is "
+                       "swallowed with no re-raise, counter, or handling"
+                       % (what,
+                          "passes" if isinstance(node.body[0], ast.Pass)
+                          else "logs/continues"))
+        self.generic_visit(node)
 
     def visit_With(self, node):
         held = 0
@@ -364,16 +426,19 @@ class _Analyzer(ast.NodeVisitor):
         return self.findings
 
 
-def lint_source(source, path="<string>", hot=None, registry_text=None):
+def lint_source(source, path="<string>", hot=None, registry_text=None,
+                swallow=None):
     """Lint one file's source; returns findings with pragmas applied."""
     if hot is None:
         hot = is_hot_path(path)
+    if swallow is None:
+        swallow = is_swallow_scope(path)
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
         return [Finding("TPL001", "parse", Severity.ERROR,
                         "syntax error: %s" % e, path, e.lineno or 0)]
-    analyzer = _Analyzer(path, hot, registry_text)
+    analyzer = _Analyzer(path, hot, registry_text, swallow=swallow)
     analyzer.visit(tree)
     findings = analyzer.finish()
     findings += apply_pragmas(findings, source, path)

@@ -25,6 +25,8 @@ import threading
 import time
 
 from ..base import MXNetError, atomic_write, get_env
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy, TransientError
 from . import layout, state as state_mod
 
 __all__ = ["CheckpointManager", "SaveHandle"]
@@ -127,6 +129,12 @@ class CheckpointManager:
         self._live_capture = None
         self._prev_handlers = {}
         self._atexit_registered = False
+        # ONE retry/backoff policy for transient write-side I/O failures
+        # (resilience layer): a full staging+commit attempt re-runs from
+        # a fresh tmp dir, so a retried attempt can never inherit a
+        # half-written file from the failed one
+        self._write_retry = RetryPolicy(site="checkpoint.write",
+                                        retryable=(OSError, TransientError))
 
     # ------------------------------------------------------------------
     # save
@@ -225,47 +233,78 @@ class CheckpointManager:
         # one long-lived daemon per manager: a retire-on-idle thread could
         # race a concurrent save() past its liveness check and strand the
         # job in the queue forever
+        from ..resilience.watchdog import watchdog as _watchdog
+        hb = _watchdog().register("mx-checkpoint-writer",
+                                  thread=self._writer)
         while True:
+            hb.idle()
             # tpulint: allow-blocking-get long-lived daemon by design (see comment above); atexit flush drains in-flight writes
             step, state, handle, tmp = self._queue.get()
+            hb.beat()
             self._write_one(step, state, handle, tmp=tmp)
             self._queue.task_done()
 
-    def _write_one(self, step, state, handle, tmp=None):
-        host, num_hosts = state_mod._jax_process_info()
-        shared = num_hosts > 1
+    def _write_attempt(self, step, state, tmp, shared, host, num_hosts):
+        """One full staging+commit attempt. Returns the committed path
+        (non-coordinator hosts of a shared save: their staged path). On
+        failure the attempt discards its OWN staging dir — peers never
+        discard, their error must not destroy files other hosts are
+        still writing — and re-raises, so a retried attempt always
+        starts from a fresh tmp dir."""
+        if tmp is None:
+            tmp = layout.begin_write(self.directory, step, shared=shared)
+        with self._lock:
+            self._active_tmp.add(tmp)
         try:
-            if tmp is None:
-                tmp = layout.begin_write(self.directory, step, shared=shared)
-            with self._lock:
-                self._active_tmp.add(tmp)
+            _faults.fault_point("checkpoint.write", step=step)
             meta = self._write_files(tmp, step, state,
                                      shard_only=shared and host != 0)
             if shared and host != 0:
                 # non-coordinator hosts only stage their shard files; the
                 # coordinator awaits them, writes the manifest, commits
-                handle._finish(path=tmp)
-                return
+                return tmp
             if shared:
                 self._await_host_files(tmp, num_hosts)
             layout.write_meta(tmp, meta)  # commit marker, written last
-            path = layout.commit(tmp, self.directory, step)
-            handle._finish(path=path)
-        except BaseException as e:  # surfaced at handle.wait()
+            _faults.fault_point("checkpoint.commit", step=step)
+            return layout.commit(tmp, self.directory, step)
+        except BaseException:
             # the coordinator also discards a failed SHARED staging dir:
             # begin_write reuses the deterministic name, and a later save
             # of the same step must not inherit this attempt's stale
-            # shard files. Peers never discard — their error must not
-            # destroy files other hosts are still writing.
-            if tmp is not None and (not shared or host == 0):
+            # shard files
+            if not shared or host == 0:
                 layout.discard(tmp)
-            handle._finish(error=e)
+            raise
         finally:
             with self._lock:
                 self._active_tmp.discard(tmp)
+
+    def _write_one(self, step, state, handle, tmp=None):
+        host, num_hosts = state_mod._jax_process_info()
+        shared = num_hosts > 1
+        peer = shared and host != 0
+        try:
+            if tmp is None and not shared:
+                # single-host saves retry transient I/O under the unified
+                # policy: each attempt is a whole fresh stage+commit, so
+                # atomicity is per attempt. Pre-staged dirs (extra
+                # writers) and multi-host shared staging run ONE attempt —
+                # a retry would have to discard a dir peers share.
+                path = self._write_retry.call(
+                    self._write_attempt, step, state, None, shared, host,
+                    num_hosts)
+            else:
+                path = self._write_attempt(step, state, tmp, shared, host,
+                                           num_hosts)
+            handle._finish(path=path)
+        except BaseException as e:  # surfaced at handle.wait()
+            handle._finish(error=e)
+        finally:
+            with self._lock:
                 self._handles[:] = [h for h in self._handles
                                     if not h.done() or h._err]
-        if shared and host != 0:
+        if peer:
             return  # retention/sweeping is the coordinator's job: another
             # host's listing must never rmtree a peer's in-flight staging
         try:
@@ -274,6 +313,7 @@ class CheckpointManager:
                 active = set(self._active_tmp)
             layout.clean_stale(self.directory, active=active)
         except Exception as e:
+            # tpulint: allow-swallowed-exception retention sweep is advisory; the next committed save re-runs it
             self.logger.warning("checkpoint retention sweep failed: %s", e)
 
     def _await_host_files(self, tmp, num_hosts, timeout=600.0):
@@ -361,6 +401,7 @@ class CheckpointManager:
         try:
             self.wait(timeout=60.0)
         except Exception as e:
+            # tpulint: allow-swallowed-exception interpreter is exiting; logging is all that is left to do
             self.logger.error("checkpoint flush at exit: %s", e)
 
     # ------------------------------------------------------------------
@@ -530,6 +571,10 @@ class CheckpointManager:
             self.logger.warning("signal %d: flushing final checkpoint",
                                 signum)
             try:
+                # preemption-timing fault hook: chaos tests inject a delay
+                # (slow flush vs the preemptor's grace period) or an error
+                # here to exercise the flush under duress
+                _faults.fault_point("checkpoint.preempt", signum=signum)
                 # drain queued boundary saves FIRST: the mid-epoch flush
                 # below may reuse the current epoch's step number, and a
                 # concurrent in-queue write of that step would race the
@@ -537,6 +582,7 @@ class CheckpointManager:
                 try:
                     self.wait(timeout=300.0)
                 except Exception as e:
+                    # tpulint: allow-swallowed-exception queue drain is best-effort under preemption; the blocking final save below still runs
                     self.logger.error("preemption flush: %s", e)
                 cap = capture or self._live_capture
                 if cap is not None:

@@ -143,6 +143,25 @@ def restore_optimizer_attrs(dst, src):
         dst.lr_scheduler = src.lr_scheduler
 
 
+def _donation_safe_tree(tree):
+    """Device-copy every jax leaf of a fused-step state tree at CAPTURE
+    time. The fused train step DONATES its opt_state buffers, so the
+    next step DELETES the tree a zero-copy capture would be holding —
+    the async writer then serializes a dead buffer ("Array has been
+    deleted", a race the chaos verify drive exposed). The
+    device-to-device copy is enqueued on the capture thread BEFORE any
+    later step's donation, so XLA stream ordering guarantees it reads
+    valid data, and the copy itself is a buffer nobody donates. (The
+    eager updater path never donates; its zero-copy snapshot_tree
+    pinning stays correct and cheaper.)"""
+    import jax
+    import jax.numpy as jnp
+
+    def _copy(v):
+        return jnp.copy(v) if isinstance(v, jax.Array) else v
+    return jax.tree_util.tree_map(_copy, tree)
+
+
 def capture_optimizer(mod):
     """(payload dict with pinned trees, extra_writers) for a Module's
     optimizer state; payload is None when no optimizer is initialized."""
@@ -150,15 +169,18 @@ def capture_optimizer(mod):
         return None, []
     if getattr(mod, "_fused_step", None) is not None:
         step = mod._fused_step
-        # opt_state is replaced functionally every iteration — holding
-        # the current tree IS the point-in-time snapshot. Under
-        # MXNET_TPU_ZERO the per-param slots are (dp, chunk) shard
-        # blocks; the layout manifest rides along so restore can
-        # reassemble canonical per-param slots — including under a
-        # DIFFERENT replica count, or into a non-sharded step.
+        # opt_state is replaced functionally every iteration, but its
+        # buffers are DONATED to the next step's update — the snapshot
+        # must device-copy them now (see _donation_safe_tree) or the
+        # async writer races the donation and serializes deleted
+        # buffers. Under MXNET_TPU_ZERO the per-param slots are
+        # (dp, chunk) shard blocks (jnp.copy preserves the sharding);
+        # the layout manifest rides along so restore can reassemble
+        # canonical per-param slots — including under a DIFFERENT
+        # replica count, or into a non-sharded step.
         payload = {_OPT_FORMAT_KEY: 1, "kind": "fused",
                    "optimizer": _clean_optimizer(step.optimizer),
-                   "state": step.opt_state}
+                   "state": _donation_safe_tree(step.opt_state)}
         zero_meta = getattr(step, "opt_state_layout_meta", lambda: None)()
         if zero_meta is not None:
             payload["zero"] = zero_meta

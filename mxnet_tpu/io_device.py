@@ -91,6 +91,11 @@ class DevicePrefetchIter(DataIter):
         self._stop = threading.Event()
         self._thread = None
         self._terminal = None
+        # the worker's real exception, kept OUTSIDE the queue transport:
+        # if the terminal sentinel is ever lost (a put() raced shutdown),
+        # the training loop's error still carries the root cause instead
+        # of a generic death message
+        self._worker_error = None
         self.counters = {"hits": 0, "stalls": 0, "stall_ms": 0.0, "staged": 0}
 
     # ------------------------------------------------------------------
@@ -109,21 +114,41 @@ class DevicePrefetchIter(DataIter):
     # ------------------------------------------------------------------
     def _worker(self):
         from . import profiler as _prof
+        from .resilience import faults as _faults
+        from .resilience.retry import RetryPolicy
+        from .resilience.watchdog import watchdog as _watchdog
+        hb = _watchdog().register("mx-device-prefetch", thread=self._thread)
+        # transient H2D staging failures (device hiccup, OOM-race on a
+        # shared host) retry under the one policy instead of killing the
+        # whole epoch's pipeline on the first blip
+        stage_retry = RetryPolicy(site="prefetch.stage")
+
+        def _stage_once(b):
+            _faults.fault_point("prefetch.stage",
+                                staged=self.counters["staged"])
+            return self.stage_fn(b)
+
         try:
             while not self._stop.is_set():
+                hb.beat()
                 try:
                     batch = self.base.next()
                 except StopIteration:
                     self._put(self._STOP)
                     return
                 t0 = time.perf_counter()
-                staged = self.stage_fn(batch)
+                staged = stage_retry.call(_stage_once, batch)
                 _prof.record_pipeline_event(
                     prefetch_stage_ms=(time.perf_counter() - t0) * 1e3)
                 self.counters["staged"] += 1
+                hb.idle()  # a put() blocked on a full queue is downstream
+                #            backpressure, not a prefetch stall
                 self._put(staged)
         except BaseException as e:  # transported to next(), then sticky
+            self._worker_error = e
             self._put(e)
+        finally:
+            hb.close()
 
     def _put(self, item):
         # bounded put that a concurrent reset() can always interrupt
@@ -132,7 +157,7 @@ class DevicePrefetchIter(DataIter):
                 self._queue.put(item, timeout=0.05)
                 return
             except queue.Full:
-                pass
+                pass  # tpulint: allow-swallowed-exception bounded-put poll: Full just re-checks the stop flag
 
     def _start(self):
         self._thread = threading.Thread(target=self._worker,
@@ -149,14 +174,14 @@ class DevicePrefetchIter(DataIter):
             try:
                 self._queue.get(timeout=0.05)
             except queue.Empty:
-                pass
+                pass  # tpulint: allow-swallowed-exception shutdown drain poll: Empty re-checks worker liveness
         self._thread.join(timeout=5)
         self._thread = None
         while True:
             try:
                 self._queue.get_nowait()
             except queue.Empty:
-                break
+                break  # tpulint: allow-swallowed-exception queue fully drained: Empty IS the exit condition
         self._stop.clear()
 
     # ------------------------------------------------------------------
@@ -164,6 +189,7 @@ class DevicePrefetchIter(DataIter):
         self._shutdown()
         self.base.reset()
         self._terminal = None
+        self._worker_error = None
         # worker restarts lazily on the next next(): after the final epoch
         # the base iterator is left freshly reset, not advanced by an
         # eagerly-refilling stager
@@ -193,9 +219,14 @@ class DevicePrefetchIter(DataIter):
                             item = self._queue.get_nowait()
                             break
                         except queue.Empty:
-                            self._terminal = MXNetError(
-                                "device prefetch worker died "
-                                "without a sentinel")
+                            cause = self._worker_error
+                            msg = "device prefetch worker died " \
+                                  "without a sentinel"
+                            if cause is not None:
+                                msg += " (root cause: %s: %s)" \
+                                    % (type(cause).__name__, cause)
+                            self._terminal = MXNetError(msg)
+                            self._terminal.__cause__ = cause
                             raise self._terminal
             stall_ms = (time.perf_counter() - t0) * 1e3
         if item is self._STOP:
@@ -223,4 +254,4 @@ class DevicePrefetchIter(DataIter):
         try:
             self._stop.set()
         except Exception:
-            pass
+            pass  # tpulint: allow-swallowed-exception interpreter-teardown destructor must never raise

@@ -61,8 +61,20 @@ from .base import MXNetError
 from .kvstore import KVStore, _key_list, _val_list
 from .ndarray import sparse as _mx_sparse
 from .ndarray.ndarray import array
+from .resilience import faults as _faults
+from .resilience.retry import RetryPolicy, TransientError
 
-__all__ = ["AsyncParamServer", "KVStoreDistAsync", "serve_forever"]
+__all__ = ["AsyncParamServer", "KVStoreDistAsync", "serve_forever",
+           "TransportError"]
+
+
+class TransportError(TransientError):
+    """Connection-level dist_async failure (socket error, server closed
+    the connection mid-round-trip) — typed apart from application errors
+    the server reports, because only transport failures of IDEMPOTENT
+    operations (the pull family) are safe to retry: a retried push whose
+    original the server DID apply before dying would double-apply the
+    optimizer update."""
 
 
 def _stable_hash(key):
@@ -449,9 +461,14 @@ class KVStoreDistAsync(KVStore):
                 "`tools/launch.py -n <workers> --num-servers N` (sets "
                 "DMLC_PS_ROOT_URI/PORT), or start "
                 "`python -m mxnet_tpu.kvstore_server` with DMLC_ROLE=server")
+        self._endpoints = _server_endpoints()
         self._socks = [self._connect_with_retry(host, port)
-                       for host, port in _server_endpoints()]
+                       for host, port in self._endpoints]
         self._sock_locks = [threading.Lock() for _ in self._socks]
+        # transport retry: IDEMPOTENT round-trips only (see _rpc_scatter);
+        # each attempt reconnects whatever sockets the last one broke
+        self._idempotent_retry = RetryPolicy(site="kvstore.pull",
+                                             retryable=TransportError)
 
     @property
     def num_servers(self):
@@ -460,22 +477,22 @@ class KVStoreDistAsync(KVStore):
     @staticmethod
     def _connect_with_retry(uri, port, deadline_s=60.0):
         """The server process may still be binding when workers start
-        (launch.py spawns both concurrently) — retry briefly."""
-        import time
-        end = time.time() + deadline_s
-        while True:
-            try:
-                return socket.create_connection((uri, port), timeout=300.0)
-            except OSError as e:
-                if time.time() > end:
-                    raise MXNetError(
-                        "could not reach dist_async server at %s:%d within "
-                        "%.0fs (%s). If the server runs on another host, "
-                        "it binds 127.0.0.1 by default — set "
-                        "DMLC_PS_BIND_ADDR on the server (empty string = "
-                        "all interfaces; trusted networks only)"
-                        % (uri, port, deadline_s, e)) from e
-                time.sleep(0.2)
+        (launch.py spawns both concurrently) — retry under the unified
+        backoff policy until the deadline budget runs out."""
+        policy = RetryPolicy(attempts=1000, base_delay_s=0.05,
+                             cap_delay_s=0.5, deadline_s=deadline_s,
+                             retryable=OSError, site="kvstore.connect")
+        try:
+            return policy.call(socket.create_connection, (uri, port),
+                               timeout=300.0)
+        except OSError as e:
+            raise MXNetError(
+                "could not reach dist_async server at %s:%d within "
+                "%.0fs (%s). If the server runs on another host, "
+                "it binds 127.0.0.1 by default — set "
+                "DMLC_PS_BIND_ADDR on the server (empty string = "
+                "all interfaces; trusted networks only)"
+                % (uri, port, deadline_s, e)) from e
 
     # identity from the DMLC env, NOT jax.process_*: async workers are
     # independent processes, no jax.distributed mesh exists
@@ -495,34 +512,94 @@ class KVStoreDistAsync(KVStore):
                 "worker API calls belong on worker processes"
                 % os.environ.get("DMLC_ROLE"))
 
-    def _rpc(self, server, *msg):
-        return self._rpc_scatter([(server, msg)])[0]
+    def _rpc(self, server, *msg, idempotent=False):
+        return self._rpc_scatter([(server, msg)],
+                                 idempotent=idempotent)[0]
 
-    def _rpc_scatter(self, calls):
+    def _rpc_scatter(self, calls, idempotent=False):
         """One round-trip to several servers, overlapped: send every
         request first, then collect replies — per-key shard latency is
         max(server round-trips), not their sum. `calls` is
-        [(server, msg tuple)] with at most one call per server."""
+        [(server, msg tuple)] with at most one call per server.
+
+        ``idempotent=True`` (the pull/stats family — reads with no
+        server-side effect) retries TRANSPORT failures under the unified
+        backoff policy, reconnecting broken sockets between attempts.
+        Effectful ops (push, init, set_optimizer, barrier) never retry:
+        a server may have applied the original before the connection
+        died, and re-applying a push double-counts the gradient."""
+        if idempotent:
+            return self._idempotent_retry.call(self._rpc_scatter_once,
+                                               calls)
+        return self._rpc_scatter_once(calls)
+
+    def _reconnect_locked(self, s):
+        """Rebuild server `s`'s socket (caller holds its lock). A short
+        deadline: the retry policy above owns the long-haul waiting."""
+        host, port = self._endpoints[s]
+        self._socks[s] = self._connect_with_retry(host, port,
+                                                  deadline_s=10.0)
+        return self._socks[s]
+
+    def _break_locked(self, s):
+        """Mark server `s`'s connection dead (caller holds its lock): a
+        half-finished round-trip leaves an unreadable request/reply
+        stream, so the socket must never be reused."""
+        sock = self._socks[s]
+        self._socks[s] = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass  # tpulint: allow-swallowed-exception socket already dead; close is best-effort hygiene
+        return TransportError("dist_async server %d connection broken" % s)
+
+    def _rpc_scatter_once(self, calls):
         self._require_worker()
         for s, _ in calls:
             self._sock_locks[s].acquire()
         try:
+            sent = []
             for s, msg in calls:
-                _send_msg(self._socks[s], msg)
+                sock = self._socks[s]
+                if sock is None:  # broken by a previous round-trip
+                    sock = self._reconnect_locked(s)
+                try:
+                    _send_msg(sock, msg)
+                except OSError as e:
+                    # a half-sent scatter poisons EVERY socket already
+                    # sent to this attempt: their replies will arrive
+                    # unread, and reusing such a connection would pair
+                    # the NEXT request with this round's stale reply.
+                    # Break them all so a retry reconnects fresh.
+                    err = self._break_locked(s)
+                    for prev in sent:
+                        self._break_locked(prev)
+                    raise err from e
+                sent.append(s)
             # drain EVERY reply before raising: leaving an unread reply in
             # a socket buffer desyncs that connection's request/reply
             # protocol for good (the next RPC would read this stale one)
-            replies, errors = [], []
+            replies, errors, transport_only = [], [], True
             for s, _ in calls:
-                reply = _recv_msg(self._socks[s])
+                try:
+                    reply = _recv_msg(self._socks[s])
+                except OSError:
+                    reply = None
                 if reply is None:
+                    self._break_locked(s)
                     errors.append("server %d closed the connection" % s)
                 elif reply[0] == "error":
+                    transport_only = False
                     errors.append("server %d: %s" % (s, reply[1]))
                 else:
                     replies.append(reply)
             if errors:
-                raise MXNetError("dist_async " + "; ".join(errors))
+                # typed: pure connection-level failure is retryable (for
+                # idempotent calls); any APPLICATION error from a server
+                # must surface as-is, never be retried into a double-apply
+                cls = TransportError if transport_only else MXNetError
+                raise cls("dist_async " + "; ".join(errors))
             return replies
         finally:
             for s, _ in calls:
@@ -575,6 +652,7 @@ class KVStoreDistAsync(KVStore):
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
+            _faults.fault_point("kvstore.push", key=str(k))
             if self._gc.active:
                 vlist = self._compress_vlist(str(k), vlist)
             merged = self._merge(vlist)
@@ -607,16 +685,18 @@ class KVStoreDistAsync(KVStore):
         keys, _ = _key_list(key)
         outs = _val_list(out, len(keys))
         for k, olist in zip(keys, outs):
+            _faults.fault_point("kvstore.pull", key=str(k))
             # placement is derivable from the out buffer, so a fresh
             # process (worker restart, eval-only attach) can pull keys it
             # never init-ed as long as the servers hold them
             plan = self._placement(str(k), olist[0])
             if plan[0][1] is None:
-                weights = self._rpc(plan[0][0], "pull", str(k))[1]
+                weights = self._rpc(plan[0][0], "pull", str(k),
+                                    idempotent=True)[1]
             else:
                 replies = self._rpc_scatter(
                     [(s, ("pull", self._subkey(str(k), s, False)))
-                     for s, _, _ in plan])
+                     for s, _, _ in plan], idempotent=True)
                 weights = _np.concatenate([r[1] for r in replies], axis=0)
             for o in olist:
                 o[:] = array(weights)
@@ -641,7 +721,8 @@ class KVStoreDistAsync(KVStore):
             if rows.size == 0:
                 vals = _np.zeros((0,) + row_shape, _np.float32)
             elif plan[0][1] is None:
-                vals = self._rpc(plan[0][0], "pull_rows", str(k), rows)[1]
+                vals = self._rpc(plan[0][0], "pull_rows", str(k), rows,
+                                 idempotent=True)[1]
             else:
                 calls, kept = [], []
                 for s, r0, r1 in plan:
@@ -652,7 +733,7 @@ class KVStoreDistAsync(KVStore):
                                           rows[mask] - r0)))
                         kept.append(rows[mask])
                 if calls:
-                    replies = self._rpc_scatter(calls)
+                    replies = self._rpc_scatter(calls, idempotent=True)
                     vals = _np.concatenate([r[1] for r in replies], axis=0)
                     rows = _np.concatenate(kept)
                 else:
@@ -687,7 +768,8 @@ class KVStoreDistAsync(KVStore):
         hook (key accounting proves where shards landed)."""
         self._require_worker()
         per = [r[1] for r in self._rpc_scatter(
-            [(s, ("stats",)) for s in range(len(self._socks))])]
+            [(s, ("stats",)) for s in range(len(self._socks))],
+            idempotent=True)]
         return {"push_count": sum(p["push_count"] for p in per),
                 "num_keys": sum(p["num_keys"] for p in per),
                 "per_server": per}

@@ -18,7 +18,10 @@ __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
            "record_analysis_check", "record_analysis_finding",
            "analysis_counters", "record_kernel_roofline", "kernel_counters",
            "record_zero_sharding", "zero_counters",
-           "record_latency", "latency_counters"]
+           "record_latency", "latency_counters",
+           "record_retry", "retry_counters",
+           "record_watchdog_event", "watchdog_counters",
+           "record_fault_injection", "fault_counters"]
 
 _state = {"running": False, "filename": "profile.json", "events": [],
           "jax_trace_dir": None, "lock": threading.Lock()}
@@ -309,6 +312,86 @@ def latency_counters(reset=False, prefix=None):
             else:
                 for key in [k for k in _latency if k.startswith(prefix)]:
                     del _latency[key]
+    return out
+
+
+# ----------------------------------------------------------------------
+# resilience counters (ISSUE 9): the retry/backoff policy, the thread
+# watchdog, and the fault-injection registry each record here — always-on
+# plain adds like the pipeline family, so chaos tests and operators can
+# assert "N retries, M recoveries, zero giveups" (or "the stall WAS
+# detected") without a profiler session or a debugger.
+# ----------------------------------------------------------------------
+_RETRY_ZERO = {"retries": 0, "recoveries": 0, "giveups": 0}
+_retry = dict(_RETRY_ZERO)
+_WATCHDOG_ZERO = {"stalls": 0, "deaths": 0, "restarts": 0,
+                  "stall_recoveries": 0}
+_watchdog = dict(_WATCHDOG_ZERO)
+_faults = {"injected": 0}
+
+
+def record_retry(site, outcome):
+    """Count one retry-policy event for `site` (e.g. "checkpoint.write").
+    `outcome`: "retry" (a failed attempt that will be retried),
+    "recovery" (success after >= 1 retry), "giveup" (attempts/budget
+    exhausted — the error surfaced)."""
+    total_key = {"retry": "retries", "recovery": "recoveries",
+                 "giveup": "giveups"}.get(outcome)
+    with _state["lock"]:
+        if total_key is not None:
+            _retry[total_key] += 1
+        key = "%s.%s" % (site, outcome)
+        _retry[key] = _retry.get(key, 0) + 1
+
+
+def retry_counters(reset=False):
+    """Snapshot (optionally reset) the retry counters: totals plus
+    per-site `<site>.retry` / `<site>.recovery` / `<site>.giveup` keys."""
+    with _state["lock"]:
+        out = dict(_retry)
+        if reset:
+            _retry.clear()
+            _retry.update(_RETRY_ZERO)
+    return out
+
+
+def record_watchdog_event(name, event):
+    """Count one watchdog observation for thread `name`. `event`: "stall",
+    "stall_recovered", "death", "restart", "restart_failed"."""
+    total_key = {"stall": "stalls", "death": "deaths",
+                 "restart": "restarts",
+                 "stall_recovered": "stall_recoveries"}.get(event)
+    with _state["lock"]:
+        if total_key is not None:
+            _watchdog[total_key] += 1
+        key = "%s.%s" % (name, event)
+        _watchdog[key] = _watchdog.get(key, 0) + 1
+
+
+def watchdog_counters(reset=False):
+    """Snapshot (optionally reset) the watchdog stall/death counters."""
+    with _state["lock"]:
+        out = dict(_watchdog)
+        if reset:
+            _watchdog.clear()
+            _watchdog.update(_WATCHDOG_ZERO)
+    return out
+
+
+def record_fault_injection(site):
+    """Count one fired injected fault (resilience.faults)."""
+    with _state["lock"]:
+        _faults["injected"] += 1
+        _faults[site] = _faults.get(site, 0) + 1
+
+
+def fault_counters(reset=False):
+    """Snapshot (optionally reset) injected-fault counts per site."""
+    with _state["lock"]:
+        out = dict(_faults)
+        if reset:
+            _faults.clear()
+            _faults["injected"] = 0
     return out
 
 

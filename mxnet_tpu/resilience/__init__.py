@@ -1,0 +1,23 @@
+"""Resilience layer (ISSUE 9, docs/faq/resilience.md): deterministic
+fault injection, one retry/backoff policy, and thread watchdogs — the
+pieces that make the long-lived subsystems (serving tier, async
+checkpointing, device prefetch, kvstore transport) fail *predictably*
+and recover *provably*.
+
+    from mxnet_tpu.resilience import fault_point, RetryPolicy, watchdog
+
+Fault injection is configured by ``MXNET_TPU_FAULT_SPEC`` (grammar in
+faults.py / docs/faq/resilience.md) and is a zero-overhead no-op when
+the spec is unset. The serving tier's per-replica circuit breaker lives
+with its subject in ``serving/server.py``; this package holds the
+cross-cutting machinery.
+"""
+from .faults import (fault_point, configure, reset, enabled, stats,
+                     FaultInjected, TransientError)
+from .retry import RetryPolicy, RETRYABLE_DEFAULT, retry_call
+from .watchdog import Watchdog, Heartbeat, watchdog
+
+__all__ = ["fault_point", "configure", "reset", "enabled", "stats",
+           "FaultInjected", "TransientError", "RetryPolicy",
+           "RETRYABLE_DEFAULT", "retry_call", "Watchdog", "Heartbeat",
+           "watchdog"]

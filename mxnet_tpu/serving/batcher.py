@@ -158,7 +158,7 @@ class _Request:
             try:
                 fn(self)
             except Exception:
-                pass  # an observer must never poison the delivery path
+                pass  # tpulint: allow-swallowed-exception an observer callback must never poison the delivery path
 
 
 class DynamicBatcher:
@@ -243,6 +243,7 @@ class DynamicBatcher:
         self._cv = threading.Condition()
         self._stopped = False
         self._worker = None
+        self._hb = None          # watchdog heartbeat of the live worker
         self._autostart = autostart
         self.batches_run = 0
         self.requests = 0
@@ -335,11 +336,18 @@ class DynamicBatcher:
 
     def _ensure_worker(self):
         if self._worker is None or not self._worker.is_alive():
+            from ..resilience.watchdog import watchdog as _watchdog
             with self._cv:
                 if self._worker is None or not self._worker.is_alive():
                     self._worker = threading.Thread(
                         target=self._loop, name="mx-serving-batcher",
                         daemon=True)
+                    # each (re)started worker registers its own heartbeat;
+                    # a crashed predecessor is surfaced by the monitor as
+                    # a death, and this path is what restarts it
+                    self._hb = _watchdog().register(
+                        "batcher:%s" % (self._lat_key or "serving"),
+                        thread=self._worker)
                     self._worker.start()
 
     def stop(self):
@@ -497,13 +505,25 @@ class DynamicBatcher:
                         "serving batch failed: %s" % e))
 
     def _loop(self):
+        hb = self._hb
         while True:
             group, total = self._take_group(wait=True)
             if group:
+                if hb is not None:
+                    hb.beat()   # busy only across the dispatch — the
+                    #             idle cv wait is supposed to be silent
                 self._run_group(group, total)
+                if hb is not None:
+                    hb.idle()
                 continue
             with self._cv:
                 if self._stopped and not self._queue:
+                    # close ONLY on the clean stop path: an unexpected
+                    # crash must leave the heartbeat open so the
+                    # watchdog monitor records the death (a closed
+                    # heartbeat is indistinguishable from stop())
+                    if hb is not None:
+                        hb.close()
                     return
 
     def flush(self):

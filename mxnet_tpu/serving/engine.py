@@ -29,12 +29,65 @@ import numpy as _np
 from ..base import MXNetError, get_env
 from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray, zeros as _nd_zeros, _new_from_jax
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy, TransientError
 from .program_cache import BucketedProgramCache, DEFAULT_BUCKETS
 from .batcher import DynamicBatcher
 
 __all__ = ["InferenceEngine"]
 
 _QSUF = "_quantize"
+
+
+def _reload_retry_policy():
+    """THE definition of 'transient' for checkpoint reloads, shared by
+    the engine- and ModelServer-level pollers: framework-typed errors
+    (unknown model, validation) surface immediately; everything else —
+    OSError, partial-dir unpickling, retention-pruning races — retries
+    under the unified backoff (the policy itself never retries
+    non-Exception BaseExceptions like KeyboardInterrupt)."""
+    return RetryPolicy(
+        site="serving.reload",
+        retryable=lambda e: (isinstance(e, TransientError)
+                             or not isinstance(e, MXNetError)))
+
+
+def _run_reload_poller(hb_name, target_desc, poll_interval, stop_evt,
+                       reload_once):
+    """Shared checkpoint-poller daemon body (engine + ModelServer
+    `reload_from`): repeated load failures (a corrupt or perpetually-
+    partial checkpoint dir) are RATE-LIMITED — each distinct error logs
+    once, repeats only count
+    (`profiler.retry_counters()["serving.reload.poll_failure"]`) — and
+    serving keeps the old weights throughout. Watchdog-supervised via
+    the CALLING thread (this function runs inside the poller daemon)."""
+    import threading as _threading
+    from .. import profiler as _prof
+    from ..resilience.watchdog import watchdog as _watchdog
+    hb = _watchdog().register(hb_name,
+                              thread=_threading.current_thread())
+    last_sig = None
+    try:
+        while not stop_evt.wait(poll_interval):
+            hb.beat()
+            try:
+                reload_once()
+            except Exception as e:  # keep serving the old weights
+                _prof.record_retry("serving.reload", "poll_failure")
+                sig = "%s: %s" % (type(e).__name__, e)
+                if sig != last_sig:
+                    logging.warning(
+                        "%s: %s (repeats of this error are counted, "
+                        "not logged)", target_desc, e)
+                    last_sig = sig
+            else:
+                if last_sig is not None:
+                    logging.info("%s: recovered", target_desc)
+                    last_sig = None
+            hb.idle()
+    finally:
+        hb.close()  # every exit here is handled (the body swallows
+        #             poll errors): retirement, not a death
 
 
 class InferenceEngine:
@@ -95,6 +148,8 @@ class InferenceEngine:
                      else current_context())
         self._device = self._ctx.jax_device
         self.name = name
+        self.replica = None   # replica index when owned by a ModelServer
+        #                       (fault-spec matcher + breaker identity)
         self._lat_key = "serving.%s" % name if name else "serving"
         if default_deadline_ms is None:
             default_deadline_ms = get_env("MXNET_SERVING_DEADLINE_MS",
@@ -183,6 +238,12 @@ class InferenceEngine:
         self._reload_dir = None
         self._reload_stop = threading.Event()
         self._reload_thread = None
+        # unified transient-failure policy for checkpoint loads: retention
+        # pruning / re-commits remove dirs between discovery and read, so
+        # anything that is NOT a framework-typed error re-resolves
+        # "latest" and retries under backoff (resilience layer; replaces
+        # the ad-hoc 3-attempt/0.1s loop)
+        self._reload_retry = _reload_retry_policy()
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -326,49 +387,47 @@ class InferenceEngine:
             # on its next check instead of being revived by a new start
             stop_evt = threading.Event()
             self._reload_stop = stop_evt
-
-            def _poll():
-                while not stop_evt.wait(poll_interval):
-                    try:
-                        self._reload_once(directory)
-                    except Exception as e:  # keep serving the old weights
-                        logging.warning("reload_from(%s): %s", directory, e)
             self._reload_thread = threading.Thread(
-                target=_poll, name="mx-serving-reload", daemon=True)
+                target=self._poll_loop, name="mx-serving-reload",
+                args=(directory, poll_interval, stop_evt), daemon=True)
             self._reload_thread.start()
         return loaded
 
-    def _reload_once(self, directory, _retries=3):
+    def _poll_loop(self, directory, poll_interval, stop_evt):
+        """Checkpoint-poller daemon body (see `_run_reload_poller` for
+        the shared rate-limit/watchdog semantics)."""
+        _run_reload_poller("mx-serving-reload:%s" % self._lat_key,
+                           "reload_from(%s)" % directory,
+                           poll_interval, stop_evt,
+                           lambda: self._reload_once(directory))
+
+    def _reload_once(self, directory):
+        return self._reload_retry.call(self._reload_attempt, directory)
+
+    def _reload_attempt(self, directory):
+        """One discovery+load+swap attempt (the retry policy re-runs the
+        WHOLE attempt: retention pruning or a same-step re-commit can
+        remove the dir between discovery and read, so 'latest' must be
+        re-resolved per attempt)."""
         from .. import checkpoint as ckpt
-        for attempt in range(_retries):
-            path = ckpt.latest_checkpoint(directory)
-            if path is None:
-                return None
-            step = None
-            try:
-                meta = ckpt.read_meta(path)
-                step = meta.get("step")
-                if step is not None and self._reload_step is not None \
-                        and step <= self._reload_step:
-                    # NEWER-only: a re-commit of the current step briefly
-                    # makes an older step the "latest" (commit unlinks
-                    # before replacing); swapping back would serve stale
-                    # weights for a poll interval
-                    return None
-                arg_params, aux_params = ckpt.load_params(path)
-            except Exception:
-                # transient by construction: retention pruning or a
-                # same-step re-commit removed the dir between discovery
-                # and read — re-resolve "latest" and try again
-                if attempt == _retries - 1:
-                    raise
-                import time as _time
-                _time.sleep(0.1)
-                continue
-            self.update_params(arg_params, aux_params)
-            self._reload_step = step
-            return step
-        return None
+        _faults.fault_point("serving.reload", directory=directory,
+                            engine=self.name or "")
+        path = ckpt.latest_checkpoint(directory)
+        if path is None:
+            return None
+        meta = ckpt.read_meta(path)
+        step = meta.get("step")
+        if step is not None and self._reload_step is not None \
+                and step <= self._reload_step:
+            # NEWER-only: a re-commit of the current step briefly
+            # makes an older step the "latest" (commit unlinks
+            # before replacing); swapping back would serve stale
+            # weights for a poll interval
+            return None
+        arg_params, aux_params = ckpt.load_params(path)
+        self.update_params(arg_params, aux_params)
+        self._reload_step = step
+        return step
 
     # ------------------------------------------------------------------
     # shape templates
@@ -555,6 +614,13 @@ class InferenceEngine:
         sample instead. Steady state stays fully async."""
         import jax
         bucket = int(next(iter(padded.values())).shape[0]) if padded else n
+        # replica-kill hook: a chaos spec matching this engine/replica
+        # fails the whole coalesced batch here, exactly like a sick
+        # device would — the ModelServer's breaker + resubmit path is
+        # what must keep the requests alive
+        _faults.fault_point("serving.dispatch", engine=self.name or "",
+                            replica="" if self.replica is None
+                            else self.replica, mode="async")
         compiles_before = self._cache.compiles
         tic = time.monotonic()
         outs = self._cache.run(self._stage(padded), self._params,
@@ -596,6 +662,9 @@ class InferenceEngine:
         tic = time.monotonic()
         arrays, n = self._normalize_request(data, keep_device=True)
         bucket = self._cache.bucket_for(n)
+        _faults.fault_point("serving.dispatch", engine=self.name or "",
+                            replica="" if self.replica is None
+                            else self.replica, mode="sync")
         staged = {}
         for name, arr in arrays.items():
             padded = self._pad_rows(arr, n, bucket)

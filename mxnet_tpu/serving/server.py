@@ -44,29 +44,249 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
 from ..base import MXNetError, get_env
 from ..context import Context, current_context
-from .engine import InferenceEngine
+from ..resilience import faults as _faults
+from .batcher import DeadlineExceeded
+from .engine import (InferenceEngine, _reload_retry_policy,
+                     _run_reload_poller)
 
 __all__ = ["ModelServer"]
 
 
-class _Replica:
-    __slots__ = ("engine", "inflight")
+class _Breaker:
+    """Per-replica circuit breaker (graceful degradation, ISSUE 9).
 
-    def __init__(self, engine):
+    ``threshold`` consecutive dispatch failures OPEN the breaker: the
+    replica stops receiving traffic (dispatch routes around it through
+    the existing least-loaded path), so one sick replica costs capacity,
+    never correctness. After ``cooldown_s`` the breaker goes HALF-OPEN:
+    exactly one probe request is admitted — success closes the breaker,
+    failure re-opens it for another cooldown. Sheds (DeadlineExceeded)
+    are load, not sickness: they touch neither the failure streak nor a
+    success reset.
+
+    All state mutations run under the ModelServer registry lock."""
+
+    __slots__ = ("threshold", "cooldown_s", "failures", "state",
+                 "opened_at", "opens", "probing")
+
+    def __init__(self, threshold, cooldown_s):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self.failures = 0
+        self.state = "closed"
+        self.opened_at = None
+        self.opens = 0
+        self.probing = False
+
+    def available(self, now):
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            return now - self.opened_at >= self.cooldown_s
+        return not self.probing  # half-open: one probe at a time
+
+    def note_dispatch(self, now):
+        """Called when dispatch picks this replica (post-`available`)."""
+        if self.state == "open" and now - self.opened_at >= self.cooldown_s:
+            self.state = "half_open"
+            self.probing = True
+        elif self.state == "half_open":
+            self.probing = True
+
+    def on_success(self):
+        self.failures = 0
+        self.probing = False
+        if self.state != "closed":
+            self.state = "closed"
+            self.opened_at = None
+
+    def on_failure(self, now):
+        self.failures += 1
+        self.probing = False
+        if self.state == "half_open" or (self.state == "closed"
+                                         and self.failures
+                                         >= self.threshold):
+            self.state = "open"
+            self.opened_at = now
+            self.opens += 1
+            return True  # newly opened (caller records the counter)
+        if self.state == "open":
+            self.opened_at = now  # forced dispatch failed: restart cooldown
+        return False
+
+    def snapshot(self):
+        return {"state": self.state, "consecutive_failures": self.failures,
+                "opens": self.opens}
+
+
+class _Replica:
+    __slots__ = ("engine", "inflight", "breaker")
+
+    def __init__(self, engine, breaker):
         self.engine = engine
         self.inflight = 0
+        self.breaker = breaker
 
 
 class _ModelEntry:
-    __slots__ = ("versions", "default_version", "reload_step")
+    __slots__ = ("versions", "default_version", "reload_step", "counters")
 
     def __init__(self):
         self.versions = {}        # label -> list of _Replica
         self.default_version = None
         self.reload_step = None   # checkpoint-poller watermark
+        # request accounting (the chaos contract: submitted must equal
+        # served + shed + failed, with failed == 0 while any healthy
+        # replica remains)
+        self.counters = {"submitted": 0, "served": 0, "shed": 0,
+                         "failed": 0, "dispatch_retries": 0,
+                         "breaker_opens": 0}
+
+
+class _ServerRequest:
+    """Server-level future: proxies a replica-local batcher request and
+    RESUBMITS on dispatch failure.
+
+    A failed dispatch means the request was NEVER served (the batcher
+    resolves a failed group with an error, not a result), so resubmitting
+    to a different replica cannot double-serve — exactly-once by
+    construction. Sheds (`DeadlineExceeded`) pass through: the deadline
+    is global to the request, not per-replica. Retried attempts carry the
+    REMAINING deadline budget, and a budget exhausted mid-retry resolves
+    as a shed rather than burning a hopeless dispatch.
+
+    Same future surface as the batcher's `_Request` (``done()`` /
+    ``result_wait(timeout)`` / ``add_done_callback(fn)``), so callers and
+    the bench/CI accounting treat both alike."""
+
+    __slots__ = ("_server", "_name", "_version", "_data", "_priority",
+                 "_deadline", "_retries_left", "_tried", "_event",
+                 "_cb_lock", "_callbacks", "result", "error", "attempts",
+                 "_t_submit", "_inner")
+
+    def __init__(self, server, name, version, data, deadline_ms, priority,
+                 retries):
+        self._server = server
+        self._name = name
+        self._version = version
+        self._data = data
+        self._priority = priority
+        self._deadline = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1000.0
+        self._retries_left = retries
+        self._tried = set()
+        self._event = threading.Event()
+        self._cb_lock = threading.Lock()
+        self._callbacks = []
+        self.result = None
+        self.error = None
+        self.attempts = 0
+        self._t_submit = time.monotonic()
+        self._inner = None    # the FINAL replica-local request (timing)
+
+    # latency surface, proxied from the resolving attempt (t_submit is
+    # the server-level submit — queue wait spans resubmits too)
+    @property
+    def t_submit(self):
+        return self._t_submit
+
+    @property
+    def t_dispatch(self):
+        return self._inner.t_dispatch if self._inner is not None else None
+
+    @property
+    def t_done(self):
+        return self._inner.t_done if self._inner is not None else None
+
+    # -- future surface ------------------------------------------------
+    def done(self):
+        return self._event.is_set()
+
+    def result_wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise MXNetError("inference request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    def add_done_callback(self, fn):
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _resolve(self, result=None, error=None):
+        outcome = "served" if error is None else (
+            "shed" if isinstance(error, DeadlineExceeded) else "failed")
+        self._server._count(self._name, outcome)
+        self.result = result
+        self.error = error
+        with self._cb_lock:
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:
+                pass  # tpulint: allow-swallowed-exception an observer must never poison the delivery path (same contract as batcher._finish)
+
+    # -- dispatch ------------------------------------------------------
+    def _remaining_ms(self):
+        if self._deadline is None:
+            return None
+        return (self._deadline - time.monotonic()) * 1000.0
+
+    def _attempt(self):
+        """Acquire a replica and submit; raises on synchronous submit
+        failure (the caller decides whether that surfaces or resolves)."""
+        rep = self._server._acquire(self._name, self._version,
+                                    exclude=self._tried)
+        self.attempts += 1
+        deadline_ms = self._remaining_ms()
+        try:
+            fut = rep.engine.predict_async(self._data,
+                                           deadline_ms=deadline_ms,
+                                           priority=self._priority)
+        except BaseException:
+            self._server._complete(rep, "failure", self._name)
+            raise
+        fut.add_done_callback(
+            lambda inner, rep=rep: self._on_done(rep, inner))
+
+    def _on_done(self, rep, inner):
+        self._inner = inner
+        err = inner.error
+        if err is None:
+            self._server._complete(rep, "success", self._name)
+            self._resolve(result=inner.result)
+            return
+        if isinstance(err, DeadlineExceeded):
+            # load, not sickness: neutral for the breaker
+            self._server._complete(rep, "shed", self._name)
+            self._resolve(error=err)
+            return
+        self._server._complete(rep, "failure", self._name)
+        self._tried.add(rep)
+        if self._retries_left <= 0:
+            self._resolve(error=err)
+            return
+        remaining = self._remaining_ms()
+        if remaining is not None and remaining <= 0.0:
+            self._resolve(error=DeadlineExceeded(
+                "request shed: deadline budget consumed by a failed "
+                "dispatch (%s)" % err))
+            return
+        self._retries_left -= 1
+        self._server._count(self._name, "dispatch_retries")
+        try:
+            self._attempt()
+        except BaseException as e:  # retries exhaust replicas / stopped
+            self._resolve(error=e)
 
 
 def _replica_ctxs(base, replicas):
@@ -92,11 +312,33 @@ class ModelServer:
     `InferenceEngine` replicas; route by ``(model, version)`` with a
     default-version alias; swap weights live with zero recompiles."""
 
-    def __init__(self):
+    def __init__(self, breaker_threshold=None, breaker_cooldown_ms=None,
+                 dispatch_retries=None):
         self._lock = threading.Lock()
         self._models = {}
         self._pollers = {}    # model name -> (thread, stop_event)
         self._stopped = False
+        # graceful-degradation knobs (docs/faq/resilience.md): N
+        # consecutive dispatch failures open a replica's breaker, a
+        # cooldown later one half-open probe re-admits it; failed
+        # dispatches resubmit to a different replica up to
+        # `dispatch_retries` times
+        if breaker_threshold is None:
+            breaker_threshold = get_env("MXNET_SERVING_BREAKER_THRESHOLD",
+                                        3, int)
+        if breaker_cooldown_ms is None:
+            breaker_cooldown_ms = get_env(
+                "MXNET_SERVING_BREAKER_COOLDOWN_MS", 1000.0, float)
+        if dispatch_retries is None:
+            dispatch_retries = get_env("MXNET_SERVING_DISPATCH_RETRIES",
+                                       2, int)
+        if breaker_threshold < 1:
+            raise MXNetError("breaker_threshold must be >= 1, got %s"
+                             % breaker_threshold)
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown_s = float(breaker_cooldown_ms) / 1000.0
+        self._dispatch_retries = max(0, int(dispatch_retries))
+        self._reload_retry = _reload_retry_policy()
 
     # ------------------------------------------------------------------
     # registration
@@ -145,7 +387,11 @@ class ModelServer:
             engines = [engines]
         if not engines:
             raise MXNetError("register: need at least one engine")
-        reps = [_Replica(e) for e in engines]
+        for i, eng in enumerate(engines):
+            eng.replica = i   # fault-spec matcher + breaker identity
+        reps = [_Replica(e, _Breaker(self._breaker_threshold,
+                                     self._breaker_cooldown_s))
+                for e in engines]
         with self._lock:
             if self._stopped:
                 raise MXNetError("ModelServer is stopped")
@@ -245,45 +491,105 @@ class ModelServer:
                                                     key=str)))
         return label, reps
 
-    def _acquire(self, name, version):
-        """Pick the least-loaded replica and count the request in-flight
-        (the counter is what 'least-loaded' means — live queue depth, not
-        a stale round-robin)."""
+    def _acquire(self, name, version, exclude=()):
+        """Pick the least-loaded AVAILABLE replica and count the request
+        in-flight (the counter is what 'least-loaded' means — live queue
+        depth, not a stale round-robin).
+
+        Availability is the circuit breaker's verdict: open-breaker
+        replicas are routed around; a replica whose cooldown has elapsed
+        is admitted as a single half-open probe. ``exclude`` (the
+        resubmit path) removes replicas this request already failed on.
+        When NOTHING is available — every replica open/excluded — the
+        least-loaded replica is dispatched anyway (forced probe):
+        degraded capacity must never become a self-inflicted full
+        outage."""
+        now = time.monotonic()
         with self._lock:
             _, reps = self._resolve_locked(name, version)
-            rep = min(reps, key=lambda r: r.inflight)
+            avail = [r for r in reps
+                     if r not in exclude and r.breaker.available(now)]
+            if not avail:
+                avail = [r for r in reps if r.breaker.available(now)] \
+                    or list(reps)
+            rep = min(avail, key=lambda r: r.inflight)
+            rep.breaker.note_dispatch(now)
             rep.inflight += 1
             return rep
 
-    def _release(self, rep):
+    def _complete(self, rep, outcome, name=None):
+        """One dispatch finished on `rep`: release the in-flight slot and
+        feed the breaker. `outcome`: "success" | "failure" | "shed"
+        (sheds are overload, breaker-neutral)."""
         with self._lock:
             rep.inflight -= 1
+            if outcome == "success":
+                rep.breaker.on_success()
+            elif outcome == "failure":
+                if rep.breaker.on_failure(time.monotonic()):
+                    logging.warning(
+                        "serving breaker OPEN for %s replica %s after %d "
+                        "consecutive failures",
+                        rep.engine.name, rep.engine.replica,
+                        rep.breaker.failures)
+                    if name is not None:
+                        entry = self._models.get(name)
+                        if entry is not None:
+                            entry.counters["breaker_opens"] += 1
+
+    def _count(self, name, key, n=1):
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is not None and key in entry.counters:
+                entry.counters[key] += n
 
     def predict(self, name, data, version=None):
         """Synchronous inference on the (model, version)'s least-loaded
-        replica (default version when ``version`` is None)."""
-        rep = self._acquire(name, version)
-        try:
-            return rep.engine.predict(data)
-        finally:
-            self._release(rep)
+        available replica (default version when ``version`` is None). A
+        replica failure feeds its breaker and retries on a different
+        replica up to the server's ``dispatch_retries``. Counts into the
+        same per-model accounting as the async path (stats()'s
+        submitted == served + shed + failed invariant covers BOTH
+        surfaces)."""
+        with self._lock:
+            self._resolve_locked(name, version)  # unknown model/version
+            #                                      surfaces before counting
+        self._count(name, "submitted")
+        tried = set()
+        last_err = None
+        for _attempt in range(self._dispatch_retries + 1):
+            rep = self._acquire(name, version, exclude=tried)
+            try:
+                out = rep.engine.predict(data)
+            except BaseException as e:
+                self._complete(rep, "failure", name)
+                tried.add(rep)
+                last_err = e
+                if _attempt < self._dispatch_retries:
+                    self._count(name, "dispatch_retries")
+                continue
+            self._complete(rep, "success", name)
+            self._count(name, "served")
+            return out
+        self._count(name, "failed")
+        raise last_err
 
     def predict_async(self, name, data, version=None, deadline_ms=None,
                       priority=0):
-        """Queue onto the least-loaded replica's micro-batcher; returns
-        the future-like request handle (see
+        """Queue onto the least-loaded available replica's micro-batcher;
+        returns a future-like request handle (see
         `InferenceEngine.predict_async` for the deadline/priority SLA
-        semantics). The replica stays counted in-flight until the request
-        resolves — served, failed, or shed."""
-        rep = self._acquire(name, version)
-        try:
-            fut = rep.engine.predict_async(data, deadline_ms=deadline_ms,
-                                           priority=priority)
-        except BaseException:
-            self._release(rep)
-            raise
-        fut.add_done_callback(lambda _req: self._release(rep))
-        return fut
+        semantics). A replica stays counted in-flight until its dispatch
+        resolves; a FAILED dispatch (replica death, device error — not a
+        shed) resubmits to a different replica with the remaining
+        deadline budget, so one sick replica degrades capacity, never
+        correctness (exactly-once: a failed dispatch never produced a
+        result)."""
+        req = _ServerRequest(self, name, version, data, deadline_ms,
+                             priority, self._dispatch_retries)
+        req._attempt()   # synchronous submit errors propagate to caller
+        self._count(name, "submitted")
+        return req
 
     # ------------------------------------------------------------------
     # zero-downtime rollover
@@ -336,63 +642,63 @@ class ModelServer:
                      and not self._stopped)
         if start:
             stop_evt = threading.Event()
-
-            def _poll():
-                while not stop_evt.wait(poll_interval):
-                    try:
-                        self._reload_once(name, directory)
-                    except Exception as e:  # keep serving the old weights
-                        logging.warning("ModelServer.reload_from(%s, %s): "
-                                        "%s", name, directory, e)
             thread = threading.Thread(
-                target=_poll, name="mx-serving-server-reload", daemon=True)
+                target=self._poll_loop, name="mx-serving-server-reload",
+                args=(name, directory, poll_interval, stop_evt),
+                daemon=True)
             with self._lock:
                 if name not in self._pollers and not self._stopped:
                     self._pollers[name] = (thread, stop_evt)
                     thread.start()
         return loaded
 
-    def _reload_once(self, name, directory, _retries=3):
+    def _poll_loop(self, name, directory, poll_interval, stop_evt):
+        """Server checkpoint-poller body (see engine._run_reload_poller
+        for the shared rate-limit/watchdog semantics)."""
+        _run_reload_poller(
+            "mx-serving-server-reload:%s" % name,
+            "ModelServer.reload_from(%s, %s)" % (name, directory),
+            poll_interval, stop_evt,
+            lambda: self._reload_once(name, directory))
+
+    def _reload_once(self, name, directory):
+        return self._reload_retry.call(self._reload_attempt, name,
+                                       directory)
+
+    def _reload_attempt(self, name, directory):
+        """One discovery+load+rollover attempt; the unified retry policy
+        re-runs the whole attempt on transient (non-framework) errors —
+        retention pruning can remove the dir between discovery and read,
+        so 'latest' is re-resolved per attempt."""
         from .. import checkpoint as ckpt
-        for attempt in range(_retries):
-            path = ckpt.latest_checkpoint(directory)
-            if path is None:
+        _faults.fault_point("serving.reload", model=name,
+                            directory=directory)
+        path = ckpt.latest_checkpoint(directory)
+        if path is None:
+            return None
+        meta = ckpt.read_meta(path)
+        step = meta.get("step")
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise MXNetError("unknown model %r" % name)
+            if step is not None and entry.reload_step is not None \
+                    and step <= entry.reload_step:
+                # NEWER-only: a re-commit of the current step
+                # briefly makes an older step the "latest"
                 return None
-            try:
-                meta = ckpt.read_meta(path)
-                step = meta.get("step")
-                with self._lock:
-                    entry = self._models.get(name)
-                    if entry is None:
-                        raise MXNetError("unknown model %r" % name)
-                    if step is not None and entry.reload_step is not None \
-                            and step <= entry.reload_step:
-                        # NEWER-only: a re-commit of the current step
-                        # briefly makes an older step the "latest"
-                        return None
-                arg_params, aux_params = ckpt.load_params(path)
-            except MXNetError:
-                raise
-            except Exception:
-                # transient by construction: retention pruning removed
-                # the dir between discovery and read — re-resolve
-                if attempt == _retries - 1:
-                    raise
-                import time as _time
-                _time.sleep(0.1)
-                continue
-            try:
-                self.rollover(name, arg_params, aux_params, version=step)
-            except MXNetError:
-                # label collision (e.g. a pre-registered step label):
-                # weights are what matter — swap under the existing label
-                self.rollover(name, arg_params, aux_params)
-            with self._lock:
-                entry = self._models.get(name)
-                if entry is not None:
-                    entry.reload_step = step
-            return step
-        return None
+        arg_params, aux_params = ckpt.load_params(path)
+        try:
+            self.rollover(name, arg_params, aux_params, version=step)
+        except MXNetError:
+            # label collision (e.g. a pre-registered step label):
+            # weights are what matter — swap under the existing label
+            self.rollover(name, arg_params, aux_params)
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is not None:
+                entry.reload_step = step
+        return step
 
     # ------------------------------------------------------------------
     # lifecycle / observability
@@ -423,19 +729,24 @@ class ModelServer:
             snapshot = {
                 name: (entry.default_version,
                        {label: list(reps)
-                        for label, reps in entry.versions.items()})
+                        for label, reps in entry.versions.items()},
+                       dict(entry.counters))
                 for name, entry in self._models.items()}
         out = {}
-        for name, (default, versions) in snapshot.items():
+        for name, (default, versions, counters) in snapshot.items():
             vstats = {}
             for label, reps in versions.items():
                 vstats[str(label)] = [
                     dict(rep.engine.stats(), inflight=rep.inflight,
-                         ctx=str(rep.engine._ctx))
+                         ctx=str(rep.engine._ctx),
+                         breaker=rep.breaker.snapshot())
                     for rep in reps]
             out[name] = {
                 "default_version": default,
                 "versions": vstats,
+                # server-level request accounting: submitted ==
+                # served + shed + failed (the chaos-suite invariant)
+                "counters": counters,
                 # trailing dot: "serving.res" must not absorb
                 # "serving.resnet.*"
                 "latency": _prof.latency_counters(

@@ -409,6 +409,111 @@ class TestEnvRegistry:
 
 
 # ----------------------------------------------------------------------
+# TPL106 swallowed exceptions (resilience-critical set)
+# ----------------------------------------------------------------------
+class TestSwallowedException:
+    SCOPED = "pkg/checkpoint/manager.py"
+
+    def test_except_pass_flagged(self):
+        bad = """
+            def f():
+                try:
+                    risky()
+                except OSError:
+                    pass
+        """
+        f = _active(_lint(bad, path=self.SCOPED))
+        assert [x.rule_id for x in f] == ["TPL106"]
+        # anchored on the inert body statement so the pragma reads inline
+        assert f[0].line == 6
+
+    def test_log_and_continue_flagged(self):
+        bad = """
+            import logging
+            def f(items):
+                for it in items:
+                    try:
+                        risky(it)
+                    except Exception as e:
+                        logging.warning("boom: %s", e)
+                        continue
+        """
+        assert [x.rule_id for x in _active(_lint(bad, path=self.SCOPED))] \
+            == ["TPL106"]
+
+    def test_counter_or_reraise_or_value_return_clean(self):
+        ok = """
+            from mxnet_tpu import profiler
+            def a():
+                try:
+                    risky()
+                except OSError:
+                    profiler.record_retry("site", "giveup")
+            def b():
+                try:
+                    risky()
+                except OSError:
+                    raise
+            def c():
+                try:
+                    return risky()
+                except OSError:
+                    return 0.0
+            def d(self):
+                try:
+                    risky()
+                except OSError as e:
+                    self.err = e
+        """
+        assert not _active(_lint(ok, path=self.SCOPED))
+
+    def test_bare_return_and_print_still_flagged(self):
+        bad = """
+            def f():
+                try:
+                    risky()
+                except Exception:
+                    print("oops")
+                    return
+        """
+        assert [x.rule_id for x in _active(_lint(bad, path=self.SCOPED))] \
+            == ["TPL106"]
+
+    def test_out_of_scope_file_clean(self):
+        bad = """
+            def f():
+                try:
+                    risky()
+                except OSError:
+                    pass
+        """
+        # kvstore.py / ops are outside the resilience-critical set
+        assert not _active(_lint(bad, path="pkg/ops/math.py"))
+
+    def test_scope_detection(self):
+        from mxnet_tpu.analysis.rules import is_swallow_scope
+        assert is_swallow_scope("mxnet_tpu/serving/engine.py")
+        assert is_swallow_scope("mxnet_tpu/checkpoint/layout.py")
+        assert is_swallow_scope("mxnet_tpu/parallel/zero.py")
+        assert is_swallow_scope("mxnet_tpu/io_device.py")
+        assert not is_swallow_scope("mxnet_tpu/kvstore.py")
+        assert not is_swallow_scope("mxnet_tpu/ops/math.py")
+
+    def test_pragma_suppresses_with_reason(self):
+        src = """
+            def f():
+                try:
+                    risky()
+                except OSError:
+                    pass  # tpulint: allow-swallowed-exception unlink is best-effort cleanup
+        """
+        findings = _lint(src, path=self.SCOPED)
+        assert not _active(findings)
+        assert any(f.rule_id == "TPL106" and f.suppressed
+                   for f in findings)
+
+
+# ----------------------------------------------------------------------
 # TPL201 f64 leaks (symbol + jaxpr)
 # ----------------------------------------------------------------------
 class TestF64:

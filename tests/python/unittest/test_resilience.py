@@ -1,0 +1,867 @@
+"""Resilience layer chaos suite (mxnet_tpu/resilience/ — ISSUE 9).
+
+The acceptance contracts exercised here:
+  * fault injection is DETERMINISTIC (spec grammar, count/after/times/
+    prob+seed triggers, context matchers) and a ZERO-OVERHEAD no-op when
+    no spec is configured (one cached flag; asserted below);
+  * the unified retry policy backs off with full jitter, retries only
+    typed-transient errors, honors its deadline budget, and counts every
+    retry/recovery/give-up into profiler.retry_counters();
+  * the watchdog detects stalls (busy-silent threads), deaths, and
+    applies a restart-or-surface policy, exporting counters;
+  * killing one serving replica mid-trace: served + shed == submitted
+    (exactly-once, zero lost requests), the breaker opens and traffic
+    reroutes, a healed replica is re-admitted through a half-open probe;
+  * an injected checkpoint-write failure is retried transparently; a
+    persistent one surfaces while the previous committed checkpoint
+    stays discoverable and loadable — including under a SIGTERM
+    preemption flush (no torn manifest);
+  * the serving checkpoint poller rate-limits repeated load failures
+    (log once per distinct error, always count) and recovers;
+  * dist_async idempotent pulls survive a broken transport connection
+    (reconnect + retry); pushes never retry.
+"""
+import logging
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resilience import faults
+from mxnet_tpu.resilience.retry import RetryPolicy, TransientError
+from mxnet_tpu.resilience.watchdog import Watchdog
+from mxnet_tpu.serving import ModelServer, DeadlineExceeded
+from mxnet_tpu.serving.server import _Breaker
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    profiler.retry_counters(reset=True)
+    profiler.fault_counters(reset=True)
+    yield
+    faults.reset()
+
+
+def _net(prefix, hidden=8, indim=6):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden,
+                                name=prefix + "_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name=prefix + "_fc1")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params_for(sym, rng, indim=6):
+    shapes, _, _ = sym.infer_shape(data=(4, indim))
+    return {n: mx.nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+# ---------------------------------------------------------------------------
+# fault-injection registry
+# ---------------------------------------------------------------------------
+class TestFaults:
+    def test_spec_grammar_and_count_trigger(self):
+        faults.configure("a.b:count=2:raise=TransientError,boom")
+        faults.fault_point("a.b")                      # hit 1: no fire
+        with pytest.raises(TransientError, match="boom"):
+            faults.fault_point("a.b")                  # hit 2: fires
+        faults.fault_point("a.b")                      # hit 3: no fire
+        st = faults.stats()
+        assert st["a.b"] == 1
+        assert st["specs"][0]["hits"] == 3
+
+    def test_context_matchers(self):
+        faults.configure("checkpoint.write:step=3:raise=OSError")
+        for step in (1, 2, 4):
+            faults.fault_point("checkpoint.write", step=step)
+        with pytest.raises(OSError):
+            faults.fault_point("checkpoint.write", step=3)
+        # missing matcher key never matches
+        faults.fault_point("checkpoint.write")
+
+    def test_after_and_times_triggers(self):
+        faults.configure("s:after=1:times=2:raise=OSError")
+        faults.fault_point("s")                        # hit 1: after=1
+        for _ in range(2):
+            with pytest.raises(OSError):
+                faults.fault_point("s")
+        faults.fault_point("s")                        # disarmed by times=2
+
+    def test_prob_seed_deterministic(self):
+        fired = []
+        for _ in range(2):
+            faults.configure("p:prob=0.5:seed=7:raise=OSError")
+            seq = []
+            for _ in range(20):
+                try:
+                    faults.fault_point("p")
+                    seq.append(0)
+                except OSError:
+                    seq.append(1)
+            fired.append(seq)
+        assert fired[0] == fired[1]      # same seed, same firing pattern
+        assert 0 < sum(fired[0]) < 20
+
+    def test_delay_action(self):
+        faults.configure("d:delay=30")
+        t0 = time.monotonic()
+        faults.fault_point("d")
+        assert time.monotonic() - t0 >= 0.025
+
+    def test_bad_specs_raise(self):
+        for bad in ("siteonly", "a.b:count=x:raise=OSError",
+                    "a.b:raise=Shrug", "a.b:raise=OSError:delay=5",
+                    "a b:raise=OSError"):
+            with pytest.raises(MXNetError):
+                faults.configure(bad)
+        # a failed configure leaves injection OFF
+        assert not faults.enabled()
+
+    def test_unset_is_zero_overhead_noop(self, monkeypatch):
+        """THE acceptance guard: with no spec configured, fault_point
+        returns off one cached flag without touching the registry."""
+        faults.reset()
+        assert not faults.enabled()
+        assert faults._ENABLED is False   # the cached flag itself
+
+        def _boom(*a, **k):
+            raise AssertionError("registry touched while disabled")
+        monkeypatch.setattr(faults, "_fire", _boom)
+        faults.fault_point("serving.dispatch", replica=0)
+        faults.fault_point("checkpoint.write", step=1)
+
+    def test_fault_counter_records(self):
+        faults.configure("x.y:raise=OSError")
+        with pytest.raises(OSError):
+            faults.fault_point("x.y")
+        assert profiler.fault_counters()["x.y"] == 1
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+class TestRetryPolicy:
+    def test_recovers_and_counts(self):
+        import random
+        calls = []
+        policy = RetryPolicy(attempts=4, base_delay_s=0.001,
+                             site="t.recover", rng=random.Random(0))
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+        profiler.retry_counters(reset=True)
+        assert policy.call(flaky) == "ok"
+        c = profiler.retry_counters()
+        assert c["t.recover.retry"] == 2
+        assert c["t.recover.recovery"] == 1
+        assert c.get("t.recover.giveup", 0) == 0
+
+    def test_gives_up_after_attempts(self):
+        policy = RetryPolicy(attempts=3, base_delay_s=0.0, site="t.giveup")
+        calls = []
+
+        def always(): calls.append(1); raise OSError("down")
+        profiler.retry_counters(reset=True)
+        with pytest.raises(OSError):
+            policy.call(always)
+        assert len(calls) == 3
+        assert profiler.retry_counters()["t.giveup.giveup"] == 1
+
+    def test_non_retryable_is_immediate(self):
+        policy = RetryPolicy(attempts=5, base_delay_s=0.0)
+        calls = []
+
+        def bug(): calls.append(1); raise ValueError("a real bug")
+        with pytest.raises(ValueError):
+            policy.call(bug)
+        assert len(calls) == 1
+
+    def test_base_exceptions_never_retry_even_with_permissive_predicate(
+            self):
+        """KeyboardInterrupt/SystemExit must surface on the FIRST raise
+        regardless of the policy's predicate — a Ctrl-C swallowed into
+        backoff sleeps turns an interrupt into a hang."""
+        policy = RetryPolicy(attempts=5, base_delay_s=0.0,
+                             retryable=lambda e: True)
+        for exc in (KeyboardInterrupt, SystemExit):
+            calls = []
+
+            def interrupted():
+                calls.append(1)
+                raise exc()
+            with pytest.raises(exc):
+                policy.call(interrupted)
+            assert len(calls) == 1
+
+    def test_transient_error_marker_retries(self):
+        policy = RetryPolicy(attempts=2, base_delay_s=0.0)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise TransientError("marked transient")
+            return 1
+        assert policy.call(flaky) == 1
+
+    def test_backoff_full_jitter_curve(self):
+        import random
+        policy = RetryPolicy(attempts=9, base_delay_s=0.1, cap_delay_s=0.4,
+                             rng=random.Random(1))
+        for k in range(8):
+            ceiling = min(0.4, 0.1 * 2 ** k)
+            for _ in range(16):
+                assert 0.0 <= policy.backoff_s(k) <= ceiling
+
+    def test_deadline_budget_stops_retries(self):
+        import random
+
+        class _FixedRng(random.Random):
+            def uniform(self, a, b):  # force max backoff
+                return b
+        policy = RetryPolicy(attempts=100, base_delay_s=10.0,
+                             deadline_s=0.05, rng=_FixedRng())
+        calls = []
+
+        def always(): calls.append(1); raise OSError("down")
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            policy.call(always)
+        assert len(calls) == 1          # backoff would cross the deadline
+        assert time.monotonic() - t0 < 1.0
+
+    def test_env_defaults_and_validation(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TPU_RETRY_ATTEMPTS", "7")
+        monkeypatch.setenv("MXNET_TPU_RETRY_BASE_MS", "10")
+        monkeypatch.setenv("MXNET_TPU_RETRY_CAP_MS", "100")
+        p = RetryPolicy()
+        assert p.attempts == 7
+        assert p.base_delay_s == pytest.approx(0.01)
+        assert p.cap_delay_s == pytest.approx(0.1)
+        with pytest.raises(MXNetError):
+            RetryPolicy(attempts=0)
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+class TestWatchdog:
+    def test_stall_detect_and_recover(self):
+        wd = Watchdog(interval_s=60, stall_timeout_s=0.01, enabled=True)
+        profiler.watchdog_counters(reset=True)
+        hb = wd.register("w.stall")
+        hb.beat()
+        time.sleep(0.03)
+        assert wd.scan() == 1
+        assert wd.stats()["w.stall"]["stalled"]
+        assert wd.scan() == 0            # one stall episode, counted once
+        hb.beat()
+        wd.scan()
+        c = profiler.watchdog_counters()
+        assert c["w.stall.stall"] == 1
+        assert c["w.stall.stall_recovered"] == 1
+        wd.stop()
+
+    def test_idle_threads_exempt_from_stall(self):
+        wd = Watchdog(interval_s=60, stall_timeout_s=0.01, enabled=True)
+        hb = wd.register("w.idle")
+        hb.idle()
+        time.sleep(0.03)
+        assert wd.scan() == 0
+        wd.stop()
+
+    def test_death_surfaces_and_retires(self):
+        wd = Watchdog(interval_s=60, stall_timeout_s=30, enabled=True)
+        profiler.watchdog_counters(reset=True)
+        t = threading.Thread(target=lambda: None)
+        t.start(); t.join()
+        wd.register("w.dead", thread=t)
+        wd.scan()
+        assert profiler.watchdog_counters()["w.dead.death"] == 1
+        assert "w.dead" not in wd.stats()     # surfaced and retired
+        wd.stop()
+
+    def test_death_restart_policy(self):
+        wd = Watchdog(interval_s=60, stall_timeout_s=30, enabled=True)
+        stop = threading.Event()
+        made = []
+
+        def mk():
+            t = threading.Thread(target=stop.wait, daemon=True)
+            t.start(); made.append(t); return t
+        dead = threading.Thread(target=lambda: None)
+        dead.start(); dead.join()
+        wd.register("w.restart", thread=dead, on_death="restart",
+                    restart=mk)
+        wd.scan()
+        assert len(made) == 1 and made[0].is_alive()
+        assert wd.stats()["w.restart"]["restarts"] == 1
+        assert wd.scan() == 0          # restarted thread is supervised, alive
+        stop.set()
+        wd.stop()
+
+    def test_clean_close_is_not_a_death(self):
+        wd = Watchdog(interval_s=60, enabled=True)
+        t = threading.Thread(target=lambda: None)
+        t.start(); t.join()
+        hb = wd.register("w.closed", thread=t)
+        hb.close()
+        profiler.watchdog_counters(reset=True)
+        wd.scan()
+        assert profiler.watchdog_counters().get("w.closed.death", 0) == 0
+        wd.stop()
+
+    def test_disabled_registers_noop(self):
+        wd = Watchdog(enabled=False)
+        hb = wd.register("w.off")
+        hb.beat(); hb.idle(); hb.close()     # all no-ops
+        assert wd.stats() == {}
+        assert wd._monitor is None           # no thread ever started
+
+
+# ---------------------------------------------------------------------------
+# device prefetch under injected staging faults
+# ---------------------------------------------------------------------------
+class TestPrefetchFaults:
+    def _iter(self, n=6, batch=4):
+        data = np.arange(n * batch * 3, dtype=np.float32).reshape(
+            n * batch, 3)
+        label = np.zeros((n * batch,), np.float32)
+        return mx.io.NDArrayIter(data=data, label=label, batch_size=batch)
+
+    def test_transient_stage_fault_recovers(self):
+        from mxnet_tpu.io_device import DevicePrefetchIter
+        faults.configure("prefetch.stage:count=2:raise=OSError,blip")
+        it = DevicePrefetchIter(self._iter())
+        batches = list(it)
+        assert len(batches) == 6                  # nothing lost
+        c = profiler.retry_counters()
+        assert c["prefetch.stage.retry"] >= 1
+        assert c["prefetch.stage.recovery"] == 1
+
+    def test_permanent_stage_fault_surfaces_root_cause(self):
+        from mxnet_tpu.io_device import DevicePrefetchIter
+        faults.configure("prefetch.stage:raise=RuntimeError,stage broken")
+        it = DevicePrefetchIter(self._iter())
+        with pytest.raises(RuntimeError, match="stage broken"):
+            for _ in range(10):
+                it.next()
+        # sticky: the SAME error re-raises, training cannot hang
+        with pytest.raises(RuntimeError, match="stage broken"):
+            it.next()
+
+    def test_lost_sentinel_message_carries_root_cause(self):
+        """Satellite: even when the terminal sentinel is lost (put raced
+        shutdown), the surfaced error names the worker's real
+        exception."""
+        from mxnet_tpu.io_device import DevicePrefetchIter
+        it = DevicePrefetchIter(self._iter())
+        dead = threading.Thread(target=lambda: None)
+        dead.start(); dead.join()
+        it._thread = dead
+        it._worker_error = ValueError("the real reason")
+        with pytest.raises(MXNetError, match="ValueError: the real reason"):
+            it.next()
+        assert isinstance(it._terminal.__cause__, ValueError)
+
+
+# ---------------------------------------------------------------------------
+# serving: circuit breaker + replica kill chaos
+# ---------------------------------------------------------------------------
+class TestBreakerUnit:
+    def test_open_halfopen_close_cycle(self):
+        br = _Breaker(threshold=2, cooldown_s=0.05)
+        now = time.monotonic()
+        assert br.available(now)
+        br.on_failure(now)
+        assert br.state == "closed"
+        assert br.on_failure(now)            # second failure opens
+        assert br.state == "open" and br.opens == 1
+        assert not br.available(now)
+        later = now + 0.06
+        assert br.available(later)           # cooldown elapsed
+        br.note_dispatch(later)
+        assert br.state == "half_open"
+        assert not br.available(later)       # single probe in flight
+        br.on_success()
+        assert br.state == "closed" and br.failures == 0
+
+    def test_halfopen_failure_reopens(self):
+        br = _Breaker(threshold=1, cooldown_s=0.01)
+        now = time.monotonic()
+        br.on_failure(now)
+        assert br.state == "open"
+        later = now + 0.02
+        br.note_dispatch(later)
+        br.on_failure(later)
+        assert br.state == "open" and br.opens == 2
+
+    def test_shed_is_breaker_neutral(self):
+        # sheds call neither on_success nor on_failure — asserted at the
+        # integration level below; here: success resets the streak
+        br = _Breaker(threshold=3, cooldown_s=1.0)
+        now = time.monotonic()
+        br.on_failure(now); br.on_failure(now)
+        br.on_success()
+        assert br.failures == 0 and br.state == "closed"
+
+
+class TestReplicaKillChaos:
+    def _server(self, rng, threshold=2, cooldown_ms=100.0):
+        sym = _net("cm")
+        srv = ModelServer(breaker_threshold=threshold,
+                          breaker_cooldown_ms=cooldown_ms)
+        srv.register("cm", sym, _params_for(sym, rng), ctx=mx.cpu(),
+                     replicas=2, buckets=(4,), async_worker=False,
+                     warmup_shapes={"data": (4, 6)})
+        return srv
+
+    def _drain(self, srv, rounds=3):
+        for _ in range(rounds):
+            srv.engine("cm", replica=0).flush()
+            srv.engine("cm", replica=1).flush()
+
+    def test_replica_kill_exactly_once_and_reroute(self):
+        """THE chaos acceptance: kill replica 0 mid-trace — every request
+        resolves exactly once (served + shed == submitted, zero failed),
+        the breaker opens, and the healthy replica serves everything."""
+        rng = np.random.RandomState(0)
+        srv = self._server(rng)
+        x = rng.normal(0, 1, (1, 6)).astype(np.float32)
+        # warm traffic before the kill
+        pre = [srv.predict_async("cm", {"data": x}) for _ in range(4)]
+        self._drain(srv)
+        faults.configure(
+            "serving.dispatch:replica=0:mode=async:raise=OSError,killed")
+        futs = [srv.predict_async("cm", {"data": x}) for _ in range(20)]
+        self._drain(srv)
+        served = shed = failed = 0
+        for f in pre + futs:
+            assert f.done()
+            if f.error is None:
+                served += 1
+            elif isinstance(f.error, DeadlineExceeded):
+                shed += 1
+            else:
+                failed += 1
+        st = srv.stats()["cm"]
+        assert failed == 0
+        assert served == 24 and shed == 0
+        assert st["counters"]["submitted"] == 24
+        assert st["counters"]["served"] == 24
+        assert st["counters"]["failed"] == 0
+        assert st["counters"]["dispatch_retries"] >= 1
+        breakers = [r["breaker"] for r in st["versions"]["1"]]
+        assert breakers[0]["state"] == "open"
+        assert breakers[1]["state"] == "closed"
+        # outputs come from the healthy replica's weights: row-identical
+        ref = srv.engine("cm", replica=1).predict({"data": x})[0].asnumpy()
+        got = futs[-1].result_wait(0.0)[0]
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-6)
+        srv.stop()
+
+    def test_healed_replica_readmitted_via_half_open_probe(self):
+        rng = np.random.RandomState(1)
+        srv = self._server(rng, threshold=2, cooldown_ms=40.0)
+        x = rng.normal(0, 1, (1, 6)).astype(np.float32)
+        faults.configure(
+            "serving.dispatch:replica=0:mode=async:raise=OSError,sick")
+        futs = [srv.predict_async("cm", {"data": x}) for _ in range(12)]
+        self._drain(srv)
+        assert srv.stats()["cm"]["versions"]["1"][0]["breaker"]["state"] \
+            == "open"
+        faults.reset()                       # the replica heals...
+        time.sleep(0.06)                     # ...and the cooldown passes
+        futs += [srv.predict_async("cm", {"data": x}) for _ in range(12)]
+        self._drain(srv)
+        assert all(f.error is None for f in futs)
+        # the healed replica took its half-open probe and closed
+        assert srv.stats()["cm"]["versions"]["1"][0]["breaker"]["state"] \
+            == "closed"
+        srv.stop()
+
+    def test_sync_predict_reroutes_too(self):
+        rng = np.random.RandomState(2)
+        srv = self._server(rng)
+        faults.configure(
+            "serving.dispatch:replica=0:mode=sync:raise=OSError,dead")
+        x = rng.normal(0, 1, (2, 6)).astype(np.float32)
+        for _ in range(6):
+            out = srv.predict("cm", {"data": x})
+            assert out[0].shape[0] == 2
+        st = srv.stats()["cm"]
+        assert st["versions"]["1"][0]["breaker"]["state"] == "open"
+        # sync traffic counts into the SAME accounting invariant
+        c = st["counters"]
+        assert c["submitted"] == 6 and c["served"] == 6
+        assert c["submitted"] == c["served"] + c["shed"] + c["failed"]
+        srv.stop()
+
+    def test_all_replicas_dead_surfaces_error(self):
+        rng = np.random.RandomState(3)
+        srv = self._server(rng)
+        faults.configure("serving.dispatch:mode=async:raise=OSError,all dead")
+        x = rng.normal(0, 1, (1, 6)).astype(np.float32)
+        f = srv.predict_async("cm", {"data": x})
+        self._drain(srv)
+        assert f.done() and f.error is not None
+        assert not isinstance(f.error, DeadlineExceeded)
+        # accounting stays exact even in total failure
+        c = srv.stats()["cm"]["counters"]
+        assert c["submitted"] == c["served"] + c["shed"] + c["failed"] == 1
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: injected write faults, SIGTERM preemption
+# ---------------------------------------------------------------------------
+class TestCheckpointFaults:
+    def _manager(self, tmp_path, **kw):
+        from mxnet_tpu.checkpoint import CheckpointManager
+        return CheckpointManager(str(tmp_path), **kw)
+
+    def _save(self, mgr, step, value):
+        sym = _net("ck")
+        arg = {"ck_fc0_weight": mx.nd.array(
+            np.full((8, 6), value, np.float32))}
+        return mgr.save(step, symbol=sym, arg_params=arg, blocking=True)
+
+    def test_transient_write_fault_retried_transparently(self, tmp_path):
+        mgr = self._manager(tmp_path)
+        faults.configure("checkpoint.write:count=1:raise=OSError,disk blip")
+        self._save(mgr, 1, 1.0)
+        assert mgr.latest_step() == 1
+        c = profiler.retry_counters()
+        assert c["checkpoint.write.retry"] == 1
+        assert c["checkpoint.write.recovery"] == 1
+
+    def test_persistent_write_fault_keeps_previous_committed(self,
+                                                             tmp_path):
+        from mxnet_tpu import checkpoint as ckpt
+        mgr = self._manager(tmp_path)
+        self._save(mgr, 1, 1.0)
+        faults.configure("checkpoint.write:raise=OSError,disk dead")
+        with pytest.raises(OSError):
+            self._save(mgr, 2, 2.0)
+        assert profiler.retry_counters()["checkpoint.write.giveup"] == 1
+        # the previous committed checkpoint is untouched and loadable
+        assert mgr.latest_step() == 1
+        data = mgr.restore()
+        assert data.step == 1
+        np.testing.assert_array_equal(
+            data.arg_params["ck_fc0_weight"].asnumpy(),
+            np.full((8, 6), 1.0, np.float32))
+        # no torn staging dirs left behind with a manifest
+        for name in os.listdir(str(tmp_path)):
+            if name.startswith(".tmp-"):
+                assert not os.path.isfile(
+                    os.path.join(str(tmp_path), name, "meta.json"))
+
+    def test_commit_fault_never_tears_latest(self, tmp_path):
+        mgr = self._manager(tmp_path)
+        self._save(mgr, 1, 1.0)
+        faults.configure("checkpoint.commit:raise=MXNetError,commit blocked")
+        with pytest.raises(MXNetError):
+            self._save(mgr, 2, 2.0)
+        assert mgr.latest_step() == 1           # discovery unaffected
+
+    def test_sigterm_with_injected_write_failure_keeps_committed(
+            self, tmp_path):
+        """Satellite: SIGTERM preemption flush with an injected
+        disk-write failure still leaves the newest COMMITTED checkpoint
+        discoverable and loadable — no torn manifest."""
+        from mxnet_tpu import checkpoint as ckpt
+        mgr = self._manager(tmp_path)
+        self._save(mgr, 3, 3.0)
+        sym = _net("ck")
+        live_arg = {"ck_fc0_weight": mx.nd.array(
+            np.full((8, 6), 9.0, np.float32))}
+        mgr.set_live_capture(lambda: dict(step=7, symbol=sym,
+                                          arg_params=live_arg))
+        prev = signal.signal(signal.SIGTERM, lambda s, f: None)
+        try:
+            mgr.install_preemption_hook()
+            faults.configure("checkpoint.write:raise=OSError,disk gone")
+            with pytest.raises(OSError):
+                os.kill(os.getpid(), signal.SIGTERM)
+                # the handler runs synchronously on this (main) thread;
+                # give the interpreter a bytecode boundary just in case
+                time.sleep(0.01)
+        finally:
+            mgr.uninstall_preemption_hook()
+            signal.signal(signal.SIGTERM, prev)
+        faults.reset()
+        # newest committed checkpoint: the pre-preemption step 3
+        path = ckpt.latest_checkpoint(str(tmp_path))
+        assert path is not None and path.endswith("step-00000003")
+        meta = ckpt.read_meta(path)              # manifest intact
+        assert meta["step"] == 3
+        arg, _ = ckpt.load_params(path)
+        np.testing.assert_array_equal(
+            arg["ck_fc0_weight"].asnumpy(),
+            np.full((8, 6), 3.0, np.float32))
+
+    def test_sigterm_flush_succeeds_without_fault(self, tmp_path):
+        """Twin: the same preemption flush COMMITS when the disk works,
+        proving the fault (not the flush) caused the failure above."""
+        mgr = self._manager(tmp_path)
+        self._save(mgr, 3, 3.0)
+        sym = _net("ck")
+        live_arg = {"ck_fc0_weight": mx.nd.array(
+            np.full((8, 6), 9.0, np.float32))}
+        mgr.set_live_capture(lambda: dict(step=7, symbol=sym,
+                                          arg_params=live_arg))
+        prev = signal.signal(signal.SIGTERM, lambda s, f: None)
+        try:
+            mgr.install_preemption_hook()
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.01)
+        finally:
+            mgr.uninstall_preemption_hook()
+            signal.signal(signal.SIGTERM, prev)
+        assert mgr.latest_step() == 7
+        assert mgr.restore().meta.get("mid_epoch")
+
+
+class TestDonationSafeCapture:
+    def test_async_capture_survives_later_donating_steps(self, tmp_path):
+        """Regression (found by the chaos verify drive): the fused step
+        DONATES its opt_state buffers, so a zero-copy capture held by
+        the async checkpoint writer was deleted by the next training
+        step — serialization crashed with "Array has been deleted".
+        Capture must device-copy the tree so later steps cannot kill
+        the snapshot, and the snapshot must stay point-in-time."""
+        from mxnet_tpu.checkpoint import state as state_mod
+        mod, it0 = self._fused_module()
+        state = state_mod.capture_module(mod, step=1)
+        # IMPORTANT: nothing materializes the captured tree here — a
+        # host pull would cache npy values on the arrays and mask the
+        # deletion the donation below causes on an unfixed capture.
+        # Keep training on the SAME fused step: each update donates
+        # (and deletes) the previous opt_state buffers. (A second
+        # fit() call would rebuild the step and hide the race.)
+        self._steps(mod, it0, 2)
+        blob = state_mod._serialize_opt_payload(state.optimizer)
+        assert blob    # serializes fine — the capture owns its buffers
+
+    def test_capture_is_point_in_time(self, tmp_path):
+        """The donation-safe copy must also stay a SNAPSHOT: later
+        training steps must not change what the capture serializes."""
+        from mxnet_tpu.checkpoint import state as state_mod
+        mod, it0 = self._fused_module()
+        state = state_mod.capture_module(mod, step=1)
+        blob_before = state_mod._serialize_opt_payload(state.optimizer)
+        self._steps(mod, it0, 2)
+        blob_after = state_mod._serialize_opt_payload(state.optimizer)
+        assert blob_before == blob_after
+        # ...while the LIVE tree did move (the steps really updated)
+        live = state_mod._serialize_opt_payload(
+            state_mod.capture_optimizer(mod)[0])
+        assert live != blob_before
+
+    @staticmethod
+    def _fused_module():
+        rng = np.random.RandomState(0)
+        X = rng.normal(0, 1, (64, 6)).astype(np.float32)
+        y = (rng.uniform(size=64) * 3).astype(np.float32)
+        sym = _net("dc")
+        mod = mx.mod.Module(sym, data_names=["data"],
+                            label_names=["softmax_label"])
+        it0 = mx.io.NDArrayIter(data=X, label=y, batch_size=16)
+        mod.fit(it0, num_epoch=1, kvstore="tpu_sync",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        assert mod._fused_step is not None
+        return mod, it0
+
+    @staticmethod
+    def _steps(mod, it0, n):
+        it0.reset()
+        batch = it0.next()
+        for _ in range(n):
+            mod.forward_backward(batch)
+            mod.update()
+
+
+# ---------------------------------------------------------------------------
+# serving checkpoint poller: rate-limited failure logging + recovery
+# ---------------------------------------------------------------------------
+class TestPollerRateLimit:
+    def test_poll_failures_logged_once_counted_always(self, tmp_path,
+                                                      caplog):
+        from mxnet_tpu.checkpoint import CheckpointManager
+        from mxnet_tpu.serving import InferenceEngine
+        rng = np.random.RandomState(4)
+        sym = _net("pl")
+        eng = InferenceEngine(sym, _params_for(sym, rng), ctx=mx.cpu(),
+                              buckets=(4,), async_worker=False)
+        ckdir = str(tmp_path)
+        profiler.retry_counters(reset=True)
+        caplog.set_level(logging.WARNING)
+        # a perpetually-failing load: every poll gives up after retries
+        faults.configure("serving.reload:raise=OSError,corrupt dir")
+        eng._reload_retry.base_delay_s = 0.0   # keep the test fast
+        with pytest.raises(OSError):
+            eng.reload_from(ckdir)             # first call surfaces
+        # reload_from raises synchronously while the fault is hot, so
+        # drive the poller loop directly against a stop event
+        stop = threading.Event()
+        t = threading.Thread(target=eng._poll_loop,
+                             args=(ckdir, 0.02, stop), daemon=True)
+        eng._reload_thread = t
+        t.start()
+        time.sleep(0.15)
+        # repeated identical failures: ONE warning, many counts
+        warnings = [r for r in caplog.records
+                    if "repeats of this error are counted" in r.message]
+        assert len(warnings) == 1
+        count_mid = profiler.retry_counters()["serving.reload.poll_failure"]
+        assert count_mid >= 2
+        # heal: write a real checkpoint; the poller recovers and swaps
+        faults.reset()
+        mgr = CheckpointManager(ckdir)
+        new_w = {n: mx.nd.array(v * 0 + 5.0)
+                 for n, v in _params_for(sym, rng).items()}
+        mgr.save(11, symbol=sym, arg_params=new_w, blocking=True)
+        time.sleep(0.15)
+        stop.set()
+        t.join(timeout=5)
+        assert eng._reload_step == 11
+        srv_w = np.asarray(eng._params["pl_fc0_weight"])
+        np.testing.assert_array_equal(
+            srv_w, np.full(srv_w.shape, 5.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# dist_async transport resilience
+# ---------------------------------------------------------------------------
+class TestKvstoreTransport:
+    @pytest.fixture()
+    def server_env(self, monkeypatch):
+        from mxnet_tpu.kvstore_async import AsyncParamServer
+        s = socket.socket(); s.bind(("", 0))
+        port = s.getsockname()[1]; s.close()
+        server = AsyncParamServer(port, num_workers=1)
+        t = threading.Thread(target=server.serve, daemon=True)
+        t.start()
+        assert server._ready.wait(timeout=30)
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        yield server
+        server._done.set()
+        t.join(timeout=10)
+
+    def test_idempotent_pull_survives_broken_socket(self, server_env):
+        from mxnet_tpu.kvstore_async import KVStoreDistAsync
+        kv = KVStoreDistAsync()
+        kv._idempotent_retry.base_delay_s = 0.0
+        w = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+        kv.init("w", w)
+        profiler.retry_counters(reset=True)
+        # sever the transport under the client's feet
+        kv._socks[0].close()
+        out = mx.nd.zeros((3, 4))
+        kv.pull("w", out=out)                 # reconnect + retry, no error
+        np.testing.assert_array_equal(out.asnumpy(), w.asnumpy())
+        c = profiler.retry_counters()
+        assert c["kvstore.pull.retry"] >= 1
+        assert c["kvstore.pull.recovery"] == 1
+        kv.stop_server()
+
+    def test_push_transport_failure_never_retries(self, server_env):
+        from mxnet_tpu.kvstore_async import KVStoreDistAsync, TransportError
+        import mxnet_tpu.optimizer as opt
+        kv = KVStoreDistAsync()
+        kv.init("w", mx.nd.zeros((2, 2)))
+        kv.set_optimizer(opt.SGD(learning_rate=0.1))
+        before = server_env._push_count
+        kv._socks[0].close()
+        with pytest.raises(TransportError):
+            kv.push("w", mx.nd.ones((2, 2)))
+        # the server applied AT MOST the original push — never a retry's
+        assert server_env._push_count <= before + 1
+        kv.stop_server()
+
+    def test_half_sent_scatter_never_desyncs(self, monkeypatch):
+        """Regression (review finding): a send failure mid-scatter must
+        break EVERY socket already sent to in that attempt — the peers'
+        replies arrive unread, and reusing such a connection pairs the
+        next request with this round's stale reply (a later pull would
+        silently return another round-trip's payload)."""
+        from mxnet_tpu import kvstore_async as ka
+        servers, threads = [], []
+        base = None
+        for i in range(2):
+            s = socket.socket(); s.bind(("", 0))
+            port = s.getsockname()[1]; s.close()
+            if i == 0:
+                base = port
+            srv = ka.AsyncParamServer(port, num_workers=1)
+            t = threading.Thread(target=srv.serve, daemon=True)
+            t.start()
+            assert srv._ready.wait(timeout=30)
+            servers.append(srv); threads.append(t)
+            if i == 0:
+                uris = "127.0.0.1:%d" % port
+            else:
+                uris += ",127.0.0.1:%d" % port
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(base))
+        monkeypatch.setenv("DMLC_PS_SERVER_URIS", uris)
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+        kv = ka.KVStoreDistAsync()
+        kv._idempotent_retry.base_delay_s = 0.0
+        a = np.arange(4, dtype=np.float32)
+        b = np.arange(4, 8, dtype=np.float32)
+        kv.init("a", mx.nd.array(a))
+        kv.init("b", mx.nd.array(b))
+        real_send = ka._send_msg
+        state = {"armed": True}
+
+        def flaky_send(sock, obj):
+            # fail the SECOND server's send of the stats scatter once:
+            # server 0 was already sent to and will answer
+            if state["armed"] and isinstance(obj, tuple) \
+                    and obj[0] == "stats" and sock is kv._socks[1]:
+                state["armed"] = False
+                raise OSError("link down mid-scatter")
+            return real_send(sock, obj)
+        monkeypatch.setattr(ka, "_send_msg", flaky_send)
+        st = kv.server_stats()      # half-sent attempt -> retry fresh
+        assert st["num_keys"] == 2
+        # the next pulls must return the RIGHT payloads — a desynced
+        # socket would hand back the orphaned stats reply instead
+        for key, want in (("a", a), ("b", b)):
+            out = mx.nd.zeros((4,))
+            kv.pull(key, out=out)
+            np.testing.assert_array_equal(out.asnumpy(), want)
+        kv.stop_server()
+        for srv, t in zip(servers, threads):
+            srv._done.set()
+        for t in threads:
+            t.join(timeout=10)
+
+    def test_injected_pull_fault_surfaces(self, server_env):
+        from mxnet_tpu.kvstore_async import KVStoreDistAsync
+        kv = KVStoreDistAsync()
+        kv.init("w", mx.nd.zeros((2, 2)))
+        faults.configure("kvstore.pull:count=1:raise=ConnectionError,net")
+        with pytest.raises(ConnectionError):
+            kv.pull("w", out=mx.nd.zeros((2, 2)))
+        kv.pull("w", out=mx.nd.zeros((2, 2)))  # next pull fine
+        kv.stop_server()

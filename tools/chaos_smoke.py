@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""CI chaos smoke (`ci/run.py chaos_smoke` stage, ISSUE 9).
+
+Fast, non-slow gate over the resilience layer — the two headline chaos
+scenarios plus the zero-overhead contract:
+
+  * replica-kill-under-load: one serving replica's dispatch is killed by
+    an injected fault mid-trace; served + shed must equal submitted with
+    ZERO non-shed failures (exactly-once), the dead replica's breaker
+    must be OPEN and the healthy replica must have absorbed the traffic;
+  * checkpoint-write-fault: a transient injected write failure is
+    retried to a commit; a persistent one surfaces while the previous
+    committed checkpoint stays discoverable and bit-exactly loadable
+    (no torn manifest);
+  * zero-overhead: with no spec configured, `fault_point` is a no-op
+    behind one cached flag.
+
+Prints one JSON summary line; non-zero exit on any violated contract.
+ci/run.py runs tpulint (incl. TPL106 swallowed-exception) over the
+resilience modules as the stage's second command.
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+from mxnet_tpu.resilience import faults  # noqa: E402
+from mxnet_tpu.serving import ModelServer, DeadlineExceeded  # noqa: E402
+
+
+def _net(prefix, hidden=8):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden,
+                                name=prefix + "_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name=prefix + "_fc1")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params(sym, rng):
+    shapes, _, _ = sym.infer_shape(data=(4, 6))
+    return {n: mx.nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+def scenario_zero_overhead():
+    faults.reset()
+    assert not faults.enabled(), "injection enabled with no spec"
+    orig = faults._fire
+    try:
+        def boom(*a, **k):
+            raise AssertionError("fault registry touched while disabled")
+        faults._fire = boom
+        faults.fault_point("serving.dispatch", replica=0)
+        faults.fault_point("checkpoint.write", step=1)
+    finally:
+        faults._fire = orig
+    return {"zero_overhead": True}
+
+
+def scenario_replica_kill():
+    rng = np.random.RandomState(0)
+    sym = _net("cs")
+    srv = ModelServer(breaker_threshold=2, breaker_cooldown_ms=200.0)
+    srv.register("cs", sym, _params(sym, rng), ctx=mx.cpu(), replicas=2,
+                 buckets=(4,), async_worker=False,
+                 warmup_shapes={"data": (4, 6)})
+    x = rng.normal(0, 1, (1, 6)).astype(np.float32)
+    n_req = 32
+    faults.configure(
+        "serving.dispatch:replica=0:mode=async:raise=OSError,killed")
+    futs = [srv.predict_async("cs", {"data": x}) for _ in range(n_req)]
+    for _ in range(3):
+        srv.engine("cs", replica=0).flush()
+        srv.engine("cs", replica=1).flush()
+    faults.reset()
+    served = shed = failed = 0
+    for f in futs:
+        assert f.done(), "request left unresolved after replica kill"
+        if f.error is None:
+            served += 1
+        elif isinstance(f.error, DeadlineExceeded):
+            shed += 1
+        else:
+            failed += 1
+    st = srv.stats()["cs"]
+    breakers = [r["breaker"] for r in st["versions"]["1"]]
+    out = {"submitted": n_req, "served": served, "shed": shed,
+           "failed": failed,
+           "dispatch_retries": st["counters"]["dispatch_retries"],
+           "breaker_states": [b["state"] for b in breakers],
+           "faults_injected": profiler.fault_counters().get(
+               "serving.dispatch", 0)}
+    srv.stop()
+    assert served + shed == n_req, "requests lost under replica kill"
+    assert failed == 0, "non-shed failures leaked to clients"
+    assert out["faults_injected"] > 0, "the kill never fired"
+    assert breakers[0]["state"] == "open", "dead replica breaker not open"
+    assert breakers[1]["state"] == "closed", "healthy replica tripped"
+    assert out["dispatch_retries"] > 0, "no reroute happened"
+    return {"replica_kill": out}
+
+
+def scenario_checkpoint_write_fault():
+    from mxnet_tpu import checkpoint as ckpt
+    from mxnet_tpu.checkpoint import CheckpointManager
+    tmpdir = tempfile.mkdtemp(prefix="chaos_ckpt_")
+    try:
+        mgr = CheckpointManager(tmpdir)
+        mgr._write_retry.base_delay_s = 0.001
+        sym = _net("ck")
+        w1 = np.full((8, 6), 1.0, np.float32)
+
+        def save(step, value):
+            return mgr.save(step, symbol=sym,
+                            arg_params={"ck_fc0_weight":
+                                        mx.nd.array(value)},
+                            blocking=True)
+        save(1, w1)
+        profiler.retry_counters(reset=True)
+        # transient: one injected failure, retried to a commit
+        faults.configure("checkpoint.write:count=1:raise=OSError,blip")
+        save(2, np.full((8, 6), 2.0, np.float32))
+        rc = profiler.retry_counters()
+        assert rc.get("checkpoint.write.recovery", 0) == 1, \
+            "transient write fault was not retried to success"
+        # persistent: every attempt fails; step 2 must survive intact
+        faults.configure("checkpoint.write:raise=OSError,disk dead")
+        failed = False
+        try:
+            save(3, np.full((8, 6), 3.0, np.float32))
+        except OSError:
+            failed = True
+        faults.reset()
+        assert failed, "persistent write fault did not surface"
+        path = ckpt.latest_checkpoint(tmpdir)
+        assert path and path.endswith("step-00000002"), \
+            "previous committed checkpoint lost"
+        arg, _ = ckpt.load_params(path)
+        got = arg["ck_fc0_weight"].asnumpy()
+        assert np.array_equal(got, np.full((8, 6), 2.0, np.float32)), \
+            "restored params not bit-exact"
+        torn = [n for n in os.listdir(tmpdir) if n.startswith(".tmp-")
+                and os.path.isfile(os.path.join(tmpdir, n, "meta.json"))]
+        assert not torn, "torn staging dir carries a manifest: %s" % torn
+        return {"checkpoint_fault": {
+            "transient_recovered": True, "persistent_surfaced": True,
+            "latest_step_after_fault": 2,
+            "giveups": profiler.retry_counters().get(
+                "checkpoint.write.giveup", 0)}}
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def main():
+    summary = {}
+    summary.update(scenario_zero_overhead())
+    summary.update(scenario_replica_kill())
+    summary.update(scenario_checkpoint_write_fault())
+    summary["retry_counters"] = {
+        k: v for k, v in profiler.retry_counters().items()
+        if isinstance(v, int) and v}
+    print(json.dumps(summary), flush=True)
+    print("chaos_smoke OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
