@@ -38,7 +38,7 @@ PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy
     "jax_baseline": 700, "flash": 700, "io_train": 600,
     "infer_int8": 600, "train_big_batch": 900, "flash_parity": 500,
     "cost": 600, "serving": 600, "serving_sla": 300,
-    "fault_recovery": 300,
+    "frontdoor": 300, "fault_recovery": 300,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -304,7 +304,7 @@ def main():
     # 2) measurement phases, each in its own budgeted child
     phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
               "io_train", "infer_int8", "train_big_batch", "flash_parity",
-              "cost", "serving", "fault_recovery"]
+              "cost", "serving", "frontdoor", "fault_recovery"]
     # phases that measure nothing useful on the CPU fallback (outage
     # removals — unlike explicit_skips, the bank may still supply them)
     cpu_useless = {"train_bf16", "train_big_batch", "flash_parity"}
@@ -409,7 +409,8 @@ def main():
         extra.update(_host_stamp())
     for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
                   "io_train", "infer_int8", "train_big_batch",
-                  "flash_parity", "cost", "serving", "fault_recovery"):
+                  "flash_parity", "cost", "serving", "frontdoor",
+                  "fault_recovery"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
                       if not k.startswith("_")})
     # mixed-platform runs (partial rescue): say which metric ran where.
@@ -1397,6 +1398,220 @@ def _phase_io_train():
                 "steps": int(pc.get("steps", 0))}}
 
 
+# The front-door bench client: a REAL second OS process driving the TCP
+# gateway closed-loop. Reports per-request client latency plus the
+# server's per-request timing breakdown, so added wire cost is measured
+# per request (client wall - server queue - server device), not inferred
+# from separate runs.
+_FRONTDOOR_CLIENT = r'''
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, %(root)r)
+import numpy as np
+from mxnet_tpu.serving import ServingClient
+port, seed, n_req, rows = (int(sys.argv[1]), int(sys.argv[2]),
+                           int(sys.argv[3]), int(sys.argv[4]))
+cli = ServingClient("127.0.0.1", port)
+rng = np.random.RandomState(seed)
+x = rng.uniform(-1, 1, (rows, %(indim)d)).astype(np.float32)
+# warm the connection + program path outside the timed window
+for _ in range(3):
+    cli.predict({"data": x}, model="frontdoor", timeout=120.0)
+lat, added = [], []
+tic = time.monotonic()
+for i in range(n_req):
+    t0 = time.monotonic()
+    f = cli.predict_async({"data": x}, model="frontdoor")
+    f.result_wait(120.0)
+    ms = (time.monotonic() - t0) * 1e3
+    lat.append(ms)
+    t = f.timings or {}
+    added.append(ms - t.get("queue_ms", 0.0) - t.get("device_ms", 0.0))
+wall = time.monotonic() - tic
+lat.sort(); added.sort()
+def pct(v, q):
+    return v[min(int(q * len(v)), len(v) - 1)] if v else None
+print(json.dumps({
+    "n": n_req, "wall_s": wall,
+    "lat_p50_ms": pct(lat, 0.5), "lat_p99_ms": pct(lat, 0.99),
+    "added_p50_ms": pct(added, 0.5), "added_p99_ms": pct(added, 0.99)}))
+cli.close()
+'''
+
+
+def _phase_frontdoor():
+    """Cross-process serving gateway (ISSUE 11): N client OS processes
+    drive the TCP front door against the in-process baseline. Reports
+    `frontdoor_req_per_sec` (aggregate closed-loop across the socket)
+    vs `frontdoor_inprocess_req_per_sec` (same trace, same process),
+    the ADDED wire latency per request (client wall minus the server's
+    own queue+device time, p50/p99 — serialization + TCP + demux), and
+    goodput under a 2x open-loop overload ACROSS the socket with the
+    served p99 decomposed into wire/queue/device from the trace-id
+    latency histograms. A graceful drain closes the phase and its
+    accounting must be exact."""
+    import subprocess
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import (ModelServer, ServingFrontDoor,
+                                   ServingClient, DeadlineExceeded)
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+    # same model shape logic as serving_sla: a step in the tens-of-ms
+    # band so the serving/network tier is what gets measured, not host
+    # scheduling noise
+    hidden = 1024
+    indim = 128
+    bucket = 8
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fdb_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=hidden, name="fdb_fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fdb_fc2")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape(data=(bucket, indim))
+    args = {n: mx.nd.array(rng.normal(0, 0.05, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+    profiler.latency_counters(reset=True, prefix="serving.frontdoor.")
+    srv = ModelServer()
+    srv.register("frontdoor", sym, args, ctx=mx.tpu(0), buckets=(bucket,),
+                 max_delay_ms=1.0, slack_factor=3.0, shed_margin=2.5,
+                 warmup_shapes={"data": (bucket, indim)})
+    fd = ServingFrontDoor(srv, port=0).start()
+    xb = rng.uniform(-1, 1, (bucket, indim)).astype(np.float32)
+    x1 = xb[:1]
+
+    # --- in-process baseline: same closed-loop trace, no socket -------
+    n_base = bucket * 12
+    for _ in range(bucket):
+        srv.predict_async("frontdoor", {"data": x1}).result_wait(120.0)
+    tic = time.monotonic()
+    for _ in range(n_base):
+        srv.predict_async("frontdoor", {"data": x1}).result_wait(120.0)
+    inproc_rps = n_base / (time.monotonic() - tic)
+
+    # --- N client processes, closed loop over the socket --------------
+    n_clients = 2
+    n_req = bucket * 12
+    script = _FRONTDOOR_CLIENT % {"root": _HERE, "indim": indim}
+    tic = time.monotonic()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", script, str(fd.port), str(seed), str(n_req),
+         "1"], stdout=subprocess.PIPE, text=True)
+        for seed in range(1, n_clients + 1)]
+    reports = []
+    for p in procs:
+        out_s, _ = p.communicate(timeout=PHASE_BUDGET_S["frontdoor"])
+        if p.returncode != 0:
+            raise RuntimeError("frontdoor bench client failed: %s"
+                               % out_s[-500:])
+        reports.append(json.loads(out_s.strip().splitlines()[-1]))
+    wall = time.monotonic() - tic
+    total_req = sum(r["n"] for r in reports)
+    wire_rps = total_req / wall
+
+    # --- 2x open-loop overload ACROSS the socket ----------------------
+    cli = ServingClient("127.0.0.1", fd.port, pool_size=2)
+    eng = srv.engine("frontdoor")
+    # SATURATED capacity over the socket (async backlog drain — the
+    # closed-loop wire_rps above is round-trip-bound, not a capacity):
+    # the overload schedule and the SLA both key off this, exactly like
+    # the in-process serving_sla phase
+    n_cal = bucket * 16
+    tic = time.monotonic()
+    cal = [cli.predict_async({"data": x1}, model="frontdoor")
+           for _ in range(n_cal)]
+    for f in cal:
+        f.result_wait(PHASE_BUDGET_S["frontdoor"])
+    capacity_rps = n_cal / (time.monotonic() - tic)
+    # the p99 decomposition below must describe the OVERLOAD window, not
+    # a blend with the baseline/closed-loop/calibration traffic recorded
+    # so far (same reason serving_sla uses a steady-state window)
+    profiler.latency_counters(reset=True, prefix="serving.frontdoor.")
+    tail_s = eng._cache.step_time_tail(bucket) or 0.01
+    sla_floor_ms = 25.0 if on_tpu else 200.0
+    sla_ms = max(8.0 * bucket / max(capacity_rps, 1e-6) * 1e3,
+                 2.5 * 1.5 * tail_s * 1e3, sla_floor_ms)
+    gap_s = max(bucket / max(2.0 * capacity_rps, 1e-6), 1.5e-3)
+    duration_s = max(0.4, 8.0 * sla_ms / 1e3)
+    n_bursts = max(12, min(1600 // bucket, int(duration_s / gap_s)))
+    futs = []
+    start = time.monotonic()
+    for b in range(n_bursts):
+        target = start + b * gap_s
+        now = time.monotonic()
+        if target > now:
+            time.sleep(target - now)
+        for _ in range(bucket):
+            futs.append(cli.predict_async({"data": x1}, model="frontdoor",
+                                          deadline_ms=0.85 * sla_ms))
+    submit_wall_s = time.monotonic() - start
+    served = shed = errors = 0
+    lat = []
+    for f in futs:
+        try:
+            f.result_wait(PHASE_BUDGET_S["frontdoor"])
+            served += 1
+            t = f.timings or {}
+            if "total_ms" in t:
+                lat.append(t["total_ms"])
+        except DeadlineExceeded:
+            shed += 1
+        except Exception:
+            errors += 1
+    submitted = len(futs)
+    lat.sort()
+
+    def pct(vals, q):
+        return round(vals[min(int(q * len(vals)), len(vals) - 1)], 2) \
+            if vals else None
+
+    within = sum(1 for v in lat if v <= sla_ms)
+    hist = profiler.latency_counters(prefix="serving.frontdoor.")
+    decomp = {leg: hist.get("serving.frontdoor.%s" % leg, {}).get("p99_ms")
+              for leg in ("wire", "queue", "device", "total")}
+    cli.close()
+    drain_clean = fd.drain(timeout=60.0)
+    st = fd.stats()
+    srv.stop()
+    return {
+        "frontdoor_req_per_sec": round(wire_rps, 1),
+        "frontdoor_inprocess_req_per_sec": round(inproc_rps, 1),
+        "frontdoor_vs_inprocess": round(wire_rps / inproc_rps, 3)
+        if inproc_rps else None,
+        "frontdoor_clients": n_clients,
+        "frontdoor_wire_added_p50_ms": round(max(
+            r["added_p50_ms"] for r in reports), 3),
+        "frontdoor_wire_added_p99_ms": round(max(
+            r["added_p99_ms"] for r in reports), 3),
+        "frontdoor_client_p50_ms": round(max(
+            r["lat_p50_ms"] for r in reports), 3),
+        "frontdoor_capacity_rps": round(capacity_rps, 1),
+        "frontdoor_sla_ms": round(sla_ms, 2),
+        "frontdoor_overload_factor": round(
+            (submitted / max(submit_wall_s, 1e-9))
+            / max(capacity_rps, 1e-9), 2),
+        "frontdoor_submitted": submitted,
+        "frontdoor_served": served,
+        "frontdoor_shed": shed,
+        "frontdoor_errors": errors,
+        "frontdoor_goodput_under_sla": round(within / float(submitted), 3),
+        "frontdoor_shed_rate": round(shed / float(submitted), 3),
+        "frontdoor_served_p99_ms": pct(lat, 0.99),
+        "frontdoor_p99_decomposition_ms": decomp,
+        "frontdoor_accounting_exact":
+            served + shed + errors == submitted
+            and st["submitted"] == st["served"] + st["shed"] + st["failed"],
+        "frontdoor_drain_clean": bool(drain_clean),
+        "frontdoor_orphaned": st["orphaned"],
+    }
+
+
 def _phase_fault_recovery():
     """Resilience under injected faults (ISSUE 9): the numbers that make
     the recovery claims measurable. (a) Replica kill mid-trace: one of
@@ -1521,6 +1736,7 @@ PHASES = {
     "cost": _phase_cost,
     "serving": _phase_serving,
     "serving_sla": _phase_serving_sla,
+    "frontdoor": _phase_frontdoor,
     "fault_recovery": _phase_fault_recovery,
 }
 

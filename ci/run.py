@@ -105,6 +105,23 @@ def stage_serving_smoke(_):
          os.path.join("mxnet_tpu", "serving")], cwd=ROOT)
 
 
+def stage_frontdoor_smoke(_):
+    """Non-slow cross-process serving gate (ISSUE 11): two client OS
+    processes get bit-identical predictions over the TCP front door,
+    deadline shed travels typed across the wire, and a graceful drain
+    resolves every in-flight request (submitted == served + shed +
+    failed, zero pending) — then tpulint over the serving modules."""
+    rc = subprocess.call(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "frontdoor_smoke.py")],
+        env=_env_cpu_mesh(1), cwd=ROOT)
+    if rc != 0:
+        return rc
+    return subprocess.call(
+        [sys.executable, "-m", "mxnet_tpu.analysis.lint",
+         os.path.join("mxnet_tpu", "serving")], cwd=ROOT)
+
+
 def stage_chaos_smoke(_):
     """Non-slow resilience gate (ISSUE 9): replica-kill-under-load
     (served + shed == submitted, breaker opens, traffic reroutes) and
@@ -142,6 +159,7 @@ STAGES = [
     ("zero_smoke", stage_zero_smoke),
     ("multichip", stage_multichip),
     ("serving_smoke", stage_serving_smoke),
+    ("frontdoor_smoke", stage_frontdoor_smoke),
     ("chaos_smoke", stage_chaos_smoke),
     ("bench_smoke", stage_bench_smoke),
 ]

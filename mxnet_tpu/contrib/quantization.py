@@ -275,7 +275,24 @@ def calib_thresholds_minmax(collected):
 
 def calib_threshold_kl(hist, hist_edges, num_quantized_bins=255):
     """Optimal threshold minimizing KL(P||Q) (reference:
-    _get_optimal_threshold — the TensorRT-style entropy calibration)."""
+    _get_optimal_threshold — the TensorRT-style entropy calibration).
+
+    Faithful to the reference in the two places a simpler vectorization
+    silently mis-scales thresholds (the PR 11 tier-1 diagnosis —
+    entropy-calibrated ResNet layers came out clipped to
+    ``num_quantized_bins / num_bins`` = 3.2% of their range):
+
+    * each quantized level's mass expands back over its NONZERO source
+      bins only (the reference's ``is_nonzeros`` masking; dividing by
+      ALL source bins smears mass into empty bins, which inflates KL
+      for every coarse candidate exactly when the histogram is spiky —
+      ReLU/global-pool activations put half their mass in the first few
+      of 8001 bins);
+    * the ``i == num_quantized_bins`` candidate is EXCLUDED: there the
+      quantize/expand is the identity, so its KL omits all resolution
+      error by construction and wins on any spike-shaped histogram —
+      a degenerate comparison, not a better threshold.
+    """
     hist = _np.asarray(hist, _np.float64)
     hist_edges = _np.asarray(hist_edges, _np.float64)
     if len(hist_edges) == len(hist) + 1:  # full edges -> upper edges
@@ -286,19 +303,23 @@ def calib_threshold_kl(hist, hist_edges, num_quantized_bins=255):
     thresholds = []
     divergences = []
     tail = _np.concatenate([hist[::-1].cumsum()[::-1][1:], [0.0]])
-    for i in range(num_quantized_bins, num_bins + 1):
+    for i in range(num_quantized_bins + 1, num_bins + 1):
         p = hist[:i].copy()
         p[i - 1] += tail[i - 1]  # clip outliers into the edge bin
+        nonzero = p > 0
         p_norm = p / p.sum()
         # quantize the first i bins into num_quantized_bins, expand back
-        # (vectorized: the naive per-bin python loops make 8001-bin
-        # calibration of a deep net take hours)
+        # over the nonzero source bins (vectorized: the naive per-bin
+        # python loops make 8001-bin calibration of a deep net take
+        # hours; the bincount pair is the reference's per-level
+        # mass/norm loop)
         idx = (_np.arange(i) * num_quantized_bins // i)
         q = _np.bincount(idx, weights=hist[:i],
                          minlength=num_quantized_bins)
-        counts = _np.bincount(idx, minlength=num_quantized_bins)
-        expanded = (q / _np.maximum(counts, 1))[idx]
-        nonzero = p > 0
+        nz_counts = _np.bincount(idx[nonzero],
+                                 minlength=num_quantized_bins)
+        expanded = _np.zeros(i)
+        expanded[nonzero] = (q / _np.maximum(nz_counts, 1))[idx[nonzero]]
         expanded_norm = expanded / max(expanded.sum(), 1e-12)
         kl = _np.sum(p_norm[nonzero] * _np.log(
             _np.maximum(p_norm[nonzero], 1e-12)

@@ -52,7 +52,6 @@ import os
 import pickle
 import re
 import socket
-import struct
 import threading
 
 import numpy as _np
@@ -63,6 +62,7 @@ from .ndarray import sparse as _mx_sparse
 from .ndarray.ndarray import array
 from .resilience import faults as _faults
 from .resilience.retry import RetryPolicy, TransientError
+from .serving import wire as _wire
 
 __all__ = ["AsyncParamServer", "KVStoreDistAsync", "serve_forever",
            "TransportError"]
@@ -86,28 +86,23 @@ def _stable_hash(key):
     return h
 
 
+# framing lives in serving/wire.py (extracted there for the serving
+# front door, ISSUE 11); these wrappers keep the kvstore's historical
+# contract — ANY end-of-stream, clean or mid-frame, reads as None and
+# the caller breaks the socket
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+    _wire.send_msg(sock, obj)
 
 
 def _recv_msg(sock):
-    header = _recv_exact(sock, 8)
-    if header is None:
+    try:
+        # no frame cap: the historical transport accepted arbitrarily
+        # large parameter shards (trusted peers only), and capping here
+        # would misread an oversized-but-healthy reply as a dead
+        # connection and retry it forever
+        return _wire.recv_msg(sock, max_bytes=None)
+    except _wire.FrameError:
         return None
-    (n,) = struct.unpack("<Q", header)
-    payload = _recv_exact(sock, n)
-    return None if payload is None else pickle.loads(payload)
-
-
-def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
 
 
 class AsyncParamServer:

@@ -13,6 +13,13 @@ shedding requests whose deadline budget queue wait already consumed
 and a `ModelServer` hosts many named model/version entries with
 least-loaded replica fan-out and live weight rollover.
 
+Cross-process serving (ISSUE 11): `ServingFrontDoor` hosts a ModelServer
+behind a TCP port (`serving/frontdoor.py` — deadline propagation,
+request-level tracing, graceful drain) and `ServingClient`
+(`serving/client.py`) is the pooled-connection caller; both speak the
+length-prefixed framing in `serving/wire.py` shared with the dist_async
+transport.
+
     from mxnet_tpu.serving import InferenceEngine, ModelServer
 """
 from .program_cache import BucketedProgramCache, DEFAULT_BUCKETS, bucket_for
@@ -20,7 +27,10 @@ from .batcher import (DynamicBatcher, DeadlineExceeded, pad_to_bucket,
                       default_max_batch)
 from .engine import InferenceEngine
 from .server import ModelServer
+from .frontdoor import ServingFrontDoor
+from .client import ServingClient
 
-__all__ = ["InferenceEngine", "ModelServer", "BucketedProgramCache",
+__all__ = ["InferenceEngine", "ModelServer", "ServingFrontDoor",
+           "ServingClient", "BucketedProgramCache",
            "DynamicBatcher", "DeadlineExceeded", "DEFAULT_BUCKETS",
            "bucket_for", "pad_to_bucket", "default_max_batch"]

@@ -432,30 +432,36 @@ class DynamicBatcher:
             # and that sweep (not the model) becomes the serving tier's
             # critical path.
             now = time.monotonic()
+
+            def _infeasible(req):
+                """(shed?, est): spike budget is shed_margin x the
+                decaying-max step, CLAMPED to 60% of the request's own
+                budget — the tail is a conservative spike estimate, and
+                letting a pathological stall observation exceed whole
+                budgets would flip the shedder from bounding p99 to
+                refusing all work. Queue wait stays the primary shed
+                signal (the ISSUE contract); the tail refines the
+                edge."""
+                est = min(
+                    self._est_step(req.n, tail=True) * self._shed_margin,
+                    0.6 * (req.deadline - req.t_submit))
+                return now + est > req.deadline, est
+
             self._queue.sort(key=_Request._edf_key)
             group, total = [], 0
+            shed_engaged = False
             i = 0
             while i < len(self._queue) and total < self.max_batch:
                 req = self._queue[i]
                 if req.deadline is not None:
-                    # spike budget: shed_margin x the decaying-max step,
-                    # CLAMPED to 60% of the request's own budget — the
-                    # tail is a conservative spike estimate, and letting
-                    # a pathological stall observation exceed whole
-                    # budgets would flip the shedder from bounding p99
-                    # to refusing all work. Queue wait stays the primary
-                    # shed signal (the ISSUE contract); the tail refines
-                    # the edge.
-                    est = min(
-                        self._est_step(req.n, tail=True)
-                        * self._shed_margin,
-                        0.6 * (req.deadline - req.t_submit))
-                    if now + est > req.deadline:
+                    shed, est = _infeasible(req)
+                    if shed:
                         # queue wait consumed the budget (or the step
                         # cannot fit what remains): fast-fail instead of
                         # serving late
                         self._queue.pop(i)
                         self._shed_locked(req, now, est)
+                        shed_engaged = True
                         continue
                 if total + req.n <= self.max_batch:
                     self._queue.pop(i)
@@ -463,6 +469,34 @@ class DynamicBatcher:
                     total += req.n
                 else:
                     i += 1
+            if shed_engaged:
+                # Shed-order fairness (ISSUE 11 satellite): the selection
+                # scan judges requests front-to-back in EDF order — i.e.
+                # HIGHEST priority first — and stops once the batch
+                # fills. Left alone, that sheds a high-priority request
+                # at the front while an equal-slack LOWER-priority
+                # request deeper in the queue escapes judgment this
+                # formation (and may then survive outright when the
+                # decaying-max estimate relaxes before it is next
+                # judged). When shedding engages, finish the job: sweep
+                # the REMAINING queue from the back — lowest priority /
+                # farthest deadline first — and shed everything
+                # infeasible by the same test at the same `now`, so
+                # victims at equal slack are always taken
+                # lowest-priority-first and a shed notification never
+                # waits on a later formation. The sweep runs ONLY in
+                # formations that already shed (overload), so the lazy
+                # O(batch) argument above still holds for healthy
+                # traffic; each swept victim leaves the queue, so the
+                # cost amortizes to one judgment per shed request.
+                for j in range(len(self._queue) - 1, -1, -1):
+                    req = self._queue[j]
+                    if req.deadline is None:
+                        continue
+                    shed, est = _infeasible(req)
+                    if shed:
+                        self._queue.pop(j)
+                        self._shed_locked(req, now, est)
             if not group and self._queue:
                 # head request alone exceeds max_batch (e.g. a small
                 # set_bulk_size with large warmed buckets): dispatch it

@@ -1,0 +1,578 @@
+"""ServingClient — the cross-process caller of a ServingFrontDoor.
+
+The cheap half of the serving split (arXiv:1605.08695's client/master
+asymmetry; `serving/frontdoor.py` documents the wire protocol): a client
+process holds a small pool of TCP connections, ships request batches as
+length-prefixed frames, and gets back typed outcomes — served outputs,
+the typed `DeadlineExceeded` for sheds, or a failure message.
+
+Retry semantics (the PR 9 `RetryPolicy`, mirroring the dist_async
+push-never-retries split):
+
+* **connect** retries under the unified exponential-backoff policy
+  (``site="frontdoor.connect"``) — the gateway may still be binding when
+  clients start;
+* a request whose send FAILED is safe to resubmit on a fresh connection:
+  `sendall` raised, so the server saw at most a partial frame and
+  discarded it (`wire.FrameError`) — the request was never admitted;
+* a request whose bytes were FULLY sent is **never blindly retried** —
+  the server may have admitted (and even served) the original. After a
+  reconnect the client sends ``("resolve", ...)`` with the
+  server-assigned request ids: a retained outcome resolves the future
+  with the REAL result, ``unknown`` proves the request was never
+  admitted (safe to resubmit), ``pending`` waits and asks again.
+  Exactly-once by construction, like the kvstore's idempotent-pull-only
+  retry.
+
+Deadline propagation: ``deadline_ms`` is tracked against the CLIENT's
+clock from submit; each (re)send ships only the REMAINING budget plus
+the send wall-clock, and the server subtracts the measured transfer —
+so queue wait at the gateway accrues against the true end-to-end budget
+no matter how many resubmits happened. Every request carries a trace id
+(caller-supplied or generated) that comes back in the reply's timing
+breakdown (``wire_ms``/``queue_ms``/``device_ms``/``total_ms``).
+
+    client = ServingClient("127.0.0.1", port)
+    out = client.predict({"data": batch}, model="resnet")
+    fut = client.predict_async({"data": rows}, model="resnet",
+                               deadline_ms=25, priority=1)
+    rows_out = fut.result_wait(1.0)     # raises DeadlineExceeded on shed
+    client.health()                     # the autoscaling signal
+    client.close()
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import uuid
+
+import numpy as _np
+
+from ..base import MXNetError, get_env
+from ..resilience.retry import RetryPolicy
+from . import wire as _wire
+from .batcher import DeadlineExceeded
+from .frontdoor import DEFAULT_PORT
+
+__all__ = ["ServingClient", "ClientRequest"]
+
+
+class ClientRequest:
+    """Future-like handle with the same surface as the in-process
+    request objects (``done()`` / ``result_wait(timeout)`` /
+    ``add_done_callback(fn)``), plus the reply's server-side timing
+    breakdown under ``timings`` and the request's ``trace`` id."""
+
+    __slots__ = ("rid", "trace", "model", "result", "error", "timings",
+                 "resubmits", "_event", "_cb_lock", "_callbacks",
+                 "_deadline", "_priority", "_version", "_arrays",
+                 "_send_wall")
+
+    def __init__(self, rid, trace, model, version, arrays, deadline,
+                 priority):
+        self.rid = rid
+        self.trace = trace
+        self.model = model
+        self.result = None
+        self.error = None
+        self.timings = None
+        self.resubmits = 0
+        self._event = threading.Event()
+        self._cb_lock = threading.Lock()
+        self._callbacks = []
+        self._deadline = deadline      # absolute monotonic or None
+        self._priority = priority
+        self._version = version
+        self._arrays = arrays
+        self._send_wall = None
+
+    # -- future surface ------------------------------------------------
+    def done(self):
+        return self._event.is_set()
+
+    def result_wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise MXNetError("inference request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    def add_done_callback(self, fn):
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _resolve(self, result=None, error=None, timings=None):
+        with self._cb_lock:
+            if self._event.is_set():
+                return              # exactly-once: a late resolve is a no-op
+            self.result = result
+            self.error = error
+            self.timings = timings
+            self._arrays = None     # no resubmit after resolution: release
+            #                         the request payload (bench loops hold
+            #                         thousands of futures)
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:
+                pass  # tpulint: allow-swallowed-exception an observer must never poison the delivery path (batcher._finish contract)
+
+    def _remaining_ms(self):
+        if self._deadline is None:
+            return None
+        return (self._deadline - time.monotonic()) * 1000.0
+
+    def _spec(self):
+        """The wire payload for one (re)send: remaining budget + fresh
+        send wall-clock, so every attempt propagates the TRUE budget."""
+        self._send_wall = time.time()
+        return {"model": self.model, "version": self._version,
+                "arrays": self._arrays, "deadline_ms": self._remaining_ms(),
+                "priority": self._priority, "trace": self.trace,
+                "t_send": self._send_wall}
+
+
+class _ClientConn:
+    """One pooled connection: socket + reply-demultiplexing reader."""
+
+    __slots__ = ("client", "sock", "conn_id", "seq", "send_lock",
+                 "pending", "pending_lock", "alive", "reader", "stop_evt")
+
+    def __init__(self, client, sock, conn_id):
+        self.client = client
+        self.sock = sock
+        self.conn_id = conn_id
+        self.seq = 0
+        self.send_lock = threading.Lock()
+        self.pending = {}       # rid -> ClientRequest (or control future)
+        self.pending_lock = threading.Lock()
+        self.alive = True
+        self.stop_evt = threading.Event()
+        self.reader = threading.Thread(target=self._read_loop,
+                                       name="mx-serving-client-read",
+                                       daemon=True)
+        self.reader.start()
+
+    def next_rid(self):
+        with self.send_lock:
+            self.seq += 1
+            return "c%d-%d" % (self.conn_id, self.seq)
+
+    def inflight(self):
+        with self.pending_lock:
+            return len(self.pending)
+
+    def send(self, frame):
+        """One frame out; raises on transport failure (the caller owns
+        the resubmit-vs-resolve decision)."""
+        with self.send_lock:
+            _wire.send_msg(self.sock, frame)
+
+    def register(self, rid, fut):
+        with self.pending_lock:
+            self.pending[rid] = fut
+
+    def unregister(self, rid):
+        with self.pending_lock:
+            return self.pending.pop(rid, None)
+
+    @staticmethod
+    def _teardown(sock):
+        """shutdown THEN close: a bare close() on a socket another
+        thread is blocked in recv() on neither wakes that thread nor
+        promptly FINs the peer — shutdown(SHUT_RDWR) does both."""
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # tpulint: allow-swallowed-exception peer already gone; shutdown is best-effort
+        try:
+            sock.close()
+        except OSError:
+            pass  # tpulint: allow-swallowed-exception socket already dead; close is best-effort hygiene
+
+    def close(self):
+        self.alive = False
+        self.stop_evt.set()
+        self._teardown(self.sock)
+
+    def break_transport(self):
+        """Mark the transport dead WITHOUT setting stop_evt — the
+        difference matters: ``close()`` is the user's shutdown and
+        suppresses recovery, while a broken transport must let the
+        reader wake (shutdown raises EOF under its recv), see a
+        transport death, and run the client's resolve-by-id recovery
+        for every OTHER request still pending on this connection."""
+        self.alive = False
+        self._teardown(self.sock)
+
+    # ------------------------------------------------------------------
+    def _read_loop(self):
+        while not self.stop_evt.is_set():
+            try:
+                # tick-aware: an idle-timeout before any frame byte just
+                # re-checks stop_evt; a timeout INSIDE a frame is a
+                # stalled-peer FrameError, never a silent desync
+                msg = _wire.recv_msg_tick(self.sock)
+            except (_wire.FrameError, OSError):
+                msg = None
+            if msg is _wire.TICK:
+                continue
+            if msg is None:
+                break
+            self._dispatch(msg)
+        if not self.stop_evt.is_set():     # transport death, not close()
+            self.alive = False
+            with self.pending_lock:
+                lost = dict(self.pending)
+                self.pending.clear()
+            if lost:
+                self.client._recover(self, lost)
+
+    def _dispatch(self, msg):
+        verb = msg[0]
+        rid = msg[1] if len(msg) > 1 else None
+        fut = self.unregister(rid)
+        if fut is None:
+            return                  # late reply for an already-failed-over rid
+        if verb == "served":
+            fut._resolve(result=msg[2], timings=msg[3])
+        elif verb == "shed":
+            fut._resolve(error=DeadlineExceeded(msg[2]))
+        elif verb == "failed":
+            fut._resolve(error=MXNetError(msg[2]))
+        elif verb in ("resolved", "health", "models", "pong"):
+            fut._resolve(result=msg[2] if len(msg) > 2 else None)
+        else:
+            fut._resolve(error=MXNetError("unknown reply verb %r"
+                                          % (verb,)))
+
+
+class _ControlFuture:
+    """Minimal future for control round-trips (resolve/health/...)."""
+
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+    def _resolve(self, result=None, error=None, timings=None):
+        self.result = result
+        self.error = error
+        self.event.set()
+
+    def wait(self, timeout):
+        if not self.event.wait(timeout):
+            raise MXNetError("front door control round-trip timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class ServingClient:
+    """Pooled-connection client of a :class:`ServingFrontDoor`.
+
+    Parameters
+    ----------
+    host, port : gateway address (port defaults to
+        ``MXNET_SERVING_PORT``).
+    pool_size : int
+        Connections to spread concurrent requests over (default 1;
+        submissions pick the least-loaded live connection).
+    connect_deadline_s : float
+        Wall-clock budget for establishing (or re-establishing) one
+        connection under the retry policy.
+    resubmits : int
+        How many times one request may be RE-submitted after a
+        transport failure (applies to the never-admitted cases: failed
+        sends and ``unknown`` resolve outcomes; an admitted request is
+        resolved, never resubmitted).
+    """
+
+    def __init__(self, host="127.0.0.1", port=None, pool_size=1,
+                 connect_deadline_s=30.0, resubmits=2):
+        self._host = host
+        self._port = int(port) if port is not None else int(get_env(
+            "MXNET_SERVING_PORT", DEFAULT_PORT, int))
+        self._pool_size = max(1, int(pool_size))
+        self._resubmits = max(0, int(resubmits))
+        self._connect_retry = RetryPolicy(
+            attempts=1000, base_delay_s=0.05, cap_delay_s=0.5,
+            deadline_s=float(connect_deadline_s), retryable=OSError,
+            site="frontdoor.connect")
+        self._lock = threading.Lock()
+        self._pool = []
+        self._closed = False
+        self.stats = {"submitted": 0, "resubmits": 0, "resolved_remote": 0,
+                      "recovered_unknown": 0, "failovers": 0}
+
+    # ------------------------------------------------------------------
+    # connections
+    # ------------------------------------------------------------------
+    def _connect(self):
+        sock = self._connect_retry.call(
+            socket.create_connection, (self._host, self._port),
+            timeout=300.0)
+        hello = _wire.recv_msg(sock)
+        if not (isinstance(hello, tuple) and hello
+                and hello[0] == "hello"):
+            sock.close()
+            raise MXNetError("front door handshake failed: expected "
+                             "hello, got %r" % (hello,))
+        return _ClientConn(self, sock, int(hello[1]))
+
+    def _acquire(self):
+        """Least-loaded live pooled connection, growing the pool lazily
+        up to ``pool_size``; dead connections are replaced."""
+        with self._lock:
+            if self._closed:
+                raise MXNetError("ServingClient is closed")
+            self._pool = [c for c in self._pool if c.alive]
+            if len(self._pool) < self._pool_size:
+                grow = True
+            else:
+                grow = False
+                conn = min(self._pool, key=_ClientConn.inflight)
+        if grow:
+            conn = self._connect()
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    raise MXNetError("ServingClient is closed")
+                self._pool = [c for c in self._pool if c.alive]
+                if len(self._pool) >= self._pool_size:
+                    # lost the grow race to a concurrent submitter: the
+                    # pool is full again — keep the documented cap, use
+                    # a pooled connection instead of the fresh one
+                    pooled = min(self._pool, key=_ClientConn.inflight)
+                    conn.close()
+                    conn = pooled
+                else:
+                    self._pool.append(conn)
+        return conn
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            conn.close()
+
+    # ------------------------------------------------------------------
+    # predict
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _normalize(data):
+        """Host np arrays for the wire — a dict, a single array, or a
+        positional list (the gateway's engine maps names)."""
+        if isinstance(data, dict):
+            # tpulint: allow-host-sync client-side request staging: the wire ships host arrays by construction
+            return {k: _np.asarray(v) for k, v in data.items()}
+        if isinstance(data, (list, tuple)):
+            # tpulint: allow-host-sync same wire-staging rule for positional request arrays
+            return [_np.asarray(v) for v in data]
+        return _np.asarray(data)  # tpulint: allow-host-sync same wire-staging rule for a bare request array
+
+    def predict_async(self, data, model, version=None, deadline_ms=None,
+                      priority=0, trace_id=None):
+        """Ship one request; returns a :class:`ClientRequest` future.
+        ``deadline_ms`` is the END-TO-END budget from this call: wire
+        transfer, gateway queue wait and device time all accrue against
+        it (a shed comes back as the typed `DeadlineExceeded`)."""
+        deadline = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1000.0
+        trace = trace_id or uuid.uuid4().hex[:12]
+        req = ClientRequest(None, trace, model, version,
+                            self._normalize(data), deadline, int(priority))
+        self.stats["submitted"] += 1
+        self._submit(req)
+        return req
+
+    def predict(self, data, model, version=None, deadline_ms=None,
+                priority=0, timeout=None, trace_id=None):
+        """Synchronous predict over the wire; returns the output list."""
+        return self.predict_async(data, model, version=version,
+                                  deadline_ms=deadline_ms,
+                                  priority=priority,
+                                  trace_id=trace_id).result_wait(timeout)
+
+    def _submit(self, req):
+        """(Re)send one request. Failed SENDS resubmit on a fresh
+        connection (never admitted); a fully-sent request is owned by
+        the resolve protocol from here on."""
+        attempts = 0
+        while True:
+            rem = req._remaining_ms()
+            if rem is not None and rem <= 0.0:
+                req._resolve(error=DeadlineExceeded(
+                    "request shed client-side: deadline budget consumed "
+                    "before a send succeeded"))
+                return
+            try:
+                conn = self._acquire()
+            except BaseException as e:
+                req._resolve(error=e if isinstance(e, Exception)
+                             else MXNetError(str(e)))
+                if not isinstance(e, Exception):
+                    raise
+                return
+            rid = conn.next_rid()
+            req.rid = rid
+            conn.register(rid, req)
+            try:
+                conn.send(("predict", rid, req._spec()))
+                return
+            except OSError as e:
+                # sendall raised: at most a partial frame reached the
+                # server and was discarded as a FrameError — never
+                # admitted, safe to resubmit. break_transport (NOT
+                # close) so the reader still runs recovery for the
+                # OTHER requests pending on this connection.
+                conn.unregister(rid)
+                conn.break_transport()
+                attempts += 1
+                if attempts > self._resubmits:
+                    req._resolve(error=MXNetError(
+                        "front door send failed after %d attempts: %s"
+                        % (attempts, e)))
+                    return
+                req.resubmits += 1
+                self.stats["resubmits"] += 1
+
+    # ------------------------------------------------------------------
+    # transport-death recovery (reader thread)
+    # ------------------------------------------------------------------
+    def _recover(self, dead_conn, lost):
+        """The connection died with fully-sent requests outstanding.
+        NOT blindly retried: ask the server what became of each id;
+        only proven-unknown requests resubmit."""
+        self.stats["failovers"] += 1
+        with self._lock:
+            closed = self._closed
+        control = dict(lost)
+        requests = {rid: f for rid, f in control.items()
+                    if isinstance(f, ClientRequest)}
+        for rid, fut in control.items():
+            if not isinstance(fut, ClientRequest):
+                fut._resolve(error=MXNetError(
+                    "front door connection lost mid-control-round-trip"))
+        if not requests:
+            return
+        if closed:
+            for fut in requests.values():
+                fut._resolve(error=MXNetError(
+                    "client closed with requests in flight"))
+            return
+        outcomes = {}
+        # the resolve budget must outlive any request still LEGALLY in
+        # flight: failing a pending request while the server may yet
+        # serve it would race its own (orphaned) result. Deadline-less
+        # requests get a fixed window; everything is capped so a wedged
+        # gateway cannot pin this reader thread forever.
+        now = time.monotonic()
+        budget = now + 30.0
+        for fut in requests.values():
+            if fut._deadline is not None:
+                budget = max(budget, fut._deadline + 5.0)
+        budget = min(budget, now + 300.0)
+        attempt = 0
+        try:
+            while True:
+                pending_rids = [r for r in requests if r not in outcomes]
+                if not pending_rids:
+                    break
+                res = self._control("resolve", pending_rids, timeout=10.0)
+                still_pending = False
+                for rid, outcome in (res or {}).items():
+                    if outcome and outcome[0] == "pending":
+                        still_pending = True
+                    else:
+                        outcomes[rid] = outcome
+                if not still_pending or time.monotonic() > budget:
+                    break
+                attempt += 1
+                time.sleep(min(0.05 * attempt, 0.5))
+        except Exception as e:
+            for rid, fut in requests.items():
+                if rid not in outcomes:
+                    fut._resolve(error=MXNetError(
+                        "connection lost and the outcome could not be "
+                        "resolved: %s" % e))
+        for rid, fut in requests.items():
+            outcome = outcomes.get(rid)
+            if outcome is None:
+                # already failed in the except path above, or the
+                # resolve budget expired with the request still pending
+                # server-side — resolve TYPED rather than leave the
+                # future hanging forever (_resolve is exactly-once, so
+                # the already-failed case is a no-op)
+                fut._resolve(error=MXNetError(
+                    "connection lost; request still pending server-side "
+                    "when the resolve budget expired"))
+                continue
+            verb = outcome[0]
+            if verb == "served":
+                self.stats["resolved_remote"] += 1
+                fut._resolve(result=outcome[2], timings=outcome[3])
+            elif verb == "shed":
+                self.stats["resolved_remote"] += 1
+                fut._resolve(error=DeadlineExceeded(outcome[2]))
+            elif verb == "failed":
+                self.stats["resolved_remote"] += 1
+                fut._resolve(error=MXNetError(outcome[2]))
+            elif verb == "unknown":
+                # proven never-admitted: the one case a fully-sent
+                # request may go out again (mirrors push-never-retries:
+                # push retries only when the server provably never saw
+                # the original)
+                if fut.resubmits < self._resubmits:
+                    fut.resubmits += 1
+                    self.stats["recovered_unknown"] += 1
+                    self.stats["resubmits"] += 1
+                    self._submit(fut)
+                else:
+                    fut._resolve(error=MXNetError(
+                        "connection lost; request unknown to the server "
+                        "and resubmit budget exhausted"))
+            else:
+                fut._resolve(error=MXNetError(
+                    "unresolvable outcome %r" % (verb,)))
+
+    # ------------------------------------------------------------------
+    # control verbs
+    # ------------------------------------------------------------------
+    def _control(self, verb, payload=None, timeout=10.0):
+        conn = self._acquire()
+        fut = _ControlFuture()
+        rid = conn.next_rid()
+        conn.register(rid, fut)
+        try:
+            frame = (verb, rid) if payload is None else (verb, rid, payload)
+            conn.send(frame)
+        except OSError as e:
+            conn.unregister(rid)
+            conn.break_transport()
+            raise MXNetError("front door %s round-trip failed: %s"
+                             % (verb, e)) from e
+        return fut.wait(timeout)
+
+    def health(self, timeout=10.0):
+        """`ModelServer.health()` over the wire — per-model queue-wait
+        p95, shed rate, breaker states, in-flight counts (the
+        autoscaling signal; zero-deadline control verb)."""
+        return self._control("health", timeout=timeout)
+
+    def list_models(self, timeout=10.0):
+        """Registered models/versions/default aliases over the wire."""
+        return self._control("list_models", timeout=timeout)
+
+    def ping(self, timeout=10.0):
+        self._control("ping", timeout=timeout)
+        return True

@@ -244,10 +244,19 @@ class _ServerRequest:
     def _attempt(self):
         """Acquire a replica and submit; raises on synchronous submit
         failure (the caller decides whether that surfaces or resolves)."""
+        deadline_ms = self._remaining_ms()
+        if deadline_ms is not None and deadline_ms <= 0.0:
+            # the budget expired between submission and this attempt (a
+            # sub-millisecond remainder after the front door's wire
+            # subtraction, or scheduling delay): that is overload, and
+            # it resolves as the TYPED shed — handing a negative budget
+            # to the batcher would raise and mislabel it a failure
+            self._resolve(error=DeadlineExceeded(
+                "request shed: deadline budget consumed before dispatch"))
+            return
         rep = self._server._acquire(self._name, self._version,
                                     exclude=self._tried)
         self.attempts += 1
-        deadline_ms = self._remaining_ms()
         try:
             fut = rep.engine.predict_async(self._data,
                                            deadline_ms=deadline_ms,
@@ -719,6 +728,57 @@ class ModelServer:
             thread.join(timeout=5.0)
         for eng in engines:
             eng.stop()
+
+    def health(self):
+        """Machine-readable serving health — the AUTOSCALING signal
+        (ROADMAP item 3: queue-wait p95 as the scale-out trigger).
+        Unlike :meth:`stats` (a human-debugging deep dive) this is a
+        small, stable dict a controller can poll cheaply, and the front
+        door answers it as a zero-deadline control verb
+        (`serving/frontdoor.py` ``("health", rid)``).
+
+        Per model: ``queue_wait_p95_ms`` / ``queue_wait_p50_ms`` (from
+        the always-on latency histograms — the scale-out signal),
+        ``wire_p95_ms`` when the front door serves it, ``shed_rate`` /
+        request counters (the scale-up-NOW signal), live ``inflight``,
+        and per-replica breaker states (capacity actually available).
+        """
+        from .. import profiler as _prof
+        with self._lock:
+            snapshot = {
+                name: ({label: list(reps)
+                        for label, reps in entry.versions.items()},
+                       entry.default_version, dict(entry.counters))
+                for name, entry in self._models.items()}
+        models = {}
+        for name, (versions, default, counters) in snapshot.items():
+            lat = _prof.latency_counters(prefix="serving.%s." % name)
+            qwait = lat.get("serving.%s.queue" % name, {})
+            wire = lat.get("serving.%s.wire" % name, {})
+            submitted = counters.get("submitted", 0)
+            reps = [rep for rep_list in versions.values()
+                    for rep in rep_list]
+            breakers = [rep.breaker.snapshot() for rep in reps]
+            models[name] = {
+                "default_version": str(default),
+                "versions": sorted(str(v) for v in versions),
+                "replicas": len(reps),
+                "replicas_available": sum(
+                    1 for b in breakers if b["state"] != "open"),
+                "breaker_states": [b["state"] for b in breakers],
+                "inflight": sum(rep.inflight for rep in reps),
+                "queue_wait_p50_ms": qwait.get("p50_ms"),
+                "queue_wait_p95_ms": qwait.get("p95_ms"),
+                "wire_p95_ms": wire.get("p95_ms"),
+                "submitted": submitted,
+                "served": counters.get("served", 0),
+                "shed": counters.get("shed", 0),
+                "failed": counters.get("failed", 0),
+                "shed_rate": (round(counters.get("shed", 0)
+                                    / float(submitted), 4)
+                              if submitted else 0.0),
+            }
+        return {"ok": True, "models": models, "time": time.time()}
 
     def stats(self):
         """Per-model serving surface: default version, per-version

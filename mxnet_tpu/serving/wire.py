@@ -1,0 +1,190 @@
+"""Length-prefixed binary wire framing — ONE definition shared by the
+dist_async parameter-server transport (`kvstore_async.py`) and the
+serving front door (`serving/frontdoor.py`).
+
+Frame layout: an 8-byte little-endian unsigned length header followed by
+a pickled payload. Exactly the framing the dist_async transport has
+shipped since PR 2 — extracted here (ISSUE 11) so the two TCP tiers in
+the tree cannot drift apart on the one thing that must never drift: how
+a byte stream splits back into messages.
+
+Like the reference's ps-lite vans this transport is for TRUSTED cluster
+networks only: pickle deserialization is code execution, so never expose
+a port speaking this protocol beyond the job's hosts (both call sites
+bind 127.0.0.1 unless the operator opts into a wider interface).
+
+The front door needs one distinction the kvstore client never did:
+a connection that closes AT a frame boundary is a client hanging up
+cleanly (``recv_msg`` returns None), while a close MID-frame — or a
+header whose length exceeds the frame cap — is a broken/misbehaving
+peer and raises :class:`FrameError` (what the front door's
+per-connection eviction counts strikes on). ``kvstore_async`` keeps its
+historical "any EOF is None" behavior with a two-line wrapper.
+"""
+from __future__ import annotations
+
+import pickle
+import socket as _socket
+import struct
+
+from ..base import MXNetError
+
+__all__ = ["FrameError", "send_msg", "recv_msg", "recv_exact",
+           "recv_msg_tick", "send_msg_stall", "TICK",
+           "DEFAULT_MAX_FRAME_BYTES"]
+
+# A corrupt or adversarial 8-byte header must not become a multi-TB
+# allocation: frames above the cap raise FrameError instead. 1 GiB
+# covers any realistic request batch (the serving tier pads to buckets
+# of at most a few thousand rows) with orders of magnitude to spare.
+DEFAULT_MAX_FRAME_BYTES = 1 << 30
+
+_HEADER = struct.Struct("<Q")
+
+
+class FrameError(MXNetError):
+    """The byte stream stopped being a frame stream: EOF mid-frame, a
+    length header above the frame cap, or an unpicklable payload. The
+    connection that raised it is unusable (the next read would pair
+    bytes with the wrong frame) and must be closed."""
+
+
+def send_msg(sock, obj):
+    """Pickle ``obj`` and send it as one length-prefixed frame."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+
+def recv_exact(sock, n):
+    """Read exactly ``n`` bytes. Returns None on EOF before the FIRST
+    byte (clean close); raises :class:`FrameError` on EOF after a
+    partial read (the peer died mid-frame)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if not buf:
+                return None
+            raise FrameError(
+                "connection closed mid-frame (%d of %d bytes)"
+                % (len(buf), n))
+        buf += chunk
+    return buf
+
+
+def recv_msg(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES):
+    """Receive one frame and unpickle it. Returns None when the peer
+    closed cleanly at a frame boundary; raises :class:`FrameError` for
+    a mid-frame close, an oversized length header, or a payload that
+    does not unpickle. ``max_bytes=None`` disables the frame cap (the
+    kvstore transport, whose trusted peers ship arbitrarily large
+    parameter shards and never had a cap)."""
+    header = recv_exact(sock, _HEADER.size)
+    if header is None:
+        return None
+    (n,) = _HEADER.unpack(header)
+    if max_bytes is not None and n > max_bytes:
+        raise FrameError("frame length %d exceeds the %d-byte cap "
+                         "(corrupt header or misbehaving peer)"
+                         % (n, max_bytes))
+    payload = recv_exact(sock, n)
+    if payload is None:
+        raise FrameError("connection closed between header and payload")
+    try:
+        return pickle.loads(payload)
+    except Exception as e:
+        raise FrameError("frame payload does not unpickle: %s" % e) from e
+
+
+#: sentinel returned by :func:`recv_msg_tick` for a poll timeout that
+#: fired before ANY byte of a frame was consumed — the caller's cue to
+#: check its stop flag and poll again. Distinct from None (clean EOF).
+TICK = object()
+
+
+def recv_msg_tick(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES,
+                  stall_timeout=30.0):
+    """`recv_msg` for a socket carrying a short poll timeout (the
+    front-door reader pattern: block briefly, check a stop event, block
+    again).
+
+    The naive ``except socket.timeout: continue`` around `recv_msg` is
+    only safe while ZERO bytes of a frame have been consumed — a timeout
+    after partial bytes would discard them and re-parse the remainder as
+    a fresh header, desyncing the stream and striking an honest-but-slow
+    peer. Here a timeout before the first byte returns :data:`TICK`;
+    once inside a frame, timeouts keep reading (a slow cross-host peer
+    is not a tick) until ``stall_timeout`` of consecutive zero-progress
+    passes accumulates, which raises :class:`FrameError`."""
+    tick_s = sock.gettimeout() or 0.0
+    consumed = [False]
+
+    def read_n(n):
+        buf = b""
+        stalled = 0.0
+        while len(buf) < n:
+            try:
+                chunk = sock.recv(n - len(buf))
+            except _socket.timeout:
+                if not consumed[0]:
+                    return None         # pure tick: nothing consumed yet
+                stalled += tick_s
+                if stall_timeout is not None and stalled >= stall_timeout:
+                    raise FrameError(
+                        "peer stalled mid-frame for %.1fs (%d of %d "
+                        "bytes)" % (stalled, len(buf), n))
+                continue
+            if not chunk:
+                if not buf and not consumed[0]:
+                    return b""          # clean EOF at a frame boundary
+                raise FrameError(
+                    "connection closed mid-frame (%d of %d bytes)"
+                    % (len(buf), n))
+            consumed[0] = True
+            stalled = 0.0
+            buf += chunk
+        return buf
+
+    header = read_n(_HEADER.size)
+    if header is None:
+        return TICK
+    if header == b"":
+        return None
+    (n,) = _HEADER.unpack(header)
+    if max_bytes is not None and n > max_bytes:
+        raise FrameError("frame length %d exceeds the %d-byte cap "
+                         "(corrupt header or misbehaving peer)"
+                         % (n, max_bytes))
+    payload = read_n(n)
+    try:
+        return pickle.loads(payload)
+    except Exception as e:
+        raise FrameError("frame payload does not unpickle: %s" % e) from e
+
+
+def send_msg_stall(sock, obj, stall_timeout=30.0):
+    """`send_msg` for a socket carrying a short poll timeout: `sendall`
+    raising mid-send loses how much went out, so a big reply to a
+    backpressured (but healthy) client would look like a dead peer.
+    This send loop keeps pushing while the peer makes ANY progress and
+    raises :class:`FrameError` only after ``stall_timeout`` of
+    consecutive zero-progress passes."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    data = _HEADER.pack(len(payload)) + payload
+    view = memoryview(data)
+    tick_s = sock.gettimeout() or 0.0
+    off = 0
+    stalled = 0.0
+    while off < len(data):
+        try:
+            sent = sock.send(view[off:])
+        except _socket.timeout:
+            stalled += tick_s
+            if stall_timeout is not None and stalled >= stall_timeout:
+                raise FrameError(
+                    "peer stalled mid-send for %.1fs (%d of %d bytes)"
+                    % (stalled, off, len(data)))
+            continue
+        if sent:
+            stalled = 0.0
+        off += sent

@@ -1,0 +1,990 @@
+"""Cross-process serving front door (mxnet_tpu/serving/frontdoor.py +
+client.py + wire.py, ISSUE 11).
+
+The contracts under test:
+  * wire framing — roundtrip, clean-close vs mid-frame split, frame cap;
+  * a client over a real socket gets BIT-IDENTICAL predictions to
+    in-process ModelServer.predict;
+  * deadline propagation — the budget on the wire is the remaining
+    budget, the gateway subtracts measured transfer, and a budget
+    consumed by the wire sheds typed without touching the batcher;
+  * exactly-once across connection loss — fully-sent requests are
+    resolved by server-assigned id (orphan store), never blindly
+    retried; unknown ids (never admitted) resubmit;
+  * per-connection breaker-style eviction of mid-frame-failing peers;
+  * graceful drain — stop accepting, resolve in-flight, flush replies,
+    close — and the server-side accounting invariant
+    submitted == served + shed + failed across all of the above;
+  * multi-process socket stress — 4 client processes x concurrent
+    mixed-size requests racing server drain (the satellite test).
+"""
+import io
+import json
+import os
+import pickle
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import (ModelServer, ServingFrontDoor, ServingClient,
+                               DeadlineExceeded)
+from mxnet_tpu.serving import wire
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+def _net(prefix, hidden=8, classes=3):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden,
+                                name=prefix + "_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes,
+                                name=prefix + "_fc1")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params(sym, rng):
+    shapes, _, _ = sym.infer_shape(data=(4, 6))
+    return {n: mx.nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+def _server(model="fd", async_worker=True, **kw):
+    rng = np.random.RandomState(0)
+    sym = _net(model)
+    srv = ModelServer()
+    srv.register(model, sym, _params(sym, rng), ctx=mx.cpu(),
+                 buckets=(1, 4), async_worker=async_worker,
+                 max_delay_ms=0.0, warmup_shapes={"data": (4, 6)}, **kw)
+    return srv
+
+
+def _frontdoor(srv, **kw):
+    return ServingFrontDoor(srv, port=0, **kw).start()
+
+
+class _RawClient:
+    """Minimal protocol speaker for surgical frame-level tests."""
+
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=30.0)
+        hello = wire.recv_msg(self.sock)
+        assert hello[0] == "hello"
+        self.conn_id = hello[1]
+        self.seq = 0
+
+    def rid(self):
+        self.seq += 1
+        return "c%d-%d" % (self.conn_id, self.seq)
+
+    def send(self, msg):
+        wire.send_msg(self.sock, msg)
+
+    def recv(self, timeout=30.0):
+        self.sock.settimeout(timeout)
+        return wire.recv_msg(self.sock)
+
+    def predict_spec(self, x, deadline_ms=None, priority=0, model="fd",
+                     t_send=None, trace=None):
+        return {"model": model, "version": None, "arrays": {"data": x},
+                "deadline_ms": deadline_ms, "priority": priority,
+                "trace": trace,
+                "t_send": time.time() if t_send is None else t_send}
+
+    def close(self):
+        self.sock.close()
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+
+class _FakeSock:
+    def __init__(self, data=b""):
+        self.rx = io.BytesIO(data)
+        self.tx = b""
+
+    def sendall(self, b):
+        self.tx += b
+
+    def recv(self, n):
+        return self.rx.read(n)
+
+
+class TestWire:
+    def test_roundtrip(self):
+        s = _FakeSock()
+        payload = ("predict", "c1-1", {"arrays": np.arange(6).reshape(2, 3)})
+        wire.send_msg(s, payload)
+        got = wire.recv_msg(_FakeSock(s.tx))
+        assert got[0] == "predict" and got[1] == "c1-1"
+        np.testing.assert_array_equal(got[2]["arrays"],
+                                      np.arange(6).reshape(2, 3))
+
+    def test_clean_close_is_none(self):
+        assert wire.recv_msg(_FakeSock(b"")) is None
+
+    def test_midframe_close_raises(self):
+        s = _FakeSock()
+        wire.send_msg(s, ("x",) * 8)
+        with pytest.raises(wire.FrameError, match="mid-frame"):
+            wire.recv_msg(_FakeSock(s.tx[:-3]))
+        # partial header is mid-frame too
+        with pytest.raises(wire.FrameError):
+            wire.recv_msg(_FakeSock(s.tx[:4]))
+
+    def test_oversized_frame_rejected_not_allocated(self):
+        huge = struct.pack("<Q", 1 << 60) + b"x"
+        with pytest.raises(wire.FrameError, match="cap"):
+            wire.recv_msg(_FakeSock(huge))
+
+    def test_garbage_payload_raises(self):
+        bad = struct.pack("<Q", 4) + b"\xff\xff\xff\xff"
+        with pytest.raises(wire.FrameError, match="unpickle"):
+            wire.recv_msg(_FakeSock(bad))
+
+    def test_kvstore_wrappers_keep_none_contract(self):
+        from mxnet_tpu import kvstore_async as kva
+        s = _FakeSock()
+        kva._send_msg(s, ("ok", 1))
+        assert kva._recv_msg(_FakeSock(s.tx)) == ("ok", 1)
+        # the kvstore's historical contract: ANY eof reads as None
+        assert kva._recv_msg(_FakeSock(s.tx[:-2])) is None
+        assert kva._recv_msg(_FakeSock(b"")) is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over a real socket (one process, many sockets)
+# ---------------------------------------------------------------------------
+
+def test_client_bit_identical_to_in_process():
+    srv = _server()
+    fd = _frontdoor(srv)
+    cli = ServingClient("127.0.0.1", fd.port)
+    try:
+        rng = np.random.RandomState(1)
+        for rows in (1, 3, 4):
+            x = rng.normal(0, 1, (rows, 6)).astype(np.float32)
+            got = cli.predict({"data": x}, model="fd", timeout=30.0)
+            want = srv.predict("fd", {"data": x})
+            for g, w in zip(got, want):
+                np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    finally:
+        cli.close()
+        fd.drain(timeout=10.0)
+        srv.stop()
+
+
+def test_reply_carries_trace_and_timing_decomposition():
+    srv = _server()
+    fd = _frontdoor(srv)
+    cli = ServingClient("127.0.0.1", fd.port)
+    try:
+        profiler.latency_counters(reset=True, prefix="serving.fd.")
+        x = np.zeros((1, 6), np.float32)
+        fut = cli.predict_async({"data": x}, model="fd",
+                                deadline_ms=5000.0, trace_id="trace-42")
+        fut.result_wait(30.0)
+        t = fut.timings
+        assert t["trace"] == "trace-42"
+        for key in ("wire_ms", "queue_ms", "device_ms", "total_ms"):
+            assert t[key] >= 0.0
+        # total decomposes: wire + queue + device == total (same clocks)
+        assert t["total_ms"] == pytest.approx(
+            t["wire_ms"] + t["queue_ms"] + t["device_ms"], abs=0.01)
+        lat = profiler.latency_counters(prefix="serving.fd.")
+        for key in ("serving.fd.wire", "serving.fd.queue",
+                    "serving.fd.device", "serving.fd.total"):
+            assert lat[key]["count"] >= 1, sorted(lat)
+    finally:
+        cli.close()
+        fd.drain(timeout=10.0)
+        srv.stop()
+
+
+def test_deadline_budget_shrinks_by_measured_transfer():
+    """The gateway subtracts (server recv wall - client t_send) from the
+    wire budget before submitting — asserted by forging t_send into the
+    past and watching the submitted budget shrink to nothing."""
+    srv = _server()
+    fd = _frontdoor(srv)
+    raw = _RawClient(fd.port)
+    try:
+        # plenty of budget, honest clock: served
+        rid = raw.rid()
+        raw.send(("predict", rid,
+                  raw.predict_spec(np.zeros((1, 6), np.float32),
+                                   deadline_ms=5000.0)))
+        reply = raw.recv()
+        assert reply[0] == "served" and reply[1] == rid
+        # t_send 10s in the past: the 5000 ms budget is provably consumed
+        # on the wire -> typed shed BEFORE the batcher ever sees it
+        batcher_requests = srv.engine("fd")._batcher.requests
+        rid = raw.rid()
+        raw.send(("predict", rid,
+                  raw.predict_spec(np.zeros((1, 6), np.float32),
+                                   deadline_ms=5000.0,
+                                   t_send=time.time() - 10.0)))
+        reply = raw.recv()
+        assert reply[0] == "shed" and "wire" in reply[2]
+        assert srv.engine("fd")._batcher.requests == batcher_requests
+        st = fd.stats()
+        assert st["wire_shed"] == 1
+        assert st["submitted"] == st["served"] + st["shed"] + st["failed"]
+        # clock skew (t_send in the future) clamps to zero, never grows
+        # the budget: still served
+        rid = raw.rid()
+        raw.send(("predict", rid,
+                  raw.predict_spec(np.zeros((1, 6), np.float32),
+                                   deadline_ms=5000.0,
+                                   t_send=time.time() + 10.0)))
+        assert raw.recv()[0] == "served"
+    finally:
+        raw.close()
+        fd.drain(timeout=10.0)
+        srv.stop()
+
+
+def test_control_verbs_health_models_ping_and_unknown():
+    srv = _server()
+    fd = _frontdoor(srv)
+    cli = ServingClient("127.0.0.1", fd.port)
+    raw = _RawClient(fd.port)
+    try:
+        cli.predict({"data": np.zeros((2, 6), np.float32)}, model="fd",
+                    timeout=30.0)
+        health = cli.health()
+        assert health["ok"] and "fd" in health["models"]
+        m = health["models"]["fd"]
+        assert m["queue_wait_p95_ms"] is not None
+        assert m["breaker_states"] == ["closed"]
+        assert m["submitted"] >= 1 and m["shed_rate"] == 0.0
+        assert m["inflight"] == 0
+        models = cli.list_models()
+        assert models["fd"]["default_version"] == "1"
+        assert cli.ping()
+        raw.send(("bogus_verb", "c0-0"))
+        assert raw.recv()[0] == "failed"
+    finally:
+        raw.close()
+        cli.close()
+        fd.drain(timeout=10.0)
+        srv.stop()
+
+
+def test_priority_and_version_travel_the_wire():
+    """The spec's priority/version reach the ModelServer intact."""
+    seen = {}
+    srv = _server()
+    orig = srv.predict_async
+
+    def spy(name, data, version=None, deadline_ms=None, priority=0):
+        seen.update(version=version, deadline_ms=deadline_ms,
+                    priority=priority)
+        return orig(name, data, version=version, deadline_ms=deadline_ms,
+                    priority=priority)
+
+    srv.predict_async = spy
+    fd = _frontdoor(srv)
+    cli = ServingClient("127.0.0.1", fd.port)
+    try:
+        cli.predict({"data": np.zeros((1, 6), np.float32)}, model="fd",
+                    version=1, deadline_ms=8000.0, priority=3,
+                    timeout=30.0)
+        assert seen["version"] == 1 and seen["priority"] == 3
+        assert 0 < seen["deadline_ms"] <= 8000.0
+    finally:
+        cli.close()
+        fd.drain(timeout=10.0)
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# exactly-once across connection loss: orphan store + resolve
+# ---------------------------------------------------------------------------
+
+def test_connection_kill_orphans_results_and_resolve_returns_them():
+    """Kill the connection after the request is fully sent: the admitted
+    request still resolves server-side (nothing lost), its reply parks
+    in the orphan store, and a reconnecting client resolves it by id."""
+    srv = _server(async_worker=False)     # requests run only at flush
+    fd = _frontdoor(srv)
+    raw = _RawClient(fd.port)
+    x = np.full((2, 6), 3.0, np.float32)
+    rid = raw.rid()
+    raw.send(("predict", rid, raw.predict_spec(x, deadline_ms=None)))
+    deadline = time.monotonic() + 10.0
+    while fd.stats()["pending"] != 1:     # admitted, queued in the batcher
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    raw.close()                           # mid-flight connection kill
+    # a resolve from a NEW connection while still pending says so
+    raw2 = _RawClient(fd.port)
+    raw2.send(("resolve", raw2.rid(), [rid]))
+    assert raw2.recv()[2][rid] == ("pending",)
+    srv.engine("fd").flush()              # the kill lost NO accepted work
+    deadline = time.monotonic() + 10.0
+    while fd.stats()["pending"]:
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    st = fd.stats()
+    assert st["served"] == 1 and st["orphaned"] == 1
+    assert st["submitted"] == st["served"] + st["shed"] + st["failed"]
+    rrid = raw2.rid()
+    raw2.send(("resolve", rrid, [rid, "c999-1"]))
+    reply = raw2.recv()
+    assert reply[0] == "resolved" and reply[1] == rrid
+    outcome = reply[2][rid]
+    assert outcome[0] == "served" and outcome[1] == rid
+    np.testing.assert_array_equal(
+        outcome[2][0], np.asarray(srv.predict("fd", {"data": x})[0]))
+    assert reply[2]["c999-1"] == ("unknown",)     # never admitted
+    # resolved orphans are handed out exactly once
+    raw2.send(("resolve", raw2.rid(), [rid]))
+    assert raw2.recv()[2][rid] == ("unknown",)
+    assert fd.stats()["orphan_resolved"] == 1
+    raw2.close()
+    fd.drain(timeout=10.0)
+    srv.stop()
+
+
+def test_client_failover_resolves_by_id_never_blind_retries():
+    """The real client: its connection dies with a fully-sent request in
+    flight; the reader fails over, resolves by server-assigned id, and
+    delivers the REAL (orphaned) result — submitted counts exactly one
+    request server-side."""
+    srv = _server(async_worker=False)
+    fd = _frontdoor(srv)
+    cli = ServingClient("127.0.0.1", fd.port, resubmits=2)
+    x = np.full((1, 6), 2.0, np.float32)
+    fut = cli.predict_async({"data": x}, model="fd")
+    deadline = time.monotonic() + 10.0
+    while fd.stats()["pending"] != 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    # sever the server side of the client's connection
+    with fd._lock:
+        conn = next(iter(fd._conns))
+    fd._close_conn(conn)
+    flusher = threading.Thread(
+        target=lambda: (time.sleep(0.15), srv.engine("fd").flush()))
+    flusher.start()
+    out = fut.result_wait(30.0)
+    flusher.join()
+    np.testing.assert_array_equal(
+        np.asarray(out[0]), np.asarray(srv.predict("fd", {"data": x})[0]))
+    assert cli.stats["failovers"] == 1
+    assert cli.stats["resolved_remote"] == 1
+    st = fd.stats()
+    # ONE submit server-side: the fully-sent request was resolved, not
+    # re-sent (the in-process reference predict bypasses the gateway)
+    assert st["submitted"] == 1
+    assert st["submitted"] == st["served"] + st["shed"] + st["failed"]
+    cli.close()
+    fd.drain(timeout=10.0)
+    srv.stop()
+
+
+def test_client_resubmits_only_proven_unknown(monkeypatch):
+    """A send that fails outright never reached the server: the client
+    resubmits on a fresh connection transparently."""
+    srv = _server()
+    fd = _frontdoor(srv)
+    cli = ServingClient("127.0.0.1", fd.port, resubmits=2)
+    try:
+        from mxnet_tpu.serving.client import _ClientConn
+        orig_send = _ClientConn.send
+        fails = {"n": 0}
+
+        def flaky(self, frame):
+            if frame[0] == "predict" and fails["n"] == 0:
+                fails["n"] += 1
+                raise OSError("socket closed under us")
+            orig_send(self, frame)
+
+        monkeypatch.setattr(_ClientConn, "send", flaky)
+        out = cli.predict({"data": np.ones((1, 6), np.float32)},
+                          model="fd", timeout=30.0)
+        assert out and fails["n"] == 1
+        assert cli.stats["resubmits"] == 1
+    finally:
+        cli.close()
+        fd.drain(timeout=10.0)
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# eviction of mid-frame-failing peers
+# ---------------------------------------------------------------------------
+
+def test_repeated_midframe_failures_evict_peer_until_cooldown():
+    srv = _server()
+    fd = _frontdoor(srv, evict_threshold=2, evict_cooldown_ms=60000.0)
+    # two connections that each break a frame mid-stream
+    for _ in range(2):
+        raw = _RawClient(fd.port)
+        raw.sock.sendall(struct.pack("<Q", 1 << 59))  # oversized header
+        deadline = time.monotonic() + 10.0
+        while raw.sock.fileno() != -1:
+            raw.sock.settimeout(5.0)
+            try:
+                if raw.sock.recv(1) == b"":
+                    break
+            except OSError:
+                break
+        raw.close()
+    deadline = time.monotonic() + 10.0
+    while fd.stats()["evictions"] < 1:
+        assert time.monotonic() < deadline, fd.stats()
+        time.sleep(0.01)
+    # evicted: the next connection is refused (closed before hello)
+    sock = socket.create_connection(("127.0.0.1", fd.port), timeout=10.0)
+    sock.settimeout(5.0)
+    assert wire.recv_msg(sock) is None
+    sock.close()
+    assert fd.stats()["refused_evicted"] >= 1
+    fd.drain(timeout=10.0)
+    srv.stop()
+
+
+def test_clean_frames_reset_strikes():
+    """Breaker-style: a clean frame closes the strike streak, so a
+    once-glitchy client is never evicted for non-consecutive failures."""
+    srv = _server()
+    fd = _frontdoor(srv, evict_threshold=2, evict_cooldown_ms=60000.0)
+    for _ in range(3):   # 3 x (one strike, then clean traffic elsewhere)
+        raw = _RawClient(fd.port)
+        raw.sock.sendall(struct.pack("<Q", 1 << 59))
+        raw.close()
+        good = _RawClient(fd.port)      # same peer host: resets streak
+        good.send(("ping", good.rid()))
+        assert good.recv()[0] == "pong"
+        good.close()
+    assert fd.stats()["evictions"] == 0
+    fd.drain(timeout=10.0)
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+def test_drain_resolves_inflight_then_refuses():
+    srv = _server()
+    fd = _frontdoor(srv)
+    cli = ServingClient("127.0.0.1", fd.port)
+    futs = [cli.predict_async({"data": np.zeros((1, 6), np.float32)},
+                              model="fd") for _ in range(16)]
+    # make sure some requests were ADMITTED before the cutoff
+    deadline = time.monotonic() + 10.0
+    while fd.stats()["served"] + fd.stats()["pending"] < 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.002)
+    assert fd.drain(timeout=30.0)
+    served = refused = 0
+    for f in futs:
+        # every request resolves TYPED: served, or the draining refusal
+        # for frames that crossed the cutoff — nothing hangs, nothing
+        # is silently dropped
+        try:
+            f.result_wait(10.0)
+            served += 1
+        except MXNetError as e:
+            assert "draining" in str(e), e
+            refused += 1
+    assert served >= 1 and served + refused == 16
+    st = fd.stats()
+    assert st["pending"] == 0
+    assert st["submitted"] == st["served"] + st["shed"] + st["failed"]
+    # post-drain: new connections get no hello
+    sock = socket.create_connection(("127.0.0.1", fd.port), timeout=5.0) \
+        if _port_open(fd.port) else None
+    if sock is not None:
+        sock.settimeout(2.0)
+        try:
+            assert wire.recv_msg(sock) is None
+        except (OSError, wire.FrameError):
+            pass
+        sock.close()
+    cli.close()
+    srv.stop()
+
+
+def _port_open(port):
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=0.5)
+    except OSError:
+        return False
+    s.close()
+    return True
+
+
+def test_sigterm_handler_drains_and_chains():
+    calls = []
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: calls.append("prev"))
+        srv = _server()
+        fd = _frontdoor(srv)
+        fd.install_sigterm_drain(timeout=10.0)
+        fut = ServingClient("127.0.0.1", fd.port)
+        f = fut.predict_async({"data": np.zeros((1, 6), np.float32)},
+                              model="fd")
+        deadline = time.monotonic() + 10.0
+        while fd.stats()["served"] + fd.stats()["pending"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)               # admitted before the SIGTERM
+        signal.raise_signal(signal.SIGTERM)
+        assert calls == ["prev"]            # chained AFTER the drain
+        f.result_wait(10.0)                 # in-flight request resolved
+        st = fd.stats()
+        assert st["pending"] == 0
+        assert st["submitted"] == st["served"] + st["shed"] + st["failed"]
+        fut.close()
+        srv.stop()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# multi-process socket stress (the satellite test): 4 client processes x
+# concurrent mixed-size requests racing server drain
+# ---------------------------------------------------------------------------
+
+# A protocol speaker with NO mxnet_tpu import (numpy + stdlib only): the
+# subprocesses boot in well under a second, and the wire format gets a
+# second, independent implementation — a conformance check in itself.
+_SPEAKER = r'''
+import json, pickle, socket, struct, sys, time
+import numpy as np
+host, port, n_req, seed, kill = (sys.argv[1], int(sys.argv[2]),
+                                 int(sys.argv[3]), int(sys.argv[4]),
+                                 sys.argv[5] == "kill")
+H = struct.Struct("<Q")
+def send(sock, obj):
+    b = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(H.pack(len(b)) + b)
+def recv(sock):
+    buf = b""
+    while len(buf) < 8:
+        c = sock.recv(8 - len(buf))
+        if not c:
+            return None
+        buf += c
+    (n,) = H.unpack(buf)
+    payload = b""
+    while len(payload) < n:
+        c = sock.recv(n - len(payload))
+        if not c:
+            return None
+        payload += c
+    return pickle.loads(payload)
+rng = np.random.RandomState(seed)
+pending = set()
+out = {"submitted": 0, "served": 0, "shed": 0, "failed": 0,
+       "send_failed": 0, "double": 0}
+try:
+    sock = socket.create_connection((host, port), timeout=60.0)
+    sock.settimeout(60.0)
+    hello = recv(sock)
+except OSError:
+    hello = None
+if hello is None:
+    # refused/reset at the door (the drain race) — nothing submitted
+    out["unresolved"] = 0
+    print(json.dumps(out)); sys.exit(0)
+conn = hello[1]
+for i in range(n_req):
+    rid = "c%d-%d" % (conn, i + 1)
+    rows = int(rng.randint(1, 5))
+    spec = {"model": "fd", "version": None,
+            "arrays": {"data": rng.normal(0, 1, (rows, 6))
+                       .astype(np.float32)},
+            "deadline_ms": None if i % 3 else 10000.0,
+            "priority": int(i % 2), "trace": rid, "t_send": time.time()}
+    try:
+        send(sock, ("predict", rid, spec))
+    except OSError:
+        out["send_failed"] += 1
+        continue
+    out["submitted"] += 1
+    pending.add(rid)
+if kill:
+    sock.close()                     # mid-flight connection kill
+    out["unresolved"] = len(pending)
+    print(json.dumps(out)); sys.exit(0)
+while pending:
+    try:
+        msg = recv(sock)
+    except OSError:
+        break
+    if msg is None:
+        break
+    verb, rid = msg[0], msg[1]
+    if rid not in pending:
+        out["double"] += 1           # a second reply for a resolved rid
+        continue
+    pending.discard(rid)
+    out[verb if verb in ("served", "shed", "failed") else "failed"] += 1
+out["unresolved"] = len(pending)
+print(json.dumps(out))
+'''
+
+
+def test_multiprocess_stress_racing_drain(tmp_path):
+    """4 client OS processes fire concurrent mixed-size requests while
+    the server drains mid-trace; one client additionally kills its
+    connection with requests in flight. Exactly-once everywhere:
+    server-side submitted == served + shed + failed with zero pending,
+    and no client ever sees two replies for one request id."""
+    script = tmp_path / "speaker.py"
+    script.write_text(_SPEAKER)
+    srv = _server()
+    fd = _frontdoor(srv)
+    n_req = 25
+    procs = []
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH",)}
+    for i in range(4):
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), "127.0.0.1", str(fd.port),
+             str(n_req), str(i), "kill" if i == 3 else "read"],
+            stdout=subprocess.PIPE, text=True, env=env))
+    # drain only once real traffic is flowing — the race under test is
+    # drain vs in-flight requests, not drain vs process startup
+    deadline = time.monotonic() + 60.0
+    while fd.stats()["submitted"] < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    drain_done = {}
+    drainer = threading.Thread(
+        target=lambda: drain_done.update(ok=fd.drain(timeout=60.0)))
+    drainer.start()
+    reports = []
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, out
+        reports.append(json.loads(out.strip().splitlines()[-1]))
+    drainer.join(timeout=120)
+    assert drain_done.get("ok"), "drain did not resolve in-flight work"
+    st = fd.stats()
+    # server-side exactly-once: every admitted request resolved typed
+    assert st["pending"] == 0
+    assert st["submitted"] == st["served"] + st["shed"] + st["failed"], st
+    for rep in reports:
+        assert rep["double"] == 0, rep
+        # every request the client sent is accounted: replied, refused
+        # (conn closed -> unresolved), or never-sent
+        assert rep["served"] + rep["shed"] + rep["failed"] \
+            + rep["unresolved"] == rep["submitted"], rep
+    client_submitted = sum(r["submitted"] for r in reports)
+    client_replied = sum(r["served"] + r["shed"] + r["failed"]
+                         for r in reports)
+    # the gateway can only have read frames the clients fully sent, and
+    # clients can only have read replies the gateway counted
+    assert st["submitted"] <= client_submitted
+    assert client_replied <= st["served"] + st["shed"] + st["failed"]
+    assert st["submitted"] >= 4          # real traffic flowed pre-drain
+    srv.stop()
+
+
+def test_drain_under_async_load_serves_everything_accepted():
+    """Drain during a live async trace: whatever was admitted before the
+    cutoff resolves served (no deadline pressure), the rest is refused
+    typed — nothing hangs."""
+    srv = _server()
+    fd = _frontdoor(srv)
+    cli = ServingClient("127.0.0.1", fd.port, pool_size=2)
+    stop = threading.Event()
+    futs = []
+
+    def pump():
+        while not stop.is_set():
+            try:
+                futs.append(cli.predict_async(
+                    {"data": np.zeros((2, 6), np.float32)}, model="fd"))
+            except MXNetError:
+                return
+            time.sleep(0.002)
+
+    t = threading.Thread(target=pump)
+    t.start()
+    time.sleep(0.1)
+    ok = fd.drain(timeout=30.0)
+    stop.set()
+    t.join(timeout=10.0)
+    assert ok
+    outcomes = {"served": 0, "failed": 0}
+    for f in futs:
+        try:
+            f.result_wait(10.0)
+            outcomes["served"] += 1
+        except MXNetError:
+            outcomes["failed"] += 1
+    assert outcomes["served"] >= 1
+    st = fd.stats()
+    assert st["pending"] == 0
+    assert st["submitted"] == st["served"] + st["shed"] + st["failed"]
+    cli.close()
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault injection on the frontdoor sites (resilience integration)
+# ---------------------------------------------------------------------------
+
+def test_injected_reply_fault_orphans_result_then_resolve_recovers():
+    """`frontdoor.reply:raise=OSError` — the reply send dies, the
+    connection is dropped, but the OUTCOME survives in the orphan store
+    and a reconnecting client resolves it: injected network failure on
+    the reply leg loses zero accepted requests."""
+    from mxnet_tpu.resilience import faults
+    srv = _server()
+    fd = _frontdoor(srv)
+    raw = _RawClient(fd.port)
+    x = np.full((1, 6), 5.0, np.float32)
+    faults.configure(
+        "frontdoor.reply:verb=served:count=1:raise=OSError,wire down")
+    try:
+        rid = raw.rid()
+        raw.send(("predict", rid, raw.predict_spec(x)))
+        try:
+            assert raw.recv(10.0) is None      # server dropped our conn
+        except (OSError, wire.FrameError):
+            pass
+        deadline = time.monotonic() + 10.0
+        while fd.stats()["orphaned"] < 1:
+            assert time.monotonic() < deadline, fd.stats()
+            time.sleep(0.01)
+    finally:
+        faults.reset()
+    raw2 = _RawClient(fd.port)
+    raw2.send(("resolve", raw2.rid(), [rid]))
+    outcome = raw2.recv()[2][rid]
+    assert outcome[0] == "served"
+    np.testing.assert_array_equal(
+        outcome[2][0], np.asarray(srv.predict("fd", {"data": x})[0]))
+    st = fd.stats()
+    assert st["submitted"] == st["served"] + st["shed"] + st["failed"]
+    assert profiler.fault_counters().get("frontdoor.reply", 0) >= 1
+    raw2.close()
+    raw.close()
+    fd.drain(timeout=10.0)
+    srv.stop()
+
+
+def test_injected_accept_fault_rejects_connection_not_gateway():
+    from mxnet_tpu.resilience import faults
+    srv = _server()
+    fd = _frontdoor(srv)
+    faults.configure("frontdoor.accept:count=1:raise=OSError,sick accept")
+    try:
+        sock = socket.create_connection(("127.0.0.1", fd.port),
+                                        timeout=10.0)
+        sock.settimeout(5.0)
+        try:
+            assert wire.recv_msg(sock) is None   # rejected, no hello
+        except (OSError, wire.FrameError):
+            pass
+        sock.close()
+    finally:
+        faults.reset()
+    # the gateway survived: the next client is served normally
+    cli = ServingClient("127.0.0.1", fd.port)
+    out = cli.predict({"data": np.zeros((1, 6), np.float32)}, model="fd",
+                      timeout=30.0)
+    assert out
+    cli.close()
+    fd.drain(timeout=10.0)
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+def test_kvstore_transport_has_no_frame_cap():
+    """The wire.py extraction must not impose the serving frame cap on
+    the kvstore transport (its trusted peers ship arbitrarily large
+    parameter shards and never had one): a frame the serving cap would
+    reject still decodes through the kvstore wrappers."""
+    from mxnet_tpu import kvstore_async as kva
+    s = _FakeSock()
+    kva._send_msg(s, ("blob", b"x" * 64))
+    with pytest.raises(wire.FrameError, match="cap"):
+        wire.recv_msg(_FakeSock(s.tx), max_bytes=16)
+    assert kva._recv_msg(_FakeSock(s.tx))[0] == "blob"
+
+
+def test_clean_frame_does_not_lift_active_eviction_cooldown():
+    """A clean frame resets the strike STREAK only: a peer host under an
+    active eviction cooldown must stay refused at accept even while one
+    of its pre-eviction connections keeps sending clean frames."""
+    srv = _server()
+    fd = _frontdoor(srv, evict_threshold=2, evict_cooldown_ms=60000.0)
+    good = _RawClient(fd.port)          # admitted BEFORE the eviction
+    for _ in range(2):                  # two mid-frame failures: evicted
+        bad = _RawClient(fd.port)
+        bad.sock.sendall(struct.pack("<Q", 1 << 59))
+        bad.close()
+    deadline = time.monotonic() + 10.0
+    while fd.stats()["evictions"] < 1:
+        assert time.monotonic() < deadline, fd.stats()
+        time.sleep(0.01)
+    # clean traffic on the surviving connection...
+    good.send(("ping", good.rid()))
+    assert good.recv()[0] == "pong"
+    # ...must NOT lift the cooldown for NEW connections from the host
+    sock = socket.create_connection(("127.0.0.1", fd.port), timeout=10.0)
+    sock.settimeout(5.0)
+    try:
+        assert wire.recv_msg(sock) is None, \
+            "clean frame lifted an active eviction cooldown"
+    except (OSError, wire.FrameError):
+        pass
+    sock.close()
+    assert fd.stats()["refused_evicted"] >= 1
+    good.close()
+    fd.drain(timeout=10.0)
+    srv.stop()
+
+
+def test_send_failure_on_shared_conn_recovers_other_inflight(monkeypatch):
+    """A failed send must BREAK the transport (reader runs recovery),
+    never close() it (which suppresses recovery): request A — fully
+    sent and pending — on the same pooled connection as failing
+    request B must still resolve with its real result via the
+    resolve-by-id protocol."""
+    srv = _server(async_worker=False)
+    fd = _frontdoor(srv)
+    cli = ServingClient("127.0.0.1", fd.port, pool_size=1, resubmits=1)
+    x = np.full((1, 6), 4.0, np.float32)
+    futA = cli.predict_async({"data": x}, model="fd")
+    deadline = time.monotonic() + 10.0
+    while fd.stats()["pending"] != 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    from mxnet_tpu.serving.client import _ClientConn
+    orig = _ClientConn.send
+
+    def flaky(self, frame):
+        if frame[0] == "predict":
+            raise OSError("transport died under B")
+        orig(self, frame)           # control frames (resolve) still flow
+
+    monkeypatch.setattr(_ClientConn, "send", flaky)
+    futB = cli.predict_async({"data": x}, model="fd")
+    with pytest.raises(MXNetError):
+        futB.result_wait(30.0)      # B exhausts its resubmit budget
+    monkeypatch.undo()
+    # A's work is still queued server-side; run it — A's outcome lands
+    # in the orphan store and recovery delivers the REAL result
+    time.sleep(0.1)
+    srv.engine("fd").flush()
+    out = futA.result_wait(60.0)
+    np.testing.assert_array_equal(
+        np.asarray(out[0]), np.asarray(srv.predict("fd", {"data": x})[0]))
+    assert cli.stats["failovers"] >= 1
+    st = fd.stats()
+    assert st["submitted"] == st["served"] + st["shed"] + st["failed"]
+    cli.close()
+    fd.drain(timeout=10.0)
+    srv.stop()
+
+
+class _TimeoutSock(_FakeSock):
+    """Socket stub whose recv/send raise socket.timeout at scripted
+    positions — the slow-but-honest-peer simulator."""
+
+    def __init__(self, data=b"", timeout_at=(), tick=0.1):
+        super().__init__(data)
+        self.timeouts = list(timeout_at)   # byte offsets to stall at
+        self.read = 0
+        self.tick = tick
+
+    def gettimeout(self):
+        return self.tick
+
+    def recv(self, n):
+        if self.timeouts and self.read >= self.timeouts[0]:
+            self.timeouts.pop(0)
+            raise socket.timeout("stalled")
+        # one byte at a time so stall offsets are exact
+        chunk = self.rx.read(1)
+        self.read += len(chunk)
+        return chunk
+
+
+class TestWireTickStall:
+    def test_tick_before_any_byte(self):
+        s = _TimeoutSock(b"", timeout_at=(0,))
+        assert wire.recv_msg_tick(s) is wire.TICK
+
+    def test_midframe_timeout_keeps_reading_not_desync(self):
+        """A timeout after partial bytes must RESUME the same frame —
+        the naive except-timeout-continue would re-parse the remaining
+        payload as a new header."""
+        src = _FakeSock()
+        wire.send_msg(src, ("slow", 42))
+        s = _TimeoutSock(src.tx, timeout_at=(3, 11))
+        assert wire.recv_msg_tick(s, stall_timeout=30.0) == ("slow", 42)
+
+    def test_zero_progress_stall_budget_raises(self):
+        src = _FakeSock()
+        wire.send_msg(src, ("x",))
+        # stall forever at byte 5 (inside the header)
+        s = _TimeoutSock(src.tx, timeout_at=[5] * 1000, tick=10.0)
+        with pytest.raises(wire.FrameError, match="stalled mid-frame"):
+            wire.recv_msg_tick(s, stall_timeout=30.0)
+
+    def test_clean_eof_is_none_and_midframe_eof_raises(self):
+        assert wire.recv_msg_tick(_TimeoutSock(b"")) is None
+        src = _FakeSock()
+        wire.send_msg(src, ("y",))
+        with pytest.raises(wire.FrameError, match="mid-frame"):
+            wire.recv_msg_tick(_TimeoutSock(src.tx[:-2]))
+
+    def test_send_stall_resumes_partial_progress(self):
+        class _SlowSend:
+            def __init__(self):
+                self.data = b""
+                self.calls = 0
+
+            def gettimeout(self):
+                return 0.1
+
+            def send(self, view):
+                self.calls += 1
+                if self.calls % 2 == 0:
+                    raise socket.timeout("backpressure")
+                self.data += bytes(view[:3])
+                return 3
+
+        s = _SlowSend()
+        wire.send_msg_stall(s, ("big", 7), stall_timeout=30.0)
+        got = wire.recv_msg(_FakeSock(s.data))
+        assert got == ("big", 7)
+
+    def test_send_stall_zero_progress_raises(self):
+        class _DeadSend:
+            def gettimeout(self):
+                return 10.0
+
+            def send(self, view):
+                raise socket.timeout("wedged")
+
+        with pytest.raises(wire.FrameError, match="stalled mid-send"):
+            wire.send_msg_stall(_DeadSend(), ("z",), stall_timeout=30.0)

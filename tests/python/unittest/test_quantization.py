@@ -514,3 +514,48 @@ def test_int8_grouped_conv_exact_and_fast_path():
             os.environ["MXNET_TPU_INT8_NATIVE"] = old
     assert calls, "depthwise conv fell off the fast exact-f32 path"
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_kl_threshold_spiky_histogram_not_degenerate():
+    """The PR 11 tier-1 regression: on a spike-at-zero + heavy-tail
+    histogram (the shape every ReLU/global-pool activation produces),
+    the KL search must NOT collapse to its smallest candidate. Before
+    the fix, two drifts from the reference implementation — mass
+    expanded over ALL source bins instead of the nonzero ones, and the
+    degenerate identity candidate i == num_quantized_bins left in the
+    race — made entropy calibration clip such layers to
+    255/8001 = 3.2% of their range (measured on the quantized ResNet-18
+    example: argmax agreement 0.000)."""
+    rng = np.random.RandomState(7)
+    num_bins = 8001
+    # half the mass in the first few bins, the rest spread far out —
+    # pool1_output's measured shape (50% of mass inside bin 7 of 8001,
+    # 43% beyond bin 255)
+    hist = np.zeros(num_bins)
+    hist[:8] = 1000.0
+    tail_bins = rng.randint(256, num_bins, size=4000)
+    np.add.at(hist, tail_bins, 2.0)
+    edges = np.linspace(0.0, 1.0, num_bins + 1)
+    th = Q.calib_threshold_kl(hist, edges)
+    assert th > 0.25, \
+        "KL threshold collapsed to the degenerate identity candidate " \
+        "(th=%.4f of absmax 1.0)" % th
+
+
+def test_kl_threshold_uniform_histogram_keeps_range():
+    """A uniform |v| histogram has no outliers to clip: the optimal
+    threshold is (near) the full range."""
+    hist = np.full(8001, 5.0)
+    edges = np.linspace(0.0, 2.0, 8002)
+    th = Q.calib_threshold_kl(hist, edges)
+    assert th > 1.8, th
+
+
+def test_kl_threshold_gaussian_clips_tail_mildly():
+    """Gaussian |v|: KL calibration should clip some tail (below the
+    absmax) but keep the bulk (far above the degenerate candidate)."""
+    rng = np.random.RandomState(3)
+    v = np.abs(rng.normal(0, 1.0, 200000))
+    hist, edges = np.histogram(v, bins=8001, range=(0, v.max()))
+    th = Q.calib_threshold_kl(hist, edges)
+    assert 0.3 * v.max() < th <= v.max(), (th, v.max())

@@ -821,3 +821,71 @@ def test_quantized_reload_from_hot_swap(tmp_path):
     np.testing.assert_array_equal(out, np.asarray(
         ref.predict({"data": x})[0]))
     eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# shed-order fairness (ISSUE 11 satellite): victims at equal slack are
+# selected lowest-priority-first
+# ---------------------------------------------------------------------------
+
+def test_shed_fairness_equal_slack_low_priority_sheds_same_formation():
+    """Mixed-class overload: a high-priority request and a low-priority
+    request carry the SAME (already-expired) deadline. The selection
+    scan reaches the high-priority one first and sheds it; before the
+    fix, the equal-slack low-priority request escaped judgment once the
+    batch filled with feasible traffic and SURVIVED the formation
+    (pending past its deadline, and potentially served outright if the
+    decaying-max estimate relaxed first). Victims at equal slack must be
+    taken lowest-priority-first — i.e. within the same formation."""
+
+    def run_batch(padded, n_real):
+        return [padded["x"]]
+
+    b = DynamicBatcher(run_batch, buckets=(1, 2), max_batch=2,
+                       autostart=False)
+    # same tight budget for both classes; feasible deadline-less traffic
+    # fills the batch between them in EDF order (prio 2 > prio 1 > prio 0)
+    high = b.submit({"x": np.zeros((1, 1), np.float32)}, deadline_ms=1.0,
+                    priority=2)
+    mid1 = b.submit({"x": np.ones((1, 1), np.float32)}, priority=1)
+    mid2 = b.submit({"x": np.ones((1, 1), np.float32)}, priority=1)
+    low = b.submit({"x": np.zeros((1, 1), np.float32)}, deadline_ms=1.0,
+                   priority=0)
+    time.sleep(0.02)                     # both 1 ms budgets are now spent
+    group, total = b._take_group(wait=False)   # ONE formation
+    assert [r.priority for r in group] == [1, 1] and total == 2
+    # the high-priority victim shed at the selection front...
+    assert high.done()
+    with pytest.raises(DeadlineExceeded):
+        high.result_wait(0.0)
+    # ...and the equal-slack low-priority request shed in the SAME
+    # formation (the fairness sweep), not left pending for a later one
+    assert low.done(), \
+        "equal-slack low-priority request survived the shedding formation"
+    with pytest.raises(DeadlineExceeded):
+        low.result_wait(0.0)
+    assert b.stats()["shed"] == 2
+    b._run_group(group, total)
+    assert mid1.done() and mid2.done()
+    assert b.stats()["served"] == 2
+    assert b.stats()["served"] + b.stats()["shed"] == b.stats()["requests"]
+
+
+def test_shed_fairness_sweep_only_runs_when_shedding_engages():
+    """Healthy traffic pays nothing: no shed at the selection front means
+    no queue sweep — deadline-less and feasible requests are untouched
+    beyond normal selection."""
+    ests = []
+
+    def step_time(bucket):
+        ests.append(bucket)
+        return 0.001
+
+    b = DynamicBatcher(lambda p, n: [p["x"]], buckets=(1, 2), max_batch=1,
+                       autostart=False, step_time=step_time)
+    b.submit({"x": np.zeros((1, 1), np.float32)}, deadline_ms=10000.0)
+    queued = b.submit({"x": np.zeros((1, 1), np.float32)},
+                      deadline_ms=10000.0)
+    group, total = b._take_group(wait=False)
+    assert len(group) == 1 and not queued.done()
+    assert len(b._queue) == 1            # no sweep touched the remainder

@@ -452,3 +452,70 @@ def test_submit_time_shed_respects_stop():
     with pytest.raises(MXNetError, match="stopped"):
         b.submit({"x": np.zeros((1, 1), np.float32)}, deadline_ms=1.0)
     assert b.stats()["requests"] == 0 and b.stats()["shed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ModelServer.health() — the machine-readable autoscaling signal
+# (ISSUE 11 satellite; ROADMAP item 3's "queue-wait p95 as the
+# scale-out signal")
+# ---------------------------------------------------------------------------
+
+def test_health_reports_queue_p95_shed_rate_breakers_inflight():
+    rng = np.random.RandomState(0)
+    sym = _net(8, "hl")
+    srv = ModelServer()
+    srv.register("hl", sym, _params_for(sym, rng), ctx=mx.cpu(),
+                 buckets=(4,), async_worker=False,
+                 warmup_shapes={"data": (4, 6)})
+    profiler.latency_counters(reset=True, prefix="serving.hl.")
+    x = rng.normal(0, 1, (1, 6)).astype(np.float32)
+    eng = srv.engine("hl")
+    # a few served requests (feed the queue histogram), one forced shed
+    for _ in range(3):
+        srv.predict_async("hl", {"data": x})
+        eng.flush()
+    doomed = srv.predict_async("hl", {"data": x}, deadline_ms=1.0)
+    time.sleep(0.02)
+    eng.flush()
+    assert isinstance(doomed.error, DeadlineExceeded)
+
+    h = srv.health()
+    assert h["ok"] and set(h["models"]) == {"hl"}
+    m = h["models"]["hl"]
+    assert m["queue_wait_p95_ms"] is not None
+    assert m["queue_wait_p95_ms"] >= 0
+    assert m["queue_wait_p50_ms"] is not None
+    assert m["submitted"] == 4 and m["served"] == 3 and m["shed"] == 1
+    assert m["shed_rate"] == pytest.approx(0.25)
+    assert m["submitted"] == m["served"] + m["shed"] + m["failed"]
+    assert m["inflight"] == 0
+    assert m["breaker_states"] == ["closed"]
+    assert m["replicas"] == m["replicas_available"] == 1
+    assert m["default_version"] == "1" and m["versions"] == ["1"]
+    # an OPEN breaker shows up as lost available capacity
+    with srv._lock:
+        rep = srv._models["hl"].versions[1][0]
+        rep.breaker.state = "open"
+        rep.breaker.opened_at = time.monotonic()
+    h2 = srv.health()
+    m2 = h2["models"]["hl"]
+    assert m2["breaker_states"] == ["open"]
+    assert m2["replicas_available"] == 0
+    srv.stop()
+
+
+def test_health_counts_live_inflight():
+    rng = np.random.RandomState(1)
+    sym = _net(8, "hi")
+    srv = ModelServer()
+    srv.register("hi", sym, _params_for(sym, rng), ctx=mx.cpu(),
+                 buckets=(4,), async_worker=False,
+                 warmup_shapes={"data": (4, 6)})
+    x = rng.normal(0, 1, (1, 6)).astype(np.float32)
+    futs = [srv.predict_async("hi", {"data": x}) for _ in range(3)]
+    assert srv.health()["models"]["hi"]["inflight"] == 3
+    srv.engine("hi").flush()
+    for f in futs:
+        f.result_wait(5.0)
+    assert srv.health()["models"]["hi"]["inflight"] == 0
+    srv.stop()

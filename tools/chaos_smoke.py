@@ -63,6 +63,13 @@ def scenario_zero_overhead():
         faults._fire = boom
         faults.fault_point("serving.dispatch", replica=0)
         faults.fault_point("checkpoint.write", step=1)
+        # the front door's hooks (ISSUE 11) ride the same contract: one
+        # cached flag, zero registry work when no spec is set
+        faults.fault_point("frontdoor.accept", peer="127.0.0.1")
+        faults.fault_point("frontdoor.read", peer="127.0.0.1",
+                           verb="predict")
+        faults.fault_point("frontdoor.reply", peer="127.0.0.1",
+                           verb="served")
     finally:
         faults._fire = orig
     return {"zero_overhead": True}

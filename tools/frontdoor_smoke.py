@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""CI front-door smoke (`ci/run.py frontdoor_smoke` stage, ISSUE 11).
+
+Fast, non-slow gate over the cross-process serving tier:
+  * a REAL second OS process (two of them) gets predictions over the
+    TCP wire BIT-IDENTICAL to in-process `ModelServer.predict`;
+  * deadline shed over the wire: a budget the gateway's measured queue
+    cannot honor comes back as the typed shed, with accounting exact;
+  * connection kill mid-trace loses ZERO accepted requests
+    (`submitted == served + shed + failed` holds server-side; the
+    outcomes land in the orphan store for the resolve protocol);
+  * graceful drain: SIGTERM-style drain resolves every in-flight
+    request before the socket closes (`submitted == served + shed +
+    failed`, zero pending);
+  * the wire/queue/device/total latency decomposition is present in the
+    per-model histograms.
+
+Prints one JSON summary line; non-zero exit on any violated contract.
+The companion lint half of the stage (tpulint over mxnet_tpu/serving)
+runs as a second command in ci/run.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+from mxnet_tpu.serving import (ModelServer, ServingFrontDoor,  # noqa: E402
+                               ServingClient, DeadlineExceeded)
+
+# The client subprocess body: real ServingClient in a REAL second
+# process — the acceptance criterion is cross-PROCESS bit-identity.
+_CLIENT = r'''
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, %(root)r)
+import numpy as np
+from mxnet_tpu.serving import ServingClient, DeadlineExceeded
+port, seed, n_req = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+cli = ServingClient("127.0.0.1", port, pool_size=2)
+rng = np.random.RandomState(seed)
+out = {"served": 0, "shed": 0, "failed": 0, "lat_ms": []}
+rows_out = None
+x_fixed = np.arange(24, dtype=np.float32).reshape(4, 6) / 24.0
+futs = []
+import time
+for i in range(n_req):
+    x = x_fixed if i == 0 else rng.normal(
+        0, 1, (int(rng.randint(1, 5)), 6)).astype(np.float32)
+    futs.append((time.monotonic(),
+                 cli.predict_async({"data": x}, model="smoke",
+                                   deadline_ms=10000.0)))
+for t0, f in futs:
+    try:
+        res = f.result_wait(60.0)
+        out["served"] += 1
+        out["lat_ms"].append((time.monotonic() - t0) * 1e3)
+        if f is futs[0][1]:
+            out["fixed_out"] = [float(v) for v in
+                                np.asarray(res[0]).ravel()]
+            out["timings"] = f.timings
+    except DeadlineExceeded:
+        out["shed"] += 1
+    except Exception as e:
+        out["failed"] += 1
+        out.setdefault("errors", []).append(str(e)[:200])
+out["lat_ms"] = sorted(out["lat_ms"])[:3] + sorted(out["lat_ms"])[-3:]
+cli.close()
+print(json.dumps(out))
+'''
+
+
+def _net(prefix):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name=prefix + "_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name=prefix + "_fc1")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    rng = np.random.RandomState(0)
+    sym = _net("smoke")
+    shapes, _, _ = sym.infer_shape(data=(4, 6))
+    params = {n: mx.nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+    srv = ModelServer()
+    srv.register("smoke", sym, params, ctx=mx.cpu(), buckets=(1, 4),
+                 max_delay_ms=0.5, warmup_shapes={"data": (4, 6)})
+    profiler.latency_counters(reset=True, prefix="serving.smoke.")
+    fd = ServingFrontDoor(srv, port=0).start()
+
+    # --- two client OS processes, bit-identity + mixed traffic --------
+    x_fixed = np.arange(24, dtype=np.float32).reshape(4, 6) / 24.0
+    want = np.asarray(srv.predict("smoke", {"data": x_fixed})[0])
+    script = _CLIENT % {"root": ROOT}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", script, str(fd.port), str(seed), "20"],
+        stdout=subprocess.PIPE, text=True) for seed in (1, 2)]
+    reports = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        assert p.returncode == 0, out
+        reports.append(json.loads(out.strip().splitlines()[-1]))
+    for rep in reports:
+        got = np.asarray(rep["fixed_out"], np.float32).reshape(want.shape)
+        assert np.array_equal(got, want), \
+            "cross-process prediction diverged from in-process"
+        assert rep["failed"] == 0, rep
+        t = rep["timings"]
+        assert t["total_ms"] >= t["queue_ms"] + t["device_ms"]
+
+    # --- deadline shed over the wire ----------------------------------
+    cli = ServingClient("127.0.0.1", fd.port)
+    x1 = rng.normal(0, 1, (1, 6)).astype(np.float32)
+    # prime the step estimate, then queue far more work than a tight
+    # budget covers — the gateway must shed TYPED across the socket
+    for _ in range(4):
+        cli.predict({"data": x1}, model="smoke", timeout=30.0)
+    step_s = srv.engine("smoke").step_time(1) or 1e-3
+    deadline_ms = max(4.0 * step_s * 1e3, 20.0)
+    n_over = 300
+    futs = [cli.predict_async({"data": x1}, model="smoke",
+                              deadline_ms=deadline_ms)
+            for _ in range(n_over)]
+    served = shed = failed = 0
+    fail_msgs = []
+    for f in futs:
+        try:
+            f.result_wait(120.0)
+            served += 1
+        except DeadlineExceeded:
+            shed += 1
+        except Exception as e:
+            failed += 1
+            if len(fail_msgs) < 5:
+                fail_msgs.append("%s: %s" % (type(e).__name__,
+                                             str(e)[:200]))
+    assert served + shed + failed == n_over, "client accounting broken"
+    assert failed == 0, "non-shed failures over the wire: %s" % fail_msgs
+    assert shed > 0, "overload shed nothing across the socket"
+    assert served > 0, "overload shed everything"
+
+    # --- connection kill mid-trace loses zero accepted requests -------
+    from mxnet_tpu.serving import wire
+    import socket as _socket
+    before = fd.stats()
+    ks = _socket.create_connection(("127.0.0.1", fd.port), timeout=30.0)
+    hello = wire.recv_msg(ks)
+    n_kill = 5
+    for i in range(n_kill):
+        wire.send_msg(ks, ("predict", "c%d-%d" % (hello[1], i + 1),
+                           {"model": "smoke", "version": None,
+                            "arrays": {"data": x1}, "deadline_ms": None,
+                            "priority": 0, "trace": "kill-%d" % i,
+                            "t_send": time.time()}))
+    # wait for admission, then KILL the connection with work in flight
+    deadline = time.monotonic() + 60.0
+    while fd.stats()["submitted"] - before["submitted"] < n_kill:
+        assert time.monotonic() < deadline, fd.stats()
+        time.sleep(0.005)
+    ks.close()
+    deadline = time.monotonic() + 60.0
+    while fd.stats()["pending"] > 0:
+        assert time.monotonic() < deadline, fd.stats()
+        time.sleep(0.005)
+    after = fd.stats()
+    assert after["submitted"] - before["submitted"] == n_kill
+    assert after["submitted"] == after["served"] + after["shed"] \
+        + after["failed"], "connection kill lost accepted requests"
+
+    # --- wire/queue/device/total decomposition present ----------------
+    lat = profiler.latency_counters(prefix="serving.smoke.")
+    for key in ("wire", "queue", "device", "total"):
+        assert "serving.smoke.%s" % key in lat, sorted(lat)
+
+    # --- graceful drain under live async load -------------------------
+    drain_futs = [cli.predict_async({"data": x1}, model="smoke")
+                  for _ in range(32)]
+    ok = fd.drain(timeout=60.0)
+    resolved = 0
+    for f in drain_futs:
+        try:
+            f.result_wait(30.0)
+            resolved += 1
+        except Exception:
+            resolved += 1     # typed refusal also counts as resolved
+    st = fd.stats()
+    summary = {
+        "clients": reports,
+        "overload": {"submitted": n_over, "served": served, "shed": shed,
+                     "deadline_ms": round(deadline_ms, 1)},
+        "drain_clean": ok,
+        "frontdoor": {k: v for k, v in st.items() if v},
+        "latency_keys": sorted(lat),
+    }
+    print(json.dumps(summary), flush=True)
+    assert ok, "drain did not resolve in-flight work in time"
+    assert resolved == len(drain_futs)
+    assert st["pending"] == 0, st
+    assert st["submitted"] == st["served"] + st["shed"] + st["failed"], st
+    cli.close()
+    srv.stop()
+    print("frontdoor_smoke OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
