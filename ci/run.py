@@ -122,6 +122,24 @@ def stage_frontdoor_smoke(_):
          os.path.join("mxnet_tpu", "serving")], cwd=ROOT)
 
 
+def stage_fleet_smoke(_):
+    """Non-slow cross-host serving gate (ISSUE 12): a REAL worker OS
+    process joins the fleet (warmup + half-open probe) and serves
+    bit-identical predictions; SIGKILLing it mid-trace loses nothing
+    (submitted == served + shed + failed, requests reroute, the fleet
+    marks the host SUSPECT/DEAD); a tampered frame is rejected by the
+    HMAC auth BEFORE unpickling; the zero-overhead contract holds with
+    fleet env unset — then tpulint over the serving modules."""
+    rc = subprocess.call(
+        [sys.executable, os.path.join(ROOT, "tools", "fleet_smoke.py")],
+        env=_env_cpu_mesh(1), cwd=ROOT)
+    if rc != 0:
+        return rc
+    return subprocess.call(
+        [sys.executable, "-m", "mxnet_tpu.analysis.lint",
+         os.path.join("mxnet_tpu", "serving")], cwd=ROOT)
+
+
 def stage_chaos_smoke(_):
     """Non-slow resilience gate (ISSUE 9): replica-kill-under-load
     (served + shed == submitted, breaker opens, traffic reroutes) and
@@ -160,6 +178,7 @@ STAGES = [
     ("multichip", stage_multichip),
     ("serving_smoke", stage_serving_smoke),
     ("frontdoor_smoke", stage_frontdoor_smoke),
+    ("fleet_smoke", stage_fleet_smoke),
     ("chaos_smoke", stage_chaos_smoke),
     ("bench_smoke", stage_bench_smoke),
 ]

@@ -19,9 +19,11 @@ __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
            "analysis_counters", "record_kernel_roofline", "kernel_counters",
            "record_zero_sharding", "zero_counters",
            "record_latency", "latency_counters",
+           "latency_histogram", "percentile_from_counts",
            "record_retry", "retry_counters",
            "record_watchdog_event", "watchdog_counters",
-           "record_fault_injection", "fault_counters"]
+           "record_fault_injection", "fault_counters",
+           "record_fleet_event", "fleet_counters"]
 
 _state = {"running": False, "filename": "profile.json", "events": [],
           "jax_trace_dir": None, "lock": threading.Lock()}
@@ -287,6 +289,34 @@ def _lat_percentile_ns(h, q):
     return h["max_ns"]
 
 
+def latency_histogram(key):
+    """Raw CUMULATIVE bucket counts for `key` (a copy; aligned with the
+    fixed log-spaced edges), or None when nothing recorded. For callers
+    that need WINDOWED percentiles — e.g. `ModelServer.health()`'s
+    autoscaling signal — who diff two of their own snapshots and feed
+    :func:`percentile_from_counts`."""
+    with _state["lock"]:
+        h = _latency.get(key)
+        return list(h["counts"]) if h else None
+
+
+def percentile_from_counts(counts, q):
+    """Conservative (upper-bucket-edge) percentile in MILLISECONDS from
+    a bucket-count list (typically a delta of two
+    :func:`latency_histogram` snapshots). None when the window holds no
+    samples."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target and c:
+            return _LAT_EDGES_NS[i] / 1e6
+    return _LAT_EDGES_NS[-1] / 1e6
+
+
 def latency_counters(reset=False, prefix=None):
     """Snapshot (optionally reset) the latency histograms as
     key -> {count, p50_ms, p95_ms, p99_ms, mean_ms, max_ms}. `prefix`
@@ -383,6 +413,41 @@ def record_fault_injection(site):
     with _state["lock"]:
         _faults["injected"] += 1
         _faults[site] = _faults.get(site, 0) + 1
+
+
+# ----------------------------------------------------------------------
+# serving-fleet counters (serving/pool.py + autoscaler.py, ISSUE 12):
+# worker membership transitions and autoscaler actions, always-on adds
+# like the watchdog family — the chaos/bench gates assert "the death WAS
+# detected" and "capacity WAS restored" off these.
+# ----------------------------------------------------------------------
+_FLEET_ZERO = {"joins": 0, "rejoins": 0, "suspects": 0, "deads": 0,
+               "recoveries": 0, "scale_ups": 0, "scale_downs": 0}
+_fleet = dict(_FLEET_ZERO)
+
+
+def record_fleet_event(event):
+    """Count one fleet membership/autoscaler event: "join", "rejoin",
+    "suspect", "dead", "recovery", "scale_up", "scale_down"."""
+    total_key = {"join": "joins", "rejoin": "rejoins",
+                 "suspect": "suspects", "dead": "deads",
+                 "recovery": "recoveries", "scale_up": "scale_ups",
+                 "scale_down": "scale_downs"}.get(event)
+    with _state["lock"]:
+        if total_key is not None:
+            _fleet[total_key] += 1
+        else:
+            _fleet[event] = _fleet.get(event, 0) + 1
+
+
+def fleet_counters(reset=False):
+    """Snapshot (optionally reset) the serving-fleet counters."""
+    with _state["lock"]:
+        out = dict(_fleet)
+        if reset:
+            _fleet.clear()
+            _fleet.update(_FLEET_ZERO)
+    return out
 
 
 def fault_counters(reset=False):

@@ -20,6 +20,16 @@ request-level tracing, graceful drain) and `ServingClient`
 length-prefixed framing in `serving/wire.py` shared with the dist_async
 transport.
 
+Cross-HOST serving (ISSUE 12): `ReplicaWorker` processes host replicas
+behind their own front doors and register with a gateway's `FleetPool`
+(`serving/pool.py` — heartbeat supervision with SUSPECT/DEAD states,
+resolve-by-id recovery of a dead host's in-flight work, warmup +
+half-open-probe readmission), `RemoteReplica` adapts them onto the
+ModelServer's unchanged dispatch surface, tail-latency hedging
+duplicates straggler dispatches (`MXNET_SERVING_HEDGE_MS`), and
+`Autoscaler` polls `health()` to drive a pluggable worker launcher.
+Optional HMAC frame auth: ``MXNET_SERVING_AUTH_KEY``.
+
     from mxnet_tpu.serving import InferenceEngine, ModelServer
 """
 from .program_cache import BucketedProgramCache, DEFAULT_BUCKETS, bucket_for
@@ -29,8 +39,13 @@ from .engine import InferenceEngine
 from .server import ModelServer
 from .frontdoor import ServingFrontDoor
 from .client import ServingClient
+from .pool import FleetPool, RemoteReplica
+from .worker import ReplicaWorker
+from .autoscaler import Autoscaler, LocalProcessLauncher
 
 __all__ = ["InferenceEngine", "ModelServer", "ServingFrontDoor",
-           "ServingClient", "BucketedProgramCache",
+           "ServingClient", "FleetPool", "RemoteReplica",
+           "ReplicaWorker", "Autoscaler", "LocalProcessLauncher",
+           "BucketedProgramCache",
            "DynamicBatcher", "DeadlineExceeded", "DEFAULT_BUCKETS",
            "bucket_for", "pad_to_bucket", "default_max_batch"]

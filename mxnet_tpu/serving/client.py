@@ -67,7 +67,7 @@ class ClientRequest:
     __slots__ = ("rid", "trace", "model", "result", "error", "timings",
                  "resubmits", "_event", "_cb_lock", "_callbacks",
                  "_deadline", "_priority", "_version", "_arrays",
-                 "_send_wall")
+                 "_send_wall", "_t_submit", "_t_done")
 
     def __init__(self, rid, trace, model, version, arrays, deadline,
                  priority):
@@ -86,6 +86,30 @@ class ClientRequest:
         self._version = version
         self._arrays = arrays
         self._send_wall = None
+        self._t_submit = time.monotonic()
+        self._t_done = None
+
+    # latency surface, mirroring the in-process request objects so a
+    # RemoteReplica's inner future decomposes the same way at the
+    # gateway (serving/pool.py): t_dispatch is back-derived from the
+    # server-reported device time — everything before the worker's
+    # device slot (client queueing, wire, worker queue) counts as queue
+    @property
+    def t_submit(self):
+        return self._t_submit
+
+    @property
+    def t_done(self):
+        return self._t_done
+
+    @property
+    def t_dispatch(self):
+        if self._t_done is None:
+            return None
+        device_ms = (self.timings or {}).get("device_ms")
+        if device_ms is None:
+            return None
+        return self._t_done - device_ms / 1e3
 
     # -- future surface ------------------------------------------------
     def done(self):
@@ -112,6 +136,7 @@ class ClientRequest:
             self.result = result
             self.error = error
             self.timings = timings
+            self._t_done = time.monotonic()
             self._arrays = None     # no resubmit after resolution: release
             #                         the request payload (bench loops hold
             #                         thousands of futures)
@@ -172,7 +197,8 @@ class _ClientConn:
         """One frame out; raises on transport failure (the caller owns
         the resubmit-vs-resolve decision)."""
         with self.send_lock:
-            _wire.send_msg(self.sock, frame)
+            _wire.send_msg(self.sock, frame,
+                           auth_key=self.client._auth_key)
 
     def register(self, rid, fut):
         with self.pending_lock:
@@ -182,19 +208,10 @@ class _ClientConn:
         with self.pending_lock:
             return self.pending.pop(rid, None)
 
-    @staticmethod
-    def _teardown(sock):
-        """shutdown THEN close: a bare close() on a socket another
-        thread is blocked in recv() on neither wakes that thread nor
-        promptly FINs the peer — shutdown(SHUT_RDWR) does both."""
-        try:
-            sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass  # tpulint: allow-swallowed-exception peer already gone; shutdown is best-effort
-        try:
-            sock.close()
-        except OSError:
-            pass  # tpulint: allow-swallowed-exception socket already dead; close is best-effort hygiene
+    # shutdown THEN close: a bare close() on a socket another thread is
+    # blocked in recv() on neither wakes that thread nor promptly FINs
+    # the peer — one shared definition in wire.teardown
+    _teardown = staticmethod(_wire.teardown)
 
     def close(self):
         self.alive = False
@@ -218,7 +235,8 @@ class _ClientConn:
                 # tick-aware: an idle-timeout before any frame byte just
                 # re-checks stop_evt; a timeout INSIDE a frame is a
                 # stalled-peer FrameError, never a silent desync
-                msg = _wire.recv_msg_tick(self.sock)
+                msg = _wire.recv_msg_tick(
+                    self.sock, auth_key=self.client._auth_key)
             except (_wire.FrameError, OSError):
                 msg = None
             if msg is _wire.TICK:
@@ -294,10 +312,16 @@ class ServingClient:
         transport failure (applies to the never-admitted cases: failed
         sends and ``unknown`` resolve outcomes; an admitted request is
         resolved, never resubmitted).
+    auth_key : str or bytes, optional
+        Shared HMAC frame-auth key (default: ``MXNET_SERVING_AUTH_KEY``,
+        read once here). Must match the front door's key — the server
+        rejects unauthenticated frames before unpickling, and this
+        client rejects unauthenticated replies the same way.
     """
 
     def __init__(self, host="127.0.0.1", port=None, pool_size=1,
-                 connect_deadline_s=30.0, resubmits=2):
+                 connect_deadline_s=30.0, resubmits=2, auth_key=None):
+        self._auth_key = _wire.normalize_auth_key(auth_key)
         self._host = host
         self._port = int(port) if port is not None else int(get_env(
             "MXNET_SERVING_PORT", DEFAULT_PORT, int))
@@ -320,7 +344,7 @@ class ServingClient:
         sock = self._connect_retry.call(
             socket.create_connection, (self._host, self._port),
             timeout=300.0)
-        hello = _wire.recv_msg(sock)
+        hello = _wire.recv_msg(sock, auth_key=self._auth_key)
         if not (isinstance(hello, tuple) and hello
                 and hello[0] == "hello"):
             sock.close()
@@ -364,6 +388,19 @@ class ServingClient:
             pool, self._pool = self._pool, []
         for conn in pool:
             conn.close()
+
+    def fail_over(self):
+        """Break every pooled connection's TRANSPORT without closing the
+        client: each reader wakes, sees a transport death, and runs the
+        resolve-by-id recovery for its in-flight requests — exactly what
+        a fleet gateway needs when it declares a worker DEAD on missed
+        heartbeats while the dispatch sockets still look alive (a wedged
+        process ACKs TCP long after it stopped serving). New submissions
+        reconnect through the normal pool path."""
+        with self._lock:
+            pool = list(self._pool)
+        for conn in pool:
+            conn.break_transport()
 
     # ------------------------------------------------------------------
     # predict

@@ -147,12 +147,20 @@ class ServingFrontDoor:
     evict_threshold, evict_cooldown_ms, orphan_ttl_s, max_frame_mb :
         Operational knobs; each defaults to its
         ``MXNET_SERVING_FRONTDOOR_*`` env var (docs/faq/env_var.md).
+    auth_key : str or bytes, optional
+        Shared HMAC-SHA256 frame-auth key (default: the
+        ``MXNET_SERVING_AUTH_KEY`` env var, read ONCE here). When set,
+        every frame is verified BEFORE unpickling; an unauthenticated
+        or tampered frame is rejected as an eviction strike
+        (``auth_rejected`` counter) — see docs/faq/serving.md
+        "Trust model".
     """
 
     def __init__(self, server, host=None, port=None, backlog=16,
                  evict_threshold=None, evict_cooldown_ms=None,
-                 orphan_ttl_s=None, max_frame_mb=None):
+                 orphan_ttl_s=None, max_frame_mb=None, auth_key=None):
         self._server = server
+        self._auth_key = _wire.normalize_auth_key(auth_key)
         self._host = host if host is not None else get_env(
             "MXNET_SERVING_FRONTDOOR_BIND", "127.0.0.1")
         self.port = int(port) if port is not None else int(get_env(
@@ -196,7 +204,7 @@ class ServingFrontDoor:
             "frames": 0, "submitted": 0, "served": 0, "shed": 0,
             "failed": 0, "wire_shed": 0, "refused_draining": 0,
             "orphaned": 0, "orphan_resolved": 0, "orphan_expired": 0,
-            "control": 0}
+            "control": 0, "auth_rejected": 0}
         self._prev_sigterm = None
 
     # ------------------------------------------------------------------
@@ -316,6 +324,12 @@ class ServingFrontDoor:
                 try:
                     sock, addr = self._listen_sock.accept()
                 except socket.timeout:
+                    # the accept poll tick doubles as the TIME-DRIVEN
+                    # orphan sweep (ISSUE 12 satellite): TTL enforcement
+                    # must not depend on new traffic arriving — an idle
+                    # gateway would otherwise retain expired replies
+                    # until the next orphan insertion
+                    self._sweep_orphans()
                     continue  # tpulint: allow-swallowed-exception the accept poll tick — timeouts just re-check the stop event
                 except OSError:
                     break  # tpulint: allow-swallowed-exception listener closed by drain(): the clean shutdown path of this loop
@@ -358,7 +372,7 @@ class ServingFrontDoor:
         conn = _Conn(sock, peer, conn_id)
         # hello before the reader/writer exist: the conn_id must be the
         # FIRST frame on the stream (the client's request ids embed it)
-        _wire.send_msg(sock, ("hello", conn_id))
+        _wire.send_msg(sock, ("hello", conn_id), auth_key=self._auth_key)
         conn.reader = threading.Thread(
             target=self._read_loop, args=(conn,),
             name="mx-frontdoor-read-%d" % conn_id, daemon=True)
@@ -387,7 +401,8 @@ class ServingFrontDoor:
                     # frame keeps reading (an honest slow peer must not
                     # be desynced into a strike) until the stall budget
                     msg = _wire.recv_msg_tick(conn.sock,
-                                              max_bytes=self._max_frame)
+                                              max_bytes=self._max_frame,
+                                              auth_key=self._auth_key)
                 except _wire.FrameError as e:
                     self._strike(conn, e)
                     return
@@ -430,9 +445,14 @@ class ServingFrontDoor:
     def _strike(self, conn, err):
         """One mid-frame failure from this peer: count a breaker strike;
         at the threshold the peer is evicted — refused at accept until
-        the cooldown elapses."""
+        the cooldown elapses. Auth failures (a peer without the shared
+        ``MXNET_SERVING_AUTH_KEY``, or a tampered frame) are strikes of
+        the same kind, separately counted — the frame never reached
+        unpickling."""
         now = time.monotonic()
         with self._lock:
+            if isinstance(err, _wire.AuthError):
+                self._counters["auth_rejected"] += 1
             rec = self._strikes.setdefault(conn.peer, [0, 0.0])
             rec[0] += 1
             evicted = rec[0] >= self._evict_threshold
@@ -640,14 +660,26 @@ class ServingFrontDoor:
     # ------------------------------------------------------------------
     # orphan store + resolve protocol
     # ------------------------------------------------------------------
+    def _sweep_orphans_locked(self, now):
+        """Drop expired orphan replies (caller holds ``self._lock``).
+        Runs on the acceptor's poll tick, on every resolve, and on each
+        insertion — TTL is enforced by TIME, not by traffic (an idle
+        gateway must not retain expired replies indefinitely)."""
+        expired = [r for r, (exp, _) in self._orphans.items()
+                   if exp <= now]
+        for r in expired:
+            del self._orphans[r]
+            self._counters["orphan_expired"] += 1
+
+    def _sweep_orphans(self):
+        with self._lock:
+            if self._orphans:
+                self._sweep_orphans_locked(time.monotonic())
+
     def _orphan(self, rid, reply):
         now = time.monotonic()
         with self._lock:
-            expired = [r for r, (exp, _) in self._orphans.items()
-                       if exp <= now]
-            for r in expired:
-                del self._orphans[r]
-                self._counters["orphan_expired"] += 1
+            self._sweep_orphans_locked(now)
             self._orphans[rid] = (now + self._orphan_ttl_s, reply)
             self._counters["orphaned"] += 1
 
@@ -655,6 +687,7 @@ class ServingFrontDoor:
         now = time.monotonic()
         out = {}
         with self._lock:
+            self._sweep_orphans_locked(now)
             for r in rids:
                 rec = self._orphans.pop(r, None)
                 if rec is not None and rec[0] > now:
@@ -690,7 +723,8 @@ class ServingFrontDoor:
                     # stall-tolerant send: the socket's short poll
                     # timeout must not kill a merely backpressured
                     # client mid-reply (only a zero-progress stall does)
-                    _wire.send_msg_stall(conn.sock, reply)
+                    _wire.send_msg_stall(conn.sock, reply,
+                                         auth_key=self._auth_key)
                     if reply[0] in ("served", "shed", "failed"):
                         # "sent" is not "delivered" (TCP buffers accept
                         # frames for a dead peer): keep the outcome in

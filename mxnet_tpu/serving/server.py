@@ -104,6 +104,16 @@ class _Breaker:
             self.state = "closed"
             self.opened_at = None
 
+    def on_neutral(self):
+        """A dispatch that produced NO health verdict (a shed, or a
+        hedge slot handed back unused) releases the half-open probe
+        slot without closing or re-opening — the next dispatch becomes
+        the probe. Without this, a half-open replica whose probe
+        request sheds keeps ``probing=True`` forever and never receives
+        normal traffic again (permanent capacity loss while the fleet
+        looks healthy)."""
+        self.probing = False
+
     def on_failure(self, now):
         self.failures += 1
         self.probing = False
@@ -124,27 +134,42 @@ class _Breaker:
 
 
 class _Replica:
-    __slots__ = ("engine", "inflight", "breaker")
+    __slots__ = ("engine", "inflight", "breaker", "available")
 
     def __init__(self, engine, breaker):
         self.engine = engine
         self.inflight = 0
         self.breaker = breaker
+        # fleet health gate (serving/pool.py): a SUSPECT/DEAD worker's
+        # replicas flip this False and dispatch routes around them. A
+        # plain attribute — the in-process path pays one boolean read,
+        # no lock and no env (the fleet zero-overhead contract).
+        self.available = True
 
 
 class _ModelEntry:
-    __slots__ = ("versions", "default_version", "reload_step", "counters")
+    __slots__ = ("versions", "default_version", "reload_step", "counters",
+                 "replica_seq")
 
     def __init__(self):
         self.versions = {}        # label -> list of _Replica
         self.default_version = None
         self.reload_step = None   # checkpoint-poller watermark
+        self.replica_seq = 0      # monotonic id source for add_replicas:
+        #                           ids must stay unique across the
+        #                           model's whole lifetime (fleet churn
+        #                           removes and adds replicas, and a
+        #                           reused id would alias fault-spec
+        #                           matchers + breaker-log identity)
         # request accounting (the chaos contract: submitted must equal
         # served + shed + failed, with failed == 0 while any healthy
-        # replica remains)
+        # replica remains). Hedges are INTERNAL duplicates: they count
+        # under "hedges"/"hedge_wins" only — the loser's result is
+        # discarded, so submitted == served + shed + failed holds with
+        # every submitted request counted exactly once.
         self.counters = {"submitted": 0, "served": 0, "shed": 0,
                          "failed": 0, "dispatch_retries": 0,
-                         "breaker_opens": 0}
+                         "breaker_opens": 0, "hedges": 0, "hedge_wins": 0}
 
 
 class _ServerRequest:
@@ -161,12 +186,21 @@ class _ServerRequest:
 
     Same future surface as the batcher's `_Request` (``done()`` /
     ``result_wait(timeout)`` / ``add_done_callback(fn)``), so callers and
-    the bench/CI accounting treat both alike."""
+    the bench/CI accounting treat both alike.
+
+    Hedging (ISSUE 12): when the server carries a `_Hedger`, a request
+    whose primary dispatch outlives the per-(model, bucket) hedge delay
+    is DUPLICATED onto a second available replica. Resolution is
+    first-wins (``_resolve`` is exactly-once), the loser's outcome is
+    discarded internally, and both dispatches still release their
+    replica slots and feed their breakers — hedges never double-count
+    in the served/shed/failed invariant."""
 
     __slots__ = ("_server", "_name", "_version", "_data", "_priority",
                  "_deadline", "_retries_left", "_tried", "_event",
                  "_cb_lock", "_callbacks", "result", "error", "attempts",
-                 "_t_submit", "_inner")
+                 "_t_submit", "_inner", "_hedged", "_primary_rep",
+                 "_claimed")
 
     def __init__(self, server, name, version, data, deadline_ms, priority,
                  retries):
@@ -186,7 +220,10 @@ class _ServerRequest:
         self.error = None
         self.attempts = 0
         self._t_submit = time.monotonic()
-        self._inner = None    # the FINAL replica-local request (timing)
+        self._inner = None    # the WINNING replica-local request (timing)
+        self._hedged = False  # at most one hedge per request
+        self._primary_rep = None
+        self._claimed = False  # exactly-once resolution guard
 
     # latency surface, proxied from the resolving attempt (t_submit is
     # the server-level submit — queue wait spans resubmits too)
@@ -220,12 +257,26 @@ class _ServerRequest:
                 return
         fn(self)
 
-    def _resolve(self, result=None, error=None):
+    def _resolve(self, result=None, error=None, inner=None):
+        """Exactly-once resolution: the FIRST caller wins (and is the
+        only one that counts into served/shed/failed); a hedge loser's
+        call is a no-op. Returns True when this call resolved.
+
+        The outcome is counted BETWEEN claiming the resolution and
+        waking waiters: a caller returning from ``result_wait`` must
+        observe its own request already counted (the smoke/bench gates
+        read the counters right after the last future resolves)."""
+        with self._cb_lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            self.result = result
+            self.error = error
+            if inner is not None:
+                self._inner = inner
         outcome = "served" if error is None else (
             "shed" if isinstance(error, DeadlineExceeded) else "failed")
         self._server._count(self._name, outcome)
-        self.result = result
-        self.error = error
         with self._cb_lock:
             self._event.set()
             callbacks, self._callbacks = self._callbacks, []
@@ -234,6 +285,7 @@ class _ServerRequest:
                 fn(self)
             except Exception:
                 pass  # tpulint: allow-swallowed-exception an observer must never poison the delivery path (same contract as batcher._finish)
+        return True
 
     # -- dispatch ------------------------------------------------------
     def _remaining_ms(self):
@@ -257,6 +309,7 @@ class _ServerRequest:
         rep = self._server._acquire(self._name, self._version,
                                     exclude=self._tried)
         self.attempts += 1
+        self._primary_rep = rep
         try:
             fut = rep.engine.predict_async(self._data,
                                            deadline_ms=deadline_ms,
@@ -266,21 +319,74 @@ class _ServerRequest:
             raise
         fut.add_done_callback(
             lambda inner, rep=rep: self._on_done(rep, inner))
+        hedger = self._server._hedger
+        if hedger is not None and not self._hedged:
+            hedger.arm(self)
 
-    def _on_done(self, rep, inner):
-        self._inner = inner
+    def _hedge(self):
+        """Fire one hedge dispatch (the hedger's timer thread): duplicate
+        the still-unresolved request onto a second available replica.
+        The hedge NEVER touches the primary attempt — first resolution
+        wins, and a hedge that sheds or fails is simply discarded (the
+        primary's own retry machinery stays in charge)."""
+        with self._cb_lock:
+            # claim the one hedge slot atomically: a retry re-arms the
+            # hedger, so two timer entries for this request can fire in
+            # the same batch — only one may dispatch. The _tried
+            # snapshot rides the same lock _on_done mutates under (a
+            # concurrent add() during the copy would raise
+            # mid-iteration and silently cost the hedge).
+            if self._claimed or self._hedged:
+                return
+            self._hedged = True
+            exclude = set(self._tried)
+        remaining = self._remaining_ms()
+        if remaining is not None and remaining <= 0.0:
+            return
+        if self._primary_rep is not None:
+            exclude.add(self._primary_rep)
+        try:
+            rep = self._server._acquire(self._name, self._version,
+                                        exclude=exclude)
+        except BaseException:
+            return  # tpulint: allow-swallowed-exception a hedge is OPTIONAL — model unregistered/stopped mid-flight leaves the primary attempt owning the request's outcome
+        if rep in exclude:
+            # no SECOND replica is actually available (forced-probe
+            # fallback handed the primary back): a hedge onto the same
+            # queue buys nothing — release the slot, breaker-neutral
+            self._server._complete(rep, "shed")
+            return
+        self._server._count(self._name, "hedges")
+        try:
+            fut = rep.engine.predict_async(self._data,
+                                           deadline_ms=remaining,
+                                           priority=self._priority)
+        except BaseException:
+            self._server._complete(rep, "failure", self._name)
+            return
+        fut.add_done_callback(
+            lambda inner, rep=rep: self._on_done(rep, inner, hedge=True))
+
+    def _on_done(self, rep, inner, hedge=False):
         err = inner.error
         if err is None:
             self._server._complete(rep, "success", self._name)
-            self._resolve(result=inner.result)
+            if self._resolve(result=inner.result, inner=inner) and hedge:
+                self._server._count(self._name, "hedge_wins")
             return
         if isinstance(err, DeadlineExceeded):
             # load, not sickness: neutral for the breaker
             self._server._complete(rep, "shed", self._name)
-            self._resolve(error=err)
+            if not hedge:
+                # a hedge's shed is discarded — the primary (or its
+                # retries) still owns this request's outcome
+                self._resolve(error=err)
             return
         self._server._complete(rep, "failure", self._name)
-        self._tried.add(rep)
+        with self._cb_lock:
+            self._tried.add(rep)   # paired with _hedge's snapshot
+        if hedge or self.done():
+            return  # hedge losers never resubmit; primary owns retries
         if self._retries_left <= 0:
             self._resolve(error=err)
             return
@@ -296,6 +402,176 @@ class _ServerRequest:
             self._attempt()
         except BaseException as e:  # retries exhaust replicas / stopped
             self._resolve(error=e)
+
+
+def _request_rows(data):
+    """Best-effort row count of one request (the hedge-delay bucket
+    key); None when the payload shape is unrecognizable."""
+    try:
+        if isinstance(data, dict):
+            data = next(iter(data.values()))
+        elif isinstance(data, (list, tuple)):
+            data = data[0]
+        return int(data.shape[0])
+    except Exception:
+        return None
+
+
+class _Hedger:
+    """Tail-latency hedging (ISSUE 12; the classic tied-request /
+    hedged-request defense against straggler replicas — one slow or
+    half-dead host must cost a duplicate dispatch, not the p99).
+
+    A single lazy timer thread holds a min-heap of (fire_at, request).
+    When a request's primary dispatch is still unresolved at its hedge
+    delay, `_ServerRequest._hedge` duplicates it onto a second available
+    replica; first resolution wins and the loser is discarded
+    internally (never double-counted — see `_ServerRequest`).
+
+    The hedge delay is per (model, bucket): ``hedge_ms`` fixes it
+    globally (``MXNET_SERVING_HEDGE_MS`` > 0); with auto-derivation
+    (``MXNET_SERVING_HEDGE_MS=0``) it is ``factor`` x the LARGER of the
+    model's device-latency histogram p95 (`profiler.latency_counters`,
+    the signal that already exists) and the request bucket's measured
+    step-time tail, floored at ``min_ms`` — so hedges fire on genuine
+    stragglers, not on the expected service time. Exists ONLY when
+    hedging is configured: the default serving path never builds this
+    object, starts this thread, or touches this heap."""
+
+    def __init__(self, server, fixed_ms, factor, min_ms):
+        self._server = server
+        self._fixed_ms = fixed_ms      # None => derive from p95
+        self._factor = float(factor)
+        self._min_ms = float(min_ms)
+        self._cv = threading.Condition()
+        self._heap = []                # (fire_at, seq, request)
+        self._seq = 0
+        self._stop_evt = threading.Event()
+        self._thread = None
+        self._delay_cache = {}         # (model, rows) -> (expiry, s)
+        self._hist_prev = {}           # model -> device-histogram snapshot
+
+    # -- delay derivation ---------------------------------------------
+    def delay_s(self, model, rows):
+        if self._fixed_ms is not None:
+            return self._fixed_ms / 1e3
+        # cache key on (model, rows): rows -> bucket is deterministic,
+        # so a cache hit skips BOTH the histogram walk and the
+        # registry-lock bucket/tail lookup — the whole point of the
+        # cache on a per-request arm path
+        key = (model, rows)
+        now = time.monotonic()
+        cached = self._delay_cache.get(key)
+        if cached is not None and cached[0] > now:
+            return cached[1]
+        bucket, tail_s = self._server._local_bucket_tail(model, rows)
+        from .. import profiler as _prof
+        # WINDOWED device p95 (delta since this hedger's last
+        # derivation): a cumulative percentile would let one past
+        # straggler episode ratchet the delay up for the rest of the
+        # process lifetime, after which no hedge ever fires again. A
+        # window too thin to trust (< 16 samples) keeps the previous
+        # delay; the first derivation uses the full history it has.
+        dev_key = "serving.%s.device" % model
+        counts = _prof.latency_histogram(dev_key)
+        p95_ms = None
+        if counts is not None:
+            prev = self._hist_prev.get(model)
+            if prev is None:
+                self._hist_prev[model] = counts
+                p95_ms = _prof.percentile_from_counts(counts, 0.95)
+            else:
+                delta = [c - p for c, p in zip(counts, prev)]
+                if sum(delta) >= 16:
+                    self._hist_prev[model] = counts
+                    p95_ms = _prof.percentile_from_counts(delta, 0.95)
+                elif cached is not None:
+                    # thin window: extend the previous delay's life
+                    self._delay_cache[key] = (now + 1.0, cached[1])
+                    return cached[1]
+                else:
+                    p95_ms = _prof.percentile_from_counts(counts, 0.95)
+        base_ms = max(p95_ms or 0.0,
+                      (tail_s or 0.0) * 1e3)
+        delay_ms = max(self._min_ms, self._factor * base_ms)
+        # 1s cache: percentile extraction walks histogram buckets and
+        # must not run once per request under load. Bounded: arbitrary
+        # client-chosen row counts must not grow the dict forever
+        if len(self._delay_cache) >= 512:
+            self._delay_cache.clear()
+        self._delay_cache[key] = (now + 1.0, delay_ms / 1e3)
+        return delay_ms / 1e3
+
+    # -- arming --------------------------------------------------------
+    def arm(self, req):
+        fire_at = time.monotonic() + self.delay_s(
+            req._name, _request_rows(req._data))
+        with self._cv:
+            if self._stop_evt.is_set():
+                return
+            import heapq
+            self._seq += 1
+            heapq.heappush(self._heap, (fire_at, self._seq, req))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="mx-serving-hedge",
+                    daemon=True)
+                self._thread.start()
+            self._cv.notify()
+
+    def stop(self):
+        self._stop_evt.set()
+        with self._cv:
+            self._cv.notify_all()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)
+
+    # -- timer loop ----------------------------------------------------
+    def _loop(self):
+        import heapq
+        from ..resilience.watchdog import watchdog as _watchdog
+        hb = _watchdog().register("serving:hedger",
+                                  thread=threading.current_thread())
+        try:
+            while not self._stop_evt.is_set():
+                due = []
+                with self._cv:
+                    now = time.monotonic()
+                    while self._heap and self._heap[0][0] <= now:
+                        due.append(heapq.heappop(self._heap)[2])
+                    if not due:
+                        hb.idle()
+                        timeout = 0.5 if not self._heap else \
+                            min(0.5, self._heap[0][0] - now)
+                        self._cv.wait(timeout=max(timeout, 1e-3))
+                        continue
+                hb.beat()
+                # fire OUTSIDE the heap lock (a hedge dispatch stages
+                # request arrays onto a device — tpulint TPL104) and
+                # OFF this thread: a remote-replica hedge is a blocking
+                # socket send, and one backpressured worker must stall
+                # ITS hedge, not every hedge behind it in the heap.
+                # Hedges are straggler-rate events; a short-lived thread
+                # each is cheap
+                for req in due:
+                    if req.done():
+                        continue
+                    threading.Thread(
+                        target=self._fire_one, args=(req,),
+                        name="mx-serving-hedge-fire",
+                        daemon=True).start()
+        finally:
+            hb.close()
+
+    @staticmethod
+    def _fire_one(req):
+        try:
+            req._hedge()
+        except Exception as e:
+            # tpulint: allow-swallowed-exception hedges are best-effort duplicates; the primary attempt still resolves the request
+            logging.warning("serving hedge dispatch failed (primary "
+                            "still owns the request): %s", e)
 
 
 def _replica_ctxs(base, replicas):
@@ -322,11 +598,35 @@ class ModelServer:
     default-version alias; swap weights live with zero recompiles."""
 
     def __init__(self, breaker_threshold=None, breaker_cooldown_ms=None,
-                 dispatch_retries=None):
+                 dispatch_retries=None, hedge_ms=None, hedge_factor=None,
+                 hedge_min_ms=None):
         self._lock = threading.Lock()
         self._models = {}
         self._pollers = {}    # model name -> (thread, stop_event)
         self._stopped = False
+        # tail-latency hedging (ISSUE 12): OFF unless configured — the
+        # env is read ONCE here, the hedger object (and its timer
+        # thread) only exists when hedging is on, and the unhedged
+        # dispatch path pays a single `is None` check.
+        # hedge_ms=False forces OFF regardless of the env (the bench's
+        # unhedged baseline must stay unhedged under
+        # MXNET_SERVING_HEDGE_MS); None defers to the env; 0 = auto.
+        if hedge_ms is False:
+            hedge_ms = None
+        elif hedge_ms is None:
+            hedge_ms = get_env("MXNET_SERVING_HEDGE_MS", None, float)
+        if hedge_ms is None:
+            self._hedger = None
+        else:
+            if hedge_factor is None:
+                hedge_factor = get_env("MXNET_SERVING_HEDGE_FACTOR",
+                                       2.0, float)
+            if hedge_min_ms is None:
+                hedge_min_ms = get_env("MXNET_SERVING_HEDGE_MIN_MS",
+                                       10.0, float)
+            self._hedger = _Hedger(
+                self, fixed_ms=(float(hedge_ms) if hedge_ms > 0 else None),
+                factor=hedge_factor, min_ms=hedge_min_ms)
         # graceful-degradation knobs (docs/faq/resilience.md): N
         # consecutive dispatch failures open a replica's breaker, a
         # cooldown later one half-open probe re-admits it; failed
@@ -348,6 +648,7 @@ class ModelServer:
         self._breaker_cooldown_s = float(breaker_cooldown_ms) / 1000.0
         self._dispatch_retries = max(0, int(dispatch_retries))
         self._reload_retry = _reload_retry_policy()
+        self._health_prev_counts = {}   # lat key -> histogram snapshot
 
     # ------------------------------------------------------------------
     # registration
@@ -415,6 +716,79 @@ class ModelServer:
             if default or entry.default_version is None:
                 entry.default_version = version
         return version
+
+    def add_replicas(self, name, engines, version=None):
+        """Attach additional replica(s) to an ALREADY-registered version
+        (default version when ``version`` is None) — the fleet layer's
+        attach point: a joining worker's `RemoteReplica` adapters land
+        in the same least-loaded/breaker/resubmit dispatch table as
+        local engines (serving/pool.py). Accepts anything with the
+        replica dispatch surface (``predict_async``/``predict``/
+        ``update_params``/``stats``/``stop``). Returns the new
+        `_Replica` wrappers (the handle :meth:`remove_replicas`
+        takes)."""
+        if not isinstance(engines, (list, tuple)):
+            engines = [engines]
+        if not engines:
+            return []
+        reps_new = [_Replica(e, _Breaker(self._breaker_threshold,
+                                         self._breaker_cooldown_s))
+                    for e in engines]
+        with self._lock:
+            if self._stopped:
+                raise MXNetError("ModelServer is stopped")
+            _, reps = self._resolve_locked(name, version)
+            entry = self._models[name]
+            if entry.replica_seq == 0:
+                # seed past every id register_engines handed out
+                existing = [r.engine.replica
+                            for rl in entry.versions.values() for r in rl
+                            if isinstance(getattr(r.engine, "replica",
+                                                  None), int)]
+                entry.replica_seq = max(existing) + 1 if existing else 0
+            for rep in reps_new:
+                rep.engine.replica = entry.replica_seq
+                entry.replica_seq += 1
+            reps.extend(reps_new)
+        return reps_new
+
+    def remove_replicas(self, name, replicas, version=None):
+        """Detach replica wrappers previously returned by
+        :meth:`add_replicas` (the fleet layer's DEAD-host path). With
+        ``version=None`` EVERY version's replica list is searched — the
+        default alias may have moved since the wrappers attached, and a
+        dead worker's wrappers must detach from wherever they live, not
+        from wherever the alias points today. The engines are NOT
+        stopped — their owner (the pool) controls their lifecycle;
+        in-flight dispatches on them resolve through the normal
+        completion path. Removing the last replica of a version is
+        refused: routing must never point at an empty replica list."""
+        if not isinstance(replicas, (list, tuple, set)):
+            replicas = [replicas]
+        wanted = set(replicas)
+        removed = 0
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise MXNetError("unknown model %r" % name)
+            if version is not None:
+                _, rep_lists = self._resolve_locked(name, version)
+                rep_lists = [rep_lists]
+            else:
+                rep_lists = list(entry.versions.values())
+            for reps in rep_lists:
+                doomed = [r for r in reps if r in wanted]
+                if not doomed:
+                    continue
+                if len(doomed) >= len(reps):
+                    raise MXNetError(
+                        "remove_replicas would leave model %r with no "
+                        "replicas — keep a local floor replica (the "
+                        "autoscaler's hard-floor rule)" % name)
+                for r in doomed:
+                    reps.remove(r)
+                    removed += 1
+        return removed
 
     def unregister(self, name, version=None):
         """Remove one version (or, with ``version=None``, the whole
@@ -516,10 +890,18 @@ class ModelServer:
         now = time.monotonic()
         with self._lock:
             _, reps = self._resolve_locked(name, version)
+            # `r.available` is the fleet health gate (a SUSPECT/DEAD
+            # worker's replicas are routed around exactly like an open
+            # breaker); the forced-probe fallback still ignores it last
+            # — degraded capacity must never become a self-inflicted
+            # full outage
             avail = [r for r in reps
-                     if r not in exclude and r.breaker.available(now)]
+                     if r not in exclude and r.available
+                     and r.breaker.available(now)]
             if not avail:
-                avail = [r for r in reps if r.breaker.available(now)] \
+                avail = [r for r in reps
+                         if r.available and r.breaker.available(now)] \
+                    or [r for r in reps if r.breaker.available(now)] \
                     or list(reps)
             rep = min(avail, key=lambda r: r.inflight)
             rep.breaker.note_dispatch(now)
@@ -529,11 +911,14 @@ class ModelServer:
     def _complete(self, rep, outcome, name=None):
         """One dispatch finished on `rep`: release the in-flight slot and
         feed the breaker. `outcome`: "success" | "failure" | "shed"
-        (sheds are overload, breaker-neutral)."""
+        (sheds are overload, breaker-neutral — but they DO release a
+        half-open probe slot, see `_Breaker.on_neutral`)."""
         with self._lock:
             rep.inflight -= 1
             if outcome == "success":
                 rep.breaker.on_success()
+            elif outcome == "shed":
+                rep.breaker.on_neutral()
             elif outcome == "failure":
                 if rep.breaker.on_failure(time.monotonic()):
                     logging.warning(
@@ -551,6 +936,30 @@ class ModelServer:
             entry = self._models.get(name)
             if entry is not None and key in entry.counters:
                 entry.counters[key] += n
+
+    def _local_bucket_tail(self, name, rows):
+        """(bucket, step-tail seconds) for a request of ``rows`` rows
+        from the first LOCAL replica's program cache — the hedger's
+        per-bucket signal. Remote replicas (no local cache) are skipped;
+        (None, None) when nothing local has measured anything."""
+        try:
+            with self._lock:
+                _, reps = self._resolve_locked(name, None)
+                engines = [r.engine for r in reps]
+        except MXNetError:
+            return None, None
+        for eng in engines:
+            cache = getattr(eng, "_cache", None)
+            if cache is None:
+                continue
+            try:
+                bucket = cache.bucket_for(rows) if rows else None
+                tail = cache.step_time_tail(bucket) \
+                    if bucket is not None else None
+            except MXNetError:
+                return None, None   # rows above the top bucket
+            return bucket, tail
+        return None, None
 
     def predict(self, name, data, version=None):
         """Synchronous inference on the (model, version)'s least-loaded
@@ -617,8 +1026,27 @@ class ModelServer:
         label."""
         with self._lock:
             label, reps = self._resolve_locked(name, None)
+        # per-replica isolation: one unreachable remote replica (a
+        # SUSPECT worker whose control channel dropped) must not abort
+        # the fan-out mid-swap — the rest of the fleet still gets the
+        # new weights, the failure surfaces as a typed error AFTER the
+        # loop (no relabel), and the checkpoint poller's next attempt
+        # re-runs the whole idempotent swap
+        failures = []
         for rep in reps:
-            rep.engine.update_params(arg_params, aux_params)
+            try:
+                rep.engine.update_params(arg_params, aux_params)
+            except Exception as e:
+                failures.append("replica %s: %s: %s"
+                                % (rep.engine.replica,
+                                   type(e).__name__, e))
+        if failures:
+            raise MXNetError(
+                "rollover of %r reached %d/%d replicas — failed on: %s "
+                "(weights that DID swap stay swapped; retry re-runs the "
+                "idempotent fan-out)"
+                % (name, len(reps) - len(failures), len(reps),
+                   "; ".join(failures)))
         if version is None or version == label:
             return label
         with self._lock:
@@ -722,6 +1150,8 @@ class ModelServer:
             engines = [rep.engine for entry in self._models.values()
                        for reps in entry.versions.values()
                        for rep in reps]
+        if self._hedger is not None:
+            self._hedger.stop()
         for _thread, stop_evt in pollers:
             stop_evt.set()
         for thread, _evt in pollers:
@@ -737,11 +1167,16 @@ class ModelServer:
         door answers it as a zero-deadline control verb
         (`serving/frontdoor.py` ``("health", rid)``).
 
-        Per model: ``queue_wait_p95_ms`` / ``queue_wait_p50_ms`` (from
-        the always-on latency histograms — the scale-out signal),
+        Per model: ``queue_wait_p95_ms`` / ``queue_wait_p50_ms`` — the
+        scale-out signal, WINDOWED over the requests served since the
+        PREVIOUS ``health()`` call (a cumulative percentile would echo
+        an overload long after it ended and lag a fresh one behind the
+        process's whole history; None when the window saw no traffic) —
         ``wire_p95_ms`` when the front door serves it, ``shed_rate`` /
         request counters (the scale-up-NOW signal), live ``inflight``,
         and per-replica breaker states (capacity actually available).
+        One poller owns the window semantics: concurrent health()
+        callers split the samples between their windows.
         """
         from .. import profiler as _prof
         with self._lock:
@@ -753,8 +1188,19 @@ class ModelServer:
         models = {}
         for name, (versions, default, counters) in snapshot.items():
             lat = _prof.latency_counters(prefix="serving.%s." % name)
-            qwait = lat.get("serving.%s.queue" % name, {})
             wire = lat.get("serving.%s.wire" % name, {})
+            device = lat.get("serving.%s.device" % name, {})
+            # queue wait: WINDOWED since the previous health() poll
+            qkey = "serving.%s.queue" % name
+            qp50 = qp95 = None
+            counts = _prof.latency_histogram(qkey)
+            if counts is not None:
+                prev = self._health_prev_counts.get(qkey)
+                delta = counts if prev is None else \
+                    [c - p for c, p in zip(counts, prev)]
+                self._health_prev_counts[qkey] = counts
+                qp50 = _prof.percentile_from_counts(delta, 0.50)
+                qp95 = _prof.percentile_from_counts(delta, 0.95)
             submitted = counters.get("submitted", 0)
             reps = [rep for rep_list in versions.values()
                     for rep in rep_list]
@@ -764,16 +1210,20 @@ class ModelServer:
                 "versions": sorted(str(v) for v in versions),
                 "replicas": len(reps),
                 "replicas_available": sum(
-                    1 for b in breakers if b["state"] != "open"),
+                    1 for rep, b in zip(reps, breakers)
+                    if rep.available and b["state"] != "open"),
                 "breaker_states": [b["state"] for b in breakers],
                 "inflight": sum(rep.inflight for rep in reps),
-                "queue_wait_p50_ms": qwait.get("p50_ms"),
-                "queue_wait_p95_ms": qwait.get("p95_ms"),
+                "queue_wait_p50_ms": qp50,
+                "queue_wait_p95_ms": qp95,
                 "wire_p95_ms": wire.get("p95_ms"),
+                "device_p95_ms": device.get("p95_ms"),
                 "submitted": submitted,
                 "served": counters.get("served", 0),
                 "shed": counters.get("shed", 0),
                 "failed": counters.get("failed", 0),
+                "hedges": counters.get("hedges", 0),
+                "hedge_wins": counters.get("hedge_wins", 0),
                 "shed_rate": (round(counters.get("shed", 0)
                                     / float(submitted), 4)
                               if submitted else 0.0),

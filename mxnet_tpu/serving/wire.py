@@ -20,18 +20,34 @@ header whose length exceeds the frame cap — is a broken/misbehaving
 peer and raises :class:`FrameError` (what the front door's
 per-connection eviction counts strikes on). ``kvstore_async`` keeps its
 historical "any EOF is None" behavior with a two-line wrapper.
+
+Frame authentication (ISSUE 12): when a call supplies ``auth_key``,
+every frame's payload is prefixed with an HMAC-SHA256 tag over the
+pickled bytes, and the receive side verifies the tag BEFORE the payload
+reaches ``pickle.loads`` — a frame from a peer without the shared key
+is rejected as :class:`AuthError` while it is still inert bytes, never
+after deserialization gave it code execution. The serving tier
+(front door, client, fleet control channel) reads the shared key from
+``MXNET_SERVING_AUTH_KEY`` once at construction; the kvstore wrappers
+deliberately keep their trusted no-auth default (the dist_async hosts
+are launched as one job on one cluster network — docs/faq/serving.md
+"Trust model" records the split, and a non-pickle schema remains the
+future work for genuinely untrusted networks).
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
 import pickle
 import socket as _socket
 import struct
 
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 
-__all__ = ["FrameError", "send_msg", "recv_msg", "recv_exact",
-           "recv_msg_tick", "send_msg_stall", "TICK",
-           "DEFAULT_MAX_FRAME_BYTES"]
+__all__ = ["FrameError", "AuthError", "send_msg", "recv_msg",
+           "recv_exact", "recv_msg_tick", "send_msg_stall", "TICK",
+           "DEFAULT_MAX_FRAME_BYTES", "auth_key_from_env", "MAC_LEN",
+           "teardown"]
 
 # A corrupt or adversarial 8-byte header must not become a multi-TB
 # allocation: frames above the cap raise FrameError instead. 1 GiB
@@ -49,9 +65,67 @@ class FrameError(MXNetError):
     bytes with the wrong frame) and must be closed."""
 
 
-def send_msg(sock, obj):
-    """Pickle ``obj`` and send it as one length-prefixed frame."""
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+class AuthError(FrameError):
+    """Frame failed HMAC authentication (or arrived unauthenticated at
+    an authenticated endpoint). Raised BEFORE the payload is unpickled —
+    the whole point of the tag — and, being a FrameError, counts an
+    eviction strike at the front door."""
+
+
+#: HMAC-SHA256 digest length prefixed to every authenticated payload.
+MAC_LEN = hashlib.sha256().digest_size
+
+
+def auth_key_from_env():
+    """The serving tier's shared frame-auth key (``MXNET_SERVING_AUTH_KEY``)
+    as bytes, or None when unset/empty (auth off). Call ONCE at endpoint
+    construction — never per frame (the zero-overhead contract)."""
+    key = get_env("MXNET_SERVING_AUTH_KEY")
+    if not key:
+        return None
+    return key.encode("utf-8") if isinstance(key, str) else bytes(key)
+
+
+def normalize_auth_key(auth_key):
+    """THE constructor-time auth-key rule, shared by every serving
+    endpoint (front door, client, fleet pool, worker): ``None`` defers
+    to the env var, a str encodes to bytes, and any falsy value (empty
+    str/bytes) means auth OFF."""
+    if auth_key is None:
+        return auth_key_from_env()
+    if isinstance(auth_key, str):
+        auth_key = auth_key.encode("utf-8")
+    return auth_key or None
+
+
+def _seal(payload, auth_key):
+    if auth_key is None:
+        return payload
+    return _hmac.new(auth_key, payload, hashlib.sha256).digest() + payload
+
+
+def _open(payload, auth_key):
+    """Verify-and-strip the MAC prefix. Must run before pickle.loads —
+    an unauthenticated payload stays inert bytes."""
+    if auth_key is None:
+        return payload
+    if len(payload) < MAC_LEN:
+        raise AuthError("frame too short to carry an auth tag "
+                        "(%d bytes) — unauthenticated peer?" % len(payload))
+    mac, body = payload[:MAC_LEN], payload[MAC_LEN:]
+    want = _hmac.new(auth_key, body, hashlib.sha256).digest()
+    if not _hmac.compare_digest(mac, want):
+        raise AuthError("frame failed HMAC authentication — peer does "
+                        "not hold MXNET_SERVING_AUTH_KEY (or the frame "
+                        "was tampered with in transit)")
+    return body
+
+
+def send_msg(sock, obj, auth_key=None):
+    """Pickle ``obj`` and send it as one length-prefixed frame (HMAC-
+    prefixed when ``auth_key`` is set)."""
+    payload = _seal(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+                    auth_key)
     sock.sendall(_HEADER.pack(len(payload)) + payload)
 
 
@@ -72,13 +146,15 @@ def recv_exact(sock, n):
     return buf
 
 
-def recv_msg(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES):
+def recv_msg(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES, auth_key=None):
     """Receive one frame and unpickle it. Returns None when the peer
     closed cleanly at a frame boundary; raises :class:`FrameError` for
     a mid-frame close, an oversized length header, or a payload that
-    does not unpickle. ``max_bytes=None`` disables the frame cap (the
-    kvstore transport, whose trusted peers ship arbitrarily large
-    parameter shards and never had a cap)."""
+    does not unpickle — and :class:`AuthError` (before any unpickling)
+    when ``auth_key`` is set and the frame's HMAC does not verify.
+    ``max_bytes=None`` disables the frame cap (the kvstore transport,
+    whose trusted peers ship arbitrarily large parameter shards and
+    never had a cap)."""
     header = recv_exact(sock, _HEADER.size)
     if header is None:
         return None
@@ -90,10 +166,27 @@ def recv_msg(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES):
     payload = recv_exact(sock, n)
     if payload is None:
         raise FrameError("connection closed between header and payload")
+    payload = _open(payload, auth_key)
     try:
         return pickle.loads(payload)
     except Exception as e:
         raise FrameError("frame payload does not unpickle: %s" % e) from e
+
+
+def teardown(sock):
+    """shutdown(SHUT_RDWR) THEN close — THE socket-teardown idiom for
+    every serving transport (PR 10): a bare close neither wakes a
+    reader blocked in recv() nor promptly FINs the peer, so death
+    detection would hang on the other side. One definition, shared by
+    the client pool, the fleet pool, and the worker."""
+    try:
+        sock.shutdown(_socket.SHUT_RDWR)
+    except OSError:
+        pass  # tpulint: allow-swallowed-exception peer already gone; shutdown is best-effort
+    try:
+        sock.close()
+    except OSError:
+        pass  # tpulint: allow-swallowed-exception socket already dead; close is best-effort hygiene
 
 
 #: sentinel returned by :func:`recv_msg_tick` for a poll timeout that
@@ -103,7 +196,7 @@ TICK = object()
 
 
 def recv_msg_tick(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES,
-                  stall_timeout=30.0):
+                  stall_timeout=30.0, auth_key=None):
     """`recv_msg` for a socket carrying a short poll timeout (the
     front-door reader pattern: block briefly, check a stop event, block
     again).
@@ -155,21 +248,22 @@ def recv_msg_tick(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES,
         raise FrameError("frame length %d exceeds the %d-byte cap "
                          "(corrupt header or misbehaving peer)"
                          % (n, max_bytes))
-    payload = read_n(n)
+    payload = _open(read_n(n), auth_key)
     try:
         return pickle.loads(payload)
     except Exception as e:
         raise FrameError("frame payload does not unpickle: %s" % e) from e
 
 
-def send_msg_stall(sock, obj, stall_timeout=30.0):
+def send_msg_stall(sock, obj, stall_timeout=30.0, auth_key=None):
     """`send_msg` for a socket carrying a short poll timeout: `sendall`
     raising mid-send loses how much went out, so a big reply to a
     backpressured (but healthy) client would look like a dead peer.
     This send loop keeps pushing while the peer makes ANY progress and
     raises :class:`FrameError` only after ``stall_timeout`` of
     consecutive zero-progress passes."""
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = _seal(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+                    auth_key)
     data = _HEADER.pack(len(payload)) + payload
     view = memoryview(data)
     tick_s = sock.gettimeout() or 0.0

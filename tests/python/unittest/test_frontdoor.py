@@ -870,7 +870,13 @@ def test_send_failure_on_shared_conn_recovers_other_inflight(monkeypatch):
     resolve-by-id protocol."""
     srv = _server(async_worker=False)
     fd = _frontdoor(srv)
-    cli = ServingClient("127.0.0.1", fd.port, pool_size=1, resubmits=1)
+    # resubmits=0: B must NOT retry on a fresh connection — its retry
+    # would break the very connection A's resolve-by-id recovery just
+    # acquired (the control round-trip dies mid-flight and A
+    # typed-fails instead of recovering its real result; a rare but
+    # real flake). B still exhausts its (zero) resubmit budget, which
+    # is all this test needs from B.
+    cli = ServingClient("127.0.0.1", fd.port, pool_size=1, resubmits=0)
     x = np.full((1, 6), 4.0, np.float32)
     futA = cli.predict_async({"data": x}, model="fd")
     deadline = time.monotonic() + 10.0

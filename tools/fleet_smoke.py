@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""CI fleet smoke (`ci/run.py fleet_smoke` stage, ISSUE 12).
+
+Fast, non-slow gate over the cross-HOST serving tier:
+  * a REAL worker OS process joins the gateway's FleetPool (warmup +
+    half-open probe) and serves predictions BIT-IDENTICAL to the
+    gateway's local replica;
+  * worker SIGKILL mid-trace loses NOTHING: client-side
+    served + shed + failed == submitted with zero non-typed failures,
+    server-side submitted == served + shed + failed, requests reroute
+    (dispatch_retries > 0 or all served locally), and the fleet marks
+    the host SUSPECT/DEAD;
+  * auth gate: with a shared MXNET_SERVING_AUTH_KEY a tampered frame is
+    rejected BEFORE unpickling and counted (auth_rejected), while the
+    keyed round trip stays bit-exact;
+  * zero-overhead: with no fleet/hedge env set, ModelServer builds no
+    hedger and fault hooks stay disabled no-ops.
+
+Prints one JSON summary line; non-zero exit on any violated contract.
+The companion lint half of the stage (tpulint over mxnet_tpu/serving)
+runs as a second command in ci/run.py.
+"""
+import json
+import os
+import pickle
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.resilience import faults  # noqa: E402
+from mxnet_tpu.serving import (ModelServer, ServingFrontDoor,  # noqa: E402
+                               ServingClient, FleetPool,
+                               DeadlineExceeded)
+from mxnet_tpu.serving import wire  # noqa: E402
+
+# the worker bootstrap AND the matching gateway net/params come from
+# ONE shared fixture (tools/fleet_worker_fixture.py) — same seed, same
+# names, which is what makes the cross-process bit-identity gate below
+# meaningful
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import fleet_worker_fixture as fx  # noqa: E402
+
+
+def _spawn_worker(port, wid):
+    return subprocess.Popen(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "fleet_worker_fixture.py"),
+         str(port), wid])
+
+
+def _wait(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, "timed out: %s" % what
+        time.sleep(0.05)
+
+
+def main():
+    summary = {}
+    rng = np.random.RandomState(0)
+    sym = fx.net()
+    params = fx.params(sym)
+    model = fx.MODEL
+
+    # --- zero-overhead contract (before any fleet env is honored) -----
+    for var in ("MXNET_SERVING_HEDGE_MS", "MXNET_SERVING_AUTH_KEY"):
+        assert not os.environ.get(var), \
+            "%s leaked into the smoke environment" % var
+    probe_srv = ModelServer()
+    assert probe_srv._hedger is None, "hedger built with no hedge env"
+    assert not faults.enabled(), "fault injection on with no spec"
+    faults.fault_point("fleet.dispatch", worker="none")
+    faults.fault_point("fleet.heartbeat", worker="none", side="worker")
+    faults.fault_point("fleet.join", worker="none")
+    summary["zero_overhead"] = True
+
+    # --- gateway + one REAL worker process ----------------------------
+    gw = ModelServer(dispatch_retries=3)
+    gw.register(model, sym, params, ctx=mx.cpu(), buckets=(1, 4),
+                max_delay_ms=0.5, warmup_shapes={"data": (4, 6)})
+    pool = FleetPool(gw, port=0, heartbeat_s=0.25,
+                     connect_deadline_s=1.0).start()
+    proc = _spawn_worker(pool.port, "smoke-w1")
+    try:
+        _wait(lambda: pool.stats()["workers_alive"] >= 1, 90.0,
+              "worker join")
+        x = np.arange(24, dtype=np.float32).reshape(4, 6) / 24.0
+        want = np.asarray(gw.predict(model, {"data": x})[0])
+        # bit-identity THROUGH the remote worker, explicitly
+        handle = pool._workers["smoke-w1"]
+        rep = next(iter(handle.replicas.values()))[0]
+        got = np.asarray(rep.engine.predict_async(
+            {"data": x}).result_wait(60.0)[0])
+        assert np.array_equal(got, want), \
+            "remote worker prediction diverged from local replica"
+        summary["remote_bit_identical"] = True
+
+        # --- worker-kill-loses-nothing gate ---------------------------
+        futs = []
+        n_req = 240
+        t_kill = None
+        for i in range(n_req):
+            if i == 80:
+                proc.send_signal(signal.SIGKILL)
+                t_kill = time.monotonic()
+            futs.append(gw.predict_async(model, {"data": x},
+                                         deadline_ms=8000.0))
+        served = shed = failed = 0
+        retried = 0
+        t_recover = None
+        errors = []
+        for f in futs:
+            try:
+                out = f.result_wait(60.0)
+                assert np.array_equal(np.asarray(out[0]), want)
+                served += 1
+                if f.attempts > 1:
+                    retried += 1
+                    if t_recover is None or f.t_done < t_recover:
+                        t_recover = f.t_done
+            except DeadlineExceeded:
+                shed += 1
+            except Exception as e:
+                failed += 1
+                if len(errors) < 4:
+                    errors.append(str(e)[:150])
+        assert served + shed + failed == n_req, "client accounting broken"
+        assert failed == 0, "worker kill produced non-typed failures: %s" \
+            % errors
+        c = gw.stats()[model]["counters"]
+        assert c["submitted"] == c["served"] + c["shed"] + c["failed"], c
+        _wait(lambda: pool.workers()["smoke-w1"]["state"]
+              in ("suspect", "dead"), 20.0, "death detection")
+        summary["kill"] = {
+            "submitted": n_req, "served": served, "shed": shed,
+            "rerouted": retried,
+            "recovery_ms": (round((t_recover - t_kill) * 1e3, 1)
+                            if t_recover and t_kill else None),
+            "worker_state": pool.workers()["smoke-w1"]["state"]}
+    finally:
+        pool.stop()
+        gw.stop()
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=15)
+
+    # --- auth gate: tampered frame rejected before unpickling ---------
+    key = "smoke-auth-key"
+    asrv = ModelServer()
+    asrv.register(model, sym, params, ctx=mx.cpu(), buckets=(1, 4),
+                  max_delay_ms=0.5, warmup_shapes={"data": (4, 6)})
+    fd = ServingFrontDoor(asrv, port=0, auth_key=key).start()
+    try:
+        x1 = rng.normal(0, 1, (1, 6)).astype(np.float32)
+        cli = ServingClient("127.0.0.1", fd.port, auth_key=key)
+        keyed = np.asarray(cli.predict({"data": x1}, model=model,
+                                       timeout=60.0)[0])
+        want1 = np.asarray(asrv.predict(model, {"data": x1})[0])
+        assert np.array_equal(keyed, want1), "keyed round trip diverged"
+        ks = socket.create_connection(("127.0.0.1", fd.port),
+                                      timeout=30.0)
+        wire.recv_msg(ks, auth_key=key.encode())   # hello
+        sealed = wire._seal(pickle.dumps(("ping", "r1")), key.encode())
+        tampered = bytes([sealed[0] ^ 0xFF]) + sealed[1:]
+        ks.sendall(struct.pack("<Q", len(tampered)) + tampered)
+        _wait(lambda: fd.stats()["auth_rejected"] >= 1, 20.0,
+              "auth rejection")
+        ks.close()
+        cli.close()
+        summary["auth"] = {"keyed_bit_identical": True,
+                           "tampered_rejected":
+                               fd.stats()["auth_rejected"]}
+    finally:
+        fd.drain(timeout=15.0)
+        asrv.stop()
+        probe_srv.stop()
+
+    print(json.dumps(summary), flush=True)
+    print("fleet_smoke OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

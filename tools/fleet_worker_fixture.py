@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Shared fleet-worker fixture — ONE definition of the tiny-MLP worker
+bootstrap used by tests/python/unittest/test_fleet.py,
+tools/fleet_smoke.py, and bench.py's `fleet` phase (ISSUE 12).
+
+Three call shapes:
+  * run directly as a worker process:
+        python tools/fleet_worker_fixture.py <gateway_port> <worker_id>
+  * as the `LocalProcessLauncher` builder spec (PYTHONPATH must include
+    this directory):  --builder fleet_worker_fixture:build
+  * imported by the gateway side for the MATCHING net/params
+    (same seed, same names — what makes cross-process bit-identity
+    checks meaningful):  fx.net(), fx.params(sym)
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+MODEL = "fl"
+INDIM = 6
+DATA_SHAPE = (4, INDIM)
+
+
+def net(prefix=MODEL, hidden=8, classes=3):
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=hidden,
+                                name=prefix + "_fc0")
+    out = mx.sym.Activation(out, act_type="relu")
+    out = mx.sym.FullyConnected(out, num_hidden=classes,
+                                name=prefix + "_fc1")
+    return mx.sym.SoftmaxOutput(out, name="softmax")
+
+
+def params(sym, seed=0, scale=0.5):
+    rng = np.random.RandomState(seed)
+    shapes, _, _ = sym.infer_shape(data=DATA_SHAPE)
+    return {n: mx.nd.array(rng.normal(0, scale, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+def build(model=MODEL, ctx=None):
+    """A populated, WARMED ModelServer (the fleet admission contract) —
+    the `--builder` entry point."""
+    from mxnet_tpu.serving import ModelServer
+    sym = net(model)
+    srv = ModelServer()
+    srv.register(model, sym, params(sym), ctx=ctx or mx.cpu(),
+                 buckets=(1, 4), max_delay_ms=0.5,
+                 warmup_shapes={"data": DATA_SHAPE})
+    return srv
+
+
+def run(gateway_port, worker_id, heartbeat_s=0.25):
+    """The worker-process body: build, join, serve until drained."""
+    from mxnet_tpu.serving import ReplicaWorker
+    worker = ReplicaWorker(("127.0.0.1", int(gateway_port)), build(),
+                           port=0, worker_id=worker_id,
+                           heartbeat_s=heartbeat_s).start()
+    worker._frontdoor.install_sigterm_drain()
+    print("WORKER_READY", worker.worker_id, flush=True)
+    worker.wait()
+    worker.stop()
+
+
+if __name__ == "__main__":
+    run(sys.argv[1], sys.argv[2])
