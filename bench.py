@@ -1411,7 +1411,11 @@ import numpy as np
 from mxnet_tpu.serving import ServingClient
 port, seed, n_req, rows = (int(sys.argv[1]), int(sys.argv[2]),
                            int(sys.argv[3]), int(sys.argv[4]))
-cli = ServingClient("127.0.0.1", port)
+# optional 5th arg: wire codec mode — "safe" (default) or "pickle"
+# (the previous protocol), so the phase can bank the safe codec's
+# per-request cost against the pickle baseline on the SAME gateway
+mode = sys.argv[5] if len(sys.argv) > 5 else "safe"
+cli = ServingClient("127.0.0.1", port, wire_mode=mode)
 rng = np.random.RandomState(seed)
 x = rng.uniform(-1, 1, (rows, %(indim)d)).astype(np.float32)
 # warm the connection + program path outside the timed window
@@ -1499,21 +1503,68 @@ def _phase_frontdoor():
     n_clients = 2
     n_req = bucket * 12
     script = _FRONTDOOR_CLIENT % {"root": _HERE, "indim": indim}
-    tic = time.monotonic()
-    procs = [subprocess.Popen(
-        [sys.executable, "-c", script, str(fd.port), str(seed), str(n_req),
-         "1"], stdout=subprocess.PIPE, text=True)
-        for seed in range(1, n_clients + 1)]
-    reports = []
-    for p in procs:
-        out_s, _ = p.communicate(timeout=PHASE_BUDGET_S["frontdoor"])
-        if p.returncode != 0:
-            raise RuntimeError("frontdoor bench client failed: %s"
-                               % out_s[-500:])
-        reports.append(json.loads(out_s.strip().splitlines()[-1]))
-    wall = time.monotonic() - tic
+
+    def _client_pass(mode):
+        tic = time.monotonic()
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", script, str(fd.port), str(seed),
+             str(n_req), "1", mode], stdout=subprocess.PIPE, text=True)
+            for seed in range(1, n_clients + 1)]
+        reports = []
+        for p in procs:
+            out_s, _ = p.communicate(timeout=PHASE_BUDGET_S["frontdoor"])
+            if p.returncode != 0:
+                raise RuntimeError("frontdoor bench client failed: %s"
+                                   % out_s[-500:])
+            reports.append(json.loads(out_s.strip().splitlines()[-1]))
+        return reports, time.monotonic() - tic
+
+    reports, wall = _client_pass("safe")
     total_req = sum(r["n"] for r in reports)
     wire_rps = total_req / wall
+    # same trace over the PREVIOUS protocol (pickle wire) on the same
+    # gateway: the per-request p50/p99 added-wire-latency delta is the
+    # safe codec's measured cost — banked, not guessed (ISSUE 13)
+    reports_pickle, _ = _client_pass("pickle")
+    codec_delta = {}
+    for q in ("added_p50_ms", "added_p99_ms"):
+        safe_q = max(r[q] for r in reports)
+        pick_q = max(r[q] for r in reports_pickle)
+        codec_delta["safe_" + q] = round(safe_q, 3)
+        codec_delta["pickle_" + q] = round(pick_q, 3)
+        codec_delta["delta_" + q] = round(safe_q - pick_q, 3)
+
+    # --- codec micro-bench: encode+decode of one real request/reply ---
+    from mxnet_tpu.serving import wire as _wire_mod
+    spec_frame = ("predict", "c1-1",
+                  {"model": "frontdoor", "version": None,
+                   "arrays": {"data": xb}, "deadline_ms": 200.0,
+                   "priority": 0, "trace": "bench-codec",
+                   "t_send": time.time()})
+    reply_frame = ("served", "c1-1",
+                   [np.zeros((bucket, 10), np.float32)],
+                   {"trace": "bench-codec", "wire_ms": 0.5,
+                    "queue_ms": 2.0, "device_ms": 10.0, "total_ms": 12.5})
+    codec_us = {}
+    for codec_name in ("safe", "pickle"):
+        enc_us, dec_us = [], []
+        for frame in (spec_frame, reply_frame):
+            payload = _wire_mod.encode_payload(frame, codec=codec_name)
+            for _ in range(300):
+                t0 = time.perf_counter_ns()
+                _wire_mod.encode_payload(frame, codec=codec_name)
+                t1 = time.perf_counter_ns()
+                _wire_mod.decode_payload(payload)
+                t2 = time.perf_counter_ns()
+                enc_us.append((t1 - t0) / 1e3)
+                dec_us.append((t2 - t1) / 1e3)
+        enc_us.sort()
+        dec_us.sort()
+        codec_us[codec_name] = {
+            "encode_p50_us": round(enc_us[len(enc_us) // 2], 2),
+            "decode_p50_us": round(dec_us[len(dec_us) // 2], 2),
+            "encode_p99_us": round(enc_us[int(0.99 * len(enc_us))], 2),
+            "decode_p99_us": round(dec_us[int(0.99 * len(dec_us))], 2)}
 
     # --- 2x open-loop overload ACROSS the socket ----------------------
     cli = ServingClient("127.0.0.1", fd.port, pool_size=2)
@@ -1591,6 +1642,8 @@ def _phase_frontdoor():
             r["added_p99_ms"] for r in reports), 3),
         "frontdoor_client_p50_ms": round(max(
             r["lat_p50_ms"] for r in reports), 3),
+        "frontdoor_codec_wire_ms": codec_delta,
+        "frontdoor_codec_us": codec_us,
         "frontdoor_capacity_rps": round(capacity_rps, 1),
         "frontdoor_sla_ms": round(sla_ms, 2),
         "frontdoor_overload_factor": round(

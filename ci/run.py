@@ -122,6 +122,26 @@ def stage_frontdoor_smoke(_):
          os.path.join("mxnet_tpu", "serving")], cwd=ROOT)
 
 
+def stage_wire_fuzz_smoke(_):
+    """Non-slow untrusted-wire gate (ISSUE 13): a fuzz corpus captured
+    from REAL frontdoor+fleet traffic feeds >= 10k seeded mutations
+    through the safe decoder — only typed FrameError, allocation
+    bounded by the caps; a previous-protocol subprocess (old hello, old
+    pickle codec) is served bit-identically by the safe-default gateway
+    (rolling upgrade); a fuzz-spraying peer is evicted with exact
+    accounting for everyone else — then tpulint (incl. TPL107
+    wire-unpickle) over the serving modules."""
+    rc = subprocess.call(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "wire_fuzz_smoke.py")],
+        env=_env_cpu_mesh(1), cwd=ROOT)
+    if rc != 0:
+        return rc
+    return subprocess.call(
+        [sys.executable, "-m", "mxnet_tpu.analysis.lint",
+         os.path.join("mxnet_tpu", "serving")], cwd=ROOT)
+
+
 def stage_fleet_smoke(_):
     """Non-slow cross-host serving gate (ISSUE 12): a REAL worker OS
     process joins the fleet (warmup + half-open probe) and serves
@@ -178,6 +198,7 @@ STAGES = [
     ("multichip", stage_multichip),
     ("serving_smoke", stage_serving_smoke),
     ("frontdoor_smoke", stage_frontdoor_smoke),
+    ("wire_fuzz_smoke", stage_wire_fuzz_smoke),
     ("fleet_smoke", stage_fleet_smoke),
     ("chaos_smoke", stage_chaos_smoke),
     ("bench_smoke", stage_bench_smoke),

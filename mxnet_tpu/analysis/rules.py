@@ -11,6 +11,9 @@ hand (docs/faq/analysis.md has the catalog with examples):
 - TPL105 ``env-registry``   MXNET_* env read missing from docs/faq/env_var.md
 - TPL106 ``swallowed-exception`` except handler that only passes/logs in
   the resilience-critical set (serving|checkpoint|parallel|io_device.py)
+- TPL107 ``wire-unpickle`` pickle.loads/pickle.load in the serving tier
+  outside the ``wire.py`` codec seam — bytes there are network-sourced
+  and unpickling them is code execution (ISSUE 13's safe-wire contract)
 
 All rules are static heuristics over the AST — they cannot prove an
 expression is a device array, so genuinely-host uses are silenced with a
@@ -24,7 +27,8 @@ import re
 
 from .findings import Finding, Severity, apply_pragmas
 
-__all__ = ["lint_source", "is_hot_path", "is_swallow_scope", "RULES"]
+__all__ = ["lint_source", "is_hot_path", "is_swallow_scope",
+           "is_unpickle_scope", "RULES"]
 
 RULES = {
     "TPL000": ("pragma", Severity.ERROR,
@@ -46,6 +50,10 @@ RULES = {
     "TPL106": ("swallowed-exception", Severity.ERROR,
                "exception swallowed (pass / log-and-continue with no "
                "re-raise or counter) in a resilience-critical module"),
+    "TPL107": ("wire-unpickle", Severity.ERROR,
+               "pickle.loads/pickle.load in mxnet_tpu/serving/ outside "
+               "the wire.py codec seam — serving bytes are "
+               "network-sourced and unpickling them is code execution"),
 }
 
 # directories whose files are fused/serving hot paths (ISSUE 5): host
@@ -70,6 +78,18 @@ def is_swallow_scope(path):
     if parts and parts[-1] in _SWALLOW_FILES:
         return True
     return any(p in _SWALLOW_PARTS for p in parts[:-1])
+
+
+# TPL107 scope: every serving module EXCEPT the wire.py codec seam —
+# the one place a (compat-gated, documented) pickle.loads may live
+_UNPICKLE_SEAM_FILES = {"wire.py"}
+
+
+def is_unpickle_scope(path):
+    parts = str(path).replace("\\", "/").split("/")
+    if not parts or parts[-1] in _UNPICKLE_SEAM_FILES:
+        return False
+    return "serving" in parts[:-1]
 
 
 def _is_inert_stmt(stmt):
@@ -146,10 +166,14 @@ def _str_arg(call, index=0):
 
 
 class _Analyzer(ast.NodeVisitor):
-    def __init__(self, path, hot, registry_text, swallow=False):
+    def __init__(self, path, hot, registry_text, swallow=False,
+                 unpickle=False):
         self.path = path
         self.hot = hot
         self.swallow = swallow
+        self.unpickle = unpickle
+        self.pickle_aliases = set()
+        self.pickle_fn_names = set()
         self.registry = registry_text
         self.findings = []
         self.np_aliases = set()
@@ -179,6 +203,8 @@ class _Analyzer(ast.NodeVisitor):
                 self.jnp_aliases.add(asname)
             elif name == "jax":
                 self.jax_aliases.add(asname)
+            elif name in ("pickle", "cPickle", "_pickle"):
+                self.pickle_aliases.add(asname)
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node):
@@ -187,6 +213,10 @@ class _Analyzer(ast.NodeVisitor):
             for a in node.names:
                 if a.name == "numpy":
                     self.jnp_aliases.add(a.asname or "numpy")
+        if node.module in ("pickle", "cPickle", "_pickle"):
+            for a in node.names:
+                if a.name in ("loads", "load"):
+                    self.pickle_fn_names.add(a.asname or a.name)
         self.generic_visit(node)
 
     # -------------------------------------------------- scope tracking
@@ -339,6 +369,24 @@ class _Analyzer(ast.NodeVisitor):
                            "%s(...) under a held lock serializes device "
                            "dispatch/compile behind the lock" % what)
 
+        # ---- TPL107 unpickling network-sourced bytes in serving/
+        if self.unpickle:
+            hit = False
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in ("loads", "load") \
+                    and _root_name(func.value) in self.pickle_aliases:
+                hit = True
+            elif isinstance(func, ast.Name) \
+                    and func.id in self.pickle_fn_names:
+                hit = True
+            if hit:
+                self._emit("TPL107", node,
+                           "pickle deserialization in the serving tier: "
+                           "bytes here are network-sourced and "
+                           "pickle.load(s) is code execution — route "
+                           "through the wire.py codec seam (or pragma "
+                           "with the reason the bytes are trusted)")
+
         # ---- TPL105 env registry
         var = self._env_read_var(node)
         if var is not None and var.startswith("MXNET"):
@@ -427,18 +475,21 @@ class _Analyzer(ast.NodeVisitor):
 
 
 def lint_source(source, path="<string>", hot=None, registry_text=None,
-                swallow=None):
+                swallow=None, unpickle=None):
     """Lint one file's source; returns findings with pragmas applied."""
     if hot is None:
         hot = is_hot_path(path)
     if swallow is None:
         swallow = is_swallow_scope(path)
+    if unpickle is None:
+        unpickle = is_unpickle_scope(path)
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
         return [Finding("TPL001", "parse", Severity.ERROR,
                         "syntax error: %s" % e, path, e.lineno or 0)]
-    analyzer = _Analyzer(path, hot, registry_text, swallow=swallow)
+    analyzer = _Analyzer(path, hot, registry_text, swallow=swallow,
+                         unpickle=unpickle)
     analyzer.visit(tree)
     findings = analyzer.finish()
     findings += apply_pragmas(findings, source, path)

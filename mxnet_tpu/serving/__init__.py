@@ -30,6 +30,15 @@ duplicates straggler dispatches (`MXNET_SERVING_HEDGE_MS`), and
 `Autoscaler` polls `health()` to drive a pluggable worker launcher.
 Optional HMAC frame auth: ``MXNET_SERVING_AUTH_KEY``.
 
+Untrusted-network wire (ISSUE 13): every serving socket defaults to the
+safe NON-EXECUTABLE codec (`serving/codec.py`,
+``MXNET_SERVING_WIRE=safe`` — tagged plain-data encodings, allowlisted
+array dtypes, every cap enforced before allocation), with per-connection
+protocol/codec negotiation and rolling-upgrade tolerance for
+previous-protocol pickle peers (``MXNET_SERVING_WIRE_COMPAT``);
+`serving/wire_fuzz.py` + ``ci/run.py wire_fuzz_smoke`` keep the decoder
+total over seeded mutational fuzz.
+
     from mxnet_tpu.serving import InferenceEngine, ModelServer
 """
 from .program_cache import BucketedProgramCache, DEFAULT_BUCKETS, bucket_for

@@ -167,12 +167,14 @@ class _ClientConn:
     """One pooled connection: socket + reply-demultiplexing reader."""
 
     __slots__ = ("client", "sock", "conn_id", "seq", "send_lock",
-                 "pending", "pending_lock", "alive", "reader", "stop_evt")
+                 "pending", "pending_lock", "alive", "reader", "stop_evt",
+                 "codec")
 
-    def __init__(self, client, sock, conn_id):
+    def __init__(self, client, sock, conn_id, codec=_wire.CODEC_PICKLE):
         self.client = client
         self.sock = sock
         self.conn_id = conn_id
+        self.codec = codec          # negotiated on THIS connection
         self.seq = 0
         self.send_lock = threading.Lock()
         self.pending = {}       # rid -> ClientRequest (or control future)
@@ -198,7 +200,9 @@ class _ClientConn:
         the resubmit-vs-resolve decision)."""
         with self.send_lock:
             _wire.send_msg(self.sock, frame,
-                           auth_key=self.client._auth_key)
+                           auth_key=self.client._auth_key,
+                           codec=self.codec,
+                           limits=self.client._codec_limits)
 
     def register(self, rid, fut):
         with self.pending_lock:
@@ -234,9 +238,13 @@ class _ClientConn:
             try:
                 # tick-aware: an idle-timeout before any frame byte just
                 # re-checks stop_evt; a timeout INSIDE a frame is a
-                # stalled-peer FrameError, never a silent desync
+                # stalled-peer FrameError, never a silent desync. A
+                # safe-negotiated connection refuses pickle replies —
+                # the client never unpickles gateway bytes either.
                 msg = _wire.recv_msg_tick(
-                    self.sock, auth_key=self.client._auth_key)
+                    self.sock, auth_key=self.client._auth_key,
+                    allow_pickle=self.codec == _wire.CODEC_PICKLE,
+                    limits=self.client._codec_limits)
             except (_wire.FrameError, OSError):
                 msg = None
             if msg is _wire.TICK:
@@ -320,8 +328,18 @@ class ServingClient:
     """
 
     def __init__(self, host="127.0.0.1", port=None, pool_size=1,
-                 connect_deadline_s=30.0, resubmits=2, auth_key=None):
+                 connect_deadline_s=30.0, resubmits=2, auth_key=None,
+                 wire_mode=None):
         self._auth_key = _wire.normalize_auth_key(auth_key)
+        # wire codec, read ONCE (zero-overhead contract).
+        # "safe" (default): send a proto-2 hello, skip the gateway's
+        # legacy pickle bootstrap UNDECODED, and require a safe
+        # hello_ack — this client never unpickles network bytes.
+        # "pickle": the previous protocol byte-for-byte (what a v-old
+        # client is; also the escape hatch against a v-old gateway).
+        self._wire_mode = _wire.resolve_wire_mode(wire_mode)
+        from . import codec as _codec
+        self._codec_limits = _codec.Limits()
         self._host = host
         self._port = int(port) if port is not None else int(get_env(
             "MXNET_SERVING_PORT", DEFAULT_PORT, int))
@@ -344,13 +362,76 @@ class ServingClient:
         sock = self._connect_retry.call(
             socket.create_connection, (self._host, self._port),
             timeout=300.0)
+        try:
+            if self._wire_mode == _wire.CODEC_PICKLE:
+                conn_id, codec = self._handshake_legacy(sock)
+            else:
+                conn_id, codec = self._handshake_safe(sock)
+        except BaseException:
+            _wire.teardown(sock)
+            raise
+        return _ClientConn(self, sock, conn_id, codec=codec)
+
+    def _handshake_legacy(self, sock):
+        """Protocol 1, byte-for-byte: read the pickle hello, speak
+        pickle. What a previous-version client does — kept as the
+        explicit escape hatch (``MXNET_SERVING_WIRE=pickle``) and as
+        the rolling-upgrade test double."""
         hello = _wire.recv_msg(sock, auth_key=self._auth_key)
         if not (isinstance(hello, tuple) and hello
                 and hello[0] == "hello"):
-            sock.close()
             raise MXNetError("front door handshake failed: expected "
                              "hello, got %r" % (hello,))
-        return _ClientConn(self, sock, int(hello[1]))
+        return int(hello[1]), _wire.CODEC_PICKLE
+
+    def _handshake_safe(self, sock):
+        """Protocol 2: offer (protos, codecs) in a safe-codec hello and
+        adopt the gateway's pick from the hello_ack. The gateway's
+        legacy bootstrap hello (pickle, sent first for v-old clients)
+        is SKIPPED by magic-sniff without ever being unpickled; the
+        hello_ack re-states the conn id. Unknown ack keys are ignored
+        (forward compat)."""
+        _wire.send_msg(
+            sock, ("hello", {"protos": list(_wire.SUPPORTED_PROTOS),
+                             "codecs": [_wire.CODEC_SAFE],
+                             "lib": "mxnet_tpu"}),
+            auth_key=self._auth_key, codec=_wire.CODEC_SAFE,
+            limits=self._codec_limits)
+        prev_timeout = sock.gettimeout()
+        sock.settimeout(min(10.0, self._connect_retry.deadline_s or 10.0))
+        try:
+            for _ in range(4):          # bounded pre-ack frame skip
+                try:
+                    payload = _wire.recv_payload(sock,
+                                                 auth_key=self._auth_key)
+                except socket.timeout:
+                    raise MXNetError(
+                        "gateway did not answer the safe-wire handshake "
+                        "— previous-protocol gateway? (set "
+                        "MXNET_SERVING_WIRE=pickle to speak proto 1)")
+                if payload is None:
+                    raise MXNetError("gateway hung up during the "
+                                     "safe-wire handshake")
+                from . import codec as _codec
+                if not _codec.sniff(payload):
+                    continue            # the legacy bootstrap hello: skip
+                msg = _codec.decode(payload, self._codec_limits)
+                break
+            else:
+                raise MXNetError("no hello_ack within the handshake "
+                                 "frame budget")
+        finally:
+            sock.settimeout(prev_timeout)
+        if isinstance(msg, tuple) and msg and msg[0] == "hello_reject":
+            raise MXNetError("gateway refused the wire handshake: %s"
+                             % (msg[2] if len(msg) > 2 else msg,))
+        if not (isinstance(msg, tuple) and len(msg) >= 3
+                and msg[0] == "hello_ack"):
+            raise MXNetError("front door handshake failed: expected "
+                             "hello_ack, got %r" % (msg,))
+        info = msg[2] if isinstance(msg[2], dict) else {}
+        codec = str(info.get("codec") or _wire.CODEC_SAFE)
+        return int(msg[1]), codec
 
     def _acquire(self):
         """Least-loaded live pooled connection, growing the pool lazily

@@ -63,9 +63,17 @@ Operational surface (the repo's contract for a subsystem):
   kills because outcomes are counted when the FUTURE resolves, not when
   the reply is delivered — an orphaned result is still a served request.
 
-Trust model: the wire is pickle (code execution). Bind 127.0.0.1 unless
-the cluster network is trusted (``MXNET_SERVING_FRONTDOOR_BIND``), the
-same rule the dist_async transport ships with.
+Trust model (ISSUE 13, docs/faq/serving.md): the wire defaults to the
+safe non-executable codec (``MXNET_SERVING_WIRE=safe`` —
+``serving/codec.py``: tagged plain-data encodings with every resource
+cap enforced before allocation), negotiated per connection via hello
+frames (proto 2). Previous-protocol pickle peers keep being served
+while ``MXNET_SERVING_WIRE_COMPAT`` is on (rolling upgrade); switch it
+off post-migration and the gateway never runs ``pickle.loads`` on
+network bytes. HMAC auth (``MXNET_SERVING_AUTH_KEY``) composes in
+front of either codec: MAC verified first, then decode. Bind
+127.0.0.1 unless the network is trusted
+(``MXNET_SERVING_FRONTDOOR_BIND``).
 """
 from __future__ import annotations
 
@@ -106,7 +114,8 @@ class _Conn:
     mid-frame)."""
 
     __slots__ = ("sock", "peer", "conn_id", "send_q", "stop_evt",
-                 "alive", "reader", "writer", "sent_ring")
+                 "alive", "reader", "writer", "sent_ring", "codec",
+                 "proto")
 
     def __init__(self, sock, peer, conn_id):
         self.sock = sock
@@ -117,6 +126,12 @@ class _Conn:
         self.alive = True
         self.reader = None
         self.writer = None
+        # wire codec for THIS connection: None until the first frame
+        # decides it — a ("hello", offer) negotiates (proto 2), any
+        # other first frame marks a previous-protocol pickle peer
+        # (proto 1, rolling-upgrade tolerance)
+        self.codec = None
+        self.proto = 1
         import collections
         self.sent_ring = collections.deque(maxlen=_SENT_RING)
 
@@ -139,7 +154,7 @@ class ServingFrontDoor:
         The in-process serving tier every request submits into.
     host : str, optional
         Listen interface (default ``MXNET_SERVING_FRONTDOOR_BIND``,
-        127.0.0.1 — pickle transport, trusted networks only).
+        127.0.0.1 — see the trust model in docs/faq/serving.md).
     port : int, optional
         Listen port (default ``MXNET_SERVING_PORT``, 9611). Pass 0 for
         an OS-assigned port; :attr:`port` reports the bound value after
@@ -158,9 +173,19 @@ class ServingFrontDoor:
 
     def __init__(self, server, host=None, port=None, backlog=16,
                  evict_threshold=None, evict_cooldown_ms=None,
-                 orphan_ttl_s=None, max_frame_mb=None, auth_key=None):
+                 orphan_ttl_s=None, max_frame_mb=None, auth_key=None,
+                 wire_mode=None, wire_compat=None):
         self._server = server
         self._auth_key = _wire.normalize_auth_key(auth_key)
+        # wire codec policy, read ONCE here (zero-overhead contract):
+        # mode governs what this gateway PREFERS to speak; compat is the
+        # rolling-upgrade tolerance — whether previous-protocol pickle
+        # peers are still admitted (docs/faq/serving.md "Trust model")
+        self._wire_mode = _wire.resolve_wire_mode(wire_mode)
+        self._wire_compat = _wire.wire_compat_from_env() \
+            if wire_compat is None else bool(wire_compat)
+        from . import codec as _codec
+        self._codec_limits = _codec.Limits()
         self._host = host if host is not None else get_env(
             "MXNET_SERVING_FRONTDOOR_BIND", "127.0.0.1")
         self.port = int(port) if port is not None else int(get_env(
@@ -204,7 +229,9 @@ class ServingFrontDoor:
             "frames": 0, "submitted": 0, "served": 0, "shed": 0,
             "failed": 0, "wire_shed": 0, "refused_draining": 0,
             "orphaned": 0, "orphan_resolved": 0, "orphan_expired": 0,
-            "control": 0, "auth_rejected": 0}
+            "control": 0, "auth_rejected": 0,
+            "negotiated_safe": 0, "negotiated_pickle": 0,
+            "legacy_peers": 0, "hello_rejected": 0}
         self._prev_sigterm = None
 
     # ------------------------------------------------------------------
@@ -370,9 +397,20 @@ class ServingFrontDoor:
             return
         sock.settimeout(0.5)
         conn = _Conn(sock, peer, conn_id)
-        # hello before the reader/writer exist: the conn_id must be the
-        # FIRST frame on the stream (the client's request ids embed it)
-        _wire.send_msg(sock, ("hello", conn_id), auth_key=self._auth_key)
+        # bootstrap hello before the reader/writer exist: the conn_id
+        # must be the FIRST frame on the stream (the client's request
+        # ids embed it). ALWAYS pickle-encoded: a previous-protocol
+        # client can only read pickle, and a safe-mode client SKIPS this
+        # frame undecoded (it takes conn_id from the hello_ack instead)
+        # — sending pickle is harmless, only loading it is code
+        # execution. The third element advertises this build's
+        # (protos, codecs) for proto-2 peers that do decode it; proto-1
+        # clients index only [0] and [1] (forward compat by position).
+        _wire.send_msg(
+            sock, ("hello", conn_id,
+                   {"protos": list(_wire.SUPPORTED_PROTOS),
+                    "codecs": self._offered_codecs()}),
+            auth_key=self._auth_key)
         conn.reader = threading.Thread(
             target=self._read_loop, args=(conn,),
             name="mx-frontdoor-read-%d" % conn_id, daemon=True)
@@ -399,10 +437,20 @@ class ServingFrontDoor:
                     # TICK-aware receive: a poll timeout BEFORE any frame
                     # byte re-checks the stop event; a timeout INSIDE a
                     # frame keeps reading (an honest slow peer must not
-                    # be desynced into a strike) until the stall budget
+                    # be desynced into a strike) until the stall budget.
+                    # Pickle acceptance is PER-CONNECTION: before the
+                    # first frame the compat policy decides (rolling
+                    # upgrade); after negotiation only a pickle-codec
+                    # connection may keep sending pickle — a
+                    # negotiated-safe peer switching back is a violation
+                    # (and a strike), not a fallback.
+                    allow_pickle = (self._wire_compat if conn.codec is None
+                                    else conn.codec == _wire.CODEC_PICKLE)
                     msg = _wire.recv_msg_tick(conn.sock,
                                               max_bytes=self._max_frame,
-                                              auth_key=self._auth_key)
+                                              auth_key=self._auth_key,
+                                              allow_pickle=allow_pickle,
+                                              limits=self._codec_limits)
                 except _wire.FrameError as e:
                     self._strike(conn, e)
                     return
@@ -524,6 +572,27 @@ class ServingFrontDoor:
     # ------------------------------------------------------------------
     def _handle(self, conn, msg):
         verb = msg[0]
+        if verb == "hello":
+            if conn.codec is not None:
+                # negotiation is ONCE per connection: a re-hello after
+                # the codec is fixed is a protocol violation (it could
+                # renegotiate a safe connection back onto pickle and
+                # bypass the post-negotiation allow_pickle gate) — a
+                # strike, exactly like any other malformed stream
+                self._strike(conn, _wire.FrameError(
+                    "hello after negotiation on connection %d"
+                    % conn.conn_id))
+                return
+            self._handle_hello(conn, msg[1] if len(msg) > 1 else {})
+            return
+        if conn.codec is None:
+            # first frame and it is NOT a hello: a previous-protocol
+            # peer (old hello consumed, old codec). The connection
+            # speaks pickle for its lifetime — the rolling-upgrade
+            # tolerance the compat gate already admitted.
+            conn.codec = _wire.CODEC_PICKLE
+            with self._lock:
+                self._counters["legacy_peers"] += 1
         if verb == "predict":
             self._handle_predict(conn, msg[1], msg[2])
         elif verb == "resolve":
@@ -541,6 +610,39 @@ class ServingFrontDoor:
         else:
             conn.send_q.put(("failed", msg[1] if len(msg) > 1 else None,
                              "unknown verb %r" % (verb,)))
+
+    def _offered_codecs(self):
+        if self._wire_mode == _wire.CODEC_SAFE:
+            return [_wire.CODEC_SAFE] + (
+                [_wire.CODEC_PICKLE] if self._wire_compat else [])
+        return [_wire.CODEC_PICKLE, _wire.CODEC_SAFE]
+
+    def _handle_hello(self, conn, offer):
+        """Proto-2 negotiation: pick the highest common (proto, codec)
+        pair and ack it; every later frame on this connection — both
+        directions — speaks the chosen codec. Unknown offer keys are
+        ignored (forward compat). A failed negotiation is answered
+        typed (``hello_reject``), not struck: a version-mismatched
+        honest peer deserves a readable verdict, and it will hang up
+        cleanly on receipt."""
+        try:
+            proto, chosen = _wire.negotiate(
+                offer if isinstance(offer, dict) else {},
+                self._wire_mode, self._wire_compat)
+        except _wire.FrameError as e:
+            with self._lock:
+                self._counters["hello_rejected"] += 1
+            # the peer sent a (decodable) hello, so it reads the safe
+            # codec; answer in it so the refusal is legible
+            conn.codec = _wire.CODEC_SAFE
+            conn.send_q.put(("hello_reject", None, str(e)))
+            return
+        with self._lock:
+            self._counters["negotiated_%s" % chosen] += 1
+        conn.codec = chosen
+        conn.proto = proto
+        conn.send_q.put(("hello_ack", conn.conn_id,
+                         {"proto": proto, "codec": chosen}))
 
     def _list_models(self):
         out = {}
@@ -722,9 +824,15 @@ class ServingFrontDoor:
                                         verb=str(reply[0]))
                     # stall-tolerant send: the socket's short poll
                     # timeout must not kill a merely backpressured
-                    # client mid-reply (only a zero-progress stall does)
-                    _wire.send_msg_stall(conn.sock, reply,
-                                         auth_key=self._auth_key)
+                    # client mid-reply (only a zero-progress stall does).
+                    # Replies speak the connection's negotiated codec;
+                    # pre-negotiation control replies (a pre-hello
+                    # "failed" verdict) default to pickle — the only
+                    # codec a peer that skipped the handshake can read.
+                    _wire.send_msg_stall(
+                        conn.sock, reply, auth_key=self._auth_key,
+                        codec=conn.codec or _wire.CODEC_PICKLE,
+                        limits=self._codec_limits)
                     if reply[0] in ("served", "shed", "failed"):
                         # "sent" is not "delivered" (TCP buffers accept
                         # frames for a dead peer): keep the outcome in

@@ -154,11 +154,13 @@ class WorkerHandle:
     `ServingClient`, and the `_Replica` wrappers attached to the
     ModelServer."""
 
-    def __init__(self, worker_id, host, port, pid=None):
+    def __init__(self, worker_id, host, port, pid=None,
+                 codec=_wire.CODEC_PICKLE):
         self.worker_id = worker_id
         self.host = host
         self.port = port             # the worker's DISPATCH (frontdoor) port
         self.pid = pid
+        self.codec = codec           # control-channel codec (negotiated)
         self.state = ALIVE
         self.last_hb = time.monotonic()
         self.health = None           # last heartbeat's health snapshot
@@ -213,8 +215,18 @@ class FleetPool:
 
     def __init__(self, server, host=None, port=None, heartbeat_s=None,
                  suspect_after_s=None, dead_after_s=None, auth_key=None,
-                 connect_deadline_s=3.0, probe_timeout_s=30.0, backlog=16):
+                 connect_deadline_s=3.0, probe_timeout_s=30.0, backlog=16,
+                 wire_mode=None, wire_compat=None):
         self._server = server
+        # control-channel wire codec policy, read ONCE (ISSUE 13): the
+        # fleet channel defaults to the safe non-executable codec; a
+        # previous-protocol worker whose first frame is a pickle "join"
+        # is tolerated while compat is on (rolling upgrade)
+        self._wire_mode = _wire.resolve_wire_mode(wire_mode)
+        self._wire_compat = _wire.wire_compat_from_env() \
+            if wire_compat is None else bool(wire_compat)
+        from . import codec as _codec
+        self._codec_limits = _codec.Limits()
         self._host = host if host is not None else get_env(
             "MXNET_SERVING_FLEET_BIND", "127.0.0.1")
         self.port = int(port) if port is not None else int(get_env(
@@ -344,14 +356,19 @@ class FleetPool:
         dies."""
         from ..resilience.watchdog import watchdog as _watchdog
         handle = None
+        codec = None                # None until the first frame decides
         hb = _watchdog().register("fleet:control:%s" % (addr[0],),
                                   thread=threading.current_thread())
         try:
             while not self._stop_evt.is_set():
                 hb.idle()
                 try:
+                    allow_pickle = (self._wire_compat if codec is None
+                                    else codec == _wire.CODEC_PICKLE)
                     msg = _wire.recv_msg_tick(sock,
-                                              auth_key=self._auth_key)
+                                              auth_key=self._auth_key,
+                                              allow_pickle=allow_pickle,
+                                              limits=self._codec_limits)
                 except (_wire.FrameError, OSError) as e:
                     if handle is not None:
                         _log.warning("fleet: control channel to %s lost "
@@ -363,8 +380,38 @@ class FleetPool:
                     break
                 hb.beat()
                 verb = msg[0]
-                if verb == "join" and handle is None:
-                    handle = self._handle_join(sock, addr, msg[1])
+                # hello is ONCE per session (codec is None): a re-hello
+                # after the codec is fixed falls through to the
+                # unexpected-frame break — it must not renegotiate the
+                # session codec mid-stream
+                if verb == "hello" and handle is None and codec is None:
+                    # proto-2 worker: negotiate the session codec before
+                    # the join (the frontdoor handshake, control-plane
+                    # shape — the worker speaks first here, so there is
+                    # no bootstrap frame to skip)
+                    try:
+                        _, codec = _wire.negotiate(
+                            msg[1] if len(msg) > 1
+                            and isinstance(msg[1], dict) else {},
+                            self._wire_mode, self._wire_compat)
+                    except _wire.FrameError as e:
+                        _wire.send_msg(sock, ("hello_reject", None,
+                                              str(e)),
+                                       auth_key=self._auth_key,
+                                       codec=_wire.CODEC_SAFE)
+                        break
+                    _wire.send_msg(
+                        sock, ("hello_ack", None,
+                               {"proto": _wire.PROTO_VERSION,
+                                "codec": codec}),
+                        auth_key=self._auth_key, codec=codec,
+                        limits=self._codec_limits)
+                elif verb == "join" and handle is None:
+                    if codec is None:
+                        # hello-less join: a previous-protocol worker —
+                        # its session speaks pickle (compat admitted it)
+                        codec = _wire.CODEC_PICKLE
+                    handle = self._handle_join(sock, addr, msg[1], codec)
                     if handle is None:
                         break       # rejected; reply already sent
                 elif verb == "heartbeat" and handle is not None:
@@ -396,11 +443,11 @@ class FleetPool:
     # ------------------------------------------------------------------
     # join / admission (warmup + half-open probe)
     # ------------------------------------------------------------------
-    def _handle_join(self, sock, addr, info):
+    def _handle_join(self, sock, addr, info, codec):
         worker_id = str(info.get("worker_id") or "%s:%s" % addr)
         try:
             _faults.fault_point("fleet.join", worker=worker_id)
-            return self._admit(sock, addr, worker_id, info)
+            return self._admit(sock, addr, worker_id, info, codec)
         except Exception as e:
             with self._lock:
                 self._counters["rejects"] += 1
@@ -408,12 +455,13 @@ class FleetPool:
             try:
                 _wire.send_msg(sock, ("reject", "%s: %s"
                                       % (type(e).__name__, e)),
-                               auth_key=self._auth_key)
+                               auth_key=self._auth_key, codec=codec,
+                               limits=self._codec_limits)
             except OSError:
                 pass  # tpulint: allow-swallowed-exception the rejected worker may already be gone; the verdict frame is best-effort
             return None
 
-    def _admit(self, sock, addr, worker_id, info):
+    def _admit(self, sock, addr, worker_id, info, codec):
         from .. import profiler as _prof
         port = int(info.get("port") or 0)
         if port <= 0:
@@ -451,7 +499,7 @@ class FleetPool:
             if prior.client is not None:
                 self._retire_client(prior.client)
         handle = WorkerHandle(worker_id, host, port,
-                              pid=info.get("pid"))
+                              pid=info.get("pid"), codec=codec)
         handle.conn = sock
         # HALF-OPEN PROBE (the breaker idiom, host-scale): exactly one
         # self-predict per model must succeed before any traffic routes
@@ -459,7 +507,7 @@ class FleetPool:
         # wedged during warmup) is refused readmission
         probe_rid = self._next_rid(handle)
         self._send_cmd(handle, ("probe", probe_rid))
-        reply = self._await_probe(sock, probe_rid)
+        reply = self._await_probe(sock, probe_rid, codec)
         if reply[0] != "probe_ok":
             with self._lock:
                 self._counters["probe_failures"] += 1
@@ -470,9 +518,20 @@ class FleetPool:
         # leaked client (reader thread + sockets, once per rejoin
         # attempt) or a half-attached model (routable replicas with no
         # supervising handle) would outlive the rejected join
+        # the dispatch client's codec comes from what the worker's join
+        # ADVERTISES ("codecs" — absent from a previous-protocol join,
+        # whose front door only speaks pickle; an old pool ignores the
+        # key, the forward-compat rule both ways): a v-new gateway keeps
+        # dispatching to a v-old worker through a rolling upgrade
+        offered = [str(c) for c in (info.get("codecs")
+                                    or (_wire.CODEC_PICKLE,))]
+        dispatch_mode = _wire.CODEC_SAFE \
+            if (self._wire_mode == _wire.CODEC_SAFE
+                and _wire.CODEC_SAFE in offered) else _wire.CODEC_PICKLE
         client = ServingClient(host, port, pool_size=2,
                                connect_deadline_s=self._connect_deadline_s,
-                               resubmits=1, auth_key=self._auth_key)
+                               resubmits=1, auth_key=self._auth_key,
+                               wire_mode=dispatch_mode)
         try:
             client.ping(timeout=self._probe_timeout_s)
             handle.client = client
@@ -506,12 +565,15 @@ class FleetPool:
                   ", READMITTED after death" if rejoin else "")
         return handle
 
-    def _await_probe(self, sock, probe_rid):
+    def _await_probe(self, sock, probe_rid, codec):
         """Block this control reader until the worker answers the probe
         (heartbeats may interleave; they are consumed, not lost)."""
         deadline = time.monotonic() + self._probe_timeout_s
         while time.monotonic() < deadline:
-            msg = _wire.recv_msg_tick(sock, auth_key=self._auth_key)
+            msg = _wire.recv_msg_tick(
+                sock, auth_key=self._auth_key,
+                allow_pickle=codec == _wire.CODEC_PICKLE,
+                limits=self._codec_limits)
             if msg is _wire.TICK:
                 continue
             if msg is None:
@@ -675,7 +737,9 @@ class FleetPool:
             # takes far longer than one tick — plain sendall would
             # raise mid-frame and desync the channel (the front door's
             # big-reply rule, applied to the control plane)
-            _wire.send_msg_stall(conn, frame, auth_key=self._auth_key)
+            _wire.send_msg_stall(conn, frame, auth_key=self._auth_key,
+                                 codec=handle.codec,
+                                 limits=self._codec_limits)
 
     def _handle_ack(self, handle, msg):
         rec = handle.acks.get(msg[1])
@@ -693,7 +757,8 @@ class FleetPool:
         handle.acks[rid] = rec
         try:
             self._send_cmd(handle, ("rollover", rid, model,
-                                    arg_params, aux_params))
+                                    _host_params(arg_params),
+                                    _host_params(aux_params)))
             if not rec[0].wait(timeout):
                 raise MXNetError("rollover ack from worker %s timed out"
                                  % handle.worker_id)
@@ -771,6 +836,20 @@ class FleetPool:
         health["workers_alive"] = sum(
             1 for _w, state, _h in worker_healths if state == ALIVE)
         return health
+
+
+def _host_params(params):
+    """Weight dict normalized to host numpy for the control channel:
+    the safe wire carries plain data, not framework handles (an NDArray
+    or jax buffer has no non-executable encoding by design). The worker
+    rebuilds NDArrays on receipt, so the rollover path the engines see
+    is unchanged."""
+    if not params:
+        return params
+    import numpy as _np
+    # tpulint: allow-host-sync rollover weights cross the process boundary by value — this materialization IS the control-channel payload
+    return {name: _np.asarray(getattr(val, "_data", val))
+            for name, val in params.items()}
 
 
 _teardown = _wire.teardown

@@ -3,15 +3,14 @@ dist_async parameter-server transport (`kvstore_async.py`) and the
 serving front door (`serving/frontdoor.py`).
 
 Frame layout: an 8-byte little-endian unsigned length header followed by
-a pickled payload. Exactly the framing the dist_async transport has
-shipped since PR 2 — extracted here (ISSUE 11) so the two TCP tiers in
-the tree cannot drift apart on the one thing that must never drift: how
-a byte stream splits back into messages.
-
-Like the reference's ps-lite vans this transport is for TRUSTED cluster
-networks only: pickle deserialization is code execution, so never expose
-a port speaking this protocol beyond the job's hosts (both call sites
-bind 127.0.0.1 unless the operator opts into a wider interface).
+an encoded payload — the framing the dist_async transport has shipped
+since PR 2, extracted here (ISSUE 11) so the two TCP tiers in the tree
+cannot drift apart on the one thing that must never drift: how a byte
+stream splits back into messages. Since ISSUE 13 the payload encoding is
+pluggable: the safe non-executable codec (``serving/codec.py``, the
+serving default) or legacy pickle (the kvstore transport's trusted
+default — like the reference's ps-lite vans, for the job's own cluster
+network only).
 
 The front door needs one distinction the kvstore client never did:
 a connection that closes AT a frame boundary is a client hanging up
@@ -23,16 +22,34 @@ historical "any EOF is None" behavior with a two-line wrapper.
 
 Frame authentication (ISSUE 12): when a call supplies ``auth_key``,
 every frame's payload is prefixed with an HMAC-SHA256 tag over the
-pickled bytes, and the receive side verifies the tag BEFORE the payload
-reaches ``pickle.loads`` — a frame from a peer without the shared key
-is rejected as :class:`AuthError` while it is still inert bytes, never
-after deserialization gave it code execution. The serving tier
+encoded bytes, and the receive side verifies the tag BEFORE the payload
+is decoded — a frame from a peer without the shared key is rejected as
+:class:`AuthError` while it is still inert bytes. The serving tier
 (front door, client, fleet control channel) reads the shared key from
 ``MXNET_SERVING_AUTH_KEY`` once at construction; the kvstore wrappers
 deliberately keep their trusted no-auth default (the dist_async hosts
 are launched as one job on one cluster network — docs/faq/serving.md
-"Trust model" records the split, and a non-pickle schema remains the
-future work for genuinely untrusted networks).
+"Trust model" records the split).
+
+Wire codec (ISSUE 13): the serving tier no longer has to unpickle
+untrusted bytes at all. ``MXNET_SERVING_WIRE=safe`` (the default for
+the front door, the serving client, and the fleet control channel)
+encodes every frame with the self-describing, bounded, NON-EXECUTABLE
+codec in ``serving/codec.py``; ``pickle`` keeps the previous protocol
+byte-for-byte. The receive path is sniff-based — a safe frame (magic
+``b"MXW1"``; our pickles always start ``b"\\x80"``) decodes safely no
+matter the endpoint mode, while a legacy pickle frame is accepted only
+where the endpoint's compat policy allows it
+(``MXNET_SERVING_WIRE_COMPAT``, default on: a v-old peer keeps being
+served through a rolling upgrade; set 0 post-migration and the
+listening side never runs ``pickle.loads`` on network bytes again).
+Protocol version negotiation rides hello frames — see
+:func:`negotiate` and ``serving/frontdoor.py``. The kvstore wrappers
+keep their trusted pickle default: the dist_async transport's peers
+are one launched job, its payloads exceed serving caps by design, and
+tpulint TPL107 keeps any new ``pickle.loads`` out of ``serving/``
+outside this seam. Auth composes codec-independently: the MAC is
+verified first, THEN the payload decodes.
 """
 from __future__ import annotations
 
@@ -47,7 +64,23 @@ from ..base import MXNetError, get_env
 __all__ = ["FrameError", "AuthError", "send_msg", "recv_msg",
            "recv_exact", "recv_msg_tick", "send_msg_stall", "TICK",
            "DEFAULT_MAX_FRAME_BYTES", "auth_key_from_env", "MAC_LEN",
-           "teardown"]
+           "teardown", "PROTO_VERSION", "SUPPORTED_PROTOS",
+           "CODEC_SAFE", "CODEC_PICKLE", "wire_mode_from_env",
+           "wire_compat_from_env", "encode_payload", "decode_payload",
+           "recv_payload", "negotiate"]
+
+#: protocol versions this build speaks. 1 = the PR 10 wire (server
+#: pickle hello, pickle frames, no negotiation). 2 = negotiated: the
+#: client sends a ("hello", offer) frame, the server answers
+#: ("hello_ack", conn_id, {"proto", "codec"}) picking the highest
+#: common pair; unknown offer/ack map keys are IGNORED on both sides so
+#: a proto-3 peer can extend the handshake without breaking us.
+PROTO_VERSION = 2
+SUPPORTED_PROTOS = (1, 2)
+
+CODEC_SAFE = "safe"
+CODEC_PICKLE = "pickle"
+_CODECS = (CODEC_SAFE, CODEC_PICKLE)
 
 # A corrupt or adversarial 8-byte header must not become a multi-TB
 # allocation: frames above the cap raise FrameError instead. 1 GiB
@@ -74,6 +107,102 @@ class AuthError(FrameError):
 
 #: HMAC-SHA256 digest length prefixed to every authenticated payload.
 MAC_LEN = hashlib.sha256().digest_size
+
+# AFTER the error types: codec.py imports FrameError from this module,
+# so this module-object import must run once FrameError exists (both
+# import orders then resolve — attribute access happens at call time)
+from . import codec as _codec_mod            # noqa: E402
+
+
+def wire_mode_from_env():
+    """The serving tier's wire codec (``MXNET_SERVING_WIRE``): ``safe``
+    (default — the non-executable codec) or ``pickle`` (the previous
+    protocol, byte-for-byte). Read ONCE at endpoint construction."""
+    return resolve_wire_mode(get_env("MXNET_SERVING_WIRE", CODEC_SAFE))
+
+
+def resolve_wire_mode(mode=None):
+    """THE constructor-time wire-mode rule, shared by every serving
+    endpoint (front door, client, fleet pool, worker): ``None`` defers
+    to the env var; anything else lowercases and validates — so an
+    explicit ``wire_mode="SAFE"`` behaves exactly like
+    ``MXNET_SERVING_WIRE=SAFE``."""
+    if mode is None:
+        return wire_mode_from_env()
+    mode = str(mode).lower()
+    if mode not in _CODECS:
+        raise MXNetError("wire mode must be one of %s, got %r"
+                         % ("/".join(_CODECS), mode))
+    return mode
+
+
+def wire_compat_from_env():
+    """Rolling-upgrade tolerance (``MXNET_SERVING_WIRE_COMPAT``,
+    default on): whether a safe-mode LISTENER still accepts legacy
+    pickle frames from previous-protocol peers. Read once at endpoint
+    construction; set 0 once the fleet is fully migrated and the
+    listening side never unpickles network bytes again."""
+    return bool(get_env("MXNET_SERVING_WIRE_COMPAT", True, bool))
+
+
+def encode_payload(obj, codec=CODEC_PICKLE, limits=None):
+    """One frame body (pre-MAC): safe-codec or pickle bytes."""
+    if codec == CODEC_SAFE:
+        return _codec_mod.encode(obj, limits)
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode_payload(payload, allow_pickle=True, limits=None):
+    """Sniff-based frame decode — THE receive-side codec policy. A
+    safe-codec frame (magic-prefixed) always decodes: it is inert data
+    regardless of endpoint mode. Anything else is a legacy pickle
+    frame, accepted only when ``allow_pickle`` (the endpoint's
+    per-connection verdict: its own mode is pickle, the connection
+    negotiated pickle, or pre-negotiation compat tolerance). Refused
+    pickle surfaces as :class:`FrameError` — an eviction strike, not a
+    deserialization."""
+    if _codec_mod.sniff(payload):
+        return _codec_mod.decode(payload, limits)
+    if not allow_pickle:
+        raise FrameError(
+            "legacy pickle frame refused: this endpoint speaks the safe "
+            "wire only (MXNET_SERVING_WIRE=safe with compat off, or the "
+            "connection negotiated the safe codec)")
+    try:
+        return pickle.loads(payload)
+    except Exception as e:
+        raise FrameError("frame payload does not unpickle: %s" % e) from e
+
+
+def negotiate(offer, mode, compat):
+    """Server-side half of the hello handshake: pick the highest common
+    ``(proto, codec)`` pair from a client hello's ``offer`` mapping
+    (keys ``protos`` and ``codecs``; UNKNOWN keys ignored — forward
+    compat). ``mode``/``compat`` are the listener's construction-time
+    policy. Returns ``(proto, codec)``; raises :class:`FrameError` when
+    nothing is common (the caller replies ``hello_reject``)."""
+    if not isinstance(offer, dict):
+        raise FrameError("hello offer must be a mapping, got %s"
+                         % type(offer).__name__)
+    try:
+        protos = {int(p) for p in (offer.get("protos") or (1,))}
+    except (TypeError, ValueError) as e:
+        raise FrameError("hello protos are not integers: %s" % e) from e
+    common = protos & set(SUPPORTED_PROTOS)
+    if not common:
+        raise FrameError("no common protocol version: peer speaks %s, "
+                         "this build %s" % (sorted(protos),
+                                            list(SUPPORTED_PROTOS)))
+    peer_codecs = [str(c) for c in (offer.get("codecs") or (CODEC_PICKLE,))]
+    if mode == CODEC_SAFE:
+        preference = [CODEC_SAFE] + ([CODEC_PICKLE] if compat else [])
+    else:
+        preference = [CODEC_PICKLE, CODEC_SAFE]
+    for codec in preference:
+        if codec in peer_codecs:
+            return max(common), codec
+    raise FrameError("no common wire codec: peer offers %s, this "
+                     "endpoint allows %s" % (peer_codecs, preference))
 
 
 def auth_key_from_env():
@@ -121,11 +250,10 @@ def _open(payload, auth_key):
     return body
 
 
-def send_msg(sock, obj, auth_key=None):
-    """Pickle ``obj`` and send it as one length-prefixed frame (HMAC-
-    prefixed when ``auth_key`` is set)."""
-    payload = _seal(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
-                    auth_key)
+def send_msg(sock, obj, auth_key=None, codec=CODEC_PICKLE, limits=None):
+    """Encode ``obj`` (``codec``: safe or pickle) and send it as one
+    length-prefixed frame (HMAC-prefixed when ``auth_key`` is set)."""
+    payload = _seal(encode_payload(obj, codec, limits), auth_key)
     sock.sendall(_HEADER.pack(len(payload)) + payload)
 
 
@@ -146,15 +274,11 @@ def recv_exact(sock, n):
     return buf
 
 
-def recv_msg(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES, auth_key=None):
-    """Receive one frame and unpickle it. Returns None when the peer
-    closed cleanly at a frame boundary; raises :class:`FrameError` for
-    a mid-frame close, an oversized length header, or a payload that
-    does not unpickle — and :class:`AuthError` (before any unpickling)
-    when ``auth_key`` is set and the frame's HMAC does not verify.
-    ``max_bytes=None`` disables the frame cap (the kvstore transport,
-    whose trusted peers ship arbitrarily large parameter shards and
-    never had a cap)."""
+def recv_payload(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES, auth_key=None):
+    """Receive one frame's RAW payload bytes (MAC verified and stripped,
+    nothing decoded). Returns None on a clean close. What the safe-mode
+    client handshake uses to SKIP the server's legacy bootstrap hello
+    without ever unpickling it."""
     header = recv_exact(sock, _HEADER.size)
     if header is None:
         return None
@@ -166,11 +290,25 @@ def recv_msg(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES, auth_key=None):
     payload = recv_exact(sock, n)
     if payload is None:
         raise FrameError("connection closed between header and payload")
-    payload = _open(payload, auth_key)
-    try:
-        return pickle.loads(payload)
-    except Exception as e:
-        raise FrameError("frame payload does not unpickle: %s" % e) from e
+    return _open(payload, auth_key)
+
+
+def recv_msg(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES, auth_key=None,
+             allow_pickle=True, limits=None):
+    """Receive one frame and decode it (sniff-based — see
+    :func:`decode_payload`). Returns None when the peer closed cleanly
+    at a frame boundary; raises :class:`FrameError` for a mid-frame
+    close, an oversized length header, or a payload that does not
+    decode — and :class:`AuthError` (before any decoding) when
+    ``auth_key`` is set and the frame's HMAC does not verify.
+    ``max_bytes=None`` disables the frame cap (the kvstore transport,
+    whose trusted peers ship arbitrarily large parameter shards and
+    never had a cap)."""
+    payload = recv_payload(sock, max_bytes=max_bytes, auth_key=auth_key)
+    if payload is None:
+        return None
+    return decode_payload(payload, allow_pickle=allow_pickle,
+                          limits=limits)
 
 
 def teardown(sock):
@@ -196,7 +334,8 @@ TICK = object()
 
 
 def recv_msg_tick(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES,
-                  stall_timeout=30.0, auth_key=None):
+                  stall_timeout=30.0, auth_key=None, allow_pickle=True,
+                  limits=None):
     """`recv_msg` for a socket carrying a short poll timeout (the
     front-door reader pattern: block briefly, check a stop event, block
     again).
@@ -249,21 +388,19 @@ def recv_msg_tick(sock, max_bytes=DEFAULT_MAX_FRAME_BYTES,
                          "(corrupt header or misbehaving peer)"
                          % (n, max_bytes))
     payload = _open(read_n(n), auth_key)
-    try:
-        return pickle.loads(payload)
-    except Exception as e:
-        raise FrameError("frame payload does not unpickle: %s" % e) from e
+    return decode_payload(payload, allow_pickle=allow_pickle,
+                          limits=limits)
 
 
-def send_msg_stall(sock, obj, stall_timeout=30.0, auth_key=None):
+def send_msg_stall(sock, obj, stall_timeout=30.0, auth_key=None,
+                   codec=CODEC_PICKLE, limits=None):
     """`send_msg` for a socket carrying a short poll timeout: `sendall`
     raising mid-send loses how much went out, so a big reply to a
     backpressured (but healthy) client would look like a dead peer.
     This send loop keeps pushing while the peer makes ANY progress and
     raises :class:`FrameError` only after ``stall_timeout`` of
     consecutive zero-progress passes."""
-    payload = _seal(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
-                    auth_key)
+    payload = _seal(encode_payload(obj, codec, limits), auth_key)
     data = _HEADER.pack(len(payload)) + payload
     view = memoryview(data)
     tick_s = sock.gettimeout() or 0.0

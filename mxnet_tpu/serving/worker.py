@@ -78,7 +78,8 @@ class ReplicaWorker:
     """
 
     def __init__(self, gateway, server, host=None, port=0, worker_id=None,
-                 heartbeat_s=None, auth_key=None, rejoin_backoff_s=0.5):
+                 heartbeat_s=None, auth_key=None, rejoin_backoff_s=0.5,
+                 wire_mode=None):
         if isinstance(gateway, str):
             ghost, _, gport = gateway.rpartition(":")
             gateway = (ghost or "127.0.0.1", int(gport))
@@ -88,7 +89,8 @@ class ReplicaWorker:
                              % type(server).__name__)
         self._server = server
         self._frontdoor = ServingFrontDoor(server, host=host, port=port,
-                                           auth_key=auth_key)
+                                           auth_key=auth_key,
+                                           wire_mode=wire_mode)
         self.worker_id = worker_id or "%s-%d-%s" % (
             socket.gethostname(), os.getpid(), uuid.uuid4().hex[:6])
         if heartbeat_s is None:
@@ -96,6 +98,15 @@ class ReplicaWorker:
                                   2.0, float)
         self._heartbeat_s = float(heartbeat_s)
         self._auth_key = _wire.normalize_auth_key(auth_key)
+        # control-channel wire codec (ISSUE 13), read ONCE: "safe" sends
+        # a proto-2 hello before the join and never unpickles gateway
+        # bytes; "pickle" is the previous protocol byte-for-byte (the
+        # escape hatch against a v-old gateway, and the rolling-upgrade
+        # test double)
+        self._wire_mode = _wire.resolve_wire_mode(wire_mode)
+        from . import codec as _codec
+        self._codec_limits = _codec.Limits()
+        self._codec = _wire.CODEC_PICKLE   # per-session; set at handshake
         self._rejoin_backoff_s = float(rejoin_backoff_s)
         self._reject_streak = 0   # escalates the retry wait after rejects
         self._advertise_host = host
@@ -154,12 +165,10 @@ class ReplicaWorker:
     # control loop (join -> heartbeat/commands -> reconnect)
     # ------------------------------------------------------------------
     def _join_info(self):
-        # advertise None when no host was configured: the pool falls
-        # back to the address it OBSERVES on the control connection —
-        # the one address that provably routes back to this worker
-        # cross-host (a hardcoded loopback would point the gateway at
-        # itself)
-        return {"worker_id": self.worker_id,
+        # host None when unconfigured: the pool falls back to the
+        # address it OBSERVES on the control connection — the one
+        # address that provably routes back to this worker cross-host
+        info = {"worker_id": self.worker_id,
                 "host": self._advertise_host,
                 "port": self._frontdoor.port,
                 "pid": os.getpid(),
@@ -168,6 +177,15 @@ class ReplicaWorker:
                                    for v in self._server.versions(name)]}
                            for name in self._server.models()},
                 "warmed": self.warmed()}
+        if self._wire_mode == _wire.CODEC_SAFE:
+            # advertise what this worker's DISPATCH plane (its front
+            # door) speaks — the gateway derives its ServingClient codec
+            # from this; a previous-protocol pool ignores the key (the
+            # unknown-map-keys forward-compat rule). In pickle mode the
+            # key is OMITTED, exactly the shape a v-old join has, so
+            # wire_mode=pickle is a faithful previous-protocol double.
+            info["codecs"] = self._frontdoor._offered_codecs()
+        return info
 
     def _control_loop(self):
         from ..resilience.watchdog import watchdog as _watchdog
@@ -224,7 +242,9 @@ class ReplicaWorker:
         larger than one tick's worth of bytes must not desync the
         channel."""
         with self._send_lock:
-            _wire.send_msg_stall(sock, frame, auth_key=self._auth_key)
+            _wire.send_msg_stall(sock, frame, auth_key=self._auth_key,
+                                 codec=self._codec,
+                                 limits=self._codec_limits)
 
     def _session(self, sock, hb):
         """One connected control session: join, then heartbeat + serve
@@ -234,11 +254,17 @@ class ReplicaWorker:
         # 0.25s) sends at the tick period instead and the effective
         # heartbeat age brushes the pool's 2x-cadence SUSPECT threshold
         sock.settimeout(min(0.5, self._heartbeat_s / 2.0))
+        self._codec = _wire.CODEC_PICKLE
+        if self._wire_mode == _wire.CODEC_SAFE:
+            self._codec = self._hello(sock)
         self._send(sock, ("join", self._join_info()))
         last_hb_sent = time.monotonic()
         while not self._stop_evt.is_set():
             hb.idle()
-            msg = _wire.recv_msg_tick(sock, auth_key=self._auth_key)
+            msg = _wire.recv_msg_tick(
+                sock, auth_key=self._auth_key,
+                allow_pickle=self._codec == _wire.CODEC_PICKLE,
+                limits=self._codec_limits)
             now = time.monotonic()
             if msg is None:
                 raise OSError("gateway closed the control channel")
@@ -265,6 +291,42 @@ class ReplicaWorker:
                     self._send(sock, ("heartbeat", with_health))
                     self.stats["heartbeats"] += 1
                 last_hb_sent = now
+
+    def _hello(self, sock):
+        """Proto-2 control handshake: offer (protos, codecs) in a safe
+        hello, adopt the gateway's pick from the hello_ack. The worker
+        speaks first on the control channel, so unlike the serving
+        client there is no legacy bootstrap frame to skip. A gateway
+        that rejects (or a v-old gateway that drops the session on the
+        unknown verb) surfaces as a failed session — the reconnect
+        loop's backoff owns recovery either way."""
+        _wire.send_msg(
+            sock, ("hello", {"protos": list(_wire.SUPPORTED_PROTOS),
+                             "codecs": [_wire.CODEC_SAFE],
+                             "lib": "mxnet_tpu"}),
+            auth_key=self._auth_key, codec=_wire.CODEC_SAFE,
+            limits=self._codec_limits)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            msg = _wire.recv_msg_tick(sock, auth_key=self._auth_key,
+                                      allow_pickle=False,
+                                      limits=self._codec_limits)
+            if msg is _wire.TICK:
+                continue
+            if msg is None:
+                raise OSError("gateway hung up during the wire "
+                              "handshake (previous-protocol gateway? "
+                              "set MXNET_SERVING_WIRE=pickle)")
+            if msg[0] == "hello_reject":
+                raise OSError("gateway refused the wire handshake: %s"
+                              % (msg[2] if len(msg) > 2 else msg,))
+            if msg[0] == "hello_ack":
+                info = msg[2] if len(msg) > 2 \
+                    and isinstance(msg[2], dict) else {}
+                return str(info.get("codec") or _wire.CODEC_SAFE)
+            raise OSError("unexpected frame %r during the wire "
+                          "handshake" % (msg[0],))
+        raise OSError("wire handshake timed out")
 
     def _handle_cmd(self, sock, msg):
         """One gateway command. Returns False when the session should
@@ -314,7 +376,20 @@ class ReplicaWorker:
 
     def _apply_rollover(self, sock, rid, model, arg_params, aux_params):
         try:
-            self._server.rollover(model, arg_params, aux_params)
+            # the wire delivers host numpy (the safe codec's schema);
+            # rebuild NDArrays so the engines' rollover path — quantized
+            # re-fold included — sees exactly what an in-process caller
+            # hands it
+            from ..ndarray.ndarray import array as _nd_array
+
+            def _lift(params):
+                if not params:
+                    return params
+                return {name: _nd_array(val) if isinstance(val, _np.ndarray)
+                        else val for name, val in params.items()}
+
+            self._server.rollover(model, _lift(arg_params),
+                                  _lift(aux_params))
             self.stats["rollovers"] += 1
         except Exception as e:
             reply = ("err", rid, "%s: %s" % (type(e).__name__, e))

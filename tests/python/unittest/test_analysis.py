@@ -514,6 +514,99 @@ class TestSwallowedException:
 
 
 # ----------------------------------------------------------------------
+# TPL107 wire-unpickle (ISSUE 13: pickle.loads on network-sourced bytes
+# stays inside the wire.py codec seam)
+# ----------------------------------------------------------------------
+class TestWireUnpickle:
+    SCOPED = "mxnet_tpu/serving/frontdoor.py"
+
+    def test_loads_and_load_flagged_in_serving(self):
+        bad = """
+            import pickle
+            def handle(payload, fh):
+                a = pickle.loads(payload)
+                b = pickle.load(fh)
+                return a, b
+        """
+        f = _active(_lint(bad, path=self.SCOPED))
+        assert [x.rule_id for x in f] == ["TPL107", "TPL107"]
+
+    def test_alias_and_from_import_forms_flagged(self):
+        bad = """
+            import pickle as pk
+            from pickle import loads as _loads
+            def f(d):
+                return pk.loads(d), _loads(d)
+        """
+        f = _active(_lint(bad, path=self.SCOPED))
+        assert [x.rule_id for x in f] == ["TPL107", "TPL107"]
+
+    def test_wire_seam_exempt(self):
+        src = """
+            import pickle
+            def decode(payload):
+                return pickle.loads(payload)
+        """
+        assert not _active(_lint(src, path="mxnet_tpu/serving/wire.py"),
+                           rule="TPL107")
+
+    def test_outside_serving_exempt(self):
+        src = """
+            import pickle
+            def decode(payload):
+                return pickle.loads(payload)
+        """
+        for path in ("mxnet_tpu/kvstore_async.py",
+                     "mxnet_tpu/checkpoint/state.py",
+                     "tools/diagnose.py"):
+            assert not _active(_lint(src, path=path), rule="TPL107")
+
+    def test_dumps_is_clean(self):
+        # encoding is not execution — only load(s) is the hazard
+        src = """
+            import pickle
+            def encode(obj):
+                return pickle.dumps(obj)
+        """
+        assert not _active(_lint(src, path=self.SCOPED), rule="TPL107")
+
+    def test_scope_helper(self):
+        from mxnet_tpu.analysis.rules import is_unpickle_scope
+        assert is_unpickle_scope("mxnet_tpu/serving/engine.py")
+        assert is_unpickle_scope("mxnet_tpu/serving/pool.py")
+        assert not is_unpickle_scope("mxnet_tpu/serving/wire.py")
+        assert not is_unpickle_scope("mxnet_tpu/kvstore_async.py")
+
+    def test_pragma_suppresses_with_reason(self):
+        src = """
+            import pickle
+            def warm(path):
+                with open(path, "rb") as fh:
+                    return pickle.load(fh)  # tpulint: allow-wire-unpickle bytes come from the LOCAL warmup cache file, not a socket
+        """
+        findings = _lint(src, path=self.SCOPED)
+        assert not _active(findings)
+        assert any(f.rule_id == "TPL107" and f.suppressed
+                   for f in findings)
+
+    def test_shipped_serving_tree_is_tpl107_clean(self):
+        """The seam holds on the real tree: no serving module outside
+        wire.py unpickles (unsuppressed)."""
+        import os
+        import mxnet_tpu.serving as serving_pkg
+        root = os.path.dirname(serving_pkg.__file__)
+        for fname in sorted(os.listdir(root)):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join("mxnet_tpu", "serving", fname)
+            with open(os.path.join(root, fname), encoding="utf-8") as fh:
+                src = fh.read()
+            findings = [f for f in lint_source(src, path) if
+                        f.rule_id == "TPL107" and not f.suppressed]
+            assert not findings, findings
+
+
+# ----------------------------------------------------------------------
 # TPL201 f64 leaks (symbol + jaxpr)
 # ----------------------------------------------------------------------
 class TestF64:

@@ -885,16 +885,27 @@ def test_send_failure_on_shared_conn_recovers_other_inflight(monkeypatch):
         time.sleep(0.005)
     from mxnet_tpu.serving.client import _ClientConn
     orig = _ClientConn.send
+    sends = {"predicts": 0}
 
     def flaky(self, frame):
+        # fail ONLY the FIRST predict send (B's initial attempt): a
+        # fail-everything patch also killed any resubmit racing A's
+        # resolve-by-id recovery (A resolving "unknown" under full-suite
+        # timing resubmits through this same send), breaking the very
+        # connection the recovery had just acquired — the known flake
+        # this test used to carry. Scoping to the first send keeps the
+        # path under test (B's send failure triggers break_transport ->
+        # reader recovery for A) fully deterministic.
         if frame[0] == "predict":
-            raise OSError("transport died under B")
+            sends["predicts"] += 1
+            if sends["predicts"] == 1:
+                raise OSError("transport died under B")
         orig(self, frame)           # control frames (resolve) still flow
 
     monkeypatch.setattr(_ClientConn, "send", flaky)
     futB = cli.predict_async({"data": x}, model="fd")
     with pytest.raises(MXNetError):
-        futB.result_wait(30.0)      # B exhausts its resubmit budget
+        futB.result_wait(30.0)      # B exhausts its (zero) resubmit budget
     monkeypatch.undo()
     # A's work is still queued server-side; run it — A's outcome lands
     # in the orphan store and recovery delivers the REAL result

@@ -1,0 +1,689 @@
+"""Safe serving-wire codec + protocol negotiation (ISSUE 13):
+mxnet_tpu/serving/codec.py, the wire.py codec seam, and the
+rolling-upgrade behavior of the front door / client / fleet channel.
+
+Contracts under test:
+  * roundtrip fidelity — every allowlisted dtype (bool, (u)int8-64,
+    f16/bf16/f32/f64), 0-d and empty arrays, non-contiguous views,
+    numpy scalars, deep mixed containers — BIT-identical to what the
+    pickle codec carries;
+  * caps enforced BEFORE allocation: depth bombs, length bombs, shape
+    bombs, dtype confusion, truncation — every malformed input is the
+    typed FrameError, fast, without the allocation it tried to provoke;
+  * decoder-is-total: a seeded mutational fuzz sweep produces only
+    FrameError or valid data, never another exception (the CI gate in
+    tools/wire_fuzz_smoke.py runs the >=10k version with allocation
+    tracking);
+  * protocol negotiation: hello offers -> highest common (proto,
+    codec); unknown map keys ignored both ways (forward compat);
+  * ROLLING UPGRADE: a previous-protocol peer (old hello, old codec —
+    both an in-process wire_mode="pickle" client and a stdlib-only
+    subprocess speaker) is served bit-identically by a safe-default
+    gateway; with compat off the same peer is refused typed;
+  * a hostile peer spraying fuzzer output is EVICTED while
+    submitted == served + shed + failed holds for everyone else;
+  * zero-overhead: no per-request env reads on the dispatch path.
+"""
+import json
+import math
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import (ModelServer, ServingFrontDoor, ServingClient,
+                               DeadlineExceeded)
+from mxnet_tpu.serving import codec, wire, wire_fuzz
+from mxnet_tpu.serving.wire import FrameError
+
+try:
+    from ml_dtypes import bfloat16
+except ImportError:          # pragma: no cover - ships with jax
+    bfloat16 = None
+
+
+# ---------------------------------------------------------------------------
+# fixtures (the test_frontdoor idiom)
+# ---------------------------------------------------------------------------
+
+def _net(prefix, hidden=8, classes=3):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden,
+                                name=prefix + "_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes,
+                                name=prefix + "_fc1")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _server(model="wc", async_worker=True, **kw):
+    rng = np.random.RandomState(0)
+    sym = _net(model)
+    shapes, _, _ = sym.infer_shape(data=(4, 6))
+    params = {n: mx.nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+    srv = ModelServer()
+    srv.register(model, sym, params, ctx=mx.cpu(), buckets=(1, 4),
+                 async_worker=async_worker, max_delay_ms=0.0,
+                 warmup_shapes={"data": (4, 6)}, **kw)
+    return srv
+
+
+def _x(n=4, seed=3):
+    return np.random.RandomState(seed).uniform(
+        -1, 1, (n, 6)).astype(np.float32)
+
+
+def _deep_eq(a, b):
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return (isinstance(a, np.ndarray) and isinstance(b, np.ndarray)
+                and a.dtype == b.dtype and a.shape == b.shape
+                and a.tobytes() == b.tobytes())
+    if isinstance(a, float) and math.isnan(a):
+        return isinstance(b, float) and math.isnan(b)
+    if isinstance(a, dict):
+        return (isinstance(b, dict) and set(a) == set(b)
+                and all(_deep_eq(a[k], b[k]) for k in a))
+    if isinstance(a, (list, tuple)):
+        return (type(a) is type(b) and len(a) == len(b)
+                and all(_deep_eq(x, y) for x, y in zip(a, b)))
+    return type(a) is type(b) and a == b
+
+
+# ---------------------------------------------------------------------------
+# roundtrip property tests
+# ---------------------------------------------------------------------------
+
+_ALL_DTYPES = ["bool", "int8", "int16", "int32", "int64",
+               "uint8", "uint16", "uint32", "uint64",
+               "float16", "float32", "float64"]
+
+
+class TestCodecRoundtrip:
+    @pytest.mark.parametrize("dtype", _ALL_DTYPES)
+    def test_every_allowlisted_dtype_bitwise(self, dtype):
+        rng = np.random.RandomState(7)
+        dt = np.dtype(dtype)
+        if dt.kind == "b":
+            arr = rng.randint(0, 2, (5, 3)).astype(dt)
+        elif dt.kind in "iu":
+            info = np.iinfo(dt)
+            # full-range extremes (randint can't span uint64) + noise
+            arr = rng.randint(0, 1 << 31, (5, 3)).astype(dt)
+            arr.flat[0] = info.min
+            arr.flat[1] = info.max
+        else:
+            arr = rng.uniform(-1e3, 1e3, (5, 3)).astype(dt)
+        out = codec.decode(codec.encode(arr))
+        assert out.dtype == dt and out.shape == arr.shape
+        assert out.tobytes() == arr.tobytes()
+
+    @pytest.mark.skipif(bfloat16 is None, reason="ml_dtypes missing")
+    def test_bfloat16_bitwise(self):
+        arr = np.arange(-8, 8, 0.5).astype(bfloat16).reshape(4, 8)
+        out = codec.decode(codec.encode(arr))
+        assert out.dtype == np.dtype(bfloat16)
+        assert out.tobytes() == arr.tobytes()
+
+    def test_zero_d_empty_and_noncontiguous(self):
+        cases = [
+            np.array(2.5, np.float64),              # 0-d
+            np.array(7, np.int32),                  # 0-d int
+            np.zeros((0,), np.float32),             # empty
+            np.zeros((3, 0, 5), np.int64),          # empty with dims
+            np.arange(24, dtype=np.int16)[::3],     # strided view
+            np.arange(24, dtype=np.float32).reshape(4, 6).T,  # transpose
+            np.arange(24, dtype=np.uint8).reshape(2, 3, 4)[:, ::2, ::-1],
+        ]
+        for arr in cases:
+            out = codec.decode(codec.encode(arr))
+            assert out.dtype == arr.dtype and out.shape == arr.shape
+            assert out.tobytes() == np.ascontiguousarray(arr).tobytes()
+            assert out.flags["C_CONTIGUOUS"] and out.flags["WRITEABLE"]
+
+    def test_numpy_scalars_keep_their_type(self):
+        for scal in (np.float32(1.25), np.float64(-0.5), np.int64(-9),
+                     np.uint8(255), np.bool_(True), np.float16(2.0)):
+            out = codec.decode(codec.encode(scal))
+            assert type(out) is type(scal)
+            assert out.tobytes() == scal.tobytes()
+
+    def test_scalars_and_containers(self):
+        objs = [None, True, False, 0, -1, 123456789, 2 ** 63 - 1,
+                -(2 ** 63), 2 ** 200, -(2 ** 200), 0.0, -0.0, 3.14159,
+                float("inf"), float("-inf"), float("nan"),
+                "", "ascii", "héllo 世界", b"", b"\x00\xff",
+                [], (), {}, [1, [2, [3, [4]]]],
+                {"a": (1, 2.5, None), "b": {"c": [True, b"x"]}},
+                ("mixed", 1, 2.5, None, True, b"b", [{}], {0: ()})]
+        for obj in objs:
+            assert _deep_eq(codec.decode(codec.encode(obj)),
+                            pickle.loads(pickle.dumps(obj))), obj
+        # float bit-exactness incl. the sign of -0.0 and nan payloads
+        for val in (-0.0, 1e-308, float("nan")):
+            enc = codec.decode(codec.encode(val))
+            assert struct.pack("<d", enc) == struct.pack("<d", val)
+
+    def test_full_predict_request_reply_cycle_bit_identical_to_pickle(self):
+        rng = np.random.RandomState(1)
+        spec = ("predict", "c3-17",
+                {"model": "resnet", "version": None,
+                 "arrays": {"data": rng.uniform(-1, 1, (8, 128))
+                            .astype(np.float32),
+                            "ids": rng.randint(0, 9, (8,)).astype(np.int64)},
+                 "deadline_ms": 83.5, "priority": 2, "trace": "t" * 12,
+                 "t_send": time.time()})
+        reply = ("served", "c3-17",
+                 [rng.uniform(0, 1, (8, 10)).astype(np.float32)],
+                 {"trace": "t" * 12, "wire_ms": 0.731, "queue_ms": 2.0,
+                  "device_ms": 9.25, "total_ms": 11.981})
+        for frame in (spec, reply):
+            safe = codec.decode(codec.encode(frame))
+            via_pickle = pickle.loads(pickle.dumps(frame))
+            assert _deep_eq(safe, via_pickle)
+
+    def test_encode_rejects_unsupported(self):
+        for bad in (object(), {1, 2}, lambda: 0, complex(1, 2),
+                    np.array([1 + 2j]), np.array(["s"], dtype=object)):
+            with pytest.raises(codec.CodecError):
+                codec.encode(bad)
+
+    def test_encode_depth_cap(self):
+        lim = codec.Limits(max_depth=8)
+        nested = [1]
+        for _ in range(20):
+            nested = [nested]
+        with pytest.raises(codec.CodecError):
+            codec.encode(nested, lim)
+
+
+# ---------------------------------------------------------------------------
+# caps before allocation
+# ---------------------------------------------------------------------------
+
+class TestCodecCaps:
+    def _fe(self, payload, limits=None):
+        with pytest.raises(FrameError):
+            codec.decode(payload, limits)
+
+    def test_every_crafted_bomb_is_a_fast_frame_error(self):
+        tic = time.monotonic()
+        for bomb in wire_fuzz.bombs():
+            self._fe(bomb)
+        assert time.monotonic() - tic < 1.0, \
+            "a bomb stalled the decoder — a cap is checked too late"
+
+    def test_shape_bomb_never_allocates(self):
+        import tracemalloc
+        bomb = (codec.MAGIC + b"a\x00\x0b\x01"
+                + struct.pack("<Q", 1 << 40) + struct.pack("<Q", 1 << 43))
+        tracemalloc.start()
+        try:
+            tracemalloc.reset_peak()
+            self._fe(bomb)
+            _, peak = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        assert peak < 1 << 20, \
+            "shape bomb allocated %d bytes before the cap" % peak
+
+    def test_encode_enforces_element_cap_symmetrically(self):
+        """The sender must fail TYPED locally rather than build a frame
+        the peer's decoder rejects as a shape bomb (a rollover tensor
+        over the cap would otherwise break the control session)."""
+        lim = codec.Limits(max_elements=16)
+        with pytest.raises(codec.CodecError):
+            codec.encode(np.zeros(17, np.int8), lim)
+        # default cap aligns with the 1 GiB frame budget: a
+        # legacy-pickle-sized tensor (here 32 MB) encodes fine
+        big = np.zeros(1 << 25, np.int8)
+        assert codec.decode(codec.encode(big)).shape == big.shape
+
+    def test_custom_limits_bind(self):
+        lim = codec.Limits(max_depth=4, max_items=8, max_elements=16)
+        self._fe(codec.encode([[[[1]]]]), lim)            # depth 4 exceeded
+        self._fe(codec.encode(list(range(9))), lim)       # 9 items > 8
+        self._fe(codec.encode(np.zeros(17, np.int8)), lim)  # 17 elems > 16
+        # under the caps all three decode
+        assert codec.decode(codec.encode([[[1]]]), lim) == [[[1]]]
+        assert codec.decode(codec.encode(list(range(8))),
+                            lim) == list(range(8))
+        assert codec.decode(codec.encode(np.zeros(16, np.int8)),
+                            lim).shape == (16,)
+
+    def test_truncations_all_typed(self):
+        frame = codec.encode({"a": np.arange(32, dtype=np.float64),
+                              "b": ["x" * 50, 2 ** 70]})
+        for cut in range(len(frame) - 1, 3, -7):
+            self._fe(frame[:cut])
+
+    def test_fuzz_sweep_decoder_total(self):
+        report = wire_fuzz.run_fuzz(2500, seed=0xC0DEC)
+        assert report["mutations"] == 2500
+        assert report["other_exceptions"] == [], \
+            report["other_exceptions"][:3]
+        # determinism: same seed, same classification
+        assert wire_fuzz.run_fuzz(300) == wire_fuzz.run_fuzz(300)
+
+
+# ---------------------------------------------------------------------------
+# negotiation units
+# ---------------------------------------------------------------------------
+
+class TestNegotiate:
+    def test_highest_common_pair(self):
+        assert wire.negotiate({"protos": [1, 2], "codecs": ["safe"]},
+                              "safe", True) == (2, "safe")
+        assert wire.negotiate({"protos": [1, 2],
+                               "codecs": ["safe", "pickle"]},
+                              "safe", True) == (2, "safe")
+        # a pickle-mode listener prefers pickle but can speak safe
+        assert wire.negotiate({"protos": [2], "codecs": ["safe"]},
+                              "pickle", True) == (2, "safe")
+        # future peer: higher protos collapse to the common max
+        assert wire.negotiate({"protos": [1, 2, 3, 9],
+                               "codecs": ["safe"], "new_field": {"x": 1}},
+                              "safe", True) == (2, "safe")
+
+    def test_no_common_is_typed(self):
+        with pytest.raises(FrameError):
+            wire.negotiate({"protos": [7], "codecs": ["safe"]},
+                           "safe", True)
+        with pytest.raises(FrameError):        # strict: pickle-only peer
+            wire.negotiate({"protos": [1, 2], "codecs": ["pickle"]},
+                           "safe", False)
+
+    def test_resolve_wire_mode_cases_and_env_parity(self):
+        assert wire.resolve_wire_mode("SAFE") == "safe"
+        assert wire.resolve_wire_mode("Pickle") == "pickle"
+        with pytest.raises(MXNetError):
+            wire.resolve_wire_mode("json")
+        # explicit param and env spell the same rule
+        from mxnet_tpu.serving import ServingClient as _SC
+        cli = _SC("127.0.0.1", port=1, wire_mode="PICKLE")
+        assert cli._wire_mode == "pickle"
+        cli.close()
+
+    def test_decode_payload_policy(self):
+        safe = wire.encode_payload({"k": 1}, codec="safe")
+        pick = wire.encode_payload({"k": 1}, codec="pickle")
+        # safe frames decode under EVERY policy (inert data)
+        assert wire.decode_payload(safe, allow_pickle=False) == {"k": 1}
+        assert wire.decode_payload(safe, allow_pickle=True) == {"k": 1}
+        assert wire.decode_payload(pick, allow_pickle=True) == {"k": 1}
+        with pytest.raises(FrameError):
+            wire.decode_payload(pick, allow_pickle=False)
+
+    def test_mac_verified_before_safe_decode(self):
+        """Auth composes codec-independently: a tampered safe frame is
+        an AuthError BEFORE the codec sees a byte."""
+        key = b"k" * 16
+        payload = wire._seal(wire.encode_payload((1, 2), codec="safe"),
+                             key)
+        tampered = payload[:wire.MAC_LEN + 6] + b"\xff" \
+            + payload[wire.MAC_LEN + 7:]
+        with pytest.raises(wire.AuthError):
+            wire._open(tampered, key)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: safe default, rolling upgrade, eviction
+# ---------------------------------------------------------------------------
+
+class TestSafeWireEndToEnd:
+    def test_safe_default_bit_identical_and_negotiated(self):
+        srv = _server()
+        fd = ServingFrontDoor(srv, port=0).start()
+        cli = ServingClient("127.0.0.1", fd.port)     # default: safe
+        try:
+            x = _x()
+            want = np.asarray(srv.predict("wc", {"data": x})[0])
+            out = cli.predict({"data": x}, model="wc", timeout=30.0)
+            np.testing.assert_array_equal(np.asarray(out[0]), want)
+            st = fd.stats()
+            assert st["negotiated_safe"] >= 1
+            assert st["legacy_peers"] == 0
+            # deadline shed still travels typed over the safe wire
+            with pytest.raises(DeadlineExceeded):
+                cli.predict({"data": x}, model="wc", deadline_ms=0.0001,
+                            timeout=30.0)
+        finally:
+            cli.close()
+            fd.drain(timeout=10.0)
+            srv.stop()
+
+    def test_previous_protocol_client_served_bit_identically(self):
+        """Rolling upgrade, in-process half: wire_mode='pickle' IS the
+        previous protocol byte-for-byte (old hello consumed, old codec
+        spoken) — a safe-default gateway serves it identically."""
+        srv = _server()
+        fd = ServingFrontDoor(srv, port=0).start()
+        old = ServingClient("127.0.0.1", fd.port, wire_mode="pickle")
+        new = ServingClient("127.0.0.1", fd.port, wire_mode="safe")
+        try:
+            x = _x()
+            want = np.asarray(srv.predict("wc", {"data": x})[0])
+            got_old = old.predict({"data": x}, model="wc", timeout=30.0)
+            got_new = new.predict({"data": x}, model="wc", timeout=30.0)
+            np.testing.assert_array_equal(np.asarray(got_old[0]), want)
+            np.testing.assert_array_equal(np.asarray(got_new[0]), want)
+            st = fd.stats()
+            assert st["legacy_peers"] >= 1, "old client not detected"
+            assert st["negotiated_safe"] >= 1, "new client not negotiated"
+            assert st["submitted"] == st["served"] + st["shed"] \
+                + st["failed"]
+        finally:
+            old.close()
+            new.close()
+            fd.drain(timeout=10.0)
+            srv.stop()
+
+    def test_previous_protocol_subprocess_served_bit_identically(self):
+        """Rolling upgrade, cross-process half (the acceptance gate): a
+        SUBPROCESS speaking the previous protocol with nothing but the
+        stdlib (8-byte length header + pickle, reads the old hello) is
+        served bit-identically by the v-new safe-default gateway."""
+        srv = _server()
+        fd = ServingFrontDoor(srv, port=0).start()
+        x = _x()
+        want = np.asarray(srv.predict("wc", {"data": x})[0])
+        script = r'''
+import json, pickle, socket, struct, sys
+import numpy as np
+port = int(sys.argv[1])
+H = struct.Struct("<Q")
+def send(sock, obj):
+    p = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(H.pack(len(p)) + p)
+def recv(sock):
+    buf = b""
+    while len(buf) < H.size:
+        buf += sock.recv(H.size - len(buf))
+    (n,) = H.unpack(buf)
+    p = b""
+    while len(p) < n:
+        p += sock.recv(n - len(p))
+    return pickle.loads(p)
+sock = socket.create_connection(("127.0.0.1", port), timeout=30.0)
+hello = recv(sock)                      # the OLD hello: pickle, first
+assert hello[0] == "hello", hello
+x = np.frombuffer(bytes.fromhex(sys.argv[2]),
+                  dtype=np.float32).reshape(4, 6)
+rid = "c%d-1" % hello[1]
+send(sock, ("predict", rid,
+            {"model": "wc", "version": None, "arrays": {"data": x},
+             "deadline_ms": None, "priority": 0, "trace": "oldproto",
+             "t_send": __import__("time").time()}))
+reply = recv(sock)
+assert reply[0] == "served" and reply[1] == rid, reply
+out = np.asarray(reply[2][0])
+print(json.dumps({"dtype": str(out.dtype), "shape": list(out.shape),
+                  "hex": out.tobytes().hex()}))
+'''
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", script, str(fd.port),
+                 x.tobytes().hex()],
+                capture_output=True, text=True, timeout=120)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            rep = json.loads(proc.stdout.strip().splitlines()[-1])
+            got = np.frombuffer(bytes.fromhex(rep["hex"]),
+                                dtype=rep["dtype"]).reshape(rep["shape"])
+            assert got.dtype == want.dtype
+            np.testing.assert_array_equal(got, want)
+            st = fd.stats()
+            assert st["legacy_peers"] >= 1
+            assert st["submitted"] == st["served"] + st["shed"] \
+                + st["failed"]
+        finally:
+            fd.drain(timeout=10.0)
+            srv.stop()
+
+    def test_compat_off_refuses_previous_protocol_typed(self):
+        """Post-migration strictness: with compat off the gateway never
+        unpickles network bytes — a legacy frame is a strike, while the
+        safe client keeps being served on the same gateway."""
+        srv = _server()
+        fd = ServingFrontDoor(srv, port=0, wire_compat=False,
+                              evict_threshold=100).start()
+        cli = ServingClient("127.0.0.1", fd.port, wire_mode="safe")
+        try:
+            # legacy speaker: reads the bootstrap hello, sends pickle
+            sock = socket.create_connection(("127.0.0.1", fd.port),
+                                            timeout=10.0)
+            hello = wire.recv_msg(sock)
+            assert hello[0] == "hello"
+            wire.send_msg(sock, ("predict", "c9-1", {"model": "wc"}),
+                          codec="pickle")
+            sock.settimeout(10.0)
+            # the gateway strikes and closes; EOF, not a pickle reply
+            assert sock.recv(1) == b""
+            sock.close()
+            x = _x()
+            want = np.asarray(srv.predict("wc", {"data": x})[0])
+            out = cli.predict({"data": x}, model="wc", timeout=30.0)
+            np.testing.assert_array_equal(np.asarray(out[0]), want)
+            assert fd.stats()["legacy_peers"] == 0
+        finally:
+            cli.close()
+            fd.drain(timeout=10.0)
+            srv.stop()
+
+    def test_forward_compat_unknown_keys_ignored(self):
+        """A future peer's hello and predict spec carry keys this build
+        has never heard of — both sides ignore them (the negotiated
+        pair still forms, the request still serves)."""
+        srv = _server()
+        fd = ServingFrontDoor(srv, port=0).start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", fd.port),
+                                            timeout=10.0)
+            sock.settimeout(30.0)
+            wire.send_msg(sock, ("hello",
+                                 {"protos": [1, 2, 3], "codecs": ["safe"],
+                                  "compression": "zstd-unsupported",
+                                  "future": {"nested": True}}),
+                          codec="safe")
+            # skip the legacy bootstrap (non-magic), take the hello_ack
+            while True:
+                payload = wire.recv_payload(sock)
+                if codec.sniff(payload):
+                    break
+            ack = codec.decode(payload)
+            assert ack[0] == "hello_ack"
+            assert ack[2]["proto"] == 2 and ack[2]["codec"] == "safe"
+            conn_id = ack[1]
+            x = _x()
+            rid = "c%d-1" % conn_id
+            wire.send_msg(sock, ("predict", rid,
+                                 {"model": "wc", "version": None,
+                                  "arrays": {"data": x},
+                                  "deadline_ms": None, "priority": 0,
+                                  "trace": "fwd", "t_send": time.time(),
+                                  "a_future_spec_key": [1, 2, 3]}),
+                          codec="safe")
+            reply = wire.recv_msg(sock, allow_pickle=False)
+            assert reply[0] == "served" and reply[1] == rid
+            want = np.asarray(srv.predict("wc", {"data": x})[0])
+            np.testing.assert_array_equal(np.asarray(reply[2][0]), want)
+            wire.teardown(sock)
+        finally:
+            fd.drain(timeout=10.0)
+            srv.stop()
+
+    def test_rehello_after_negotiation_is_a_strike(self):
+        """Negotiation is once per connection: a second hello must not
+        renegotiate a safe connection back onto pickle (that would
+        bypass the post-negotiation allow_pickle gate) — it drops the
+        connection like any protocol violation."""
+        srv = _server()
+        fd = ServingFrontDoor(srv, port=0, evict_threshold=100).start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", fd.port),
+                                            timeout=10.0)
+            sock.settimeout(30.0)
+            offer = {"protos": [1, 2], "codecs": ["safe", "pickle"]}
+            wire.send_msg(sock, ("hello", offer), codec="safe")
+            while True:
+                payload = wire.recv_payload(sock)
+                if codec.sniff(payload):
+                    break
+            assert codec.decode(payload)[0] == "hello_ack"
+            before = fd.stats()["negotiated_safe"]
+            wire.send_msg(sock, ("hello", offer), codec="safe")
+            # the gateway strikes and closes — EOF, no second ack
+            deadline = time.monotonic() + 30.0
+            while True:
+                assert time.monotonic() < deadline
+                try:
+                    chunk = sock.recv(4096)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+            assert fd.stats()["negotiated_safe"] == before
+            sock.close()
+        finally:
+            fd.drain(timeout=10.0)
+            srv.stop()
+
+    def test_fuzz_spraying_peer_evicted_accounting_exact(self):
+        """The hostile-peer half of the acceptance gate: a peer
+        spraying seeded fuzzer output is evicted (strikes -> refusal at
+        accept), while a concurrent good client's accounting stays
+        exact."""
+        srv = _server()
+        fd = ServingFrontDoor(srv, port=0, evict_threshold=2,
+                              evict_cooldown_ms=60000.0).start()
+        cli = ServingClient("127.0.0.1", fd.port)   # connects pre-evict
+        try:
+            x = _x()
+            want = np.asarray(srv.predict("wc", {"data": x})[0])
+            out = cli.predict({"data": x}, model="wc", timeout=30.0)
+            np.testing.assert_array_equal(np.asarray(out[0]), want)
+            import random
+            rng = random.Random(0xE71C7)
+            corpus = wire_fuzz.base_corpus()
+            deadline = time.monotonic() + 30.0
+            while fd.stats()["evictions"] < 1:
+                assert time.monotonic() < deadline, \
+                    "sprayer never evicted: %s" % fd.stats()
+                try:
+                    sock = socket.create_connection(
+                        ("127.0.0.1", fd.port), timeout=5.0)
+                    sock.settimeout(5.0)
+                    for _ in range(4):
+                        garbage = wire_fuzz.mutate(rng.choice(corpus), rng)
+                        sock.sendall(struct.pack("<Q", len(garbage))
+                                     + garbage)
+                    # drain until the gateway cuts us off
+                    while sock.recv(4096):
+                        pass
+                except OSError:
+                    pass
+                finally:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            st = fd.stats()
+            assert st["evictions"] >= 1
+            # refused at accept during the cooldown... for NEW
+            # connections; the good client's established connection
+            # keeps serving and its accounting stays exact
+            for _ in range(3):
+                out = cli.predict({"data": x}, model="wc", timeout=30.0)
+                np.testing.assert_array_equal(np.asarray(out[0]), want)
+            st = fd.stats()
+            assert st["submitted"] == st["served"] + st["shed"] \
+                + st["failed"]
+            assert st["served"] >= 4
+        finally:
+            cli.close()
+            fd.drain(timeout=10.0)
+            srv.stop()
+
+    def test_zero_overhead_no_per_request_env_reads(self, monkeypatch):
+        """Every MXNET_SERVING_WIRE* knob is read once at construction:
+        with get_env poisoned across base/wire/codec, dispatch over the
+        safe wire still serves."""
+        srv = _server()
+        fd = ServingFrontDoor(srv, port=0).start()
+        cli = ServingClient("127.0.0.1", fd.port)
+        try:
+            x = _x()
+            cli.predict({"data": x}, model="wc", timeout=30.0)
+            import mxnet_tpu.base as _base
+
+            def _no_env(name, default=None, typ=str):
+                raise AssertionError("per-request env read of %s" % name)
+
+            monkeypatch.setattr(_base, "get_env", _no_env)
+            monkeypatch.setattr("mxnet_tpu.serving.wire.get_env", _no_env)
+            monkeypatch.setattr("mxnet_tpu.serving.codec.get_env", _no_env)
+            for _ in range(3):
+                out = cli.predict({"data": x}, model="wc",
+                                  deadline_ms=5000.0, timeout=30.0)
+                assert out is not None
+            monkeypatch.undo()
+        finally:
+            cli.close()
+            fd.drain(timeout=10.0)
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet control channel negotiation
+# ---------------------------------------------------------------------------
+
+class TestFleetWire:
+    def test_control_channel_negotiates_safe_and_legacy_worker_joins(self):
+        from mxnet_tpu.serving import FleetPool, ReplicaWorker
+        gw = _server("fw")
+        pool = FleetPool(gw, port=0, heartbeat_s=0.25,
+                         connect_deadline_s=1.5).start()
+        wsrv = _server("fw")
+        worker = ReplicaWorker(("127.0.0.1", pool.port), wsrv, port=0,
+                               worker_id="w-safe",
+                               heartbeat_s=0.25).start()
+        try:
+            assert worker.joined.wait(30.0), "safe worker never admitted"
+            assert worker._codec == "safe"
+            handle = pool._workers["w-safe"]
+            assert handle.codec == "safe"
+            # dispatch plane negotiated safe too (derived from the
+            # join's advertised codecs)
+            assert handle.client._wire_mode == "safe"
+            # a previous-protocol worker (wire_mode=pickle: no hello,
+            # pickle join) is admitted through compat and served over a
+            # pickle dispatch/control pair
+            wsrv2 = _server("fw")
+            worker2 = ReplicaWorker(("127.0.0.1", pool.port), wsrv2,
+                                    port=0, worker_id="w-old",
+                                    heartbeat_s=0.25,
+                                    wire_mode="pickle").start()
+            try:
+                assert worker2.joined.wait(30.0), \
+                    "legacy worker never admitted (rolling upgrade broke)"
+                h2 = pool._workers["w-old"]
+                assert h2.codec == "pickle"
+                assert h2.client._wire_mode == "pickle"
+                x = _x()
+                want = np.asarray(gw.predict("fw", {"data": x})[0])
+                np.testing.assert_array_equal(
+                    np.asarray(gw.predict("fw", {"data": x})[0]), want)
+            finally:
+                worker2.stop()
+        finally:
+            worker.stop()
+            pool.stop()
+            gw.stop()
