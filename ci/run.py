@@ -179,6 +179,18 @@ def stage_chaos_smoke(_):
          os.path.join("mxnet_tpu", "io_device.py")], cwd=ROOT)
 
 
+def stage_compile_cache_smoke(_):
+    """Non-slow unified-builder gate (ISSUE 14): subprocess A compiles a
+    serving engine's bucket programs cold into MXNET_TPU_COMPILE_CACHE,
+    subprocess B warm-starts them — B must report persistent-cache-backed
+    compiles, a <= 0.6x warmup ratio, and bit-identical predictions —
+    then tpulint (incl. TPL108 raw-compile) over the migrated modules."""
+    return subprocess.call(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "compile_cache_smoke.py")],
+        env=_env_cpu_mesh(1), cwd=ROOT)
+
+
 def stage_bench_smoke(_):
     """bench.py CPU fallback path must emit its JSON line."""
     env = _env_cpu_mesh(1)
@@ -201,6 +213,7 @@ STAGES = [
     ("wire_fuzz_smoke", stage_wire_fuzz_smoke),
     ("fleet_smoke", stage_fleet_smoke),
     ("chaos_smoke", stage_chaos_smoke),
+    ("compile_cache_smoke", stage_compile_cache_smoke),
     ("bench_smoke", stage_bench_smoke),
 ]
 

@@ -14,6 +14,11 @@ hand (docs/faq/analysis.md has the catalog with examples):
 - TPL107 ``wire-unpickle`` pickle.loads/pickle.load in the serving tier
   outside the ``wire.py`` codec seam — bytes there are network-sourced
   and unpickling them is code execution (ISSUE 13's safe-wire contract)
+- TPL108 ``raw-compile`` direct ``.lower(...)``/``.compile(...)``
+  program builds in ``mxnet_tpu/`` outside the ``compile/builder.py``
+  ProgramBuilder seam — a raw build site dodges the persistent compile
+  cache, the lint sweeps, and the compile counters (ISSUE 14's
+  one-build-path contract)
 
 All rules are static heuristics over the AST — they cannot prove an
 expression is a device array, so genuinely-host uses are silenced with a
@@ -28,7 +33,7 @@ import re
 from .findings import Finding, Severity, apply_pragmas
 
 __all__ = ["lint_source", "is_hot_path", "is_swallow_scope",
-           "is_unpickle_scope", "RULES"]
+           "is_unpickle_scope", "is_raw_compile_scope", "RULES"]
 
 RULES = {
     "TPL000": ("pragma", Severity.ERROR,
@@ -54,11 +59,17 @@ RULES = {
                "pickle.loads/pickle.load in mxnet_tpu/serving/ outside "
                "the wire.py codec seam — serving bytes are "
                "network-sourced and unpickling them is code execution"),
+    "TPL108": ("raw-compile", Severity.ERROR,
+               "direct .lower()/.compile() program build outside the "
+               "compile/builder.py ProgramBuilder seam — it dodges the "
+               "one lower/compile/cache path (persistent cache, lint "
+               "sweeps, compile counters)"),
 }
 
 # directories whose files are fused/serving hot paths (ISSUE 5): host
-# syncs there stall the XLA dispatch pipeline
-_HOT_PARTS = {"module", "parallel", "serving"}
+# syncs there stall the XLA dispatch pipeline ("compile" since ISSUE 14:
+# ProgramBuilder.__call__/aot ARE the dispatch path)
+_HOT_PARTS = {"module", "parallel", "serving", "compile"}
 _HOT_FILES = {"io_device.py"}
 
 # the resilience-critical set (ISSUE 9): modules whose failure handling
@@ -66,7 +77,7 @@ _HOT_FILES = {"io_device.py"}
 # checkpoint, a stale serving weight, or a wedged pipeline nobody can
 # diagnose. TPL106 demands every handler either re-raise, do real
 # handling work, or leave a counter/log-with-counter trail.
-_SWALLOW_PARTS = {"serving", "checkpoint", "parallel"}
+_SWALLOW_PARTS = {"serving", "checkpoint", "parallel", "compile"}
 _SWALLOW_FILES = {"io_device.py"}
 
 _LOGGING_METHODS = frozenset({"debug", "info", "warning", "warn", "error",
@@ -90,6 +101,18 @@ def is_unpickle_scope(path):
     if not parts or parts[-1] in _UNPICKLE_SEAM_FILES:
         return False
     return "serving" in parts[:-1]
+
+
+# TPL108 scope: the whole mxnet_tpu package EXCEPT compile/builder.py —
+# the one place jit.lower(...)/.compile() may be spelled raw (mirrors the
+# TPL107 seam rule; suppress genuinely-host compiles with
+# ``# tpulint: allow-raw-compile <reason>``)
+def is_raw_compile_scope(path):
+    parts = str(path).replace("\\", "/").split("/")
+    if "mxnet_tpu" not in parts[:-1]:
+        return False
+    return not (parts[-1] == "builder.py"
+                and len(parts) >= 2 and parts[-2] == "compile")
 
 
 def _is_inert_stmt(stmt):
@@ -167,11 +190,12 @@ def _str_arg(call, index=0):
 
 class _Analyzer(ast.NodeVisitor):
     def __init__(self, path, hot, registry_text, swallow=False,
-                 unpickle=False):
+                 unpickle=False, rawcompile=False):
         self.path = path
         self.hot = hot
         self.swallow = swallow
         self.unpickle = unpickle
+        self.rawcompile = rawcompile
         self.pickle_aliases = set()
         self.pickle_fn_names = set()
         self.registry = registry_text
@@ -387,6 +411,28 @@ class _Analyzer(ast.NodeVisitor):
                            "through the wire.py codec seam (or pragma "
                            "with the reason the bytes are trusted)")
 
+        # ---- TPL108 raw program build outside the ProgramBuilder seam
+        if self.rawcompile and isinstance(func, ast.Attribute):
+            root = _root_name(func.value)
+            hit = None
+            if func.attr == "lower" and (node.args or node.keywords):
+                # program lowering always takes avals/arrays; str.lower()
+                # never takes arguments
+                hit = ".lower(...)"
+            elif func.attr == "compile" \
+                    and root not in _DEVICE_CALL_SAFE_ROOTS:
+                # covers both jit.compile(...) and the zero-arg
+                # lowered.compile(); re/sre compiles are exempt by root
+                hit = ".compile(...)"
+            if hit is not None:
+                self._emit("TPL108", node,
+                           "%s builds a program outside the "
+                           "compile/builder.py ProgramBuilder seam — "
+                           "route it through a ProgramBuilder so the "
+                           "persistent cache, lint sweeps, and compile "
+                           "counters apply (or pragma with the reason "
+                           "this build is exempt)" % hit)
+
         # ---- TPL105 env registry
         var = self._env_read_var(node)
         if var is not None and var.startswith("MXNET"):
@@ -475,7 +521,7 @@ class _Analyzer(ast.NodeVisitor):
 
 
 def lint_source(source, path="<string>", hot=None, registry_text=None,
-                swallow=None, unpickle=None):
+                swallow=None, unpickle=None, rawcompile=None):
     """Lint one file's source; returns findings with pragmas applied."""
     if hot is None:
         hot = is_hot_path(path)
@@ -483,13 +529,15 @@ def lint_source(source, path="<string>", hot=None, registry_text=None,
         swallow = is_swallow_scope(path)
     if unpickle is None:
         unpickle = is_unpickle_scope(path)
+    if rawcompile is None:
+        rawcompile = is_raw_compile_scope(path)
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
         return [Finding("TPL001", "parse", Severity.ERROR,
                         "syntax error: %s" % e, path, e.lineno or 0)]
     analyzer = _Analyzer(path, hot, registry_text, swallow=swallow,
-                         unpickle=unpickle)
+                         unpickle=unpickle, rawcompile=rawcompile)
     analyzer.visit(tree)
     findings = analyzer.finish()
     findings += apply_pragmas(findings, source, path)

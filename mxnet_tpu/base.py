@@ -17,8 +17,8 @@ integer_types = (int, _np.integer)
 
 __all__ = [
     "MXNetError", "NotSupportedForSparseNDArray", "Params", "param_field",
-    "get_env", "env_flag", "configure_compile_cache", "string_types",
-    "numeric_types", "integer_types",
+    "get_env", "env_flag", "configure_compile_cache", "compile_cache_dir",
+    "string_types", "numeric_types", "integer_types",
 ]
 
 
@@ -134,8 +134,26 @@ def configure_compile_cache():
             jax.config.update(opt, val)
         except Exception:
             pass
+    try:
+        # jax initializes its compilation cache LAZILY on the first
+        # compile and then never re-reads the config — and importing
+        # mxnet_tpu itself triggers a small compile, so by the time this
+        # runs the cache has typically been frozen as "disabled". Reset
+        # it so the next compile re-initializes against the dir above
+        # (without this the env var silently configured a dead cache).
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass
     _compile_cache_state["dir"] = path
     return path
+
+
+def compile_cache_dir():
+    """The persistent compile-cache directory in effect, or None. Pure
+    state read (no env access) — safe on dispatch-adjacent paths like
+    ``profiler.compile_counters``."""
+    return _compile_cache_state["dir"]
 
 
 def atomic_write(fname, data, mode="wb"):

@@ -1,0 +1,10 @@
+"""Program-build layer — ONE lower/compile/cache seam (ROADMAP item 5).
+
+Every graph->executable path in the tree (Executor bind/warmup, the
+serving bucket cache, the fused/sharded train steps) routes through
+:class:`~mxnet_tpu.compile.builder.ProgramBuilder`, so the persistent
+compile cache, tpulint sweeps, and compile counters attach exactly once.
+"""
+from .builder import ProgramBuilder
+
+__all__ = ["ProgramBuilder"]

@@ -63,8 +63,7 @@ class Executor:
         self._grad_names = [n for n in arg_names
                             if self._grad_req.get(n, "null") != "null"]
         self._outputs = None  # lazily materialized (see outputs property)
-        self._cached = {}
-        self._aot = {}  # (is_train, shape-sig) -> AOT-compiled executable
+        self._cached = {}  # ("fwd"/"fb"/"mon", mode) -> ProgramBuilder/jit
         self._monitor_cb = None
         self._monitor_active = False
         self._pending_monitor = []
@@ -241,14 +240,34 @@ class Executor:
         return tuple(outputs), aux_updates
 
     # ------------------------------------------------------------------
-    # compiled entry points (cached; jit recompiles per shape automatically)
+    # compiled entry points — ProgramBuilder per program family (the ONE
+    # lower/compile/cache seam, compile/builder.py): dispatch goes through
+    # the builder, which runs a matching AOT executable when one exists
+    # (warmup/program_cost compiled it) and falls back to jit otherwise
     # ------------------------------------------------------------------
     def _fwd_fn(self, is_train):
         key = ("fwd", is_train)
         if key not in self._cached:
             def f(arg_vals, aux_vals, rng):
                 return self._run_graph(arg_vals, aux_vals, rng, is_train)
-            self._cached[key] = jax.jit(f)
+
+            def _sweep(args):
+                # MXNET_TPU_LINT compile-time passes (docs/faq/analysis.md):
+                # sweep the forward jaxpr for f64 leaks and dead subgraphs /
+                # params unused by any output before paying the XLA compile.
+                # The builder runs this once per distinct program — repeat
+                # warmups neither re-trace nor re-count
+                from .analysis.runtime import check_traced
+                arg_sds, aux_sds, _ = args
+                check_traced(
+                    f, args,
+                    "Executor.warmup(%s)" % self._symbol.list_outputs()[:1],
+                    # pytree flattening order: sorted dict keys, then rng
+                    input_names=(sorted(arg_sds) + sorted(aux_sds) + ["rng"]))
+
+            from .compile.builder import ProgramBuilder
+            self._cached[key] = ProgramBuilder(f, site="executor.forward",
+                                               lint_hook=_sweep)
         return self._cached[key]
 
     def _fb_fn(self, with_out_grads):
@@ -286,7 +305,11 @@ class Executor:
                 grads = vjp(seeds)[0]
                 return outs, aux_upd, grads
 
-            self._cached[key] = jax.jit(f)
+            from .compile.builder import ProgramBuilder
+            # no lint hook: the fused fwd+bwd program is only AOT-built
+            # via program_cost, which never swept (the graph passes run
+            # on the forward program at warmup)
+            self._cached[key] = ProgramBuilder(f, site="executor.train_step")
         return self._cached[key]
 
     # ------------------------------------------------------------------
@@ -294,14 +317,6 @@ class Executor:
     # memory planning that let reference executors serve with zero
     # first-request overhead — here the cost being fronted is XLA compile)
     # ------------------------------------------------------------------
-    @staticmethod
-    def _shape_sig(arg_vals, aux_vals, rng):
-        return (tuple(sorted((n, tuple(v.shape), str(v.dtype))
-                             for n, v in arg_vals.items())),
-                tuple(sorted((n, tuple(v.shape), str(v.dtype))
-                             for n, v in aux_vals.items())),
-                (tuple(rng.shape), str(rng.dtype)))
-
     def warmup(self, is_train=False):
         """Ahead-of-time compile the forward program for the BOUND shapes
         via jit.lower(...).compile(), so the first forward() pays dispatch
@@ -331,24 +346,10 @@ class Executor:
                    for n, a in self.aux_dict.items()}
         rng = _rnd.fixed_key()
         rng_sds = jax.ShapeDtypeStruct(rng.shape, rng.dtype)
-        key = (bool(is_train), self._shape_sig(arg_sds, aux_sds, rng_sds))
-        if key not in self._aot:
-            from .analysis.runtime import lint_enabled
-            if lint_enabled():
-                # MXNET_TPU_LINT compile-time passes (docs/faq/analysis.md):
-                # sweep the forward jaxpr for f64 leaks and dead subgraphs /
-                # params unused by any output before paying the XLA compile.
-                # Inside the miss branch: one sweep per distinct program,
-                # repeat warmups neither re-trace nor re-count
-                from .analysis.runtime import check_traced
-                check_traced(
-                    lambda a, x, r: self._run_graph(a, x, r, bool(is_train)),
-                    (arg_sds, aux_sds, rng_sds),
-                    "Executor.warmup(%s)" % self._symbol.list_outputs()[:1],
-                    # pytree flattening order: sorted dict keys, then rng
-                    input_names=(sorted(arg_sds) + sorted(aux_sds) + ["rng"]))
-            self._aot[key] = self._fwd_fn(bool(is_train)).lower(
-                arg_sds, aux_sds, rng_sds).compile()
+        # the builder caches per distinct program and runs the lint sweep
+        # inside its miss branch — repeat warmups neither re-trace nor
+        # re-count, and forward() dispatches the executable via lookup
+        self._fwd_fn(bool(is_train)).aot(arg_sds, aux_sds, rng_sds)
         return self
 
     def has_compiled_forward(self, is_train=False):
@@ -357,14 +358,6 @@ class Executor:
         of the executor's public surface so callers — Module's serving
         router — need not poke the private jit-cache key format."""
         return ("fwd", bool(is_train)) in self._cached
-
-    def _aot_lookup(self, is_train, arg_vals, aux_vals, rng):
-        if not self._aot or self._group_shardings is not None:
-            # mesh-sharded programs pin their own in_shardings; the AOT
-            # program was lowered for single-device placement
-            return None
-        return self._aot.get(
-            (bool(is_train), self._shape_sig(arg_vals, aux_vals, rng)))
 
     def _next_key(self):
         """Fresh PRNG key for stochastic graphs; the shared constant key
@@ -391,14 +384,20 @@ class Executor:
         rng = _rnd.fixed_key()
         if self._grad_names:
             grad_args = {n: arg_vals.pop(n) for n in self._grad_names}
-            lowered = self._fb_fn(False).lower(grad_args, arg_vals,
-                                               aux_vals, rng)
+            builder = self._fb_fn(False)
+            args = (grad_args, arg_vals, aux_vals, rng)
         else:
-            lowered = self._fwd_fn(True).lower(arg_vals, aux_vals, rng)
+            builder = self._fwd_fn(True)
+            args = (arg_vals, aux_vals, rng)
+        # one lowering, cached in the builder: the compile below reuses
+        # it, a repeat program_cost() re-traces nothing, and the compiled
+        # executable is the SAME object a later forward/backward with
+        # these shapes dispatches (no second program for the analysis)
+        lowered = builder.lowered(*args)
         ca = lowered.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
-        ma = lowered.compile().memory_analysis()
+        ma = builder.aot(*args).memory_analysis()
         return {"flops": float(ca.get("flops", 0.0)),
                 # peak live set (activations included) — temp_size alone
                 # misses buffers XLA classifies as program outputs
@@ -437,10 +436,10 @@ class Executor:
             self._pending_grads = grads
         else:
             # warmed executors dispatch straight into the AOT-compiled
-            # executable — no jit-cache lookup/trace on the serving path
-            aot = self._aot_lookup(is_train, arg_vals, aux_vals, rng)
-            fwd = aot if aot is not None else self._fwd_fn(is_train)
-            outs, aux_upd = fwd(arg_vals, aux_vals, rng)
+            # executable — the builder's lookup path; no trace, no
+            # jit-cache walk on the serving path (group-sharded programs
+            # never warm, so they always take the builder's jit branch)
+            outs, aux_upd = self._fwd_fn(is_train)(arg_vals, aux_vals, rng)
             self._pending_grads = None
         if _profiling:
             jax.block_until_ready(outs)

@@ -448,6 +448,24 @@ class Module(BaseModule):
         self.logger.info("kvstore=%s: fused train step active "
                          "(fwd+bwd+allreduce+%s in one XLA program over %d "
                          "device(s))", kvstore_type, fused_name, len(devices))
+        # AOT warmup for TRAINING (ISSUE 14) — pre-pay the fused-step
+        # compile from abstract shapes before the first batch, the same
+        # front-loading serving warmup has always done; with
+        # MXNET_TPU_COMPILE_CACHE set a warm restart turns this into a
+        # persistent-cache disk read. Opt out with MXNET_TPU_TRAIN_AOT=0.
+        if get_env("MXNET_TPU_TRAIN_AOT", 1, int):
+            dtypes = {d.name: d.dtype
+                      for d in list(self._data_shapes)
+                      + list(self._label_shapes or [])}
+            try:
+                step.warmup(dtypes)
+            except Exception as e:
+                # a dtype/shape guess the real batch contradicts only
+                # forfeits the pre-pay: the first step jit-compiles
+                # exactly as without warmup
+                self.logger.warning(
+                    "fused-step AOT warmup failed (first batch will "
+                    "compile instead): %s", e)
 
     def _fused_lr(self):
         """Per-step learning rate honoring the optimizer's lr scheduler

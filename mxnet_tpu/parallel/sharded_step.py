@@ -176,14 +176,43 @@ class ShardedTrainStep:
             lambda s: NamedSharding(self.mesh, s), opt_specs,
             is_leaf=lambda x: isinstance(x, P))
         self._batch_sharding = NamedSharding(self.mesh, self.batch_spec)
-        self._step_fn = jax.jit(
-            step,
+        # the ONE lower/compile/cache path (compile/builder.py): same
+        # dispatch semantics as the bare jit, plus warmup() AOT and the
+        # per-site compile counters
+        from ..compile.builder import ProgramBuilder
+        self._step_fn = ProgramBuilder(
+            step, site="train.sharded_step",
             in_shardings=(param_sh, opt_sh, None),
             out_shardings=(param_sh, opt_sh, NamedSharding(self.mesh, P())),
             donate_argnums=(0, 1))
         self.opt_state = self._shard(self.opt_state, opt_specs)
 
     # ------------------------------------------------------------------
+    def warmup(self, batch):
+        """Ahead-of-time compile the sharded step from abstract shapes.
+        ``batch`` is a pytree of arrays OR ShapeDtypeStruct-likes shaped
+        like one GLOBAL batch; params/opt state shapes come from init().
+        First step then pays dispatch only (and mostly disk with
+        MXNET_TPU_COMPILE_CACHE set). Returns self."""
+        if self._step_fn is None:
+            raise MXNetError("call init() first")
+
+        def sds(tree, sharding=None):
+            # the batch arg has no jit-level in_sharding (unlike params/
+            # state), so its abstract leaves must carry the dispatch-time
+            # sharding explicitly or the executable would expect
+            # unsharded inputs
+            return jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    tuple(getattr(x, "shape", _np.shape(x))),
+                    getattr(x, "dtype", _np.dtype(_np.float32)),
+                    sharding=sharding),
+                tree)
+
+        self._step_fn.aot(sds(self.params), sds(self.opt_state),
+                          sds(batch, sharding=self._batch_sharding))
+        return self
+
     def __call__(self, batch):
         """One step on a global batch (pytree of numpy/jax arrays)."""
         if self._step_fn is None:

@@ -382,9 +382,18 @@ class DataParallelTrainStep:
         from ..analysis.runtime import lint_enabled
         if lint_enabled():
             self._lint_step(step, donate_argnums)
-        self._step = jax.jit(step, in_shardings=in_shardings,
-                             out_shardings=out_shardings,
-                             donate_argnums=donate_argnums)
+        # the ONE lower/compile/cache path (compile/builder.py): dispatch
+        # goes through the builder — straight into the AOT executable
+        # after warmup() (fit pre-pays the compile), the usual jit
+        # trace/compile otherwise. No lint hook here: the fused step's
+        # jaxpr sweep stays deferred to the first __call__ (real batch
+        # dtypes are only known then — see _lint_step).
+        from ..compile.builder import ProgramBuilder
+        self._step = ProgramBuilder(step, site="train.fused_step",
+                                    donate_argnums=donate_argnums,
+                                    in_shardings=in_shardings,
+                                    out_shardings=out_shardings)
+        self._batch_shapes = {k: tuple(v) for k, v in batch_shapes.items()}
 
     def _lint_step(self, step, donate_argnums):
         """MXNET_TPU_LINT compile-time passes over the fused step
@@ -411,6 +420,43 @@ class DataParallelTrainStep:
         self._lint_sweep_pending = True
 
     # ------------------------------------------------------------------
+    def warmup(self, batch_dtypes=None):
+        """Ahead-of-time compile the fused step from ABSTRACT shapes, so
+        the first batch pays dispatch only — the AOT warmup training
+        lacked while serving had it (ISSUE 14). ``Module.fit`` calls this
+        between optimizer init and the first batch (MXNET_TPU_TRAIN_AOT).
+
+        ``batch_dtypes`` maps input/label name -> numpy dtype (default
+        float32 — the NDArrayIter contract). A mismatch with the real
+        batch is harmless: the builder's dispatch lookup misses and the
+        step jit-compiles exactly as without warmup. With
+        ``MXNET_TPU_COMPILE_CACHE`` set the compile itself is mostly a
+        persistent-cache disk read on warm restarts. Returns self."""
+        if self._step is None:
+            raise MXNetError("call init() first")
+        dts = {k: _np.dtype(v) for k, v in (batch_dtypes or {}).items()}
+        f32 = _np.dtype(_np.float32)
+
+        def sds(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
+                tree)
+
+        def batch_sds(names):
+            return {n: jax.ShapeDtypeStruct(self._batch_shapes[n],
+                                            dts.get(n, f32))
+                    for n in names
+                    if n in self._batch_shapes and n in self.arg_names}
+
+        from .. import random as _rnd
+        key = _rnd.fixed_key()
+        self._step.aot(
+            sds(self.params), sds(self.opt_state), sds(self.aux),
+            batch_sds(self.data_names), batch_sds(self.label_names),
+            jax.ShapeDtypeStruct(tuple(key.shape), key.dtype),
+            jax.ShapeDtypeStruct((), f32))
+        return self
+
     def __call__(self, batch_np, rng=None, lr=None):
         """Run one step on a global batch (dict name->numpy or jax.Array).
 

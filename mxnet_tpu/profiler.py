@@ -23,7 +23,10 @@ __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
            "record_retry", "retry_counters",
            "record_watchdog_event", "watchdog_counters",
            "record_fault_injection", "fault_counters",
-           "record_fleet_event", "fleet_counters"]
+           "record_fleet_event", "fleet_counters",
+           "record_compile", "record_compile_hit", "compile_counters",
+           "ensure_compile_listener", "persistent_cache_hit_count",
+           "thread_persistent_cache_hits"]
 
 _state = {"running": False, "filename": "profile.json", "events": [],
           "jax_trace_dir": None, "lock": threading.Lock()}
@@ -457,6 +460,110 @@ def fault_counters(reset=False):
         if reset:
             _faults.clear()
             _faults["injected"] = 0
+    return out
+
+
+# ----------------------------------------------------------------------
+# program-build counters (ISSUE 14): every lower/compile in the tree now
+# runs through compile.builder.ProgramBuilder, which records here —
+# always-on plain adds like the pipeline family. Per site (executor,
+# serving.<model>, train.fused_step, ...): compiles, wall-clock compile
+# ms, AOT vs on-demand split, in-process cache hits, and how many
+# compiles were served by the PERSISTENT cross-process cache
+# (MXNET_TPU_COMPILE_CACHE) — the fleet cold-start/scale-up signal a
+# rollover compile stampede shows up in (ModelServer.health()'s
+# compiles_in_window reads this family).
+# ----------------------------------------------------------------------
+_COMPILE_ZERO = {"compiles": 0, "compile_ms": 0.0, "aot": 0,
+                 "ondemand": 0, "cache_hits": 0, "persistent_hits": 0}
+_compile_total = dict(_COMPILE_ZERO)
+_compile_sites = {}
+_pcache = {"hits": 0, "listener": False}
+_pcache_tls = threading.local()
+
+
+def _pcache_listener(event, **kwargs):
+    # jax.monitoring fires this name once per compile served from the
+    # persistent compilation cache (any jax version that lacks the event
+    # simply never calls us). It fires SYNCHRONOUSLY on the thread
+    # running the compile, so the thread-local count lets a builder
+    # attribute a hit to ITS compile even while another thread's compile
+    # (compile-outside-lock) is in flight.
+    if event == "/jax/compilation_cache/cache_hits":
+        _pcache_tls.hits = getattr(_pcache_tls, "hits", 0) + 1
+        with _state["lock"]:
+            _pcache["hits"] += 1
+
+
+def ensure_compile_listener():
+    """Register the jax.monitoring listener that counts persistent
+    compile-cache hits. Idempotent; called once per ProgramBuilder
+    construction (never on a dispatch path)."""
+    with _state["lock"]:
+        if _pcache["listener"]:
+            return
+        _pcache["listener"] = True
+    try:
+        from jax import monitoring as _monitoring
+        _monitoring.register_event_listener(_pcache_listener)
+    except Exception:
+        # jax without the monitoring API: persistent hits read 0, the
+        # compile_ms counters still carry the cold/warm signal
+        _pcache["listener"] = False
+
+
+def persistent_cache_hit_count():
+    """Raw count of jax persistent-compilation-cache hits observed this
+    process (the process-wide figure `compile_counters()` reports)."""
+    with _state["lock"]:
+        return _pcache["hits"]
+
+
+def thread_persistent_cache_hits():
+    """Persistent-cache hits observed on THIS thread — what builders
+    diff around a compile to attribute the hit, so concurrent compiles
+    on other threads can never cross-contaminate the attribution."""
+    return getattr(_pcache_tls, "hits", 0)
+
+
+def record_compile(site, compile_ms, aot=True, persistent_hit=False):
+    """Record one program compile at `site`: wall-clock ms, whether it
+    was ahead-of-time (warmup) or on-demand (first dispatch paid it),
+    and whether the XLA executable came from the persistent cache."""
+    with _state["lock"]:
+        for d in (_compile_total,
+                  _compile_sites.setdefault(site, dict(_COMPILE_ZERO))):
+            d["compiles"] += 1
+            d["compile_ms"] += float(compile_ms)
+            d["aot" if aot else "ondemand"] += 1
+            if persistent_hit:
+                d["persistent_hits"] += 1
+
+
+def record_compile_hit(site):
+    """Record one execution served by an already-built cached program."""
+    with _state["lock"]:
+        for d in (_compile_total,
+                  _compile_sites.setdefault(site, dict(_COMPILE_ZERO))):
+            d["cache_hits"] += 1
+
+
+def compile_counters(reset=False):
+    """Snapshot (optionally reset) the program-build counters:
+    ``{"total": {...}, "sites": {site: {...}}, "persistent_cache_hits":
+    N, "persistent_cache_dir": path-or-None}``. compile_ms values are
+    cumulative wall-clock milliseconds."""
+    from .base import compile_cache_dir
+    with _state["lock"]:
+        out = {"total": dict(_compile_total),
+               "sites": {k: dict(v) for k, v in _compile_sites.items()},
+               "persistent_cache_hits": _pcache["hits"],
+               "persistent_cache_dir": compile_cache_dir()}
+        if reset:
+            _compile_total.clear()
+            _compile_total.update(_COMPILE_ZERO)
+            _compile_sites.clear()
+            _pcache["hits"] = 0
     return out
 
 

@@ -216,7 +216,11 @@ class InferenceEngine:
 
         self._cache = BucketedProgramCache(_serve, buckets=buckets,
                                            donate=donate,
-                                           device=self._device)
+                                           device=self._device,
+                                           # per-model compile attribution
+                                           # (serving.<name>) for the
+                                           # health stampede signal
+                                           site=self._lat_key)
         self._batcher = DynamicBatcher(self._run_padded, self._cache.buckets,
                                        max_batch=max_batch,
                                        max_delay_ms=max_delay_ms,

@@ -27,7 +27,7 @@ import threading
 
 import numpy as _np
 
-from ..base import MXNetError, configure_compile_cache
+from ..base import MXNetError
 
 __all__ = ["BucketedProgramCache", "DEFAULT_BUCKETS", "bucket_for"]
 
@@ -57,19 +57,6 @@ def _donate_supported():
         return False
 
 
-class _PendingProgram:
-    """Placeholder parked in the program map while its owner compiles —
-    other threads wanting the SAME program wait on `ready`; threads
-    wanting other (cached) programs sail past without touching it."""
-
-    __slots__ = ("ready", "program", "error")
-
-    def __init__(self):
-        self.ready = threading.Event()
-        self.program = None
-        self.error = None
-
-
 class BucketedProgramCache:
     """Compile-once store of per-bucket XLA executables for one model.
 
@@ -90,10 +77,14 @@ class BucketedProgramCache:
         pins jit's default device, so a non-default target (e.g. tpu(1))
         must be named explicitly or every call would hit a committed-
         device mismatch. None keeps the default.
+    site : str
+        Compile-counter label (``profiler.compile_counters()``); the
+        serving engine passes its latency key (``serving.<model>``) so a
+        rollover/rejoin compile stampede is attributable per model.
     """
 
     def __init__(self, fn, buckets=DEFAULT_BUCKETS, donate="auto",
-                 device=None):
+                 device=None, site="serving"):
         if not buckets:
             raise MXNetError("program cache needs at least one bucket")
         self._buckets = tuple(sorted(int(b) for b in buckets))
@@ -114,14 +105,20 @@ class BucketedProgramCache:
         # donate_argnums=0: only the per-request batch dict is donated;
         # the params/aux dicts are long-lived and survive every call
         self._donate_argnums = (0,) if self._donate else ()
-        self._jit = jax.jit(fn, donate_argnums=self._donate_argnums)
+        # the ONE lower/compile/cache path (compile/builder.py): the
+        # builder owns key -> lowered -> executable with compile-outside-
+        # lock concurrency, the persistent compile cache, the compile
+        # counters, and runs _lint_compile_hook once per distinct program
+        from ..compile.builder import ProgramBuilder
+        self._builder = ProgramBuilder(fn, site=site,
+                                       donate_argnums=self._donate_argnums,
+                                       lint_hook=self._lint_compile_hook)
         self._sharding = None
         if device is not None and device != jax.devices()[0]:
             # abstract lowering otherwise pins jit's default device; a
             # sharding-annotated ShapeDtypeStruct pins the real target
             from jax.sharding import SingleDeviceSharding
             self._sharding = SingleDeviceSharding(device)
-        self._programs = {}          # key -> compiled executable
         self._lock = threading.Lock()
         self.compiles = 0            # programs built (AOT or on demand)
         self.hits = 0                # executions served by a cached program
@@ -135,7 +132,8 @@ class BucketedProgramCache:
         # survive (GC pause, GIL handoff, scheduler hiccup). Compile-
         # bearing samples are the caller's job to exclude.
         self._step_time = {}         # bucket -> [ewma_s, n_samples, tail_s]
-        configure_compile_cache()    # MXNET_TPU_COMPILE_CACHE, idempotent
+        # MXNET_TPU_COMPILE_CACHE wiring (configure_compile_cache) now
+        # happens once inside the ProgramBuilder construction above
 
     # ------------------------------------------------------------------
     @property
@@ -191,14 +189,6 @@ class BucketedProgramCache:
             return rec[1] if rec is not None else 0
 
     # ------------------------------------------------------------------
-    @staticmethod
-    def _key(batch_sds, param_sds, aux_sds, rng_sd):
-        def sig(d):
-            return tuple(sorted((k, tuple(v.shape), str(v.dtype))
-                                for k, v in d.items()))
-        return (sig(batch_sds), sig(param_sds), sig(aux_sds),
-                tuple(rng_sd.shape), str(rng_sd.dtype))
-
     def _abstract(self, shape, dtype):
         import jax
         if self._sharding is not None:
@@ -210,75 +200,43 @@ class BucketedProgramCache:
         return {k: self._abstract(tuple(_np.shape(v)), v.dtype)
                 for k, v in tree.items()}
 
-    def _compile(self, batch_sds, param_sds, aux_sds, rng_sd):
-        """Lower + compile ONE program for the given abstract shapes.
-
-        Pure-shape AOT: nothing executes, no real buffers are consumed, so
-        warmup can run before any traffic (and before params are final —
-        only their shapes/dtypes matter)."""
-        if self._lint:
-            # MXNET_TPU_LINT compile-time passes (docs/faq/analysis.md):
-            # the serving donation contract (only the per-request batch
-            # may be donated — a donated weight buffer is freed under the
-            # next request), then a jaxpr sweep for f64 leaks and dead
-            # subgraphs, all before the (much costlier) XLA compile
-            from ..analysis.graph_passes import check_donation
-            from ..analysis.runtime import check_traced, report_findings
-            if not self._lint_donation_checked:
-                # the donate spec is cache-wide — one report, not one per
-                # bucket compile
-                self._lint_donation_checked = True
-                report_findings(check_donation(
-                    self._donate_argnums, ("batch", "params", "aux", "rng"),
-                    mode="serving", where="program_cache.compile"))
-            check_traced(self._fn,
-                         (batch_sds, param_sds, aux_sds, rng_sd),
-                         "serving program (batch=%s)"
-                         % sorted((k, tuple(v.shape))
-                                  for k, v in batch_sds.items()))
-        lowered = self._jit.lower(batch_sds, param_sds, aux_sds, rng_sd)
-        return lowered.compile()
+    def _lint_compile_hook(self, args):
+        """MXNET_TPU_LINT compile-time passes (docs/faq/analysis.md),
+        invoked by the builder ONCE per distinct program, before the
+        XLA compile: the serving donation contract (only the per-request
+        batch may be donated — a donated weight buffer is freed under the
+        next request), then a jaxpr sweep for f64 leaks and dead
+        subgraphs."""
+        from ..analysis.graph_passes import check_donation
+        from ..analysis.runtime import check_traced, report_findings
+        batch_sds = args[0]
+        if not self._lint_donation_checked:
+            # the donate spec is cache-wide — one report, not one per
+            # bucket compile
+            self._lint_donation_checked = True
+            report_findings(check_donation(
+                self._donate_argnums, ("batch", "params", "aux", "rng"),
+                mode="serving", where="program_cache.compile"))
+        check_traced(self._fn, args,
+                     "serving program (batch=%s)"
+                     % sorted((k, tuple(v.shape))
+                              for k, v in batch_sds.items()))
 
     def _get(self, batch_sds, param_sds, aux_sds, rng_sd, count=True):
-        key = self._key(batch_sds, param_sds, aux_sds, rng_sd)
+        # two threads racing the same bucket produce ONE compile (the
+        # counter is the test contract) and compiles never stall dispatch
+        # of already-cached bucket programs — both owned by the builder's
+        # claim-under-lock/compile-outside-it pipeline now
+        prog, built = self._builder.aot_info(
+            batch_sds, param_sds, aux_sds, rng_sd,
+            mode="ondemand" if count else "aot")
         with self._lock:
-            entry = self._programs.get(key)
-            if entry is None:
-                # claim the compile under the lock (two threads racing the
-                # same bucket must produce ONE compile — the counter is
-                # the test contract), but COMPILE outside it: a
-                # multi-second on-demand XLA compile must not stall
-                # dispatch of already-cached bucket programs
-                entry = _PendingProgram()
-                self._programs[key] = entry
-                owner = True
-            else:
-                owner = False
-        if not owner:
-            if isinstance(entry, _PendingProgram):
-                entry.ready.wait()
-                if entry.error is not None:
-                    raise entry.error
-                entry = entry.program
-            with self._lock:
+            if built:
+                self.compiles += 1
                 if count:
-                    self.hits += 1
-            return entry
-        try:
-            prog = self._compile(batch_sds, param_sds, aux_sds, rng_sd)
-        except BaseException as e:
-            entry.error = e
-            with self._lock:  # next request retries the compile
-                self._programs.pop(key, None)
-            entry.ready.set()
-            raise
-        entry.program = prog
-        with self._lock:
-            self._programs[key] = prog
-            self.compiles += 1
-            if count:
-                self.misses += 1
-        entry.ready.set()
+                    self.misses += 1
+            elif count:
+                self.hits += 1
         return prog
 
     # ------------------------------------------------------------------
@@ -336,6 +294,7 @@ class BucketedProgramCache:
             tail_ms = {str(b): round(rec[2] * 1e3, 3)
                        for b, rec in sorted(self._step_time.items())}
         return {"compiles": self.compiles, "hits": self.hits,
-                "misses": self.misses, "programs": len(self._programs),
+                "misses": self.misses,
+                "programs": self._builder.program_count(),
                 "donate": self._donate, "step_time_ms": step_ms,
                 "step_tail_ms": tail_ms}

@@ -1205,6 +1205,19 @@ class ModelServer:
             reps = [rep for rep_list in versions.values()
                     for rep in rep_list]
             breakers = [rep.breaker.snapshot() for rep in reps]
+            # compile stampede signal (ISSUE 14): XLA compiles charged to
+            # this model since the previous health() poll — a rollover or
+            # rejoining worker re-compiling its buckets shows up here
+            # beside queue-wait p95, so the autoscaler can tell "slow
+            # because compiling" from "slow because overloaded". Same
+            # windowing contract as the queue percentiles: one poller
+            # owns the window.
+            site = _prof.compile_counters()["sites"].get(
+                "serving.%s" % name, {})
+            ckey = "compile:%s" % name
+            cur = (site.get("compiles", 0), site.get("compile_ms", 0.0))
+            prev = self._health_prev_counts.get(ckey, (0, 0.0))
+            self._health_prev_counts[ckey] = cur
             models[name] = {
                 "default_version": str(default),
                 "versions": sorted(str(v) for v in versions),
@@ -1227,6 +1240,8 @@ class ModelServer:
                 "shed_rate": (round(counters.get("shed", 0)
                                     / float(submitted), 4)
                               if submitted else 0.0),
+                "compiles_in_window": cur[0] - prev[0],
+                "compile_ms_in_window": round(cur[1] - prev[1], 3),
             }
         return {"ok": True, "models": models, "time": time.time()}
 
@@ -1260,5 +1275,10 @@ class ModelServer:
                 # trailing dot: "serving.res" must not absorb
                 # "serving.resnet.*"
                 "latency": _prof.latency_counters(
-                    prefix="serving.%s." % name)}
+                    prefix="serving.%s." % name),
+                # program-build accounting for this model's engines
+                # (ISSUE 14): cumulative compiles/compile_ms, AOT vs
+                # on-demand split, persistent-cache-backed compiles
+                "compile": _prof.compile_counters()["sites"].get(
+                    "serving.%s" % name, {})}
         return out

@@ -607,6 +607,103 @@ class TestWireUnpickle:
 
 
 # ----------------------------------------------------------------------
+# TPL108 raw-compile (ISSUE 14: every program build stays inside the
+# compile/builder.py ProgramBuilder seam)
+# ----------------------------------------------------------------------
+class TestRawCompile:
+    SCOPED = "mxnet_tpu/serving/program_cache.py"
+
+    def test_lower_and_compile_flagged(self):
+        bad = """
+            import jax
+            def build(fn, sds):
+                low = jax.jit(fn).lower(sds)
+                return low.compile()
+        """
+        f = _active(_lint(bad, path=self.SCOPED))
+        assert [x.rule_id for x in f] == ["TPL108", "TPL108"]
+
+    def test_one_liner_lower_compile_flagged_twice(self):
+        bad = """
+            import jax
+            def build(fn, sds):
+                return jax.jit(fn).lower(sds).compile()
+        """
+        f = _active(_lint(bad, path=self.SCOPED))
+        assert [x.rule_id for x in f] == ["TPL108", "TPL108"]
+
+    def test_str_lower_and_re_compile_clean(self):
+        # zero-arg .lower() is the str method; re/sre roots are compilers
+        # of regexes, not programs
+        src = """
+            import re
+            def f(name, pat):
+                return name.lower(), re.compile(pat)
+        """
+        assert not _active(_lint(src, path=self.SCOPED), rule="TPL108")
+
+    def test_builder_seam_exempt(self):
+        src = """
+            import jax
+            def build(fn, sds):
+                return jax.jit(fn).lower(sds).compile()
+        """
+        assert not _active(
+            _lint(src, path="mxnet_tpu/compile/builder.py"),
+            rule="TPL108")
+
+    def test_outside_package_exempt(self):
+        src = """
+            import jax
+            def build(fn, sds):
+                return jax.jit(fn).lower(sds).compile()
+        """
+        for path in ("tools/cc_probe.py", "tests/python/unittest/t.py",
+                     "bench.py"):
+            assert not _active(_lint(src, path=path), rule="TPL108")
+
+    def test_scope_helper(self):
+        from mxnet_tpu.analysis.rules import is_raw_compile_scope
+        assert is_raw_compile_scope("mxnet_tpu/executor.py")
+        assert is_raw_compile_scope("mxnet_tpu/serving/program_cache.py")
+        assert is_raw_compile_scope("mxnet_tpu/compile/__init__.py")
+        assert not is_raw_compile_scope("mxnet_tpu/compile/builder.py")
+        assert not is_raw_compile_scope("tools/tpulint.py")
+
+    def test_pragma_suppresses_with_reason(self):
+        src = """
+            import jax
+            def oracle(fn, sds):
+                return jax.jit(fn).lower(sds).compile()  # tpulint: allow-raw-compile off-path numerics oracle, never cached or served
+        """
+        findings = _lint(src, path=self.SCOPED)
+        assert not _active(findings)
+        assert sum(1 for f in findings
+                   if f.rule_id == "TPL108" and f.suppressed) == 2
+
+    def test_shipped_tree_is_tpl108_clean(self):
+        """The seam holds on the real tree: after the ISSUE-14 migration
+        no mxnet_tpu module outside compile/builder.py builds a program
+        raw (unsuppressed)."""
+        import mxnet_tpu
+        root = os.path.dirname(mxnet_tpu.__file__)
+        bad = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fname)
+                rel = os.path.join(
+                    "mxnet_tpu", os.path.relpath(full, root))
+                with open(full, encoding="utf-8") as fh:
+                    src = fh.read()
+                bad += [f for f in lint_source(src, rel)
+                        if f.rule_id == "TPL108" and not f.suppressed]
+        assert not bad, bad
+
+
+# ----------------------------------------------------------------------
 # TPL201 f64 leaks (symbol + jaxpr)
 # ----------------------------------------------------------------------
 class TestF64:

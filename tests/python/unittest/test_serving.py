@@ -352,9 +352,10 @@ def test_executor_warmup_aot_matches_jit_path():
         if n not in ("data", "softmax_label"):
             a[:] = rng.normal(0, 1, a.shape).astype(np.float32)
     exe.aux_dict["bn1_moving_var"][:] = 1.0
-    assert exe.warmup() is exe and len(exe._aot) == 1
+    assert exe.warmup() is exe \
+        and exe._fwd_fn(False).program_count() == 1
     exe.warmup()                             # idempotent: no second program
-    assert len(exe._aot) == 1
+    assert exe._fwd_fn(False).program_count() == 1
     x = mx.nd.array(rng.normal(0, 1, (4, 6)).astype(np.float32))
     out = exe.forward(is_train=False, data=x)[0].asnumpy()
     exe2 = sym.simple_bind(mx.cpu(), grad_req="null", data=(4, 6),

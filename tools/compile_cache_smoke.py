@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""CI compile-cache smoke (`ci/run.py compile_cache_smoke` stage, ISSUE 14).
+
+Fast, non-slow gate over the unified ProgramBuilder seam:
+  * cross-process executable reuse: subprocess A compiles a serving
+    engine's bucket programs COLD into a fresh `MXNET_TPU_COMPILE_CACHE`
+    dir; subprocess B warm-starts the SAME programs — B must report
+    persistent-cache-backed compiles (`profiler.compile_counters()`
+    `persistent_hits`) and its warmup wall-time must come in at <= 0.6x
+    of A's (the bench `compile_cache` phase banks the tighter <= 0.5
+    ratio; this gate allows CI-host noise);
+  * bit-identity: both processes print the same prediction for the same
+    seeded input (the executable that came off disk computes what the
+    cold-compiled one did);
+  * builder-seam lint: tpulint over the migrated modules must be TPL108
+    clean — no raw .lower()/.compile() program build outside
+    compile/builder.py.
+
+Prints one JSON summary line; non-zero exit on any violated contract.
+
+Run directly:  python tools/compile_cache_smoke.py
+As the child:  python tools/compile_cache_smoke.py --child
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+HIDDEN = 32
+BUCKETS = (1, 4, 8)
+DATA_SHAPE = (8, 16)
+MODEL = "ccsmoke"
+
+
+def _net():
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=HIDDEN, name="cc_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="cc_fc1")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params(sym, seed=0):
+    import numpy as np
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(seed)
+    shapes, _, _ = sym.infer_shape(data=DATA_SHAPE)
+    return {n: mx.nd.array(rng.normal(0, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+def build_worker(model=MODEL, ctx=None):
+    """`LocalProcessLauncher` builder spec (``compile_cache_smoke:
+    build_worker``) — a populated, WARMED ModelServer over the smoke
+    net, used by the bench `compile_cache` phase to measure worker
+    warmup-to-admission cold vs warm."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import ModelServer
+    sym = _net()
+    srv = ModelServer()
+    srv.register(model, sym, _params(sym), ctx=ctx or mx.cpu(),
+                 buckets=BUCKETS, max_delay_ms=0.5,
+                 warmup_shapes={"data": DATA_SHAPE})
+    return srv
+
+
+def child():
+    """Build + warm one serving engine; print warmup timing, compile
+    counters, and a seeded prediction (for the cross-process
+    bit-identity check)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import InferenceEngine
+
+    rng = np.random.RandomState(0)
+    sym = _net()
+    params = _params(sym)
+    eng = InferenceEngine(sym, params, {}, ctx=mx.cpu(), buckets=BUCKETS,
+                          async_worker=False, name=MODEL)
+    try:
+        t0 = time.perf_counter()
+        compiled = eng.warmup({"data": DATA_SHAPE})
+        warmup_ms = (time.perf_counter() - t0) * 1e3
+        x = rng.normal(0, 1, (4, 16)).astype(np.float32)
+        pred = np.asarray(eng.predict({"data": x})[0])
+        site = profiler.compile_counters()["sites"].get(
+            "serving.%s" % MODEL, {})
+        print(json.dumps({
+            "warmup_ms": round(warmup_ms, 2),
+            "compiled": compiled,
+            "compiles": site.get("compiles", 0),
+            "persistent_hits": site.get("persistent_hits", 0),
+            "cache_dir": profiler.compile_counters()[
+                "persistent_cache_dir"],
+            "pred_digest": [round(float(v), 8)
+                            for v in pred.ravel()[:8]]}), flush=True)
+    finally:
+        eng.stop()
+    return 0
+
+
+def _run_child(env):
+    out = subprocess.run([sys.executable, os.path.abspath(__file__),
+                          "--child"], env=env, capture_output=True,
+                         text=True, timeout=600)
+    if out.returncode != 0:
+        print(out.stdout[-2000:])
+        print(out.stderr[-4000:], file=sys.stderr)
+        raise SystemExit("compile_cache_smoke: child failed rc=%d"
+                         % out.returncode)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    import tempfile
+
+    fails = []
+    with tempfile.TemporaryDirectory(prefix="cc_smoke_") as cache_dir:
+        env = dict(os.environ)
+        env["MXNET_TPU_COMPILE_CACHE"] = cache_dir
+        env["JAX_PLATFORMS"] = "cpu"
+        # one-device program in both processes: the virtual multi-device
+        # mesh flag would only slow the compiles this gate is timing
+        env.pop("XLA_FLAGS", None)
+        # a pre-warmed shared jax cache (the bench harness sets one for
+        # its children) would make the COLD process warm — the whole
+        # point is the fresh tmp dir above
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        cold = _run_child(env)
+        warm = _run_child(env)
+
+    if cold["cache_dir"] != warm["cache_dir"] or not cold["cache_dir"]:
+        fails.append("persistent cache dir not wired: %r / %r"
+                     % (cold["cache_dir"], warm["cache_dir"]))
+    if cold["compiles"] < len(BUCKETS):
+        fails.append("cold process compiled %d < %d bucket programs"
+                     % (cold["compiles"], len(BUCKETS)))
+    if cold["persistent_hits"] != 0:
+        fails.append("cold process reported persistent hits (%d) from a "
+                     "fresh cache dir" % cold["persistent_hits"])
+    if warm["persistent_hits"] < 1:
+        fails.append("warm process reported NO persistent-cache-backed "
+                     "compiles — cross-process reuse is broken")
+    ratio = (warm["warmup_ms"] / cold["warmup_ms"]
+             if cold["warmup_ms"] else 1.0)
+    if ratio > 0.6:
+        fails.append("warm/cold warmup ratio %.3f > 0.6 (cold %.1fms, "
+                     "warm %.1fms)" % (ratio, cold["warmup_ms"],
+                                       warm["warmup_ms"]))
+    if cold["pred_digest"] != warm["pred_digest"]:
+        fails.append("cache-backed executable broke bit-identity: %s vs "
+                     "%s" % (cold["pred_digest"], warm["pred_digest"]))
+
+    # builder-seam lint over the migrated modules (TPL108 et al.)
+    lint_rc = subprocess.call(
+        [sys.executable, "-m", "mxnet_tpu.analysis.lint",
+         os.path.join("mxnet_tpu", "compile"),
+         os.path.join("mxnet_tpu", "executor.py"),
+         os.path.join("mxnet_tpu", "serving"),
+         os.path.join("mxnet_tpu", "parallel"),
+         os.path.join("mxnet_tpu", "module")], cwd=ROOT)
+    if lint_rc != 0:
+        fails.append("tpulint over the migrated modules failed (rc=%d)"
+                     % lint_rc)
+
+    print(json.dumps({
+        "cold_warmup_ms": cold["warmup_ms"],
+        "warm_warmup_ms": warm["warmup_ms"],
+        "warm_cold_ratio": round(ratio, 4),
+        "warm_persistent_hits": warm["persistent_hits"],
+        "bit_identical": cold["pred_digest"] == warm["pred_digest"],
+        "failures": fails}), flush=True)
+    if fails:
+        for f in fails:
+            print("compile_cache_smoke: FAIL: %s" % f, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(child() if "--child" in sys.argv else main())
