@@ -39,7 +39,7 @@ PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy
     "infer_int8": 600, "train_big_batch": 900, "flash_parity": 500,
     "cost": 600, "serving": 600, "serving_sla": 300,
     "frontdoor": 300, "fleet": 300, "fault_recovery": 300,
-    "compile_cache": 300,
+    "compile_cache": 300, "train_chaos": 300,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
 _HERE = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -306,7 +306,7 @@ def main():
     phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
               "io_train", "infer_int8", "train_big_batch", "flash_parity",
               "cost", "serving", "frontdoor", "fleet", "fault_recovery",
-              "compile_cache"]
+              "compile_cache", "train_chaos"]
     # phases that measure nothing useful on the CPU fallback (outage
     # removals — unlike explicit_skips, the bank may still supply them)
     cpu_useless = {"train_bf16", "train_big_batch", "flash_parity"}
@@ -326,8 +326,11 @@ def main():
         # "compile_cache" measures HOST-side compile wall-time and
         # process-restart cold start (its acceptance gate is defined on
         # the CPU host — ISSUE 14), so it is likewise never sent down a
-        # flaky accelerator tunnel.
-        _host_phases = ("cost", "compile_cache")
+        # flaky accelerator tunnel. "train_chaos" gates kill/resume
+        # SEMANTICS (bit-parity, skip accounting) over subprocess fits
+        # whose elastic variant needs a 4-device mesh — defined on the
+        # forced-CPU mesh for the same reason (ISSUE 15).
+        _host_phases = ("cost", "compile_cache", "train_chaos")
         res, err = _run_child(phase, force_cpu or phase in _host_phases,
                               budget)
         if (res is None and not force_cpu and phase not in _host_phases
@@ -422,7 +425,8 @@ def main():
     for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
                   "io_train", "infer_int8", "train_big_batch",
                   "flash_parity", "cost", "serving", "frontdoor",
-                  "fleet", "fault_recovery", "compile_cache"):
+                  "fleet", "fault_recovery", "compile_cache",
+                  "train_chaos"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
                       if not k.startswith("_")})
     # mixed-platform runs (partial rescue): say which metric ran where.
@@ -2103,6 +2107,78 @@ def _phase_compile_cache():
     return out
 
 
+def _phase_train_chaos():
+    """Training-failure recovery cost (ISSUE 15): what the training
+    supervisor's containment actually costs, measured through the same
+    child driver as the `ci/run.py train_chaos_smoke` gate (tools/
+    train_chaos_smoke.py) so gate and bench can never measure different
+    code. Three numbers:
+
+    (a) SIGKILL mid-epoch -> supervised auto-resume: the resumed fit's
+        wall-time vs the uninterrupted twin's, gated on BIT-identical
+        final params (crash-exact resume: cursor + shuffle-RNG chain +
+        supervisor state all replayed from the manifest);
+    (b) elastic ZeRO dp=2 -> dp=4 resume (the PR-7 cross-count restore
+        driven end to end), same bit-parity gate;
+    (c) NaN-injection recovery: a supervised fit with one poisoned step
+        (train.nan fault) vs the same fit clean — the wall-time cost of
+        skip-and-back-off containment, gated on the skip being exactly
+        one step and the params staying finite."""
+    import shutil
+    import tempfile
+    sys.path.insert(0, os.path.join(_HERE, "tools"))
+    import train_chaos_smoke as _tc
+
+    out = {}
+    # -- (a) SIGKILL mid-epoch -> resume, bit-parity + wall-time --------
+    res = _tc.sigkill_resume_variant("fp32")
+    out["train_chaos_bit_identical"] = res["bit_identical"]
+    out["train_chaos_clean_fit_s"] = res["clean_fit_s"]
+    out["train_chaos_resume_fit_s"] = res["resume_fit_s"]
+    if res["clean_fit_s"]:
+        out["train_chaos_resume_ratio"] = round(
+            res["resume_fit_s"] / res["clean_fit_s"], 3)
+
+    # -- (b) elastic ZeRO resume under a changed replica count ----------
+    el = _tc.elastic_zero_variant()
+    out["train_chaos_elastic_bit_identical"] = el["bit_identical"]
+    out["train_chaos_elastic_resume_fit_s"] = el["resume_fit_s"]
+
+    # -- (c) NaN containment recovery wall-time -------------------------
+    base = tempfile.mkdtemp(prefix="bench_tc_nan_")
+    try:
+        kw = dict(epochs=2, rows=64, batch=8, seed=7)
+        t0 = time.monotonic()
+        p = _tc._run(_tc.child_argv(ckpt=os.path.join(base, "ck_clean"),
+                                    out=os.path.join(base, "clean.npz"),
+                                    **kw))
+        clean_s = time.monotonic() - t0
+        assert p.returncode == 0, p.stderr.decode()[-2000:]
+        t0 = time.monotonic()
+        p = _tc._run(_tc.child_argv(ckpt=os.path.join(base, "ck_nan"),
+                                    out=os.path.join(base, "nan.npz"),
+                                    **kw),
+                     env_extra={"MXNET_TPU_FAULT_SPEC":
+                                "train.nan:count=3:raise=FaultInjected"})
+        nan_s = time.monotonic() - t0
+        assert p.returncode == 0, p.stderr.decode()[-2000:]
+        with open(os.path.join(base, "nan.npz.json")) as f:
+            sc = json.load(f)["supervisor"]
+        assert sc["bad_steps"] == 1, \
+            "poisoned step not skipped exactly once: %s" % sc
+        import numpy as np
+        fin = np.load(os.path.join(base, "nan.npz"))
+        assert all(np.isfinite(fin[k]).all() for k in fin.files), \
+            "NaN leaked into params"
+        out["train_chaos_nan_clean_fit_s"] = round(clean_s, 2)
+        out["train_chaos_nan_faulted_fit_s"] = round(nan_s, 2)
+        out["train_chaos_nan_recovery_s"] = round(nan_s - clean_s, 2)
+        out["train_chaos_nan_steps_skipped"] = sc["bad_steps"]
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 PHASES = {
     "probe": _phase_probe,
     "infer": _phase_infer,
@@ -2121,6 +2197,7 @@ PHASES = {
     "fleet": _phase_fleet,
     "fault_recovery": _phase_fault_recovery,
     "compile_cache": _phase_compile_cache,
+    "train_chaos": _phase_train_chaos,
 }
 
 

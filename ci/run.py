@@ -179,6 +179,32 @@ def stage_chaos_smoke(_):
          os.path.join("mxnet_tpu", "io_device.py")], cwd=ROOT)
 
 
+def stage_train_chaos_smoke(_):
+    """Non-slow training-failure gate (ISSUE 15): a supervised fit
+    subprocess is SIGKILLed mid-epoch and auto-resumes BIT-identical to
+    its uninterrupted twin (fused fp32, bf16-master, dp>1 dryrun, and the
+    elastic ZeRO dp=2->4 resume); an injected NaN gradient is skipped
+    in-graph with the typed NumericDivergence after K consecutive bad
+    steps; and the zero-overhead contract holds (get_env poisoned across
+    warmed dispatches, every train.* fault hook a cached-flag no-op) —
+    then tpulint (incl. TPL109 unsupervised-thread) over the training
+    path."""
+    rc = subprocess.call(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "train_chaos_smoke.py")],
+        env=_env_cpu_mesh(1), cwd=ROOT)
+    if rc != 0:
+        return rc
+    return subprocess.call(
+        [sys.executable, "-m", "mxnet_tpu.analysis.lint",
+         os.path.join("mxnet_tpu", "resilience"),
+         os.path.join("mxnet_tpu", "checkpoint"),
+         os.path.join("mxnet_tpu", "module"),
+         os.path.join("mxnet_tpu", "parallel"),
+         os.path.join("mxnet_tpu", "io.py"),
+         os.path.join("mxnet_tpu", "io_device.py")], cwd=ROOT)
+
+
 def stage_compile_cache_smoke(_):
     """Non-slow unified-builder gate (ISSUE 14): subprocess A compiles a
     serving engine's bucket programs cold into MXNET_TPU_COMPILE_CACHE,
@@ -213,6 +239,7 @@ STAGES = [
     ("wire_fuzz_smoke", stage_wire_fuzz_smoke),
     ("fleet_smoke", stage_fleet_smoke),
     ("chaos_smoke", stage_chaos_smoke),
+    ("train_chaos_smoke", stage_train_chaos_smoke),
     ("compile_cache_smoke", stage_compile_cache_smoke),
     ("bench_smoke", stage_bench_smoke),
 ]

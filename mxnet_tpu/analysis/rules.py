@@ -19,6 +19,11 @@ hand (docs/faq/analysis.md has the catalog with examples):
   ProgramBuilder seam — a raw build site dodges the persistent compile
   cache, the lint sweeps, and the compile counters (ISSUE 14's
   one-build-path contract)
+- TPL109 ``unsupervised-thread`` ``threading.Thread`` creation in the
+  long-lived-thread subsystems (serving|checkpoint|parallel|resilience|
+  io_device.py) with no watchdog ``Heartbeat`` registration reachable in
+  the creating function, the thread target, or the enclosing class —
+  an unwatched thread wedges or dies invisibly (ISSUE 15)
 
 All rules are static heuristics over the AST — they cannot prove an
 expression is a device array, so genuinely-host uses are silenced with a
@@ -33,7 +38,8 @@ import re
 from .findings import Finding, Severity, apply_pragmas
 
 __all__ = ["lint_source", "is_hot_path", "is_swallow_scope",
-           "is_unpickle_scope", "is_raw_compile_scope", "RULES"]
+           "is_unpickle_scope", "is_raw_compile_scope",
+           "is_threadwatch_scope", "RULES"]
 
 RULES = {
     "TPL000": ("pragma", Severity.ERROR,
@@ -64,6 +70,11 @@ RULES = {
                "compile/builder.py ProgramBuilder seam — it dodges the "
                "one lower/compile/cache path (persistent cache, lint "
                "sweeps, compile counters)"),
+    "TPL109": ("unsupervised-thread", Severity.ERROR,
+               "threading.Thread created in a supervised subsystem with "
+               "no watchdog Heartbeat registration reachable in scope — "
+               "a silent wedge/death there is invisible to operators "
+               "(ISSUE 15's thread-supervision contract)"),
 }
 
 # directories whose files are fused/serving hot paths (ISSUE 5): host
@@ -101,6 +112,28 @@ def is_unpickle_scope(path):
     if not parts or parts[-1] in _UNPICKLE_SEAM_FILES:
         return False
     return "serving" in parts[:-1]
+
+
+# TPL109 scope: the long-lived-thread subsystems (ISSUE 15) — every
+# Thread created there must have a watchdog Heartbeat registration
+# reachable in its enclosing scope (the creating function, the target
+# function, or the enclosing class), or carry a reasoned
+# ``allow-unsupervised-thread`` pragma (short-lived by design, the
+# watchdog monitor itself, ...)
+_THREADWATCH_PARTS = {"serving", "checkpoint", "parallel", "resilience"}
+_THREADWATCH_FILES = {"io_device.py"}
+
+
+def is_threadwatch_scope(path):
+    parts = str(path).replace("\\", "/").split("/")
+    if parts and parts[-1] in _THREADWATCH_FILES:
+        return True
+    return any(p in _THREADWATCH_PARTS for p in parts[:-1])
+
+
+# identifiers that evidence a Heartbeat registration in scope: the
+# watchdog accessor/module, a Heartbeat object, or the hb handle idiom
+_WATCHDOGISH = re.compile(r"watchdog|heartbeat|^hb$|^_hb$|_hb$|^hb_")
 
 
 # TPL108 scope: the whole mxnet_tpu package EXCEPT compile/builder.py —
@@ -190,12 +223,13 @@ def _str_arg(call, index=0):
 
 class _Analyzer(ast.NodeVisitor):
     def __init__(self, path, hot, registry_text, swallow=False,
-                 unpickle=False, rawcompile=False):
+                 unpickle=False, rawcompile=False, threadwatch=False):
         self.path = path
         self.hot = hot
         self.swallow = swallow
         self.unpickle = unpickle
         self.rawcompile = rawcompile
+        self.threadwatch = threadwatch
         self.pickle_aliases = set()
         self.pickle_fn_names = set()
         self.registry = registry_text
@@ -502,6 +536,24 @@ class _Analyzer(ast.NodeVisitor):
     def finish(self):
         for call, cls, chain in self._thread_calls:
             fn = self._resolve_target(call, cls, chain)
+            # ---- TPL109: Thread without a reachable Heartbeat ----------
+            if self.threadwatch:
+                watch_scope = set()
+                if chain:  # the creating function's own idents
+                    watch_scope |= _idents(chain[-1])
+                if fn is not None:
+                    watch_scope |= _idents(fn)
+                if cls is not None:
+                    watch_scope |= _idents(cls)
+                if not any(_WATCHDOGISH.search(i) for i in watch_scope):
+                    self._emit("TPL109", call,
+                               "threading.Thread created with no watchdog "
+                               "Heartbeat registration reachable in the "
+                               "creating function, its target, or the "
+                               "enclosing class — register it (resilience/"
+                               "watchdog.py) or pragma with the reason it "
+                               "is exempt")
+            # ---- TPL102: looping worker without a stop path ------------
             if fn is None:
                 continue  # unresolvable target: cannot judge statically
             if not any(isinstance(n, ast.While) for n in ast.walk(fn)):
@@ -521,7 +573,8 @@ class _Analyzer(ast.NodeVisitor):
 
 
 def lint_source(source, path="<string>", hot=None, registry_text=None,
-                swallow=None, unpickle=None, rawcompile=None):
+                swallow=None, unpickle=None, rawcompile=None,
+                threadwatch=None):
     """Lint one file's source; returns findings with pragmas applied."""
     if hot is None:
         hot = is_hot_path(path)
@@ -531,13 +584,16 @@ def lint_source(source, path="<string>", hot=None, registry_text=None,
         unpickle = is_unpickle_scope(path)
     if rawcompile is None:
         rawcompile = is_raw_compile_scope(path)
+    if threadwatch is None:
+        threadwatch = is_threadwatch_scope(path)
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
         return [Finding("TPL001", "parse", Severity.ERROR,
                         "syntax error: %s" % e, path, e.lineno or 0)]
     analyzer = _Analyzer(path, hot, registry_text, swallow=swallow,
-                         unpickle=unpickle, rawcompile=rawcompile)
+                         unpickle=unpickle, rawcompile=rawcompile,
+                         threadwatch=threadwatch)
     analyzer.visit(tree)
     findings = analyzer.finish()
     findings += apply_pragmas(findings, source, path)

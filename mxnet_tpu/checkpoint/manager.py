@@ -529,23 +529,63 @@ class CheckpointManager:
             _rnd.set_key(data.rng_key)
         return data.meta
 
-    def resume(self, module, default_begin_epoch=0):
+    def resume(self, module, default_begin_epoch=0, train_data=None,
+               supervisor=None):
         """fit() auto-resume: restore the newest EPOCH-BOUNDARY checkpoint
         and return the epoch to continue from. Mid-epoch preemption
         snapshots (meta mid_epoch=true) are skipped — re-running the
         interrupted epoch from its boundary state is what keeps resumed
-        training bit-identical to an uninterrupted run."""
+        training bit-identical to an uninterrupted run.
+
+        ``train_data`` (a ResumableIter-capable iterator, io.py) replays
+        the EXACT data position from the manifest's ``data_position``:
+        cursor + shuffle permutation + the numpy shuffle-RNG chain are
+        restored, then the reset the original run performed after its
+        save is mirrored — the resumed epoch consumes the identical batch
+        schedule. ``supervisor`` restores the training supervisor's
+        loss-scale/streak state (``supervisor_state``)."""
         for step, path in reversed(layout.list_checkpoints(self.directory)):
             meta = layout.read_meta(path)
             if meta.get("mid_epoch"):
                 continue
             self.restore_module(module, step=step)
+            self._apply_data_position(meta, train_data)
+            if supervisor is not None and meta.get("supervisor_state"):
+                supervisor.load_state(meta["supervisor_state"])
             epoch = meta.get("epoch")
             self.logger.info("checkpoint resume: step %d from %s", step, path)
             if epoch is None:
                 return default_begin_epoch
             return max(default_begin_epoch, int(epoch) + 1)
         return default_begin_epoch
+
+    def _apply_data_position(self, meta, train_data):
+        """Restore the manifest's exact iterator position onto the live
+        train iterator (no-op when either side lacks it). A mismatched
+        dataset degrades to a fresh iterator with a warning — resume
+        must never brick on a changed data pipeline, it only loses the
+        bit-exact replay guarantee."""
+        pos = meta.get("data_position")
+        if not pos or train_data is None:
+            return
+        if not callable(getattr(train_data, "iter_restore", None)):
+            self.logger.warning(
+                "checkpoint carries a data_position but the train "
+                "iterator (%s) is not resumable; replaying from a fresh "
+                "iterator", type(train_data).__name__)
+            return
+        try:
+            train_data.iter_restore(pos["iter"])
+            if pos.get("pending_reset"):
+                # the original run reset AFTER this save; replay it
+                # against the restored shuffle-RNG chain
+                train_data.reset()
+        except Exception as e:
+            from .. import profiler as _prof
+            _prof.record_supervisor_event(data_position_failures=1)
+            self.logger.warning(
+                "data position restore failed (%s); replaying from a "
+                "fresh iterator", e)
 
     # ------------------------------------------------------------------
     # preemption

@@ -67,6 +67,13 @@ class _Ambiguous:
 
 _AMBIGUOUS = _Ambiguous()
 
+# serializes corrupt-cache-entry recovery: the bypass toggles the
+# PROCESS-GLOBAL jax_enable_compilation_cache flag, and builder compiles
+# deliberately run outside the per-builder lock — without this, recovery
+# A's re-enable lands before recovery B's bypass compile and B re-reads
+# the same corrupt entry (the crash this path exists to prevent)
+_CACHE_BYPASS_LOCK = threading.Lock()
+
 
 class ProgramBuilder:
     """One program family's lower/compile/cache pipeline.
@@ -291,12 +298,54 @@ class ProgramBuilder:
         # point of compile-outside-lock) can never cross-contaminate it
         phits0 = _prof.thread_persistent_cache_hits()
         t0 = time.perf_counter()
-        prog = lowered.compile()
+        try:
+            from ..resilience import faults as _faults
+            _faults.fault_point("compile.cache_read", builder=self.site)
+            prog = lowered.compile()
+        except Exception as e:
+            prog = self._compile_after_cache_corruption(lowered, e)
         ms = (time.perf_counter() - t0) * 1e3
         _prof.record_compile(
             self.site, ms, aot=(mode == "aot"),
             persistent_hit=_prof.thread_persistent_cache_hits() > phits0)
         return prog
+
+    def _compile_after_cache_corruption(self, lowered, err):
+        """A compile that failed WITH a persistent compile cache
+        configured is most plausibly a truncated/corrupt cache entry
+        (half-written by a killed process, bit-rotted on shared disk) —
+        that must degrade to a cache miss, never crash warmup. Recompile
+        once with the cache bypassed; a genuine compile error fails the
+        retry identically and surfaces. No cache configured: the original
+        error surfaces untouched (zero behavior change)."""
+        from ..base import compile_cache_dir
+        if compile_cache_dir() is None:
+            raise err
+        from .. import profiler as _prof
+        _prof.record_compile_corrupt(self.site)
+        import logging
+        logging.getLogger(__name__).warning(
+            "persistent compile cache read failed for %s (%s: %s); "
+            "degrading to a cache miss and recompiling", self.site,
+            type(err).__name__, err)
+        import jax
+        with _CACHE_BYPASS_LOCK:
+            disabled = False
+            try:
+                jax.config.update("jax_enable_compilation_cache", False)
+                disabled = True
+            except Exception:
+                # jax without the knob: still retry once — transient cache
+                # I/O may clear, and a persistent failure surfaces below
+                pass  # tpulint: allow-swallowed-exception best-effort cache bypass; the retry below surfaces real errors
+            try:
+                return lowered.compile()  # tpulint: allow-lock-device-call recovery must serialize: the bypass toggles the process-global compilation-cache flag
+            finally:
+                if disabled:
+                    try:
+                        jax.config.update("jax_enable_compilation_cache", True)
+                    except Exception:
+                        pass  # tpulint: allow-swallowed-exception re-enable is best-effort; cache-off only costs persistence
 
     # ------------------------------------------------------------------
     # dispatch

@@ -19,7 +19,8 @@ from .ndarray import sparse as _sparse
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "DevicePrefetchIter", "CSVIter", "LibSVMIter",
-           "MNISTIter", "ImageRecordIter", "ImageDetRecordIter", "io_registry"]
+           "MNISTIter", "ImageRecordIter", "ImageDetRecordIter",
+           "io_registry", "is_resumable"]
 
 io_registry = Registry("data iterator")
 
@@ -91,6 +92,29 @@ class DataIter:
 
     def getpad(self):
         raise NotImplementedError
+
+
+def _encode_np_rng_state(state):
+    """numpy.random.get_state() tuple -> JSON-safe list (MT19937 keys
+    become plain ints). The shuffle-RNG *chain*: checkpoint manifests
+    carry it so a resumed run's future epoch shuffles replay exactly."""
+    name, keys, pos, has_gauss, cached = state
+    return [str(name), [int(k) for k in _np.asarray(keys).ravel()],
+            int(pos), int(has_gauss), float(cached)]
+
+
+def _decode_np_rng_state(enc):
+    name, keys, pos, has_gauss, cached = enc
+    return (str(name), _np.asarray(keys, dtype=_np.uint32), int(pos),
+            int(has_gauss), float(cached))
+
+
+def is_resumable(it):
+    """True when `it` offers the ResumableIter capability
+    (`iter_checkpoint()`/`iter_restore(state)`) — exact data-position
+    checkpointing (NDArrayIter, DevicePrefetchIter-over-resumable)."""
+    return callable(getattr(it, "iter_checkpoint", None)) and \
+        callable(getattr(it, "iter_restore", None))
 
 
 def _init_data(data, allow_empty, default_name):
@@ -229,6 +253,43 @@ class NDArrayIter(DataIter):
 
     def getindex(self):
         return self._batch_indices()
+
+    # -- ResumableIter capability (resilience/supervisor.py pillar 3) ---
+    def iter_checkpoint(self):
+        """JSON-serializable exact position: batch cursor, the live index
+        permutation, and (shuffled iterators) the numpy global RNG state
+        the NEXT reset()'s shuffle will draw from — together they let a
+        killed-and-resumed fit replay the exact batch schedule the
+        uninterrupted run would have produced (checkpoint manifests embed
+        this under ``data_position``)."""
+        state = {"kind": "NDArrayIter",
+                 "cursor": int(self.cursor),
+                 "idx": [int(i) for i in self.idx],
+                 "num_data": int(self.num_data),
+                 "batch_size": int(self.batch_size),
+                 "shuffle": bool(self.shuffle)}
+        if self.shuffle:
+            state["np_rng"] = _encode_np_rng_state(_np.random.get_state())
+        return state
+
+    def iter_restore(self, state):
+        """Apply a position captured by :meth:`iter_checkpoint`. Restores
+        the shuffle-RNG CHAIN too (the global numpy state — the same
+        chain ``random.set_key`` restores for device RNG), so every later
+        epoch's shuffle matches the uninterrupted run bit-exactly."""
+        if int(state.get("num_data", self.num_data)) != self.num_data or \
+                int(state.get("batch_size", self.batch_size)) != \
+                self.batch_size:
+            raise MXNetError(
+                "iterator position was captured over %s rows / batch %s "
+                "but this iterator has %d/%d — dataset changed under the "
+                "checkpoint" % (state.get("num_data"),
+                                state.get("batch_size"), self.num_data,
+                                self.batch_size))
+        self.cursor = int(state["cursor"])
+        self.idx = _np.asarray(state["idx"], dtype=self.idx.dtype)
+        if state.get("np_rng") is not None:
+            _np.random.set_state(_decode_np_rng_state(state["np_rng"]))
 
 
 class ResizeIter(DataIter):

@@ -81,6 +81,7 @@ class DevicePrefetchIter(DataIter):
     """
 
     _STOP = object()
+    _MAX_RESTARTS = 3  # watchdog re-supervision budget per epoch
 
     def __init__(self, base_iter, stage_fn=None, depth=2):
         super().__init__(getattr(base_iter, "batch_size", 0))
@@ -96,6 +97,12 @@ class DevicePrefetchIter(DataIter):
         # the training loop's error still carries the root cause instead
         # of a generic death message
         self._worker_error = None
+        # the batch pulled from the base iterator but not yet DELIVERED:
+        # a worker death between pull and delivery must not drop it — the
+        # watchdog-restarted worker re-stages it first (ISSUE 15)
+        self._pending = None
+        self._restarts = 0
+        self._hb = None
         self.counters = {"hits": 0, "stalls": 0, "stall_ms": 0.0, "staged": 0}
 
     # ------------------------------------------------------------------
@@ -117,7 +124,16 @@ class DevicePrefetchIter(DataIter):
         from .resilience import faults as _faults
         from .resilience.retry import RetryPolicy
         from .resilience.watchdog import watchdog as _watchdog
-        hb = _watchdog().register("mx-device-prefetch", thread=self._thread)
+        # restart policy (ISSUE 15): a thread death that never delivered
+        # its terminal sentinel is re-supervised through the factory —
+        # the heartbeat closes ONLY on exits that DID transport their
+        # outcome (clean stop, StopIteration, sticky error), so a silent
+        # death IS detectable and restartable
+        hb = self._hb
+        if hb is None or hb.closed:
+            hb = self._hb = _watchdog().register(
+                "mx-device-prefetch", thread=self._thread,
+                on_death="restart", restart=self._restart_worker)
         # transient H2D staging failures (device hiccup, OOM-race on a
         # shared host) retry under the one policy instead of killing the
         # whole epoch's pipeline on the first blip
@@ -131,24 +147,27 @@ class DevicePrefetchIter(DataIter):
         try:
             while not self._stop.is_set():
                 hb.beat()
-                try:
-                    batch = self.base.next()
-                except StopIteration:
-                    self._put(self._STOP)
-                    return
+                if self._pending is None:
+                    try:
+                        self._pending = self.base.next()
+                    except StopIteration:
+                        self._put(self._STOP)
+                        hb.close()
+                        return
                 t0 = time.perf_counter()
-                staged = stage_retry.call(_stage_once, batch)
+                staged = stage_retry.call(_stage_once, self._pending)
                 _prof.record_pipeline_event(
                     prefetch_stage_ms=(time.perf_counter() - t0) * 1e3)
                 self.counters["staged"] += 1
                 hb.idle()  # a put() blocked on a full queue is downstream
                 #            backpressure, not a prefetch stall
                 self._put(staged)
+                self._pending = None  # delivered (or shutdown drained it)
+            hb.close()  # clean stop
         except BaseException as e:  # transported to next(), then sticky
             self._worker_error = e
             self._put(e)
-        finally:
-            hb.close()
+            hb.close()  # outcome delivered: a surfaced exit, not a death
 
     def _put(self, item):
         # bounded put that a concurrent reset() can always interrupt
@@ -164,7 +183,44 @@ class DevicePrefetchIter(DataIter):
                                         name="mx-device-prefetch", daemon=True)
         self._thread.start()
 
+    def _restart_worker(self):
+        """Watchdog restart factory (on_death="restart"): rebuild the
+        stager after a silent death — the pending (pulled-but-never-
+        delivered) batch is re-staged first, so no batch is dropped or
+        reordered. Raises (=> restart_failed, surfaced) when the iterator
+        is stopped/terminal or the budget is spent."""
+        if self._stop.is_set() or self._terminal is not None:
+            raise MXNetError("prefetch stager stopped/terminal — "
+                             "not restartable")
+        if self._restarts >= self._MAX_RESTARTS:
+            raise MXNetError(
+                "prefetch stager exceeded its restart budget (%d)"
+                % self._MAX_RESTARTS)
+        self._restarts += 1
+        self._worker_error = None
+        self._start()
+        return self._thread
+
+    def _maybe_restart(self):
+        """next()'s dead-worker path: give the watchdog's restart policy
+        one immediate chance (scan now instead of waiting out the scan
+        interval). True when a restart was applied."""
+        hb = self._hb
+        if hb is None or getattr(hb, "closed", True) \
+                or self._restarts >= self._MAX_RESTARTS:
+            return False
+        before = self._restarts
+        from .resilience.watchdog import watchdog as _watchdog
+        _watchdog().scan()
+        return self._restarts > before or (
+            self._thread is not None and self._thread.is_alive())
+
     def _shutdown(self):
+        if self._hb is not None:
+            # retire supervision BEFORE stopping the thread: a shutdown
+            # must never read as a death (and never trigger a restart)
+            self._hb.close()
+            self._hb = None
         if self._thread is None:
             return
         self._stop.set()
@@ -190,9 +246,35 @@ class DevicePrefetchIter(DataIter):
         self.base.reset()
         self._terminal = None
         self._worker_error = None
+        self._pending = None
+        self._restarts = 0
         # worker restarts lazily on the next next(): after the final epoch
         # the base iterator is left freshly reset, not advanced by an
         # eagerly-refilling stager
+
+    # -- ResumableIter capability: forwarded to the base iterator -------
+    def iter_checkpoint(self):
+        """Exact data position (io.py ResumableIter) — valid at an epoch
+        boundary, where the stager has delivered its terminal sentinel
+        and the base iterator's cursor IS the consumed position. A
+        mid-flight capture would be off by the staged read-ahead."""
+        if not callable(getattr(self.base, "iter_checkpoint", None)):
+            raise MXNetError("base iterator %s is not resumable"
+                             % type(self.base).__name__)
+        if self._thread is not None and self._thread.is_alive() \
+                and self._terminal is None:
+            raise MXNetError(
+                "DevicePrefetchIter position is only capturable at an "
+                "epoch boundary (the stager reads ahead of consumption)")
+        return self.base.iter_checkpoint()
+
+    def iter_restore(self, state):
+        self._shutdown()
+        self._terminal = None
+        self._worker_error = None
+        self._pending = None
+        self._restarts = 0
+        self.base.iter_restore(state)
 
     def next(self):
         from . import profiler as _prof
@@ -219,6 +301,11 @@ class DevicePrefetchIter(DataIter):
                             item = self._queue.get_nowait()
                             break
                         except queue.Empty:
+                            if self._maybe_restart():
+                                # the watchdog's restart policy revived
+                                # the stager (pending batch re-staged
+                                # first: nothing dropped) — keep waiting
+                                continue
                             cause = self._worker_error
                             msg = "device prefetch worker died " \
                                   "without a sentinel"

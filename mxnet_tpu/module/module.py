@@ -81,6 +81,7 @@ class Module(BaseModule):
         from collections import deque
         self._inflight = deque()
         self._dispatch_depth = 2
+        self._fused_step_count = 0  # fault-site context (train.step)
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -432,13 +433,18 @@ class Module(BaseModule):
                     "MXNET_FUSED_COMPUTE_DTYPE=%r is not a dtype; "
                     "running the fused step in fp32", compute_dtype)
                 compute_dtype = None
+        supervisor = getattr(self, "_supervisor", None)
         step = DataParallelTrainStep(
             self._symbol, mesh, lr=opt.lr, wd=opt.wd,
             data_names=self._data_names, label_names=self._label_names,
             rescale_grad=opt.rescale_grad, optimizer=fused_name, opt_hp=hp,
             fixed_param_names=self._fixed_param_names,
-            clip_gradient=opt.clip_gradient, compute_dtype=compute_dtype)
+            clip_gradient=opt.clip_gradient, compute_dtype=compute_dtype,
+            supervise=supervisor is not None)
         step.init_from(self._arg_params, self._aux_params, batch_shapes)
+        if supervisor is not None:
+            # derive the default loss scale from the step's compute dtype
+            supervisor.attach_step(step)
         self._fused_step = step
         self._fused_dirty = False
         from ..base import get_env
@@ -502,9 +508,23 @@ class Module(BaseModule):
         # already on the fused step's batch sharding and pass through
         # zero-copy; anything else is staged by the step itself
         from .. import profiler as _prof
+        from ..resilience import faults as _faults
         import time as _time
+        # fault site on the host side of every fused dispatch (cached-flag
+        # no-op when no spec is set — the zero-overhead contract); the
+        # train_chaos gates SIGKILL here mid-epoch
+        _faults.fault_point("train.step", step=self._fused_step_count)
+        self._fused_step_count += 1
+        sup = self._supervisor
         _t0 = _time.perf_counter()
-        outs = fused(batch, lr=self._fused_lr())
+        if sup is not None and fused.supervise:
+            # supervised step: the loss scale rides as a runtime arg and
+            # the in-graph all-finite verdict rides the output tuple
+            outs = fused(batch, lr=self._fused_lr(), scale=sup.step_scale())
+            flag = fused.last_flag
+        else:
+            outs = fused(batch, lr=self._fused_lr())
+            flag = None
         # dispatch_ms is host enqueue time only — captured BEFORE any
         # profiler block_until_ready, or it would absorb the whole step
         _prof.record_pipeline_event(
@@ -522,14 +542,39 @@ class Module(BaseModule):
         self._params_dirty = True
         # bounded async dispatch: retain outputs of the last `depth` steps
         # and block on step i-depth before dispatching further
-        self._inflight.append(outs)
+        self._inflight.append((outs, flag))
         while len(self._inflight) > self._dispatch_depth:
-            oldest = self._inflight.popleft()
-            _t1 = _time.perf_counter()
+            self._retire_oldest_inflight()
+
+    def _retire_oldest_inflight(self):
+        """Block on (and, supervised, judge) the oldest in-flight step —
+        the ONE host point that reads the step verdict, so supervision
+        adds zero sync points to the dispatch pipeline."""
+        from .. import profiler as _prof
+        import time as _time
+        oldest, flag = self._inflight.popleft()
+        _t1 = _time.perf_counter()
+        sup = self._supervisor
+        if sup is not None and flag is not None:
+            # bounded readback (stall deadline) + verdict observation:
+            # NaN skip accounting, loss-scale backoff, NumericDivergence
+            sup.await_ready(oldest, flag)
+        else:
             import jax as _jax
             _jax.block_until_ready(oldest)
-            _prof.record_pipeline_event(
-                readback_stall_ms=(_time.perf_counter() - _t1) * 1e3)
+        _prof.record_pipeline_event(
+            readback_stall_ms=(_time.perf_counter() - _t1) * 1e3)
+
+    def _drain_inflight_flags(self):
+        """Epoch-boundary drain (supervised fits only): every dispatched
+        step's verdict must be observed before the checkpoint captures
+        the supervisor state, or a resumed run would replay with a stale
+        loss scale."""
+        if self._supervisor is None:
+            return
+        while self._inflight:
+            self._retire_oldest_inflight()
+        self._supervisor.idle()
 
     def _sync_fused_to_execs(self):
         """Push fused-step params into exec_group (before eval/predict)."""

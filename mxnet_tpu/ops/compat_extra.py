@@ -203,6 +203,14 @@ def _identity_attach_kl_sparse_reg(params, data, moving_avg):
         (avg,) = res
         a = jnp.clip(avg, 1e-6, 1 - 1e-6)
         reg = penalty * (-rho / a + (1 - rho) / (1 - a))
+        # implicit loss: no head cotangent carries the supervised
+        # loss-scale seed down to this additive term — fold the traced
+        # scale in directly or the post-step unscale divides the
+        # penalty by the scale (see nn.current_loss_grad_scale)
+        from .nn import current_loss_grad_scale
+        s = current_loss_grad_scale()
+        if s is not None:
+            reg = reg * jnp.asarray(s, reg.dtype)
         return g + reg[None, :], jnp.zeros_like(avg)
 
     f.defvjp(fwd, bwd)

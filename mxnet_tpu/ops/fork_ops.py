@@ -84,7 +84,9 @@ def _multi_logistic(params, data, label):
         out = jax.nn.sigmoid(d)
         grad = out - l
         grad = params.grad_scale * (grad * l * params.weight + grad * (1 - l))
-        return grad.astype(d.dtype), jnp.zeros_like(l)
+        # * g: ones in every standard backward (bitwise identity); the
+        # supervised loss-scale seed must reach the chain (see nn._loss_op)
+        return (grad * g).astype(d.dtype), jnp.zeros_like(l)
 
     op.defvjp(fwd, bwd)
     return op(data, label)
@@ -109,7 +111,7 @@ def _weighted_l1(params, data, label):
     def bwd(res, g):
         d, l = res
         grad = params.grad_scale * jnp.sign(d - l) * (l != 0).astype(d.dtype)
-        return grad, jnp.zeros_like(l)
+        return grad * g, jnp.zeros_like(l)
 
     op.defvjp(fwd, bwd)
     return op(data, label)

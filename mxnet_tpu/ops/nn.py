@@ -5,10 +5,15 @@ so XLA tiles them onto the MXU; normalization/activation stay as jnp elementwise
 (XLA fuses them into neighbors). The fused RNN op is a `lax.scan` over time —
 the compiler-friendly TPU formulation of the reference's cuDNN RNN kernels.
 Loss-layer ops (SoftmaxOutput family) use `jax.custom_vjp` to reproduce the
-reference semantics where backward ignores head gradients
+reference semantics where backward emits its own gradient; the head
+cotangent enters multiplicatively so seeds-of-ones stay bitwise reference
+and the supervised loss-scale seed reaches the chain
 (reference: src/operator/softmax_output-inl.h).
 """
 from __future__ import annotations
+
+import contextlib
+import contextvars
 
 import numpy as _np
 import jax
@@ -17,6 +22,33 @@ from jax import lax
 
 from ..base import Params, param_field, np_dtype, MXNetError
 from .registry import register_op
+
+# ---------------------------------------------------------------------------
+# Supervised loss-scale plumbing for IMPLICIT loss sites. Loss heads get
+# the scale through their cotangent seed (see _loss_op), but an op that
+# injects a gradient mid-chain with no head above it (e.g.
+# IdentityAttachKLSparseReg's sparsity penalty) has no seed to carry it —
+# without the multiply, the supervised step's post-backward unscale would
+# silently divide that gradient by the scale. The supervised fused step
+# (parallel/tpu_step.py) traces its backward with this set to the TRACED
+# scale scalar; None (every other trace) keeps the op bitwise unchanged.
+# ---------------------------------------------------------------------------
+_loss_grad_scale = contextvars.ContextVar("mx_loss_grad_scale", default=None)
+
+
+def current_loss_grad_scale():
+    """The traced loss-scale scalar of an in-progress supervised backward
+    trace, or None. Read by implicit-loss vjp rules at trace time."""
+    return _loss_grad_scale.get()
+
+
+@contextlib.contextmanager
+def loss_grad_scale_scope(scale):
+    token = _loss_grad_scale.set(scale)
+    try:
+        yield
+    finally:
+        _loss_grad_scale.reset(token)
 
 # ---------------------------------------------------------------------------
 # FullyConnected (nn/fully_connected.cc:228-309)
@@ -478,14 +510,20 @@ def _upsampling(params, *args):
 
 
 # ---------------------------------------------------------------------------
-# Loss-layer ops with reference backward semantics (ignore head grads)
+# Loss-layer ops with reference backward semantics (emit their own gradient;
+# the head cotangent — ones everywhere but the loss-scaled supervised step —
+# enters multiplicatively)
 # ---------------------------------------------------------------------------
 
 
 def _loss_op(forward, backward_grad):
     """Build a custom-vjp fn: forward(data, label) -> out;
-    d(data) = backward_grad(data, label) regardless of head cotangent scale
-    (reference loss layers always emit their own gradient)."""
+    d(data) = backward_grad(data, label) * g (reference loss layers emit
+    their own gradient; the head cotangent enters MULTIPLICATIVELY).
+    Every standard backward seeds ones, so `* g` is a bitwise identity —
+    the multiply exists so the supervised fused step's loss-scale seed
+    (resilience/supervisor.py, a power of two) actually reaches the
+    backward chain instead of dying at the head."""
 
     @jax.custom_vjp
     def op(data, label):
@@ -496,7 +534,8 @@ def _loss_op(forward, backward_grad):
 
     def bwd(res, g):
         data, label = res
-        return backward_grad(data, label).astype(data.dtype), jnp.zeros_like(label)
+        return ((backward_grad(data, label) * g).astype(data.dtype),
+                jnp.zeros_like(label))
 
     op.defvjp(fwd, bwd)
     return op
@@ -622,13 +661,15 @@ def _make_loss_op(params, data):
         return d, d
 
     def bwd(d, g):
+        # * g: ones in every standard backward (bitwise identity); the
+        # supervised loss-scale seed must reach the chain (see _loss_op)
         scale = params.grad_scale
         if params.normalization == "batch":
             scale = scale / d.shape[0]
         elif params.normalization == "valid":
             valid = jnp.maximum(jnp.sum((d > params.valid_thresh).astype(jnp.float32)), 1.0)
-            return (jnp.full(d.shape, params.grad_scale, d.dtype) / valid,)
-        return (jnp.full(d.shape, scale, d.dtype),)
+            return (jnp.full(d.shape, params.grad_scale, d.dtype) / valid * g,)
+        return (jnp.full(d.shape, scale, d.dtype) * g,)
 
     op.defvjp(fwd, bwd)
     return op(data)

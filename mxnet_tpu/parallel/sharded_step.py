@@ -39,8 +39,16 @@ class ShardedTrainStep:
     def __init__(self, loss_fn, mesh, param_specs, batch_spec=None,
                  optimizer="adam", lr=1e-3, momentum=0.9, wd=0.0,
                  beta1=0.9, beta2=0.999, eps=1e-8, grad_clip=None,
-                 shard_update=None, zero=None):
+                 shard_update=None, zero=None, skip_nonfinite=False):
         self.loss_fn = loss_fn
+        # supervised numeric containment (resilience/supervisor.py's
+        # pillar 1, composed-mesh form): the step computes an in-graph
+        # all-finite verdict over loss + global grad norm and carries
+        # params/opt_state unchanged on a bad step. The verdict device
+        # scalar lands in `last_good` — readers fold it into whatever
+        # readback they already do.
+        self.skip_nonfinite = bool(skip_nonfinite)
+        self.last_good = None
         self.mesh = mesh
         self.param_specs = param_specs
         if batch_spec is None:
@@ -138,8 +146,14 @@ class ShardedTrainStep:
         state_specs = jax.tree_util.tree_map(
             self._state_spec, self.params, self.param_specs)
 
+        skip_nonfinite = self.skip_nonfinite
+
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            if skip_nonfinite:
+                gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in jax.tree_util.tree_leaves(grads))
+                good = jnp.isfinite(loss) & jnp.isfinite(gsq)
             if hp["grad_clip"]:
                 gnorm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2)
                                      for g in jax.tree_util.tree_leaves(grads)))
@@ -161,8 +175,19 @@ class ShardedTrainStep:
                         g, NamedSharding(mesh, s)),
                     grads, state_specs)
             from .optim_update import apply_update
-            params, opt_state = apply_update(opt, hp, params, opt_state, grads)
-            return params, opt_state, loss
+            new_params, new_state = apply_update(opt, hp, params, opt_state,
+                                                 grads)
+            if skip_nonfinite:
+                # carry the pre-step state through a bad update (the
+                # donation-safe skip idiom shared with tpu_step)
+                new_params = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(good, new, old),
+                    new_params, params)
+                new_state = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(good, new, old),
+                    new_state, opt_state)
+                return new_params, new_state, loss, good
+            return new_params, new_state, loss
 
         if self.optimizer == "adam":
             opt_specs = {"m": state_specs, "v": state_specs, "t": P()}
@@ -179,11 +204,14 @@ class ShardedTrainStep:
         # the ONE lower/compile/cache path (compile/builder.py): same
         # dispatch semantics as the bare jit, plus warmup() AOT and the
         # per-site compile counters
+        out_sh = (param_sh, opt_sh, NamedSharding(self.mesh, P()))
+        if skip_nonfinite:
+            out_sh = out_sh + (NamedSharding(self.mesh, P()),)
         from ..compile.builder import ProgramBuilder
         self._step_fn = ProgramBuilder(
             step, site="train.sharded_step",
             in_shardings=(param_sh, opt_sh, None),
-            out_shardings=(param_sh, opt_sh, NamedSharding(self.mesh, P())),
+            out_shardings=out_sh,
             donate_argnums=(0, 1))
         self.opt_state = self._shard(self.opt_state, opt_specs)
 
@@ -220,7 +248,11 @@ class ShardedTrainStep:
         batch = jax.tree_util.tree_map(
             lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding),
             batch)
-        self.params, self.opt_state, loss = self._step_fn(
-            self.params, self.opt_state, batch)
+        if self.skip_nonfinite:
+            self.params, self.opt_state, loss, self.last_good = \
+                self._step_fn(self.params, self.opt_state, batch)
+        else:
+            self.params, self.opt_state, loss = self._step_fn(
+                self.params, self.opt_state, batch)
         self.step_count += 1
         return loss

@@ -38,8 +38,16 @@ class DataParallelTrainStep:
                  sharding_config=None, rescale_grad=None, optimizer="sgd",
                  opt_hp=None, fixed_param_names=(), clip_gradient=None,
                  compute_dtype=None, shard_update=None,
-                 fused_optupdate=None, zero=None):
+                 fused_optupdate=None, zero=None, supervise=False):
         self.symbol = symbol
+        # supervised numeric containment (resilience/supervisor.py): the
+        # step takes a runtime loss-scale argument, seeds the backward
+        # pass with it, unscales grads in-graph, and returns an
+        # all-finite verdict; a bad step CARRIES params/opt_state/aux
+        # unchanged through jnp.where. Off by default — the unsupervised
+        # program is byte-identical to before (zero-overhead contract).
+        self.supervise = bool(supervise)
+        self.last_flag = None  # device verdict of the latest supervised step
         # stochastic-op scan decides whether steps draw fresh keys or reuse
         # one cached replicated key (see __call__)
         self._needs_rng = symbol._needs_rng()
@@ -279,11 +287,15 @@ class DataParallelTrainStep:
         cdt = self.compute_dtype
         cast_names = frozenset(self.data_names)  # NEVER labels: class
         # indices >= 257 are unrepresentable in bf16's 8-bit significand
+        supervise = self.supervise
 
         # batch rides in as TWO pytree args: data (dp-sharded, bf16-castable)
         # and labels (kept separate so the host-side metric fallback and
-        # callbacks can keep distinct sharding/dtype treatment)
-        def step(params, opt_state, aux, data_part, label_part, rng, lr):
+        # callbacks can keep distinct sharding/dtype treatment).
+        # Supervised steps take one more runtime arg (the loss scale) and
+        # return one more output (the all-finite verdict) — see _body.
+        def step(params, opt_state, aux, data_part, label_part, rng, lr,
+                 scale=None):
             batch = {**data_part, **label_part}
             if cdt is not None:
                 batch = {n: (v.astype(cdt)
@@ -303,8 +315,38 @@ class DataParallelTrainStep:
                                for n, v in aux_upd.items()}
                 return outs, aux_upd
             outs, vjp, aux_upd = jax.vjp(loss_fn, params, has_aux=True)
-            seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
-            grads = vjp(seeds)[0]
+            if supervise:
+                # loss-scaled backward: the cotangent seed IS the runtime
+                # scale (a power of two, so the cast and the unscale
+                # multiply below are exact in bf16/fp32 — scale 1.0 makes
+                # the math bitwise identical to the unscaled seed). Loss
+                # heads pick the seed up multiplicatively (ops/nn._loss_op);
+                # implicit mid-chain loss sites read the scope instead.
+                from ..ops.nn import loss_grad_scale_scope
+                s32 = jnp.asarray(scale, jnp.float32)
+                seeds = tuple(jnp.full(o.shape, s32.astype(o.dtype))
+                              for o in outs)
+                with loss_grad_scale_scope(s32):
+                    grads = vjp(seeds)[0]
+            else:
+                seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+                grads = vjp(seeds)[0]
+            if supervise:
+                inv = jnp.float32(1.0) / s32
+                grads = {n: g * inv.astype(g.dtype)
+                         for n, g in grads.items()}
+                # in-graph all-finite verdict: every output plus the
+                # global gradient norm (an f32 norm overflowing to inf is
+                # a numeric fault by definition). Device scalars only —
+                # the host reads the verdict where async dispatch already
+                # blocks, never adding a sync.
+                good = jnp.bool_(True)
+                for o in outs:
+                    if jnp.issubdtype(o.dtype, jnp.floating):
+                        good &= jnp.all(jnp.isfinite(o))
+                gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in grads.values())
+                good &= jnp.isfinite(gsq)
             if cdt is not None and zero_layout is None:
                 # fp32 master update (mp_sgd semantics); the ZERO path
                 # casts inside its shard_map island instead
@@ -348,6 +390,21 @@ class DataParallelTrainStep:
             if fixed:
                 new_params = {n: (params[n] if n in fixed else v)
                               for n, v in new_params.items()}
+            if supervise:
+                # donation-safe carry: a bad step keeps params/opt_state/
+                # BN aux EXACTLY as they were — jnp.where builds fresh
+                # output buffers, so the skipped state never aliases the
+                # poisoned update math (and XLA may still alias the
+                # donated inputs on the clean path)
+                def _carry(new, old):
+                    return jnp.where(good, new, old)
+                new_params = {n: _carry(v, params[n])
+                              for n, v in new_params.items()}
+                new_state = jax.tree_util.tree_map(_carry, new_state,
+                                                   opt_state)
+                aux_upd = {n: _carry(v, aux[n])
+                           for n, v in aux_upd.items()}
+                return new_params, new_state, aux_upd, outs, good
             return new_params, new_state, aux_upd, outs
 
         st_sharding = self._state_shardings()
@@ -366,6 +423,9 @@ class DataParallelTrainStep:
         # shards and all-gathers the updated weights
         out_shardings = ({n: self._repl for n in self.param_names},
                          st_sharding, None, None)
+        if supervise:
+            in_shardings = in_shardings + (None,)   # loss scale (scalar)
+            out_shardings = out_shardings + (None,)  # all-finite verdict
         # batch args (3, 4) are NOT donated: no step output matches the
         # batch shapes, so XLA could never alias them — donation would only
         # warn per compile and force callers that reuse device-resident
@@ -407,6 +467,9 @@ class DataParallelTrainStep:
         # mode; this step donates params only, see _build_step)
         roles = ("params", "opt_state_shard" if self.zero else "opt_state",
                  "aux", "batch", "batch", "rng", "lr")
+        if self.supervise:
+            roles = roles + ("lr",)  # the loss scale: a runtime scalar
+            # with the same (never-donated) contract as lr
         report_findings(check_donation(donate_argnums, roles, mode="train",
                                        where="tpu_step"))
         # the jaxpr sweep AND the donation-aliasing check wait for the
@@ -450,14 +513,16 @@ class DataParallelTrainStep:
 
         from .. import random as _rnd
         key = _rnd.fixed_key()
-        self._step.aot(
-            sds(self.params), sds(self.opt_state), sds(self.aux),
-            batch_sds(self.data_names), batch_sds(self.label_names),
-            jax.ShapeDtypeStruct(tuple(key.shape), key.dtype),
-            jax.ShapeDtypeStruct((), f32))
+        args = (sds(self.params), sds(self.opt_state), sds(self.aux),
+                batch_sds(self.data_names), batch_sds(self.label_names),
+                jax.ShapeDtypeStruct(tuple(key.shape), key.dtype),
+                jax.ShapeDtypeStruct((), f32))
+        if self.supervise:
+            args = args + (jax.ShapeDtypeStruct((), f32),)  # loss scale
+        self._step.aot(*args)
         return self
 
-    def __call__(self, batch_np, rng=None, lr=None):
+    def __call__(self, batch_np, rng=None, lr=None, scale=None):
         """Run one step on a global batch (dict name->numpy or jax.Array).
 
         Device-resident inputs already on the right sharding (e.g.
@@ -492,6 +557,8 @@ class DataParallelTrainStep:
             rng = jax.device_put(rng, self._repl)
         if lr is None:
             lr = self.lr
+        if self.supervise and scale is None:
+            scale = 1.0
         if self._lint_sweep_pending:
             # deferred MXNET_TPU_LINT jaxpr sweep (see _lint_step): one
             # abstract trace of the REAL argument signature, first step only
@@ -500,6 +567,8 @@ class DataParallelTrainStep:
             from ..analysis.runtime import check_traced, report_findings
             step_args = (self.params, self.opt_state, self.aux, data_part,
                          label_part, rng, _np.float32(lr))
+            if self.supervise:
+                step_args = step_args + (_np.float32(scale),)
             _, jaxpr = check_traced(self._step_fn, step_args,
                                     "tpu_step.fused_step", want_jaxpr=True)
             if jaxpr is not None:
@@ -511,9 +580,15 @@ class DataParallelTrainStep:
                 report_findings(check_donation_aliasing(
                     in_avals, out_avals, self._lint_donate_argnums,
                     where="tpu_step"))
-        self.params, self.opt_state, aux_upd, outs = self._step(
-            self.params, self.opt_state, self.aux, data_part, label_part,
-            rng, _np.float32(lr))
+        if self.supervise:
+            (self.params, self.opt_state, aux_upd, outs,
+             self.last_flag) = self._step(
+                self.params, self.opt_state, self.aux, data_part,
+                label_part, rng, _np.float32(lr), _np.float32(scale))
+        else:
+            self.params, self.opt_state, aux_upd, outs = self._step(
+                self.params, self.opt_state, self.aux, data_part,
+                label_part, rng, _np.float32(lr))
         self.moms = self.opt_state.get("mom") or {}
         self.aux.update(aux_upd)
         return outs

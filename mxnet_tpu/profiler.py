@@ -24,7 +24,9 @@ __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
            "record_watchdog_event", "watchdog_counters",
            "record_fault_injection", "fault_counters",
            "record_fleet_event", "fleet_counters",
-           "record_compile", "record_compile_hit", "compile_counters",
+           "record_supervisor_event", "supervisor_counters",
+           "record_compile", "record_compile_hit", "record_compile_corrupt",
+           "compile_counters",
            "ensure_compile_listener", "persistent_cache_hit_count",
            "thread_persistent_cache_hits"]
 
@@ -453,6 +455,37 @@ def fleet_counters(reset=False):
     return out
 
 
+# ----------------------------------------------------------------------
+# training-supervisor counters (resilience/supervisor.py, ISSUE 15):
+# numeric-fault containment and restart/resume accounting — always-on
+# plain adds like the retry family, so the train_chaos gates can assert
+# "the NaN WAS skipped" / "the run WAS restarted" without a profiler
+# session. Keys: steps (verdicts observed), bad_steps (skipped),
+# divergences, restarts, stalls, scale_backoffs, scale_regrows, resumes.
+# ----------------------------------------------------------------------
+_SUPERVISOR_ZERO = {"steps": 0, "bad_steps": 0, "divergences": 0,
+                    "restarts": 0, "stalls": 0, "scale_backoffs": 0,
+                    "scale_regrows": 0, "resumes": 0}
+_supervisor = dict(_SUPERVISOR_ZERO)
+
+
+def record_supervisor_event(**deltas):
+    """Accumulate training-supervisor counters (free-form int deltas)."""
+    with _state["lock"]:
+        for k, v in deltas.items():
+            _supervisor[k] = _supervisor.get(k, 0) + v
+
+
+def supervisor_counters(reset=False):
+    """Snapshot (optionally reset) the training-supervisor counters."""
+    with _state["lock"]:
+        out = dict(_supervisor)
+        if reset:
+            _supervisor.clear()
+            _supervisor.update(_SUPERVISOR_ZERO)
+    return out
+
+
 def fault_counters(reset=False):
     """Snapshot (optionally reset) injected-fault counts per site."""
     with _state["lock"]:
@@ -475,7 +508,8 @@ def fault_counters(reset=False):
 # compiles_in_window reads this family).
 # ----------------------------------------------------------------------
 _COMPILE_ZERO = {"compiles": 0, "compile_ms": 0.0, "aot": 0,
-                 "ondemand": 0, "cache_hits": 0, "persistent_hits": 0}
+                 "ondemand": 0, "cache_hits": 0, "persistent_hits": 0,
+                 "cache_corrupt": 0}
 _compile_total = dict(_COMPILE_ZERO)
 _compile_sites = {}
 _pcache = {"hits": 0, "listener": False}
@@ -546,6 +580,16 @@ def record_compile_hit(site):
         for d in (_compile_total,
                   _compile_sites.setdefault(site, dict(_COMPILE_ZERO))):
             d["cache_hits"] += 1
+
+
+def record_compile_corrupt(site):
+    """Record one persistent-compile-cache entry that failed to load
+    (truncated/corrupt bytes) and was degraded to a cache miss — the
+    builder recompiled instead of crashing warmup (ISSUE 15)."""
+    with _state["lock"]:
+        for d in (_compile_total,
+                  _compile_sites.setdefault(site, dict(_COMPILE_ZERO))):
+            d["cache_corrupt"] += 1
 
 
 def compile_counters(reset=False):

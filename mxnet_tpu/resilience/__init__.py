@@ -13,11 +13,14 @@ with its subject in ``serving/server.py``; this package holds the
 cross-cutting machinery.
 """
 from .faults import (fault_point, configure, reset, enabled, stats,
-                     FaultInjected, TransientError)
+                     register_exception, FaultInjected, TransientError)
 from .retry import RetryPolicy, RETRYABLE_DEFAULT, retry_call
 from .watchdog import Watchdog, Heartbeat, watchdog
+from .supervisor import (TrainingSupervisor, NumericDivergence,
+                         TrainingStalled, supervisor_from_env)
 
 __all__ = ["fault_point", "configure", "reset", "enabled", "stats",
-           "FaultInjected", "TransientError", "RetryPolicy",
-           "RETRYABLE_DEFAULT", "retry_call", "Watchdog", "Heartbeat",
-           "watchdog"]
+           "register_exception", "FaultInjected", "TransientError",
+           "RetryPolicy", "RETRYABLE_DEFAULT", "retry_call", "Watchdog",
+           "Heartbeat", "watchdog", "TrainingSupervisor",
+           "NumericDivergence", "TrainingStalled", "supervisor_from_env"]

@@ -48,7 +48,8 @@ import time
 from ..base import MXNetError, get_env
 
 __all__ = ["fault_point", "configure", "reset", "enabled", "stats",
-           "parse_spec", "FaultInjected", "TransientError"]
+           "parse_spec", "register_exception", "FaultInjected",
+           "TransientError"]
 
 
 class FaultInjected(MXNetError):
@@ -83,6 +84,17 @@ for _name in ("OSError", "IOError", "RuntimeError", "ValueError",
     _EXC_WHITELIST[_name] = getattr(_builtins, _name)
 
 _SITE_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_.\-]*$")
+
+
+def register_exception(name, exc_cls):
+    """Add a framework-typed exception to the ``raise=`` whitelist so
+    specs can exercise a subsystem's own typed failure path (e.g.
+    ``train.stall:raise=TrainingStalled``). Never an arbitrary attribute
+    lookup: callers register explicit classes at import time."""
+    if not (isinstance(exc_cls, type) and issubclass(exc_cls, BaseException)):
+        raise MXNetError("register_exception needs an exception class, "
+                         "got %r" % (exc_cls,))
+    _EXC_WHITELIST[name] = exc_cls
 
 
 class _FaultSpec:

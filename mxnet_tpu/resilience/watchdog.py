@@ -112,6 +112,12 @@ class Watchdog:
         self.stall_timeout_s = float(stall_timeout_s)
         self.enabled = bool(enabled)
         self._lock = threading.Lock()
+        # serializes whole scans (NOT self._lock: restart factories may
+        # re-register, which takes self._lock) — a monitor-tick scan
+        # racing an explicit scan (io_device._maybe_restart) must never
+        # apply one death's restart policy twice (two live workers over
+        # one base iterator)
+        self._scan_lock = threading.Lock()
         self._beats = []
         self._stop = threading.Event()
         self._monitor = None
@@ -164,6 +170,11 @@ class Watchdog:
         events recorded."""
         from .. import profiler as _prof
         now = time.monotonic() if now is None else now
+        with self._scan_lock:
+            return self._scan_locked(now)
+
+    def _scan_locked(self, now):
+        from .. import profiler as _prof
         events = 0
         with self._lock:
             beats = list(self._beats)

@@ -184,6 +184,12 @@ class _ClientConn:
         self.reader = threading.Thread(target=self._read_loop,
                                        name="mx-serving-client-read",
                                        daemon=True)
+        # watchdog supervision (TPL109): the reader mostly idles in recv
+        # (exempt from stall judgment); a death without running its
+        # transport-loss recovery IS a watchdog death worth a counter
+        from ..resilience.watchdog import watchdog as _watchdog
+        self.hb = _watchdog().register("mx-serving-client-read",
+                                       thread=self.reader)
         self.reader.start()
 
     def next_rid(self):
@@ -235,6 +241,7 @@ class _ClientConn:
     # ------------------------------------------------------------------
     def _read_loop(self):
         while not self.stop_evt.is_set():
+            self.hb.idle()  # blocked in recv = waiting for work
             try:
                 # tick-aware: an idle-timeout before any frame byte just
                 # re-checks stop_evt; a timeout INSIDE a frame is a
@@ -251,7 +258,10 @@ class _ClientConn:
                 continue
             if msg is None:
                 break
+            self.hb.beat()
             self._dispatch(msg)
+        self.hb.close()  # loop exit (close or transport death) is an
+        # outcome the recovery below handles — not a silent watchdog death
         if not self.stop_evt.is_set():     # transport death, not close()
             self.alive = False
             with self.pending_lock:

@@ -391,6 +391,7 @@ class InferenceEngine:
             # on its next check instead of being revived by a new start
             stop_evt = threading.Event()
             self._reload_stop = stop_evt
+            # tpulint: allow-unsupervised-thread target registers its own heartbeat inside _run_reload_poller
             self._reload_thread = threading.Thread(
                 target=self._poll_loop, name="mx-serving-reload",
                 args=(directory, poll_interval, stop_evt), daemon=True)

@@ -1079,6 +1079,7 @@ class ModelServer:
                      and not self._stopped)
         if start:
             stop_evt = threading.Event()
+            # tpulint: allow-unsupervised-thread target registers its own heartbeat inside _run_reload_poller
             thread = threading.Thread(
                 target=self._poll_loop, name="mx-serving-server-reload",
                 args=(name, directory, poll_interval, stop_evt),

@@ -1318,3 +1318,122 @@ class TestCLI:
             assert "lint" in {name for name, _ in run.STAGES}
         finally:
             sys.path.remove(_REPO)
+
+
+# ----------------------------------------------------------------------
+# TPL109 unsupervised-thread (ISSUE 15: every thread created in the
+# long-lived-thread subsystems registers a watchdog Heartbeat)
+# ----------------------------------------------------------------------
+class TestUnsupervisedThread:
+    SCOPED = "mxnet_tpu/serving/worker.py"
+
+    def test_bare_thread_flagged(self):
+        bad = """
+            import threading
+            def start(loop):
+                t = threading.Thread(target=loop, daemon=True)
+                t.start()
+        """
+        f = _active(_lint(bad, path=self.SCOPED))
+        assert [x.rule_id for x in f] == ["TPL109"]
+
+    def test_heartbeat_in_creating_function_clean(self):
+        # the good twin: same Thread, but the creating function registers
+        # a watchdog Heartbeat for it
+        src = """
+            import threading
+            from mxnet_tpu.resilience.watchdog import watchdog
+            def start(loop):
+                t = threading.Thread(target=loop, daemon=True)
+                hb = watchdog().register("w", thread=t)
+                t.start()
+        """
+        assert not _active(_lint(src, path=self.SCOPED), rule="TPL109")
+
+    def test_heartbeat_in_target_clean(self):
+        # the worker target registering its own heartbeat also counts
+        src = """
+            import threading
+            from mxnet_tpu.resilience.watchdog import watchdog
+
+            def _loop():
+                hb = watchdog().register("w")
+                while True:
+                    hb.beat()
+
+            def start():
+                threading.Thread(target=_loop, daemon=True).start()
+        """
+        assert not _active(_lint(src, path=self.SCOPED), rule="TPL109")
+
+    def test_heartbeat_on_enclosing_class_clean(self):
+        # registration elsewhere on the same class (e.g. the worker loop
+        # method) keeps the creator clean
+        src = """
+            import threading
+            from mxnet_tpu.resilience.watchdog import watchdog
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    self._hb = watchdog().register("w", thread=self._t)
+        """
+        assert not _active(_lint(src, path=self.SCOPED), rule="TPL109")
+
+    def test_out_of_scope_paths_exempt(self):
+        bad = """
+            import threading
+            def start(loop):
+                threading.Thread(target=loop).start()
+        """
+        for path in ("mxnet_tpu/module/module.py", "mxnet_tpu/io.py",
+                     "tools/launch.py", "tests/python/unittest/t.py"):
+            assert not _active(_lint(bad, path=path), rule="TPL109")
+
+    def test_scope_helper(self):
+        from mxnet_tpu.analysis.rules import is_threadwatch_scope
+        assert is_threadwatch_scope("mxnet_tpu/serving/engine.py")
+        assert is_threadwatch_scope("mxnet_tpu/checkpoint/manager.py")
+        assert is_threadwatch_scope("mxnet_tpu/parallel/tpu_step.py")
+        assert is_threadwatch_scope("mxnet_tpu/resilience/watchdog.py")
+        assert is_threadwatch_scope("mxnet_tpu/io_device.py")
+        assert not is_threadwatch_scope("mxnet_tpu/io.py")
+        assert not is_threadwatch_scope("mxnet_tpu/module/module.py")
+
+    def test_pragma_suppresses_with_reason(self):
+        src = """
+            import threading
+            def start(loop):
+                # tpulint: allow-unsupervised-thread short-lived join()ed helper, dies with its caller
+                t = threading.Thread(target=loop, daemon=True)
+                t.start()
+        """
+        findings = _lint(src, path=self.SCOPED)
+        assert not _active(findings)
+        assert any(f.rule_id == "TPL109" and f.suppressed for f in findings)
+
+    def test_shipped_tree_is_tpl109_clean(self):
+        """The supervision contract holds on the real tree: every thread
+        in serving/checkpoint/parallel/resilience/io_device.py is either
+        heartbeat-registered or carries a reasoned pragma."""
+        import mxnet_tpu
+        from mxnet_tpu.analysis.rules import is_threadwatch_scope
+        root = os.path.dirname(mxnet_tpu.__file__)
+        bad = []
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fname in filenames:
+                if not fname.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fname)
+                rel = os.path.join("mxnet_tpu",
+                                   os.path.relpath(full, root))
+                if not is_threadwatch_scope(rel):
+                    continue
+                with open(full, encoding="utf-8") as fh:
+                    src = fh.read()
+                bad += [f for f in lint_source(src, rel)
+                        if f.rule_id == "TPL109" and not f.suppressed]
+        assert not bad, bad

@@ -447,3 +447,82 @@ class TestCrossProcessReuse:
         assert warm["persistent_hits"] >= 1  # cache-backed, reported
         # generous bound for CI noise; the bench phase gates <= 0.5
         assert warm["ms"] < cold["ms"] * 0.8, (cold, warm)
+
+
+# ----------------------------------------------------------------------
+# persistent-cache corruption tolerance (ISSUE 15 satellite: a corrupt
+# entry degrades to a cache miss — recompile, never a crashed warmup)
+# ----------------------------------------------------------------------
+class TestCacheCorruptionTolerance:
+    def test_flipped_bytes_in_cached_entry_degrade_to_miss(self, tmp_path):
+        """Warm the persistent cache, flip bytes in the middle of every
+        entry (a half-written file from a killed process, bit rot on
+        shared disk), restart: warmup must complete by recompiling —
+        corrupt entries can neither crash the build nor 'hit'."""
+        env = dict(os.environ)
+        env["MXNET_TPU_COMPILE_CACHE"] = str(tmp_path)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+
+        def run():
+            out = subprocess.run(
+                [sys.executable, "-c", _CHILD % {"repo": _REPO}],
+                env=env, capture_output=True, text=True, timeout=300)
+            assert out.returncode == 0, out.stderr[-2000:]
+            return json.loads(out.stdout.strip().splitlines()[-1])
+
+        cold = run()
+        assert cold["cache_dir"] == str(tmp_path)
+        entries = [os.path.join(r, f)
+                   for r, _d, files in os.walk(str(tmp_path))
+                   for f in files]
+        assert entries, "cold run cached nothing"
+        for path in entries:
+            with open(path, "r+b") as fh:
+                data = bytearray(fh.read())
+                for i in range(len(data) // 2, min(len(data), 
+                                                   len(data) // 2 + 64)):
+                    data[i] ^= 0xFF
+                fh.seek(0)
+                fh.write(data)
+        rerun = run()                        # the regression: no crash
+        assert rerun["persistent_hits"] == 0, \
+            "a corrupt entry must not count as a cache hit: %s" % rerun
+
+    def test_cache_read_fault_recompiles_and_counts(self, tmp_path,
+                                                    monkeypatch):
+        """The compile.cache_read fault site: an injected read failure
+        with a cache configured recompiles once (cache bypassed) and
+        lands in the compile.cache_corrupt counter."""
+        from mxnet_tpu import base as mx_base
+        from mxnet_tpu.resilience import faults
+        monkeypatch.setitem(mx_base._compile_cache_state, "dir",
+                            str(tmp_path))
+        faults.configure(
+            "compile.cache_read:count=1:raise=RuntimeError,corrupt entry")
+        try:
+            b = ProgramBuilder(_fn, site="corrupt_fault")
+            b.aot(_sds(), _sds())
+        finally:
+            faults.reset()
+        site = profiler.compile_counters()["sites"]["corrupt_fault"]
+        assert site["cache_corrupt"] == 1
+        assert site["compiles"] == 1         # the recompile succeeded
+
+    def test_cache_read_fault_without_cache_surfaces(self, monkeypatch):
+        """No persistent cache configured: a compile failure is a real
+        compile failure — zero behavior change, the error surfaces."""
+        from mxnet_tpu import base as mx_base
+        from mxnet_tpu.resilience import faults
+        monkeypatch.setitem(mx_base._compile_cache_state, "dir", None)
+        faults.configure(
+            "compile.cache_read:count=1:raise=RuntimeError,real failure")
+        try:
+            b = ProgramBuilder(_fn, site="corrupt_nofault")
+            with pytest.raises(RuntimeError, match="real failure"):
+                b.aot(_sds(), _sds())
+        finally:
+            faults.reset()
+        site = profiler.compile_counters()["sites"].get("corrupt_nofault",
+                                                        {})
+        assert site.get("cache_corrupt", 0) == 0

@@ -865,3 +865,76 @@ class TestKvstoreTransport:
             kv.pull("w", out=mx.nd.zeros((2, 2)))
         kv.pull("w", out=mx.nd.zeros((2, 2)))  # next pull fine
         kv.stop_server()
+
+
+# ---------------------------------------------------------------------------
+# prefetch stager RESTART policy (ISSUE 15: factory re-supervision)
+# ---------------------------------------------------------------------------
+class TestStagerRestart:
+    def _iter(self, n=8, batch=4):
+        data = np.arange(n * batch * 3, dtype=np.float32).reshape(
+            n * batch, 3)
+        label = np.arange(n * batch, dtype=np.float32)
+        return mx.io.NDArrayIter(data=data, label=label, batch_size=batch)
+
+    def test_killed_stager_recovers_without_losing_a_batch(self):
+        """A stager thread killed WITHOUT running its own error transport
+        (the exception handler itself dies — the in-process equivalent of
+        an interpreter-level kill) is revived by the watchdog restart
+        factory mid-epoch; the pulled-but-undelivered batch is re-staged
+        first, so the consumer sees every batch exactly once, in order."""
+        from mxnet_tpu.io_device import DevicePrefetchIter
+        profiler.watchdog_counters(reset=True)
+        it = DevicePrefetchIter(self._iter())
+        orig_put = it._put
+        state = {"kills": 0}
+
+        def killer_put(item):
+            # raise on the delivery AND on the worker's error transport:
+            # the thread dies silently, heartbeat left open (a real kill
+            # never runs finally blocks either)
+            if state["kills"] < 2 and it.counters["staged"] >= 3:
+                state["kills"] += 1
+                raise SystemExit("simulated stager kill")
+            return orig_put(item)
+
+        it._put = killer_put
+        got = [np.asarray(b.data[0])[:, 0].copy() for b in it]
+        want = [np.asarray(b.data[0].asnumpy())[:, 0] for b in self._iter()]
+        assert state["kills"] == 2            # the kill really happened
+        assert it._restarts == 1
+        assert len(got) == len(want) == 8
+        for w, g in zip(want, got):
+            assert np.array_equal(w, g)       # no drop, no reorder
+        c = profiler.watchdog_counters()
+        assert c.get("mx-device-prefetch.death", 0) >= 1
+        assert c.get("mx-device-prefetch.restart", 0) >= 1
+        it._shutdown()
+
+    def test_restart_budget_exhaustion_surfaces(self):
+        """A stager that keeps dying burns its restart budget and then
+        surfaces an error instead of looping forever."""
+        from mxnet_tpu.io_device import DevicePrefetchIter
+        it = DevicePrefetchIter(self._iter())
+
+        def always_killed_put(item):
+            raise SystemExit("simulated stager kill")
+
+        it._put = always_killed_put
+        with pytest.raises(MXNetError):
+            for _ in range(20):
+                it.next()
+        assert it._restarts <= it._MAX_RESTARTS
+        it._shutdown()
+
+    def test_clean_shutdown_is_not_a_death(self):
+        from mxnet_tpu.io_device import DevicePrefetchIter
+        profiler.watchdog_counters(reset=True)
+        it = DevicePrefetchIter(self._iter())
+        it.next()
+        it._shutdown()
+        from mxnet_tpu.resilience.watchdog import watchdog
+        watchdog().scan()
+        c = profiler.watchdog_counters()
+        assert c.get("mx-device-prefetch.death", 0) == 0
+        assert c.get("mx-device-prefetch.restart", 0) == 0

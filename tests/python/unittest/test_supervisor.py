@@ -1,0 +1,515 @@
+"""Training supervisor (ISSUE 15): NaN/stall containment, loss-scale
+dynamics, crash-exact data-position resume, and repeated-preemption
+churn (resilience/supervisor.py, docs/faq/resilience.md "Training
+supervision").
+
+The SIGKILL scenarios spawn real OS processes through the shared child
+driver in tools/train_chaos_smoke.py — the same code path the
+`ci/run.py train_chaos_smoke` gate and bench.py's train_chaos phase
+drive, so test, gate, and bench can never measure different things.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resilience import (NumericDivergence, TrainingStalled,
+                                  TrainingSupervisor, faults)
+
+_REPO = os.path.normpath(os.path.join(os.path.dirname(__file__),
+                                      "..", "..", ".."))
+_CHAOS = os.path.join(_REPO, "tools", "train_chaos_smoke.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.reset()
+    profiler.supervisor_counters(reset=True)
+    yield
+    faults.reset()
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="sv_fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="sv_fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy(n=64, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(0, 1, (n, d)).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    return X, y
+
+
+def _fit(supervisor, num_epoch=2, bf16=False, shuffle=True, seed=7,
+         manager=None, epoch_end_callback=None):
+    X, y = _toy()
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=shuffle,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=[mx.tpu(0)])
+    opt_params = {"learning_rate": 0.05, "momentum": 0.9}
+    if bf16:
+        opt_params["multi_precision"] = True
+    mod.fit(it, num_epoch=num_epoch, kvstore="tpu_sync", optimizer="sgd",
+            optimizer_params=opt_params,
+            initializer=mx.init.Xavier(), supervisor=supervisor,
+            checkpoint_manager=manager,
+            epoch_end_callback=epoch_end_callback)
+    args, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in args.items()}
+
+
+# ---------------------------------------------------------------------------
+# the containment state machine (unit)
+# ---------------------------------------------------------------------------
+class TestStateMachine:
+    def test_fp32_scale_is_exact_one_and_never_regrows(self):
+        sup = TrainingSupervisor(scale_window=1)
+        assert sup.loss_scale == 1.0
+        for _ in range(5):
+            sup.observe_step(True)
+        assert sup.loss_scale == 1.0          # exact multiply-by-one kept
+        sup.observe_step(False)
+        assert sup.loss_scale == 1.0          # floor is 1.0
+
+    def test_backoff_and_regrow_trajectory(self):
+        """The regression trajectory: bad step halves, `scale_window`
+        clean steps double, always powers of two, capped."""
+        sup = TrainingSupervisor(loss_scale=2.0 ** 15, scale_window=2,
+                                 bad_steps_limit=10)
+        trajectory = []
+        plan = [True, True, False, True, False, True, True, True, True]
+        for good in plan:
+            sup.observe_step(good)
+            trajectory.append(sup.loss_scale)
+        assert trajectory == [2.0 ** 15, 2.0 ** 16,          # regrow at 2
+                              2.0 ** 15,                     # backoff
+                              2.0 ** 15, 2.0 ** 14,          # backoff again
+                              2.0 ** 14, 2.0 ** 15,          # clean streak
+                              2.0 ** 15, 2.0 ** 16]
+        c = profiler.supervisor_counters()
+        assert c["scale_backoffs"] == 2 and c["scale_regrows"] == 3
+        assert all(v == 2.0 ** int(np.log2(v)) for v in trajectory)
+
+    def test_scale_cap(self):
+        sup = TrainingSupervisor(loss_scale=TrainingSupervisor._SCALE_MAX,
+                                 scale_window=1)
+        sup.observe_step(True)
+        assert sup.loss_scale == TrainingSupervisor._SCALE_MAX
+
+    def test_divergence_after_k_consecutive_bad_steps(self):
+        sup = TrainingSupervisor(bad_steps_limit=3)
+        sup.observe_step(False)
+        sup.observe_step(False)
+        sup.observe_step(True)                # streak broken
+        sup.observe_step(False)
+        sup.observe_step(False)
+        with pytest.raises(NumericDivergence):
+            sup.observe_step(False)
+        c = profiler.supervisor_counters()
+        assert c["divergences"] == 1 and c["bad_steps"] == 5
+
+    def test_divergence_is_not_retryable(self):
+        sup = TrainingSupervisor()
+        assert not sup._backoff.is_retryable(NumericDivergence("x"))
+        assert sup._backoff.is_retryable(TrainingStalled("x"))
+
+    def test_state_roundtrip(self):
+        a = TrainingSupervisor(loss_scale=2.0 ** 12)
+        a.observe_step(True)
+        a.observe_step(False)
+        b = TrainingSupervisor()
+        b.load_state(a.state_dict())
+        assert b.loss_scale == a.loss_scale
+        assert (b.steps, b.bad_steps, b.bad_streak, b.clean_streak) == \
+            (a.steps, a.bad_steps, a.bad_streak, a.clean_streak)
+        assert profiler.supervisor_counters()["resumes"] == 1
+        # a restored scale is authoritative: attach must not re-derive
+        class _Step:
+            compute_dtype = "bfloat16"
+        b.attach_step(_Step())
+        assert b.loss_scale == a.loss_scale
+
+    def test_attach_derives_reduced_precision_default(self):
+        class _Step:
+            compute_dtype = "bfloat16"
+        sup = TrainingSupervisor()
+        sup.attach_step(_Step())
+        assert sup.loss_scale == 2.0 ** 15
+        _Step.compute_dtype = None
+        sup2 = TrainingSupervisor()
+        sup2.attach_step(_Step())
+        assert sup2.loss_scale == 1.0
+
+    def test_stall_deadline_raises_typed(self):
+        sup = TrainingSupervisor(step_deadline_s=0.05)
+
+        class _NeverReady:
+            def is_ready(self):
+                return False
+        with pytest.raises(TrainingStalled):
+            sup.await_ready([_NeverReady()], None)
+        assert profiler.supervisor_counters()["stalls"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the supervised fused step (integration, CPU mesh)
+# ---------------------------------------------------------------------------
+class TestSupervisedFit:
+    def test_clean_supervised_run_is_bit_identical_to_unsupervised(self):
+        """fp32 supervision must be numerically FREE: scale 1.0 seeds the
+        backward identically and the carry picks the clean branch — the
+        whole fit lands on bit-equal params."""
+        _, plain = _fit(supervisor=False)
+        _, sup = _fit(supervisor=TrainingSupervisor())
+        assert set(plain) == set(sup)
+        for k in plain:
+            assert np.array_equal(plain[k], sup[k]), k
+
+    def test_bf16_scaled_run_is_bit_identical_to_unsupervised(self):
+        """The loss-scale seed must actually REACH the gradients: the
+        reference loss heads emit their own gradient, so the head
+        cotangent enters multiplicatively (ops/nn.py _loss_op) — without
+        that, scaled runs divide gradients that were never multiplied
+        (2^15 off, the run silently freezes). Power-of-two scale up then
+        down is exact in bf16, so the scaled fit is bit-equal to the
+        unscaled one."""
+        _, plain = _fit(supervisor=False, bf16=True)
+        sup = TrainingSupervisor(loss_scale=2.0 ** 15, scale_window=0)
+        _, scaled = _fit(supervisor=sup, bf16=True)
+        assert sup.loss_scale == 2.0 ** 15    # no backoff: steps stayed clean
+        for k in plain:
+            assert np.array_equal(plain[k], scaled[k]), k
+
+    def test_injected_nan_step_is_skipped_and_contained(self):
+        faults.configure("train.nan:count=3:raise=FaultInjected")
+        sup = TrainingSupervisor()
+        _, params = _fit(supervisor=sup)
+        c = profiler.supervisor_counters()
+        assert c["bad_steps"] == 1 and sup.bad_steps == 1
+        assert c["steps"] == 16               # every verdict observed
+        assert all(np.isfinite(v).all() for v in params.values())
+
+    def test_skipped_step_leaves_state_untouched(self):
+        """The donation-safe carry: a poisoned step must leave params
+        exactly where the previous step put them — the run with one
+        poisoned FINAL step equals the clean run up to that step."""
+        # clean run, one epoch = 8 steps
+        _, ref = _fit(supervisor=TrainingSupervisor(), num_epoch=1)
+        # same run with the LAST step poisoned: its update is skipped,
+        # so the result must bit-equal the clean 7-step prefix + skip
+        faults.configure("train.nan:count=8:raise=FaultInjected")
+        sup = TrainingSupervisor()
+        _, skipped = _fit(supervisor=sup, num_epoch=1)
+        assert sup.bad_steps == 1
+        diff = any(not np.array_equal(ref[k], skipped[k]) for k in ref)
+        assert diff                            # the skip really skipped
+        assert all(np.isfinite(v).all() for v in skipped.values())
+
+    def test_consecutive_nan_steps_raise_numeric_divergence(self):
+        faults.configure("train.nan:after=1:raise=FaultInjected")
+        with pytest.raises(NumericDivergence):
+            _fit(supervisor=TrainingSupervisor(bad_steps_limit=3))
+        assert profiler.supervisor_counters()["divergences"] == 1
+
+    def test_bf16_loss_scale_backs_off_and_regrows(self):
+        faults.configure("train.nan:count=3:raise=FaultInjected")
+        sup = TrainingSupervisor(scale_window=4)
+        _, params = _fit(supervisor=sup, bf16=True)
+        assert sup.loss_scale != 1.0          # the bf16 default engaged
+        # deterministic trajectory over 16 steps: start 2**15, the
+        # poisoned step 2 halves to 2**14, the 13-step clean tail regrows
+        # at streaks 4/8/12 -> 2**17
+        c = profiler.supervisor_counters()
+        assert c["scale_backoffs"] == 1 and c["scale_regrows"] == 3
+        assert sup.loss_scale == 2.0 ** 17
+        assert all(np.isfinite(v).all() for v in params.values())
+
+    def test_supervising_a_prebound_module_rebuilds_the_fused_step(self):
+        """A module already bound by an UNsupervised fit carries a fused
+        step with no verdict plumbing; a later supervisor= fit must
+        rebuild it, not silently run unsupervised."""
+        X, y = _toy()
+        it = mx.io.NDArrayIter(X, y, batch_size=8,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(_mlp(), context=[mx.tpu(0)])
+        mx.random.seed(7)
+        mod.fit(it, num_epoch=1, kvstore="tpu_sync", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05},
+                initializer=mx.init.Xavier())
+        assert not mod._fused_step.supervise
+        profiler.supervisor_counters(reset=True)
+        it.reset()
+        sup = TrainingSupervisor()
+        mod.fit(it, num_epoch=1, kvstore="tpu_sync", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05},
+                supervisor=sup)
+        assert mod._fused_step.supervise
+        assert profiler.supervisor_counters()["steps"] == 8
+
+    def test_restart_drops_failed_attempts_inflight_steps(self):
+        """The failed attempt's undrained in-flight verdicts must not be
+        judged against the restored supervisor state on the retry — a
+        leftover bad flag would back off the restored loss scale."""
+        from collections import deque
+
+        class _StubModule:
+            def __init__(self):
+                self._inflight = deque([("stale-outs", "stale-flag")])
+                self.calls = 0
+
+            def fit(self, **kwargs):
+                self.calls += 1
+                if self.calls == 1:
+                    raise TrainingStalled("wedged")
+
+        mod = _StubModule()
+        sup = TrainingSupervisor(max_restarts=1)
+        sup._backoff.base_delay_s = 0.0      # no real backoff in tests
+        sup._backoff.cap_delay_s = 0.0
+        sup.run_fit(mod, {})
+        assert mod.calls == 2
+        assert not mod._inflight
+
+    def test_implicit_loss_site_honors_the_scale_scope(self):
+        """IdentityAttachKLSparseReg injects its penalty gradient
+        mid-chain where no head cotangent carries the loss-scale seed —
+        it must fold the traced scale in itself, or the supervised
+        post-step unscale divides the penalty by the scale."""
+        import jax
+        import jax.numpy as jnp
+        from mxnet_tpu.ops import nn as nn_ops
+        from mxnet_tpu.ops.compat_extra import (
+            KLSparseRegParam, _identity_attach_kl_sparse_reg)
+        p = KLSparseRegParam()
+        x = jnp.full((4, 3), 0.5, jnp.float32)
+        avg = jnp.full((3,), 0.3, jnp.float32)
+
+        def f(xx):
+            out, _ = _identity_attach_kl_sparse_reg(p, xx, avg)
+            return out
+
+        # zero seed isolates the additive penalty term
+        zero_seed = jnp.zeros((4, 3), jnp.float32)
+        _, vjp = jax.vjp(f, x)
+        reg = np.asarray(vjp(zero_seed)[0])
+        assert np.any(reg != 0.0)
+        with nn_ops.loss_grad_scale_scope(jnp.float32(8.0)):
+            _, vjp_s = jax.vjp(f, x)
+            reg_scaled = np.asarray(vjp_s(zero_seed)[0])
+        assert np.allclose(reg_scaled, reg * 8.0)
+
+    def test_clean_supervised_steps_add_no_host_syncs(self):
+        """The zero-added-syncs contract, asserted the PR-9 way: with
+        NDArray.asnumpy poisoned, warmed supervised dispatches must not
+        pull a single array to host (the verdict scalar is read only at
+        the bounded-dispatch retire point, where the unsupervised path
+        already blocks)."""
+        sup = TrainingSupervisor()
+        mod, _ = _fit(supervisor=sup)
+        mod._supervisor = sup                 # as during a live fit
+        X, y = _toy()
+        it = mx.io.NDArrayIter(X, y, batch_size=8,
+                               label_name="softmax_label")
+        batch = next(iter(it))
+        real = mx.nd.NDArray.asnumpy
+        try:
+            def poisoned(self):
+                raise AssertionError("host pull on the supervised "
+                                     "dispatch path")
+            mx.nd.NDArray.asnumpy = poisoned
+            for _ in range(6):
+                mod.forward(batch, is_train=True)
+            mod._drain_inflight_flags()
+        finally:
+            mx.nd.NDArray.asnumpy = real
+            mod._supervisor = None
+        assert profiler.supervisor_counters()["steps"] >= 6
+
+    def test_stall_fault_restarts_and_completes_bit_exact(self, tmp_path):
+        """An injected readback stall (delay past the deadline) raises
+        the typed TrainingStalled; the supervisor restores the newest
+        committed boundary checkpoint, replays the exact data position,
+        and the final params bit-match the clean twin. The epoch-end
+        `mgr.wait` guarantees a committed checkpoint exists before the
+        epoch-2 stall — a stall with NO checkpoint legitimately
+        continues from in-memory state instead (no rewind to replay)."""
+        from mxnet_tpu.checkpoint import CheckpointManager
+        _, ref = _fit(supervisor=False, num_epoch=3)
+        faults.configure("train.stall:count=20:delay=400")
+        mgr = CheckpointManager(str(tmp_path))
+        sup = TrainingSupervisor(manager=mgr, step_deadline_s=0.2,
+                                 max_restarts=1)
+        _, params = _fit(supervisor=sup, manager=mgr, num_epoch=3,
+                         epoch_end_callback=lambda *a: mgr.wait(timeout=60))
+        assert sup.restarts == 1
+        c = profiler.supervisor_counters()
+        assert c["stalls"] >= 1 and c["restarts"] == 1
+        assert c["resumes"] >= 1              # the rewind really happened
+        for k in ref:
+            assert np.array_equal(ref[k], params[k]), k
+
+    def test_unretryable_crash_surfaces_without_restart(self):
+        faults.configure("train.step:count=4:raise=ValueError,boom")
+        sup = TrainingSupervisor(max_restarts=3)
+        with pytest.raises(ValueError):
+            _fit(supervisor=sup)
+        assert sup.restarts == 0              # ValueError is not transient
+
+
+# ---------------------------------------------------------------------------
+# exact data-position resume (ResumableIter capability)
+# ---------------------------------------------------------------------------
+class TestResumableIter:
+    def _schedules(self, it, epochs):
+        out = []
+        for _ in range(epochs):
+            rows = [np.asarray(b.data[0].asnumpy())[:, 0].copy()
+                    for b in it]
+            out.append(np.concatenate(rows))
+            it.reset()
+        return out
+
+    def test_is_resumable_helper(self):
+        X, y = _toy()
+        assert mx.io.is_resumable(mx.io.NDArrayIter(X, y, batch_size=8))
+        assert not mx.io.is_resumable(object())
+
+    def test_restored_iter_replays_exact_shuffle_chain(self):
+        """Capture at an epoch boundary, restore into a DIFFERENTLY
+        seeded fresh iterator: every later epoch's schedule must match
+        the original bit-for-bit (permutation AND the RNG chain that
+        shuffles all future epochs)."""
+        X, y = _toy()
+        np.random.seed(11)
+        a = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True)
+        for b in a:                           # consume epoch 0
+            pass
+        state = a.iter_checkpoint()
+        a.reset()
+        want = self._schedules(a, epochs=3)   # epochs 1-3
+
+        np.random.seed(999)                   # a different world
+        b = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True)
+        b.iter_restore(state)
+        b.reset()                             # the replayed pending reset
+        got = self._schedules(b, epochs=3)
+        for w, g in zip(want, got):
+            assert np.array_equal(w, g)
+
+    def test_restore_rejects_changed_dataset(self):
+        X, y = _toy()
+        state = mx.io.NDArrayIter(X, y, batch_size=8).iter_checkpoint()
+        other = mx.io.NDArrayIter(X[:32], y[:32], batch_size=8)
+        with pytest.raises(MXNetError, match="dataset changed"):
+            other.iter_restore(state)
+
+    def test_device_prefetch_forwards_capability(self):
+        from mxnet_tpu.io_device import DevicePrefetchIter
+        X, y = _toy()
+        np.random.seed(3)
+        base = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True)
+        it = DevicePrefetchIter(base)
+        assert mx.io.is_resumable(it)
+        for _ in it:                          # a full epoch: boundary
+            pass
+        state = it.iter_checkpoint()
+        assert state["cursor"] >= len(X)      # consumed position
+        it.iter_restore(state)
+        it.reset()
+        assert [np.asarray(b.data[0].asnumpy()).shape for b in it] \
+            == [(8, 6)] * 8
+
+    def test_device_prefetch_rejects_mid_flight_capture(self):
+        from mxnet_tpu.io_device import DevicePrefetchIter
+        X, y = _toy()
+        it = DevicePrefetchIter(mx.io.NDArrayIter(X, y, batch_size=8))
+        it.next()                             # stager alive mid-epoch
+        with pytest.raises(MXNetError, match="epoch boundary"):
+            it.iter_checkpoint()
+        it._shutdown()
+
+
+# ---------------------------------------------------------------------------
+# repeated-preemption churn (subprocess SIGKILL cycles, shared driver)
+# ---------------------------------------------------------------------------
+class TestPreemptionChurn:
+    def _child(self, ckpt, out, kill_at=None, keep_last=2, timeout=240):
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import train_chaos_smoke as tc
+        finally:
+            sys.path.pop(0)
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["MXNET_CHECKPOINT_KEEP_LAST"] = str(keep_last)
+        if kill_at is not None:
+            env["MXNET_TPU_FAULT_SPEC"] = \
+                "train.step:count=%d:kill=SIGKILL" % kill_at
+        else:
+            env.pop("MXNET_TPU_FAULT_SPEC", None)
+        return subprocess.run(
+            tc.child_argv(ckpt=ckpt, out=out, epochs=4, rows=64, batch=8,
+                          seed=7),
+            env=env, cwd=_REPO, timeout=timeout,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+    def test_kill_resume_churn_keeps_invariants_and_bit_parity(self,
+                                                               tmp_path):
+        """Three SIGKILL/resume cycles inside one logical fit: every
+        relaunch resumes from a retained epoch-boundary checkpoint
+        (keep_last_n=2 retention running the whole time), stale staging
+        dirs from killed writers are swept, and the final params
+        bit-match the uninterrupted twin."""
+        twin_out = str(tmp_path / "twin.npz")
+        p = self._child(str(tmp_path / "ckpt_twin"), twin_out)
+        assert p.returncode == 0, p.stderr.decode()[-2000:]
+
+        ckpt = str(tmp_path / "ckpt_vic")
+        out = str(tmp_path / "vic.npz")
+        # the first kill must land AFTER the epoch-1 boundary (dispatch
+        # 16), whose mgr.wait deterministically flushes the async
+        # epoch-0 commit — an earlier kill races the writer and can
+        # leave nothing to resume from; later attempts resume at a
+        # later epoch and dispatch fewer steps, so their kill points
+        # must fit the worst-case remaining window (8 steps)
+        for kill_at in (17, 7, 3):
+            p = self._child(ckpt, out, kill_at=kill_at)
+            assert p.returncode == -signal.SIGKILL, \
+                "victim survived kill@%d: rc=%s" % (kill_at, p.returncode)
+        p = self._child(ckpt, out)            # the surviving attempt
+        assert p.returncode == 0, p.stderr.decode()[-2000:]
+
+        # bit parity with the uninterrupted twin
+        want, got = np.load(twin_out), np.load(out)
+        assert set(want.files) == set(got.files)
+        for k in want.files:
+            assert np.array_equal(want[k], got[k]), k
+        with open(out + ".json") as f:
+            meta = json.load(f)
+        assert meta["supervisor"].get("resumes", 0) >= 1
+
+        # retention invariants after the churn
+        from mxnet_tpu.checkpoint import layout
+        names = sorted(os.listdir(ckpt))
+        stale = [n for n in names if n.startswith(".tmp-")]
+        assert not stale, "stale staging dirs survived churn: %s" % stale
+        ckpts = layout.list_checkpoints(ckpt)
+        assert len(ckpts) <= 2 + 1            # keep_last_n plus boundary pin
+        boundary = [s for s, path in ckpts
+                    if not layout.read_meta(path).get("mid_epoch")]
+        assert boundary, "no epoch-boundary checkpoint retained"
+        assert max(boundary) == max(s for s, _ in ckpts), \
+            "newest retained checkpoint is not an epoch boundary"

@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""CI train-chaos smoke (`ci/run.py train_chaos_smoke` stage, ISSUE 15).
+
+Fast, non-slow gate over the training supervisor — the headline
+training-failure scenarios plus the zero-overhead contract:
+
+  * SIGKILL-exact resume: a supervised fit subprocess is SIGKILLed
+    mid-epoch by an injected `train.step:kill=SIGKILL` fault; relaunching
+    the same command auto-resumes from the newest committed checkpoint
+    (exact data position: cursor + shuffle-RNG chain) and the final
+    params are BIT-identical to an uninterrupted twin;
+  * NaN containment: an injected `train.nan` fault poisons one step's
+    loss scale — the step is skipped in-graph (params/opt_state/aux
+    carried), the run finishes finite, and K consecutive poisoned steps
+    raise the typed NumericDivergence;
+  * zero-overhead: with supervision off the fused step takes no scale
+    arg and returns no verdict, dispatch reads NO env vars (get_env
+    poisoned), no supervisor heartbeat exists, and every `train.*` /
+    `compile.cache_read` fault hook is a no-op behind one cached flag.
+
+The `--child` mode is the one supervised-fit driver shared by this
+smoke, bench.py's train_chaos phase, and test_supervisor.py's subprocess
+tests — gate and bench can never measure different code.
+
+Prints one JSON summary line; non-zero exit on any violated contract.
+"""
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+# ---------------------------------------------------------------------------
+# child: one deterministic supervised fit
+# ---------------------------------------------------------------------------
+
+def child_argv(python=None, **kw):
+    """argv for one child run — the shared vocabulary of every caller."""
+    argv = [python or sys.executable, os.path.abspath(__file__), "--child"]
+    for key, val in kw.items():
+        flag = "--" + key.replace("_", "-")
+        if isinstance(val, bool):
+            if val:
+                argv.append(flag)
+        elif val is not None:
+            argv += [flag, str(val)]
+    return argv
+
+
+def _child(args):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.devices > 1:
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        flags.append("--xla_force_host_platform_device_count=%d"
+                     % args.devices)
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    if args.zero:
+        os.environ["MXNET_TPU_ZERO"] = "1"
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.resilience import TrainingSupervisor
+
+    rng = np.random.RandomState(0)  # the DATA is seed-independent
+    X = rng.normal(0, 1, (args.rows, 6)).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="tc_fc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="tc_fc1")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mx.random.seed(args.seed)
+    np.random.seed(args.seed)
+    it = mx.io.NDArrayIter(X, y, batch_size=args.batch, shuffle=True)
+    mod = mx.mod.Module(sym, context=[mx.tpu(i)
+                                      for i in range(args.devices)])
+    mgr = CheckpointManager(args.ckpt, save_period=args.save_period)
+    sup = TrainingSupervisor(manager=mgr)
+    opt_params = {"learning_rate": 0.05, "momentum": 0.9}
+    if args.bf16:
+        opt_params["multi_precision"] = True
+    # flush the async writer at each boundary: these toy epochs run in
+    # milliseconds, and the SIGKILL gate needs a committed checkpoint to
+    # prove RESUME (a kill that outraces every commit correctly retrains
+    # from scratch — bit-exact too, but not the scenario under test)
+    mod.fit(it, num_epoch=args.epochs, kvstore="tpu_sync", optimizer="sgd",
+            optimizer_params=opt_params, initializer=mx.init.Xavier(),
+            epoch_end_callback=lambda *a: mgr.wait(timeout=120),
+            supervisor=sup)
+    arg_params, _ = mod.get_params()
+    np.savez(args.out, **{k: v.asnumpy() for k, v in arg_params.items()})
+    with open(args.out + ".json", "w") as f:
+        json.dump({"supervisor": profiler.supervisor_counters(),
+                   "loss_scale": sup.loss_scale,
+                   "zero": bool(getattr(mod._fused_step, "zero", False)),
+                   "bf16": mod._fused_step.compute_dtype is not None},
+                  f)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def _run(argv, env_extra=None, timeout=300):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(env_extra or {})
+    p = subprocess.run(argv, env=env, cwd=ROOT, timeout=timeout,
+                       stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    return p
+
+
+def sigkill_resume_variant(tag, twin_kw=None, resume_kw=None):
+    """One crash-exact-resume gate: uninterrupted twin vs a SIGKILLed
+    (mid third epoch, two boundary checkpoints committed) and relaunched
+    victim — final params must match bit-for-bit. `resume_kw` overrides
+    the relaunch (the elastic variant resumes under a DIFFERENT device
+    count over the saved ZeRO layout)."""
+    import numpy as np
+    base = tempfile.mkdtemp(prefix="train_chaos_")
+    try:
+        twin_out = os.path.join(base, "twin.npz")
+        vic_out = os.path.join(base, "victim.npz")
+        common = dict(epochs=4, rows=64, batch=8, seed=7, **(twin_kw or {}))
+        t0 = time.monotonic()
+        p = _run(child_argv(ckpt=os.path.join(base, "ckpt_twin"),
+                            out=twin_out, **common))
+        clean_s = time.monotonic() - t0
+        assert p.returncode == 0, p.stderr.decode()[-2000:]
+        # victim: SIGKILL mid epoch 2 (8 steps/epoch — step 21 is inside
+        # the third epoch, after two boundary checkpoints committed)
+        vic_ckpt = os.path.join(base, "ckpt_victim")
+        p = _run(child_argv(ckpt=vic_ckpt, out=vic_out, **common),
+                 env_extra={"MXNET_TPU_FAULT_SPEC":
+                            "train.step:count=21:kill=SIGKILL"})
+        assert p.returncode == -signal.SIGKILL, \
+            "[%s] victim survived the SIGKILL (rc=%s)" % (tag, p.returncode)
+        assert not os.path.exists(vic_out), \
+            "[%s] killed run wrote output" % tag
+        t1 = time.monotonic()
+        p = _run(child_argv(ckpt=vic_ckpt, out=vic_out,
+                            **{**common, **(resume_kw or {})}))
+        resume_s = time.monotonic() - t1
+        assert p.returncode == 0, p.stderr.decode()[-2000:]
+        want, got = np.load(twin_out), np.load(vic_out)
+        assert set(want.files) == set(got.files)
+        for k in want.files:
+            assert np.array_equal(want[k], got[k]), \
+                "[%s] param %s not bit-identical after SIGKILL resume" \
+                % (tag, k)
+        with open(vic_out + ".json") as f:
+            meta = json.load(f)
+        assert meta["supervisor"].get("resumes", 0) >= 1, \
+            "[%s] resumed run never restored supervisor state: %s" \
+            % (tag, meta)
+        # the variant must have exercised the path it names
+        for key in ("bf16", "zero"):
+            if common.get(key) or (resume_kw or {}).get(key):
+                assert meta[key], "[%s] %s path not engaged: %s" \
+                    % (tag, key, meta)
+        return {"bit_identical": True, "resumed_from_checkpoint": True,
+                "clean_fit_s": round(clean_s, 2),
+                "resume_fit_s": round(resume_s, 2)}
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+# the acceptance matrix (ISSUE 15): fused fp32 and bf16-master, dp=1 and
+# a dp>1 dryrun; the elastic ZeRO path has its own scenario below (the
+# step math is only ~1-ulp-equal ACROSS device counts, so its baseline
+# is a planned elastic continuation, not a fixed-dp twin)
+SIGKILL_VARIANTS = {
+    "fp32": {},
+    "bf16": {"twin_kw": {"bf16": True}},
+    "dp2": {"twin_kw": {"devices": 2}},
+}
+
+
+def scenario_sigkill_resume():
+    out = {}
+    for tag, kw in SIGKILL_VARIANTS.items():
+        out[tag] = sigkill_resume_variant(tag, **kw)
+    out["elastic_zero"] = elastic_zero_variant()
+    return {"sigkill_resume": out}
+
+
+def elastic_zero_variant():
+    """Elastic restart over the saved ZeRO layout (the PR-7 cross-count
+    restore, finally driven end to end): a dp=2 run is SIGKILLed, then
+    resumed under dp=4. Cross-count gradient reductions differ by ~1 ulp,
+    so the bit-parity baseline is a PLANNED elastic continuation — a
+    clean dp=2 run to the same epoch boundary, continued at dp=4 — which
+    sees the identical params, data positions, and dp=4 step math. With
+    ``save_period=2`` and the kill mid epoch 3, exactly the epoch-1
+    boundary checkpoint is committed on both sides: the resume point is
+    deterministic, not a race against the async writer."""
+    import numpy as np
+    base = tempfile.mkdtemp(prefix="train_chaos_el_")
+    try:
+        twin_out = os.path.join(base, "twin.npz")
+        vic_out = os.path.join(base, "victim.npz")
+        common = dict(rows=64, batch=8, seed=7, zero=True, save_period=2)
+        # twin: planned world change — dp=2 for epochs 0-1, a clean stop
+        # at the boundary, then a dp=4 continuation for epochs 2-3
+        twin_ckpt = os.path.join(base, "ckpt_twin")
+        p = _run(child_argv(ckpt=twin_ckpt, out=twin_out, epochs=2,
+                            devices=2, **common))
+        assert p.returncode == 0, p.stderr.decode()[-2000:]
+        p = _run(child_argv(ckpt=twin_ckpt, out=twin_out, epochs=4,
+                            devices=4, **common))
+        assert p.returncode == 0, p.stderr.decode()[-2000:]
+        # victim: same schedule, except the world change is a SIGKILL mid
+        # epoch 3 (count=29; epoch-1 is the one committed boundary) and
+        # the dp=4 resume replays epochs 2-3 from the exact position
+        vic_ckpt = os.path.join(base, "ckpt_victim")
+        p = _run(child_argv(ckpt=vic_ckpt, out=vic_out, epochs=4,
+                            devices=2, **common),
+                 env_extra={"MXNET_TPU_FAULT_SPEC":
+                            "train.step:count=29:kill=SIGKILL"})
+        assert p.returncode == -signal.SIGKILL, \
+            "[elastic] victim survived the SIGKILL (rc=%s)" % p.returncode
+        t0 = time.monotonic()
+        p = _run(child_argv(ckpt=vic_ckpt, out=vic_out, epochs=4,
+                            devices=4, **common))
+        resume_s = time.monotonic() - t0
+        assert p.returncode == 0, p.stderr.decode()[-2000:]
+        want, got = np.load(twin_out), np.load(vic_out)
+        assert set(want.files) == set(got.files)
+        for k in want.files:
+            assert np.array_equal(want[k], got[k]), \
+                "[elastic] param %s not bit-identical after dp=2 -> dp=4 " \
+                "resume" % k
+        with open(vic_out + ".json") as f:
+            meta = json.load(f)
+        assert meta["supervisor"].get("resumes", 0) >= 1, \
+            "[elastic] resumed run never restored state: %s" % meta
+        assert meta["zero"], "[elastic] ZeRO path not engaged: %s" % meta
+        return {"bit_identical": True, "resumed_from_checkpoint": True,
+                "dp_change": "2->4", "resume_fit_s": round(resume_s, 2)}
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def scenario_nan_containment():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.resilience import (faults, TrainingSupervisor,
+                                      NumericDivergence)
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (64, 6)).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="nc_fc"), name="softmax")
+
+    def fit(sup):
+        mx.random.seed(7)
+        np.random.seed(7)
+        it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True)
+        mod = mx.mod.Module(sym, context=[mx.tpu(0)])
+        mod.fit(it, num_epoch=2, kvstore="tpu_sync", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+                initializer=mx.init.Xavier(), supervisor=sup)
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    profiler.supervisor_counters(reset=True)
+    faults.configure("train.nan:count=3:raise=FaultInjected")
+    sup = TrainingSupervisor()
+    params = fit(sup)
+    faults.reset()
+    sc = profiler.supervisor_counters()
+    assert sup.bad_steps == 1, "poisoned step not skipped: %s" % sc
+    assert sc["bad_steps"] == 1 and sc["steps"] == 16, sc
+    assert all(np.isfinite(v).all() for v in params.values()), \
+        "NaN leaked into params"
+    # K consecutive poisoned steps surface the typed divergence
+    faults.configure("train.nan:after=1:raise=FaultInjected")
+    diverged = False
+    try:
+        fit(TrainingSupervisor(bad_steps_limit=3))
+    except NumericDivergence:
+        diverged = True
+    faults.reset()
+    assert diverged, "NumericDivergence never raised"
+    return {"nan_containment": {
+        "skipped": 1, "params_finite": True, "divergence_typed": True,
+        "scale_backoffs": sc.get("scale_backoffs", 0)}}
+
+
+def scenario_zero_overhead():
+    import numpy as np
+    import threading
+    import mxnet_tpu as mx
+    from mxnet_tpu import base as mx_base
+    from mxnet_tpu.resilience import faults
+
+    # 1) every train/compile fault hook is a no-op behind the cached flag
+    faults.reset()
+    assert not faults.enabled()
+    orig = faults._fire
+    try:
+        def boom(*a, **k):
+            raise AssertionError("fault registry touched while disabled")
+        faults._fire = boom
+        faults.fault_point("train.step", step=0)
+        faults.fault_point("train.nan", step=0)
+        faults.fault_point("train.stall", step=0)
+        faults.fault_point("train.restore", attempt=1)
+        faults.fault_point("compile.cache_read", builder="x")
+    finally:
+        faults._fire = orig
+
+    # 2) unsupervised fit: no supervisor thread/heartbeat, plain 4-output
+    #    step, and NO env reads on the dispatch path
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (32, 6)).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="zo_fc"), name="softmax")
+    mx.random.seed(7)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(sym, context=[mx.tpu(0)])
+    mod.fit(it, num_epoch=1, kvstore="tpu_sync", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            initializer=mx.init.Xavier(), supervisor=False)
+    assert mod._supervisor is None
+    assert mod._fused_step is not None and not mod._fused_step.supervise
+    names = {t.name for t in threading.enumerate()}
+    assert "mx-train-supervisor" not in names
+    # poisoned get_env across warmed dispatches: supervision off means
+    # zero per-step env reads (the PR-9 contract extended to training)
+    it.reset()
+    batch = next(iter(it))
+    real = mx_base.get_env
+    try:
+        def poisoned(*a, **k):
+            raise AssertionError("env read on the dispatch path: %r" % (a,))
+        mx_base.get_env = poisoned
+        for _ in range(4):
+            mod.forward(batch, is_train=True)
+    finally:
+        mx_base.get_env = real
+    return {"zero_overhead": {"fault_hooks_noop": True,
+                              "no_supervisor_thread": True,
+                              "no_dispatch_env_reads": True}}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--ckpt")
+    ap.add_argument("--out")
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--rows", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--devices", type=int, default=1)
+    ap.add_argument("--save-period", type=int, default=None)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--zero", action="store_true")
+    args = ap.parse_args()
+    if args.child:
+        return _child(args)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    summary = {}
+    summary.update(scenario_zero_overhead())
+    summary.update(scenario_nan_containment())
+    summary.update(scenario_sigkill_resume())
+    print(json.dumps(summary), flush=True)
+    print("train_chaos_smoke OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
