@@ -38,7 +38,7 @@ PHASE_BUDGET_S = {               # per-phase child timeouts (first-compile heavy
     "jax_baseline": 700, "flash": 700, "io_train": 600,
     "infer_int8": 600, "train_big_batch": 900, "flash_parity": 500,
     "cost": 600, "serving": 600, "serving_sla": 300,
-    "frontdoor": 300, "fleet": 300, "fault_recovery": 300,
+    "frontdoor": 300, "fleet": 300, "decode": 300, "fault_recovery": 300,
     "compile_cache": 300, "train_chaos": 300,
 }
 TOTAL_DEADLINE_S = int(os.environ.get("BENCH_DEADLINE_S", "3300"))
@@ -305,8 +305,8 @@ def main():
     # 2) measurement phases, each in its own budgeted child
     phases = ["infer", "train_fp32", "train_bf16", "jax_baseline", "flash",
               "io_train", "infer_int8", "train_big_batch", "flash_parity",
-              "cost", "serving", "frontdoor", "fleet", "fault_recovery",
-              "compile_cache", "train_chaos"]
+              "cost", "serving", "frontdoor", "fleet", "decode",
+              "fault_recovery", "compile_cache", "train_chaos"]
     # phases that measure nothing useful on the CPU fallback (outage
     # removals — unlike explicit_skips, the bank may still supply them)
     cpu_useless = {"train_bf16", "train_big_batch", "flash_parity"}
@@ -425,7 +425,7 @@ def main():
     for phase in ("train_fp32", "train_bf16", "jax_baseline", "flash",
                   "io_train", "infer_int8", "train_big_batch",
                   "flash_parity", "cost", "serving", "frontdoor",
-                  "fleet", "fault_recovery", "compile_cache",
+                  "fleet", "decode", "fault_recovery", "compile_cache",
                   "train_chaos"):
         extra.update({k: v for k, v in results.get(phase, {}).items()
                       if not k.startswith("_")})
@@ -904,9 +904,10 @@ def _phase_cost():
             "data": jax.ShapeDtypeStruct((batch, 3, 224, 224), jnp.float32)}
         abstract_label = {
             "softmax_label": jax.ShapeDtypeStruct((batch,), jnp.float32)}
-        lowered = step._step.lower(step.params, step.opt_state, step.aux,
-                                   abstract_data, abstract_label,
-                                   jax.random.PRNGKey(0), np.float32(0.05))
+        lowered = step._step.lowered(step.params, step.opt_state, step.aux,
+                                     abstract_data, abstract_label,
+                                     jax.random.PRNGKey(0),
+                                     np.float32(0.05))
         gflops, mbytes = _analyze(lowered)
         out["step%s_gflops" % tag] = gflops
         out["step%s_bytes_mb" % tag] = mbytes
@@ -1886,6 +1887,99 @@ def _phase_fleet():
     return out
 
 
+def _phase_decode():
+    """Stateful decode serving (ISSUE 18): the numbers behind the
+    continuous-batching claim. One paged-KV DecodeEngine runs the same
+    varied-length trace twice: CONTINUOUS (all sequences submitted
+    up-front; iteration-level admit/retire keeps the batch full) vs
+    STATIC emulation (groups of batch_size gated to completion — slots
+    idle while the group straggler finishes). Reports aggregate
+    `decode_tokens_per_sec` for both, their goodput ratio, the
+    inter-token and time-to-first-token p50/p99 from the engine's
+    always-on latency histograms, the streamed tokens/s for the same
+    trace ACROSS the TCP wire (stok frames, safe codec), and the
+    program-family size (must stay len(buckets) prefill + 1 step: the
+    steady-state loop never recompiles)."""
+    import numpy as np
+    import jax
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import (ModelServer, ServingFrontDoor,
+                                   ServingClient, DecodeEngine,
+                                   tiny_lm_params)
+    platform = jax.devices()[0].platform
+    vocab, dim = 256, 64
+    params = tiny_lm_params(vocab=vocab, dim=dim)
+    batch = 4
+    eng = DecodeEngine(params, name="bench", num_blocks=256,
+                       batch_size=batch, max_seq_len=128,
+                       prefill_buckets=(16,))
+    rng = np.random.RandomState(0)
+    n_seq = 32
+    prompts = [[int(t) for t in rng.randint(1, vocab, rng.randint(3, 13))]
+               for _ in range(n_seq)]
+    # widely varied generation lengths: the regime where iteration-level
+    # batching wins (a static batch idles its slots on the straggler)
+    budgets = [int(b) for b in rng.randint(4, 33, size=n_seq)]
+    wait_s = PHASE_BUDGET_S["decode"]
+    eng.generate(prompts[0], max_new_tokens=4)        # warm the family
+    profiler.latency_counters(reset=True, prefix="decode.bench.")
+
+    # --- continuous: everything submitted up-front --------------------
+    tic = time.monotonic()
+    streams = [eng.submit(p, max_new_tokens=b)
+               for p, b in zip(prompts, budgets)]
+    toks_cont = sum(len(s.result_wait(wait_s)) for s in streams)
+    wall_cont = time.monotonic() - tic
+    lat = profiler.latency_counters(prefix="decode.bench.")
+    intertok = lat.get("decode.bench.intertoken", {})
+    ttft = lat.get("decode.bench.ttft", {})
+
+    # --- static emulation: batch_size groups gated to completion ------
+    tic = time.monotonic()
+    toks_stat = 0
+    for i in range(0, n_seq, batch):
+        grp = [eng.submit(p, max_new_tokens=b)
+               for p, b in zip(prompts[i:i + batch], budgets[i:i + batch])]
+        toks_stat += sum(len(s.result_wait(wait_s)) for s in grp)
+    wall_stat = time.monotonic() - tic
+
+    # --- same trace streamed across the TCP wire ----------------------
+    srv = ModelServer()
+    srv.register_decode("bench", eng)
+    fd = ServingFrontDoor(srv, port=0).start()
+    cli = ServingClient("127.0.0.1", fd.port)
+    try:
+        tic = time.monotonic()
+        sts = [cli.decode_async(p, model="bench", max_new_tokens=b)
+               for p, b in zip(prompts, budgets)]
+        toks_wire = sum(len(s.result_wait(wait_s)) for s in sts)
+        wall_wire = time.monotonic() - tic
+    finally:
+        cli.close()
+        fd.drain(timeout=30.0)
+        srv.stop()
+
+    cont_tps = toks_cont / wall_cont if wall_cont else 0.0
+    stat_tps = toks_stat / wall_stat if wall_stat else 0.0
+    pf, st = eng.program_counts()
+    kv = eng.stats()["kv"]
+    return {
+        "decode_tokens_per_sec": round(cont_tps, 1),
+        "decode_static_tokens_per_sec": round(stat_tps, 1),
+        "decode_goodput_continuous_vs_static": round(
+            cont_tps / stat_tps, 2) if stat_tps else None,
+        "decode_intertoken_p50_ms": intertok.get("p50_ms"),
+        "decode_intertoken_p99_ms": intertok.get("p99_ms"),
+        "decode_ttft_p50_ms": ttft.get("p50_ms"),
+        "decode_ttft_p99_ms": ttft.get("p99_ms"),
+        "decode_stream_tokens_per_sec": round(
+            toks_wire / wall_wire, 1) if wall_wire else 0.0,
+        "decode_programs": "%d+%d" % (pf, st),
+        "decode_kv_blocks_high_water": kv["blocks_high_water"],
+        "decode_platform": platform,
+    }
+
+
 def _phase_fault_recovery():
     """Resilience under injected faults (ISSUE 9): the numbers that make
     the recovery claims measurable. (a) Replica kill mid-trace: one of
@@ -2195,6 +2289,7 @@ PHASES = {
     "serving_sla": _phase_serving_sla,
     "frontdoor": _phase_frontdoor,
     "fleet": _phase_fleet,
+    "decode": _phase_decode,
     "fault_recovery": _phase_fault_recovery,
     "compile_cache": _phase_compile_cache,
     "train_chaos": _phase_train_chaos,

@@ -122,6 +122,25 @@ def stage_frontdoor_smoke(_):
          os.path.join("mxnet_tpu", "serving")], cwd=ROOT)
 
 
+def stage_decode_smoke(_):
+    """Non-slow stateful-decode gate (ISSUE 18): two client OS processes
+    stream autoregressive decodes bit-identical to solo decode, a
+    connection killed mid-stream resumes by sequence id with zero token
+    loss/duplication, cache pressure sheds typed across the wire
+    (never-fit up front, mid-generation with partial output intact), the
+    program family stays at len(buckets) + 1 and the paged allocator
+    drains to zero live blocks — then tpulint over the serving
+    modules."""
+    rc = subprocess.call(
+        [sys.executable, os.path.join(ROOT, "tools", "decode_smoke.py")],
+        env=_env_cpu_mesh(1), cwd=ROOT)
+    if rc != 0:
+        return rc
+    return subprocess.call(
+        [sys.executable, "-m", "mxnet_tpu.analysis.lint",
+         os.path.join("mxnet_tpu", "serving")], cwd=ROOT)
+
+
 def stage_wire_fuzz_smoke(_):
     """Non-slow untrusted-wire gate (ISSUE 13): a fuzz corpus captured
     from REAL frontdoor+fleet traffic feeds >= 10k seeded mutations
@@ -236,6 +255,7 @@ STAGES = [
     ("multichip", stage_multichip),
     ("serving_smoke", stage_serving_smoke),
     ("frontdoor_smoke", stage_frontdoor_smoke),
+    ("decode_smoke", stage_decode_smoke),
     ("wire_fuzz_smoke", stage_wire_fuzz_smoke),
     ("fleet_smoke", stage_fleet_smoke),
     ("chaos_smoke", stage_chaos_smoke),

@@ -127,6 +127,8 @@ class CheckpointManager:
         self._handles = []       # outstanding SaveHandles
         self._active_tmp = set()  # staging dirs being written right now
         self._live_capture = None
+        self._preempt_notice_t = None   # monotonic time of the notice
+        self._preempt_deadline_s = None
         self._prev_handlers = {}
         self._atexit_registered = False
         # ONE retry/backoff policy for transient write-side I/O failures
@@ -594,6 +596,61 @@ class CheckpointManager:
         """`capture() -> save(**kwargs)` provider the preemption hook uses
         for its final flush (fit points this at the live module/epoch)."""
         self._live_capture = capture
+
+    def notify_preemption(self, deadline_s=None):
+        """Advance notice of preemption (cloud maintenance events arrive
+        MINUTES before the SIGTERM the hook reacts to): tighten the save
+        cadence to every epoch for the remaining lifetime and flush one
+        immediate live-capture snapshot so at most ``deadline_s`` of
+        work is exposed even if the final SIGTERM flush loses the race
+        with the preemptor.
+
+        ``deadline_s`` — seconds until the instance goes away (default
+        ``MXNET_TPU_PREEMPT_NOTICE_S``). Returns the SaveHandle of the
+        immediate snapshot, or None when no live capture is installed
+        (fit() installs one; before that there is nothing to save yet).
+        """
+        if deadline_s is None:
+            deadline_s = get_env("MXNET_TPU_PREEMPT_NOTICE_S", 60.0, float)
+        with self._lock:
+            self._preempt_notice_t = time.monotonic()
+            self._preempt_deadline_s = float(deadline_s)
+            cap = self._live_capture
+        self.logger.warning(
+            "preemption notice: instance going away in %.0fs — save "
+            "cadence tightened to every epoch", float(deadline_s))
+        if cap is None:
+            return None
+        kwargs = dict(cap())
+        kwargs.setdefault("mid_epoch", True)
+        kwargs.setdefault("preempted", True)
+        step = kwargs.get("step")
+        committed = layout.step_path(self.directory, step) \
+            if step is not None else None
+        if committed is not None and layout.is_committed(committed):
+            # this step already landed (boundary save or an earlier
+            # notice) — don't race a second write of the same step
+            return None
+        return self.save(**kwargs)
+
+    def preemption_notice(self):
+        """Seconds remaining on an active preemption notice (clamped at
+        0), or None when none was received."""
+        with self._lock:
+            if self._preempt_notice_t is None:
+                return None
+            elapsed = time.monotonic() - self._preempt_notice_t
+            return max(0.0, self._preempt_deadline_s - elapsed)
+
+    def effective_save_period(self):
+        """``save_period``, collapsed to 1 once a preemption notice has
+        arrived — the cadence consumer in ``Module.fit`` calls this, so
+        a doomed instance checkpoints every epoch no matter how sparse
+        the configured cadence is."""
+        with self._lock:
+            if self._preempt_notice_t is not None:
+                return 1
+        return self.save_period
 
     def install_preemption_hook(self, signals=None, capture=None):
         """Install signal handlers that flush one final checkpoint (the

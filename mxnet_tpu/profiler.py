@@ -25,6 +25,7 @@ __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
            "record_fault_injection", "fault_counters",
            "record_fleet_event", "fleet_counters",
            "record_supervisor_event", "supervisor_counters",
+           "record_decode_event", "decode_counters",
            "record_compile", "record_compile_hit", "record_compile_corrupt",
            "compile_counters",
            "ensure_compile_listener", "persistent_cache_hit_count",
@@ -483,6 +484,42 @@ def supervisor_counters(reset=False):
         if reset:
             _supervisor.clear()
             _supervisor.update(_SUPERVISOR_ZERO)
+    return out
+
+
+# ----------------------------------------------------------------------
+# stateful-decode counters (serving/decode.py, ISSUE 18): continuous-
+# batching decode engine accounting — always-on plain adds like the
+# supervisor family, so tests and the decode_smoke gate can assert
+# "tokens were produced", "the batch stayed full", "OOM was shed typed"
+# without a profiler session. Keys: submitted, served, shed, failed,
+# tokens (generated tokens emitted), prefills, steps (decode iterations),
+# slot_steps (steps x active rows — occupancy numerator), slot_capacity
+# (steps x batch slots — occupancy denominator), cache_oom (allocation
+# failures shed typed), stream_frames (token frames crossing the wire),
+# stream_resumes (mid-stream resume-by-id re-attaches).
+# ----------------------------------------------------------------------
+_DECODE_ZERO = {"submitted": 0, "served": 0, "shed": 0, "failed": 0,
+                "tokens": 0, "prefills": 0, "steps": 0, "slot_steps": 0,
+                "slot_capacity": 0, "cache_oom": 0, "stream_frames": 0,
+                "stream_resumes": 0}
+_decode = dict(_DECODE_ZERO)
+
+
+def record_decode_event(**deltas):
+    """Accumulate stateful-decode counters (free-form int deltas)."""
+    with _state["lock"]:
+        for k, v in deltas.items():
+            _decode[k] = _decode.get(k, 0) + v
+
+
+def decode_counters(reset=False):
+    """Snapshot (optionally reset) the stateful-decode counters."""
+    with _state["lock"]:
+        out = dict(_decode)
+        if reset:
+            _decode.clear()
+            _decode.update(_DECODE_ZERO)
     return out
 
 

@@ -39,6 +39,17 @@ previous-protocol pickle peers (``MXNET_SERVING_WIRE_COMPAT``);
 `serving/wire_fuzz.py` + ``ci/run.py wire_fuzz_smoke`` keep the decoder
 total over seeded mutational fuzz.
 
+Stateful decode (ISSUE 18): `DecodeEngine` (`serving/decode.py`) runs
+iteration-level continuous batching for autoregressive models over a
+`PagedKVCache` (`serving/kvcache.py` — block-allocated device-resident
+KV state, HBM bounded by LIVE tokens; allocation failure is the typed
+`CacheOverflow` shed). Exactly two programs per (model, prefill-bucket)
+family through the unified ProgramBuilder, AOT-warmed. The front door
+streams replies (``stok``/``sdone`` frames) and `ClientStream` resumes
+a broken stream by id with zero token loss or duplication; fleet
+dispatch pins sequences to the replica holding their cache and never
+hedges them.
+
     from mxnet_tpu.serving import InferenceEngine, ModelServer
 """
 from .program_cache import BucketedProgramCache, DEFAULT_BUCKETS, bucket_for
@@ -47,14 +58,18 @@ from .batcher import (DynamicBatcher, DeadlineExceeded, pad_to_bucket,
 from .engine import InferenceEngine
 from .server import ModelServer
 from .frontdoor import ServingFrontDoor
-from .client import ServingClient
+from .client import ServingClient, ClientStream
 from .pool import FleetPool, RemoteReplica
 from .worker import ReplicaWorker
 from .autoscaler import Autoscaler, LocalProcessLauncher
+from .kvcache import PagedKVCache, CacheOverflow, NULL_BLOCK
+from .decode import DecodeEngine, DecodeStream, tiny_lm_params
 
 __all__ = ["InferenceEngine", "ModelServer", "ServingFrontDoor",
-           "ServingClient", "FleetPool", "RemoteReplica",
+           "ServingClient", "ClientStream", "FleetPool", "RemoteReplica",
            "ReplicaWorker", "Autoscaler", "LocalProcessLauncher",
            "BucketedProgramCache",
            "DynamicBatcher", "DeadlineExceeded", "DEFAULT_BUCKETS",
-           "bucket_for", "pad_to_bucket", "default_max_batch"]
+           "bucket_for", "pad_to_bucket", "default_max_batch",
+           "DecodeEngine", "DecodeStream", "PagedKVCache",
+           "CacheOverflow", "NULL_BLOCK", "tiny_lm_params"]

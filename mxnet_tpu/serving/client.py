@@ -32,11 +32,22 @@ no matter how many resubmits happened. Every request carries a trace id
 (caller-supplied or generated) that comes back in the reply's timing
 breakdown (``wire_ms``/``queue_ms``/``device_ms``/``total_ms``).
 
+Streaming decode (PR 18) generalizes exactly-once to STREAMS: a
+``decode`` request answers with incremental ``("stok", rid, seq_no,
+token)`` frames and one terminal ``("sdone", rid, outcome, info)``.
+On a connection loss the resolve protocol answers ``("stream", hwm,
+terminal)`` for a stream id; the client re-attaches by ORIGINAL rid
+with ``("sresume", ..., {"rid", "have"})`` and the gateway replays
+exactly the frames past ``have`` — contiguous-seq_no dedup on this
+side makes the hand-off lose and duplicate nothing.
+
     client = ServingClient("127.0.0.1", port)
     out = client.predict({"data": batch}, model="resnet")
     fut = client.predict_async({"data": rows}, model="resnet",
                                deadline_ms=25, priority=1)
     rows_out = fut.result_wait(1.0)     # raises DeadlineExceeded on shed
+    for tok in client.decode_async([1, 2, 3], model="lm"):
+        ...                             # tokens as they generate
     client.health()                     # the autoscaling signal
     client.close()
 """
@@ -55,7 +66,7 @@ from . import wire as _wire
 from .batcher import DeadlineExceeded
 from .frontdoor import DEFAULT_PORT
 
-__all__ = ["ServingClient", "ClientRequest"]
+__all__ = ["ServingClient", "ClientRequest", "ClientStream"]
 
 
 class ClientRequest:
@@ -163,12 +174,104 @@ class ClientRequest:
                 "t_send": self._send_wall}
 
 
+class ClientStream(ClientRequest):
+    """Streaming decode handle: tokens arrive incrementally under
+    ``tokens`` (and via the optional ``on_token(stream, seq_no, token)``
+    callback, or by iterating the stream); the terminal outcome lands
+    through the same future surface as :class:`ClientRequest` —
+    ``result_wait`` returns the full token list, raises the typed
+    `DeadlineExceeded` on a shed (including a mid-generation one).
+
+    Exactly-once over streams: every token frame carries ``(rid,
+    seq_no)`` and the client only appends the next contiguous seq_no —
+    duplicates from a resume replay are dropped here, and the terminal
+    frame's token count is cross-checked so a gap becomes a TYPED
+    failure, never silent loss."""
+
+    __slots__ = ("tokens", "_max_new", "_on_token", "_tok_cv")
+
+    def __init__(self, rid, trace, model, prompt, deadline, priority,
+                 max_new_tokens=None, on_token=None):
+        flat = _np.asarray(prompt).reshape(-1)  # tpulint: allow-host-sync prompt tokens are host ints, normalized once at submission
+        super().__init__(rid, trace, model, None,
+                         [int(t) for t in flat], deadline, priority)
+        self.tokens = []
+        self._max_new = max_new_tokens
+        self._on_token = on_token
+        self._tok_cv = threading.Condition()
+
+    def _spec(self):
+        self._send_wall = time.time()
+        return {"model": self.model, "tokens": self._arrays,
+                "max_new_tokens": self._max_new,
+                "deadline_ms": self._remaining_ms(),
+                "priority": self._priority, "trace": self.trace,
+                "t_send": self._send_wall}
+
+    def _token(self, seq_no, token):
+        """One ``("stok", rid, seq_no, token)`` frame (reader thread).
+        seq_no is 1-based and appended only when contiguous."""
+        seq_no = int(seq_no)
+        cb = None
+        with self._tok_cv:
+            if seq_no != len(self.tokens) + 1:
+                return      # duplicate (resume replay overlap) — or a
+                #             gap, which the terminal count-check below
+                #             converts into a typed failure
+            self.tokens.append(int(token))
+            self._tok_cv.notify_all()
+            cb = self._on_token
+        if cb is not None:
+            try:
+                cb(self, seq_no, int(token))
+            except Exception:
+                pass  # tpulint: allow-swallowed-exception an observer must never poison the token delivery path (batcher._finish contract)
+
+    def _finish_served(self, info):
+        """Terminal ``served``: cross-check the server's token count
+        against what was delivered before declaring success."""
+        info = info if isinstance(info, dict) else {}
+        expect = info.get("tokens")
+        with self._tok_cv:
+            have = len(self.tokens)
+        if expect is not None and int(expect) != have:
+            self._resolve(error=MXNetError(
+                "stream %s terminal reports %s tokens but %d were "
+                "delivered — frames lost despite resume" %
+                (self.rid, expect, have)))
+        else:
+            self._resolve(result=list(self.tokens), timings=info)
+
+    def _resolve(self, result=None, error=None, timings=None):
+        super()._resolve(result=result, error=error, timings=timings)
+        with self._tok_cv:
+            self._tok_cv.notify_all()   # wake iterators on any terminal
+
+    def __iter__(self):
+        """Yield tokens as they arrive; ends at the terminal frame.
+        A shed/failed terminal ends iteration silently — call
+        ``result_wait(0)`` afterwards for the typed outcome."""
+        i = 0
+        while True:
+            with self._tok_cv:
+                while i >= len(self.tokens) and not self._event.is_set():
+                    self._tok_cv.wait(0.2)
+                if i < len(self.tokens):
+                    tok = self.tokens[i]
+                elif self._event.is_set():
+                    return
+                else:
+                    continue
+            yield tok
+            i += 1
+
+
 class _ClientConn:
     """One pooled connection: socket + reply-demultiplexing reader."""
 
     __slots__ = ("client", "sock", "conn_id", "seq", "send_lock",
                  "pending", "pending_lock", "alive", "reader", "stop_evt",
-                 "codec")
+                 "codec", "hb")
 
     def __init__(self, client, sock, conn_id, codec=_wire.CODEC_PICKLE):
         self.client = client
@@ -273,10 +376,27 @@ class _ClientConn:
     def _dispatch(self, msg):
         verb = msg[0]
         rid = msg[1] if len(msg) > 1 else None
+        if verb == "stok":
+            # incremental token frame: the stream STAYS registered (the
+            # terminal sdone pops it) — get, not pop
+            with self.pending_lock:
+                fut = self.pending.get(rid)
+            if fut is not None:
+                fut._token(msg[2], msg[3])
+            return
         fut = self.unregister(rid)
         if fut is None:
             return                  # late reply for an already-failed-over rid
-        if verb == "served":
+        if verb == "sdone":
+            outcome = msg[2]
+            info = msg[3] if len(msg) > 3 else None
+            if outcome == "served" and isinstance(fut, ClientStream):
+                fut._finish_served(info)
+            elif outcome == "shed":
+                fut._resolve(error=DeadlineExceeded(str(info)))
+            else:
+                fut._resolve(error=MXNetError(str(info)))
+        elif verb == "served":
             fut._resolve(result=msg[2], timings=msg[3])
         elif verb == "shed":
             fut._resolve(error=DeadlineExceeded(msg[2]))
@@ -363,7 +483,8 @@ class ServingClient:
         self._pool = []
         self._closed = False
         self.stats = {"submitted": 0, "resubmits": 0, "resolved_remote": 0,
-                      "recovered_unknown": 0, "failovers": 0}
+                      "recovered_unknown": 0, "failovers": 0,
+                      "stream_resumes": 0}
 
     # ------------------------------------------------------------------
     # connections
@@ -531,6 +652,73 @@ class ServingClient:
                                   priority=priority,
                                   trace_id=trace_id).result_wait(timeout)
 
+    # ------------------------------------------------------------------
+    # stateful decode (streaming)
+    # ------------------------------------------------------------------
+    def decode_async(self, tokens, model, max_new_tokens=None,
+                     deadline_ms=None, priority=0, trace_id=None,
+                     on_token=None):
+        """Submit one prompt for streaming decode; returns a
+        :class:`ClientStream`. Tokens arrive incrementally (iterate the
+        stream, watch ``stream.tokens``, or pass ``on_token``);
+        ``result_wait`` blocks for the terminal outcome and returns the
+        full generated token list. ``deadline_ms`` is the end-to-end
+        budget for the WHOLE generation — a sequence that runs past it
+        is shed mid-stream with the tokens so far retained."""
+        deadline = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1000.0
+        trace = trace_id or uuid.uuid4().hex[:12]
+        stream = ClientStream(None, trace, model, tokens, deadline,
+                              int(priority), max_new_tokens=max_new_tokens,
+                              on_token=on_token)
+        self.stats["submitted"] += 1
+        self._submit(stream)
+        return stream
+
+    def decode(self, tokens, model, max_new_tokens=None, deadline_ms=None,
+               priority=0, timeout=None, trace_id=None):
+        """Synchronous decode over the wire; returns the token list."""
+        return self.decode_async(tokens, model,
+                                 max_new_tokens=max_new_tokens,
+                                 deadline_ms=deadline_ms, priority=priority,
+                                 trace_id=trace_id).result_wait(timeout)
+
+    def _resume_stream(self, stream):
+        """Re-attach a live stream after a connection loss: register the
+        ORIGINAL rid on a fresh connection and ask the gateway to replay
+        everything past our high-water mark. The gateway's frame history
+        plus our contiguous-seq_no dedup make the hand-off exactly-once
+        in both directions."""
+        attempts = 0
+        while True:
+            if stream.done():
+                return
+            try:
+                conn = self._acquire()
+            except BaseException as e:
+                stream._resolve(error=e if isinstance(e, Exception)
+                                else MXNetError(str(e)))
+                if not isinstance(e, Exception):
+                    raise
+                return
+            conn.register(stream.rid, stream)
+            with stream._tok_cv:
+                have = len(stream.tokens)
+            try:
+                conn.send(("sresume", conn.next_rid(),
+                           {"rid": stream.rid, "have": have}))
+                self.stats["stream_resumes"] += 1
+                return
+            except OSError as e:
+                conn.unregister(stream.rid)
+                conn.break_transport()
+                attempts += 1
+                if attempts > self._resubmits:
+                    stream._resolve(error=MXNetError(
+                        "stream resume failed after %d attempts: %s"
+                        % (attempts, e)))
+                    return
+
     def _submit(self, req):
         """(Re)send one request. Failed SENDS resubmit on a fresh
         connection (never admitted); a fully-sent request is owned by
@@ -554,8 +742,9 @@ class ServingClient:
             rid = conn.next_rid()
             req.rid = rid
             conn.register(rid, req)
+            verb = "decode" if isinstance(req, ClientStream) else "predict"
             try:
-                conn.send(("predict", rid, req._spec()))
+                conn.send((verb, rid, req._spec()))
                 return
             except OSError as e:
                 # sendall raised: at most a partial frame reached the
@@ -655,7 +844,23 @@ class ServingClient:
             elif verb == "failed":
                 self.stats["resolved_remote"] += 1
                 fut._resolve(error=MXNetError(outcome[2]))
+            elif verb == "stream":
+                # the gateway still holds the stream (live or terminal):
+                # re-attach by original id — sresume replays every frame
+                # past our high-water mark, then the terminal
+                self.stats["resolved_remote"] += 1
+                self._resume_stream(fut)
             elif verb == "unknown":
+                if isinstance(fut, ClientStream) and fut.tokens:
+                    # a stream that already delivered tokens can NOT be
+                    # resubmitted (a fresh sequence would regenerate
+                    # from scratch — duplicate tokens); unknown here
+                    # means the gateway's stream TTL expired
+                    fut._resolve(error=MXNetError(
+                        "connection lost; stream unknown to the server "
+                        "with %d tokens already delivered (stream TTL "
+                        "expired?)" % len(fut.tokens)))
+                    continue
                 # proven never-admitted: the one case a fully-sent
                 # request may go out again (mirrors push-never-retries:
                 # push retries only when the server provably never saw

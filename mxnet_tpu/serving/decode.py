@@ -1,0 +1,673 @@
+"""Stateful decode serving: continuous batching over a paged KV cache.
+
+Everything the serving stack dispatched before this module was
+stateless fixed-shape inference — one request, one program call, one
+reply. Autoregressive decode breaks all three assumptions: a request is
+a *sequence* that holds device state (its KV cache) across many program
+calls, produces output incrementally, and finishes at a data-dependent
+time. This module is the decode side of the stack (ISSUE 18):
+
+- **Paged KV cache** (:mod:`.kvcache`): device pages shaped
+  ``(num_blocks, block_size, dim)``; a sequence owns a block table and
+  HBM scales with live tokens, not ``max_length x batch``. Block 0 is
+  the null block — fixed-shape programs route padding/inactive writes
+  there and real reads never touch it, so partial batches cannot alias.
+- **Iteration-level continuous batching**: the decode loop generalizes
+  the EDF batcher's formation pass. Between *every* step it retires
+  finished sequences (EOS / max-new-tokens / deadline) and admits
+  waiting ones (highest priority, then earliest deadline, then FIFO) —
+  the batch stays full while sequences join and leave, and the
+  deadline/shed contract is enforced per *token*, not per request
+  (a sequence can be shed typed mid-generation, keeping the tokens it
+  already produced).
+- **Two-program family** through :class:`~..compile.builder.ProgramBuilder`
+  (TPL108 seam): per model, one bucketed batch-1 *prefill* program per
+  prompt-length bucket (site ``decode.prefill.<name>``) and exactly one
+  fixed-shape batched *decode step* over the block table (site
+  ``decode.step.<name>``). ``warmup()`` AOT-compiles the whole family,
+  so ``program_count()`` is ``len(buckets)`` + 1 and stays there — the
+  steady-state decode loop never compiles.
+
+The built-in program bodies implement a deliberately tiny single-layer
+attention LM (embed → K/V into the paged cache → masked attention over
+the sequence's own blocks → greedy argmax). It is small enough for the
+CPU test mesh yet genuinely history-dependent and row-independent, so
+"continuous-batched decode is bit-identical to solo decode" is a real
+statement about the cache/batching machinery. Custom models plug in via
+``prefill_fn``/``step_fn`` with the same signatures.
+
+Cache-pressure behavior: an allocation the pool cannot cover raises the
+typed :class:`~.kvcache.CacheOverflow` (a ``DeadlineExceeded``
+subclass) — a prompt that can never fit is shed immediately; a sequence
+that outgrows the pool mid-generation is shed typed with its partial
+output intact; a prompt that merely has to wait stays queued until
+blocks free up or its deadline sheds it.
+
+Observability: always-on counters via ``profiler.record_decode_event``
+(tokens, steps, occupancy, cache OOMs) plus latency histograms
+``decode.<name>.step`` / ``decode.<name>.ttft`` /
+``decode.<name>.intertoken``; fault site ``decode.step`` fires before
+every device dispatch (prefill and step) for chaos tests.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+import numpy as _np
+
+from .. import profiler as _prof
+from ..base import get_env
+from ..resilience import faults as _faults
+from .batcher import DeadlineExceeded
+from .kvcache import PagedKVCache, CacheOverflow, NULL_BLOCK
+
+__all__ = ["DecodeEngine", "DecodeStream", "tiny_lm_params",
+           "DEFAULT_DECODE_BUCKETS"]
+
+#: Default prompt-length buckets for the prefill program family.
+DEFAULT_DECODE_BUCKETS = (16, 64)
+
+# Additive attention mask for padded positions. exp(-1e30 - max) is
+# exactly 0.0 in f32, so masked garbage can never perturb real rows —
+# the bit-parity guarantee rides on this.
+_MASKED = -1e30
+
+
+def tiny_lm_params(vocab=32, dim=16, seed=0):
+    """Deterministic parameters for the built-in single-layer LM.
+
+    Keys: ``emb (V, D)``, ``w_k (D, D)``, ``w_v (D, D)``,
+    ``w_out (D, V)`` — all float32 from a seeded RandomState, so every
+    process (tests, smoke clients, bench) derives the same model."""
+    rng = _np.random.RandomState(seed)
+    s = 1.0 / math.sqrt(dim)
+    return {
+        "emb": rng.standard_normal((vocab, dim)).astype(_np.float32),
+        "w_k": (rng.standard_normal((dim, dim)) * s).astype(_np.float32),
+        "w_v": (rng.standard_normal((dim, dim)) * s).astype(_np.float32),
+        "w_out": (rng.standard_normal((dim, vocab)) * s).astype(_np.float32),
+    }
+
+
+def _lm_prefill(params, k_pages, v_pages, tokens, length, table):
+    """Built-in prefill body (batch 1, bucketed prompt length).
+
+    ``tokens (L,) i32`` bucket-padded prompt; ``length () i32`` real
+    length; ``table (MB,) i32`` the sequence's block table padded with
+    the null block. Writes K/V for positions ``0..length-1`` (padding
+    rows scatter into the null block), attends the last real token over
+    ``pos < length``, returns ``(next_id, k_pages, v_pages)``."""
+    import jax
+    import jax.numpy as jnp
+    emb, w_k, w_v, w_out = (params["emb"], params["w_k"],
+                            params["w_v"], params["w_out"])
+    bs = k_pages.shape[1]
+    dim = emb.shape[1]
+    mb = table.shape[0]
+    x = emb[tokens]                                     # (L, D)
+    k = x @ w_k
+    v = x @ w_v
+    pos = jnp.arange(tokens.shape[0], dtype=jnp.int32)
+    blk = jnp.where(pos < length, table[pos // bs], NULL_BLOCK)
+    k_pages = k_pages.at[blk, pos % bs].set(k)
+    v_pages = v_pages.at[blk, pos % bs].set(v)
+    x_last = jnp.take(x, length - 1, axis=0)            # (D,)
+    ks = k_pages[table].reshape(mb * bs, dim)
+    vs = v_pages[table].reshape(mb * bs, dim)
+    tpos = jnp.arange(mb * bs, dtype=jnp.int32)
+    scores = (ks @ x_last) * (1.0 / math.sqrt(dim))
+    scores = jnp.where(tpos < length, scores, _MASKED)
+    ctx = jax.nn.softmax(scores) @ vs
+    next_id = jnp.argmax(ctx @ w_out).astype(jnp.int32)
+    return next_id, k_pages, v_pages
+
+
+def _lm_step(params, k_pages, v_pages, token_ids, positions, tables, active):
+    """Built-in decode-step body (fixed batch shape, one program total).
+
+    ``token_ids (B,) i32`` last emitted token per row; ``positions (B,)
+    i32`` write position of that token; ``tables (B, MB) i32`` block
+    tables (inactive rows all-null); ``active (B,) bool``. Inactive
+    rows scatter into the null block and their outputs are discarded on
+    host. Every per-row computation contracts only over that row's own
+    gathered blocks — rows cannot observe each other, which is what
+    makes batched decode bit-identical to solo decode."""
+    import jax
+    import jax.numpy as jnp
+    emb, w_k, w_v, w_out = (params["emb"], params["w_k"],
+                            params["w_v"], params["w_out"])
+    bs = k_pages.shape[1]
+    dim = emb.shape[1]
+    b, mb = tables.shape
+    x = emb[token_ids]                                  # (B, D)
+    k = x @ w_k
+    v = x @ w_v
+    blk = jnp.take_along_axis(tables, (positions // bs)[:, None], axis=1)
+    blk = jnp.where(active, blk[:, 0], NULL_BLOCK)
+    k_pages = k_pages.at[blk, positions % bs].set(k)
+    v_pages = v_pages.at[blk, positions % bs].set(v)
+    ks = k_pages[tables].reshape(b, mb * bs, dim)       # (B, T, D)
+    vs = v_pages[tables].reshape(b, mb * bs, dim)
+    tpos = jnp.arange(mb * bs, dtype=jnp.int32)[None, :]
+    scores = jnp.einsum("bd,btd->bt", x, ks) * (1.0 / math.sqrt(dim))
+    scores = jnp.where(tpos <= positions[:, None], scores, _MASKED)
+    ctx = jnp.einsum("bt,btd->bd", jax.nn.softmax(scores, axis=-1), vs)
+    next_ids = jnp.argmax(ctx @ w_out, axis=-1).astype(jnp.int32)
+    return next_ids, k_pages, v_pages
+
+
+class DecodeStream:
+    """Handle for one decode request: tokens appear incrementally, the
+    terminal outcome resolves exactly once.
+
+    ``tokens`` grows as the engine emits (generated token ``i`` has
+    stream ``seq_no i+1`` — the numbering the wire frames carry).
+    ``result_wait`` blocks for the terminal outcome and returns the full
+    token list, raising the typed error on shed/failure (partial tokens
+    stay readable on ``.tokens`` either way). Iterating the stream
+    yields tokens as they are produced. ``on_token(stream, seq_no,
+    token)`` / ``on_done(stream)`` callbacks run on the engine loop
+    thread — keep them cheap (the front door only enqueues a frame)."""
+
+    def __init__(self, rid, prompt, max_new_tokens, deadline, priority,
+                 trace=None, on_token=None, on_done=None):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.deadline = deadline        # absolute monotonic or None
+        self.priority = priority
+        self.trace = trace
+        self.tokens = []
+        self.error = None
+        self.outcome = None             # "served" | "shed" | "failed"
+        self._on_token = on_token
+        self._on_done = on_done
+        self._cond = threading.Condition()
+        self._done_evt = threading.Event()
+        self.submitted_t = time.monotonic()
+        self.first_token_t = None
+        self.last_token_t = None
+
+    def _emit(self, token):
+        with self._cond:
+            self.tokens.append(token)
+            seq_no = len(self.tokens)
+            self._cond.notify_all()
+        if self._on_token is not None:
+            self._on_token(self, seq_no, token)
+        return seq_no
+
+    def _resolve(self, error=None):
+        with self._cond:
+            if self._done_evt.is_set():
+                return False
+            self.error = error
+            self.outcome = ("served" if error is None else
+                            "shed" if isinstance(error, DeadlineExceeded)
+                            else "failed")
+            self._done_evt.set()
+            self._cond.notify_all()
+        if self._on_done is not None:
+            self._on_done(self)
+        return True
+
+    def done(self):
+        return self._done_evt.is_set()
+
+    def result_wait(self, timeout=None):
+        if not self._done_evt.wait(timeout):
+            raise TimeoutError("decode stream %s still generating" % self.rid)
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+    def __iter__(self):
+        i = 0
+        while True:
+            with self._cond:
+                while len(self.tokens) <= i and not self._done_evt.is_set():
+                    self._cond.wait(0.1)
+                fresh = self.tokens[i:]
+                finished = self._done_evt.is_set()
+                err = self.error
+            for tok in fresh:
+                yield tok
+            i += len(fresh)
+            if finished and i >= len(self.tokens):
+                if err is not None:
+                    raise err
+                return
+
+
+class _MISSING:  # sentinel: "kwarg not passed" (None is a valid value)
+    pass
+
+
+class DecodeEngine:
+    """Continuous-batching decode engine over a paged KV cache.
+
+    Parameters
+    ----------
+    params : dict of arrays
+        Model parameters (see :func:`tiny_lm_params` for the built-in
+        LM's keys; opaque pytree for custom ``prefill_fn``/``step_fn``).
+    eos_id : int or None
+        Token id that terminates a sequence (emitted, then retired).
+    block_size / num_blocks : int
+        KV pool geometry (``MXNET_SERVING_DECODE_BLOCK`` /
+        ``MXNET_SERVING_DECODE_BLOCKS``). Block 0 is reserved.
+    batch_size : int
+        Decode slots — THE fixed step shape (``MXNET_SERVING_DECODE_BATCH``).
+    max_seq_len : int
+        Hard cap on prompt + generated per sequence; fixes the block-
+        table width (``MXNET_SERVING_DECODE_MAX_SEQ``).
+    prefill_buckets : tuple of int
+        Prompt-length buckets (``MXNET_SERVING_DECODE_BUCKETS``,
+        comma-separated). One prefill program per bucket.
+    default_deadline_ms : float or None
+        Deadline applied when ``submit`` passes none
+        (``MXNET_SERVING_DECODE_DEADLINE_MS``; unset/0 = no deadline).
+
+    All env vars are read once here — never per step (zero-overhead
+    contract). ``warmup=True`` AOT-compiles the full program family at
+    construction so the loop never compiles.
+    """
+
+    def __init__(self, params, *, name="decode", eos_id=None,
+                 block_size=None, num_blocks=None, batch_size=None,
+                 max_seq_len=None, prefill_buckets=None,
+                 default_deadline_ms=_MISSING, default_max_new=None,
+                 prefill_fn=None, step_fn=None, warmup=True,
+                 autostart=True):
+        import jax
+        import jax.numpy as jnp
+        from ..compile.builder import ProgramBuilder
+        from .program_cache import _donate_supported
+
+        self.name = name
+        self.eos_id = eos_id
+        if block_size is None:
+            block_size = get_env("MXNET_SERVING_DECODE_BLOCK", 16, int)
+        if num_blocks is None:
+            num_blocks = get_env("MXNET_SERVING_DECODE_BLOCKS", 64, int)
+        if batch_size is None:
+            batch_size = get_env("MXNET_SERVING_DECODE_BATCH", 4, int)
+        if max_seq_len is None:
+            max_seq_len = get_env("MXNET_SERVING_DECODE_MAX_SEQ", 256, int)
+        if prefill_buckets is None:
+            raw = get_env("MXNET_SERVING_DECODE_BUCKETS",
+                          ",".join(str(b) for b in DEFAULT_DECODE_BUCKETS))
+            prefill_buckets = tuple(sorted(
+                int(t) for t in raw.split(",") if t.strip()))
+        if default_deadline_ms is _MISSING:
+            default_deadline_ms = get_env(
+                "MXNET_SERVING_DECODE_DEADLINE_MS", None, float)
+            if default_deadline_ms is not None and default_deadline_ms <= 0:
+                default_deadline_ms = None
+        if default_max_new is None:
+            default_max_new = get_env("MXNET_SERVING_DECODE_MAX_NEW", 32, int)
+        self.batch_size = int(batch_size)
+        self.max_seq_len = int(max_seq_len)
+        self.prefill_buckets = tuple(b for b in prefill_buckets
+                                     if b <= self.max_seq_len) or (
+                                         self.max_seq_len,)
+        self.default_deadline_ms = default_deadline_ms
+        self.default_max_new = int(default_max_new)
+
+        self._kv = PagedKVCache(num_blocks, block_size)
+        self._mb = self._kv.blocks_for(self.max_seq_len)  # table width
+        dim = int(params["emb"].shape[1]) if "emb" in params else int(
+            next(iter(params.values())).shape[-1])
+        self._params = jax.device_put(
+            {k: jnp.asarray(v) for k, v in params.items()})
+        self._k_pages = jnp.zeros((self._kv.num_blocks, self._kv.block_size,
+                                   dim), jnp.float32)
+        self._v_pages = jnp.zeros_like(self._k_pages)
+        # pages are consumed and replaced every call — donate them back
+        # to XLA where the backend supports it (not host CPU)
+        donate = (1, 2) if _donate_supported() else ()
+        self._prefill_b = ProgramBuilder(
+            prefill_fn or _lm_prefill, site="decode.prefill.%s" % name,
+            donate_argnums=donate)
+        self._step_b = ProgramBuilder(
+            step_fn or _lm_step, site="decode.step.%s" % name,
+            donate_argnums=donate)
+
+        self._cv = threading.Condition()
+        self._waiting = []              # DecodeStream, EDF-ordered at admit
+        self._slots = [None] * self.batch_size   # _Seq state per row
+        self._stop = False
+        self._rid_ctr = 0
+        self._counters = {"submitted": 0, "served": 0, "shed": 0,
+                          "failed": 0, "tokens": 0, "prefills": 0,
+                          "steps": 0, "cache_oom": 0}
+        self._lat_step = "decode.%s.step" % name
+        self._lat_ttft = "decode.%s.ttft" % name
+        self._lat_tok = "decode.%s.intertoken" % name
+
+        if warmup:
+            self.warmup()
+        self._thread = None
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------------
+    # program family
+    # ------------------------------------------------------------------
+    def warmup(self):
+        """AOT-compile the whole family: one prefill per bucket + the
+        decode step. After this, steady-state decode never compiles."""
+        import jax
+        import numpy as np
+        i32 = np.int32
+        sd = jax.ShapeDtypeStruct
+        pages = sd(self._k_pages.shape, self._k_pages.dtype)
+        for bucket in self.prefill_buckets:
+            self._prefill_b.aot_info(
+                self._params, pages, pages, sd((bucket,), i32),
+                sd((), i32), sd((self._mb,), i32), mode="aot")
+        b, mb = self.batch_size, self._mb
+        self._step_b.aot_info(
+            self._params, pages, pages, sd((b,), i32), sd((b,), i32),
+            sd((b, mb), i32), sd((b,), np.bool_), mode="aot")
+
+    def program_counts(self):
+        """(prefill_programs, step_programs) — the acceptance counters:
+        len(prefill_buckets) and exactly 1, flat while serving."""
+        return (self._prefill_b.program_count(), self._step_b.program_count())
+
+    def _bucket_for(self, n):
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return None
+
+    # ------------------------------------------------------------------
+    # submission API
+    # ------------------------------------------------------------------
+    def submit(self, tokens, max_new_tokens=None, deadline_ms=_MISSING,
+               priority=0, trace=None, on_token=None, on_done=None):
+        """Queue a prompt for decode; returns a :class:`DecodeStream`.
+
+        Raises ``ValueError`` synchronously (nothing counted) for
+        prompts the engine can never serve: empty, longer than the
+        largest prefill bucket, or leaving no room to generate."""
+        flat = _np.asarray(tokens).reshape(-1)  # tpulint: allow-host-sync prompt tokens are host ints, normalized once at submission
+        prompt = [int(t) for t in flat]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if self._bucket_for(len(prompt)) is None:
+            raise ValueError(
+                "prompt of %d tokens exceeds the largest prefill bucket "
+                "(%d)" % (len(prompt), self.prefill_buckets[-1]))
+        if max_new_tokens is None:
+            max_new_tokens = self.default_max_new
+        max_new_tokens = min(int(max_new_tokens),
+                             self.max_seq_len - len(prompt))
+        if max_new_tokens < 1:
+            raise ValueError("prompt of %d tokens leaves no room to "
+                             "generate (max_seq_len=%d)"
+                             % (len(prompt), self.max_seq_len))
+        if deadline_ms is _MISSING:
+            deadline_ms = self.default_deadline_ms
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("decode engine %s is stopped" % self.name)
+            self._rid_ctr += 1
+            stream = DecodeStream("%s-%d" % (self.name, self._rid_ctr),
+                                  prompt, max_new_tokens, deadline, priority,
+                                  trace=trace, on_token=on_token,
+                                  on_done=on_done)
+            stream._order = self._rid_ctr
+            self._counters["submitted"] += 1
+            self._waiting.append(stream)
+            self._cv.notify_all()
+        _prof.record_decode_event(submitted=1)
+        return stream
+
+    def generate(self, tokens, max_new_tokens=None, timeout=60.0, **kw):
+        """Blocking convenience: submit and wait for the full output."""
+        return self.submit(tokens, max_new_tokens, **kw).result_wait(timeout)
+
+    # ------------------------------------------------------------------
+    # loop
+    # ------------------------------------------------------------------
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="mx-decode-%s" % self.name, daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout=10.0):
+        """Stop the loop; unfinished work resolves failed (counted)."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        leftovers = []
+        with self._cv:
+            leftovers.extend(self._waiting)
+            self._waiting = []
+            for i, seq in enumerate(self._slots):
+                if seq is not None:
+                    leftovers.append(seq)
+                    self._slots[i] = None
+        for s in leftovers:
+            self._kv.free(s.rid)
+            self._finish(s, RuntimeError("decode engine stopped"))
+
+    def _finish(self, stream, error=None):
+        """Resolve a stream exactly once + count the outcome."""
+        if not stream._resolve(error):
+            return
+        key = stream.outcome
+        with self._cv:
+            self._counters[key] += 1
+            if isinstance(error, CacheOverflow):
+                self._counters["cache_oom"] += 1
+        _prof.record_decode_event(
+            **({key: 1, "cache_oom": 1} if isinstance(error, CacheOverflow)
+               else {key: 1}))
+
+    def _loop(self):
+        from ..resilience.watchdog import watchdog as _watchdog
+        hb = _watchdog().register("mx-decode-%s" % self.name,
+                                  thread=threading.current_thread())
+        try:
+            while True:
+                with self._cv:
+                    while (not self._stop and not self._waiting
+                           and not any(s is not None for s in self._slots)):
+                        hb.idle()
+                        self._cv.wait(0.05)
+                    if self._stop:
+                        return
+                    hb.beat()
+                    sheds, rejects, admitted = self._form_batch_locked()
+                for s in sheds:
+                    self._finish(s, s._shed_err)
+                for s in rejects:
+                    self._finish(s, s._shed_err)
+                for s in admitted:
+                    self._prefill_one(s)
+                self._decode_step()
+        finally:
+            hb.close()
+
+    def _form_batch_locked(self):
+        """The formation pass (EDF, generalizing the batcher): shed
+        expired waiters, reject never-fit prompts, admit into free slots
+        while their prompts fit the pool. Runs under ``_cv`` — host
+        bookkeeping only, no device calls (TPL104)."""
+        now = time.monotonic()
+        sheds, rejects = [], []
+        keep = []
+        for s in self._waiting:
+            if s.deadline is not None and now > s.deadline:
+                s._shed_err = DeadlineExceeded(
+                    "decode %s: deadline expired before admission" % s.rid)
+                sheds.append(s)
+            elif self._kv.blocks_for(len(s.prompt) + 1) \
+                    > self._kv.capacity_blocks:
+                s._shed_err = CacheOverflow(
+                    "decode %s: prompt of %d tokens can never fit a pool "
+                    "of %d blocks" % (s.rid, len(s.prompt),
+                                      self._kv.capacity_blocks))
+                rejects.append(s)
+            else:
+                keep.append(s)
+        # highest priority first, then earliest deadline, then arrival
+        keep.sort(key=lambda s: (-s.priority,
+                                 s.deadline if s.deadline is not None
+                                 else float("inf"), s._order))
+        admitted = []
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        still_waiting = []
+        for s in keep:
+            if free and self._kv.can_fit(len(s.prompt)):
+                self._kv.allocate(s.rid, len(s.prompt))
+                s._slot = free.pop(0)
+                self._slots[s._slot] = s
+                admitted.append(s)
+            else:
+                still_waiting.append(s)
+        self._waiting = still_waiting
+        return sheds, rejects, admitted
+
+    def _evict(self, stream, error):
+        """Drop an ACTIVE sequence: free its blocks, vacate its slot,
+        resolve the outcome."""
+        self._kv.free(stream.rid)
+        self._slots[stream._slot] = None
+        self._finish(stream, error)
+
+    def _prefill_one(self, stream):
+        """Run the bucketed prefill program for one admitted sequence
+        and emit its first token (device call — outside ``_cv``)."""
+        prompt = stream.prompt
+        bucket = self._bucket_for(len(prompt))
+        toks = _np.zeros((bucket,), _np.int32)
+        toks[:len(prompt)] = prompt
+        table = _np.zeros((self._mb,), _np.int32)
+        own = self._kv.table(stream.rid)
+        table[:len(own)] = own
+        _faults.fault_point("decode.step", model=self.name, kind="prefill",
+                            rid=stream.rid)
+        try:
+            next_id, self._k_pages, self._v_pages = self._prefill_b(
+                self._params, self._k_pages, self._v_pages, toks,
+                _np.int32(len(prompt)), table)
+            tok = int(_np.asarray(next_id))  # tpulint: allow-host-sync sampled token feeds the next step and the reply stream; decode cannot proceed without it
+        except Exception as e:
+            self._evict(stream, e if isinstance(e, DeadlineExceeded)
+                        else RuntimeError("decode prefill failed: %s" % e))
+            return
+        now = time.monotonic()
+        stream.first_token_t = stream.last_token_t = now
+        stream._cached = len(prompt)    # positions 0..len-1 hold K/V
+        _prof.record_latency(self._lat_ttft,
+                             int((now - stream.submitted_t) * 1e9))
+        with self._cv:
+            self._counters["prefills"] += 1
+            self._counters["tokens"] += 1
+        _prof.record_decode_event(prefills=1, tokens=1)
+        stream._emit(tok)
+        self._maybe_retire(stream, tok)
+
+    def _maybe_retire(self, stream, last_tok):
+        """Retire on EOS or token budget; returns True when retired."""
+        if ((self.eos_id is not None and last_tok == self.eos_id)
+                or len(stream.tokens) >= stream.max_new_tokens):
+            self._kv.free(stream.rid)
+            self._slots[stream._slot] = None
+            self._finish(stream, None)
+            return True
+        return False
+
+    def _decode_step(self):
+        """One continuous-batching iteration over the active slots:
+        per-token deadline enforcement, cache growth (typed shed on
+        overflow), one fixed-shape step program call, distribution."""
+        now = time.monotonic()
+        for seq in [s for s in self._slots if s is not None]:
+            if seq.deadline is not None and now > seq.deadline:
+                self._evict(seq, DeadlineExceeded(
+                    "decode %s: deadline exceeded after %d tokens"
+                    % (seq.rid, len(seq.tokens))))
+        for seq in [s for s in self._slots if s is not None]:
+            try:
+                # room for the token this step writes at position _cached
+                self._kv.extend(seq.rid, 1)
+            except CacheOverflow as e:
+                self._evict(seq, e)
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return
+        b, mb = self.batch_size, self._mb
+        token_ids = _np.zeros((b,), _np.int32)
+        positions = _np.zeros((b,), _np.int32)
+        tables = _np.zeros((b, mb), _np.int32)
+        mask = _np.zeros((b,), _np.bool_)
+        for seq in active:
+            i = seq._slot
+            token_ids[i] = seq.tokens[-1]
+            positions[i] = seq._cached
+            own = self._kv.table(seq.rid)
+            tables[i, :len(own)] = own
+            mask[i] = True
+        _faults.fault_point("decode.step", model=self.name, kind="step",
+                            batch=len(active))
+        t0 = time.monotonic()
+        try:
+            next_ids, self._k_pages, self._v_pages = self._step_b(
+                self._params, self._k_pages, self._v_pages, token_ids,
+                positions, tables, mask)
+            ids = _np.asarray(next_ids)  # tpulint: allow-host-sync sampled tokens feed the next step and the reply streams; decode cannot proceed without them
+        except Exception as e:
+            # step state is unknown after a failed dispatch: fail the
+            # whole active set (chaos tests drive this via decode.step)
+            err = e if isinstance(e, DeadlineExceeded) else RuntimeError(
+                "decode step failed: %s" % e)
+            for seq in active:
+                self._evict(seq, err)
+            return
+        now = time.monotonic()
+        step_ns = int((now - t0) * 1e9)
+        _prof.record_latency(self._lat_step, step_ns)
+        with self._cv:
+            self._counters["steps"] += 1
+            self._counters["tokens"] += len(active)
+        _prof.record_decode_event(steps=1, tokens=len(active),
+                                  slot_steps=len(active),
+                                  slot_capacity=self.batch_size)
+        for seq in active:
+            tok = int(ids[seq._slot])
+            seq._cached += 1
+            if seq.last_token_t is not None:
+                _prof.record_latency(
+                    self._lat_tok, int((now - seq.last_token_t) * 1e9))
+            seq.last_token_t = now
+            seq._emit(tok)
+            self._maybe_retire(seq, tok)
+
+    # ------------------------------------------------------------------
+    def stats(self):
+        """Counters + cache occupancy + program family sizes."""
+        with self._cv:
+            out = dict(self._counters)
+            out["waiting"] = len(self._waiting)
+            out["active"] = sum(1 for s in self._slots if s is not None)
+        out["kv"] = self._kv.stats()
+        pf, st = self.program_counts()
+        out["programs"] = {"prefill": pf, "step": st}
+        sites = _prof.compile_counters()["sites"]
+        out["compile"] = {
+            "prefill": sites.get("decode.prefill.%s" % self.name, {}),
+            "step": sites.get("decode.step.%s" % self.name, {})}
+        return out

@@ -45,6 +45,21 @@ length-prefixed pickle, extracted and shared):
   store for ``MXNET_SERVING_FRONTDOOR_ORPHAN_TTL_S``), ``("pending",)``
   (still in flight), or ``("unknown",)`` (never admitted — safe to
   resubmit).
+* streaming decode (ISSUE 18, stateful serving): ``("decode", rid,
+  spec)`` where ``spec`` carries ``model``, ``tokens`` (prompt ids),
+  ``max_new_tokens``, ``deadline_ms``/``priority``/``trace``/``t_send``
+  as for predict. Replies stream: ``("stok", rid, seq_no, token)`` per
+  generated token (seq_no 1-based, contiguous) and one terminal
+  ``("sdone", rid, outcome, info)`` — outcome ``served`` (info: trace +
+  token count), ``shed`` (typed deadline/cache-pressure shed, possibly
+  MID-generation), or ``failed``. Exactly-once generalizes to streams:
+  the gateway retains every frame of a live stream (and a finished
+  stream's history for the orphan TTL); ``resolve`` answers
+  ``("stream", high_water, terminal_or_None)`` for a stream id, and
+  ``("sresume", rid, {"rid": orig, "have": n})`` re-attaches the stream
+  to a new connection, replaying exactly the frames past ``n``. Decode
+  dispatch pins a sequence to one engine replica by request id (KV
+  state lives there) and is structurally outside the hedging path.
 
 Operational surface (the repo's contract for a subsystem):
 
@@ -145,6 +160,31 @@ class _Pending:
         self.rid = rid
 
 
+class _Stream:
+    """Gateway-side state of one decode stream (ISSUE 18): the frame
+    history IS the exactly-once story. Every token frame ever produced
+    for the stream is retained (in order — index ``i`` holds seq_no
+    ``i+1``) until the stream expires, so a reconnecting client can
+    resume from any high-water mark: resolve answers ``("stream", hwm,
+    terminal)`` and ``sresume`` replays exactly the suffix the client
+    lacks. ``conn`` is the CURRENT delivery target (None while
+    detached); the terminal reply parks here too — streams never use
+    the per-request orphan store."""
+
+    __slots__ = ("rid", "model", "conn", "trace", "frames", "terminal",
+                 "expiry", "engine_stream")
+
+    def __init__(self, rid, model, conn, trace):
+        self.rid = rid
+        self.model = model
+        self.conn = conn
+        self.trace = trace
+        self.frames = []        # ("stok", rid, seq_no, token), in order
+        self.terminal = None    # ("sdone", rid, outcome, info) once done
+        self.expiry = None      # monotonic TTL once terminal
+        self.engine_stream = None
+
+
 class ServingFrontDoor:
     """Host one ModelServer behind a TCP port for many client processes.
 
@@ -223,6 +263,7 @@ class ServingFrontDoor:
         self._pending = {}          # rid -> _Pending
         self._idle_cv = threading.Condition(self._lock)  # pending drained
         self._orphans = {}          # rid -> (expiry_monotonic, reply tuple)
+        self._streams = {}          # rid -> _Stream (decode, ISSUE 18)
         self._strikes = {}          # peer host -> [strikes, refuse_until]
         self._counters = {
             "connections": 0, "refused_evicted": 0, "evictions": 0,
@@ -231,7 +272,9 @@ class ServingFrontDoor:
             "orphaned": 0, "orphan_resolved": 0, "orphan_expired": 0,
             "control": 0, "auth_rejected": 0,
             "negotiated_safe": 0, "negotiated_pickle": 0,
-            "legacy_peers": 0, "hello_rejected": 0}
+            "legacy_peers": 0, "hello_rejected": 0,
+            "stream_frames": 0, "stream_resumes": 0,
+            "stream_resume_unknown": 0, "streams_expired": 0}
         self._prev_sigterm = None
 
     # ------------------------------------------------------------------
@@ -524,6 +567,12 @@ class ServingFrontDoor:
         with self._lock:
             conn.alive = False
             self._conns.discard(conn)
+            # detach this connection's decode streams: they keep
+            # generating (and retaining frames) headless; a reconnect
+            # re-attaches via resolve + sresume
+            for st in self._streams.values():
+                if st.conn is conn:
+                    st.conn = None
         conn.stop_evt.set()
         try:
             # shutdown before close: wakes a reader blocked in recv()
@@ -595,6 +644,10 @@ class ServingFrontDoor:
                 self._counters["legacy_peers"] += 1
         if verb == "predict":
             self._handle_predict(conn, msg[1], msg[2])
+        elif verb == "decode":
+            self._handle_decode(conn, msg[1], msg[2])
+        elif verb == "sresume":
+            self._handle_sresume(conn, msg[1], msg[2])
         elif verb == "resolve":
             self._handle_resolve(conn, msg[1], msg[2])
         elif verb == "health":
@@ -760,6 +813,159 @@ class ServingFrontDoor:
             self._orphan(entry.rid, reply)
 
     # ------------------------------------------------------------------
+    # stateful decode streaming (ISSUE 18)
+    # ------------------------------------------------------------------
+    def _handle_decode(self, conn, rid, spec):
+        """Admit one decode stream. Token frames ``("stok", rid,
+        seq_no, token)`` flow back incrementally; ``("sdone", rid,
+        outcome, info)`` terminates. Accounting is identical to
+        predict: one submitted, exactly one terminal outcome — a stream
+        is one request however many frames it produces."""
+        from .. import profiler as _prof
+        model = spec.get("model")
+        trace = spec.get("trace") or rid
+        with self._lock:
+            self._counters["submitted"] += 1
+        t_send = spec.get("t_send")
+        wire_ms = 0.0
+        if t_send is not None:
+            wire_ms = max(0.0, (time.time() - float(t_send)) * 1e3)
+        _prof.record_latency("serving.%s.wire" % model, wire_ms * 1e6)
+        deadline_ms = spec.get("deadline_ms")
+        if deadline_ms is not None:
+            deadline_ms = float(deadline_ms) - wire_ms
+            if deadline_ms <= 0.0:
+                with self._lock:
+                    self._counters["wire_shed"] += 1
+                    self._counters["shed"] += 1
+                conn.send_q.put((
+                    "sdone", rid, "shed",
+                    "decode shed at the front door: deadline budget "
+                    "consumed by %.1fms wire transfer" % wire_ms))
+                return
+        st = _Stream(rid, model, conn, trace)
+        with self._lock:
+            # same one-critical-section rule as predict: the draining
+            # check, the pending registration, and the stream
+            # registration are atomic against drain()
+            if self._draining:
+                self._counters["refused_draining"] += 1
+                self._counters["failed"] += 1
+                refused = True
+            else:
+                self._pending[rid] = _Pending(conn, model, rid)
+                self._streams[rid] = st
+                refused = False
+        if refused:
+            conn.send_q.put(("sdone", rid, "failed",
+                             "server draining: request refused"))
+            return
+        extra = {}
+        if deadline_ms is not None:
+            # explicit client budget (minus wire time); an absent one
+            # falls through to the engine's configured default
+            extra["deadline_ms"] = deadline_ms
+        try:
+            st.engine_stream = self._server.submit_decode(
+                model, spec.get("tokens"),
+                max_new_tokens=spec.get("max_new_tokens"),
+                priority=int(spec.get("priority") or 0),
+                trace=trace, pin=rid, **extra,
+                on_token=lambda es, seq_no, tok, s=st:
+                    self._stream_token(s, seq_no, tok),
+                on_done=lambda es, s=st: self._stream_done(s, es))
+        except Exception as e:
+            with self._idle_cv:
+                self._pending.pop(rid, None)
+                self._streams.pop(rid, None)
+                self._counters["failed"] += 1
+                if not self._pending:
+                    self._idle_cv.notify_all()
+            conn.send_q.put(("sdone", rid, "failed", "%s: %s"
+                             % (type(e).__name__, e)))
+
+    def _stream_token(self, st, seq_no, token):
+        """One generated token (engine loop thread): record the frame in
+        the stream history, then deliver to the current connection. The
+        append and the enqueue share one lock acquisition with sresume's
+        replay, so a concurrent resume can neither drop nor duplicate a
+        frame. An injected ``decode.stream`` fault models a broken
+        delivery path: the frame is RETAINED (it already happened) and
+        the connection is dropped so the client's resume-by-id recovery
+        takes over."""
+        from .. import profiler as _prof
+        frame = ("stok", st.rid, int(seq_no), int(token))
+        fault = None
+        try:
+            _faults.fault_point("decode.stream", rid=st.rid, seq_no=seq_no)
+        except Exception as e:
+            fault = e
+        with self._lock:
+            st.frames.append(frame)
+            self._counters["stream_frames"] += 1
+            conn = st.conn
+            deliver = (fault is None and conn is not None and conn.alive)
+            if deliver:
+                conn.send_q.put(frame)
+        _prof.record_decode_event(stream_frames=1)
+        if fault is not None and conn is not None:
+            _log.warning("front door: stream %s delivery fault: %s",
+                         st.rid, fault)
+            self._conn_lost(conn)
+
+    def _stream_done(self, st, engine_stream):
+        """Terminal engine outcome for a stream: count it (the
+        accounting invariant treats the whole stream as one request),
+        park the terminal reply on the stream state with a TTL, and
+        deliver when a connection is attached."""
+        if engine_stream.outcome == "served":
+            reply = ("sdone", st.rid, "served",
+                     {"trace": st.trace, "tokens": len(engine_stream.tokens)})
+        else:
+            reply = ("sdone", st.rid, engine_stream.outcome,
+                     str(engine_stream.error))
+        with self._idle_cv:
+            self._counters[engine_stream.outcome] += 1
+            self._pending.pop(st.rid, None)
+            if not self._pending:
+                self._idle_cv.notify_all()
+            st.terminal = reply
+            st.expiry = time.monotonic() + self._orphan_ttl_s
+            conn = st.conn
+            if conn is not None and conn.alive:
+                conn.send_q.put(reply)
+
+    def _handle_sresume(self, conn, rid, payload):
+        """Re-attach a stream to a (new) connection and replay exactly
+        the frames past the client's high-water mark — the streaming
+        half of exactly-once: the client asked for ``have+1..`` and
+        that is precisely what it gets, plus the terminal if the stream
+        finished while detached."""
+        from .. import profiler as _prof
+        orig = payload.get("rid")
+        have = max(0, int(payload.get("have") or 0))
+        with self._lock:
+            self._counters["control"] += 1
+            st = self._streams.get(orig)
+            if st is None:
+                self._counters["stream_resume_unknown"] += 1
+                known = False
+            else:
+                known = True
+                st.conn = conn
+                self._counters["stream_resumes"] += 1
+                for frame in st.frames[have:]:
+                    conn.send_q.put(frame)
+                if st.terminal is not None:
+                    conn.send_q.put(st.terminal)
+        if known:
+            _prof.record_decode_event(stream_resumes=1)
+        else:
+            conn.send_q.put(("sdone", orig, "failed",
+                             "unknown stream %r (expired, or never "
+                             "admitted)" % (orig,)))
+
+    # ------------------------------------------------------------------
     # orphan store + resolve protocol
     # ------------------------------------------------------------------
     def _sweep_orphans_locked(self, now):
@@ -772,10 +978,19 @@ class ServingFrontDoor:
         for r in expired:
             del self._orphans[r]
             self._counters["orphan_expired"] += 1
+        # finished streams age out on the same TTL: once terminal, the
+        # retained frame history only exists for resume-by-id, and a
+        # client that has not reconnected within the orphan window gets
+        # the same "unknown" answer an expired orphan would
+        dead = [r for r, st in self._streams.items()
+                if st.terminal is not None and st.expiry <= now]
+        for r in dead:
+            del self._streams[r]
+            self._counters["streams_expired"] += 1
 
     def _sweep_orphans(self):
         with self._lock:
-            if self._orphans:
+            if self._orphans or self._streams:
                 self._sweep_orphans_locked(time.monotonic())
 
     def _orphan(self, rid, reply):
@@ -791,6 +1006,14 @@ class ServingFrontDoor:
         with self._lock:
             self._sweep_orphans_locked(now)
             for r in rids:
+                st = self._streams.get(r)
+                if st is not None:
+                    # streams resolve to their high-water mark: the
+                    # client learns how many frames exist (and the
+                    # terminal outcome, if any) and resumes via sresume
+                    # rather than resubmitting
+                    out[r] = ("stream", len(st.frames), st.terminal)
+                    continue
                 rec = self._orphans.pop(r, None)
                 if rec is not None and rec[0] > now:
                     self._counters["orphan_resolved"] += 1
@@ -871,4 +1094,5 @@ class ServingFrontDoor:
             out["open_connections"] = len(self._conns)
             out["pending"] = len(self._pending)
             out["orphans_held"] = len(self._orphans)
+            out["streams_held"] = len(self._streams)
         return out

@@ -602,6 +602,7 @@ class ModelServer:
                  hedge_min_ms=None):
         self._lock = threading.Lock()
         self._models = {}
+        self._decode = {}     # decode model name -> [DecodeEngine]
         self._pollers = {}    # model name -> (thread, stop_event)
         self._stopped = False
         # tail-latency hedging (ISSUE 12): OFF unless configured — the
@@ -821,6 +822,78 @@ class ModelServer:
             poller[1].set()
         for rep in removed:
             rep.engine.stop()
+
+    # ------------------------------------------------------------------
+    # stateful decode (ISSUE 18)
+    # ------------------------------------------------------------------
+    def register_decode(self, name, engine):
+        """Register a :class:`~.decode.DecodeEngine` replica under
+        ``name``. Decode is the STATEFUL serving path: a sequence's KV
+        cache lives on one replica for its whole life, so dispatch pins
+        by sequence id and the hedger never sees this path — hedging a
+        decode request would start a divergent twin with its own cache
+        instead of cutting tail latency (docs/faq/serving.md,
+        "hedging vs pinning")."""
+        with self._lock:
+            if self._stopped:
+                raise MXNetError("ModelServer is stopped")
+            self._decode.setdefault(name, []).append(engine)
+
+    def unregister_decode(self, name):
+        """Remove (and stop) every decode replica under ``name``."""
+        with self._lock:
+            engines = self._decode.pop(name, None)
+        if engines is None:
+            raise MXNetError("unknown decode model %r" % name)
+        for eng in engines:
+            eng.stop()
+
+    def decode_models(self):
+        with self._lock:
+            return sorted(self._decode)
+
+    def decode_engine(self, name, replica=0):
+        with self._lock:
+            engines = self._decode.get(name)
+            if not engines:
+                raise MXNetError("unknown decode model %r (registered: %s)"
+                                 % (name, sorted(self._decode)))
+            return engines[replica]
+
+    def submit_decode(self, name, tokens, pin=None, **kw):
+        """Submit one sequence for decode; returns the engine's
+        :class:`~.decode.DecodeStream`.
+
+        ``pin`` is the stable sequence key (the front door passes the
+        request id): the replica is chosen by hash of the pin, so every
+        resubmit/resume of the same sequence lands on the replica that
+        holds its KV state. No hedging, no failover mid-sequence —
+        replaying from the prefix is the client's recovery story, not
+        the dispatcher's."""
+        with self._lock:
+            engines = self._decode.get(name)
+            if not engines:
+                raise MXNetError("unknown decode model %r (registered: %s)"
+                                 % (name, sorted(self._decode)))
+            if pin is not None:
+                import zlib
+                idx = zlib.crc32(str(pin).encode("utf-8")) % len(engines)
+            else:
+                loads = [e.stats() for e in engines]
+                idx = min(range(len(engines)),
+                          key=lambda i: (loads[i]["active"]
+                                         + loads[i]["waiting"]))
+            engine = engines[idx]
+        return engine.submit(tokens, **kw)
+
+    def decode_stats(self):
+        """Per-decode-model engine stats (counters, KV occupancy,
+        program family sizes)."""
+        with self._lock:
+            snapshot = {name: list(engines)
+                        for name, engines in self._decode.items()}
+        return {name: [eng.stats() for eng in engines]
+                for name, engines in snapshot.items()}
 
     # ------------------------------------------------------------------
     # routing
@@ -1151,6 +1224,9 @@ class ModelServer:
             engines = [rep.engine for entry in self._models.values()
                        for reps in entry.versions.values()
                        for rep in reps]
+            engines.extend(e for engs in self._decode.values()
+                           for e in engs)
+            self._decode.clear()
         if self._hedger is not None:
             self._hedger.stop()
         for _thread, stop_evt in pollers:

@@ -61,9 +61,21 @@ def base_corpus(limits=None):
           "device_ms": 11.5, "total_ms": 15.56}),
         ("shed", "c7-2", "deadline budget consumed by 42.0ms wire"),
         ("failed", "c7-3", "MXNetError: unknown model 'x'"),
+        # stateful decode: request, streamed tokens, terminal, resume
+        ("decode", "c7-5",
+         {"model": "lm", "tokens": [3, 1, 4, 1, 5], "max_new_tokens": 32,
+          "deadline_ms": 2500.0, "priority": 0,
+          "trace": "a1b2c3d4e5f6", "t_send": 1754300000.5}),
+        ("stok", "c7-5", 7, 31173),
+        ("sdone", "c7-5", "served", {"trace": "a1b2c3d4e5f6", "tokens": 32}),
+        ("sdone", "c7-6", "shed",
+         "CacheOverflow: prompt of 10 tokens can never fit a pool of "
+         "2 blocks"),
+        ("sresume", "c8-3", {"rid": "c7-5", "have": 7}),
         # resolve round-trip
         ("resolve", "c8-1", ["c7-1", "c7-2", "c9-9"]),
-        ("resolved", "c8-1", {"c7-1": ("pending",), "c9-9": ("unknown",)}),
+        ("resolved", "c8-1", {"c7-1": ("pending",), "c9-9": ("unknown",),
+                              "c7-5": ("stream", 7, None)}),
         # fleet control plane
         ("join", {"worker_id": "h-1234-ab", "host": None, "port": 40001,
                   "pid": 1234, "codecs": ["safe", "pickle"],

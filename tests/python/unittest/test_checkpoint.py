@@ -316,6 +316,37 @@ def test_preemption_hook_flushes_final_checkpoint(tmp_path):
     assert meta["step"] == 6 and meta["mid_epoch"] and meta["preempted"]
 
 
+def test_preemption_notice_tightens_cadence(tmp_path, monkeypatch):
+    """A fake advance notice (cloud maintenance event) collapses the save
+    cadence to every epoch and flushes one immediate live snapshot."""
+    mgr = mx.checkpoint.CheckpointManager(str(tmp_path), save_period=5)
+    assert mgr.effective_save_period() == 5
+    assert mgr.preemption_notice() is None
+    sym = _fc_symbol()
+    params = {"fc_weight": mx.nd.ones((2, 4)), "fc_bias": mx.nd.zeros((2,))}
+    mgr.set_live_capture(lambda: dict(step=7, symbol=sym, arg_params=params,
+                                      epoch=7))
+    handle = mgr.notify_preemption(deadline_s=120.0)
+    assert mgr.effective_save_period() == 1      # cadence consumer:
+    #   base_module.fit checks effective_save_period(), not save_period
+    assert 0.0 < mgr.preemption_notice() <= 120.0
+    assert handle is not None
+    handle.wait(30.0)
+    meta = mx.checkpoint.read_meta(mx.checkpoint.latest_checkpoint(
+        str(tmp_path)))
+    assert meta["step"] == 7 and meta["mid_epoch"] and meta["preempted"]
+    # a second notice for an already-committed step skips the save
+    assert mgr.notify_preemption(deadline_s=60.0) is None
+
+
+def test_preemption_notice_deadline_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_PREEMPT_NOTICE_S", "42.5")
+    mgr = mx.checkpoint.CheckpointManager(str(tmp_path))
+    assert mgr.notify_preemption() is None       # no live capture yet
+    assert 0.0 < mgr.preemption_notice() <= 42.5
+    assert mgr.effective_save_period() == 1
+
+
 # ---------------------------------------------------------------------------
 # formats: legacy import, optimizer payloads, sharded reassembly
 # ---------------------------------------------------------------------------

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""CI stateful-decode smoke (`ci/run.py decode_smoke` stage, ISSUE 18).
+
+Fast, non-slow gate over the decode serving tier:
+  * two REAL client OS processes stream autoregressive decodes over the
+    TCP wire; every streamed output is BIT-IDENTICAL to solo
+    `DecodeEngine.generate` on the same prompt (continuous batching may
+    not change a single token);
+  * one client breaks its transport mid-stream and resumes by sequence
+    id: the delivered `seq_no`s are exactly 1..N — zero tokens lost,
+    zero duplicated — across the killed connection;
+  * cache pressure sheds TYPED across the socket: a never-fit prompt is
+    refused up front and a sequence that outgrows the pool
+    mid-generation sheds with its partial output intact, both arriving
+    as `DeadlineExceeded` client-side;
+  * the program family stays at exactly len(prefill_buckets) + 1
+    compiled programs after all traffic (the steady-state loop never
+    recompiles), the paged allocator drains back to zero live blocks,
+    and `submitted == served + shed + failed` holds gateway-side with
+    the whole stream counted as ONE request.
+
+Prints one JSON summary line; non-zero exit on any violated contract.
+The companion lint half of the stage (tpulint over mxnet_tpu/serving)
+runs as a second command in ci/run.py.
+"""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from mxnet_tpu.serving import (ModelServer, ServingFrontDoor,  # noqa: E402
+                               DecodeEngine, tiny_lm_params)
+
+# Client subprocess body: a REAL ServingClient in a REAL second OS
+# process streaming decodes — the acceptance criteria are cross-process
+# bit-parity and exactly-once delivery across a killed connection.
+_CLIENT = r'''
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, %(root)r)
+from mxnet_tpu.serving import ServingClient, DeadlineExceeded
+port, seed = int(sys.argv[1]), int(sys.argv[2])
+cli = ServingClient("127.0.0.1", port)
+out = {"outs": [], "seqs_ok": True, "kill_fired": False}
+
+# --- streamed decodes on the healthy engine; seed 1 breaks its
+# transport mid-stream on the third prompt ------------------------------
+prompts = [[seed, i + 1, (seed * 7 + i) %% 11 + 1] for i in range(5)]
+for i, prompt in enumerate(prompts):
+    got = []
+    def on_tok(st, n, t, _i=i, _got=got):
+        _got.append((n, t))
+        if seed == 1 and _i == 2 and n == 3 and not out["kill_fired"]:
+            out["kill_fired"] = True
+            cli.fail_over()      # break every transport, mid-stream
+    st = cli.decode_async(prompt, model="lm", max_new_tokens=8 + i,
+                          on_token=on_tok)
+    toks = st.result_wait(60.0)
+    out["outs"].append(toks)
+    if [t for _, t in sorted(got)] != toks or \
+            sorted(n for n, _ in got) != list(range(1, len(toks) + 1)):
+        out["seqs_ok"] = False
+        out["bad_seq"] = {"prompt": prompt, "got": sorted(got),
+                          "toks": toks}
+out["resumes"] = cli.stats.get("stream_resumes", 0)
+
+# --- typed shed: never-fit prompt on the starved engine ----------------
+try:
+    cli.decode(list(range(1, 11)), model="tiny", max_new_tokens=4,
+               timeout=60.0)
+    out["neverfit_typed"] = False
+except DeadlineExceeded as e:
+    out["neverfit_typed"] = "never fit" in str(e)
+except Exception as e:
+    out["neverfit_typed"] = "%%s: %%s" %% (type(e).__name__, str(e)[:200])
+
+# --- typed shed mid-generation, partial output retained ----------------
+st = cli.decode_async([seed, 2, 3, 4, 5], model="tiny", max_new_tokens=10)
+try:
+    st.result_wait(60.0)
+    out["midgen_typed"] = False
+except DeadlineExceeded:
+    out["midgen_typed"] = True
+except Exception as e:
+    out["midgen_typed"] = "%%s: %%s" %% (type(e).__name__, str(e)[:200])
+out["midgen_partial"] = len(st.tokens)
+cli.close()
+print(json.dumps(out))
+'''
+
+
+def main():
+    params = tiny_lm_params()
+    # healthy engine: pool comfortably covers the traffic
+    eng = DecodeEngine(params, name="lm", num_blocks=64, batch_size=4,
+                       max_seq_len=96, prefill_buckets=(16,))
+    # starved engine: 2 usable blocks x 4 tokens = 8-token capacity, so
+    # a 10-token prompt can never fit and a 5-token prompt overflows
+    # mid-generation — both must shed typed across the wire
+    tiny = DecodeEngine(params, name="tiny", block_size=4, num_blocks=3,
+                        batch_size=2, max_seq_len=64, prefill_buckets=(16,))
+    srv = ModelServer()
+    srv.register_decode("lm", eng)
+    srv.register_decode("tiny", tiny)
+    fd = ServingFrontDoor(srv, port=0).start()
+
+    script = _CLIENT % {"root": ROOT}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", script, str(fd.port), str(seed)],
+        stdout=subprocess.PIPE, text=True) for seed in (1, 2)]
+    reports = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        assert p.returncode == 0, out
+        reports.append(json.loads(out.strip().splitlines()[-1]))
+
+    # --- bit-parity vs solo decode, exactly-once seq_nos ---------------
+    for seed, rep in zip((1, 2), reports):
+        assert rep["seqs_ok"], rep
+        prompts = [[seed, i + 1, (seed * 7 + i) % 11 + 1] for i in range(5)]
+        for i, (prompt, toks) in enumerate(zip(prompts, rep["outs"])):
+            solo = eng.generate(prompt, max_new_tokens=8 + i)
+            assert toks == solo, \
+                "continuous batching diverged from solo decode: " \
+                "%r -> %r != %r" % (prompt, toks, solo)
+        assert rep["neverfit_typed"] is True, rep
+        assert rep["midgen_typed"] is True, rep
+        assert rep["midgen_partial"] >= 1, rep
+    assert reports[0]["kill_fired"], reports[0]
+    assert reports[0]["resumes"] >= 1, reports[0]
+
+    # --- program family flat, allocator drained, accounting exact ------
+    st_lm, st_tiny = eng.stats(), tiny.stats()
+    assert st_lm["programs"] == {"prefill": 1, "step": 1}, st_lm
+    assert st_tiny["programs"] == {"prefill": 1, "step": 1}, st_tiny
+    assert st_lm["kv"]["blocks_live"] == 0, st_lm["kv"]
+    assert st_tiny["kv"]["blocks_live"] == 0, st_tiny["kv"]
+    assert st_tiny["cache_oom"] >= 4, st_tiny      # 2 never-fit + 2 midgen
+    fs = fd.stats()
+    assert fs["submitted"] == fs["served"] + fs["shed"] + fs["failed"], fs
+    assert fs["stream_resumes"] >= 1, fs
+    n_toks = sum(len(t) for rep in reports for t in rep["outs"])
+    assert fs["stream_frames"] >= n_toks, fs
+
+    summary = {
+        "clients": reports,
+        "frontdoor": {k: v for k, v in fs.items() if v},
+        "lm": {"counters": {k: v for k, v in st_lm.items()
+                            if isinstance(v, int) and v},
+               "kv": st_lm["kv"], "programs": st_lm["programs"]},
+        "tiny": {"cache_oom": st_tiny["cache_oom"],
+                 "kv": st_tiny["kv"]},
+    }
+    print(json.dumps(summary), flush=True)
+    assert fd.drain(timeout=30.0)
+    srv.stop()
+    print("decode_smoke OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,15 @@ def capture_corpus():
             cli.predict({"data": x[:rows]}, model="fz", timeout=60.0)
         cli.health()
         cli.list_models()
+        # stateful-decode leg: decode request + streamed stok frames +
+        # terminal sdone cross the tap (ISSUE 18 stream frames)
+        from mxnet_tpu.serving import DecodeEngine, tiny_lm_params
+        eng = DecodeEngine(tiny_lm_params(), name="fz_lm", num_blocks=16,
+                           batch_size=2, max_seq_len=64,
+                           prefill_buckets=(16,))
+        srv.register_decode("fz_lm", eng)
+        cli.decode([3, 1, 4, 1, 5], model="fz_lm", max_new_tokens=6,
+                   timeout=60.0)
         # fleet leg: join (hello + probe + joined), heartbeats, rollover
         pool = FleetPool(srv, port=0, heartbeat_s=0.25,
                          connect_deadline_s=2.0).start()
