@@ -129,11 +129,14 @@ def stage_decode_smoke(_):
     loss/duplication, cache pressure sheds typed across the wire
     (never-fit up front, mid-generation with partial output intact), the
     program family stays at len(buckets) + 1 and the paged allocator
-    drains to zero live blocks — then tpulint over the serving
-    modules."""
+    drains to zero live blocks. The transformer section (ISSUE 19)
+    needs the 8-device host mesh: the flash kernel tier must ENGAGE
+    (interpret off-TPU, asserted — never a silent lax fallback) and the
+    tp-sharded-KV engine must match lax solo token-for-token — then
+    tpulint over the serving modules."""
     rc = subprocess.call(
         [sys.executable, os.path.join(ROOT, "tools", "decode_smoke.py")],
-        env=_env_cpu_mesh(1), cwd=ROOT)
+        env=_env_cpu_mesh(8), cwd=ROOT)
     if rc != 0:
         return rc
     return subprocess.call(

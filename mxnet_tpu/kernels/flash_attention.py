@@ -1183,11 +1183,14 @@ _flash_attention_tpu.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
                     block_q=512, block_k=512, use_pallas=None,
-                    variant="stream"):
+                    interpret=False, variant="stream"):
     """Fused attention over [B, H, S, D] tensors.
 
     `use_pallas=None` auto-selects: the Pallas kernel on TPU backends,
     blockwise jnp elsewhere (identical numerics up to fp tolerance).
+    `interpret=True` forces the Pallas kernel in interpret mode — the
+    off-TPU kernel tier used by the mesh-parity suite and the multichip
+    dryrun (same kernel body, executed op-by-op on the host backend).
     `variant` picks the Pallas kernels (fwd and bwd): "stream" (whole
     sequence resident in VMEM, fori_loop over blocks) or "grid" (blocks
     as an arbitrary grid dimension with scratch accumulators — O(block)
@@ -1197,11 +1200,12 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
         sm_scale = 1.0 / _np.sqrt(q.shape[-1])
     if use_pallas is None:
         use_pallas = default_use_pallas()
+    run_kernel = use_pallas or interpret
     ok_shapes = (q.shape[2] % min(block_q, q.shape[2]) == 0
                  and k.shape[2] % min(block_k, k.shape[2]) == 0)
-    if use_pallas and ok_shapes:
+    if run_kernel and ok_shapes:
         return _flash_attention_tpu(q, k, v, sm_scale, causal,
-                                    block_q, block_k, False, variant)
+                                    block_q, block_k, interpret, variant)
     out, _ = blockwise_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                  block_k=block_k)
     return out

@@ -30,7 +30,9 @@ from ..parallel.collectives import shard_map
 from ..parallel.ring_attention import sequence_parallel_attention
 
 __all__ = ["TransformerConfig", "init_transformer", "transformer_forward",
-           "transformer_loss", "transformer_sharding_rules"]
+           "transformer_loss", "transformer_sharding_rules",
+           "transformer_decode_prefill", "transformer_decode_step",
+           "TransformerDecodeModel"]
 
 
 class TransformerConfig:
@@ -128,9 +130,18 @@ def _layer_norm(x, scale, bias, eps=1e-5):
 
 
 def _attention(q, k, v, cfg, mesh):
-    """[B, H, S, D] attention; shard_map island when a mesh is given."""
+    """[B, H, S, D] attention; shard_map island when a mesh is given.
+
+    The kernel tier inside the island follows MXNET_TPU_MESH_KERNEL_TIER
+    (`parallel.mesh_kernels.resolve_kernel_tier`, resolved at trace
+    time): pallas_call is not auto-partitionable, but per-shard inside
+    the manual region it is a plain local op, so the flash kernel stays
+    engaged on dp×tp meshes instead of lax-falling-back."""
+    from ..parallel.mesh_kernels import resolve_kernel_tier
+    kt_pallas, kt_interpret = resolve_kernel_tier()
     if mesh is None:
         return flash_attention(q, k, v, causal=True, block_k=cfg.block_k,
+                               use_pallas=kt_pallas, interpret=kt_interpret,
                                variant=cfg.attn_variant)
     names = mesh.axis_names
     bq = "dp" if "dp" in names else None
@@ -144,6 +155,8 @@ def _attention(q, k, v, cfg, mesh):
     def local(q, k, v):
         if sq is None or impl == "full":
             return flash_attention(q, k, v, causal=True, block_k=cfg.block_k,
+                                   use_pallas=kt_pallas,
+                                   interpret=kt_interpret,
                                    variant=cfg.attn_variant)
         return sequence_parallel_attention(q, k, v, sq, impl=impl,
                                            causal=True, block_k=cfg.block_k,
@@ -236,3 +249,214 @@ def transformer_loss(params, tokens, targets, cfg, mesh=None, rng=None,
     nll = logz - gold
     mask = (targets >= 0).astype(jnp.float32)
     return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Paged-KV decode bodies (serving/decode.py program family)
+# ---------------------------------------------------------------------------
+# The serving DecodeEngine is model-agnostic: it owns the paged KV pool,
+# block tables and continuous batching, and calls a bucketed batch-1
+# prefill program plus one fixed-shape batched step program. These are
+# the real multi-layer multi-head transformer bodies for that seam —
+# replacing the engine's built-in single-layer parity fixture with the
+# model family the parallel stack is designed around.
+#
+# KV page layout: ``(num_blocks, block_size, num_layers, d_model)`` for
+# each of K and V (heads folded into d_model, so tp-sharding the trailing
+# dim shards heads — `kvcache.page_sharding`). Per layer l, position p of
+# a sequence lives at ``pages[table[p // bs], p % bs, l]``.
+#
+# Masking contract (shared with the built-in fixture): padding/inactive
+# writes scatter into the null block, and every read masks additively
+# with -1e30 — exp(-1e30 - m) is exactly 0.0 in f32, so not-yet-written
+# or foreign page content can never perturb a real row's bits. This is
+# what makes chunked prefill BIT-identical to whole-prompt prefill: a
+# query at global position p gathers the same table-shaped page block
+# either way, real keys (tpos <= p) hold identical bits by induction
+# over layers/chunks, and masked lanes contribute exactly 0 regardless
+# of content.
+
+_NEG = -1e30
+
+
+def _decode_attn_prefill(q, ks, vs, start, cfg, use_pallas, interpret):
+    """Chunk attention over gathered pages. q: (C, H, Dh); ks/vs:
+    (T, H, Dh) gathered from the sequence's block table. Causal at
+    global offset `start` (query row i sits at position start + i).
+
+    Kernel tier: the offset-aware flash kernels
+    (`_flash_fwd_offs_kernel` block-table variant) with
+    offs = [start, 0]; lax tier: `blockwise_attention` with q_offset —
+    identical masking semantics, fp-tolerance numerics."""
+    from ..kernels.flash_attention import (blockwise_attention,
+                                           flash_attention_with_lse)
+    C, H, Dh = q.shape
+    T = ks.shape[0]
+    sm = 1.0 / _np.sqrt(Dh)
+    q4 = q.transpose(1, 0, 2)[None]                     # (1, H, C, Dh)
+    k4 = ks.transpose(1, 0, 2)[None]
+    v4 = vs.transpose(1, 0, 2)[None]
+    # block sizes must tile exactly: C is a prefill bucket (so C itself
+    # always works), T = mb * block_size (so block_size always works)
+    bq = C if C % min(cfg.block_k, C) else min(cfg.block_k, C)
+    bk = T if T % min(cfg.block_k, T) else min(cfg.block_k, T)
+    if use_pallas or interpret:
+        offs = jnp.asarray([start, 0], jnp.int32) \
+            if not hasattr(start, "dtype") else \
+            jnp.stack([start.astype(jnp.int32), jnp.int32(0)])
+        out, _ = flash_attention_with_lse(q4, k4, v4, offs, sm, True,
+                                          bq, bk, interpret,
+                                          cfg.attn_variant)
+    else:
+        out, _ = blockwise_attention(q4, k4, v4, causal=True, sm_scale=sm,
+                                     block_k=bk, q_offset=start, k_offset=0)
+    return out[0].transpose(1, 0, 2)                    # (C, H, Dh)
+
+
+def transformer_decode_prefill(params, cfg, k_pages, v_pages, tokens,
+                               start, length, table, *, use_pallas=False,
+                               interpret=False):
+    """Bucketed batch-1 prefill chunk: write K/V for global positions
+    ``start .. start+length-1`` into the paged cache, return the greedy
+    next token after the chunk's last real position.
+
+    Matches the DecodeEngine prefill seam
+    ``(params, k_pages, v_pages, tokens, start, length, table)``.
+    Whole-prompt prefill is the ``start=0`` call; chunked prefill is the
+    SAME bucket program called repeatedly with advancing ``start`` —
+    the program family stays at len(buckets)+1."""
+    C = tokens.shape[0]
+    bs = k_pages.shape[1]
+    mb = table.shape[0]
+    L = cfg.num_layers
+    H, Dh = cfg.num_heads, cfg.d_model // cfg.num_heads
+    T = mb * bs
+    idx = jnp.arange(C, dtype=jnp.int32)
+    pos = start + idx
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = x + params["pos_embed"][jnp.clip(pos, 0, cfg.max_len - 1)] \
+        .astype(cfg.dtype)
+    valid = idx < length
+    blk = jnp.where(valid, table[jnp.clip(pos, 0, T - 1) // bs], 0)
+    slot = jnp.clip(pos, 0, T - 1) % bs
+    lp_all = params["layers"]
+    for l in range(L):
+        lp = {k: v[l] for k, v in lp_all.items()}
+        h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+        q = (h @ lp["wq"]).reshape(C, H, Dh)
+        kk = h @ lp["wk"]                               # (C, D)
+        vv = h @ lp["wv"]
+        k_pages = k_pages.at[blk, slot, l].set(kk)
+        v_pages = v_pages.at[blk, slot, l].set(vv)
+        ks = k_pages[table][:, :, l].reshape(T, H, Dh)
+        vs = v_pages[table][:, :, l].reshape(T, H, Dh)
+        a = _decode_attn_prefill(q, ks, vs, start, cfg, use_pallas,
+                                 interpret)
+        x = x + a.reshape(C, cfg.d_model) @ lp["wo"]
+        h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+        x = x + (jax.nn.gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"])
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    x_last = jnp.take(x, jnp.clip(length - 1, 0, C - 1), axis=0)
+    logits = x_last @ params["embed"].T.astype(cfg.dtype)
+    return jnp.argmax(logits).astype(jnp.int32), k_pages, v_pages
+
+
+def transformer_decode_step(params, cfg, k_pages, v_pages, token_ids,
+                            positions, tables, active):
+    """Fixed-shape batched decode step: one token per active row.
+
+    Matches the DecodeEngine step seam ``(params, k_pages, v_pages,
+    token_ids, positions, tables, active)``. Every per-row contraction
+    runs only over that row's own gathered blocks (einsum batch dim),
+    so rows cannot observe each other — batched decode stays
+    bit-identical to solo decode, layer count notwithstanding. The lax
+    tier is deliberate here: a 1-token query has no MXU win and rows
+    carry different lengths, which cannot share the flash kernels'
+    scalar-prefetch offs — prefill is where the flash tier earns its
+    keep."""
+    B, mb = tables.shape
+    bs = k_pages.shape[1]
+    L = cfg.num_layers
+    H, Dh = cfg.num_heads, cfg.d_model // cfg.num_heads
+    T = mb * bs
+    sm = 1.0 / _np.sqrt(Dh)
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    x = x + params["pos_embed"][jnp.clip(positions, 0, cfg.max_len - 1)] \
+        .astype(cfg.dtype)
+    blk = jnp.take_along_axis(tables, (positions // bs)[:, None], axis=1)
+    blk = jnp.where(active, blk[:, 0], 0)
+    slot = positions % bs
+    tpos = jnp.arange(T, dtype=jnp.int32)[None, None, :]
+    lp_all = params["layers"]
+    for l in range(L):
+        lp = {k: v[l] for k, v in lp_all.items()}
+        h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+        q = (h @ lp["wq"]).reshape(B, H, Dh)
+        kk = h @ lp["wk"]
+        vv = h @ lp["wv"]
+        k_pages = k_pages.at[blk, slot, l].set(kk)
+        v_pages = v_pages.at[blk, slot, l].set(vv)
+        ks = k_pages[tables][:, :, :, l].reshape(B, T, H, Dh)
+        vs = v_pages[tables][:, :, :, l].reshape(B, T, H, Dh)
+        scores = jnp.einsum("bhd,bthd->bht", q, ks) * sm
+        scores = jnp.where(tpos <= positions[:, None, None], scores, _NEG)
+        w = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bht,bthd->bhd", w, vs).reshape(B, cfg.d_model)
+        x = x + ctx @ lp["wo"]
+        h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+        x = x + (jax.nn.gelu(h @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"])
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = x @ params["embed"].T.astype(cfg.dtype)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_pages, v_pages
+
+
+class TransformerDecodeModel:
+    """Adapter: a multi-layer TransformerConfig wired for the
+    DecodeEngine seam.
+
+    >>> model = TransformerDecodeModel(TransformerConfig(vocab_size=256,
+    ...     num_layers=2, num_heads=4, d_model=64, max_len=128))
+    >>> eng = DecodeEngine(model.params, kv_shape=model.kv_shape,
+    ...                    prefill_fn=model.prefill_fn,
+    ...                    step_fn=model.step_fn, max_seq_len=128)
+
+    ``flash`` picks the prefill attention tier (the step body is always
+    lax — see transformer_decode_step): None reads
+    ``MXNET_SERVING_DECODE_FLASH`` (auto | 1/on | 0/off | interpret,
+    the `resolve_kernel_tier` vocabulary). Params default to
+    `init_transformer` from a seeded key, so every process (engine,
+    smoke clients, bench) derives the same model."""
+
+    def __init__(self, cfg, params=None, seed=0, flash=None):
+        from ..parallel.mesh_kernels import resolve_kernel_tier
+        self.cfg = cfg
+        if params is None:
+            params = init_transformer(cfg, jax.random.PRNGKey(seed))
+        self.params = params
+        mode = flash
+        if mode is None:
+            import os
+            mode = os.environ.get("MXNET_SERVING_DECODE_FLASH", "auto")
+        self.use_pallas, self.interpret = resolve_kernel_tier(mode)
+        self.flash_engaged = bool(self.use_pallas or self.interpret)
+
+    @property
+    def kv_shape(self):
+        """Trailing page dims: (num_layers, d_model)."""
+        return (self.cfg.num_layers, self.cfg.d_model)
+
+    def prefill_fn(self, params, k_pages, v_pages, tokens, start, length,
+                   table):
+        return transformer_decode_prefill(
+            params, self.cfg, k_pages, v_pages, tokens, start, length,
+            table, use_pallas=self.use_pallas, interpret=self.interpret)
+
+    def step_fn(self, params, k_pages, v_pages, token_ids, positions,
+                tables, active):
+        return transformer_decode_step(params, self.cfg, k_pages, v_pages,
+                                       token_ids, positions, tables, active)
+
+    def engine_kwargs(self):
+        """kwargs bundle for DecodeEngine(**model.engine_kwargs(), ...)."""
+        return {"params": self.params, "kv_shape": self.kv_shape,
+                "prefill_fn": self.prefill_fn, "step_fn": self.step_fn}

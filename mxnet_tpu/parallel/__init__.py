@@ -13,10 +13,14 @@ from .moe import init_moe_ffn, moe_ffn
 from .optim_update import (init_opt_state, apply_update,
                            apply_update_sharded)
 from .zero import ZeroShardLayout
+from .mesh_kernels import (resolve_kernel_tier, kernel_tier_mode,
+                           flash_attention_mesh, fused_update_mesh)
 
 __all__ = ["get_mesh", "data_parallel_mesh", "ShardingConfig",
            "allreduce_hosts", "host_barrier", "shard_map", "ring_attention",
            "ulysses_attention", "sequence_parallel_attention",
            "ShardedTrainStep", "pipeline_apply", "PipelinedTrainStep",
            "init_moe_ffn", "moe_ffn", "init_opt_state", "apply_update",
-           "apply_update_sharded", "ZeroShardLayout"]
+           "apply_update_sharded", "ZeroShardLayout",
+           "resolve_kernel_tier", "kernel_tier_mode",
+           "flash_attention_mesh", "fused_update_mesh"]

@@ -91,7 +91,8 @@ def apply_update(optimizer, hp, params, opt_state, grads):
 
 def apply_update_sharded(optimizer, hp, params, opt_state, grads, layout,
                          mesh, rescale=1.0, clip=None, wd=0.0,
-                         fused=False, cast_grads=None):
+                         fused=False, cast_grads=None,
+                         use_pallas=None, interpret=False):
     """ZeRO form of prologue + `apply_update` (arxiv 2004.13336): runs
     INSIDE the jitted step, as a `shard_map` island over the dp axis.
 
@@ -123,9 +124,17 @@ def apply_update_sharded(optimizer, hp, params, opt_state, grads, layout,
     form (`init_opt_state(..., layout=)`); scalar state (adam's ``t``)
     rides replicated. Returns ``(new_params_full, new_opt_state_blocks)``.
 
-    ``fused=True`` routes the chunk update through the fused-optupdate
-    lax tier (`kernels/opt_update.fused_update_step`) — the Pallas kernel
-    tier is not auto-partitionable, so sharded steps always take lax.
+    ``fused=True`` routes the chunk update through
+    `kernels/opt_update.fused_update_step`. `pallas_call` is not
+    auto-partitionable, but INSIDE this manual region there is nothing to
+    partition — each replica's chunk is a plain local array — so the
+    Pallas kernel tier dispatches per chunk like anywhere else:
+    ``use_pallas``/``interpret`` thread straight through (None =
+    auto-gate on TPU; ``interpret=True`` is the off-TPU kernel tier the
+    parity suite runs). Chunks keep the kernel's eligibility rules —
+    (1, chunk) f32 blocks with chunk a multiple of 128 and >= 1024
+    elements take the kernel, the rest take the fused-lax path — and the
+    tiers are bitwise-identical by the shared-prologue construction.
 
     ``cast_grads`` applies the multi-precision (bf16-compute/fp32-master)
     grad cast to the chunk INSIDE the body: same numbers as casting
@@ -166,7 +175,8 @@ def apply_update_sharded(optimizer, hp, params, opt_state, grads, layout,
             from ..kernels.opt_update import fused_update_step
             new_p_sh, new_state = fused_update_step(
                 optimizer, hp_l, p_sh, opt_state, g_sh,
-                rescale=rescale, clip=clip, wd=wd, use_pallas=False)
+                rescale=rescale, clip=clip, wd=wd,
+                use_pallas=use_pallas, interpret=interpret)
         else:
             g_sh = grad_prologue(p_sh, g_sh, rescale=rescale, clip=clip,
                                  wd=wd)

@@ -39,7 +39,8 @@ class ShardedTrainStep:
     def __init__(self, loss_fn, mesh, param_specs, batch_spec=None,
                  optimizer="adam", lr=1e-3, momentum=0.9, wd=0.0,
                  beta1=0.9, beta2=0.999, eps=1e-8, grad_clip=None,
-                 shard_update=None, zero=None, skip_nonfinite=False):
+                 shard_update=None, zero=None, skip_nonfinite=False,
+                 fused_optupdate=None):
         self.loss_fn = loss_fn
         # supervised numeric containment (resilience/supervisor.py's
         # pillar 1, composed-mesh form): the step computes an in-graph
@@ -93,6 +94,17 @@ class ShardedTrainStep:
                 "mesh axes are %r" % (flag_name, dict(mesh.shape)))
         self.shard_update = dp_ok if shard_update is None \
             else bool(shard_update)
+        # Fused optimizer tier (kernels/opt_update) on the composed mesh.
+        # Off the annotation-sharded (shard_update) path the update runs
+        # as a fused_update_mesh shard_map island, where the Pallas
+        # kernel tier engages per dp chunk; combined WITH shard_update
+        # the state keeps its annotation layout and the update takes the
+        # fused-lax sweep (pallas_call is not auto-partitionable inside
+        # GSPMD-partitioned regions — only manual regions run it).
+        if fused_optupdate is None:
+            from ..base import env_flag
+            fused_optupdate = env_flag("MXNET_TPU_FUSED_OPTUPDATE")
+        self.fused_optupdate = bool(fused_optupdate)
         self._step_fn = None
         self.step_count = 0
 
@@ -147,6 +159,10 @@ class ShardedTrainStep:
             self._state_spec, self.params, self.param_specs)
 
         skip_nonfinite = self.skip_nonfinite
+        fused_opt = self.fused_optupdate
+        dp_axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+        from .mesh_kernels import resolve_kernel_tier
+        kt_pallas, kt_interpret = resolve_kernel_tier()  # build-time knob
 
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -174,9 +190,26 @@ class ShardedTrainStep:
                     lambda g, s: jax.lax.with_sharding_constraint(
                         g, NamedSharding(mesh, s)),
                     grads, state_specs)
-            from .optim_update import apply_update
-            new_params, new_state = apply_update(opt, hp, params, opt_state,
-                                                 grads)
+            if fused_opt and not shard_update:
+                # fused kernel tier as a dp shard_map island: transient
+                # (dp, chunk) blocks, kernel per eligible chunk, fresh
+                # params/slots all-gathered — bitwise equal to
+                # apply_update by the shared-prologue construction
+                from .mesh_kernels import fused_update_mesh
+                new_params, new_state = fused_update_mesh(
+                    opt, hp, params, opt_state, grads, mesh, dp_axis,
+                    use_pallas=kt_pallas, interpret=kt_interpret)
+            elif fused_opt:
+                # annotation-sharded state (ZeRO layout) keeps its specs;
+                # one fused-lax sweep per leaf — the partitioner splits
+                # the elementwise update along the state layout
+                from ..kernels.opt_update import fused_update_step
+                new_params, new_state = fused_update_step(
+                    opt, hp, params, opt_state, grads, use_pallas=False)
+            else:
+                from .optim_update import apply_update
+                new_params, new_state = apply_update(opt, hp, params,
+                                                     opt_state, grads)
             if skip_nonfinite:
                 # carry the pre-step state through a bad update (the
                 # donation-safe skip idiom shared with tpu_step)

@@ -281,6 +281,12 @@ class DataParallelTrainStep:
         zero_layout = self._zero_layout if self.zero else None
         mesh = self.mesh
         single_dev = int(_np.prod(list(self.mesh.shape.values()))) == 1
+        dp_axis = self._dp_axis
+        # Kernel-tier selection happens once at build (trace) time, never
+        # per step: auto on TPU, forced/off/interpret via
+        # MXNET_TPU_MESH_KERNEL_TIER (mesh_kernels.resolve_kernel_tier).
+        from .mesh_kernels import resolve_kernel_tier
+        kt_pallas, kt_interpret = resolve_kernel_tier()
         batch_size = list(batch_shapes.values())[0][0]
         rescale = self._rescale if self._rescale is not None else 1.0 / batch_size
 
@@ -369,18 +375,29 @@ class DataParallelTrainStep:
                     optimizer, hp, params, opt_state, grads, zero_layout,
                     mesh, rescale=rescale, clip=clip, wd=wd,
                     fused=fused_opt,
-                    cast_grads=jnp.float32 if cdt is not None else None)
+                    cast_grads=jnp.float32 if cdt is not None else None,
+                    use_pallas=kt_pallas, interpret=kt_interpret)
             elif fused_opt:
                 # one fused sweep per param block (prologue + update in
                 # the kernel) — bit-parity with the tree-map path below.
-                # Kernel tier only on a single-device mesh: pallas_call is
-                # not auto-partitionable, so sharded (dp>1 weight-update
-                # sharding) steps take the fused-lax tier instead
-                from ..kernels.opt_update import fused_update_step
-                new_params, new_state = fused_update_step(
-                    optimizer, hp, params, opt_state, grads,
-                    rescale=rescale, clip=clip, wd=wd,
-                    use_pallas=None if single_dev else False)
+                # pallas_call is not auto-partitionable, so multi-device
+                # meshes route through the fused_update_mesh shard_map
+                # island (transient dp-sharded chunks, params/slots
+                # all-gathered back): inside the manual region the kernel
+                # is a plain per-device op, so the kernel tier engages on
+                # every mesh instead of silently lax-falling-back.
+                if single_dev:
+                    from ..kernels.opt_update import fused_update_step
+                    new_params, new_state = fused_update_step(
+                        optimizer, hp, params, opt_state, grads,
+                        rescale=rescale, clip=clip, wd=wd,
+                        use_pallas=kt_pallas, interpret=kt_interpret)
+                else:
+                    from .mesh_kernels import fused_update_mesh
+                    new_params, new_state = fused_update_mesh(
+                        optimizer, hp, params, opt_state, grads, mesh,
+                        dp_axis, rescale=rescale, clip=clip, wd=wd,
+                        use_pallas=kt_pallas, interpret=kt_interpret)
             else:
                 from .optim_update import apply_update, grad_prologue
                 grads = grad_prologue(params, grads, rescale=rescale,

@@ -34,7 +34,20 @@ the sequence's own blocks → greedy argmax). It is small enough for the
 CPU test mesh yet genuinely history-dependent and row-independent, so
 "continuous-batched decode is bit-identical to solo decode" is a real
 statement about the cache/batching machinery. Custom models plug in via
-``prefill_fn``/``step_fn`` with the same signatures.
+``prefill_fn``/``step_fn`` with the same signatures — the real
+multi-layer multi-head transformer family lives in
+:class:`~..models.transformer.TransformerDecodeModel` (flash-kernel
+prefill over the paged cache, ``kv_shape=(num_layers, d_model)``).
+
+**Chunked prefill** (``prefill_chunk`` /
+``MXNET_SERVING_DECODE_PREFILL_CHUNK``): a long prompt runs as
+chunk-sized pieces through the same bucketed prefill programs (the
+prefill seam carries a ``start`` offset), with one continuous-batching
+step for the other active sequences between pieces — so a long prompt
+no longer stalls the step loop, the program family stays
+``len(buckets) + 1``, and outputs stay bit-identical to whole-prompt
+prefill (masked lanes contribute exactly 0; attended positions already
+hold final K/V bits).
 
 Cache-pressure behavior: an allocation the pool cannot cover raises the
 typed :class:`~.kvcache.CacheOverflow` (a ``DeadlineExceeded``
@@ -91,14 +104,20 @@ def tiny_lm_params(vocab=32, dim=16, seed=0):
     }
 
 
-def _lm_prefill(params, k_pages, v_pages, tokens, length, table):
-    """Built-in prefill body (batch 1, bucketed prompt length).
+def _lm_prefill(params, k_pages, v_pages, tokens, start, length, table):
+    """Built-in prefill body (batch 1, bucketed prompt chunk).
 
-    ``tokens (L,) i32`` bucket-padded prompt; ``length () i32`` real
-    length; ``table (MB,) i32`` the sequence's block table padded with
-    the null block. Writes K/V for positions ``0..length-1`` (padding
-    rows scatter into the null block), attends the last real token over
-    ``pos < length``, returns ``(next_id, k_pages, v_pages)``."""
+    ``tokens (L,) i32`` bucket-padded prompt chunk; ``start () i32``
+    global position of the chunk's first token; ``length () i32`` real
+    tokens in the chunk; ``table (MB,) i32`` the sequence's block table
+    padded with the null block. Writes K/V for global positions
+    ``start..start+length-1`` (padding rows scatter into the null
+    block), attends the chunk's last real token over
+    ``pos < start + length``, returns ``(next_id, k_pages, v_pages)``.
+    Whole-prompt prefill is the ``start=0`` call; chunked prefill calls
+    the SAME bucket program with advancing ``start`` — bit-identical
+    because masked lanes contribute exactly 0 and every attended
+    position already holds its final K/V bits."""
     import jax
     import jax.numpy as jnp
     emb, w_k, w_v, w_out = (params["emb"], params["w_k"],
@@ -109,8 +128,9 @@ def _lm_prefill(params, k_pages, v_pages, tokens, length, table):
     x = emb[tokens]                                     # (L, D)
     k = x @ w_k
     v = x @ w_v
-    pos = jnp.arange(tokens.shape[0], dtype=jnp.int32)
-    blk = jnp.where(pos < length, table[pos // bs], NULL_BLOCK)
+    idx = jnp.arange(tokens.shape[0], dtype=jnp.int32)
+    pos = jnp.clip(start + idx, 0, mb * bs - 1)
+    blk = jnp.where(idx < length, table[pos // bs], NULL_BLOCK)
     k_pages = k_pages.at[blk, pos % bs].set(k)
     v_pages = v_pages.at[blk, pos % bs].set(v)
     x_last = jnp.take(x, length - 1, axis=0)            # (D,)
@@ -118,7 +138,7 @@ def _lm_prefill(params, k_pages, v_pages, tokens, length, table):
     vs = v_pages[table].reshape(mb * bs, dim)
     tpos = jnp.arange(mb * bs, dtype=jnp.int32)
     scores = (ks @ x_last) * (1.0 / math.sqrt(dim))
-    scores = jnp.where(tpos < length, scores, _MASKED)
+    scores = jnp.where(tpos < start + length, scores, _MASKED)
     ctx = jax.nn.softmax(scores) @ vs
     next_id = jnp.argmax(ctx @ w_out).astype(jnp.int32)
     return next_id, k_pages, v_pages
@@ -189,6 +209,9 @@ class DecodeStream:
         self.submitted_t = time.monotonic()
         self.first_token_t = None
         self.last_token_t = None
+        # positions with K/V on device; None while prefill is still in
+        # flight — the step loop must not see a mid-prefill sequence
+        self._cached = None
 
     def _emit(self, token):
         with self._cond:
@@ -269,6 +292,19 @@ class DecodeEngine:
     default_deadline_ms : float or None
         Deadline applied when ``submit`` passes none
         (``MXNET_SERVING_DECODE_DEADLINE_MS``; unset/0 = no deadline).
+    kv_shape : tuple of int or None
+        Trailing page dims beyond ``(num_blocks, block_size)``; default
+        ``(model_dim,)``. The transformer family uses
+        ``(num_layers, d_model)``.
+    prefill_chunk : int or None
+        Chunked-prefill piece size
+        (``MXNET_SERVING_DECODE_PREFILL_CHUNK``; 0 disables). Resolved
+        DOWN to a prefill bucket so chunk programs reuse the family.
+    mesh / kv_shard_axis : jax.sharding.Mesh or None / str
+        When given, K/V pools are placed with
+        :func:`~.kvcache.page_sharding` (trailing model dim sharded
+        over ``kv_shard_axis`` when divisible — heads, for the
+        transformer layout) and params are replicated on the mesh.
 
     All env vars are read once here — never per step (zero-overhead
     contract). ``warmup=True`` AOT-compiles the full program family at
@@ -279,8 +315,9 @@ class DecodeEngine:
                  block_size=None, num_blocks=None, batch_size=None,
                  max_seq_len=None, prefill_buckets=None,
                  default_deadline_ms=_MISSING, default_max_new=None,
-                 prefill_fn=None, step_fn=None, warmup=True,
-                 autostart=True):
+                 prefill_fn=None, step_fn=None, kv_shape=None,
+                 prefill_chunk=None, mesh=None, kv_shard_axis="tp",
+                 warmup=True, autostart=True):
         import jax
         import jax.numpy as jnp
         from ..compile.builder import ProgramBuilder
@@ -308,6 +345,9 @@ class DecodeEngine:
                 default_deadline_ms = None
         if default_max_new is None:
             default_max_new = get_env("MXNET_SERVING_DECODE_MAX_NEW", 32, int)
+        if prefill_chunk is None:
+            prefill_chunk = get_env("MXNET_SERVING_DECODE_PREFILL_CHUNK",
+                                    0, int)
         self.batch_size = int(batch_size)
         self.max_seq_len = int(max_seq_len)
         self.prefill_buckets = tuple(b for b in prefill_buckets
@@ -315,16 +355,41 @@ class DecodeEngine:
                                          self.max_seq_len,)
         self.default_deadline_ms = default_deadline_ms
         self.default_max_new = int(default_max_new)
+        # chunked prefill: resolve the requested chunk DOWN to a bucket
+        # so chunk programs come from the existing prefill family and
+        # program_count stays len(buckets) + 1. 0 disables chunking.
+        cands = [b for b in self.prefill_buckets if b <= int(prefill_chunk)]
+        self.prefill_chunk = cands[-1] if (int(prefill_chunk) > 0
+                                           and cands) else 0
 
         self._kv = PagedKVCache(num_blocks, block_size)
         self._mb = self._kv.blocks_for(self.max_seq_len)  # table width
-        dim = int(params["emb"].shape[1]) if "emb" in params else int(
-            next(iter(params.values())).shape[-1])
+        if kv_shape is None:
+            dim = int(params["emb"].shape[1]) if "emb" in params else int(
+                next(iter(params.values())).shape[-1])
+            kv_shape = (dim,)
         self._params = jax.device_put(
-            {k: jnp.asarray(v) for k, v in params.items()})
-        self._k_pages = jnp.zeros((self._kv.num_blocks, self._kv.block_size,
-                                   dim), jnp.float32)
+            jax.tree_util.tree_map(jnp.asarray, params))
+        self._k_pages = jnp.zeros(
+            (self._kv.num_blocks, self._kv.block_size)
+            + tuple(int(d) for d in kv_shape), jnp.float32)
         self._v_pages = jnp.zeros_like(self._k_pages)
+        # tp-shardable KV pages: place the pools (and replicate params)
+        # on the mesh; the trailing model dim shards across kv_shard_axis
+        # when divisible (kvcache.page_sharding), so multi-head K/V —
+        # heads folded into the trailing dim — shards by head.
+        self._page_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from .kvcache import page_sharding
+            self._page_sharding = page_sharding(
+                mesh, self._k_pages.shape, kv_shard_axis)
+            self._params = jax.device_put(
+                self._params, NamedSharding(mesh, PartitionSpec()))
+            self._k_pages = jax.device_put(self._k_pages,
+                                           self._page_sharding)
+            self._v_pages = jax.device_put(self._v_pages,
+                                           self._page_sharding)
         # pages are consumed and replaced every call — donate them back
         # to XLA where the backend supports it (not host CPU)
         donate = (1, 2) if _donate_supported() else ()
@@ -342,7 +407,7 @@ class DecodeEngine:
         self._rid_ctr = 0
         self._counters = {"submitted": 0, "served": 0, "shed": 0,
                           "failed": 0, "tokens": 0, "prefills": 0,
-                          "steps": 0, "cache_oom": 0}
+                          "prefill_chunks": 0, "steps": 0, "cache_oom": 0}
         self._lat_step = "decode.%s.step" % name
         self._lat_ttft = "decode.%s.ttft" % name
         self._lat_tok = "decode.%s.intertoken" % name
@@ -363,11 +428,15 @@ class DecodeEngine:
         import numpy as np
         i32 = np.int32
         sd = jax.ShapeDtypeStruct
-        pages = sd(self._k_pages.shape, self._k_pages.dtype)
+        if self._page_sharding is not None:
+            pages = sd(self._k_pages.shape, self._k_pages.dtype,
+                       sharding=self._page_sharding)
+        else:
+            pages = sd(self._k_pages.shape, self._k_pages.dtype)
         for bucket in self.prefill_buckets:
             self._prefill_b.aot_info(
                 self._params, pages, pages, sd((bucket,), i32),
-                sd((), i32), sd((self._mb,), i32), mode="aot")
+                sd((), i32), sd((), i32), sd((self._mb,), i32), mode="aot")
         b, mb = self.batch_size, self._mb
         self._step_b.aot_info(
             self._params, pages, pages, sd((b,), i32), sd((b,), i32),
@@ -398,10 +467,13 @@ class DecodeEngine:
         prompt = [int(t) for t in flat]
         if not prompt:
             raise ValueError("empty prompt")
-        if self._bucket_for(len(prompt)) is None:
+        if self._bucket_for(len(prompt)) is None and not (
+                self.prefill_chunk and len(prompt) < self.max_seq_len):
             raise ValueError(
                 "prompt of %d tokens exceeds the largest prefill bucket "
-                "(%d)" % (len(prompt), self.prefill_buckets[-1]))
+                "(%d) and chunked prefill is disabled "
+                "(MXNET_SERVING_DECODE_PREFILL_CHUNK)"
+                % (len(prompt), self.prefill_buckets[-1]))
         if max_new_tokens is None:
             max_new_tokens = self.default_max_new
         max_new_tokens = min(int(max_new_tokens),
@@ -548,26 +620,55 @@ class DecodeEngine:
         self._finish(stream, error)
 
     def _prefill_one(self, stream):
-        """Run the bucketed prefill program for one admitted sequence
-        and emit its first token (device call — outside ``_cv``)."""
+        """Run the bucketed prefill program(s) for one admitted sequence
+        and emit its first token (device calls — outside ``_cv``).
+
+        Chunked prefill: when ``prefill_chunk`` is set and the prompt is
+        longer, the prompt runs as chunk-bucket-sized pieces through the
+        SAME program family, and one continuous-batching step runs for
+        the other active sequences between pieces — a long prompt no
+        longer stalls the step loop. The sequence stays invisible to the
+        step loop until its last piece lands (``_cached`` is None), and
+        per-chunk deadline checks shed typed mid-prefill."""
         prompt = stream.prompt
-        bucket = self._bucket_for(len(prompt))
-        toks = _np.zeros((bucket,), _np.int32)
-        toks[:len(prompt)] = prompt
+        chunk = self.prefill_chunk
+        if chunk and len(prompt) > chunk:
+            pieces = [prompt[i:i + chunk]
+                      for i in range(0, len(prompt), chunk)]
+        else:
+            pieces = [prompt]
         table = _np.zeros((self._mb,), _np.int32)
         own = self._kv.table(stream.rid)
         table[:len(own)] = own
-        _faults.fault_point("decode.step", model=self.name, kind="prefill",
-                            rid=stream.rid)
-        try:
-            next_id, self._k_pages, self._v_pages = self._prefill_b(
-                self._params, self._k_pages, self._v_pages, toks,
-                _np.int32(len(prompt)), table)
-            tok = int(_np.asarray(next_id))  # tpulint: allow-host-sync sampled token feeds the next step and the reply stream; decode cannot proceed without it
-        except Exception as e:
-            self._evict(stream, e if isinstance(e, DeadlineExceeded)
-                        else RuntimeError("decode prefill failed: %s" % e))
-            return
+        start = 0
+        tok = None
+        for pi, piece in enumerate(pieces):
+            last = pi == len(pieces) - 1
+            if pi and stream.deadline is not None \
+                    and time.monotonic() > stream.deadline:
+                self._evict(stream, DeadlineExceeded(
+                    "decode %s: deadline exceeded mid-prefill after %d of "
+                    "%d prompt tokens" % (stream.rid, start, len(prompt))))
+                return
+            bucket = self._bucket_for(len(piece))
+            toks = _np.zeros((bucket,), _np.int32)
+            toks[:len(piece)] = piece
+            _faults.fault_point("decode.step", model=self.name,
+                                kind="prefill", rid=stream.rid)
+            try:
+                next_id, self._k_pages, self._v_pages = self._prefill_b(
+                    self._params, self._k_pages, self._v_pages, toks,
+                    _np.int32(start), _np.int32(len(piece)), table)
+                if last:
+                    tok = int(_np.asarray(next_id))  # tpulint: allow-host-sync sampled token feeds the next step and the reply stream; decode cannot proceed without it
+            except Exception as e:
+                self._evict(stream, e if isinstance(e, DeadlineExceeded)
+                            else RuntimeError(
+                                "decode prefill failed: %s" % e))
+                return
+            start += len(piece)
+            if not last:
+                self._decode_step()
         now = time.monotonic()
         stream.first_token_t = stream.last_token_t = now
         stream._cached = len(prompt)    # positions 0..len-1 hold K/V
@@ -576,6 +677,8 @@ class DecodeEngine:
         with self._cv:
             self._counters["prefills"] += 1
             self._counters["tokens"] += 1
+            if len(pieces) > 1:
+                self._counters["prefill_chunks"] += len(pieces)
         _prof.record_decode_event(prefills=1, tokens=1)
         stream._emit(tok)
         self._maybe_retire(stream, tok)
@@ -595,18 +698,25 @@ class DecodeEngine:
         per-token deadline enforcement, cache growth (typed shed on
         overflow), one fixed-shape step program call, distribution."""
         now = time.monotonic()
-        for seq in [s for s in self._slots if s is not None]:
+        # _cached is None while a sequence's prefill is still in flight
+        # (chunked prefill steps the loop between pieces) — such rows
+        # must be invisible here: no deadline eviction (the prefill loop
+        # owns it), no growth, no step slot.
+        for seq in [s for s in self._slots
+                    if s is not None and s._cached is not None]:
             if seq.deadline is not None and now > seq.deadline:
                 self._evict(seq, DeadlineExceeded(
                     "decode %s: deadline exceeded after %d tokens"
                     % (seq.rid, len(seq.tokens))))
-        for seq in [s for s in self._slots if s is not None]:
+        for seq in [s for s in self._slots
+                    if s is not None and s._cached is not None]:
             try:
                 # room for the token this step writes at position _cached
                 self._kv.extend(seq.rid, 1)
             except CacheOverflow as e:
                 self._evict(seq, e)
-        active = [s for s in self._slots if s is not None]
+        active = [s for s in self._slots
+                  if s is not None and s._cached is not None]
         if not active:
             return
         b, mb = self.batch_size, self._mb

@@ -33,10 +33,32 @@ from __future__ import annotations
 
 from .batcher import DeadlineExceeded
 
-__all__ = ["PagedKVCache", "CacheOverflow", "NULL_BLOCK"]
+__all__ = ["PagedKVCache", "CacheOverflow", "NULL_BLOCK", "page_sharding"]
 
 #: Block id reserved for padding/inactive scatter targets. Never allocated.
 NULL_BLOCK = 0
+
+
+def page_sharding(mesh, page_shape, axis_name="tp"):
+    """NamedSharding for a KV page pool on ``mesh``: shard the trailing
+    model dim over ``axis_name`` when the axis exists, is wider than one
+    device, and divides the dim — else fully replicated.
+
+    The transformer page layout folds heads into the trailing
+    ``d_model`` dim (``(num_blocks, block_size, num_layers, d_model)``),
+    so tp-sharding the trailing dim is head sharding: each tp shard
+    holds every sequence's block table but only its own heads' K/V —
+    the standard tensor-parallel attention split, with block tables and
+    the blocks/slots axes replicated so host-side paging stays
+    tier-agnostic."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = PartitionSpec()
+    if axis_name in getattr(mesh, "axis_names", ()):
+        size = int(mesh.shape[axis_name])
+        if size > 1 and int(page_shape[-1]) % size == 0:
+            spec = PartitionSpec(*([None] * (len(page_shape) - 1)
+                                   + [axis_name]))
+    return NamedSharding(mesh, spec)
 
 
 class CacheOverflow(DeadlineExceeded):

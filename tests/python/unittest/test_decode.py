@@ -212,6 +212,132 @@ class TestDecodeEngine:
         assert eng.stats()["submitted"] == 0    # nothing counted
         eng.stop()
 
+    def test_chunked_prefill_bit_identical_and_flat_programs(self):
+        """Chunked prefill (ISSUE 19): same outputs as whole-prompt
+        prefill, programs stay len(buckets)+1, long prompts beyond the
+        largest bucket become admissible, chunks are counted."""
+        prompts = [[3, 1, 4], [1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9],
+                   [5] * 16, [2, 7]]
+        whole = _engine(name="ckw")
+        ref = [whole.generate(p, max_new_tokens=6) for p in prompts]
+        whole.stop()
+        eng = _engine(name="ckc", prefill_chunk=8)
+        out = [eng.generate(p, max_new_tokens=6) for p in prompts]
+        assert out == ref, "chunked prefill changed decode output"
+        # beyond the largest bucket (16) — only admissible chunked
+        long_out = eng.generate(list(range(1, 31)), max_new_tokens=4)
+        assert len(long_out) == 4
+        assert eng.program_counts() == (2, 1)
+        st = eng.stats()
+        assert st["prefill_chunks"] > 0
+        assert st["submitted"] == st["served"]
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# transformer decode body (models/transformer.py, ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def _tf_model(flash="off"):
+    from mxnet_tpu.models.transformer import (TransformerConfig,
+                                              TransformerDecodeModel)
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                            d_model=32, max_len=64, block_k=16)
+    return TransformerDecodeModel(cfg, flash=flash)
+
+
+def _tf_engine(model, name, **kw):
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("batch_size", 3)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("prefill_buckets", (8, 16))
+    return DecodeEngine(model.params, name=name, kv_shape=model.kv_shape,
+                        prefill_fn=model.prefill_fn,
+                        step_fn=model.step_fn, **kw)
+
+
+class TestTransformerDecode:
+    PROMPTS = [[3, 1, 4], [1, 5, 9, 2, 6], [5, 3], [8, 9, 7, 9, 3, 2],
+               [2, 7, 1, 8, 2, 8], [1], [4, 4, 4, 4]]
+    BUDGETS = [6, 9, 4, 12, 7, 10, 5]
+
+    def test_continuous_matches_solo_multilayer(self):
+        """The acceptance bit on the REAL model: multi-layer multi-head
+        decode under continuous batching (batch 3 < 7 prompts forces
+        join/leave churn) is bit-identical per sequence to solo."""
+        model = _tf_model()
+        solo_eng = _tf_engine(model, "tfsolo")
+        solo = [solo_eng.generate(p, max_new_tokens=m)
+                for p, m in zip(self.PROMPTS, self.BUDGETS)]
+        solo_eng.stop()
+        cont = _tf_engine(model, "tfcont")
+        streams = []
+        for i, (p, m) in enumerate(zip(self.PROMPTS, self.BUDGETS)):
+            streams.append(cont.submit(p, max_new_tokens=m))
+            if i % 3 == 2:
+                time.sleep(0.02)
+        outs = [s.result_wait(120.0) for s in streams]
+        assert outs == solo, "continuous transformer decode != solo"
+        assert cont.program_counts() == (2, 1)
+        assert cont.stats()["kv"]["blocks_live"] == 0
+        cont.stop()
+
+    def test_chunked_prefill_matches_whole_prompt(self):
+        model = _tf_model()
+        whole = _tf_engine(model, "tfw")
+        ref = [whole.generate(p, max_new_tokens=m)
+               for p, m in zip(self.PROMPTS, self.BUDGETS)]
+        whole.stop()
+        chunked = _tf_engine(model, "tfc", prefill_chunk=8)
+        out = [chunked.generate(p, max_new_tokens=m)
+               for p, m in zip(self.PROMPTS, self.BUDGETS)]
+        assert out == ref, "chunked transformer prefill changed output"
+        # long prompt beyond the largest bucket decodes chunked
+        long_out = chunked.generate([7] * 30, max_new_tokens=4)
+        assert len(long_out) == 4
+        assert chunked.program_counts() == (2, 1)
+        chunked.stop()
+
+    def test_flash_interpret_tier_matches_lax_tier_tokens(self):
+        """The flash-kernel prefill path (interpret tier off-TPU, the
+        _flash_fwd_offs_kernel block-table variant reading paged KV)
+        produces the same token stream as the lax tier."""
+        lax = _tf_model(flash="off")
+        assert lax.flash_engaged is False
+        flash = _tf_model(flash="interpret")
+        assert flash.flash_engaged is True
+        prompts, budgets = self.PROMPTS[:4], self.BUDGETS[:4]
+        le = _tf_engine(lax, "tflax")
+        ref = [le.generate(p, max_new_tokens=m)
+               for p, m in zip(prompts, budgets)]
+        le.stop()
+        fe = _tf_engine(flash, "tfflash")
+        out = [fe.generate(p, max_new_tokens=m)
+               for p, m in zip(prompts, budgets)]
+        fe.stop()
+        assert out == ref, "flash-tier transformer decode diverged"
+
+    def test_mesh_placed_pages_do_not_change_tokens(self):
+        """tp-sharded KV pages (kvcache.page_sharding): placement is a
+        layout choice, not a numeric one."""
+        from mxnet_tpu.parallel import get_mesh
+        from mxnet_tpu.serving.kvcache import page_sharding
+        model = _tf_model()
+        mesh = get_mesh(dp=2, tp=4)
+        ps = page_sharding(mesh, (64, 16, 2, 32), "tp")
+        assert ps.spec[-1] == "tp"      # d_model (heads) sharded
+        # indivisible trailing dim stays replicated
+        assert page_sharding(mesh, (64, 16, 2, 30), "tp").spec == \
+            type(ps.spec)()
+        plain = _tf_engine(model, "tfpl")
+        ref = [plain.generate(p, max_new_tokens=6) for p in self.PROMPTS[:3]]
+        plain.stop()
+        placed = _tf_engine(model, "tfms", mesh=mesh)
+        out = [placed.generate(p, max_new_tokens=6)
+               for p in self.PROMPTS[:3]]
+        assert out == ref
+        placed.stop()
+
 
 # ---------------------------------------------------------------------------
 # streaming over the wire

@@ -1,0 +1,245 @@
+"""Mesh-grade kernel tier (ISSUE 19): the Pallas tier engages INSIDE
+dp×tp meshes via shard_map islands instead of falling back to lax.
+
+The contracts under test (conftest gives every test 8 host devices):
+  * tier resolution — MXNET_TPU_MESH_KERNEL_TIER vocabulary is total:
+    auto / on / off / interpret map correctly and a typo RAISES (a tier
+    knob silently degrading to lax is the failure mode this kills);
+  * flash attention: mesh-sharded vs solo is BITWISE within each tier
+    (the island computes the same per-shard program), and the
+    interpret-kernel tier matches lax to fp tolerance fwd AND bwd,
+    causal and padded-block shapes (the PR 6 recipe — the two tiers
+    have different reduction orders, so allclose is the contract);
+  * fused optimizer update: the dp-sharded island (kernel tier,
+    interpret) is BITWISE identical to the replicated lax sweep under
+    jit, for sgd and adam with the full prologue (rescale/clip/wd) —
+    including the ZeRO `apply_update_sharded` path with the tier knobs;
+  * roofline accounting: per-axis byte counters exist for both kernels
+    and shrink along the sharded axes;
+  * require_kernel=True (the CI engagement gate) raises when the tier
+    resolves to lax instead of silently falling back.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kernels.flash_attention import flash_attention
+from mxnet_tpu.kernels.opt_update import fused_update_step
+from mxnet_tpu.parallel import (get_mesh, resolve_kernel_tier,
+                                kernel_tier_mode, flash_attention_mesh,
+                                fused_update_mesh, apply_update_sharded,
+                                init_opt_state, ZeroShardLayout)
+from mxnet_tpu.parallel.mesh_kernels import (flash_mesh_roofline,
+                                             optupdate_mesh_roofline)
+
+
+def _bits(tree):
+    """Leaf-wise byte views — bitwise comparison across pytrees."""
+    return [np.asarray(x).reshape(-1).view(np.uint8)
+            for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _assert_bitwise(a, b, msg=""):
+    for xa, xb in zip(_bits(a), _bits(b)):
+        np.testing.assert_array_equal(xa, xb, err_msg=msg)
+
+
+def _qkv(b=4, h=4, s=64, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((b, h, s, d)),
+                             jnp.float32)
+    return mk(), mk(), mk()
+
+
+# ---------------------------------------------------------------------------
+# tier resolution
+# ---------------------------------------------------------------------------
+
+class TestTierResolution:
+    def test_vocabulary(self, monkeypatch):
+        monkeypatch.delenv("MXNET_TPU_MESH_KERNEL_TIER", raising=False)
+        assert kernel_tier_mode() == "auto"
+        assert resolve_kernel_tier("off") == (False, False)
+        assert resolve_kernel_tier("0") == (False, False)
+        assert resolve_kernel_tier("lax") == (False, False)
+        assert resolve_kernel_tier("on") == (True, False)
+        assert resolve_kernel_tier("1") == (True, False)
+        assert resolve_kernel_tier("pallas") == (True, False)
+        assert resolve_kernel_tier("interpret") == (False, True)
+        # auto follows the platform default — a bool either way, and
+        # never the interpret tier
+        up, it = resolve_kernel_tier("auto")
+        assert isinstance(up, bool) and it is False
+
+    def test_env_is_the_default_and_typos_raise(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TPU_MESH_KERNEL_TIER", "interpret")
+        assert kernel_tier_mode() == "interpret"
+        assert resolve_kernel_tier() == (False, True)
+        monkeypatch.setenv("MXNET_TPU_MESH_KERNEL_TIER", "fastplz")
+        with pytest.raises(MXNetError):
+            resolve_kernel_tier()
+
+
+# ---------------------------------------------------------------------------
+# flash attention on the mesh
+# ---------------------------------------------------------------------------
+
+class TestFlashMeshTier:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_mesh_bitwise_vs_solo_within_each_tier(self, causal):
+        """Sharding must not change bits: the dp×tp island runs the
+        exact per-shard program of the solo call, for BOTH tiers."""
+        mesh = get_mesh(dp=2, tp=2, sp=2)
+        q, k, v = _qkv()
+        for use_pallas, interpret in ((False, False), (False, True)):
+            solo = flash_attention(q, k, v, causal=causal, block_q=32,
+                                   block_k=32, use_pallas=use_pallas,
+                                   interpret=interpret)
+            sharded = flash_attention_mesh(
+                q, k, v, mesh, causal=causal, block_q=32, block_k=32,
+                use_pallas=use_pallas, interpret=interpret)
+            _assert_bitwise(solo, sharded,
+                            "tier (%s,%s) causal=%s" % (use_pallas,
+                                                        interpret, causal))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_kernel_vs_lax_fwd_bwd_parity(self, causal):
+        """Cross-tier: interpret kernel vs lax to fp tolerance, forward
+        and backward, on the mesh path (the PR 6 parity recipe)."""
+        mesh = get_mesh(dp=2, tp=2, sp=2)
+        q, k, v = _qkv(b=2, h=2, s=32, d=16, seed=1)
+
+        def loss(tier):
+            up, it = tier
+
+            def f(q, k, v):
+                o = flash_attention_mesh(q, k, v, mesh, causal=causal,
+                                         block_q=16, block_k=16,
+                                         use_pallas=up, interpret=it)
+                return (o * o).sum()
+            return f
+
+        lax_val, lax_grads = jax.value_and_grad(
+            loss((False, False)), argnums=(0, 1, 2))(q, k, v)
+        ker_val, ker_grads = jax.value_and_grad(
+            loss((False, True)), argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(lax_val, ker_val, rtol=2e-5)
+        for g_lax, g_ker in zip(lax_grads, ker_grads):
+            np.testing.assert_allclose(np.asarray(g_lax),
+                                       np.asarray(g_ker), atol=1e-4)
+
+    def test_padded_block_shapes_take_the_kernel(self):
+        """Sequence shorter than the block: block sizes clamp and the
+        kernel still engages (padded-shape case of the parity suite)."""
+        mesh = get_mesh(dp=2, tp=2, sp=2)
+        q, k, v = _qkv(b=2, h=2, s=16, d=16, seed=2)
+        out_k = flash_attention_mesh(q, k, v, mesh, causal=True,
+                                     block_q=512, block_k=512,
+                                     interpret=True, require_kernel=True)
+        out_l = flash_attention_mesh(q, k, v, mesh, causal=True,
+                                     use_pallas=False, interpret=False)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_l),
+                                   atol=1e-5)
+
+    def test_require_kernel_raises_on_lax_fallback(self):
+        mesh = get_mesh(dp=2, tp=2, sp=2)
+        q, k, v = _qkv(b=2, h=2, s=16, d=16)
+        with pytest.raises(MXNetError, match="kernel tier"):
+            flash_attention_mesh(q, k, v, mesh, use_pallas=False,
+                                 interpret=False, require_kernel=True)
+
+    def test_roofline_shrinks_along_mesh_axes(self):
+        mesh = get_mesh(dp=4, tp=2)
+        rf = flash_mesh_roofline((8, 8, 128, 64), mesh)
+        assert rf["ideal_bytes"] > 0
+        assert rf["per_axis"]["dp"]["size"] == 4
+        assert rf["per_axis"]["tp"]["size"] == 2
+        assert rf["per_axis"]["dp"]["bytes_per_shard"] * 4 == \
+            rf["ideal_bytes"]
+        assert rf["per_axis"]["tp"]["bytes_per_shard"] * 2 == \
+            rf["ideal_bytes"]
+        assert rf["bytes_per_device"] * 8 == rf["ideal_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer update on the mesh
+# ---------------------------------------------------------------------------
+
+def _opt_fixture(opt, seed=3):
+    rng = np.random.RandomState(seed)
+    # one kernel-eligible leaf (chunk >= 1024 after dp split) + one
+    # small ragged leaf that pads — both paths in one sweep
+    params = {"w": jnp.asarray(rng.standard_normal(16384), jnp.float32),
+              "b": jnp.asarray(rng.standard_normal(153), jnp.float32)}
+    grads = {"w": jnp.asarray(rng.standard_normal(16384), jnp.float32),
+             "b": jnp.asarray(rng.standard_normal(153), jnp.float32)}
+    state = init_opt_state(opt, params,
+                           momentum=0.9 if opt == "sgd" else 0.0)
+    if opt == "adam":
+        hp = {"lr": jnp.float32(0.003), "beta1": 0.9, "beta2": 0.999,
+              "eps": 1e-8}
+    else:
+        hp = {"lr": jnp.float32(0.05), "momentum": 0.9}
+    return params, state, grads, hp
+
+
+class TestFusedUpdateMeshTier:
+    @pytest.mark.parametrize("opt", ["sgd", "adam"])
+    def test_island_kernel_tier_bitwise_vs_replicated_lax(self, opt):
+        """The acceptance bit: dp-sharded island + interpret kernel ==
+        replicated lax sweep, BITWISE, under jit (both real steps jit —
+        eager fuses differently and is out of contract)."""
+        mesh = get_mesh(dp=4, tp=2)
+        params, state, grads, hp = _opt_fixture(opt)
+        kw = dict(rescale=1.0 / 32, clip=1.0, wd=1e-4)
+
+        ref = jax.jit(lambda p, s, g: fused_update_step(
+            opt, hp, p, s, g, use_pallas=False, **kw))(params, state, grads)
+        island = jax.jit(lambda p, s, g: fused_update_mesh(
+            opt, hp, p, s, g, mesh, "dp", interpret=True, **kw))(
+                params, state, grads)
+        _assert_bitwise(ref, island, "fused mesh island, opt=%s" % opt)
+
+    def test_zero_path_kernel_tier_bitwise(self):
+        """apply_update_sharded with the tier knobs: ZeRO island +
+        interpret kernel == ZeRO island + lax, bitwise under jit."""
+        mesh = get_mesh(dp=4, tp=2)
+        params, _, grads, hp = _opt_fixture("adam", seed=4)
+        layout = ZeroShardLayout.from_params(params, dp=4)
+        state = init_opt_state("adam", params, layout=layout)
+        kw = dict(rescale=1.0, clip=None, wd=0.0, fused=True)
+
+        lax_out = jax.jit(lambda p, s, g: apply_update_sharded(
+            "adam", hp, p, s, g, layout, mesh, use_pallas=False, **kw))(
+                params, state, grads)
+        ker_out = jax.jit(lambda p, s, g: apply_update_sharded(
+            "adam", hp, p, s, g, layout, mesh, use_pallas=False,
+            interpret=True, **kw))(params, state, grads)
+        _assert_bitwise(lax_out, ker_out, "ZeRO island tier parity")
+
+    def test_degenerate_mesh_falls_through_to_plain_step(self):
+        mesh = get_mesh(dp=1, tp=8)
+        params, state, grads, hp = _opt_fixture("sgd", seed=5)
+        ref = jax.jit(lambda p, s, g: fused_update_step(
+            "sgd", hp, p, s, g, use_pallas=False))(params, state, grads)
+        out = jax.jit(lambda p, s, g: fused_update_mesh(
+            "sgd", hp, p, s, g, mesh, "dp", interpret=True))(
+                params, state, grads)
+        _assert_bitwise(ref, out, "dp=1 fallthrough")
+
+    def test_roofline_per_axis(self):
+        mesh = get_mesh(dp=4, tp=2)
+        params, state, _, _ = _opt_fixture("adam", seed=6)
+        rf = optupdate_mesh_roofline("adam", params, mesh,
+                                     opt_state=state)
+        assert rf["ideal_bytes"] > 0
+        dp_ax = rf["per_axis"]["dp"]
+        assert dp_ax["size"] == 4
+        # per-shard bytes: ~total/dp, padding may round up slightly
+        assert dp_ax["bytes_per_shard"] >= rf["ideal_bytes"] // 4
+        assert dp_ax["bytes_per_shard"] < rf["ideal_bytes"]

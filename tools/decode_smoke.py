@@ -17,7 +17,13 @@ Fast, non-slow gate over the decode serving tier:
     compiled programs after all traffic (the steady-state loop never
     recompiles), the paged allocator drains back to zero live blocks,
     and `submitted == served + shed + failed` holds gateway-side with
-    the whole stream counted as ONE request.
+    the whole stream counted as ONE request;
+  * the REAL transformer decode body (ISSUE 19) on the 8-device mesh:
+    the flash kernel tier must ENGAGE (interpret off-TPU — asserted,
+    never a silent lax fallback), chunked prefill must admit a
+    past-the-bucket prompt, and the flash-tier engine with tp-sharded
+    KV pages must stream tokens identical to the lax-tier solo engine,
+    at the same flat program family.
 
 Prints one JSON summary line; non-zero exit on any violated contract.
 The companion lint half of the stage (tpulint over mxnet_tpu/serving)
@@ -31,6 +37,14 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the transformer-decode section shards KV pages over a dp×tp mesh:
+# force the 8-device host platform unless the caller already did
+# (ci/run.py passes cpu_mesh_env(8); standalone runs get it here)
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
 
 from mxnet_tpu.serving import (ModelServer, ServingFrontDoor,  # noqa: E402
                                DecodeEngine, tiny_lm_params)
@@ -146,8 +160,56 @@ def main():
     n_toks = sum(len(t) for rep in reports for t in rep["outs"])
     assert fs["stream_frames"] >= n_toks, fs
 
+    # --- transformer decode on the 8-device mesh (ISSUE 19) ------------
+    # the real multi-layer multi-head body: kernel tier must ENGAGE
+    # (interpret off-TPU), chunked prefill must admit a past-the-bucket
+    # prompt, and the flash-tier engine with tp-sharded KV pages must
+    # stream the SAME tokens as the lax-tier solo engine.
+    from mxnet_tpu.models.transformer import (TransformerConfig,
+                                              TransformerDecodeModel)
+    from mxnet_tpu.parallel import get_mesh
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=4,
+                            d_model=32, max_len=64, block_k=16)
+    flash_model = TransformerDecodeModel(cfg, seed=0, flash="interpret")
+    assert flash_model.flash_engaged, \
+        "kernel tier did not engage (interpret off-TPU) — transformer " \
+        "prefill would silently run the lax tier"
+    lax_model = TransformerDecodeModel(cfg, params=flash_model.params,
+                                       flash="off")
+    assert not lax_model.flash_engaged
+    mesh = get_mesh(dp=2, tp=4)
+    tf_eng = DecodeEngine(name="tf", num_blocks=64, batch_size=3,
+                          max_seq_len=64, prefill_buckets=(8, 16),
+                          prefill_chunk=8, mesh=mesh,
+                          **flash_model.engine_kwargs())
+    ref_eng = DecodeEngine(name="tf_ref", num_blocks=64, batch_size=3,
+                           max_seq_len=64, prefill_buckets=(8, 16),
+                           prefill_chunk=8, **lax_model.engine_kwargs())
+    tf_prompts = [[(7 * i + j) % 63 + 1 for j in range(3 + 2 * i)]
+                  for i in range(5)]
+    tf_prompts.append([5] * 20)       # past the largest bucket: only the
+    #                                   chunked path can admit it
+    sts = [tf_eng.submit(p, max_new_tokens=6) for p in tf_prompts]
+    tf_outs = [s.result_wait(180.0) for s in sts]
+    for p, got in zip(tf_prompts, tf_outs):
+        want = ref_eng.generate(p, max_new_tokens=6, timeout=180.0)
+        assert got == want, \
+            "flash-tier mesh engine diverged from lax solo: %r -> %r " \
+            "!= %r" % (p, got, want)
+    st_tf = tf_eng.stats()
+    assert st_tf["programs"] == {"prefill": 2, "step": 1}, st_tf
+    assert st_tf["prefill_chunks"] > 0, st_tf
+    assert st_tf["kv"]["blocks_live"] == 0, st_tf["kv"]
+    tf_eng.stop()
+    ref_eng.stop()
+
     summary = {
         "clients": reports,
+        "transformer": {"flash_engaged": True,
+                        "prefill_chunks": st_tf["prefill_chunks"],
+                        "programs": st_tf["programs"],
+                        "mesh": {"dp": 2, "tp": 4},
+                        "sequences": len(tf_prompts)},
         "frontdoor": {k: v for k, v in fs.items() if v},
         "lm": {"counters": {k: v for k, v in st_lm.items()
                             if isinstance(v, int) and v},

@@ -10,6 +10,12 @@ Fast, non-slow gate over the cross-HOST serving tier:
     server-side submitted == served + shed + failed, requests reroute
     (dispatch_retries > 0 or all served locally), and the fleet marks
     the host SUSPECT/DEAD;
+  * int8 over the fleet (ISSUE 19): a worker built via
+    `--builder fleet_worker_fixture:build_int8` serves the QUANTIZED
+    engine — `int8_mode: native-int8` read off the traced jaxpr in the
+    process that owns it, int8 weights device-resident, one distinct
+    ProgramBuilder key per bucket, remote predictions bit-identical to
+    the gateway's same-seed int8 twin;
   * auth gate: with a shared MXNET_SERVING_AUTH_KEY a tampered frame is
     rejected BEFORE unpickling and counted (auth_rejected), while the
     keyed round trip stays bit-exact;
@@ -51,11 +57,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import fleet_worker_fixture as fx  # noqa: E402
 
 
-def _spawn_worker(port, wid):
-    return subprocess.Popen(
-        [sys.executable,
-         os.path.join(ROOT, "tools", "fleet_worker_fixture.py"),
-         str(port), wid])
+def _spawn_worker(port, wid, flavor=None):
+    argv = [sys.executable,
+            os.path.join(ROOT, "tools", "fleet_worker_fixture.py"),
+            str(port), wid]
+    if flavor:
+        argv.append(flavor)
+    return subprocess.Popen(argv)
 
 
 def _wait(cond, timeout, what):
@@ -153,6 +161,62 @@ def main():
         if proc.poll() is None:
             proc.kill()
         proc.wait(timeout=15)
+
+    # --- quantized engine over the fleet (ISSUE 19) -------------------
+    # the --builder path accepts an int8 engine: a worker process comes
+    # up via fleet_worker_fixture:build_int8 (which refuses to start
+    # unless its traced program classifies native-int8), and the gateway
+    # builds the bit-identical local twin from the same seed. Asserted
+    # here, in the process that owns each program: int8_mode off the
+    # jaxpr, int8 weights device-resident, and one DISTINCT program per
+    # bucket in the engine's ProgramBuilder cache (full keys carry
+    # operand dtypes, so int8 programs can never alias fp32 twins).
+    gw8 = ModelServer(dispatch_retries=3)
+    qsym, qargs = fx.quantized()
+    gw8.register(fx.MODEL_INT8, qsym, qargs, ctx=mx.cpu(),
+                 buckets=(1, 4), max_delay_ms=0.5,
+                 warmup_shapes={"data": fx.DATA_SHAPE})
+    stats8 = fx.int8_program_stats(gw8)
+    assert stats8["mode"] == "native-int8", \
+        "gateway int8 twin classifies %r: %r" % (stats8["mode"], stats8)
+    eng8 = gw8.engine(fx.MODEL_INT8)
+    qnames = [n for n in eng8._params if n.endswith("_quantize")]
+    assert qnames and all(
+        np.dtype(eng8._params[n].dtype) == np.int8 for n in qnames), \
+        "int8 weights not device-resident as int8"
+    keys8 = list(eng8._cache._builder._programs)
+    assert len(keys8) == 2 and len(set(keys8)) == 2, \
+        "expected one distinct program per bucket, got %r" % (keys8,)
+    assert any("int8" in repr(k) for k in keys8), \
+        "program keys carry no int8 dtype: %r" % (keys8,)
+    pool8 = FleetPool(gw8, port=0, heartbeat_s=0.25,
+                      connect_deadline_s=1.0).start()
+    proc8 = _spawn_worker(pool8.port, "smoke-i8", flavor="int8")
+    try:
+        _wait(lambda: pool8.stats()["workers_alive"] >= 1, 90.0,
+              "int8 worker join (build_int8 gates native-int8 in the "
+              "worker process — a join timeout here usually means the "
+              "quantized replica refused to come up)")
+        x8 = np.arange(24, dtype=np.float32).reshape(4, 6) / 24.0
+        want8 = np.asarray(gw8.predict(fx.MODEL_INT8, {"data": x8})[0])
+        handle8 = pool8._workers["smoke-i8"]
+        rep8 = next(iter(handle8.replicas.values()))[0]
+        got8 = np.asarray(rep8.engine.predict_async(
+            {"data": x8}).result_wait(60.0)[0])
+        assert np.array_equal(got8, want8), \
+            "remote int8 replica diverged from the gateway's int8 twin"
+        summary["int8_fleet"] = {
+            "int8_mode": stats8["mode"],
+            "int8_contractions": {k: v for k, v in stats8.items()
+                                  if k != "mode"},
+            "bucket_programs": len(keys8),
+            "remote_bit_identical": True}
+    finally:
+        pool8.stop()
+        gw8.stop()
+        if proc8.poll() is None:
+            proc8.kill()
+        proc8.wait(timeout=15)
 
     # --- auth gate: tampered frame rejected before unpickling ---------
     key = "smoke-auth-key"

@@ -25,6 +25,7 @@ import numpy as np  # noqa: E402
 import mxnet_tpu as mx  # noqa: E402
 
 MODEL = "fl"
+MODEL_INT8 = "fl_i8"
 INDIM = 6
 DATA_SHAPE = (4, INDIM)
 
@@ -59,10 +60,68 @@ def build(model=MODEL, ctx=None):
     return srv
 
 
-def run(gateway_port, worker_id, heartbeat_s=0.25):
+def quantized(prefix=MODEL_INT8, seed=0):
+    """(qsym, qargs): the int8 rewrite of the SAME tiny MLP — both FC
+    layers execute as ``_contrib_quantized_*`` ops on offline-folded
+    int8 weights. Deterministic (same seed as :func:`params`), so the
+    gateway can build a bit-identical local twin of a remote int8
+    replica."""
+    from mxnet_tpu.contrib.quantization import quantize_model
+    sym = net(prefix)
+    qsym, qargs, _aux, _th = quantize_model(
+        sym, params(sym, seed=seed), {}, data_names=("data",),
+        calib_mode="none")
+    return qsym, qargs
+
+
+def int8_program_stats(srv, model=MODEL_INT8, batch=DATA_SHAPE[0]):
+    """``inspect_int8_program`` over the jaxpr of the program the
+    replica actually serves — run IN the process that owns the engine
+    (the jaxpr never crosses the wire, so a fleet worker gates itself
+    at build time rather than shipping programs for remote audit)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.contrib import quantization as Q
+    eng = srv.engine(model)
+    arg_sds = {n: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+               for n, v in eng._params.items()}
+    for n in eng._input_names:
+        arg_sds[n] = jax.ShapeDtypeStruct(
+            (batch, INDIM) if n == "data" else (batch,), jnp.float32)
+    aux_sds = {n: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+               for n, v in eng._aux.items()}
+    jaxpr = jax.make_jaxpr(
+        lambda a, x: eng._exe._run_graph(a, x, jax.random.PRNGKey(0),
+                                         False))(arg_sds, aux_sds)
+    return Q.inspect_int8_program(jaxpr)
+
+
+def build_int8(model=MODEL_INT8, ctx=None):
+    """``--builder fleet_worker_fixture:build_int8`` — a warmed
+    ModelServer whose replica serves the QUANTIZED engine: int8 weights
+    staged device-resident, each bucket compiled to its own int8
+    program in the engine's ProgramBuilder cache (the full program key
+    carries operand dtypes, so int8 programs can never alias an fp32
+    twin's). Build-time gate: the traced program must classify
+    ``native-int8`` — a replica that silently fell back to f32
+    simulation refuses to come up rather than serve the wrong tier."""
+    from mxnet_tpu.serving import ModelServer
+    qsym, qargs = quantized(model)
+    srv = ModelServer()
+    srv.register(model, qsym, qargs, ctx=ctx or mx.cpu(),
+                 buckets=(1, 4), max_delay_ms=0.5,
+                 warmup_shapes={"data": DATA_SHAPE})
+    stats = int8_program_stats(srv, model)
+    assert stats["mode"] == "native-int8", \
+        "quantized fleet replica classifies %r, not native-int8: %r" \
+        % (stats["mode"], stats)
+    return srv
+
+
+def run(gateway_port, worker_id, heartbeat_s=0.25, builder=build):
     """The worker-process body: build, join, serve until drained."""
     from mxnet_tpu.serving import ReplicaWorker
-    worker = ReplicaWorker(("127.0.0.1", int(gateway_port)), build(),
+    worker = ReplicaWorker(("127.0.0.1", int(gateway_port)), builder(),
                            port=0, worker_id=worker_id,
                            heartbeat_s=heartbeat_s).start()
     worker._frontdoor.install_sigterm_drain()
@@ -72,4 +131,7 @@ def run(gateway_port, worker_id, heartbeat_s=0.25):
 
 
 if __name__ == "__main__":
-    run(sys.argv[1], sys.argv[2])
+    # optional 3rd arg selects the engine flavor: "int8" -> build_int8
+    _builder = (build_int8 if len(sys.argv) > 3 and sys.argv[3] == "int8"
+                else build)
+    run(sys.argv[1], sys.argv[2], builder=_builder)
