@@ -40,6 +40,26 @@ def stage_lint(_):
          "mxnet_tpu", "tools"], cwd=ROOT)
 
 
+def stage_program_audit_smoke(_):
+    """Non-slow compiled-program gate (ISSUE 20): the TPL3xx audit —
+    live program contracts (collectives/axes/bytes, compiled-cost,
+    donation, family cardinality) extracted on the 8-device reference
+    mesh must diff green against the committed ci/program_manifests/; a
+    seeded manifest mutation must FAIL with the right TPL3xx rule; the
+    deliberately mis-pinned ZeRO grad spec (the PR 7 hazard) must fail
+    TPL301 naming the collective and the axis — then tpulint over the
+    analysis modules."""
+    rc = subprocess.call(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "program_audit_smoke.py")],
+        env=_env_cpu_mesh(8), cwd=ROOT)
+    if rc != 0:
+        return rc
+    return subprocess.call(
+        [sys.executable, "-m", "mxnet_tpu.analysis.lint",
+         os.path.join("mxnet_tpu", "analysis")], cwd=ROOT)
+
+
 def stage_unit(args):
     """Python unit suite on the virtual 8-device CPU mesh."""
     cmd = [sys.executable, "-m", "pytest",
@@ -251,6 +271,7 @@ def stage_bench_smoke(_):
 STAGES = [
     ("build", stage_build),
     ("lint", stage_lint),
+    ("program_audit_smoke", stage_program_audit_smoke),
     ("unit", stage_unit),
     ("train", stage_train),
     ("cpp", stage_cpp),

@@ -1,16 +1,23 @@
-"""mxnet_tpu.analysis — tpulint, the two-level static analysis suite.
+"""mxnet_tpu.analysis — tpulint, the three-level static analysis suite.
 
-Level 1 (`graph_passes`): passes over Symbol graphs and the jaxprs of
-fused/AOT programs — f64 leaks, dead subgraphs/params, donation
-contracts, serving-bucket recompilation hazards, infer_shape drift.
-Hooked (behind ``MXNET_TPU_LINT=1``, see `runtime`) at
+Level 1 (`rules` + `lint` CLI): source AST lint for hot-path host syncs
+and async-subsystem discipline (TPL0xx/1xx). Run it as
+``python -m mxnet_tpu.analysis.lint mxnet_tpu tools`` or via
+``tools/tpulint.py``; the `ci/run.py` ``lint`` stage gates on it.
+
+Level 2 (`graph_passes`): passes over Symbol graphs and the jaxprs of
+fused/AOT programs (TPL2xx) — f64 leaks, dead subgraphs/params,
+donation contracts, serving-bucket recompilation hazards, infer_shape
+drift. Hooked (behind ``MXNET_TPU_LINT=1``, see `runtime`) at
 `Executor.warmup`, the serving program cache's compile, and the fused
 train step build; findings surface through `profiler` counters.
 
-Level 2 (`rules` + `lint` CLI): source AST lint for hot-path host syncs
-and async-subsystem discipline. Run it as
-``python -m mxnet_tpu.analysis.lint mxnet_tpu tools`` or via
-``tools/tpulint.py``; the `ci/run.py` ``lint`` stage gates on it.
+Level 3 (`program_audit`): contract passes over COMPILED XLA programs
+(TPL3xx) — stray collectives, comm-byte drift vs the analytic ideals,
+program-family explosion, peak-memory/donation regressions — diffed
+against committed manifests (ci/program_manifests/). Run via
+``python -m mxnet_tpu.analysis.lint --audit``; the `ci/run.py`
+``program_audit_smoke`` stage gates on it.
 
 Catalog, severities and suppression syntax: docs/faq/analysis.md.
 
@@ -35,6 +42,14 @@ _EXPORTS = {
     "check_traced": "runtime", "lint_enabled": "runtime",
     "report_findings": "runtime",
     "lint_paths": "lint", "find_registry": "lint", "main": "lint",
+    "AUDIT_RULES": "program_audit", "CommPlan": "program_audit",
+    "extract_contract": "program_audit", "audit_contract": "program_audit",
+    "diff_contract": "program_audit", "family_stats": "program_audit",
+    "parse_hlo_collectives": "program_audit",
+    "run_audit": "program_audit", "load_manifest": "program_audit",
+    "write_manifest": "program_audit", "manifest_path": "program_audit",
+    "build_mispinned_zero_unit": "program_audit",
+    "emit_comm_plans_doc": "program_audit",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -1,13 +1,23 @@
-"""tpulint CLI — run the Level-2 AST rules over source trees.
+"""tpulint CLI — the three-level pass stack behind one entry point.
 
 Usage::
 
     python -m mxnet_tpu.analysis.lint mxnet_tpu tools
     python tools/tpulint.py mxnet_tpu tools          # same thing
+    python -m mxnet_tpu.analysis.lint --audit        # TPL3xx program audit
+    python -m mxnet_tpu.analysis.lint --audit --update-manifests
+
+Levels: L1 source rules (TPL0xx/1xx, rules.py) run over .py trees; L2
+jaxpr passes (TPL2xx, graph_passes.py) run at build sites under
+MXNET_TPU_LINT; L3 compiled-program audits (TPL3xx, program_audit.py)
+run here with ``--audit``, diffing live program contracts against the
+committed manifests in ci/program_manifests/ (``--update-manifests``
+re-pins them and regenerates docs/faq/comm_plans.md).
 
 Exit status: 0 when no unsuppressed error-severity findings remain, 1
-otherwise, 2 on usage errors. CI gates on this (`ci/run.py` `lint`
-stage). Rule catalog + suppression syntax: docs/faq/analysis.md.
+otherwise, 2 on usage errors. CI gates on this (`ci/run.py` `lint` and
+`program_audit_smoke` stages). Rule catalog + suppression syntax:
+docs/faq/analysis.md.
 """
 from __future__ import annotations
 
@@ -71,11 +81,99 @@ def lint_paths(paths, registry_text=None, registry_path=None):
     return findings
 
 
+def _rule_level(rid):
+    """Which pass level owns a rule id — the --list-rules column that
+    tells a reader WHERE a rule sees the program (source text, traced
+    jaxpr, or the compiled XLA artifact)."""
+    n = int(rid[3:])
+    if n >= 300:
+        return "L3:compiled"
+    if n >= 200:
+        return "L2:jaxpr"
+    return "L1:source"
+
+
+def _prepare_audit_devices(need=8, can_reexec=False):
+    """--audit needs the 8-device reference mesh. XLA_FLAGS'
+    host-platform device count is read at backend INIT — and importing
+    mxnet_tpu already initializes the backend (the global PRNG key), so
+    by the time main() runs it is too late to set the env in-process.
+    The real CLI re-execs itself once with the flags arranged;
+    programmatic callers (tests, ci) must run under
+    ci/envutil.cpu_mesh_env(8) themselves."""
+    xb = sys.modules.get("jax._src.xla_bridge")
+    backend_live = bool(xb is not None and getattr(xb, "_backends", None))
+    if not backend_live:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=%d"
+                % need).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    if len(jax.devices()) >= need:
+        return True
+    if can_reexec and not os.environ.get("_MXNET_TPU_AUDIT_REEXEC"):
+        env = dict(os.environ,
+                   _MXNET_TPU_AUDIT_REEXEC="1",
+                   JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                              + " --xla_force_host_platform_device_count"
+                                "=%d" % need).strip())
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execve(sys.executable,
+                  [sys.executable, "-m", "mxnet_tpu.analysis.lint"]
+                  + sys.argv[1:], env)
+    print("tpulint: --audit needs %d devices but jax initialized "
+          "with %d (set XLA_FLAGS=--xla_force_host_platform_"
+          "device_count=%d before anything imports jax)"
+          % (need, len(jax.devices()), need), file=sys.stderr)
+    return False
+
+
+def _run_audit(args, can_reexec=False):
+    """The L3 pass: extract live program contracts, audit against their
+    declared comm plans, diff against the committed manifests."""
+    if not _prepare_audit_devices(can_reexec=can_reexec):
+        return 2
+    from .program_audit import (audit_tolerance, emit_comm_plans_doc,
+                                run_audit)
+    findings, contracts = run_audit(
+        names=args.programs or None,
+        update=args.update_manifests,
+        directory=args.manifest_dir,
+        tolerance=audit_tolerance())
+    if args.update_manifests:
+        doc = emit_comm_plans_doc(directory=args.manifest_dir)
+        n_units = sum(len(u) for u in contracts.values())
+        print("tpulint: pinned %d program manifest(s) (%d unit(s)); "
+              "regenerated %s" % (len(contracts), n_units, doc))
+
+    visible = [f for f in findings
+               if args.show_suppressed or not f.suppressed]
+    visible.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    if args.format == "json":
+        print(json.dumps([f.as_dict() for f in visible], indent=2))
+    else:
+        for f in visible:
+            print(format_finding(f))
+
+    active = [f for f in findings if not f.suppressed]
+    n_err = sum(1 for f in active if f.severity == Severity.ERROR)
+    if args.format == "text":
+        print("tpulint: audit: %d program(s), %d finding(s): %d error(s), "
+              "%d suppressed"
+              % (len(contracts), len(active), n_err,
+                 sum(1 for f in findings if f.suppressed)))
+    return 1 if n_err else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="tpulint",
-        description="Static analysis for TPU hot paths and async "
-                    "discipline (docs/faq/analysis.md)")
+        description="Static analysis for TPU hot paths, async discipline "
+                    "and compiled-program contracts (docs/faq/analysis.md)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories to lint (default: mxnet_tpu "
                          "tools, resolved from the repo root)")
@@ -86,13 +184,41 @@ def main(argv=None):
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print findings silenced by pragmas")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--audit", action="store_true",
+                    help="run the TPL3xx compiled-program audit: extract "
+                         "live program contracts on the reference mesh and "
+                         "diff them against ci/program_manifests/")
+    ap.add_argument("--update-manifests", action="store_true",
+                    help="with --audit: re-pin the committed manifests to "
+                         "the live contracts (and regenerate "
+                         "docs/faq/comm_plans.md) instead of diffing")
+    ap.add_argument("--programs", nargs="*", default=None,
+                    help="with --audit: restrict to these core programs "
+                         "(default: all)")
+    ap.add_argument("--manifest-dir", default=None,
+                    help="with --audit: manifest directory (default: "
+                         "ci/program_manifests, or "
+                         "MXNET_TPU_AUDIT_MANIFESTS)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         from .graph_passes import GRAPH_RULES
-        for rid, (slug, sev, desc) in sorted({**RULES, **GRAPH_RULES}.items()):
-            print("%-8s %-18s %-8s %s" % (rid, slug, sev, desc))
+        from .program_audit import AUDIT_RULES
+        for rid, (slug, sev, desc) in sorted(
+                {**RULES, **GRAPH_RULES, **AUDIT_RULES}.items()):
+            print("%-8s %-18s %-8s %-12s %s"
+                  % (rid, slug, sev, _rule_level(rid), desc))
         return 0
+
+    if args.update_manifests and not args.audit:
+        ap.error("--update-manifests requires --audit")
+    if args.audit:
+        if args.paths:
+            ap.error("--audit takes no source paths (it audits compiled "
+                     "programs, not files)")
+        # only the real CLI (argv is None -> sys.argv is the truth) may
+        # re-exec itself to arrange the 8-device host platform
+        return _run_audit(args, can_reexec=argv is None)
 
     if args.paths:
         paths = args.paths

@@ -35,13 +35,17 @@ def report_findings(findings):
     return findings
 
 
-def check_traced(fn, args, where, input_names=None, want_jaxpr=False):
+def check_traced(fn, args, where, input_names=None, want_jaxpr=False,
+                 jaxpr=None):
     """Trace `fn` abstractly (no execution) and run the jaxpr passes.
     Trace failures are swallowed — the analyzer must never break a
     build it is only observing. With ``want_jaxpr`` returns
     ``(findings, closed_jaxpr_or_None)`` so callers needing output avals
     (the donation-aliasing check) reuse the trace instead of paying a
-    second one."""
+    second one. Callers holding a ProgramBuilder pass the builder's own
+    cached trace via ``jaxpr=`` (``builder.jaxpr(*args)``) so lint +
+    cost analysis + the TPL3xx audit share ONE trace per program
+    (ISSUE 20 satellite) instead of re-tracing a throwaway twin here."""
     import jax
     from .. import profiler
     from .graph_passes import run_jaxpr_checks
@@ -49,11 +53,12 @@ def check_traced(fn, args, where, input_names=None, want_jaxpr=False):
     def _ret(findings, jaxpr=None):
         return (findings, jaxpr) if want_jaxpr else findings
 
-    try:
-        jaxpr = jax.make_jaxpr(fn)(*args)
-    except Exception as e:  # pragma: no cover - depends on jax internals
-        _log.debug("tpulint: trace for %s failed: %s", where, e)
-        return _ret([])
+    if jaxpr is None:
+        try:
+            jaxpr = jax.make_jaxpr(fn)(*args)
+        except Exception as e:  # pragma: no cover - depends on jax internals
+            _log.debug("tpulint: trace for %s failed: %s", where, e)
+            return _ret([])
     profiler.record_analysis_check()
     try:
         findings = run_jaxpr_checks(jaxpr, where, input_names)

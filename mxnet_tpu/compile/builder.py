@@ -125,9 +125,11 @@ class ProgramBuilder:
         self._lint_swept = set()     # program keys already swept
         self._lock = threading.Lock()
         self._programs = {}          # full key -> executable | _Pending
+        self._traced = {}            # full key -> jax Traced
         self._lowered = {}           # full key -> jax Lowered
         self._by_shape = {}          # shape key -> executable | _AMBIGUOUS
         self.compiles = 0            # programs built by THIS builder
+        self.traces = 0              # distinct traces performed
         self.lowerings = 0           # distinct lowerings performed
         from .. import profiler as _prof
         _prof.ensure_compile_listener()
@@ -185,12 +187,44 @@ class ProgramBuilder:
         return self._sigs(args)[0]
 
     # ------------------------------------------------------------------
-    # lowering (cached; the memory/cost-analysis entry point)
+    # tracing / lowering (cached; the analysis entry points)
     # ------------------------------------------------------------------
+    def traced(self, *args):
+        """The cached ``jax.stages.Traced`` for these arguments, tracing
+        at most once per distinct program. Every analysis consumer —
+        the jaxpr lint sweep (TPL2xx), ``lowered()``/``program_cost``,
+        and the TPL3xx program audit — derives from this ONE trace;
+        before ISSUE 20 the same program could be traced three times
+        (make_jaxpr for lint, jit.lower for cost, a twin for audit).
+
+        Only analysis entry points retain the Traced; plain dispatch
+        compiles that never asked for analysis let theirs go (see the
+        retention rule on :meth:`lowered`)."""
+        key, _ = self._sigs(args)
+        with self._lock:
+            tr = self._traced.get(key)
+        if tr is not None:
+            return tr
+        tr = self._jit.trace(*args)
+        with self._lock:
+            if key in self._traced:
+                return self._traced[key]
+            self._traced[key] = tr
+            self.traces += 1
+        return tr
+
+    def jaxpr(self, *args):
+        """Closed jaxpr of the program these arguments select — the
+        TPL2xx sweep input, shared with the trace the compile uses
+        (``Traced.jaxpr`` is the same body ``make_jaxpr`` would build,
+        minus the second trace)."""
+        return self.traced(*args).jaxpr
+
     def lowered(self, *args):
-        """The cached ``jax.stages.Lowered`` for these arguments, lowering
-        at most once per distinct program — ``cost_analysis()`` callers
-        (Executor.program_cost) reuse the same lowering the compile does
+        """The cached ``jax.stages.Lowered`` for these arguments, tracing
+        and lowering at most once per distinct program —
+        ``cost_analysis()`` callers (Executor.program_cost) and the
+        program audit reuse the same trace+lowering the compile does
         instead of re-tracing a throwaway twin.
 
         Only THIS entry point retains the Lowered (an analysis consumer
@@ -203,7 +237,7 @@ class ProgramBuilder:
             low = self._lowered.get(key)
         if low is not None:
             return low
-        low = self._jit.lower(*args)
+        low = self.traced(*args).lower()
         with self._lock:
             if key in self._lowered:
                 return self._lowered[key]
@@ -285,11 +319,15 @@ class ProgramBuilder:
                     self.site, e)
         with self._lock:
             lowered = self._lowered.get(key)
+            traced = self._traced.get(key)
         if lowered is None:
             # lower WITHOUT retaining: the executable is what this path
             # is for, and nothing re-reads an un-requested Lowered (see
-            # lowered() for the analysis-consumer retention rule)
-            lowered = self._jit.lower(*args)
+            # lowered() for the analysis-consumer retention rule). A
+            # trace an analysis consumer already paid for IS reused —
+            # lint + audit + compile share one trace per program.
+            lowered = traced.lower() if traced is not None \
+                else self._jit.lower(*args)
             with self._lock:
                 self.lowerings += 1
         # persistent-hit attribution diffs the THREAD-local event count:
@@ -374,6 +412,28 @@ class ProgramBuilder:
         return prog(*args)
 
     # ------------------------------------------------------------------
+    # audit hook (TPL3xx, ISSUE 20) — beside the lint sweep, same seam
+    # ------------------------------------------------------------------
+    def contract(self, *args, **kw):
+        """Extract this program's audited contract (collectives, comm
+        bytes per mesh axis, compiled-cost/memory numbers, realized
+        donation, family cardinality) via analysis.program_audit. Reuses
+        the builder's own cached trace/lowering — never a throwaway
+        twin. Keyword args pass through to ``extract_contract``
+        (``mesh=``, ``plan=``)."""
+        from ..analysis.program_audit import extract_contract
+        return extract_contract(self, args, **kw)
+
+    def program_keys(self):
+        """Full cache keys of the programs this builder compiled — the
+        TPL303 family-cardinality input (keys differing only in
+        weak_type/layout are distinct programs by construction; the
+        audit flags sites where that split actually happened)."""
+        with self._lock:
+            return [k for k, v in self._programs.items()
+                    if not isinstance(v, _Pending)]
+
+    # ------------------------------------------------------------------
     def program_count(self):
         """Number of executables this builder holds (pending compiles
         excluded)."""
@@ -382,11 +442,12 @@ class ProgramBuilder:
                        if not isinstance(v, _Pending))
 
     def stats(self):
-        """Small observability dict: programs/compiles/lowerings."""
+        """Small observability dict: programs/compiles/traces/lowerings."""
         with self._lock:
             programs = sum(1 for v in self._programs.values()
                            if not isinstance(v, _Pending))
             return {"site": self.site, "programs": programs,
                     "compiles": self.compiles,
+                    "traces": self.traces,
                     "lowerings": self.lowerings,
                     "donate_argnums": self._donate_argnums}

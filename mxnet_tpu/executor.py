@@ -263,7 +263,11 @@ class Executor:
                     f, args,
                     "Executor.warmup(%s)" % self._symbol.list_outputs()[:1],
                     # pytree flattening order: sorted dict keys, then rng
-                    input_names=(sorted(arg_sds) + sorted(aux_sds) + ["rng"]))
+                    input_names=(sorted(arg_sds) + sorted(aux_sds) + ["rng"]),
+                    # the builder's cached trace — the compile this hook
+                    # precedes lowers from the SAME Traced, and so do
+                    # program_cost and the TPL3xx audit (ISSUE 20)
+                    jaxpr=self._cached[key].jaxpr(*args))
 
             from .compile.builder import ProgramBuilder
             self._cached[key] = ProgramBuilder(f, site="executor.forward",

@@ -52,7 +52,8 @@ from .collectives import shard_map
 
 __all__ = ["resolve_kernel_tier", "kernel_tier_mode", "flash_attention_mesh",
            "fused_update_mesh", "flash_mesh_roofline",
-           "optupdate_mesh_roofline"]
+           "optupdate_mesh_roofline", "flash_mesh_comm_plan",
+           "optupdate_mesh_comm_plan"]
 
 _ENV_TIER = "MXNET_TPU_MESH_KERNEL_TIER"
 
@@ -313,3 +314,54 @@ def optupdate_mesh_roofline(optimizer, params, mesh, axis_name="dp",
     return {"ideal_bytes": total,
             "per_axis": {axis_name: {"size": dp,
                                      "bytes_per_shard": per_shard}}}
+
+
+# ---------------------------------------------------------------------------
+# Declared comm plans (TPL3xx program audit — analysis/program_audit.py)
+# ---------------------------------------------------------------------------
+
+def flash_mesh_comm_plan(mesh, batch_axis="dp", head_axis="tp"):
+    """The flash-attention island's comm contract: ZERO collectives.
+    Every shard owns full rows (batch over dp, heads over tp, sequence
+    unsharded), so any collective the audit sees in this program is
+    partitioner-injected — exactly the TPL301 failure mode."""
+    from ..analysis.program_audit import CommPlan
+    return CommPlan(site="mesh.flash_attention", allowed=(),
+                    max_programs=1)
+
+
+def optupdate_mesh_comm_plan(optimizer, params, mesh, axis_name="dp",
+                             opt_state=None):
+    """The fused-update island's comm contract: all-gathers over the dp
+    axis regathering fresh params AND float slots from their transient
+    (dp, chunk) blocks. The analytic ideal is exact — per chunkable leaf
+    the gathered buffer is ``dp * chunk * itemsize`` bytes (lane padding
+    included), the same accounting `optupdate_mesh_roofline` banks —
+    so drift beyond tolerance is TPL302, not noise. Grads enter the
+    island replicated (spec P()), so an all-reduce is allowed only for
+    the embedded (step-fused) form, never counted in the ideal."""
+    from ..analysis.program_audit import CommPlan
+    dp = _mesh_axis_size(mesh, axis_name)
+    if dp <= 1:
+        return CommPlan(site="mesh.fused_update", allowed=(),
+                        max_programs=1)
+    gather = 0
+    leaves = list(jax.tree_util.tree_leaves(params))
+    if opt_state is not None:
+        leaves += list(jax.tree_util.tree_leaves(opt_state))
+    for x in leaves:
+        # abstract-friendly _chunkable: plans are built from
+        # ShapeDtypeStructs as often as from live arrays
+        if x is None or getattr(x, "ndim", 0) < 1:
+            continue
+        dt = getattr(x, "dtype", None)
+        dt = jnp.dtype(dt if dt is not None else jnp.asarray(x).dtype)
+        if not jnp.issubdtype(dt, jnp.floating):
+            continue
+        gather += dp * _chunk_size(int(_np.prod(x.shape)), dp) * dt.itemsize
+    return CommPlan(
+        site="mesh.fused_update",
+        allowed=[("all-gather", axis_name, None),
+                 ("all-reduce", axis_name, None)],
+        ideal_bytes_per_axis={axis_name: gather},
+        max_programs=1)

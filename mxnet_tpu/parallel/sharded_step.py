@@ -249,6 +249,39 @@ class ShardedTrainStep:
         self.opt_state = self._shard(self.opt_state, opt_specs)
 
     # ------------------------------------------------------------------
+    def comm_plan(self):
+        """Declared comm contract for the TPL3xx program audit
+        (analysis/program_audit.py). Gradient sums may land on any
+        single mesh axis or axis combination (GSPMD is free to reduce
+        per-axis or jointly, e.g. one all-reduce over ``dp+tp``);
+        weight-update sharding additionally allows the ZeRO pair
+        (reduce-scatter of grads onto the state layout, all-gather of
+        fresh params) over dp. Anything else — a collective over an
+        unexpected axis, or comm on a no-comm program — is TPL301."""
+        from ..analysis.program_audit import CommPlan
+        axes = [a for a in self.mesh.axis_names if self.mesh.shape[a] > 1]
+        if not axes:
+            return CommPlan(site="train.sharded_step", allowed=(),
+                            max_programs=1)
+        allowed = []
+        for a in axes:
+            allowed.append(("all-reduce", a, None))
+        if len(axes) > 1:
+            # joint-group reductions label as "ax1+ax2" (in mesh order)
+            allowed.append(("all-reduce", "+".join(axes), None))
+        if self.shard_update:
+            dp_axis = "dp" if "dp" in self.mesh.axis_names \
+                else self.mesh.axis_names[0]
+            allowed += [("reduce-scatter", dp_axis, None),
+                        ("all-gather", dp_axis, None)]
+        elif self.fused_optupdate:
+            allowed.append(("all-gather",
+                            "dp" if "dp" in self.mesh.axis_names
+                            else self.mesh.axis_names[0], None))
+        return CommPlan(site="train.sharded_step", allowed=allowed,
+                        max_programs=1)
+
+    # ------------------------------------------------------------------
     def warmup(self, batch):
         """Ahead-of-time compile the sharded step from abstract shapes.
         ``batch`` is a pytree of arrays OR ShapeDtypeStruct-likes shaped

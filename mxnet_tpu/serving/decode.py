@@ -379,6 +379,7 @@ class DecodeEngine:
         # when divisible (kvcache.page_sharding), so multi-head K/V —
         # heads folded into the trailing dim — shards by head.
         self._page_sharding = None
+        self._kv_shard_axis = str(kv_shard_axis)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             from .kvcache import page_sharding
@@ -446,6 +447,27 @@ class DecodeEngine:
         """(prefill_programs, step_programs) — the acceptance counters:
         len(prefill_buckets) and exactly 1, flat while serving."""
         return (self._prefill_b.program_count(), self._step_b.program_count())
+
+    def comm_plan(self):
+        """Declared comm contracts for the TPL3xx program audit:
+        ``{"prefill": CommPlan, "step": CommPlan}``. Unmeshed engines
+        are collective-free; with a mesh, the tp-sharded K/V heads fold
+        their partial attention outputs (and the replicated-param
+        matmuls their logits) with all-reduces over the kv-shard axis —
+        anything on another axis is TPL301. Family cardinality pins to
+        len(prefill_buckets) / 1, the same flat-while-serving invariant
+        ``program_counts`` asserts."""
+        from ..analysis.program_audit import CommPlan
+        allowed = ()
+        if self._page_sharding is not None:
+            allowed = (("all-reduce", self._kv_shard_axis, None),
+                       ("all-gather", self._kv_shard_axis, None))
+        return {
+            "prefill": CommPlan(site=self._prefill_b.site, allowed=allowed,
+                                max_programs=len(self.prefill_buckets)),
+            "step": CommPlan(site=self._step_b.site, allowed=allowed,
+                             max_programs=1),
+        }
 
     def _bucket_for(self, n):
         for b in self.prefill_buckets:

@@ -220,7 +220,10 @@ class BucketedProgramCache:
         check_traced(self._fn, args,
                      "serving program (batch=%s)"
                      % sorted((k, tuple(v.shape))
-                              for k, v in batch_sds.items()))
+                              for k, v in batch_sds.items()),
+                     # the builder's cached trace — the compile about to
+                     # happen lowers from the SAME Traced (ISSUE 20)
+                     jaxpr=self._builder.jaxpr(*args))
 
     def _get(self, batch_sds, param_sds, aux_sds, rng_sd, count=True):
         # two threads racing the same bucket produce ONE compile (the
@@ -286,6 +289,17 @@ class BucketedProgramCache:
         rng_sd = self._abstract(tuple(_np.shape(rng)), rng.dtype)
         prog = self._get(batch_sds, param_sds, aux_sds, rng_sd)
         return prog(batch_vals, param_vals, aux_vals, rng)
+
+    def comm_plan(self):
+        """Declared comm contract for the TPL3xx program audit: serving
+        programs are single-program-per-bucket and collective-free (any
+        mesh comm belongs to the model fn, not the cache) — the family
+        cardinality IS the bucket count, which is exactly what TPL303
+        pins (a per-request-shape recompile shows up as programs >
+        len(buckets))."""
+        from ..analysis.program_audit import CommPlan
+        return CommPlan(site=self._builder.site, allowed=(),
+                        max_programs=len(self._buckets))
 
     def stats(self):
         with self._lock:

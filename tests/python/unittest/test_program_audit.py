@@ -1,0 +1,450 @@
+"""TPL3xx compiled-program audit (ISSUE 20, analysis/program_audit.py).
+
+Covers: contract extraction on every core program family; the PR 7
+regression twin (mis-pinned ZeRO grad sharding -> TPL301 naming the
+collective and axis); weak_type program-family splits (TPL303); manifest
+roundtrip / diff / update; manifest-allow + pragma suppression with a
+required reason; the one-trace-per-program satellite (lint + cost +
+audit share the builder's cached Traced); and the zero-env-read
+dispatch contract for the new MXNET_TPU_AUDIT* knobs.
+
+Runs on the 8-device CPU host mesh tests/conftest.py forces.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+from mxnet_tpu.analysis.findings import apply_pragmas  # noqa: E402
+from mxnet_tpu.analysis.program_audit import (  # noqa: E402
+    AUDIT_RULES, CORE_PROGRAMS, CommPlan, audit_contract,
+    build_mispinned_zero_unit, diff_contract, extract_contract,
+    family_stats, load_manifest, manifest_path, parse_hlo_collectives,
+    reference_mesh, run_audit, write_manifest)
+from mxnet_tpu.compile.builder import ProgramBuilder  # noqa: E402
+
+
+def _mesh8():
+    return reference_mesh(4, 2)
+
+
+# ---------------------------------------------------------------------------
+# HLO parsing
+# ---------------------------------------------------------------------------
+
+class TestHLOParsing:
+    def test_iota_replica_groups_map_to_axis(self):
+        mesh = _mesh8()
+        # dp groups on a (4,2) mesh: column-major iota with transpose
+        hlo = ("%ar = f32[344]{0} all-reduce(f32[344]{0} %p), "
+               "channel_id=1, replica_groups=[2,4]<=[4,2]T(1,0), "
+               "use_global_device_ids=true, to_apply=%add")
+        colls = parse_hlo_collectives(hlo, mesh)
+        assert colls == [{"op": "all-reduce", "axis": "dp",
+                          "bytes": 344 * 4, "shape": "f32[344]{0}"}]
+
+    def test_explicit_multi_group_not_truncated(self):
+        mesh = _mesh8()
+        hlo = ("%ag = f32[64]{0} all-gather(f32[16]{0} %p), channel_id=2, "
+               "replica_groups={{0,2,4,6},{1,3,5,7}}, dimensions={0}")
+        (c,) = parse_hlo_collectives(hlo, mesh)
+        assert c["axis"] == "dp" and c["bytes"] == 256
+
+    def test_tp_and_joint_axis_labels(self):
+        mesh = _mesh8()
+        tp = ("%ar = f32[8]{0} all-reduce(f32[8]{0} %p), "
+              "replica_groups={{0,1},{2,3},{4,5},{6,7}}, to_apply=%add")
+        world = ("%ar = f32[8]{0} all-reduce(f32[8]{0} %p), "
+                 "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add")
+        assert parse_hlo_collectives(tp, mesh)[0]["axis"] == "tp"
+        assert parse_hlo_collectives(world, mesh)[0]["axis"] == "dp+tp"
+
+    def test_tuple_shape_bytes_and_async_start(self):
+        mesh = _mesh8()
+        hlo = ("%ags = (f32[16]{0}, f32[64]{0}) all-gather-start("
+               "f32[16]{0} %p), replica_groups={{0,2,4,6},{1,3,5,7}}, "
+               "dimensions={0}\n"
+               "%agd = f32[64]{0} all-gather-done((f32[16]{0}, f32[64]{0})"
+               " %ags)")
+        colls = parse_hlo_collectives(hlo, mesh)
+        # the -done line never double-counts; the -start tuple halves
+        assert len(colls) == 1
+        assert colls[0]["op"] == "all-gather"
+        assert colls[0]["bytes"] == (16 + 64) * 4 // 2
+
+    def test_collective_permute_pairs(self):
+        mesh = _mesh8()
+        hlo = ("%cp = f32[4]{0} collective-permute(f32[4]{0} %p), "
+               "source_target_pairs={{0,2},{2,4},{4,6},{6,0}}")
+        (c,) = parse_hlo_collectives(hlo, mesh)
+        assert c["op"] == "collective-permute" and c["axis"] == "dp"
+
+    def test_non_collective_lines_ignored(self):
+        assert parse_hlo_collectives(
+            "%add = f32[8]{0} add(f32[8]{0} %a, f32[8]{0} %b)",
+            _mesh8()) == []
+
+
+# ---------------------------------------------------------------------------
+# contract extraction on the core programs
+# ---------------------------------------------------------------------------
+
+class TestContractExtraction:
+    def test_all_core_programs_extract_and_audit_green(self):
+        profiler.analysis_counters(reset=True)
+        findings, contracts = run_audit()
+        assert sorted(contracts) == sorted(CORE_PROGRAMS)
+        live = [f for f in findings if not f.suppressed]
+        assert not live, [f.message for f in live]
+        n_units = sum(len(u) for u in contracts.values())
+        assert n_units >= 8
+        assert profiler.analysis_counters()["programs_checked"] >= n_units
+        for prog, units in contracts.items():
+            for unit, c in units.items():
+                assert c["peak_bytes"] > 0, (prog, unit)
+                assert c["programs"] >= 1
+                assert isinstance(c["collective_seq"], list)
+
+    def test_zero_step_comm_matches_analytic_ideal_exactly(self):
+        _, contracts = run_audit(names=["zero_step"])
+        c = contracts["zero_step"]["step"]
+        ops = {e["op"] for e in c["collectives"]}
+        assert ops == {"all-reduce", "all-gather"}
+        assert set(c["comm_bytes_per_axis"]) == {"dp"}
+        man = load_manifest("zero_step")
+        ideal = man["units"]["step"]["plan"]["ideal_bytes_per_axis"]["dp"]
+        assert c["comm_bytes_per_axis"]["dp"] == ideal
+
+    def test_collective_free_programs_stay_collective_free(self):
+        _, contracts = run_audit(names=["executor_fwd", "decode"])
+        for prog in ("executor_fwd", "decode"):
+            for unit, c in contracts[prog].items():
+                assert c["collectives"] == [], (prog, unit)
+
+
+# ---------------------------------------------------------------------------
+# the PR 7 twin: mis-pinned ZeRO grad sharding
+# ---------------------------------------------------------------------------
+
+class TestMispinnedZero:
+    def test_mispin_fires_tpl301_naming_op_and_axis(self):
+        u = build_mispinned_zero_unit(mispin=True)
+        c = extract_contract(u.builder, u.args, mesh=u.mesh, plan=u.plan)
+        findings = audit_contract(c, u.plan, where="test:twin")
+        t301 = [f for f in findings if f.rule_id == "TPL301"]
+        assert t301, [f.rule_id for f in findings]
+        assert "all-gather" in t301[0].message
+        assert "'tp'" in t301[0].message
+        assert "tp" in c["comm_bytes_per_axis"]
+
+    def test_clean_pin_audits_green(self):
+        u = build_mispinned_zero_unit(mispin=False)
+        c = extract_contract(u.builder, u.args, mesh=u.mesh, plan=u.plan)
+        assert audit_contract(c, u.plan, where="test:control") == []
+        assert set(c["comm_bytes_per_axis"]) <= {"dp"}
+
+
+# ---------------------------------------------------------------------------
+# TPL303: weak_type program-family splits
+# ---------------------------------------------------------------------------
+
+class TestFamilySplits:
+    def test_weak_type_split_detected_and_flagged(self):
+        b = ProgramBuilder(lambda x, s: x * s, site="test.family")
+        x = jnp.ones((8,), jnp.float32)
+        b.aot(x, jnp.float32(2.0))   # strong f32 scalar
+        b.aot(x, jnp.asarray(2.0))   # weak f32 scalar -> second program
+        fam = family_stats(b)
+        assert fam["programs"] == 2
+        assert fam["weak_type_splits"] == 1
+        c = extract_contract(b, (x, jnp.float32(2.0)),
+                             plan=CommPlan(site="test.family"))
+        findings = audit_contract(
+            c, CommPlan(site="test.family", max_programs=1),
+            where="test:family")
+        rules = sorted(f.rule_id for f in findings)
+        assert rules == ["TPL303", "TPL303"]  # explosion + split
+
+    def test_distinct_shapes_are_not_a_split(self):
+        b = ProgramBuilder(lambda x: x + 1, site="test.family2")
+        b.aot(jnp.ones((4,), jnp.float32))
+        b.aot(jnp.ones((8,), jnp.float32))
+        fam = family_stats(b)
+        assert fam["programs"] == 2
+        assert fam["weak_type_splits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# manifests: roundtrip, diff, update, suppression
+# ---------------------------------------------------------------------------
+
+def _tiny_contract(**over):
+    c = {"site": "test.prog", "mesh_axes": {"dp": 4, "tp": 2},
+         "collective_seq": ["all-reduce@dp"],
+         "collectives": [{"op": "all-reduce", "axis": "dp", "count": 2,
+                          "bytes": 1024}],
+         "comm_bytes_per_axis": {"dp": 1024}, "flops": 100.0,
+         "bytes_accessed": 4096.0, "argument_bytes": 512,
+         "output_bytes": 512, "temp_bytes": 256, "peak_bytes": 1280,
+         "donation": {"declared": 1, "realized": 2},
+         "programs": 1, "weak_type_splits": 0}
+    c.update(over)
+    return c
+
+
+class TestManifests:
+    def test_roundtrip_preserves_contract_and_plan(self, tmp_path):
+        plan = CommPlan(site="test.prog",
+                        allowed=[("all-reduce", "dp", 4)],
+                        ideal_bytes_per_axis={"dp": 1024})
+        write_manifest("t", {"u": (_tiny_contract(), plan)},
+                       str(tmp_path))
+        man = load_manifest("t", str(tmp_path))
+        assert man["units"]["u"]["comm_bytes_per_axis"] == {"dp": 1024}
+        rp = CommPlan.from_dict(man["units"]["u"]["plan"])
+        assert rp.allows("all-reduce", "dp") == 4
+        assert rp.allows("all-gather", "dp") is None
+        assert diff_contract(_tiny_contract(),
+                             man["units"]["u"]) == []
+
+    def test_missing_manifest_raises_with_update_hint(self, tmp_path):
+        from mxnet_tpu.base import MXNetError
+        with pytest.raises(MXNetError, match="--update-manifests"):
+            load_manifest("nope", str(tmp_path))
+
+    def test_diff_flags_each_regression_class(self):
+        man = _tiny_contract()
+        # new collective -> TPL301
+        live = _tiny_contract(collectives=[
+            {"op": "all-reduce", "axis": "dp", "count": 2, "bytes": 1024},
+            {"op": "all-gather", "axis": "tp", "count": 1, "bytes": 64}],
+            comm_bytes_per_axis={"dp": 1024, "tp": 64})
+        assert {"TPL301", "TPL302"} <= {
+            f.rule_id for f in diff_contract(live, man)}
+        # count growth -> TPL301
+        live = _tiny_contract(collectives=[
+            {"op": "all-reduce", "axis": "dp", "count": 5, "bytes": 1024}])
+        assert any(f.rule_id == "TPL301"
+                   for f in diff_contract(live, man))
+        # byte drift beyond tolerance -> TPL302
+        live = _tiny_contract(comm_bytes_per_axis={"dp": 2048})
+        assert [f.rule_id for f in diff_contract(live, man)] == ["TPL302"]
+        # within tolerance -> green
+        live = _tiny_contract(comm_bytes_per_axis={"dp": 1100})
+        assert diff_contract(live, man) == []
+        # family growth -> TPL303
+        live = _tiny_contract(programs=3)
+        assert [f.rule_id for f in diff_contract(live, man)] == ["TPL303"]
+        # peak regression + lost donation -> TPL304
+        live = _tiny_contract(peak_bytes=99999,
+                              donation={"declared": 1, "realized": 0})
+        assert [f.rule_id for f in diff_contract(live, man)] == [
+            "TPL304", "TPL304"]
+
+    def test_update_preserves_allow_entries(self, tmp_path):
+        plan = CommPlan(site="test.prog")
+        write_manifest("t", {"u": (_tiny_contract(), plan)},
+                       str(tmp_path))
+        path = manifest_path("t", str(tmp_path))
+        with open(path) as f:
+            doc = json.load(f)
+        doc["units"]["u"]["allow"] = [
+            {"slug": "comm-drift", "reason": "known CPU combiner gap"}]
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        write_manifest("t", {"u": (_tiny_contract(), plan)},
+                       str(tmp_path))
+        man = load_manifest("t", str(tmp_path))
+        assert man["units"]["u"]["allow"][0]["slug"] == "comm-drift"
+
+    def test_manifest_allow_suppresses_with_reason(self, tmp_path):
+        man = _tiny_contract()
+        live = _tiny_contract(comm_bytes_per_axis={"dp": 4096})
+        write_manifest("t", {"u": (man, None)}, str(tmp_path))
+        path = manifest_path("t", str(tmp_path))
+        with open(path) as f:
+            doc = json.load(f)
+        doc["units"]["u"]["allow"] = [
+            {"slug": "comm-drift", "reason": "pinned on another backend"}]
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        from mxnet_tpu.analysis.program_audit import _apply_manifest_allows
+        findings = diff_contract(live, doc["units"]["u"])
+        extra = _apply_manifest_allows(
+            findings, doc["units"]["u"]["allow"], "t:u")
+        assert extra == []
+        assert all(f.suppressed for f in findings
+                   if f.rule_id == "TPL302")
+        assert findings[0].suppress_reason == "pinned on another backend"
+
+    def test_bare_allow_entry_raises_tpl000(self):
+        from mxnet_tpu.analysis.program_audit import _apply_manifest_allows
+        extra = _apply_manifest_allows(
+            [], [{"slug": "comm-drift", "reason": ""}], "t:u")
+        assert [f.rule_id for f in extra] == ["TPL000"]
+
+    def test_pragma_machinery_applies_to_audit_findings(self):
+        # audit findings carry path/line like any other Finding, so the
+        # standard source-pragma suppression composes unchanged
+        findings = diff_contract(
+            _tiny_contract(comm_bytes_per_axis={"dp": 4096}),
+            _tiny_contract(), where="fake.py")
+        for f in findings:
+            f.line = 3
+        source = ("x = 1\ny = 2\n"
+                  "z = 3  # tpulint: allow-comm-drift cpu-only\n")
+        extra = apply_pragmas(findings, source, "fake.py")
+        assert all(f.suppressed for f in findings)
+        assert not extra
+
+
+# ---------------------------------------------------------------------------
+# satellites: one trace per program, zero-env-read dispatch, CLI parity
+# ---------------------------------------------------------------------------
+
+class TestOneTracePerProgram:
+    def test_lint_cost_and_audit_share_one_trace(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TPU_LINT", "1")
+
+        def fn(x):
+            return jnp.tanh(x) @ x
+
+        calls = {"hook": 0}
+        holder = {}
+
+        def hook(args):
+            calls["hook"] += 1
+            from mxnet_tpu.analysis.runtime import check_traced
+            check_traced(fn, args, "test.one_trace",
+                         jaxpr=holder["b"].jaxpr(*args))
+
+        b = ProgramBuilder(fn, site="test.one_trace", lint_hook=hook)
+        holder["b"] = b
+        x = jnp.ones((8, 8), jnp.float32)
+        b.aot(x)                      # compile (runs the lint hook)
+        b.lowered(x).cost_analysis()  # cost analysis
+        c = extract_contract(b, (x,), plan=CommPlan(site="test.one_trace"))
+        assert calls["hook"] == 1
+        assert c["programs"] == 1
+        # THE satellite assertion: lint + compile + cost + audit = 1 trace
+        assert b.stats()["traces"] == 1
+
+    def test_plain_dispatch_does_not_retain_lowered(self):
+        b = ProgramBuilder(lambda x: x + 1, site="test.no_retain")
+        x = jnp.ones((4,), jnp.float32)
+        np.testing.assert_allclose(np.asarray(b(x)), np.asarray(x) + 1)
+        # plain dispatch lowers once but retains neither a Traced nor a
+        # Lowered (the lowered() retention rule) — analysis pays for its
+        # own trace, dispatch-only processes never hold HLO
+        assert b.stats()["traces"] == 0
+        assert not b._lowered and not b._traced
+
+
+class TestZeroEnvRead:
+    def test_audit_knobs_never_read_on_dispatch(self, monkeypatch):
+        """MXNET_TPU_AUDIT* are tool-entry knobs: compiled-program
+        dispatch must not consult the environment at all. Poison the
+        repo's single env seam (base.get_env) for audit keys and drive
+        warmed dispatches through it."""
+        import mxnet_tpu.base as base
+        b = ProgramBuilder(lambda x: x * 2, site="test.env")
+        x = jnp.ones((4,), jnp.float32)
+        b.aot(x)  # build outside the poisoned region
+
+        real_get_env = base.get_env
+
+        def poisoned(name, default=None, typ=str):
+            assert not str(name).startswith("MXNET_TPU_AUDIT"), \
+                "dispatch read %s" % name
+            return real_get_env(name, default, typ)
+
+        monkeypatch.setattr(base, "get_env", poisoned)
+        for _ in range(3):
+            jax.block_until_ready(b(x))
+        # the poison itself is live: tool entry DOES trip it
+        from mxnet_tpu.analysis.program_audit import audit_tolerance
+        with pytest.raises(AssertionError, match="MXNET_TPU_AUDIT"):
+            audit_tolerance()
+
+    def test_audit_tol_env_is_read_at_tool_entry(self, monkeypatch):
+        from mxnet_tpu.analysis.program_audit import audit_tolerance
+        monkeypatch.setenv("MXNET_TPU_AUDIT_TOL", "0.5")
+        assert audit_tolerance() == 0.5
+        monkeypatch.delenv("MXNET_TPU_AUDIT_TOL")
+        assert audit_tolerance() == 0.25
+
+    def test_manifest_dir_env_override(self, monkeypatch, tmp_path):
+        from mxnet_tpu.analysis.program_audit import manifest_dir
+        monkeypatch.setenv("MXNET_TPU_AUDIT_MANIFESTS", str(tmp_path))
+        assert manifest_dir() == str(tmp_path)
+        assert manifest_dir("/x") == "/x"  # explicit arg wins
+
+
+class TestCLI:
+    def test_list_rules_includes_tpl3xx_with_level(self, capsys):
+        from mxnet_tpu.analysis.lint import main
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid, (slug, _sev, _d) in AUDIT_RULES.items():
+            assert rid in out and slug in out
+        assert "L3:compiled" in out
+        assert "L2:jaxpr" in out and "L1:source" in out
+
+    def test_audit_json_matches_finding_schema(self):
+        # TPL3xx findings flow through Finding.as_dict — same JSON shape
+        # the TPL1xx CLI emits
+        f = diff_contract(_tiny_contract(programs=3), _tiny_contract())[0]
+        d = f.as_dict()
+        assert sorted(d) == ["col", "line", "message", "path", "rule",
+                             "severity", "slug", "suppress_reason",
+                             "suppressed"]
+        assert d["rule"] == "TPL303"
+        json.dumps(d)  # serializable
+
+    def test_update_manifests_requires_audit_flag(self, capsys):
+        from mxnet_tpu.analysis.lint import main
+        with pytest.raises(SystemExit):
+            main(["--update-manifests"])
+
+
+class TestCommPlans:
+    def test_train_step_plans_cover_their_config(self):
+        mesh = _mesh8()
+        from mxnet_tpu.parallel.tpu_step import DataParallelTrainStep
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+        sym = mx.sym.SoftmaxOutput(fc, name="softmax")
+        st = DataParallelTrainStep(sym, mesh, lr=0.1, momentum=0.9,
+                                   zero=True, fused_optupdate=False)
+        st.init({"data": (16, 8), "softmax_label": (16,)})
+        plan = st.comm_plan()
+        assert plan.allows("all-reduce", "dp") is not None
+        assert plan.allows("all-gather", "dp") is not None
+        assert plan.allows("all-gather", "tp") is None
+        assert plan.ideal_bytes_per_axis["dp"] > 0
+        assert plan.max_programs == 1
+
+    def test_serving_plan_pins_family_to_buckets(self):
+        from mxnet_tpu.serving.program_cache import BucketedProgramCache
+        cache = BucketedProgramCache(lambda b, p, a, r: (b["x"],),
+                                     buckets=(1, 2, 4), donate=False)
+        plan = cache.comm_plan()
+        assert plan.max_programs == 3
+        assert plan.allowed == []
+
+    def test_mesh_kernel_plans(self):
+        mesh = _mesh8()
+        from mxnet_tpu.parallel.mesh_kernels import (
+            flash_mesh_comm_plan, optupdate_mesh_comm_plan)
+        assert flash_mesh_comm_plan(mesh).allowed == []
+        params = {"w": jax.ShapeDtypeStruct((16, 16), np.float32)}
+        plan = optupdate_mesh_comm_plan("sgd", params, mesh, "dp",
+                                        opt_state={"mom": dict(params)})
+        # w: 256 elems -> chunk 128 -> 4*128*4 bytes, x2 for the slot
+        assert plan.ideal_bytes_per_axis["dp"] == 2 * 4 * 128 * 4

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""CI program-audit smoke (`ci/run.py program_audit_smoke` stage, ISSUE 20).
+
+Fast, non-slow gate over the TPL3xx compiled-program audit
+(mxnet_tpu/analysis/program_audit.py):
+
+  * HEAD must audit GREEN: live contracts for every core program
+    (executor fwd, fused step, ZeRO step, mesh kernels, serving buckets,
+    decode prefill+step) extracted on the 8-device reference mesh,
+    checked against their declared comm plans and diffed against the
+    committed ci/program_manifests/ with zero unsuppressed findings,
+    profiler.analysis_counters() agreeing;
+  * the audit must not be a rubber stamp: a seeded manifest mutation per
+    rule (collective erased -> TPL301, pinned comm bytes halved ->
+    TPL302, program family shrunk -> TPL303, peak memory / realized
+    donation lowered -> TPL304) must FAIL with exactly that rule;
+  * the PR 7 regression twin: the REAL ZeRO update island with its grad
+    sharding deliberately mis-pinned over 'tp' must fail TPL301 naming
+    the collective op AND the axis, while the correctly-pinned control
+    audits green against the same plan.
+
+Prints one JSON summary line; non-zero exit on any violated contract.
+Must run under ci/envutil.cpu_mesh_env(8) (ci/run.py arranges it).
+"""
+import copy
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from mxnet_tpu import profiler  # noqa: E402
+from mxnet_tpu.analysis.program_audit import (  # noqa: E402
+    CORE_PROGRAMS, audit_contract, build_mispinned_zero_unit,
+    extract_contract, load_manifest, manifest_path, run_audit)
+
+
+def fail(msg):
+    print("program_audit_smoke: FAIL: %s" % msg)
+    return 1
+
+
+def _mutate_and_expect(manifests, program, unit, mutate, want_rule):
+    """Copy the committed manifests, corrupt ONE pinned fact, re-audit
+    that program, and demand the audit fails with exactly `want_rule`."""
+    tmp = tempfile.mkdtemp(prefix="audit_smoke_")
+    try:
+        for prog in CORE_PROGRAMS:
+            shutil.copy(manifest_path(prog), manifest_path(prog, tmp))
+        path = manifest_path(program, tmp)
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        mutate(doc["units"][unit])
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        findings, _ = run_audit(names=[program], directory=tmp)
+        rules = sorted({f.rule_id for f in findings if not f.suppressed})
+        if want_rule not in rules:
+            return "seeded %s mutation in %s/%s raised %s, wanted %s" % (
+                want_rule, program, unit, rules or "nothing", want_rule)
+        return None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main():
+    summary = {}
+
+    # -- 1. HEAD audits green against the committed manifests ----------
+    profiler.analysis_counters(reset=True)
+    findings, contracts = run_audit()
+    live = [f for f in findings if not f.suppressed]
+    if live:
+        for f in live:
+            print("  unexpected: %s %s" % (f.rule_id, f.message))
+        return fail("%d unsuppressed finding(s) at HEAD — shipped "
+                    "programs must audit green" % len(live))
+    counters = profiler.analysis_counters()
+    n_units = sum(len(u) for u in contracts.values())
+    if counters.get("programs_checked", 0) < n_units:
+        return fail("analysis counters did not record the audit "
+                    "(programs_checked=%r < %d units)"
+                    % (counters.get("programs_checked"), n_units))
+    summary["head_units"] = n_units
+    summary["head_findings"] = 0
+    # the audited per-axis comm bytes, for the dryrun metric bank
+    summary["comm_bytes_per_axis"] = {
+        "%s/%s" % (prog, unit): c["comm_bytes_per_axis"]
+        for prog, units in contracts.items()
+        for unit, c in units.items() if c["comm_bytes_per_axis"]}
+
+    # -- 2. seeded manifest mutations must fail with the right rule ----
+    manifests = {p: load_manifest(p) for p in CORE_PROGRAMS}
+
+    def erase_collective(u):
+        # drop the pinned all-gathers: the live ones become strays
+        u["collectives"] = [c for c in u["collectives"]
+                            if c["op"] != "all-gather"]
+
+    def halve_bytes(u):
+        u["comm_bytes_per_axis"] = {a: b // 2 for a, b in
+                                    u["comm_bytes_per_axis"].items()}
+
+    def shrink_family(u):
+        u["programs"] = u["programs"] - 1
+
+    def lower_peak(u):
+        u["peak_bytes"] = max(1, u["peak_bytes"] // 2)
+        # and pretend more donation was realized than the program does
+        u["donation"] = dict(u["donation"],
+                             realized=u["donation"]["realized"] + 1)
+
+    for program, unit, mutate, rule in (
+            ("zero_step", "step", erase_collective, "TPL301"),
+            ("mesh_kernels", "fused_update", halve_bytes, "TPL302"),
+            ("serving_buckets", "bucket4", shrink_family, "TPL303"),
+            ("fused_step", "step", lower_peak, "TPL304")):
+        err = _mutate_and_expect(manifests, program, unit, mutate, rule)
+        if err:
+            return fail(err)
+    summary["mutations_caught"] = ["TPL301", "TPL302", "TPL303", "TPL304"]
+
+    # -- 3. the PR 7 twin: mis-pinned ZeRO grad spec fails TPL301 ------
+    twin = build_mispinned_zero_unit(mispin=True)
+    c = extract_contract(twin.builder, twin.args, mesh=twin.mesh,
+                         plan=twin.plan)
+    twin_findings = audit_contract(c, twin.plan, where="smoke:twin")
+    t301 = [f for f in twin_findings if f.rule_id == "TPL301"]
+    if not t301:
+        return fail("mis-pinned ZeRO grad spec did not raise TPL301 "
+                    "(got %s)" % sorted(f.rule_id for f in twin_findings))
+    msg = t301[0].message
+    if "all-gather" not in msg or "'tp'" not in msg:
+        return fail("TPL301 must name the collective and the axis; got: "
+                    "%s" % msg)
+    control = build_mispinned_zero_unit(mispin=False)
+    cc = extract_contract(control.builder, control.args,
+                          mesh=control.mesh, plan=control.plan)
+    control_findings = audit_contract(cc, control.plan,
+                                      where="smoke:control")
+    if control_findings:
+        return fail("correctly-pinned ZeRO control must audit green; "
+                    "got %s" % sorted(f.rule_id for f in control_findings))
+    summary["mispinned_zero"] = {
+        "tpl301": msg.split(" in ")[0],
+        "stray_axes": sorted(a for a in c["comm_bytes_per_axis"]
+                             if a not in cc["comm_bytes_per_axis"])}
+
+    print("program_audit_smoke: %s" % json.dumps(summary, sort_keys=True))
+    print("program_audit_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
